@@ -1,0 +1,154 @@
+"""Pallas (Mosaic) kernels for fixed-width JCUDF row conversion.
+
+TPU analogue of the reference's tiled CUDA kernels (``copy_to_rows``
+``row_conversion.cu:575-693``, ``copy_from_rows`` ``:892-993``): where the
+reference stages 48KB shared-memory tiles per CUDA block and moves bytes with
+``cuda::memcpy_async`` warps, here each grid step owns a VMEM-resident block
+of rows (VMEM is ~16MB/core, so tiles are thousands of rows, not 144 bytes)
+and the per-column byte moves are static-offset vector stores that Mosaic
+turns into VMEM shuffles.  The grid pipeline gives the HBM->VMEM->HBM double
+buffering the reference hand-rolls (``row_conversion.cu:105-113``).
+
+Schema specialization happens at trace time: the Python loop over columns
+unrolls into a fixed kernel per schema, the way the reference specializes via
+the ``col_offsets``/``col_sizes`` device arrays (``row_conversion.cu:1748``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from spark_rapids_jni_tpu.table import Column, Table, pack_bools
+from spark_rapids_jni_tpu.ops.row_layout import RowLayout
+from spark_rapids_jni_tpu.ops import row_conversion as rc
+
+# Rows per grid step.  A 212-column/1KB-row tile at 512 rows is ~0.5MB in
+# VMEM for the output block plus ~the same across inputs — well under the
+# ~16MB budget, large enough to amortize DMA.
+DEFAULT_TILE_ROWS = 512
+
+
+def _pad_rows(arr: jnp.ndarray, n_padded: int) -> jnp.ndarray:
+    n = arr.shape[0]
+    if n == n_padded:
+        return arr
+    pad = [(0, n_padded - n)] + [(0, 0)] * (arr.ndim - 1)
+    return jnp.pad(arr, pad)
+
+
+# ---------------------------------------------------------------------------
+# to rows
+# ---------------------------------------------------------------------------
+
+def _to_rows_kernel(layout: RowLayout, *refs):
+    *in_refs, out_ref = refs
+    ncols = layout.num_columns
+    col_refs = in_refs[:ncols]
+    validity_ref = in_refs[ncols]
+    out_ref[...] = jnp.zeros(out_ref.shape, dtype=jnp.uint8)
+    for i in range(ncols):
+        s, sz = layout.col_starts[i], layout.col_sizes[i]
+        out_ref[:, s:s + sz] = col_refs[i][...]
+    out_ref[:, layout.validity_offset:
+            layout.validity_offset + layout.validity_bytes] = validity_ref[...]
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def _to_rows_pallas(table: Table, layout: RowLayout,
+                    tile_rows: int, interpret: bool) -> jnp.ndarray:
+    n = table.num_rows
+    n_padded = max(tile_rows, (n + tile_rows - 1) // tile_rows * tile_rows)
+    grid = (n_padded // tile_rows,)
+
+    col_bytes = [_pad_rows(rc.col_to_bytes(c.data), n_padded)
+                 for c in table.columns]
+    validity = _pad_rows(rc._validity_row_bytes(table, layout), n_padded)
+
+    in_specs = [
+        pl.BlockSpec((tile_rows, b.shape[1]), lambda r: (r, 0),
+                     memory_space=pltpu.VMEM)
+        for b in col_bytes
+    ]
+    in_specs.append(pl.BlockSpec((tile_rows, max(1, layout.validity_bytes)),
+                                 lambda r: (r, 0), memory_space=pltpu.VMEM))
+    out_spec = pl.BlockSpec((tile_rows, layout.fixed_row_size),
+                            lambda r: (r, 0), memory_space=pltpu.VMEM)
+
+    rows = pl.pallas_call(
+        functools.partial(_to_rows_kernel, layout),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_spec,
+        out_shape=jax.ShapeDtypeStruct((n_padded, layout.fixed_row_size),
+                                       jnp.uint8),
+        interpret=interpret,
+    )(*col_bytes, validity)
+    return rows[:n]
+
+
+def to_rows_fixed(table: Table, layout: RowLayout,
+                  tile_rows: int = DEFAULT_TILE_ROWS,
+                  interpret: bool = False) -> jnp.ndarray:
+    """[n, fixed_row_size] uint8 row matrix via the Pallas tiled kernel."""
+    return _to_rows_pallas(table, layout, tile_rows, interpret)
+
+
+# ---------------------------------------------------------------------------
+# from rows
+# ---------------------------------------------------------------------------
+
+def _from_rows_kernel(layout: RowLayout, rows_ref, *out_refs):
+    ncols = layout.num_columns
+    for i in range(ncols):
+        s, sz = layout.col_starts[i], layout.col_sizes[i]
+        out_refs[i][...] = rows_ref[:, s:s + sz]
+    out_refs[ncols][...] = rows_ref[:, layout.validity_offset:
+                                    layout.validity_offset +
+                                    layout.validity_bytes]
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def _from_rows_pallas(rows2d: jnp.ndarray, layout: RowLayout,
+                      tile_rows: int, interpret: bool):
+    n = rows2d.shape[0]
+    n_padded = max(tile_rows, (n + tile_rows - 1) // tile_rows * tile_rows)
+    grid = (n_padded // tile_rows,)
+    rows2d = _pad_rows(rows2d, n_padded)
+
+    out_shapes = [jax.ShapeDtypeStruct((n_padded, sz), jnp.uint8)
+                  for sz in layout.col_sizes]
+    out_shapes.append(jax.ShapeDtypeStruct(
+        (n_padded, max(1, layout.validity_bytes)), jnp.uint8))
+    out_specs = [pl.BlockSpec((tile_rows, s.shape[1]), lambda r: (r, 0),
+                              memory_space=pltpu.VMEM) for s in out_shapes]
+
+    outs = pl.pallas_call(
+        functools.partial(_from_rows_kernel, layout),
+        grid=grid,
+        in_specs=[pl.BlockSpec((tile_rows, layout.fixed_row_size),
+                               lambda r: (r, 0), memory_space=pltpu.VMEM)],
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(rows2d)
+
+    byte_cols, vbytes = outs[:-1], outs[-1]
+    cols: List[Column] = []
+    for i, dt in enumerate(layout.dtypes):
+        b = byte_cols[i][:n]
+        valid = ((vbytes[:n, i // 8] >> (i % 8)) & 1).astype(jnp.bool_)
+        data = rc.bytes_to_col(b, dt.np_dtype)
+        cols.append(Column(dt, data, pack_bools(valid)))
+    return cols
+
+
+def from_rows_fixed(rows2d: jnp.ndarray, layout: RowLayout,
+                    tile_rows: int = DEFAULT_TILE_ROWS,
+                    interpret: bool = False) -> List[Column]:
+    return _from_rows_pallas(rows2d, layout, tile_rows, interpret)

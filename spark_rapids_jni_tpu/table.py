@@ -1,0 +1,291 @@
+"""Columnar containers: the cudf ``column``/``table_view`` analogue as JAX pytrees.
+
+Design (TPU-first, not a cudf port):
+
+- A :class:`Column` is a pytree of device arrays: ``data`` plus an optional
+  packed ``validity`` bitmask, plus ``offsets``/``chars`` for strings.  All
+  leaves are plain ``jnp`` arrays so any column/table flows through ``jit``,
+  ``shard_map`` and ``pjit`` unchanged; the static schema (dtype) lives in
+  pytree aux data so XLA re-specializes per schema, never per data.
+- Validity is a packed little-endian bitmask over rows: byte ``r // 8``,
+  bit ``r % 8``; ``1`` means valid.  This matches cudf's bitmask bit order
+  (reference ``row_conversion.cu:753-777`` reads ``bitmask_type`` words with
+  LSB = first row) but is stored byte-granular, which is what the JCUDF row
+  format itself uses.
+- Strings use Arrow layout: ``offsets`` (int32, ``num_rows + 1``) into a flat
+  ``chars`` uint8 buffer (cudf ``strings_column_view``, used by reference
+  ``row_conversion.cu:216-261``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# DTypes
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class DType:
+    """Logical column type.
+
+    ``kind`` is one of the names below; ``itemsize`` is the fixed-width byte
+    size (8 == offset/length pair for strings, mirroring the reference's
+    compound-type handling in ``row_conversion.cu:1342-1351``); ``scale`` is
+    used by decimal types (cudf stores decimal scale out-of-band, reference
+    ``RowConversionJni.cpp:43-66`` passes it as a parallel int array).
+    """
+
+    kind: str
+    itemsize: int
+    scale: int = 0
+
+    @property
+    def is_string(self) -> bool:
+        return self.kind == "string"
+
+    @property
+    def is_fixed_width(self) -> bool:
+        return not self.is_string
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(_NP_DTYPES[self.kind])
+
+    def __repr__(self) -> str:  # compact, hashable-friendly
+        if self.kind.startswith("decimal"):
+            return f"{self.kind}(scale={self.scale})"
+        return self.kind
+
+
+_NP_DTYPES = {
+    "int8": np.int8, "int16": np.int16, "int32": np.int32, "int64": np.int64,
+    "uint8": np.uint8, "uint16": np.uint16, "uint32": np.uint32,
+    "uint64": np.uint64,
+    "float32": np.float32, "float64": np.float64,
+    "bool8": np.uint8,
+    "decimal32": np.int32, "decimal64": np.int64,
+    # strings cross the row boundary as a uint32 (offset, length) pair
+    "string": np.uint8,
+}
+
+INT8 = DType("int8", 1)
+INT16 = DType("int16", 2)
+INT32 = DType("int32", 4)
+INT64 = DType("int64", 8)
+UINT8 = DType("uint8", 1)
+UINT16 = DType("uint16", 2)
+UINT32 = DType("uint32", 4)
+UINT64 = DType("uint64", 8)
+FLOAT32 = DType("float32", 4)
+FLOAT64 = DType("float64", 8)
+BOOL8 = DType("bool8", 1)
+STRING = DType("string", 8)
+
+
+def decimal32(scale: int = 0) -> DType:
+    return DType("decimal32", 4, scale)
+
+
+def decimal64(scale: int = 0) -> DType:
+    return DType("decimal64", 8, scale)
+
+
+ALL_FIXED_WIDTH = (INT8, INT16, INT32, INT64, UINT8, UINT16, UINT32, UINT64,
+                   FLOAT32, FLOAT64, BOOL8)
+
+
+# ---------------------------------------------------------------------------
+# Validity helpers (packed byte bitmask, LSB-first)
+# ---------------------------------------------------------------------------
+
+def pack_bools(valid: jnp.ndarray) -> jnp.ndarray:
+    """Pack a bool[n] array into a uint8[ceil(n/8)] LSB-first bitmask."""
+    n = valid.shape[0]
+    nbytes = (n + 7) // 8
+    padded = jnp.zeros((nbytes * 8,), dtype=jnp.uint8).at[:n].set(
+        valid.astype(jnp.uint8))
+    bits = padded.reshape(nbytes, 8)
+    weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))
+    # dot in int32 then cast down; uint8 accumulate is fine (max 255)
+    return jnp.sum(bits.astype(jnp.int32) * weights.astype(jnp.int32),
+                   axis=1).astype(jnp.uint8)
+
+
+def unpack_bools(mask: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Unpack a uint8 LSB-first bitmask into bool[n]."""
+    bits = (mask[:, None] >> jnp.arange(8, dtype=jnp.uint8)[None, :]) & 1
+    return bits.reshape(-1)[:n].astype(jnp.bool_)
+
+
+# ---------------------------------------------------------------------------
+# Column
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Column:
+    """One column of a table.
+
+    Fixed width: ``data`` has shape ``[num_rows]`` with the logical dtype.
+    String: ``data`` is unused (kept as a 0-length placeholder), ``offsets``
+    is int32 ``[num_rows + 1]`` and ``chars`` is uint8 ``[total_bytes]``.
+    ``validity`` is a packed uint8 bitmask ``[ceil(num_rows / 8)]`` or None
+    (all rows valid).
+    """
+
+    dtype: DType
+    data: jnp.ndarray
+    validity: Optional[jnp.ndarray] = None
+    offsets: Optional[jnp.ndarray] = None
+    chars: Optional[jnp.ndarray] = None
+
+    # -- construction -----------------------------------------------------
+
+    @staticmethod
+    def from_numpy(values: np.ndarray, dtype: DType,
+                   valid: Optional[np.ndarray] = None) -> "Column":
+        vals = np.ascontiguousarray(np.asarray(values, dtype=dtype.np_dtype))
+        if dtype.itemsize == 8 and not jax.config.jax_enable_x64:
+            # TPU has no native 64-bit lanes and without x64 JAX would
+            # silently downcast; store as little-endian uint32 pairs [n, 2].
+            # Row conversion only moves bytes, so this is lossless.
+            data = jnp.asarray(vals.view(np.uint32).reshape(-1, 2))
+        else:
+            data = jnp.asarray(vals)
+        validity = None
+        if valid is not None:
+            validity = pack_bools(jnp.asarray(np.asarray(valid, dtype=bool)))
+        return Column(dtype, data, validity)
+
+    @staticmethod
+    def strings(values: Sequence[Optional[str]]) -> "Column":
+        """Build a string column from Python strings (None => null)."""
+        enc = [(s.encode("utf-8") if s is not None else b"") for s in values]
+        lens = np.fromiter((len(b) for b in enc), dtype=np.int32,
+                           count=len(enc))
+        offsets = np.zeros(len(enc) + 1, dtype=np.int32)
+        np.cumsum(lens, out=offsets[1:])
+        chars = np.frombuffer(b"".join(enc), dtype=np.uint8).copy()
+        validity = None
+        if any(s is None for s in values):
+            valid = np.fromiter((s is not None for s in values), dtype=bool,
+                                count=len(values))
+            validity = pack_bools(jnp.asarray(valid))
+        return Column(STRING, jnp.zeros((0,), jnp.uint8), validity,
+                      jnp.asarray(offsets), jnp.asarray(chars))
+
+    # -- properties -------------------------------------------------------
+
+    @property
+    def num_rows(self) -> int:
+        if self.dtype.is_string:
+            return self.offsets.shape[0] - 1
+        return self.data.shape[0]
+
+    def valid_bools(self) -> jnp.ndarray:
+        if self.validity is None:
+            return jnp.ones((self.num_rows,), dtype=jnp.bool_)
+        return unpack_bools(self.validity, self.num_rows)
+
+    # -- host conversion (tests / debugging) -------------------------------
+
+    def to_pylist(self):
+        n = self.num_rows
+        valid = np.asarray(self.valid_bools())
+        if self.dtype.is_string:
+            offs = np.asarray(self.offsets)
+            chars = np.asarray(self.chars).tobytes()
+            return [chars[offs[i]:offs[i + 1]].decode("utf-8")
+                    if valid[i] else None for i in range(n)]
+        vals = np.asarray(self.data)
+        if vals.ndim == 2:  # 64-bit column stored as uint32 pairs
+            vals = np.ascontiguousarray(vals).view(
+                self.dtype.np_dtype).reshape(-1)
+        if self.dtype.kind == "bool8":
+            return [bool(vals[i]) if valid[i] else None for i in range(n)]
+        return [vals[i].item() if valid[i] else None for i in range(n)]
+
+    # -- pytree ------------------------------------------------------------
+
+    def tree_flatten(self):
+        children = (self.data, self.validity, self.offsets, self.chars)
+        return children, self.dtype
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, validity, offsets, chars = children
+        return cls(aux, data, validity, offsets, chars)
+
+
+# ---------------------------------------------------------------------------
+# Table
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Table:
+    """An ordered set of equal-length columns (cudf ``table_view`` analogue)."""
+
+    columns: tuple
+
+    def __post_init__(self):
+        self.columns = tuple(self.columns)
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    @property
+    def num_rows(self) -> int:
+        return self.columns[0].num_rows if self.columns else 0
+
+    @property
+    def dtypes(self) -> tuple:
+        return tuple(c.dtype for c in self.columns)
+
+    def column(self, i: int) -> Column:
+        return self.columns[i]
+
+    def to_pydict(self):
+        return {i: c.to_pylist() for i, c in enumerate(self.columns)}
+
+    def tree_flatten(self):
+        return tuple(self.columns), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        del aux
+        return cls(tuple(children))
+
+
+def assert_tables_equivalent(a: Table, b: Table, *, check_nulls: bool = True):
+    """Test oracle: equality that ignores data under null rows (the semantics
+    of ``CUDF_TEST_EXPECT_TABLES_EQUIVALENT``, reference
+    ``src/main/cpp/tests/row_conversion.cpp:58-59``)."""
+    assert a.num_columns == b.num_columns, (a.num_columns, b.num_columns)
+    assert a.num_rows == b.num_rows
+    for i, (ca, cb) in enumerate(zip(a.columns, b.columns)):
+        assert ca.dtype.kind == cb.dtype.kind, (i, ca.dtype, cb.dtype)
+        va = np.asarray(ca.valid_bools())
+        vb = np.asarray(cb.valid_bools())
+        np.testing.assert_array_equal(va, vb, err_msg=f"column {i} validity")
+        if ca.dtype.is_string:
+            la = ca.to_pylist()
+            lb = cb.to_pylist()
+            assert la == lb, f"column {i} strings differ"
+        else:
+            da = np.asarray(ca.data)
+            db = np.asarray(cb.data)
+            if check_nulls:
+                ma = va[:, None] if da.ndim == 2 else va
+                mb = vb[:, None] if db.ndim == 2 else vb
+                da = np.where(ma, da, 0)
+                db = np.where(mb, db, 0)
+            np.testing.assert_array_equal(da, db, err_msg=f"column {i} data")

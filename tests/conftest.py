@@ -1,0 +1,37 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+The container's sitecustomize registers the axon TPU backend at interpreter
+startup, so JAX is already imported when this conftest runs; we therefore
+steer tests to CPU via ``jax_default_device`` (all test arrays land on cpu:0)
+and size the CPU platform to 8 virtual devices for the distributed-layer
+tests (the reference leaves multi-node to Spark; our parallel/ layer is
+tested on this virtual mesh, see SURVEY.md §5).
+"""
+
+import os
+
+# must precede first use of the (lazily created) CPU client
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+CPU_DEVICES = jax.devices("cpu")
+jax.config.update("jax_default_device", CPU_DEVICES[0])
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def cpu_devices():
+    assert len(CPU_DEVICES) >= 8, "need 8 virtual CPU devices"
+    return CPU_DEVICES

@@ -1,0 +1,63 @@
+"""Layout calculator tests against the documented JCUDF contract
+(reference javadoc ``RowConversion.java:40-99``)."""
+
+import pytest
+
+from spark_rapids_jni_tpu import (
+    BOOL8, INT16, INT32, INT64, INT8, FLOAT32, FLOAT64, STRING,
+)
+from spark_rapids_jni_tpu.ops import compute_row_layout
+
+
+def test_javadoc_example_a_b_c():
+    # | A BOOL8 | P | B INT16 | C INT32 | -> A@0, B@2, C@4, validity@8, row=16
+    lay = compute_row_layout([BOOL8, INT16, INT32])
+    assert lay.col_starts == (0, 2, 4)
+    assert lay.col_sizes == (1, 2, 4)
+    assert lay.validity_offset == 8
+    assert lay.validity_bytes == 1
+    assert lay.fixed_row_size == 16
+
+
+def test_javadoc_example_reordered():
+    # ordered C, B, A -> | C x4 | B x2 | A | V | = 8 bytes total
+    lay = compute_row_layout([INT32, INT16, BOOL8])
+    assert lay.col_starts == (0, 4, 6)
+    assert lay.validity_offset == 7
+    assert lay.fixed_row_size == 8
+
+
+def test_single_int64():
+    lay = compute_row_layout([INT64])
+    assert lay.col_starts == (0,)
+    assert lay.validity_offset == 8
+    assert lay.fixed_row_size == 16
+
+
+def test_many_columns_validity_bytes():
+    lay = compute_row_layout([INT8] * 9)
+    assert lay.validity_offset == 9
+    assert lay.validity_bytes == 2
+    assert lay.fixed_row_size == 16
+
+
+def test_string_slot_is_8_bytes_4_aligned():
+    lay = compute_row_layout([INT8, STRING, INT64])
+    # int8@0, string pair aligned to 4 -> @4 (8 bytes), int64 aligned to 8 -> @16
+    assert lay.col_starts == (0, 4, 16)
+    assert lay.variable_starts == (4,)
+    assert lay.validity_offset == 24
+    assert lay.fixed_row_size == 32
+    assert lay.has_strings
+
+
+def test_row_size_limit_enforced():
+    with pytest.raises(ValueError):
+        compute_row_layout([FLOAT64] * 200)  # 1600B fixed > 1KB contract
+
+
+def test_alignment_padding_between_columns():
+    lay = compute_row_layout([INT8, INT64, INT16, FLOAT32])
+    assert lay.col_starts == (0, 8, 16, 20)
+    assert lay.validity_offset == 24
+    assert lay.fixed_row_size == 32
