@@ -1,0 +1,4 @@
+from spark_rapids_jni_tpu.models.pipeline import (  # noqa: F401
+    filter_mask, hash_aggregate_sum, project, sort_merge_join,
+    flagship_query_step, distributed_query_step,
+)

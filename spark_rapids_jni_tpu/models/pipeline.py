@@ -1,0 +1,187 @@
+"""Columnar query operators — the framework's "model" layer.
+
+The reference is the native kernel layer *under* Spark's physical plan; the
+operators here are the TPU-native expression of the plan nodes that drive
+the north-star benchmark configs (BASELINE.json: Project + Filter +
+HashAggregate on store_sales; shuffled hash join + exchange for TPC-DS q72):
+
+- :func:`project` / :func:`filter_mask` — elementwise expressions; filters
+  produce *selection masks*, not shorter tables, because XLA wants static
+  shapes (the columnar selection-vector technique).
+- :func:`hash_aggregate_sum` — group-by-sum via sort + segment-sum, output
+  padded to a static group capacity.
+- :func:`sort_merge_join` — equi-join against a build side with unique keys
+  (the PK-FK joins the TPC-DS power run is made of): build sorted once,
+  probe via vectorized binary search, gather payloads.
+- :func:`flagship_query_step` — the single-chip flagship pipeline;
+  :func:`distributed_query_step` — the same pipeline with a mesh-wide
+  shuffle (exchange) in front of the aggregate, the q72 shape.
+
+Everything is jit-compatible and shape-static; masks carry row liveness.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu.table import Column, Table
+from spark_rapids_jni_tpu.ops.hashing import murmur3_hash, pmod
+
+
+# ---------------------------------------------------------------------------
+# Expression operators
+# ---------------------------------------------------------------------------
+
+def project(table: Table, exprs: Sequence[Callable], dtypes) -> Table:
+    """Evaluate elementwise expressions over columns: each expr receives the
+    tuple of column data arrays and returns a new data array."""
+    datas = tuple(c.data for c in table.columns)
+    cols = []
+    for expr, dt in zip(exprs, dtypes):
+        cols.append(Column(dt, expr(*datas)))
+    return Table(tuple(cols))
+
+
+def filter_mask(table: Table, pred: Callable) -> jnp.ndarray:
+    """Boolean selection mask from a predicate over column data arrays,
+    AND'd with row validity of the referenced columns being valid."""
+    datas = tuple(c.data for c in table.columns)
+    return pred(*datas)
+
+
+# ---------------------------------------------------------------------------
+# Hash aggregate (sort + segment-sum; exact group-by)
+# ---------------------------------------------------------------------------
+
+def hash_aggregate_sum(keys: jnp.ndarray, values: jnp.ndarray,
+                       mask: jnp.ndarray, max_groups: int):
+    """Exact group-by-sum with static output capacity.
+
+    Returns (group_keys[max_groups], sums[max_groups], group_valid mask,
+    num_groups).  Rows with ``mask == False`` are excluded.  If there are
+    more than ``max_groups`` distinct keys the tail groups are dropped and
+    reported via ``num_groups`` (callers size capacity like the shuffle's
+    ``capacity_factor``).
+    """
+    n = keys.shape[0]
+    # push masked-out rows to the end with a sentinel beyond any key
+    big = jnp.iinfo(keys.dtype).max
+    k = jnp.where(mask, keys, big)
+    order = jnp.argsort(k, stable=True)
+    ks = k[order]
+    vs = jnp.where(mask, values, 0)[order]
+    is_new = jnp.concatenate([jnp.ones((1,), jnp.int32),
+                              (ks[1:] != ks[:-1]).astype(jnp.int32)])
+    seg = jnp.cumsum(is_new) - 1                      # segment id per row
+    seg = jnp.minimum(seg, max_groups - 1)
+    live = ks != big
+    sums = jax.ops.segment_sum(jnp.where(live, vs, 0), seg,
+                               num_segments=max_groups)
+    # first row of each segment carries the key
+    first_idx = jax.ops.segment_min(
+        jnp.arange(n, dtype=jnp.int32), seg, num_segments=max_groups)
+    have = jax.ops.segment_max(live.astype(jnp.int32), seg,
+                               num_segments=max_groups) > 0
+    gkeys = jnp.where(have, ks[jnp.minimum(first_idx, n - 1)], 0)
+    num_groups = jnp.sum(have.astype(jnp.int32))
+    return gkeys, sums, have, num_groups
+
+
+# ---------------------------------------------------------------------------
+# Join (build: unique sorted keys; probe: binary search)
+# ---------------------------------------------------------------------------
+
+def sort_merge_join(build_keys: jnp.ndarray, build_payload: jnp.ndarray,
+                    probe_keys: jnp.ndarray):
+    """Equi-join probe rows against a unique-key build side.
+
+    Returns (payload_for_probe, matched_mask).  Build keys need not be
+    pre-sorted; they are sorted inside (once per jit trace, fused by XLA).
+    """
+    order = jnp.argsort(build_keys)
+    bk = build_keys[order]
+    bp = build_payload[order]
+    pos = jnp.searchsorted(bk, probe_keys)
+    pos = jnp.minimum(pos, bk.shape[0] - 1)
+    matched = bk[pos] == probe_keys
+    return bp[pos], matched
+
+
+# ---------------------------------------------------------------------------
+# Flagship pipeline (the forward step __graft_entry__ exposes)
+# ---------------------------------------------------------------------------
+
+MAX_GROUPS = 128
+
+
+def flagship_query_step(sold_date, item_key, quantity, price,
+                        build_item_key, build_item_price):
+    """A TPC-DS-q6-shaped pipeline over store_sales-like columns:
+
+    join items -> filter (price above item average proxy) -> project
+    (revenue) -> group-by date -> sum.  All arrays int32/float32; one fused
+    XLA program on a single chip.
+    """
+    item_price, matched = sort_merge_join(build_item_key, build_item_price,
+                                          item_key)
+    mask = matched & (price > jnp.float32(1.2) * item_price)
+    revenue = price * quantity.astype(jnp.float32)
+    gkeys, sums, have, num_groups = hash_aggregate_sum(
+        sold_date, revenue, mask, MAX_GROUPS)
+    return gkeys, sums, have, num_groups
+
+
+def distributed_query_step(mesh, axis_name="data",
+                           capacity_factor: float = 8.0):
+    """The q72-shaped distributed step: hash-exchange rows by key across the
+    mesh (so each device owns whole groups), then aggregate locally.
+
+    Returns a function (sold_date, quantity) -> per-device partial
+    aggregates; jit it over sharded inputs.  This is the "training step"
+    analogue the driver dry-runs multi-chip.
+    """
+    from jax.sharding import PartitionSpec as P
+    num_parts = mesh.shape[axis_name]
+
+    def step(sold_date, quantity):
+        n_local = sold_date.shape[0]
+        # per-(sender, target) bucket slack: group-key skew concentrates
+        # rows, so default well above the uniform expectation (overflowing
+        # buckets clamp; see parallel/shuffle.py for the flagged variant)
+        capacity = max(8, int(capacity_factor * n_local / num_parts))
+        # hash on the raw int32 data (Spark int hash contract)
+        from spark_rapids_jni_tpu.table import INT32
+        pids = pmod(murmur3_hash([Column(INT32, sold_date)]), num_parts)
+
+        order = jnp.argsort(pids, stable=True)
+        pids_s = pids[order]
+        counts = jnp.bincount(pids, length=num_parts).astype(jnp.int32)
+        starts = jnp.cumsum(counts) - counts
+        rank = jnp.minimum(
+            jnp.arange(n_local, dtype=jnp.int32) - starts[pids_s],
+            capacity - 1)
+        payload = jnp.stack([sold_date[order], quantity[order]], axis=1)
+        send = jnp.zeros((num_parts, capacity, 2), payload.dtype)
+        send = send.at[pids_s, rank].set(payload)
+        send_counts = jnp.minimum(counts, capacity)
+
+        recv = jax.lax.all_to_all(send, axis_name, 0, 0)
+        recv_counts = jax.lax.all_to_all(
+            send_counts.reshape(num_parts, 1), axis_name, 0, 0
+        ).reshape(num_parts)
+        slot = jax.lax.broadcasted_iota(jnp.int32, (num_parts, capacity), 1)
+        valid = (slot < recv_counts[:, None]).reshape(-1)
+        dates = recv[:, :, 0].reshape(-1)
+        qtys = recv[:, :, 1].reshape(-1)
+        gkeys, sums, have, num_groups = hash_aggregate_sum(
+            dates, qtys, valid, MAX_GROUPS)
+        return gkeys, sums, have, num_groups[None]
+
+    from jax import shard_map
+    spec = P(axis_name)
+    return shard_map(step, mesh=mesh, in_specs=(spec, spec),
+                     out_specs=spec, check_vma=False)

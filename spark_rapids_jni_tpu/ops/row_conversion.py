@@ -39,7 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_jni_tpu.table import (
-    Column, DType, STRING, Table, pack_bools, unpack_bools,
+    Column, DType, Table, pack_bools, unpack_bools,
 )
 from spark_rapids_jni_tpu.ops.row_layout import (
     JCUDF_ROW_ALIGNMENT, MAX_BATCH_BYTES, RowLayout, compute_row_layout,
@@ -196,21 +196,12 @@ def _assemble_fixed_rows(table: Table, layout: RowLayout) -> jnp.ndarray:
     buffer — the tiling/coalescing work the reference does by hand with
     shared-memory tiles is the compiler's job here."""
     n = table.num_rows
-    pieces = []
-    pos = 0
-    for i, col in enumerate(table.columns):
-        start, size = layout.col_starts[i], layout.col_sizes[i]
-        if start > pos:
-            pieces.append(jnp.zeros((n, start - pos), jnp.uint8))
-        pieces.append(col_to_bytes(col.data))
-        pos = start + size
-    if layout.validity_offset > pos:
-        pieces.append(jnp.zeros((n, layout.validity_offset - pos), jnp.uint8))
-    pieces.append(_validity_row_bytes(table, layout))
+    body = _assemble_fixed_variable(table, [], layout)
     tail = layout.fixed_row_size - layout.fixed_end
     if tail > 0:
-        pieces.append(jnp.zeros((n, tail), jnp.uint8))
-    return jnp.concatenate(pieces, axis=1)
+        body = jnp.concatenate(
+            [body, jnp.zeros((n, tail), jnp.uint8)], axis=1)
+    return body
 
 
 @functools.partial(jax.jit, static_argnums=(1,))
@@ -218,10 +209,9 @@ def _to_rows_fixed_jit(table: Table, layout: RowLayout) -> jnp.ndarray:
     return _assemble_fixed_rows(table, layout)
 
 
-def _disassemble_fixed_rows(rows2d: jnp.ndarray, layout: RowLayout,
-                            scales: Optional[Sequence[int]] = None) -> Table:
+def _disassemble_fixed_rows(rows2d: jnp.ndarray,
+                            layout: RowLayout) -> List[Column]:
     """Inverse of :func:`_assemble_fixed_rows` for the fixed-width section."""
-    n = rows2d.shape[0]
     vbytes = rows2d[:, layout.validity_offset:
                     layout.validity_offset + layout.validity_bytes]
     cols = []
@@ -524,13 +514,11 @@ def _assemble_fixed_variable(table: Table, pairs: List[jnp.ndarray],
 
 
 def _from_rows_variable(rows: RowsColumn, layout: RowLayout) -> Table:
-    n = rows.num_rows
     F, validities = _extract_fixed_variable_jit(rows.data, rows.offsets,
                                                 layout)
     # per-string-column host sync of char totals (reference syncs per column
     # at row_conversion.cu:2215)
     cols = []
-    si = 0
     for i, dt in enumerate(layout.dtypes):
         s = layout.col_starts[i]
         valid = validities[:, i]
@@ -546,7 +534,6 @@ def _from_rows_variable(rows: RowsColumn, layout: RowLayout) -> Table:
                 rows.data, rows.offsets, str_off, str_len, total)
             cols.append(Column(dt, jnp.zeros((0,), jnp.uint8), validity,
                                offsets, chars))
-            si += 1
         else:
             sz = layout.col_sizes[i]
             data = bytes_to_col(F[:, s:s + sz], dt.np_dtype)

@@ -14,8 +14,10 @@ Implements the layout contract of the JCUDF row format (reference javadoc
   (``JCUDF_ROW_ALIGNMENT``).  Variable-width rows append string chars after
   the validity bytes (in string-column order, unpadded) and round the total
   up to 8 bytes per row.
-- Rows larger than 1KB are rejected (reference contract
-  ``RowConversion.java:98-99``, enforced ``row_conversion.cu:1211``).
+- Rows whose *fixed-width section* exceeds 1KB are rejected (reference
+  contract ``RowConversion.java:98-99``, enforced ``row_conversion.cu:1211``
+  — a shared-memory-fit constraint on the tiled kernels; string chars are
+  copied outside the tiles and are not subject to it, there or here).
 """
 
 from __future__ import annotations
@@ -70,6 +72,7 @@ class RowLayout:
 
 
 def compute_row_layout(dtypes: Sequence[DType]) -> RowLayout:
+    dtypes = tuple(dtypes)
     col_starts = []
     col_sizes = []
     variable_starts = []
@@ -88,14 +91,14 @@ def compute_row_layout(dtypes: Sequence[DType]) -> RowLayout:
         pos += size
 
     validity_offset = pos
-    validity_bytes = (len(tuple(dtypes)) + 7) // 8
+    validity_bytes = (len(dtypes) + 7) // 8
     fixed_row_size = _round_up(validity_offset + validity_bytes,
                                JCUDF_ROW_ALIGNMENT)
     if fixed_row_size > MAX_ROW_SIZE:
         raise ValueError(
             f"row size {fixed_row_size} exceeds JCUDF maximum {MAX_ROW_SIZE}")
     return RowLayout(
-        dtypes=tuple(dtypes),
+        dtypes=dtypes,
         col_starts=tuple(col_starts),
         col_sizes=tuple(col_sizes),
         variable_starts=tuple(variable_starts),

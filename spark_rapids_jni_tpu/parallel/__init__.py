@@ -1,0 +1,4 @@
+from spark_rapids_jni_tpu.parallel.mesh import make_mesh, shard_table  # noqa: F401
+from spark_rapids_jni_tpu.parallel.shuffle import (  # noqa: F401
+    ShuffleResult, shuffle_table_sharded,
+)

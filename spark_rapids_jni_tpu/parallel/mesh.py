@@ -1,0 +1,50 @@
+"""Device mesh + sharded-table helpers.
+
+The reference is single-GPU-per-process and leaves distribution to Spark
+(SURVEY.md §2 checklist); the TPU-native framework makes the distributed
+layer first-class instead: tables shard by rows over a named mesh axis and
+ops run under ``shard_map`` with XLA collectives over ICI/DCN.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_jni_tpu.table import Column, Table
+
+
+def make_mesh(devices: Optional[Sequence] = None,
+              axis_name: str = "data") -> Mesh:
+    """1-D data mesh over the given (or all) devices."""
+    devices = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (axis_name,))
+
+
+def shard_table(table: Table, mesh: Mesh, axis_name: str = "data") -> Table:
+    """Shard a fixed-width table's rows across the mesh axis.
+
+    Row counts must divide the axis size (pad upstream); string columns are
+    not shardable this way (their ragged chars ride the row-blob shuffle
+    path instead, see ``shuffle.py``).
+    """
+    naxis = mesh.shape[axis_name]
+    if table.num_rows % (naxis * 8) != 0:
+        raise ValueError(
+            f"num_rows ({table.num_rows}) must be a multiple of 8x axis size "
+            f"({naxis}) so packed validity bitmasks shard on byte boundaries")
+    spec = NamedSharding(mesh, P(axis_name))
+    vspec = NamedSharding(mesh, P(axis_name))
+    cols = []
+    for c in table.columns:
+        if c.dtype.is_string:
+            raise ValueError("shard_table supports fixed-width columns only")
+        data = jax.device_put(c.data, spec)
+        validity = None
+        if c.validity is not None:
+            validity = jax.device_put(c.validity, vspec)
+        cols.append(Column(c.dtype, data, validity))
+    return Table(tuple(cols))

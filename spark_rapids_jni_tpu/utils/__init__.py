@@ -1,0 +1,3 @@
+from spark_rapids_jni_tpu.utils.datagen import (  # noqa: F401
+    DataProfile, create_random_table, cycle_dtypes,
+)

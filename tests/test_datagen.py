@@ -1,0 +1,68 @@
+"""Datagen tests (reference datagen is validated implicitly by its
+benchmarks; we check determinism, profiles, and that generated tables flow
+through the conversion engine)."""
+
+import numpy as np
+
+from spark_rapids_jni_tpu import (
+    BOOL8, FLOAT32, FLOAT64, INT16, INT32, INT64, INT8, STRING,
+)
+from spark_rapids_jni_tpu.ops import convert_from_rows, convert_to_rows
+from spark_rapids_jni_tpu.table import assert_tables_equivalent
+from spark_rapids_jni_tpu.utils import (
+    DataProfile, create_random_table, cycle_dtypes,
+)
+
+
+def test_cycle_dtypes():
+    out = cycle_dtypes([INT8, INT32], 5)
+    assert [d.kind for d in out] == ["int8", "int32", "int8", "int32", "int8"]
+
+
+def test_deterministic_by_seed():
+    dtypes = [INT32, FLOAT32, STRING]
+    a = create_random_table(dtypes, 100, seed=7)
+    b = create_random_table(dtypes, 100, seed=7)
+    c = create_random_table(dtypes, 100, seed=8)
+    np.testing.assert_array_equal(np.asarray(a.columns[0].data),
+                                  np.asarray(b.columns[0].data))
+    np.testing.assert_array_equal(np.asarray(a.columns[2].chars),
+                                  np.asarray(b.columns[2].chars))
+    assert not np.array_equal(np.asarray(a.columns[0].data),
+                              np.asarray(c.columns[0].data))
+
+
+def test_null_probability():
+    t = create_random_table([INT32], 10_000,
+                            DataProfile(null_probability=0.5), seed=1)
+    frac = np.asarray(t.columns[0].valid_bools()).mean()
+    assert 0.4 < frac < 0.6
+    t2 = create_random_table([INT32], 100,
+                             DataProfile(null_probability=None))
+    assert t2.columns[0].validity is None
+
+
+def test_bounded_ints():
+    t = create_random_table([INT64], 1000,
+                            DataProfile(int_lower=5, int_upper=10), seed=2)
+    vals = np.asarray(t.columns[0].data)
+    assert vals.min() >= 5 and vals.max() <= 10
+
+
+def test_string_lengths():
+    t = create_random_table([STRING], 500,
+                            DataProfile(string_len_min=2, string_len_max=6),
+                            seed=3)
+    offs = np.asarray(t.columns[0].offsets)
+    lens = np.diff(offs)
+    assert lens.min() >= 2 and lens.max() <= 6
+
+
+def test_generated_table_roundtrips():
+    dtypes = cycle_dtypes([INT64, INT32, INT16, INT8, FLOAT32, FLOAT64,
+                           BOOL8, STRING], 24)
+    t = create_random_table(dtypes, 513, seed=11)
+    batches = convert_to_rows(t)
+    assert len(batches) == 1
+    got = convert_from_rows(batches[0], t.dtypes)
+    assert_tables_equivalent(t, got)

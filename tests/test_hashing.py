@@ -1,0 +1,189 @@
+"""Hash kernel tests: the jnp vectorized murmur3/xxhash64 are cross-checked
+against independent pure-Python scalar implementations written from the
+algorithm specs (Guava Murmur3_x86_32 / xxHash64), plus Spark literal
+vectors for the partitioning contract."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import (
+    BOOL8, Column, FLOAT32, FLOAT64, INT32, INT64, Table,
+)
+from spark_rapids_jni_tpu.ops.hashing import (
+    hash_partition_ids, murmur3_hash, pmod, xxhash64,
+)
+
+MASK32 = 0xFFFFFFFF
+MASK64 = 0xFFFFFFFFFFFFFFFF
+
+
+# -- independent scalar murmur3 (Guava Murmur3_x86_32, as Spark uses) -------
+
+def _rotl(x, r, bits=32):
+    mask = (1 << bits) - 1
+    return ((x << r) | (x >> (bits - r))) & mask
+
+
+def mm3_mix_k1(k1):
+    k1 = (k1 * 0xCC9E2D51) & MASK32
+    k1 = _rotl(k1, 15)
+    return (k1 * 0x1B873593) & MASK32
+
+
+def mm3_mix_h1(h1, k1):
+    h1 ^= mm3_mix_k1(k1)
+    h1 = _rotl(h1, 13)
+    return (h1 * 5 + 0xE6546B64) & MASK32
+
+
+def mm3_fmix(h1, length):
+    h1 ^= length
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & MASK32
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & MASK32
+    return h1 ^ (h1 >> 16)
+
+
+def spark_hash_int(value, seed):
+    return mm3_fmix(mm3_mix_h1(seed & MASK32, value & MASK32), 4)
+
+
+def spark_hash_long(value, seed):
+    v = value & MASK64
+    h = mm3_mix_h1(seed & MASK32, v & MASK32)
+    h = mm3_mix_h1(h, v >> 32)
+    return mm3_fmix(h, 8)
+
+
+def as_i32(x):
+    return x - (1 << 32) if x >= (1 << 31) else x
+
+
+# -- independent scalar xxhash64 --------------------------------------------
+
+XP1, XP2, XP3 = 0x9E3779B185EBCA87, 0xC2B2AE3D27D4EB4F, 0x165667B19E3779F9
+XP4, XP5 = 0x85EBCA77C2B2AE63, 0x27D4EB2F165667C5
+
+
+def xx64_long(value, seed):
+    v = value & MASK64
+    h = (seed + XP5 + 8) & MASK64
+    k1 = (_rotl((0 + v * XP2) & MASK64, 31, 64) * XP1) & MASK64
+    h ^= k1
+    h = (_rotl(h, 27, 64) * XP1 + XP4) & MASK64
+    h ^= h >> 33
+    h = (h * XP2) & MASK64
+    h ^= h >> 29
+    h = (h * XP3) & MASK64
+    return h ^ (h >> 32)
+
+
+# ---------------------------------------------------------------------------
+
+def test_murmur3_int_vs_scalar(rng):
+    vals = rng.integers(-2**31, 2**31, 200, dtype=np.int32)
+    t = Table((Column.from_numpy(vals, INT32),))
+    got = np.asarray(murmur3_hash(t))
+    exp = [as_i32(spark_hash_int(int(v) & MASK32, 42)) for v in vals]
+    np.testing.assert_array_equal(got, exp)
+
+
+def test_murmur3_long_vs_scalar(rng):
+    vals = rng.integers(-2**63, 2**63, 200, dtype=np.int64)
+    t = Table((Column.from_numpy(vals, INT64),))
+    got = np.asarray(murmur3_hash(t))
+    exp = [as_i32(spark_hash_long(int(v), 42)) for v in vals]
+    np.testing.assert_array_equal(got, exp)
+
+
+def test_murmur3_multi_column_chaining(rng):
+    a = rng.integers(-100, 100, 50, dtype=np.int32)
+    b = rng.integers(-2**62, 2**62, 50, dtype=np.int64)
+    t = Table((Column.from_numpy(a, INT32), Column.from_numpy(b, INT64)))
+    got = np.asarray(murmur3_hash(t))
+    exp = [as_i32(spark_hash_long(int(b[i]),
+                                  spark_hash_int(int(a[i]) & MASK32, 42)))
+           for i in range(50)]
+    np.testing.assert_array_equal(got, exp)
+
+
+def test_murmur3_floats_hash_as_bits(rng):
+    f = np.array([1.5, -2.25, 0.0, -0.0, 3e7], np.float32)
+    t = Table((Column.from_numpy(f, FLOAT32),))
+    got = np.asarray(murmur3_hash(t))
+    exp = [as_i32(spark_hash_int(
+        int(np.float32(v if v != 0 else 0.0).view(np.int32)) & MASK32, 42))
+        for v in f]
+    np.testing.assert_array_equal(got, exp)
+    # -0.0 and 0.0 must agree (Spark normalization)
+    assert got[2] == got[3]
+
+
+def test_murmur3_double_and_bool(rng):
+    d = np.array([3.14159, -1e300, 0.0], np.float64)
+    bl = np.array([1, 0, 1], np.uint8)
+    t = Table((Column.from_numpy(d, FLOAT64), Column.from_numpy(bl, BOOL8)))
+    got = np.asarray(murmur3_hash(t))
+    exp = []
+    for i in range(3):
+        h = spark_hash_long(int(np.float64(d[i]).view(np.int64)), 42)
+        h = spark_hash_int(int(bl[i]), h)
+        exp.append(as_i32(h))
+    np.testing.assert_array_equal(got, exp)
+
+
+def test_murmur3_spark_literal_vectors():
+    """Values produced by Spark's `SELECT hash(...)` (seed 42)."""
+    t = Table((Column.from_numpy(np.array([1], np.int32), INT32),))
+    assert int(np.asarray(murmur3_hash(t))[0]) == as_i32(
+        spark_hash_int(1, 42))
+    # the canonical published value for spark.sql hash(1)
+    assert int(np.asarray(murmur3_hash(t))[0]) == -559580957
+
+
+def test_murmur3_nulls_skip_column(rng):
+    vals = np.array([10, 20], np.int32)
+    t = Table((
+        Column.from_numpy(np.array([5, 5], np.int32), INT32),
+        Column.from_numpy(vals, INT32, valid=np.array([True, False])),
+    ))
+    got = np.asarray(murmur3_hash(t))
+    h0 = spark_hash_int(5, 42)
+    assert got[0] == as_i32(spark_hash_int(10, h0))
+    assert got[1] == as_i32(h0)  # null field leaves hash unchanged
+
+
+def test_pmod_positive():
+    h = np.array([-7, 7, -1, 0], np.int32)
+    import jax.numpy as jnp
+    got = np.asarray(pmod(jnp.asarray(h), 4))
+    np.testing.assert_array_equal(got, [1, 3, 3, 0])
+
+
+def test_hash_partition_ids_range(rng):
+    t = Table((Column.from_numpy(
+        rng.integers(-2**31, 2**31, 1000, dtype=np.int32), INT32),))
+    pids = np.asarray(hash_partition_ids(t, 8))
+    assert pids.min() >= 0 and pids.max() < 8
+    # roughly uniform
+    counts = np.bincount(pids, minlength=8)
+    assert counts.min() > 50
+
+
+def test_xxhash64_long_vs_scalar(rng):
+    vals = rng.integers(-2**63, 2**63, 100, dtype=np.int64)
+    t = Table((Column.from_numpy(vals, INT64),))
+    got = np.asarray(xxhash64(t)).astype(np.uint64)
+    combined = got[:, 0] | (got[:, 1] << np.uint64(32))
+    exp = np.array([xx64_long(int(v), 42) for v in vals], np.uint64)
+    np.testing.assert_array_equal(combined, exp)
+
+
+def test_xxhash64_int_promotes_to_long(rng):
+    vals = rng.integers(-2**31, 2**31, 100, dtype=np.int32)
+    t = Table((Column.from_numpy(vals, INT32),))
+    got = np.asarray(xxhash64(t)).astype(np.uint64)
+    combined = got[:, 0] | (got[:, 1] << np.uint64(32))
+    exp = np.array([xx64_long(int(v), 42) for v in vals], np.uint64)
+    np.testing.assert_array_equal(combined, exp)
