@@ -1,0 +1,133 @@
+// Parquet footer parse / prune / re-serialize — semantic layer over the
+// generic thrift DOM.
+//
+// Capability parity with the reference's footer component
+// (/root/reference/src/main/cpp/src/NativeParquetJni.cpp:37-564): schema
+// column pruning against a Spark-side selection tree, row-group filtering by
+// the split-midpoint rule (including the PARQUET-2078 bad-offset workaround),
+// and PAR1-framed re-serialization.  The architecture differs: the reference
+// filters Thrift-generated structs; here pruning is a rewrite of the generic
+// DOM, so fields this code does not model survive untouched.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "srj/thrift_compact.hpp"
+
+namespace srj {
+namespace parquet {
+
+// parquet.thrift field ids used by the semantic layer (parquet-format IDL).
+// FileMetaData
+constexpr int16_t FMD_VERSION = 1;
+constexpr int16_t FMD_SCHEMA = 2;
+constexpr int16_t FMD_NUM_ROWS = 3;
+constexpr int16_t FMD_ROW_GROUPS = 4;
+constexpr int16_t FMD_KV_METADATA = 5;
+constexpr int16_t FMD_CREATED_BY = 6;
+constexpr int16_t FMD_COLUMN_ORDERS = 7;
+// SchemaElement
+constexpr int16_t SE_TYPE = 1;
+constexpr int16_t SE_REPETITION = 3;
+constexpr int16_t SE_NAME = 4;
+constexpr int16_t SE_NUM_CHILDREN = 5;
+constexpr int16_t SE_CONVERTED_TYPE = 6;
+// RowGroup
+constexpr int16_t RG_COLUMNS = 1;
+constexpr int16_t RG_TOTAL_BYTE_SIZE = 2;
+constexpr int16_t RG_NUM_ROWS = 3;
+constexpr int16_t RG_FILE_OFFSET = 5;
+constexpr int16_t RG_TOTAL_COMPRESSED_SIZE = 6;
+// ColumnChunk
+constexpr int16_t CC_META_DATA = 3;
+// ColumnMetaData
+constexpr int16_t CMD_TOTAL_COMPRESSED_SIZE = 7;
+constexpr int16_t CMD_DATA_PAGE_OFFSET = 9;
+constexpr int16_t CMD_DICTIONARY_PAGE_OFFSET = 11;
+// enum ConvertedType
+constexpr int64_t CT_MAP = 1;
+constexpr int64_t CT_MAP_KEY_VALUE = 2;
+constexpr int64_t CT_LIST = 3;
+// enum FieldRepetitionType
+constexpr int64_t REP_REPEATED = 2;
+
+// Selection-tree node kinds, numerically identical to the reference's JNI
+// contract (ParquetFooter.java:142-170 emits 0..3 in this order).
+enum class Tag : int32_t { VALUE = 0, STRUCT = 1, LIST = 2, MAP = 3 };
+
+// UTF-8-aware simple lowercasing (ASCII, Latin-1, Latin Extended-A, Greek,
+// Cyrillic; other codepoints pass through).  The reference leans on the
+// process locale via mbstowcs/towlower (NativeParquetJni.cpp:45-77); a
+// table-driven fold is deterministic across environments.
+std::string utf8_to_lower(const std::string& in);
+
+// Gather maps produced by pruning (the reference's column_pruning_maps,
+// NativeParquetJni.cpp:84-94).
+struct PruneMaps {
+  std::vector<int> schema_map;           // indexes into the input schema list
+  std::vector<int> schema_num_children;  // rewritten child counts
+  std::vector<int> chunk_map;            // indexes into leaf-chunk order
+};
+
+// Selection tree built from the depth-first (names, num_children, tags)
+// flattening the JVM-analogue front end sends down.
+class ColumnPruner {
+ public:
+  ColumnPruner(const std::vector<std::string>& names,
+               const std::vector<int32_t>& num_children,
+               const std::vector<Tag>& tags, int32_t parent_num_children);
+  ColumnPruner() = default;
+  explicit ColumnPruner(Tag t) : tag_(t) {}
+
+  // Walk the file's schema-element list and emit gather maps for the
+  // elements/chunks selected by this tree.  Throws on schema-shape
+  // mismatches (same contract as the reference walkers).
+  PruneMaps filter_schema(const std::vector<thrift::Value>& schema,
+                          bool ignore_case) const;
+
+ private:
+  struct Walk;  // mutable cursor state shared down the recursion
+  void filter(const std::vector<thrift::Value>& schema, bool ignore_case, Walk& w) const;
+  void filter_struct(const std::vector<thrift::Value>& schema, bool ignore_case, Walk& w) const;
+  void filter_value(const std::vector<thrift::Value>& schema, Walk& w) const;
+  void filter_list(const std::vector<thrift::Value>& schema, bool ignore_case, Walk& w) const;
+  void filter_map(const std::vector<thrift::Value>& schema, bool ignore_case, Walk& w) const;
+  static void skip(const std::vector<thrift::Value>& schema, Walk& w);
+
+  std::map<std::string, ColumnPruner> children_;
+  Tag tag_ = Tag::STRUCT;
+};
+
+// A parsed footer: the DOM plus the operations the C ABI exposes.
+class Footer {
+ public:
+  // Parse `len` bytes of thrift-compact FileMetaData (footer body only, no
+  // PAR1 framing).
+  static Footer parse(const uint8_t* buf, uint64_t len);
+
+  // Prune schema + column chunks + column orders to the selection tree.
+  void filter_columns(const std::vector<std::string>& names,
+                      const std::vector<int32_t>& num_children,
+                      const std::vector<Tag>& tags, int32_t parent_num_children,
+                      bool ignore_case);
+
+  // Drop row groups whose byte-range midpoint falls outside
+  // [part_offset, part_offset + part_length); negative part_length keeps all
+  // (the reference gates on part_length >= 0, NativeParquetJni.cpp:619-621).
+  void filter_groups(int64_t part_offset, int64_t part_length);
+
+  int64_t num_rows() const;     // sum of surviving row groups' num_rows
+  int32_t num_columns() const;  // root schema element's num_children
+
+  // PAR1 + thrift bytes + u32-LE length + PAR1 (the footer-file framing the
+  // reference emits, NativeParquetJni.cpp:683-697).
+  std::vector<uint8_t> serialize_file() const;
+
+  thrift::Struct meta;  // the FileMetaData DOM
+};
+
+}  // namespace parquet
+}  // namespace srj

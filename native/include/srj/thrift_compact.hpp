@@ -1,0 +1,110 @@
+// Generic Thrift Compact Protocol codec over a field DOM.
+//
+// Part of the spark_rapids_jni_tpu native host layer (the role the
+// Thrift-generated parquet types + TCompactProtocol play for the reference's
+// footer component, /root/reference/src/main/cpp/src/NativeParquetJni.cpp:521-550).
+//
+// Fresh design, not a port: instead of code-generated structs (which drop
+// unknown fields at read time unless regenerated against the newest IDL),
+// we parse into a *generic* value tree keyed by thrift field ids.  Every
+// field -- including ones this library knows nothing about (encryption
+// metadata, future additions to parquet.thrift) -- survives a
+// parse -> prune -> serialize round trip byte-faithfully.  The semantic
+// layer (parquet_footer.hpp) addresses the handful of fields it must
+// understand by field id.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace srj {
+namespace thrift {
+
+// Compact-protocol wire type codes (field headers & container element types).
+enum TType : uint8_t {
+  T_STOP = 0,
+  T_BOOL_TRUE = 1,
+  T_BOOL_FALSE = 2,
+  T_I8 = 3,
+  T_I16 = 4,
+  T_I32 = 5,
+  T_I64 = 6,
+  T_DOUBLE = 7,
+  T_BINARY = 8,
+  T_LIST = 9,
+  T_SET = 10,
+  T_MAP = 11,
+  T_STRUCT = 12,
+};
+
+struct Value;
+
+// A struct is parallel vectors of (field id, wire type, value), preserving
+// the order fields appeared on the wire so re-serialization can use the
+// short-form delta encoding the original writer used.
+struct Struct {
+  std::vector<int16_t> ids;
+  std::vector<uint8_t> types;  // TType; bools normalized to T_BOOL_TRUE
+  std::vector<Value> values;
+
+  // Returns the index of field `id`, or -1.
+  int find(int16_t id) const;
+  bool has(int16_t id) const { return find(id) >= 0; }
+  Value& at(int16_t id);              // throws if absent
+  const Value& at(int16_t id) const;  // throws if absent
+  void erase(int16_t id);             // no-op if absent
+  void set(int16_t id, uint8_t type, Value v);  // replace or append
+};
+
+struct List {
+  uint8_t elem_type = T_STRUCT;  // TType
+  bool is_set = false;           // re-serialize as SET if it arrived as one
+  std::vector<Value> elems;
+};
+
+struct Map {
+  uint8_t key_type = T_BINARY;
+  uint8_t val_type = T_BINARY;
+  std::vector<Value> keys;
+  std::vector<Value> vals;
+};
+
+// Tagged union of every thrift value shape.  Only one member is active,
+// selected by the wire type stored next to it; a plain struct-of-members
+// keeps recursive containment legal without std::variant gymnastics.
+struct Value {
+  bool b = false;
+  int64_t i = 0;       // I8/I16/I32/I64 all live here
+  double d = 0.0;
+  std::string bin;     // BINARY / STRING
+  List list;           // LIST / SET
+  Map map;
+  Struct strct;
+
+  static Value of_bool(bool v) { Value x; x.b = v; return x; }
+  static Value of_int(int64_t v) { Value x; x.i = v; return x; }
+  static Value of_double(double v) { Value x; x.d = v; return x; }
+  static Value of_bin(std::string v) { Value x; x.bin = std::move(v); return x; }
+  static Value of_list(List v) { Value x; x.list = std::move(v); return x; }
+  static Value of_map(Map v) { Value x; x.map = std::move(v); return x; }
+  static Value of_struct(Struct v) { Value x; x.strct = std::move(v); return x; }
+};
+
+// Guards against malformed / hostile footers (the reference caps string and
+// container sizes when deserializing, NativeParquetJni.cpp:536-540).
+struct Limits {
+  uint64_t max_string = 100ull * 1000 * 1000;
+  uint64_t max_container = 1000ull * 1000;
+  uint32_t max_depth = 64;
+};
+
+// Parse one compact-protocol struct occupying [buf, buf+len).  Throws
+// std::runtime_error on malformed input or exceeded limits.
+Struct read_struct(const uint8_t* buf, uint64_t len, const Limits& limits = Limits());
+
+// Serialize a struct to compact-protocol bytes.
+std::vector<uint8_t> write_struct(const Struct& s);
+
+}  // namespace thrift
+}  // namespace srj

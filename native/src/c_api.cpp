@@ -1,0 +1,101 @@
+// Stable C ABI for the host-native parquet footer engine.
+//
+// This is the framework's "JNI surface" analogue: the boundary the reference
+// crosses with JNIEXPORT shims and jlong handles
+// (/root/reference/src/main/cpp/src/NativeParquetJni.cpp:566-702) is here a
+// flat C API consumed by Python via ctypes.  Errors cross the boundary as
+// (return code, thread-local message) instead of thrown Java exceptions.
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "srj/parquet_footer.hpp"
+
+namespace {
+
+thread_local std::string g_last_error;
+
+int set_error(const std::exception& e) {
+  g_last_error = e.what();
+  return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+struct srj_footer {
+  srj::parquet::Footer impl;
+};
+
+const char* srj_last_error() { return g_last_error.c_str(); }
+
+// Parse thrift-compact FileMetaData bytes (footer body only).  Returns a
+// handle, or null (see srj_last_error).
+srj_footer* srj_footer_parse(const uint8_t* buf, uint64_t len) {
+  try {
+    auto* f = new srj_footer{srj::parquet::Footer::parse(buf, len)};
+    return f;
+  } catch (const std::exception& e) {
+    set_error(e);
+    return nullptr;
+  }
+}
+
+void srj_footer_close(srj_footer* f) { delete f; }
+
+// Prune columns against a depth-first flattened selection tree and drop row
+// groups outside the [part_offset, part_offset+part_length) split (skipped
+// when part_length < 0).  `names` holds n UTF-8 strings; `tags` uses the
+// Tag enum values 0=VALUE 1=STRUCT 2=LIST 3=MAP.
+int srj_footer_filter(srj_footer* f, int64_t part_offset, int64_t part_length,
+                      const char* const* names, const int32_t* num_children,
+                      const int32_t* tags, int32_t n,
+                      int32_t parent_num_children, int32_t ignore_case) {
+  try {
+    std::vector<std::string> names_v(n);
+    std::vector<int32_t> nc_v(n);
+    std::vector<srj::parquet::Tag> tags_v(n);
+    for (int32_t i = 0; i < n; ++i) {
+      names_v[i] = names[i];
+      nc_v[i] = num_children[i];
+      tags_v[i] = static_cast<srj::parquet::Tag>(tags[i]);
+    }
+    f->impl.filter_columns(names_v, nc_v, tags_v, parent_num_children,
+                           ignore_case != 0);
+    f->impl.filter_groups(part_offset, part_length);
+    return 0;
+  } catch (const std::exception& e) {
+    return set_error(e);
+  }
+}
+
+int64_t srj_footer_num_rows(const srj_footer* f) { return f->impl.num_rows(); }
+
+int32_t srj_footer_num_columns(const srj_footer* f) {
+  return f->impl.num_columns();
+}
+
+// Serialize with PAR1 file framing.  Call with out=null to size the buffer;
+// then again with a buffer of at least that many bytes.  Returns the byte
+// count, or -1 on error.
+int64_t srj_footer_serialize(const srj_footer* f, uint8_t* out,
+                             uint64_t out_capacity) {
+  try {
+    std::vector<uint8_t> bytes = f->impl.serialize_file();
+    if (out != nullptr) {
+      if (bytes.size() > out_capacity) {
+        g_last_error = "serialize buffer too small";
+        return -1;
+      }
+      std::memcpy(out, bytes.data(), bytes.size());
+    }
+    return static_cast<int64_t>(bytes.size());
+  } catch (const std::exception& e) {
+    set_error(e);
+    return -1;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,497 @@
+#include "srj/parquet_footer.hpp"
+
+#include <stdexcept>
+
+namespace srj {
+namespace parquet {
+
+using thrift::Struct;
+using thrift::Value;
+
+// ---------------------------------------------------------------------------
+// Case folding
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// Simple (non-context-sensitive) lowercase for the Unicode ranges that cover
+// real-world column names.  Mirrors Java's String.toLowerCase(Locale.ROOT)
+// on these ranges, which is what the JVM side of the contract applies
+// (ParquetFooter.java:138-139).
+uint32_t lower_codepoint(uint32_t c) {
+  if (c >= 'A' && c <= 'Z') return c + 0x20;
+  if (c >= 0xC0 && c <= 0xDE && c != 0xD7) return c + 0x20;  // Latin-1
+  if (c >= 0x100 && c <= 0x137) return c | 1;                // Latin Ext-A pairs
+  if (c >= 0x139 && c <= 0x148) return ((c + 1) | 1) - 1;    // odd upper
+  if (c >= 0x14A && c <= 0x177) return c | 1;
+  if (c >= 0x179 && c <= 0x17E) return ((c + 1) | 1) - 1;
+  if (c >= 0x391 && c <= 0x3A9 && c != 0x3A2) return c + 0x20;  // Greek
+  if (c >= 0x410 && c <= 0x42F) return c + 0x20;                // Cyrillic
+  if (c >= 0x400 && c <= 0x40F) return c + 0x50;
+  return c;
+}
+
+}  // namespace
+
+std::string utf8_to_lower(const std::string& in) {
+  std::string out;
+  out.reserve(in.size());
+  size_t i = 0;
+  const size_t n = in.size();
+  while (i < n) {
+    uint8_t b0 = static_cast<uint8_t>(in[i]);
+    uint32_t cp;
+    size_t len;
+    if (b0 < 0x80) {
+      cp = b0;
+      len = 1;
+    } else if ((b0 & 0xE0) == 0xC0 && i + 1 < n) {
+      cp = (b0 & 0x1F) << 6 | (in[i + 1] & 0x3F);
+      len = 2;
+    } else if ((b0 & 0xF0) == 0xE0 && i + 2 < n) {
+      cp = (b0 & 0x0F) << 12 | (in[i + 1] & 0x3F) << 6 | (in[i + 2] & 0x3F);
+      len = 3;
+    } else if ((b0 & 0xF8) == 0xF0 && i + 3 < n) {
+      cp = (b0 & 0x07) << 18 | (in[i + 1] & 0x3F) << 12 | (in[i + 2] & 0x3F) << 6 |
+           (in[i + 3] & 0x3F);
+      len = 4;
+    } else {  // invalid sequence: copy the byte through
+      out.push_back(in[i]);
+      ++i;
+      continue;
+    }
+    cp = lower_codepoint(cp);
+    if (cp < 0x80) {
+      out.push_back(static_cast<char>(cp));
+    } else if (cp < 0x800) {
+      out.push_back(static_cast<char>(0xC0 | (cp >> 6)));
+      out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    } else if (cp < 0x10000) {
+      out.push_back(static_cast<char>(0xE0 | (cp >> 12)));
+      out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+      out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    } else {
+      out.push_back(static_cast<char>(0xF0 | (cp >> 18)));
+      out.push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+      out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+      out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    }
+    i += len;
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Schema-element DOM accessors
+// ---------------------------------------------------------------------------
+
+namespace {
+
+const Struct& as_struct(const Value& v) { return v.strct; }
+
+std::string se_name(const Value& elem, bool fold) {
+  const Struct& s = as_struct(elem);
+  int i = s.find(SE_NAME);
+  std::string name = i >= 0 ? s.values[i].bin : std::string();
+  return fold ? utf8_to_lower(name) : name;
+}
+
+bool se_is_leaf(const Value& elem) { return as_struct(elem).has(SE_TYPE); }
+
+int se_num_children(const Value& elem) {
+  const Struct& s = as_struct(elem);
+  int i = s.find(SE_NUM_CHILDREN);
+  return i >= 0 ? static_cast<int>(s.values[i].i) : 0;
+}
+
+bool se_converted_is(const Value& elem, std::initializer_list<int64_t> wanted) {
+  const Struct& s = as_struct(elem);
+  int i = s.find(SE_CONVERTED_TYPE);
+  if (i < 0) return false;
+  for (int64_t w : wanted) {
+    if (s.values[i].i == w) return true;
+  }
+  return false;
+}
+
+bool se_is_repeated(const Value& elem) {
+  const Struct& s = as_struct(elem);
+  int i = s.find(SE_REPETITION);
+  return i >= 0 && s.values[i].i == REP_REPEATED;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// ColumnPruner
+// ---------------------------------------------------------------------------
+
+struct ColumnPruner::Walk {
+  size_t schema_index = 0;  // cursor into the flattened schema-element list
+  size_t chunk_index = 0;   // cursor over leaf columns seen so far
+  PruneMaps maps;
+};
+
+ColumnPruner::ColumnPruner(const std::vector<std::string>& names,
+                           const std::vector<int32_t>& num_children,
+                           const std::vector<Tag>& tags,
+                           int32_t parent_num_children)
+    : tag_(Tag::STRUCT) {
+  if (parent_num_children == 0) return;
+  // Rebuild the tree from its depth-first flattening: a stack of
+  // (node, children still expected) frames (the inverse of the JVM side's
+  // depthFirstNamesHelper flattening, ParquetFooter.java:136-174).
+  std::vector<ColumnPruner*> node_stack{this};
+  std::vector<int32_t> remaining_stack{parent_num_children};
+  for (size_t i = 0; i < names.size(); ++i) {
+    ColumnPruner& child =
+        node_stack.back()->children_.emplace(names[i], ColumnPruner(tags[i])).first->second;
+    if (num_children[i] > 0) {
+      node_stack.push_back(&child);
+      remaining_stack.push_back(num_children[i]);
+    } else {
+      // Pop every frame whose expected children are now all consumed.
+      while (!node_stack.empty()) {
+        if (--remaining_stack.back() > 0) break;
+        node_stack.pop_back();
+        remaining_stack.pop_back();
+      }
+    }
+  }
+  if (!node_stack.empty()) {
+    throw std::invalid_argument("schema filter flattening is inconsistent");
+  }
+}
+
+void ColumnPruner::skip(const std::vector<Value>& schema, Walk& w) {
+  // Consume the element at the cursor and its whole subtree, advancing the
+  // chunk cursor past every leaf inside it.
+  long pending = 1;
+  while (pending > 0 && w.schema_index < schema.size()) {
+    const Value& elem = schema[w.schema_index];
+    if (se_is_leaf(elem)) ++w.chunk_index;
+    pending += se_num_children(elem) - 1;
+    ++w.schema_index;
+  }
+}
+
+void ColumnPruner::filter_struct(const std::vector<Value>& schema, bool ignore_case,
+                                 Walk& w) const {
+  const Value& self = schema.at(w.schema_index);
+  if (se_is_leaf(self)) {
+    throw std::runtime_error("expected a struct column but found a leaf");
+  }
+  int nc = se_num_children(self);
+  w.maps.schema_map.push_back(static_cast<int>(w.schema_index));
+  size_t my_count_slot = w.maps.schema_num_children.size();
+  w.maps.schema_num_children.push_back(0);
+  ++w.schema_index;
+  for (int k = 0; k < nc && w.schema_index < schema.size(); ++k) {
+    std::string name = se_name(schema[w.schema_index], ignore_case);
+    auto it = children_.find(name);
+    if (it != children_.end()) {
+      ++w.maps.schema_num_children[my_count_slot];
+      it->second.filter(schema, ignore_case, w);
+    } else {
+      skip(schema, w);
+    }
+  }
+}
+
+void ColumnPruner::filter_value(const std::vector<Value>& schema, Walk& w) const {
+  const Value& self = schema.at(w.schema_index);
+  if (!se_is_leaf(self)) {
+    throw std::runtime_error("expected a leaf column but found a group");
+  }
+  if (se_num_children(self) != 0) {
+    throw std::runtime_error("leaf column unexpectedly has children");
+  }
+  w.maps.schema_map.push_back(static_cast<int>(w.schema_index));
+  w.maps.schema_num_children.push_back(0);
+  ++w.schema_index;
+  w.maps.chunk_map.push_back(static_cast<int>(w.chunk_index));
+  ++w.chunk_index;
+}
+
+void ColumnPruner::filter_list(const std::vector<Value>& schema, bool ignore_case,
+                               Walk& w) const {
+  // Selection trees name the list's payload "element" by convention
+  // (ParquetFooter.java:161).
+  auto elem_it = children_.find("element");
+  if (elem_it == children_.end()) {
+    throw std::runtime_error("list selection has no 'element' child");
+  }
+  const Value& outer = schema.at(w.schema_index);
+  std::string outer_name = se_name(outer, false);
+  if (se_is_leaf(outer)) {
+    throw std::runtime_error("expected a LIST group but found a leaf");
+  }
+  if (!se_converted_is(outer, {CT_LIST})) {
+    throw std::runtime_error("expected a LIST converted type");
+  }
+  if (se_num_children(outer) != 1) {
+    throw std::runtime_error("LIST group must have exactly one child");
+  }
+  w.maps.schema_map.push_back(static_cast<int>(w.schema_index));
+  w.maps.schema_num_children.push_back(1);
+  ++w.schema_index;
+
+  // parquet-format LogicalTypes list rules: a repeated group with one child
+  // not named "array"/"<list>_tuple" is the 3-level form; anything else is a
+  // legacy 2-level where the repeated node itself is the element.
+  const Value& rep = schema.at(w.schema_index);
+  if (!se_is_repeated(rep)) {
+    throw std::runtime_error("LIST child is not repeated");
+  }
+  bool rep_is_group = !se_is_leaf(rep);
+  std::string rep_name = se_name(rep, false);
+  if (rep_is_group && se_num_children(rep) == 1 && rep_name != "array" &&
+      rep_name != outer_name + "_tuple") {
+    w.maps.schema_map.push_back(static_cast<int>(w.schema_index));
+    w.maps.schema_num_children.push_back(1);
+    ++w.schema_index;
+    elem_it->second.filter(schema, ignore_case, w);
+  } else {
+    elem_it->second.filter(schema, ignore_case, w);
+  }
+}
+
+void ColumnPruner::filter_map(const std::vector<Value>& schema, bool ignore_case,
+                              Walk& w) const {
+  auto key_it = children_.find("key");
+  auto val_it = children_.find("value");
+  if (key_it == children_.end() || val_it == children_.end()) {
+    throw std::runtime_error("map selection needs 'key' and 'value' children");
+  }
+  const Value& outer = schema.at(w.schema_index);
+  if (se_is_leaf(outer)) {
+    throw std::runtime_error("expected a MAP group but found a leaf");
+  }
+  if (!se_converted_is(outer, {CT_MAP, CT_MAP_KEY_VALUE})) {
+    throw std::runtime_error("expected a MAP converted type");
+  }
+  if (se_num_children(outer) != 1) {
+    throw std::runtime_error("MAP group must have exactly one child");
+  }
+  w.maps.schema_map.push_back(static_cast<int>(w.schema_index));
+  w.maps.schema_num_children.push_back(1);
+  ++w.schema_index;
+
+  const Value& rep = schema.at(w.schema_index);
+  if (!se_is_repeated(rep)) {
+    throw std::runtime_error("MAP key_value group is not repeated");
+  }
+  int rep_children = se_num_children(rep);
+  if (rep_children != 1 && rep_children != 2) {
+    throw std::runtime_error("MAP key_value group has wrong child count");
+  }
+  w.maps.schema_map.push_back(static_cast<int>(w.schema_index));
+  w.maps.schema_num_children.push_back(rep_children);
+  ++w.schema_index;
+
+  key_it->second.filter(schema, ignore_case, w);
+  if (rep_children == 2) val_it->second.filter(schema, ignore_case, w);
+}
+
+void ColumnPruner::filter(const std::vector<Value>& schema, bool ignore_case,
+                          Walk& w) const {
+  switch (tag_) {
+    case Tag::STRUCT:
+      filter_struct(schema, ignore_case, w);
+      break;
+    case Tag::VALUE:
+      filter_value(schema, w);
+      break;
+    case Tag::LIST:
+      filter_list(schema, ignore_case, w);
+      break;
+    case Tag::MAP:
+      filter_map(schema, ignore_case, w);
+      break;
+  }
+}
+
+PruneMaps ColumnPruner::filter_schema(const std::vector<Value>& schema,
+                                      bool ignore_case) const {
+  Walk w;
+  filter(schema, ignore_case, w);
+  return std::move(w.maps);
+}
+
+// ---------------------------------------------------------------------------
+// Footer
+// ---------------------------------------------------------------------------
+
+Footer Footer::parse(const uint8_t* buf, uint64_t len) {
+  Footer f;
+  f.meta = thrift::read_struct(buf, len);
+  return f;
+}
+
+namespace {
+
+std::vector<Value>* list_field(Struct& s, int16_t id) {
+  int i = s.find(id);
+  return i >= 0 ? &s.values[i].list.elems : nullptr;
+}
+
+int64_t chunk_start_offset(const Value& chunk) {
+  // Row-group start = its first data byte: min(data page, dictionary page)
+  // offsets of the first column (NativeParquetJni.cpp:458-465 semantics).
+  const Struct& cc = chunk.strct;
+  int mi = cc.find(CC_META_DATA);
+  if (mi < 0) return 0;
+  const Struct& md = cc.values[mi].strct;
+  int64_t off = 0;
+  int di = md.find(CMD_DATA_PAGE_OFFSET);
+  if (di >= 0) off = md.values[di].i;
+  int dict = md.find(CMD_DICTIONARY_PAGE_OFFSET);
+  if (dict >= 0 && off > md.values[dict].i) off = md.values[dict].i;
+  return off;
+}
+
+int64_t i64_field_or(const Struct& s, int16_t id, int64_t dflt) {
+  int i = s.find(id);
+  return i >= 0 ? s.values[i].i : dflt;
+}
+
+}  // namespace
+
+void Footer::filter_columns(const std::vector<std::string>& names,
+                            const std::vector<int32_t>& num_children,
+                            const std::vector<Tag>& tags,
+                            int32_t parent_num_children, bool ignore_case) {
+  std::vector<Value>* schema = list_field(meta, FMD_SCHEMA);
+  if (!schema) throw std::runtime_error("footer has no schema");
+
+  ColumnPruner pruner(names, num_children, tags, parent_num_children);
+  PruneMaps maps = pruner.filter_schema(*schema, ignore_case);
+
+  // Rewrite the schema list through the gather map, patching child counts.
+  std::vector<Value> new_schema;
+  new_schema.reserve(maps.schema_map.size());
+  for (size_t i = 0; i < maps.schema_map.size(); ++i) {
+    Value elem = (*schema)[maps.schema_map[i]];
+    if (elem.strct.has(SE_NUM_CHILDREN) || maps.schema_num_children[i] != 0) {
+      elem.strct.set(SE_NUM_CHILDREN, thrift::T_I32,
+                     Value::of_int(maps.schema_num_children[i]));
+    }
+    new_schema.push_back(std::move(elem));
+  }
+  *schema = std::move(new_schema);
+
+  // column_orders is one entry per leaf column: same gather map as chunks.
+  if (std::vector<Value>* orders = list_field(meta, FMD_COLUMN_ORDERS)) {
+    std::vector<Value> new_orders;
+    new_orders.reserve(maps.chunk_map.size());
+    for (int idx : maps.chunk_map) new_orders.push_back((*orders)[idx]);
+    *orders = std::move(new_orders);
+  }
+
+  // Gather each row group's column chunks.
+  if (std::vector<Value>* groups = list_field(meta, FMD_ROW_GROUPS)) {
+    for (Value& group : *groups) {
+      std::vector<Value>* cols = list_field(group.strct, RG_COLUMNS);
+      if (!cols) continue;
+      std::vector<Value> new_cols;
+      new_cols.reserve(maps.chunk_map.size());
+      for (int idx : maps.chunk_map) new_cols.push_back((*cols)[idx]);
+      *cols = std::move(new_cols);
+    }
+  }
+}
+
+void Footer::filter_groups(int64_t part_offset, int64_t part_length) {
+  if (part_length < 0) return;
+  std::vector<Value>* groups = list_field(meta, FMD_ROW_GROUPS);
+  if (!groups || groups->empty()) return;
+
+  // Does the first row group's first column carry ColumnMetaData?  If yes
+  // the chunk offsets are trustworthy; if not, fall back to RowGroup
+  // file_offset with the PARQUET-2078 monotonicity repair.
+  bool chunks_have_metadata = false;
+  {
+    const std::vector<Value>* cols0 =
+        list_field((*groups)[0].strct, RG_COLUMNS);
+    if (cols0 && !cols0->empty()) {
+      chunks_have_metadata = (*cols0)[0].strct.has(CC_META_DATA);
+    }
+  }
+
+  std::vector<Value> kept;
+  int64_t prev_start = 0;
+  int64_t prev_compressed = 0;
+  for (Value& group : *groups) {
+    Struct& rg = group.strct;
+    int64_t start;
+    if (chunks_have_metadata) {
+      const std::vector<Value>* cols = list_field(rg, RG_COLUMNS);
+      start = (cols && !cols->empty()) ? chunk_start_offset((*cols)[0]) : 0;
+    } else {
+      start = i64_field_or(rg, RG_FILE_OFFSET, 0);
+      // PARQUET-2078: only the first row group's file_offset is reliable.
+      bool bad = (prev_start == 0) ? (start != 4)
+                                   : (start < prev_start + prev_compressed);
+      if (bad) {
+        start = (prev_start == 0) ? 4 : prev_start + prev_compressed;
+      }
+      prev_start = start;
+      prev_compressed = i64_field_or(rg, RG_TOTAL_COMPRESSED_SIZE, 0);
+    }
+
+    int64_t total = i64_field_or(rg, RG_TOTAL_COMPRESSED_SIZE, -1);
+    if (total < 0) {
+      total = 0;
+      if (const std::vector<Value>* cols = list_field(rg, RG_COLUMNS)) {
+        for (const Value& c : *cols) {
+          int mi = c.strct.find(CC_META_DATA);
+          if (mi >= 0) {
+            total += i64_field_or(c.strct.values[mi].strct,
+                                  CMD_TOTAL_COMPRESSED_SIZE, 0);
+          }
+        }
+      }
+    }
+
+    int64_t mid = start + total / 2;
+    if (mid >= part_offset && mid < part_offset + part_length) {
+      kept.push_back(std::move(group));
+    }
+  }
+  *groups = std::move(kept);
+}
+
+int64_t Footer::num_rows() const {
+  int gi = meta.find(FMD_ROW_GROUPS);
+  if (gi < 0) return 0;
+  int64_t total = 0;
+  for (const Value& g : meta.values[gi].list.elems) {
+    total += i64_field_or(g.strct, RG_NUM_ROWS, 0);
+  }
+  return total;
+}
+
+int32_t Footer::num_columns() const {
+  int si = meta.find(FMD_SCHEMA);
+  if (si < 0) return 0;
+  const std::vector<Value>& schema = meta.values[si].list.elems;
+  if (schema.empty()) return 0;
+  int ci = schema[0].strct.find(SE_NUM_CHILDREN);
+  return ci >= 0 ? static_cast<int32_t>(schema[0].strct.values[ci].i) : 0;
+}
+
+std::vector<uint8_t> Footer::serialize_file() const {
+  std::vector<uint8_t> body = thrift::write_struct(meta);
+  std::vector<uint8_t> out;
+  out.reserve(body.size() + 12);
+  const char magic[4] = {'P', 'A', 'R', '1'};
+  out.insert(out.end(), magic, magic + 4);
+  out.insert(out.end(), body.begin(), body.end());
+  uint32_t n = static_cast<uint32_t>(body.size());
+  for (int k = 0; k < 4; ++k) out.push_back(static_cast<uint8_t>(n >> (8 * k)));
+  out.insert(out.end(), magic, magic + 4);
+  return out;
+}
+
+}  // namespace parquet
+}  // namespace srj

@@ -1,0 +1,329 @@
+#include "srj/thrift_compact.hpp"
+
+#include <cstring>
+#include <stdexcept>
+
+namespace srj {
+namespace thrift {
+
+int Struct::find(int16_t id) const {
+  for (size_t i = 0; i < ids.size(); ++i) {
+    if (ids[i] == id) return static_cast<int>(i);
+  }
+  return -1;
+}
+
+Value& Struct::at(int16_t id) {
+  int i = find(id);
+  if (i < 0) throw std::runtime_error("thrift field " + std::to_string(id) + " absent");
+  return values[i];
+}
+
+const Value& Struct::at(int16_t id) const {
+  int i = find(id);
+  if (i < 0) throw std::runtime_error("thrift field " + std::to_string(id) + " absent");
+  return values[i];
+}
+
+void Struct::erase(int16_t id) {
+  int i = find(id);
+  if (i < 0) return;
+  ids.erase(ids.begin() + i);
+  types.erase(types.begin() + i);
+  values.erase(values.begin() + i);
+}
+
+void Struct::set(int16_t id, uint8_t type, Value v) {
+  int i = find(id);
+  if (i >= 0) {
+    types[i] = type;
+    values[i] = std::move(v);
+  } else {
+    ids.push_back(id);
+    types.push_back(type);
+    values.push_back(std::move(v));
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Reader
+// ---------------------------------------------------------------------------
+
+namespace {
+
+class Reader {
+ public:
+  Reader(const uint8_t* buf, uint64_t len, const Limits& limits)
+      : buf_(buf), len_(len), limits_(limits) {}
+
+  Struct read_top() {
+    Struct s = read_struct_body(0);
+    return s;
+  }
+
+ private:
+  const uint8_t* buf_;
+  uint64_t len_;
+  uint64_t pos_ = 0;
+  const Limits& limits_;
+
+  [[noreturn]] void fail(const char* what) {
+    throw std::runtime_error(std::string("thrift compact parse error: ") + what);
+  }
+
+  uint8_t byte() {
+    if (pos_ >= len_) fail("unexpected end of buffer");
+    return buf_[pos_++];
+  }
+
+  uint64_t varint() {
+    uint64_t out = 0;
+    int shift = 0;
+    while (true) {
+      uint8_t b = byte();
+      out |= static_cast<uint64_t>(b & 0x7F) << shift;
+      if (!(b & 0x80)) return out;
+      shift += 7;
+      if (shift >= 64) fail("varint too long");
+    }
+  }
+
+  int64_t zigzag() {
+    uint64_t u = varint();
+    return static_cast<int64_t>(u >> 1) ^ -static_cast<int64_t>(u & 1);
+  }
+
+  Value read_value(uint8_t type, uint32_t depth) {
+    if (depth > limits_.max_depth) fail("nesting too deep");
+    Value v;
+    switch (type) {
+      case T_BOOL_TRUE:  // container element: one byte each
+      case T_BOOL_FALSE:
+        v.b = (byte() == T_BOOL_TRUE);
+        break;
+      case T_I8:
+        v.i = static_cast<int8_t>(byte());
+        break;
+      case T_I16:
+      case T_I32:
+      case T_I64:
+        v.i = zigzag();
+        break;
+      case T_DOUBLE: {
+        if (pos_ + 8 > len_) fail("truncated double");
+        uint64_t bits = 0;  // compact protocol doubles are little-endian
+        for (int k = 7; k >= 0; --k) bits = (bits << 8) | buf_[pos_ + k];
+        pos_ += 8;
+        std::memcpy(&v.d, &bits, 8);
+        break;
+      }
+      case T_BINARY: {
+        uint64_t n = varint();
+        if (n > limits_.max_string) fail("string too large");
+        if (pos_ + n > len_) fail("truncated string");
+        v.bin.assign(reinterpret_cast<const char*>(buf_ + pos_), n);
+        pos_ += n;
+        break;
+      }
+      case T_LIST:
+      case T_SET:
+        v.list = read_list(depth + 1);
+        v.list.is_set = (type == T_SET);
+        break;
+      case T_MAP:
+        v.map = read_map(depth + 1);
+        break;
+      case T_STRUCT:
+        v.strct = read_struct_body(depth + 1);
+        break;
+      default:
+        fail("unknown wire type");
+    }
+    return v;
+  }
+
+  List read_list(uint32_t depth) {
+    uint8_t head = byte();
+    uint64_t n = (head >> 4) & 0x0F;
+    if (n == 15) n = varint();
+    if (n > limits_.max_container) fail("container too large");
+    List out;
+    out.elem_type = head & 0x0F;
+    out.elems.reserve(n);
+    for (uint64_t i = 0; i < n; ++i) out.elems.push_back(read_value(out.elem_type, depth));
+    return out;
+  }
+
+  Map read_map(uint32_t depth) {
+    uint64_t n = varint();
+    if (n > limits_.max_container) fail("container too large");
+    Map out;
+    if (n == 0) return out;
+    uint8_t kv = byte();
+    out.key_type = (kv >> 4) & 0x0F;
+    out.val_type = kv & 0x0F;
+    out.keys.reserve(n);
+    out.vals.reserve(n);
+    for (uint64_t i = 0; i < n; ++i) {
+      out.keys.push_back(read_value(out.key_type, depth));
+      out.vals.push_back(read_value(out.val_type, depth));
+    }
+    return out;
+  }
+
+  Struct read_struct_body(uint32_t depth) {
+    if (depth > limits_.max_depth) fail("nesting too deep");
+    Struct out;
+    int16_t last_id = 0;
+    while (true) {
+      uint8_t head = byte();
+      if (head == T_STOP) break;
+      uint8_t type = head & 0x0F;
+      uint8_t delta = (head >> 4) & 0x0F;
+      int16_t id;
+      if (delta == 0) {
+        id = static_cast<int16_t>(zigzag());
+      } else {
+        id = static_cast<int16_t>(last_id + delta);
+      }
+      last_id = id;
+      Value v;
+      uint8_t stored_type = type;
+      if (type == T_BOOL_TRUE || type == T_BOOL_FALSE) {
+        // In a field header the type nibble IS the boolean value.
+        v.b = (type == T_BOOL_TRUE);
+        stored_type = T_BOOL_TRUE;
+      } else {
+        v = read_value(type, depth + 1);
+      }
+      out.ids.push_back(id);
+      out.types.push_back(stored_type);
+      out.values.push_back(std::move(v));
+      if (out.ids.size() > limits_.max_container) fail("too many fields");
+    }
+    return out;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Writer
+// ---------------------------------------------------------------------------
+
+class Writer {
+ public:
+  std::vector<uint8_t> out;
+
+  void varint(uint64_t v) {
+    while (v >= 0x80) {
+      out.push_back(static_cast<uint8_t>(v) | 0x80);
+      v >>= 7;
+    }
+    out.push_back(static_cast<uint8_t>(v));
+  }
+
+  void zigzag(int64_t v) {
+    varint((static_cast<uint64_t>(v) << 1) ^ static_cast<uint64_t>(v >> 63));
+  }
+
+  void value(uint8_t type, const Value& v) {
+    switch (type) {
+      case T_BOOL_TRUE:  // container element form
+      case T_BOOL_FALSE:
+        out.push_back(v.b ? T_BOOL_TRUE : T_BOOL_FALSE);
+        break;
+      case T_I8:
+        out.push_back(static_cast<uint8_t>(v.i));
+        break;
+      case T_I16:
+      case T_I32:
+      case T_I64:
+        zigzag(v.i);
+        break;
+      case T_DOUBLE: {
+        uint64_t bits;
+        std::memcpy(&bits, &v.d, 8);
+        for (int k = 0; k < 8; ++k) out.push_back(static_cast<uint8_t>(bits >> (8 * k)));
+        break;
+      }
+      case T_BINARY:
+        varint(v.bin.size());
+        out.insert(out.end(), v.bin.begin(), v.bin.end());
+        break;
+      case T_LIST:
+      case T_SET:
+        list(v.list);
+        break;
+      case T_MAP:
+        map(v.map);
+        break;
+      case T_STRUCT:
+        strct(v.strct);
+        break;
+      default:
+        throw std::runtime_error("cannot serialize unknown thrift type");
+    }
+  }
+
+  void list(const List& l) {
+    uint64_t n = l.elems.size();
+    if (n < 15) {
+      out.push_back(static_cast<uint8_t>((n << 4) | l.elem_type));
+    } else {
+      out.push_back(static_cast<uint8_t>(0xF0 | l.elem_type));
+      varint(n);
+    }
+    for (const Value& e : l.elems) value(l.elem_type, e);
+  }
+
+  void map(const Map& m) {
+    uint64_t n = m.keys.size();
+    varint(n);
+    if (n == 0) return;
+    out.push_back(static_cast<uint8_t>((m.key_type << 4) | m.val_type));
+    for (uint64_t i = 0; i < n; ++i) {
+      value(m.key_type, m.keys[i]);
+      value(m.val_type, m.vals[i]);
+    }
+  }
+
+  void strct(const Struct& s) {
+    int16_t last_id = 0;
+    for (size_t i = 0; i < s.ids.size(); ++i) {
+      int16_t id = s.ids[i];
+      uint8_t type = s.types[i];
+      uint8_t header_type = type;
+      if (type == T_BOOL_TRUE || type == T_BOOL_FALSE) {
+        header_type = s.values[i].b ? T_BOOL_TRUE : T_BOOL_FALSE;
+      }
+      int32_t delta = id - last_id;
+      if (delta > 0 && delta <= 15) {
+        out.push_back(static_cast<uint8_t>((delta << 4) | header_type));
+      } else {
+        out.push_back(header_type);
+        zigzag(id);
+      }
+      last_id = id;
+      if (header_type != T_BOOL_TRUE && header_type != T_BOOL_FALSE) {
+        value(type, s.values[i]);
+      }
+      // (booleans in field position carry their value in the header)
+    }
+    out.push_back(T_STOP);
+  }
+};
+
+}  // namespace
+
+Struct read_struct(const uint8_t* buf, uint64_t len, const Limits& limits) {
+  Reader r(buf, len, limits);
+  return r.read_top();
+}
+
+std::vector<uint8_t> write_struct(const Struct& s) {
+  Writer w;
+  w.strct(s);
+  return std::move(w.out);
+}
+
+}  // namespace thrift
+}  // namespace srj

@@ -1,0 +1,135 @@
+// Assert-based native tests for the thrift codec + footer engine.  The heavy
+// behavioral coverage lives in tests/test_parquet_footer.py, which
+// cross-checks this implementation against the pure-Python twin (the
+// dual-implementation oracle strategy of the reference test suite,
+// /root/reference/src/main/cpp/tests/row_conversion.cpp).
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+
+#include "srj/parquet_footer.hpp"
+#include "srj/thrift_compact.hpp"
+
+using namespace srj::thrift;
+using namespace srj::parquet;
+
+static Value schema_element(const std::string& name, int type, int num_children,
+                            int converted = -1, int repetition = -1) {
+  Struct s;
+  if (type >= 0) s.set(SE_TYPE, T_I32, Value::of_int(type));
+  if (repetition >= 0) s.set(SE_REPETITION, T_I32, Value::of_int(repetition));
+  s.set(SE_NAME, T_BINARY, Value::of_bin(name));
+  if (num_children >= 0) s.set(SE_NUM_CHILDREN, T_I32, Value::of_int(num_children));
+  if (converted >= 0) s.set(SE_CONVERTED_TYPE, T_I32, Value::of_int(converted));
+  return Value::of_struct(s);
+}
+
+static Value column_chunk(int64_t data_off, int64_t dict_off, int64_t comp_size) {
+  Struct md;
+  md.set(CMD_TOTAL_COMPRESSED_SIZE, T_I64, Value::of_int(comp_size));
+  md.set(CMD_DATA_PAGE_OFFSET, T_I64, Value::of_int(data_off));
+  if (dict_off >= 0) {
+    md.set(CMD_DICTIONARY_PAGE_OFFSET, T_I64, Value::of_int(dict_off));
+  }
+  Struct cc;
+  cc.set(2 /*file_offset*/, T_I64, Value::of_int(data_off));
+  cc.set(CC_META_DATA, T_STRUCT, Value::of_struct(md));
+  return Value::of_struct(cc);
+}
+
+static Struct three_col_footer() {
+  // root + columns a (i64), b (i32), c (double); two row groups
+  List schema;
+  schema.elem_type = T_STRUCT;
+  schema.elems.push_back(schema_element("root", -1, 3));
+  schema.elems.push_back(schema_element("a", 2, -1));
+  schema.elems.push_back(schema_element("B", 1, -1));
+  schema.elems.push_back(schema_element("c", 5, -1));
+
+  List groups;
+  groups.elem_type = T_STRUCT;
+  int64_t off = 4;
+  for (int g = 0; g < 2; ++g) {
+    List cols;
+    cols.elem_type = T_STRUCT;
+    int64_t group_bytes = 0;
+    for (int c = 0; c < 3; ++c) {
+      cols.elems.push_back(column_chunk(off, g == 0 && c == 0 ? 4 : -1, 100));
+      off += 100;
+      group_bytes += 100;
+    }
+    Struct rg;
+    rg.set(RG_COLUMNS, T_LIST, Value::of_list(cols));
+    rg.set(RG_TOTAL_BYTE_SIZE, T_I64, Value::of_int(group_bytes));
+    rg.set(RG_NUM_ROWS, T_I64, Value::of_int(1000 + g));
+    rg.set(RG_TOTAL_COMPRESSED_SIZE, T_I64, Value::of_int(group_bytes));
+    groups.elems.push_back(Value::of_struct(rg));
+  }
+
+  Struct meta;
+  meta.set(FMD_VERSION, T_I32, Value::of_int(1));
+  meta.set(FMD_SCHEMA, T_LIST, Value::of_list(schema));
+  meta.set(FMD_NUM_ROWS, T_I64, Value::of_int(2001));
+  meta.set(FMD_ROW_GROUPS, T_LIST, Value::of_list(groups));
+  meta.set(FMD_CREATED_BY, T_BINARY, Value::of_bin("srj-tpu test"));
+  return meta;
+}
+
+static void test_roundtrip() {
+  Struct meta = three_col_footer();
+  std::vector<uint8_t> bytes = write_struct(meta);
+  Struct back = read_struct(bytes.data(), bytes.size());
+  std::vector<uint8_t> again = write_struct(back);
+  assert(bytes == again);
+  assert(back.at(FMD_NUM_ROWS).i == 2001);
+  assert(back.at(FMD_CREATED_BY).bin == "srj-tpu test");
+  assert(back.at(FMD_SCHEMA).list.elems.size() == 4);
+}
+
+static void test_prune_and_groups() {
+  Struct meta = three_col_footer();
+  std::vector<uint8_t> bytes = write_struct(meta);
+  Footer f = Footer::parse(bytes.data(), bytes.size());
+  assert(f.num_rows() == 2001);
+  assert(f.num_columns() == 3);
+
+  // Select {c, b} case-insensitively; keep only the first row group's split.
+  std::vector<std::string> names{"b", "c"};
+  std::vector<int32_t> nch{0, 0};
+  std::vector<Tag> tags{Tag::VALUE, Tag::VALUE};
+  f.filter_columns(names, nch, tags, 2, /*ignore_case=*/true);
+  f.filter_groups(0, 300);
+
+  assert(f.num_columns() == 2);
+  assert(f.num_rows() == 1000);
+  const auto& schema = f.meta.at(FMD_SCHEMA).list.elems;
+  assert(schema.size() == 3);
+  assert(schema[1].strct.at(SE_NAME).bin == "B");
+  assert(schema[2].strct.at(SE_NAME).bin == "c");
+  const auto& groups = f.meta.at(FMD_ROW_GROUPS).list.elems;
+  assert(groups.size() == 1);
+  assert(groups[0].strct.at(RG_COLUMNS).list.elems.size() == 2);
+
+  // framing: PAR1 ... PAR1 with length
+  std::vector<uint8_t> file = f.serialize_file();
+  assert(std::memcmp(file.data(), "PAR1", 4) == 0);
+  assert(std::memcmp(file.data() + file.size() - 4, "PAR1", 4) == 0);
+  uint32_t n = 0;
+  std::memcpy(&n, file.data() + file.size() - 8, 4);
+  assert(n == file.size() - 12);
+}
+
+static void test_lowercase() {
+  assert(utf8_to_lower("AbC_123") == "abc_123");
+  assert(utf8_to_lower("\xC3\x80") == "\xC3\xA0");      // À -> à
+  assert(utf8_to_lower("\xD0\x90") == "\xD0\xB0");      // А -> а (Cyrillic)
+  assert(utf8_to_lower("\xCE\xA3") == "\xCF\x83");      // Σ -> σ
+}
+
+int main() {
+  test_roundtrip();
+  test_prune_and_groups();
+  test_lowercase();
+  std::printf("native footer tests passed\n");
+  return 0;
+}

@@ -1,0 +1,76 @@
+"""ctypes binding to the native footer engine (``native/`` C++ library).
+
+The loader role the reference plays with ``NativeDepsLoader.loadNativeDeps``
+(``ParquetFooter.java:28-30``): find (or build) the shared library once, then
+expose handle-based calls.  Handles cross this boundary as opaque pointers,
+the way the reference passes jlongs over JNI.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_LIB_NAME = "libsrj_tpu.so"
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "_native")
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed: Optional[str] = None
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.srj_last_error.restype = ctypes.c_char_p
+    lib.srj_footer_parse.restype = ctypes.c_void_p
+    lib.srj_footer_parse.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.srj_footer_close.argtypes = [ctypes.c_void_p]
+    lib.srj_footer_filter.restype = ctypes.c_int
+    lib.srj_footer_filter.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32,
+    ]
+    lib.srj_footer_num_rows.restype = ctypes.c_int64
+    lib.srj_footer_num_rows.argtypes = [ctypes.c_void_p]
+    lib.srj_footer_num_columns.restype = ctypes.c_int32
+    lib.srj_footer_num_columns.argtypes = [ctypes.c_void_p]
+    lib.srj_footer_serialize.restype = ctypes.c_int64
+    lib.srj_footer_serialize.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+    ]
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, building it from ``native/`` on first use.
+
+    Returns None (callers fall back to the pure-Python engine) if the build
+    is disabled via SRJ_TPU_NO_NATIVE=1 or the toolchain is unavailable.
+    """
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed is not None:
+            return _lib
+        if os.environ.get("SRJ_TPU_NO_NATIVE") == "1":
+            _load_failed = "disabled via SRJ_TPU_NO_NATIVE"
+            return None
+        path = os.path.abspath(os.path.join(_NATIVE_DIR, _LIB_NAME))
+        try:
+            if not os.path.exists(path):
+                subprocess.run(
+                    ["make", "-C", os.path.abspath(_SRC_DIR)],
+                    check=True, capture_output=True, timeout=300)
+            _lib = _configure(ctypes.CDLL(path))
+        except (OSError, subprocess.SubprocessError) as e:
+            _load_failed = str(e)
+            return None
+        return _lib
+
+
+def last_error(lib: ctypes.CDLL) -> str:
+    return lib.srj_last_error().decode("utf-8", "replace")

@@ -1,0 +1,339 @@
+"""Pure-Python parquet footer engine (twin of ``native/src/parquet_footer.cpp``).
+
+Implements the same parse / prune / re-serialize semantics as the native
+library over the :mod:`thrift_dom` DOM.  Behavior parity targets the
+reference footer component (``/root/reference/src/main/cpp/src/
+NativeParquetJni.cpp``): depth-first selection-tree pruning (struct/value/
+list/map walkers, subtree skipping), the row-group split-midpoint rule with
+the PARQUET-2078 bad-offset workaround, and PAR1 file framing.
+"""
+
+from __future__ import annotations
+
+import struct as _struct
+from typing import Dict, List, Sequence
+
+from spark_rapids_jni_tpu.parquet.thrift_dom import (
+    TList, TStruct, TType, read_struct, write_struct,
+)
+
+# parquet.thrift field ids (parquet-format IDL)
+FMD_VERSION = 1
+FMD_SCHEMA = 2
+FMD_NUM_ROWS = 3
+FMD_ROW_GROUPS = 4
+FMD_KV_METADATA = 5
+FMD_CREATED_BY = 6
+FMD_COLUMN_ORDERS = 7
+SE_TYPE = 1
+SE_REPETITION = 3
+SE_NAME = 4
+SE_NUM_CHILDREN = 5
+SE_CONVERTED_TYPE = 6
+RG_COLUMNS = 1
+RG_TOTAL_BYTE_SIZE = 2
+RG_NUM_ROWS = 3
+RG_FILE_OFFSET = 5
+RG_TOTAL_COMPRESSED_SIZE = 6
+CC_META_DATA = 3
+CMD_TOTAL_COMPRESSED_SIZE = 7
+CMD_DATA_PAGE_OFFSET = 9
+CMD_DICTIONARY_PAGE_OFFSET = 11
+CT_MAP = 1
+CT_MAP_KEY_VALUE = 2
+CT_LIST = 3
+REP_REPEATED = 2
+
+TAG_VALUE = 0
+TAG_STRUCT = 1
+TAG_LIST = 2
+TAG_MAP = 3
+
+
+def _se_name(elem: TStruct, fold: bool) -> str:
+    raw = elem.get(SE_NAME, b"")
+    name = raw.decode("utf-8") if isinstance(raw, bytes) else raw
+    return name.lower() if fold else name
+
+
+def _se_is_leaf(elem: TStruct) -> bool:
+    return elem.has(SE_TYPE)
+
+
+def _se_num_children(elem: TStruct) -> int:
+    return elem.get(SE_NUM_CHILDREN, 0)
+
+
+class _Node:
+    """Selection-tree node (reference ``column_pruner``)."""
+
+    def __init__(self, tag: int):
+        self.tag = tag
+        self.children: Dict[str, "_Node"] = {}
+
+
+def build_selection_tree(names: Sequence[str], num_children: Sequence[int],
+                         tags: Sequence[int], parent_num_children: int) -> _Node:
+    root = _Node(TAG_STRUCT)
+    if parent_num_children == 0:
+        return root
+    node_stack = [root]
+    remaining = [parent_num_children]
+    for name, n_c, tag in zip(names, num_children, tags):
+        child = node_stack[-1].children.setdefault(name, _Node(tag))
+        if n_c > 0:
+            node_stack.append(child)
+            remaining.append(n_c)
+        else:
+            while node_stack:
+                remaining[-1] -= 1
+                if remaining[-1] > 0:
+                    break
+                node_stack.pop()
+                remaining.pop()
+    if node_stack:
+        raise ValueError("schema filter flattening is inconsistent")
+    return root
+
+
+class _Walk:
+    def __init__(self):
+        self.schema_index = 0
+        self.chunk_index = 0
+        self.schema_map: List[int] = []
+        self.schema_num_children: List[int] = []
+        self.chunk_map: List[int] = []
+
+
+def _skip(schema: list, w: _Walk) -> None:
+    pending = 1
+    while pending > 0 and w.schema_index < len(schema):
+        elem = schema[w.schema_index]
+        if _se_is_leaf(elem):
+            w.chunk_index += 1
+        pending += _se_num_children(elem) - 1
+        w.schema_index += 1
+
+
+def _filter(node: _Node, schema: list, ignore_case: bool, w: _Walk) -> None:
+    if node.tag == TAG_STRUCT:
+        _filter_struct(node, schema, ignore_case, w)
+    elif node.tag == TAG_VALUE:
+        _filter_value(schema, w)
+    elif node.tag == TAG_LIST:
+        _filter_list(node, schema, ignore_case, w)
+    elif node.tag == TAG_MAP:
+        _filter_map(node, schema, ignore_case, w)
+    else:
+        raise ValueError(f"unknown selection tag {node.tag}")
+
+
+def _filter_struct(node: _Node, schema: list, ignore_case: bool, w: _Walk) -> None:
+    self_elem = schema[w.schema_index]
+    if _se_is_leaf(self_elem):
+        raise ValueError("expected a struct column but found a leaf")
+    nc = _se_num_children(self_elem)
+    w.schema_map.append(w.schema_index)
+    slot = len(w.schema_num_children)
+    w.schema_num_children.append(0)
+    w.schema_index += 1
+    for _ in range(nc):
+        if w.schema_index >= len(schema):
+            break
+        name = _se_name(schema[w.schema_index], ignore_case)
+        child = node.children.get(name)
+        if child is not None:
+            w.schema_num_children[slot] += 1
+            _filter(child, schema, ignore_case, w)
+        else:
+            _skip(schema, w)
+
+
+def _filter_value(schema: list, w: _Walk) -> None:
+    self_elem = schema[w.schema_index]
+    if not _se_is_leaf(self_elem):
+        raise ValueError("expected a leaf column but found a group")
+    if _se_num_children(self_elem) != 0:
+        raise ValueError("leaf column unexpectedly has children")
+    w.schema_map.append(w.schema_index)
+    w.schema_num_children.append(0)
+    w.schema_index += 1
+    w.chunk_map.append(w.chunk_index)
+    w.chunk_index += 1
+
+
+def _filter_list(node: _Node, schema: list, ignore_case: bool, w: _Walk) -> None:
+    elem_node = node.children.get("element")
+    if elem_node is None:
+        raise ValueError("list selection has no 'element' child")
+    outer = schema[w.schema_index]
+    outer_name = _se_name(outer, False)
+    if _se_is_leaf(outer):
+        raise ValueError("expected a LIST group but found a leaf")
+    if outer.get(SE_CONVERTED_TYPE) != CT_LIST:
+        raise ValueError("expected a LIST converted type")
+    if _se_num_children(outer) != 1:
+        raise ValueError("LIST group must have exactly one child")
+    w.schema_map.append(w.schema_index)
+    w.schema_num_children.append(1)
+    w.schema_index += 1
+
+    rep = schema[w.schema_index]
+    if rep.get(SE_REPETITION) != REP_REPEATED:
+        raise ValueError("LIST child is not repeated")
+    rep_is_group = not _se_is_leaf(rep)
+    rep_name = _se_name(rep, False)
+    if (rep_is_group and _se_num_children(rep) == 1
+            and rep_name != "array" and rep_name != outer_name + "_tuple"):
+        w.schema_map.append(w.schema_index)
+        w.schema_num_children.append(1)
+        w.schema_index += 1
+        _filter(elem_node, schema, ignore_case, w)
+    else:
+        _filter(elem_node, schema, ignore_case, w)
+
+
+def _filter_map(node: _Node, schema: list, ignore_case: bool, w: _Walk) -> None:
+    key_node = node.children.get("key")
+    val_node = node.children.get("value")
+    if key_node is None or val_node is None:
+        raise ValueError("map selection needs 'key' and 'value' children")
+    outer = schema[w.schema_index]
+    if _se_is_leaf(outer):
+        raise ValueError("expected a MAP group but found a leaf")
+    if outer.get(SE_CONVERTED_TYPE) not in (CT_MAP, CT_MAP_KEY_VALUE):
+        raise ValueError("expected a MAP converted type")
+    if _se_num_children(outer) != 1:
+        raise ValueError("MAP group must have exactly one child")
+    w.schema_map.append(w.schema_index)
+    w.schema_num_children.append(1)
+    w.schema_index += 1
+
+    rep = schema[w.schema_index]
+    if rep.get(SE_REPETITION) != REP_REPEATED:
+        raise ValueError("MAP key_value group is not repeated")
+    rep_children = _se_num_children(rep)
+    if rep_children not in (1, 2):
+        raise ValueError("MAP key_value group has wrong child count")
+    w.schema_map.append(w.schema_index)
+    w.schema_num_children.append(rep_children)
+    w.schema_index += 1
+
+    _filter(key_node, schema, ignore_case, w)
+    if rep_children == 2:
+        _filter(val_node, schema, ignore_case, w)
+
+
+class PyFooter:
+    """Parsed footer DOM + the filter/serialize operations."""
+
+    def __init__(self, meta: TStruct):
+        self.meta = meta
+
+    @staticmethod
+    def parse(buf: bytes) -> "PyFooter":
+        return PyFooter(read_struct(buf))
+
+    # -- pruning -----------------------------------------------------------
+
+    def filter_columns(self, names: Sequence[str], num_children: Sequence[int],
+                       tags: Sequence[int], parent_num_children: int,
+                       ignore_case: bool) -> None:
+        schema_list = self.meta.at(FMD_SCHEMA)
+        schema = [e for e in schema_list.elems]
+        root = build_selection_tree(names, num_children, tags,
+                                    parent_num_children)
+        w = _Walk()
+        _filter(root, schema, ignore_case, w)
+
+        new_schema = []
+        for idx, n_c in zip(w.schema_map, w.schema_num_children):
+            elem = schema[idx]
+            if elem.has(SE_NUM_CHILDREN) or n_c != 0:
+                elem.set(SE_NUM_CHILDREN, TType.I32, n_c)
+            new_schema.append(elem)
+        schema_list.elems = new_schema
+
+        orders = self.meta.get(FMD_COLUMN_ORDERS)
+        if orders is not None:
+            orders.elems = [orders.elems[i] for i in w.chunk_map]
+
+        groups = self.meta.get(FMD_ROW_GROUPS)
+        if groups is not None:
+            for g in groups.elems:
+                cols = g.get(RG_COLUMNS)
+                if cols is not None:
+                    cols.elems = [cols.elems[i] for i in w.chunk_map]
+
+    # -- row-group split filter -------------------------------------------
+
+    @staticmethod
+    def _chunk_start(chunk: TStruct) -> int:
+        md = chunk.get(CC_META_DATA)
+        if md is None:
+            return 0
+        off = md.get(CMD_DATA_PAGE_OFFSET, 0)
+        dict_off = md.get(CMD_DICTIONARY_PAGE_OFFSET)
+        if dict_off is not None and off > dict_off:
+            off = dict_off
+        return off
+
+    def filter_groups(self, part_offset: int, part_length: int) -> None:
+        if part_length < 0:
+            return
+        groups = self.meta.get(FMD_ROW_GROUPS)
+        if groups is None or not groups.elems:
+            return
+        cols0 = groups.elems[0].get(RG_COLUMNS)
+        chunks_have_metadata = bool(cols0 and cols0.elems
+                                    and cols0.elems[0].has(CC_META_DATA))
+        kept = []
+        prev_start = 0
+        prev_compressed = 0
+        for g in groups.elems:
+            if chunks_have_metadata:
+                cols = g.get(RG_COLUMNS)
+                start = self._chunk_start(cols.elems[0]) if cols and cols.elems else 0
+            else:
+                start = g.get(RG_FILE_OFFSET, 0)
+                bad = (start != 4) if prev_start == 0 \
+                    else (start < prev_start + prev_compressed)
+                if bad:
+                    start = 4 if prev_start == 0 else prev_start + prev_compressed
+                prev_start = start
+                prev_compressed = g.get(RG_TOTAL_COMPRESSED_SIZE, 0)
+
+            total = g.get(RG_TOTAL_COMPRESSED_SIZE)
+            if total is None:
+                total = 0
+                cols = g.get(RG_COLUMNS)
+                if cols is not None:
+                    for c in cols.elems:
+                        md = c.get(CC_META_DATA)
+                        if md is not None:
+                            total += md.get(CMD_TOTAL_COMPRESSED_SIZE, 0)
+
+            mid = start + total // 2
+            if part_offset <= mid < part_offset + part_length:
+                kept.append(g)
+        groups.elems = kept
+
+    # -- accessors ---------------------------------------------------------
+
+    def num_rows(self) -> int:
+        groups = self.meta.get(FMD_ROW_GROUPS)
+        if groups is None:
+            return 0
+        return sum(g.get(RG_NUM_ROWS, 0) for g in groups.elems)
+
+    def num_columns(self) -> int:
+        schema = self.meta.get(FMD_SCHEMA)
+        if schema is None or not schema.elems:
+            return 0
+        return schema.elems[0].get(SE_NUM_CHILDREN, 0)
+
+    # -- serialization -----------------------------------------------------
+
+    def serialize_file(self) -> bytes:
+        body = write_struct(self.meta)
+        return b"PAR1" + body + _struct.pack("<I", len(body)) + b"PAR1"

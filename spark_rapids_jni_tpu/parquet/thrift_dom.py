@@ -1,0 +1,305 @@
+"""Thrift Compact Protocol codec over a generic field DOM (pure Python).
+
+Twin of the native codec (``native/src/thrift_compact.cpp``): parses any
+compact-protocol struct into a generic (field id, wire type, value) tree and
+serializes it back byte-faithfully, unknown fields included.  The twin exists
+for two reasons: it is the fallback when the native library is unavailable,
+and it is the *independent implementation* the test suite cross-checks the
+native engine against — the dual-implementation oracle strategy the reference
+uses for its kernels (``src/main/cpp/tests/row_conversion.cpp``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct as _struct
+from enum import IntEnum
+from typing import List, Union
+
+
+class TType(IntEnum):
+    STOP = 0
+    BOOL_TRUE = 1
+    BOOL_FALSE = 2
+    I8 = 3
+    I16 = 4
+    I32 = 5
+    I64 = 6
+    DOUBLE = 7
+    BINARY = 8
+    LIST = 9
+    SET = 10
+    MAP = 11
+    STRUCT = 12
+
+
+# string/container caps against hostile footers (reference guards at
+# NativeParquetJni.cpp:536-540)
+MAX_STRING = 100 * 1000 * 1000
+MAX_CONTAINER = 1000 * 1000
+MAX_DEPTH = 64
+
+
+@dataclasses.dataclass
+class TField:
+    id: int
+    type: int  # TType; bools normalized to BOOL_TRUE
+    value: "TValue"
+
+
+@dataclasses.dataclass
+class TStruct:
+    fields: List[TField] = dataclasses.field(default_factory=list)
+
+    def find(self, fid: int) -> int:
+        for i, f in enumerate(self.fields):
+            if f.id == fid:
+                return i
+        return -1
+
+    def has(self, fid: int) -> bool:
+        return self.find(fid) >= 0
+
+    def get(self, fid: int, default=None):
+        i = self.find(fid)
+        return self.fields[i].value if i >= 0 else default
+
+    def at(self, fid: int):
+        i = self.find(fid)
+        if i < 0:
+            raise KeyError(f"thrift field {fid} absent")
+        return self.fields[i].value
+
+    def set(self, fid: int, ttype: int, value) -> None:
+        i = self.find(fid)
+        if i >= 0:
+            self.fields[i] = TField(fid, ttype, value)
+        else:
+            self.fields.append(TField(fid, ttype, value))
+
+    def erase(self, fid: int) -> None:
+        i = self.find(fid)
+        if i >= 0:
+            del self.fields[i]
+
+
+@dataclasses.dataclass
+class TList:
+    elem_type: int
+    elems: list = dataclasses.field(default_factory=list)
+    is_set: bool = False
+
+
+@dataclasses.dataclass
+class TMap:
+    key_type: int
+    val_type: int
+    keys: list = dataclasses.field(default_factory=list)
+    vals: list = dataclasses.field(default_factory=list)
+
+
+TValue = Union[bool, int, float, bytes, TList, TMap, TStruct]
+
+
+class ThriftParseError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Reader
+# ---------------------------------------------------------------------------
+
+class _Reader:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def byte(self) -> int:
+        if self.pos >= len(self.buf):
+            raise ThriftParseError("unexpected end of buffer")
+        b = self.buf[self.pos]
+        self.pos += 1
+        return b
+
+    def varint(self) -> int:
+        out = 0
+        shift = 0
+        while True:
+            b = self.byte()
+            out |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return out
+            shift += 7
+            if shift >= 64:
+                raise ThriftParseError("varint too long")
+
+    def zigzag(self) -> int:
+        u = self.varint()
+        return (u >> 1) ^ -(u & 1)
+
+    def value(self, ttype: int, depth: int):
+        if depth > MAX_DEPTH:
+            raise ThriftParseError("nesting too deep")
+        if ttype in (TType.BOOL_TRUE, TType.BOOL_FALSE):
+            return self.byte() == TType.BOOL_TRUE  # container element form
+        if ttype == TType.I8:
+            v = self.byte()
+            return v - 256 if v >= 128 else v
+        if ttype in (TType.I16, TType.I32, TType.I64):
+            return self.zigzag()
+        if ttype == TType.DOUBLE:
+            if self.pos + 8 > len(self.buf):
+                raise ThriftParseError("truncated double")
+            (v,) = _struct.unpack_from("<d", self.buf, self.pos)
+            self.pos += 8
+            return v
+        if ttype == TType.BINARY:
+            n = self.varint()
+            if n > MAX_STRING:
+                raise ThriftParseError("string too large")
+            if self.pos + n > len(self.buf):
+                raise ThriftParseError("truncated string")
+            v = self.buf[self.pos:self.pos + n]
+            self.pos += n
+            return v
+        if ttype in (TType.LIST, TType.SET):
+            out = self.tlist(depth + 1)
+            out.is_set = ttype == TType.SET
+            return out
+        if ttype == TType.MAP:
+            return self.tmap(depth + 1)
+        if ttype == TType.STRUCT:
+            return self.tstruct(depth + 1)
+        raise ThriftParseError(f"unknown wire type {ttype}")
+
+    def tlist(self, depth: int) -> TList:
+        head = self.byte()
+        n = (head >> 4) & 0x0F
+        elem_type = head & 0x0F
+        if n == 15:
+            n = self.varint()
+        if n > MAX_CONTAINER:
+            raise ThriftParseError("container too large")
+        return TList(elem_type, [self.value(elem_type, depth) for _ in range(n)])
+
+    def tmap(self, depth: int) -> TMap:
+        n = self.varint()
+        if n > MAX_CONTAINER:
+            raise ThriftParseError("container too large")
+        if n == 0:
+            return TMap(TType.BINARY, TType.BINARY)
+        kv = self.byte()
+        out = TMap((kv >> 4) & 0x0F, kv & 0x0F)
+        for _ in range(n):
+            out.keys.append(self.value(out.key_type, depth))
+            out.vals.append(self.value(out.val_type, depth))
+        return out
+
+    def tstruct(self, depth: int) -> TStruct:
+        if depth > MAX_DEPTH:
+            raise ThriftParseError("nesting too deep")
+        out = TStruct()
+        last_id = 0
+        while True:
+            head = self.byte()
+            if head == TType.STOP:
+                return out
+            ttype = head & 0x0F
+            delta = (head >> 4) & 0x0F
+            fid = self.zigzag() if delta == 0 else last_id + delta
+            last_id = fid
+            if ttype in (TType.BOOL_TRUE, TType.BOOL_FALSE):
+                # in field position the type nibble IS the value
+                out.fields.append(
+                    TField(fid, TType.BOOL_TRUE, ttype == TType.BOOL_TRUE))
+            else:
+                out.fields.append(TField(fid, ttype, self.value(ttype, depth + 1)))
+            if len(out.fields) > MAX_CONTAINER:
+                raise ThriftParseError("too many fields")
+
+
+# ---------------------------------------------------------------------------
+# Writer
+# ---------------------------------------------------------------------------
+
+class _Writer:
+    def __init__(self):
+        self.out = bytearray()
+
+    def varint(self, v: int) -> None:
+        while v >= 0x80:
+            self.out.append((v & 0x7F) | 0x80)
+            v >>= 7
+        self.out.append(v)
+
+    def zigzag(self, v: int) -> None:
+        self.varint((v << 1) ^ (v >> 63) if v < 0 else v << 1)
+
+    def value(self, ttype: int, v) -> None:
+        if ttype in (TType.BOOL_TRUE, TType.BOOL_FALSE):
+            self.out.append(TType.BOOL_TRUE if v else TType.BOOL_FALSE)
+        elif ttype == TType.I8:
+            self.out.append(v & 0xFF)
+        elif ttype in (TType.I16, TType.I32, TType.I64):
+            self.zigzag(v)
+        elif ttype == TType.DOUBLE:
+            self.out += _struct.pack("<d", v)
+        elif ttype == TType.BINARY:
+            data = v.encode("utf-8") if isinstance(v, str) else v
+            self.varint(len(data))
+            self.out += data
+        elif ttype in (TType.LIST, TType.SET):
+            self.tlist(v)
+        elif ttype == TType.MAP:
+            self.tmap(v)
+        elif ttype == TType.STRUCT:
+            self.tstruct(v)
+        else:
+            raise ThriftParseError(f"cannot serialize type {ttype}")
+
+    def tlist(self, lst: TList) -> None:
+        n = len(lst.elems)
+        if n < 15:
+            self.out.append((n << 4) | lst.elem_type)
+        else:
+            self.out.append(0xF0 | lst.elem_type)
+            self.varint(n)
+        for e in lst.elems:
+            self.value(lst.elem_type, e)
+
+    def tmap(self, m: TMap) -> None:
+        n = len(m.keys)
+        self.varint(n)
+        if n == 0:
+            return
+        self.out.append((m.key_type << 4) | m.val_type)
+        for k, v in zip(m.keys, m.vals):
+            self.value(m.key_type, k)
+            self.value(m.val_type, v)
+
+    def tstruct(self, s: TStruct) -> None:
+        last_id = 0
+        for f in s.fields:
+            header_type = f.type
+            if f.type in (TType.BOOL_TRUE, TType.BOOL_FALSE):
+                header_type = TType.BOOL_TRUE if f.value else TType.BOOL_FALSE
+            delta = f.id - last_id
+            if 0 < delta <= 15:
+                self.out.append((delta << 4) | header_type)
+            else:
+                self.out.append(header_type)
+                self.zigzag(f.id)
+            last_id = f.id
+            if header_type not in (TType.BOOL_TRUE, TType.BOOL_FALSE):
+                self.value(f.type, f.value)
+        self.out.append(TType.STOP)
+
+
+def read_struct(buf: bytes) -> TStruct:
+    return _Reader(bytes(buf)).tstruct(0)
+
+
+def write_struct(s: TStruct) -> bytes:
+    w = _Writer()
+    w.tstruct(s)
+    return bytes(w.out)

@@ -1,0 +1,442 @@
+"""Parquet footer engine tests.
+
+Oracle strategy mirrors the reference suite (SURVEY.md §4): two independent
+implementations of the same contract — the native C++ engine and the
+pure-Python twin — run on identical inputs and must agree byte-for-byte.
+Synthetic footers are built directly in the thrift DOM (the reference builds
+test inputs with cudf column wrappers; footers here are metadata-only).
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.parquet import (
+    ListElement, MapElement, ParquetFooter, StructElement, ValueElement,
+    _strip_framing, flatten_schema, read_and_filter,
+)
+from spark_rapids_jni_tpu.parquet import native as native_mod
+from spark_rapids_jni_tpu.parquet.pyfooter import (
+    CC_META_DATA, CMD_DATA_PAGE_OFFSET, CMD_DICTIONARY_PAGE_OFFSET,
+    CMD_TOTAL_COMPRESSED_SIZE, CT_LIST, CT_MAP, FMD_COLUMN_ORDERS,
+    FMD_CREATED_BY, FMD_NUM_ROWS, FMD_ROW_GROUPS, FMD_SCHEMA, FMD_VERSION,
+    PyFooter, RG_COLUMNS, RG_FILE_OFFSET, RG_NUM_ROWS,
+    RG_TOTAL_COMPRESSED_SIZE, RG_TOTAL_BYTE_SIZE, REP_REPEATED,
+    SE_CONVERTED_TYPE, SE_NAME, SE_NUM_CHILDREN, SE_REPETITION, SE_TYPE,
+)
+from spark_rapids_jni_tpu.parquet.thrift_dom import (
+    TList, TStruct, TType, read_struct, write_struct,
+)
+
+NATIVE_AVAILABLE = native_mod.load() is not None
+
+ENGINES = ["python"] + (["native"] if NATIVE_AVAILABLE else [])
+
+
+# ---------------------------------------------------------------------------
+# Synthetic footer builders
+# ---------------------------------------------------------------------------
+
+def se(name, ptype=None, num_children=None, converted=None, repetition=None):
+    s = TStruct()
+    if ptype is not None:
+        s.set(SE_TYPE, TType.I32, ptype)
+    if repetition is not None:
+        s.set(SE_REPETITION, TType.I32, repetition)
+    s.set(SE_NAME, TType.BINARY, name.encode())
+    if num_children is not None:
+        s.set(SE_NUM_CHILDREN, TType.I32, num_children)
+    if converted is not None:
+        s.set(SE_CONVERTED_TYPE, TType.I32, converted)
+    return s
+
+
+def chunk(data_off, comp_size, dict_off=None, with_meta=True, file_offset=None):
+    cc = TStruct()
+    cc.set(2, TType.I64, file_offset if file_offset is not None else data_off)
+    if with_meta:
+        md = TStruct()
+        md.set(1, TType.I32, 2)  # type INT64 (arbitrary)
+        md.set(CMD_TOTAL_COMPRESSED_SIZE, TType.I64, comp_size)
+        md.set(CMD_DATA_PAGE_OFFSET, TType.I64, data_off)
+        if dict_off is not None:
+            md.set(CMD_DICTIONARY_PAGE_OFFSET, TType.I64, dict_off)
+        cc.set(CC_META_DATA, TType.STRUCT, md)
+    return cc
+
+
+def row_group(chunks, num_rows, total_compressed=None, file_offset=None):
+    rg = TStruct()
+    rg.set(RG_COLUMNS, TType.LIST, TList(TType.STRUCT, chunks))
+    rg.set(RG_TOTAL_BYTE_SIZE, TType.I64,
+           sum(c.at(CC_META_DATA).at(CMD_TOTAL_COMPRESSED_SIZE)
+               for c in chunks if c.has(CC_META_DATA)) or 0)
+    rg.set(RG_NUM_ROWS, TType.I64, num_rows)
+    if file_offset is not None:
+        rg.set(RG_FILE_OFFSET, TType.I64, file_offset)
+    if total_compressed is not None:
+        rg.set(RG_TOTAL_COMPRESSED_SIZE, TType.I64, total_compressed)
+    return rg
+
+
+def file_meta(schema_elems, groups, created_by=b"srj", column_orders=None):
+    m = TStruct()
+    m.set(FMD_VERSION, TType.I32, 1)
+    m.set(FMD_SCHEMA, TType.LIST, TList(TType.STRUCT, schema_elems))
+    m.set(FMD_NUM_ROWS, TType.I64,
+          sum(g.at(RG_NUM_ROWS) for g in groups) if groups else 0)
+    m.set(FMD_ROW_GROUPS, TType.LIST, TList(TType.STRUCT, groups))
+    m.set(FMD_CREATED_BY, TType.BINARY, created_by)
+    if column_orders is not None:
+        m.set(FMD_COLUMN_ORDERS, TType.LIST, TList(TType.STRUCT, column_orders))
+    return m
+
+
+def flat_footer(col_names, rows_per_group=(100,), types=None):
+    """root + N leaf columns, one chunk per column per group."""
+    n = len(col_names)
+    types = types or [2] * n
+    schema = [se("root", num_children=n)]
+    for name, t in zip(col_names, types):
+        schema.append(se(name, ptype=t))
+    groups = []
+    off = 4
+    for rows in rows_per_group:
+        chunks = []
+        for _ in range(n):
+            chunks.append(chunk(off, 100))
+            off += 100
+        groups.append(row_group(chunks, rows, total_compressed=100 * n))
+    return file_meta(schema, groups)
+
+
+def select(*names):
+    b = StructElement.builder()
+    for n in names:
+        b.add_child(n, ValueElement())
+    return b.build()
+
+
+# ---------------------------------------------------------------------------
+# Thrift DOM codec
+# ---------------------------------------------------------------------------
+
+class TestThriftDom:
+    def test_roundtrip_bytes_identical(self):
+        meta = flat_footer(["a", "b", "c"], rows_per_group=(10, 20))
+        raw = write_struct(meta)
+        back = read_struct(raw)
+        assert write_struct(back) == raw
+
+    def test_all_scalar_types(self):
+        s = TStruct()
+        s.set(1, TType.BOOL_TRUE, True)
+        s.set(2, TType.BOOL_TRUE, False)
+        s.set(3, TType.I8, -5)
+        s.set(4, TType.I16, -300)
+        s.set(5, TType.I32, 1 << 20)
+        s.set(6, TType.I64, -(1 << 50))
+        s.set(7, TType.DOUBLE, 3.5)
+        s.set(8, TType.BINARY, b"hello")
+        raw = write_struct(s)
+        back = read_struct(raw)
+        assert back.at(1) is True
+        assert back.at(2) is False
+        assert back.at(3) == -5
+        assert back.at(4) == -300
+        assert back.at(5) == 1 << 20
+        assert back.at(6) == -(1 << 50)
+        assert back.at(7) == 3.5
+        assert back.at(8) == b"hello"
+        assert write_struct(back) == raw
+
+    def test_wide_field_ids_and_long_lists(self):
+        s = TStruct()
+        s.set(1000, TType.I32, 7)           # long-form field header
+        s.set(3, TType.I32, 9)              # out-of-order: negative delta
+        big = TList(TType.I32, list(range(20)))  # >15 elems: long-form size
+        s.set(4, TType.LIST, big)
+        back = read_struct(write_struct(s))
+        assert back.at(1000) == 7
+        assert back.at(3) == 9
+        assert back.at(4).elems == list(range(20))
+
+    def test_truncation_rejected(self):
+        raw = write_struct(flat_footer(["a"]))
+        with pytest.raises(ValueError):
+            read_struct(raw[: len(raw) // 2])
+
+    def test_size_bomb_rejected(self):
+        # claims a 10^9-byte string in a tiny buffer
+        bomb = bytes([0x18 | 0x00]) # field 1, BINARY
+        bomb = bytes([0x18]) + b"\xff\xff\xff\xff\x04" + b"x"
+        with pytest.raises(ValueError):
+            read_struct(bomb)
+
+
+# ---------------------------------------------------------------------------
+# Selection DSL
+# ---------------------------------------------------------------------------
+
+class TestFlatten:
+    def test_nested_flatten_matches_reference_contract(self):
+        schema = (StructElement.builder()
+                  .add_child("a", ValueElement())
+                  .add_child("s", StructElement.builder()
+                             .add_child("x", ValueElement())
+                             .add_child("y", ValueElement()).build())
+                  .add_child("l", ListElement(ValueElement()))
+                  .add_child("m", MapElement(ValueElement(), ValueElement()))
+                  .build())
+        names, nc, tags = flatten_schema(schema, lower=False)
+        assert names == ["a", "s", "x", "y", "l", "element", "m", "key", "value"]
+        assert nc == [0, 2, 0, 0, 1, 0, 2, 0, 0]
+        assert tags == [0, 1, 0, 0, 2, 0, 3, 0, 0]
+
+    def test_lowercase_flatten(self):
+        schema = select("AbC")
+        names, _, _ = flatten_schema(schema, lower=True)
+        assert names == ["abc"]
+
+
+# ---------------------------------------------------------------------------
+# Filtering behavior (both engines)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("engine", ENGINES)
+class TestReadAndFilter:
+    def test_prune_columns(self, engine):
+        raw = write_struct(flat_footer(["a", "b", "c"], rows_per_group=(50,)))
+        with read_and_filter(raw, 0, 1 << 40, select("c", "a"),
+                             engine=engine) as f:
+            assert f.num_columns() == 2
+            assert f.num_rows() == 50
+            out = PyFooter.parse(_strip_framing(f.serialize_thrift_file()))
+            schema = out.meta.at(FMD_SCHEMA).elems
+            assert [e.at(SE_NAME) for e in schema] == [b"root", b"a", b"c"]
+            assert schema[0].at(SE_NUM_CHILDREN) == 2
+            groups = out.meta.at(FMD_ROW_GROUPS).elems
+            assert len(groups[0].at(RG_COLUMNS).elems) == 2
+
+    def test_case_insensitive(self, engine):
+        raw = write_struct(flat_footer(["MiXeD", "other"]))
+        with read_and_filter(raw, 0, 1 << 40, select("mixed"),
+                             ignore_case=True, engine=engine) as f:
+            assert f.num_columns() == 1
+
+    def test_case_sensitive_misses(self, engine):
+        raw = write_struct(flat_footer(["MiXeD"]))
+        with read_and_filter(raw, 0, 1 << 40, select("mixed"),
+                             engine=engine) as f:
+            assert f.num_columns() == 0
+
+    def test_group_split_midpoint(self, engine):
+        # 2 groups of 300 bytes each: [4, 304) and [304, 604)
+        raw = write_struct(flat_footer(["a", "b", "c"],
+                                       rows_per_group=(100, 200)))
+        with read_and_filter(raw, 0, 200, select("a"), engine=engine) as f:
+            assert f.num_rows() == 100   # only group 1 midpoint (154) in range
+        with read_and_filter(raw, 200, 500, select("a"), engine=engine) as f:
+            assert f.num_rows() == 200   # group 2 midpoint 454
+        with read_and_filter(raw, 0, 1 << 40, select("a"), engine=engine) as f:
+            assert f.num_rows() == 300
+        with read_and_filter(raw, part_offset=0, part_length=-1,
+                             schema=select("a"), engine=engine) as f:
+            assert f.num_rows() == 300   # negative length: keep everything
+
+    def test_dictionary_offset_is_group_start(self, engine):
+        # data page at 104 but dictionary at 4: group 1 starts at 4
+        g1 = row_group([chunk(104, 200, dict_off=4)], 10, total_compressed=200)
+        g2 = row_group([chunk(304, 200)], 20, total_compressed=200)
+        raw = write_struct(file_meta([se("root", num_children=1),
+                                      se("a", ptype=2)], [g1, g2]))
+        with read_and_filter(raw, 0, 200, select("a"), engine=engine) as f:
+            assert f.num_rows() == 10
+
+    def test_parquet_2078_fallback(self, engine):
+        # chunks carry no ColumnMetaData -> row-group file_offsets are used,
+        # and invalid offsets repaired from the previous group's extent
+        g1 = row_group([chunk(0, 0, with_meta=False)], 10,
+                       total_compressed=300, file_offset=99)   # bad: != 4
+        g2 = row_group([chunk(0, 0, with_meta=False)], 20,
+                       total_compressed=300, file_offset=0)    # bad: < 304
+        raw = write_struct(file_meta([se("root", num_children=1),
+                                      se("a", ptype=2)], [g1, g2]))
+        # repaired starts: g1=4 (mid 154), g2=304 (mid 454)
+        with read_and_filter(raw, 0, 200, select("a"), engine=engine) as f:
+            assert f.num_rows() == 10
+        with read_and_filter(raw, 200, 400, select("a"), engine=engine) as f:
+            assert f.num_rows() == 20
+
+    def test_nested_struct_prune(self, engine):
+        schema_elems = [
+            se("root", num_children=2),
+            se("s", num_children=3),
+            se("x", ptype=1),
+            se("y", ptype=2),
+            se("z", ptype=5),
+            se("top", ptype=2),
+        ]
+        chunks = [chunk(4 + i * 100, 100) for i in range(4)]  # x y z top
+        raw = write_struct(file_meta(schema_elems,
+                                     [row_group(chunks, 42)]))
+        sel = (StructElement.builder()
+               .add_child("s", StructElement.builder()
+                          .add_child("y", ValueElement()).build())
+               .add_child("top", ValueElement())
+               .build())
+        with read_and_filter(raw, 0, 1 << 40, sel, engine=engine) as f:
+            out = PyFooter.parse(_strip_framing(f.serialize_thrift_file()))
+            schema = out.meta.at(FMD_SCHEMA).elems
+            assert [e.at(SE_NAME) for e in schema] == \
+                [b"root", b"s", b"y", b"top"]
+            assert schema[1].at(SE_NUM_CHILDREN) == 1
+            cols = out.meta.at(FMD_ROW_GROUPS).elems[0].at(RG_COLUMNS).elems
+            # chunks kept: y (index 1) and top (index 3)
+            md_offs = [c.at(CC_META_DATA).at(CMD_DATA_PAGE_OFFSET)
+                       for c in cols]
+            assert md_offs == [104, 304]
+
+    def test_list_three_level(self, engine):
+        schema_elems = [
+            se("root", num_children=1),
+            se("l", num_children=1, converted=CT_LIST),
+            se("list", num_children=1, repetition=REP_REPEATED),
+            se("element", ptype=2),
+        ]
+        raw = write_struct(file_meta(schema_elems,
+                                     [row_group([chunk(4, 100)], 7)]))
+        sel = (StructElement.builder()
+               .add_child("l", ListElement(ValueElement())).build())
+        with read_and_filter(raw, 0, 1 << 40, sel, engine=engine) as f:
+            assert f.num_rows() == 7
+            out = PyFooter.parse(_strip_framing(f.serialize_thrift_file()))
+            assert len(out.meta.at(FMD_SCHEMA).elems) == 4
+
+    def test_list_legacy_two_level(self, engine):
+        # repeated group named "array" -> legacy: element is the repeated node
+        schema_elems = [
+            se("root", num_children=1),
+            se("l", num_children=1, converted=CT_LIST),
+            se("array", ptype=2, repetition=REP_REPEATED),
+        ]
+        raw = write_struct(file_meta(schema_elems,
+                                     [row_group([chunk(4, 100)], 7)]))
+        sel = (StructElement.builder()
+               .add_child("l", ListElement(ValueElement())).build())
+        with read_and_filter(raw, 0, 1 << 40, sel, engine=engine) as f:
+            out = PyFooter.parse(_strip_framing(f.serialize_thrift_file()))
+            assert len(out.meta.at(FMD_SCHEMA).elems) == 3
+
+    def test_map_prune(self, engine):
+        schema_elems = [
+            se("root", num_children=1),
+            se("m", num_children=1, converted=CT_MAP),
+            se("key_value", num_children=2, repetition=REP_REPEATED),
+            se("key", ptype=6),
+            se("value", ptype=2),
+        ]
+        raw = write_struct(file_meta(
+            schema_elems, [row_group([chunk(4, 100), chunk(104, 100)], 3)]))
+        sel = (StructElement.builder()
+               .add_child("m", MapElement(ValueElement(), ValueElement()))
+               .build())
+        with read_and_filter(raw, 0, 1 << 40, sel, engine=engine) as f:
+            out = PyFooter.parse(_strip_framing(f.serialize_thrift_file()))
+            assert len(out.meta.at(FMD_SCHEMA).elems) == 5
+            cols = out.meta.at(FMD_ROW_GROUPS).elems[0].at(RG_COLUMNS).elems
+            assert len(cols) == 2
+
+    def test_column_orders_pruned(self, engine):
+        orders = []
+        for _ in range(3):
+            o = TStruct()
+            o.set(1, TType.STRUCT, TStruct())  # TypeDefinedOrder
+            orders.append(o)
+        meta = flat_footer(["a", "b", "c"])
+        meta.set(FMD_COLUMN_ORDERS, TType.LIST, TList(TType.STRUCT, orders))
+        raw = write_struct(meta)
+        with read_and_filter(raw, 0, 1 << 40, select("b"),
+                             engine=engine) as f:
+            out = PyFooter.parse(_strip_framing(f.serialize_thrift_file()))
+            assert len(out.meta.at(FMD_COLUMN_ORDERS).elems) == 1
+
+    def test_type_mismatch_raises(self, engine):
+        raw = write_struct(flat_footer(["a"]))
+        sel = (StructElement.builder()
+               .add_child("a", StructElement.builder()
+                          .add_child("x", ValueElement()).build())
+               .build())
+        with pytest.raises((ValueError, RuntimeError)):
+            read_and_filter(raw, 0, 1 << 40, sel, engine=engine)
+
+    def test_framed_input_accepted(self, engine):
+        body = write_struct(flat_footer(["a"]))
+        framed = b"PAR1" + body + struct.pack("<I", len(body)) + b"PAR1"
+        with read_and_filter(framed, 0, 1 << 40, select("a"),
+                             engine=engine) as f:
+            assert f.num_columns() == 1
+
+    def test_serialized_framing(self, engine):
+        raw = write_struct(flat_footer(["a"]))
+        with read_and_filter(raw, 0, 1 << 40, select("a"),
+                             engine=engine) as f:
+            out = f.serialize_thrift_file()
+        assert out[:4] == b"PAR1" and out[-4:] == b"PAR1"
+        (n,) = struct.unpack("<I", out[-8:-4])
+        assert n == len(out) - 12
+
+    def test_unknown_fields_preserved(self, engine):
+        meta = flat_footer(["a", "b"])
+        # simulate a future/unknown FileMetaData field
+        extra = TStruct()
+        extra.set(1, TType.BINARY, b"opaque")
+        meta.set(32000, TType.STRUCT, extra)
+        raw = write_struct(meta)
+        with read_and_filter(raw, 0, 1 << 40, select("a"),
+                             engine=engine) as f:
+            out = PyFooter.parse(_strip_framing(f.serialize_thrift_file()))
+            assert out.meta.at(32000).at(1) == b"opaque"
+
+
+# ---------------------------------------------------------------------------
+# Dual-implementation cross-check (native vs python twin)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(not NATIVE_AVAILABLE, reason="native lib not built")
+class TestCrossImpl:
+    def test_randomized_equivalence(self):
+        rng = np.random.default_rng(7)
+        for trial in range(25):
+            ncols = int(rng.integers(1, 12))
+            names = [f"c{i}" for i in range(ncols)]
+            ngroups = int(rng.integers(1, 5))
+            rows = [int(rng.integers(1, 10000)) for _ in range(ngroups)]
+            meta = flat_footer(names, rows_per_group=tuple(rows))
+            raw = write_struct(meta)
+            keep = [n for n in names if rng.random() < 0.6]
+            total = 100 * ncols * ngroups + 8
+            part_off = int(rng.integers(0, max(1, total)))
+            part_len = int(rng.integers(1, max(2, total)))
+            sel = select(*keep) if keep else StructElement([])
+            fn = read_and_filter(raw, part_off, part_len, sel, engine="native")
+            fp = read_and_filter(raw, part_off, part_len, sel, engine="python")
+            assert fn.num_rows() == fp.num_rows(), trial
+            assert fn.num_columns() == fp.num_columns(), trial
+            assert fn.serialize_thrift_file() == fp.serialize_thrift_file(), trial
+            fn.close()
+            fp.close()
+
+    def test_utf8_names_cross_engine(self):
+        names = ["Ärger", "Straße", "ДАННЫЕ", "Σήμα"]
+        raw = write_struct(flat_footer(names))
+        sel = select(*[n.lower() for n in names])
+        fn = read_and_filter(raw, 0, 1 << 40, sel, ignore_case=True,
+                             engine="native")
+        fp = read_and_filter(raw, 0, 1 << 40, sel, ignore_case=True,
+                             engine="python")
+        assert fn.num_columns() == fp.num_columns() == 4
+        assert fn.serialize_thrift_file() == fp.serialize_thrift_file()
+        fn.close()
+        fp.close()
