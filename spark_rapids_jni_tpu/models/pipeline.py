@@ -23,7 +23,7 @@ Everything is jit-compatible and shape-static; masks carry row liveness.
 from __future__ import annotations
 
 import functools
-from typing import Callable, Dict, Sequence
+from typing import Callable, Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -46,11 +46,22 @@ def project(table: Table, exprs: Sequence[Callable], dtypes) -> Table:
     return Table(tuple(cols))
 
 
-def filter_mask(table: Table, pred: Callable) -> jnp.ndarray:
-    """Boolean selection mask from a predicate over column data arrays,
-    AND'd with row validity of the referenced columns being valid."""
+def filter_mask(table: Table, pred: Callable,
+                cols: Optional[Sequence[int]] = None) -> jnp.ndarray:
+    """Boolean selection mask from a predicate over column data arrays.
+
+    ``cols`` names the column indices the predicate actually references;
+    their row validity is AND'd in so null inputs filter as false (Spark's
+    null semantics) without dropping rows for nulls in unrelated columns.
+    ``None`` conservatively treats every column as referenced."""
     datas = tuple(c.data for c in table.columns)
-    return pred(*datas)
+    m = pred(*datas)
+    idxs = range(table.num_columns) if cols is None else cols
+    for i in idxs:
+        c = table.columns[i]
+        if c.validity is not None:
+            m = m & c.valid_bools()
+    return m
 
 
 # ---------------------------------------------------------------------------
@@ -62,32 +73,43 @@ def hash_aggregate_sum(keys: jnp.ndarray, values: jnp.ndarray,
     """Exact group-by-sum with static output capacity.
 
     Returns (group_keys[max_groups], sums[max_groups], group_valid mask,
-    num_groups).  Rows with ``mask == False`` are excluded.  If there are
-    more than ``max_groups`` distinct keys the tail groups are dropped and
-    reported via ``num_groups`` (callers size capacity like the shuffle's
-    ``capacity_factor``).
+    num_groups).  Rows with ``mask == False`` are excluded.  ``num_groups``
+    is the TOTAL number of distinct live keys: when it exceeds
+    ``max_groups``, the tail groups (in key-sorted order) were dropped and
+    the caller must re-run with a larger capacity — the same host-checked
+    overflow contract the shuffle uses (``parallel/shuffle.py``).
     """
     n = keys.shape[0]
-    # push masked-out rows to the end with a sentinel beyond any key
+    # push masked-out rows toward the end with a max-key sentinel; liveness
+    # travels with the rows (a valid row whose key IS the sentinel value
+    # still aggregates correctly — it just shares a segment with dead rows)
     big = jnp.iinfo(keys.dtype).max
     k = jnp.where(mask, keys, big)
     order = jnp.argsort(k, stable=True)
     ks = k[order]
-    vs = jnp.where(mask, values, 0)[order]
+    live = mask[order]
+    vs = jnp.where(live, values[order], 0)
     is_new = jnp.concatenate([jnp.ones((1,), jnp.int32),
                               (ks[1:] != ks[:-1]).astype(jnp.int32)])
     seg = jnp.cumsum(is_new) - 1                      # segment id per row
-    seg = jnp.minimum(seg, max_groups - 1)
-    live = ks != big
-    sums = jax.ops.segment_sum(jnp.where(live, vs, 0), seg,
-                               num_segments=max_groups)
+    # overflow groups route to a dump segment that is sliced away, instead
+    # of corrupting the last real group
+    in_range = seg < max_groups
+    seg_c = jnp.where(in_range, seg, max_groups)
+    contrib = live & in_range
+    sums = jax.ops.segment_sum(jnp.where(contrib, vs, 0), seg_c,
+                               num_segments=max_groups + 1)[:max_groups]
     # first row of each segment carries the key
     first_idx = jax.ops.segment_min(
-        jnp.arange(n, dtype=jnp.int32), seg, num_segments=max_groups)
-    have = jax.ops.segment_max(live.astype(jnp.int32), seg,
-                               num_segments=max_groups) > 0
+        jnp.arange(n, dtype=jnp.int32), seg_c,
+        num_segments=max_groups + 1)[:max_groups]
+    have = jax.ops.segment_max(contrib.astype(jnp.int32), seg_c,
+                               num_segments=max_groups + 1)[:max_groups] > 0
     gkeys = jnp.where(have, ks[jnp.minimum(first_idx, n - 1)], 0)
-    num_groups = jnp.sum(have.astype(jnp.int32))
+    # total distinct live keys (uncapped) so overflow is detectable
+    seg_live = jax.ops.segment_sum(live.astype(jnp.int32), seg,
+                                   num_segments=n) > 0
+    num_groups = jnp.sum(seg_live.astype(jnp.int32))
     return gkeys, sums, have, num_groups
 
 
@@ -141,45 +163,30 @@ def distributed_query_step(mesh, axis_name="data",
     mesh (so each device owns whole groups), then aggregate locally.
 
     Returns a function (sold_date, quantity) -> per-device partial
-    aggregates; jit it over sharded inputs.  This is the "training step"
-    analogue the driver dry-runs multi-chip.
+    aggregates plus a per-device ``overflow`` flag (True means a shuffle
+    bucket overflowed and the step must be retried with a larger
+    ``capacity_factor``); jit it over sharded inputs.  This is the
+    "training step" analogue the driver dry-runs multi-chip.
     """
     from jax.sharding import PartitionSpec as P
+    from spark_rapids_jni_tpu.parallel.shuffle import bucket_exchange
     num_parts = mesh.shape[axis_name]
 
     def step(sold_date, quantity):
         n_local = sold_date.shape[0]
         # per-(sender, target) bucket slack: group-key skew concentrates
-        # rows, so default well above the uniform expectation (overflowing
-        # buckets clamp; see parallel/shuffle.py for the flagged variant)
+        # rows, so default well above the uniform expectation
         capacity = max(8, int(capacity_factor * n_local / num_parts))
         # hash on the raw int32 data (Spark int hash contract)
         from spark_rapids_jni_tpu.table import INT32
         pids = pmod(murmur3_hash([Column(INT32, sold_date)]), num_parts)
 
-        order = jnp.argsort(pids, stable=True)
-        pids_s = pids[order]
-        counts = jnp.bincount(pids, length=num_parts).astype(jnp.int32)
-        starts = jnp.cumsum(counts) - counts
-        rank = jnp.minimum(
-            jnp.arange(n_local, dtype=jnp.int32) - starts[pids_s],
-            capacity - 1)
-        payload = jnp.stack([sold_date[order], quantity[order]], axis=1)
-        send = jnp.zeros((num_parts, capacity, 2), payload.dtype)
-        send = send.at[pids_s, rank].set(payload)
-        send_counts = jnp.minimum(counts, capacity)
-
-        recv = jax.lax.all_to_all(send, axis_name, 0, 0)
-        recv_counts = jax.lax.all_to_all(
-            send_counts.reshape(num_parts, 1), axis_name, 0, 0
-        ).reshape(num_parts)
-        slot = jax.lax.broadcasted_iota(jnp.int32, (num_parts, capacity), 1)
-        valid = (slot < recv_counts[:, None]).reshape(-1)
-        dates = recv[:, :, 0].reshape(-1)
-        qtys = recv[:, :, 1].reshape(-1)
+        payload = jnp.stack([sold_date, quantity], axis=1)
+        exchange = bucket_exchange(num_parts, capacity, axis_name)
+        recv, valid, _, overflow = exchange(payload, pids)
         gkeys, sums, have, num_groups = hash_aggregate_sum(
-            dates, qtys, valid, MAX_GROUPS)
-        return gkeys, sums, have, num_groups[None]
+            recv[:, 0], recv[:, 1], valid, MAX_GROUPS)
+        return gkeys, sums, have, num_groups[None], overflow[None]
 
     from jax import shard_map
     spec = P(axis_name)

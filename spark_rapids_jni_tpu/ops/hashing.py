@@ -70,18 +70,33 @@ def _as_u32_words(col: Column):
     if dt.is_string:
         raise NotImplementedError(
             "string hashing requires the byte-stream path (planned)")
-    if data.ndim == 2:  # uint32 pairs (64-bit without x64)
-        return data
     k = dt.np_dtype.itemsize
     if dt.np_dtype.kind == "f":
+        if k == 8 and data.ndim == 2:
+            # wide-mode double stored as (lo, hi) uint32 pairs: normalize
+            # -0.0 and NaN at the bit level so TPU (no-x64) hashes agree
+            # with the x64/Spark path
+            lo, hi = data[:, 0], data[:, 1]
+            exp_all_ones = (hi & jnp.uint32(0x7FF00000)) == jnp.uint32(0x7FF00000)
+            mant_nonzero = ((hi & jnp.uint32(0x000FFFFF)) | lo) != 0
+            is_nan = exp_all_ones & mant_nonzero
+            is_negzero = (hi == jnp.uint32(0x80000000)) & (lo == 0)
+            hi = jnp.where(is_nan, jnp.uint32(0x7FF80000),
+                           jnp.where(is_negzero, jnp.uint32(0), hi))
+            lo = jnp.where(is_nan | is_negzero, jnp.uint32(0), lo)
+            return jnp.stack([lo, hi], axis=1)
+        # -0.0 -> 0.0 and NaN -> canonical quiet NaN, as Java's
+        # floatToIntBits/doubleToLongBits produce for Spark
+        data = jnp.where(data == 0.0, jnp.zeros_like(data), data)
+        data = jnp.where(jnp.isnan(data), jnp.full_like(data, jnp.nan), data)
         if k == 4:
-            data = jnp.where(data == 0.0, jnp.float32(0.0), data)
             return jax.lax.bitcast_convert_type(data, jnp.uint32)[:, None]
-        data = jnp.where(data == 0.0, jnp.float64(0.0), data)
         pair = jax.lax.bitcast_convert_type(
             jax.lax.bitcast_convert_type(data, jnp.uint64).reshape(-1, 1),
             jnp.uint32)
         return pair.reshape(-1, 2)
+    if data.ndim == 2:  # int64 uint32 pairs (64-bit without x64): raw bits
+        return data
     if k == 8:
         return jax.lax.bitcast_convert_type(
             data.reshape(-1, 1), jnp.uint32).reshape(-1, 2)

@@ -62,9 +62,15 @@ class ShuffleResult:
         return cls(*children)
 
 
-def _local_shuffle_fn(layout, key_idx: Tuple[int, ...], num_parts: int,
-                      capacity: int, axis_name: str):
-    """Per-device body run under shard_map."""
+def bucket_exchange(num_parts: int, capacity: int, axis_name: str):
+    """Per-device all-to-all bucket exchange body (run under shard_map).
+
+    Packs ``payload2d[n_local, width]`` rows into ``[P, capacity, width]``
+    send buckets by ``pids``, exchanges them, and returns
+    ``(recv[P*capacity, width], slot_valid, num_valid, overflow)``.  Works
+    for any payload dtype; the JCUDF shuffle feeds uint8 row blobs, the
+    query pipeline feeds int32 column stacks.
+    """
 
     def body(rows2d, pids):
         n_local = rows2d.shape[0]
@@ -78,7 +84,7 @@ def _local_shuffle_fn(layout, key_idx: Tuple[int, ...], num_parts: int,
         rank = jnp.arange(n_local, dtype=jnp.int32) - starts[pids_sorted]
         overflow_local = jnp.any(counts > capacity)
         rank = jnp.minimum(rank, capacity - 1)  # clamp (flagged overflow)
-        send = jnp.zeros((num_parts, capacity, rs), jnp.uint8)
+        send = jnp.zeros((num_parts, capacity, rs), rows2d.dtype)
         send = send.at[pids_sorted, rank].set(rows_sorted)
         send_counts = jnp.minimum(counts, capacity)
 
@@ -128,8 +134,7 @@ def shuffle_table_sharded(table: Table, key_cols: Sequence[int],
         rows2d = rc._assemble_fixed_rows(tbl, layout)
         pids = hash_partition_ids(
             [tbl.columns[i] for i in key_cols], num_parts, seed)
-        body = _local_shuffle_fn(layout, tuple(key_cols), num_parts,
-                                 capacity, axis_name)
+        body = bucket_exchange(num_parts, capacity, axis_name)
         rows, valid, num_valid, overflow = body(rows2d, pids)
         return rows, valid, num_valid[None], overflow[None]
 

@@ -59,11 +59,11 @@ def cycle_dtypes(dtypes: Sequence[DType], num_cols: int) -> list:
 
 
 def _int_bounds(dt: DType, profile: DataProfile):
-    np_dt = dt.np_dtype
-    if profile.int_lower is not None:
-        return profile.int_lower, profile.int_upper
-    info = np.iinfo(np_dt)
-    return info.min, info.max
+    """Inclusive bounds; either profile bound may be set independently."""
+    info = np.iinfo(dt.np_dtype)
+    lo = info.min if profile.int_lower is None else profile.int_lower
+    hi = info.max if profile.int_upper is None else profile.int_upper
+    return lo, hi
 
 
 def _gen_fixed(key, dt: DType, n: int, profile: DataProfile) -> jnp.ndarray:
@@ -86,11 +86,39 @@ def _gen_fixed(key, dt: DType, n: int, profile: DataProfile) -> jnp.ndarray:
         return vals.astype(np_dt) if not wide else vals
     if dt.kind == "bool8":
         return jax.random.bernoulli(key, 0.5, (n,)).astype(jnp.uint8)
+    lo_set = profile.int_lower is not None
+    hi_set = profile.int_upper is not None
+    if lo_set or hi_set:
+        lo, hi = _int_bounds(dt, profile)
+        i32_lo, i32_hi = -(1 << 31), (1 << 31) - 2  # randint-safe int32 range
+        if wide:
+            # no-x64 64-bit columns: generate int32 values and widen to
+            # little-endian (lo, hi) uint32 pairs (sign-extended).
+            # Explicit bounds must fit int32; a defaulted side clamps to it.
+            if (lo_set and not i32_lo <= lo <= i32_hi) or \
+                    (hi_set and not i32_lo <= hi <= i32_hi):
+                raise ValueError(
+                    "int bounds for 64-bit columns must fit in int32 "
+                    "when x64 is disabled")
+            lo, hi = max(lo, i32_lo), min(hi, i32_hi)
+            vals = jax.random.randint(key, (n,), lo, hi + 1,
+                                      dtype=jnp.int32)
+            lo_w = jax.lax.bitcast_convert_type(vals, jnp.uint32)
+            hi_w = jnp.where(vals < 0, jnp.uint32(0xFFFFFFFF),
+                             jnp.uint32(0))
+            if np_dt.kind == "u":
+                hi_w = jnp.zeros_like(hi_w)
+            return jnp.stack([lo_w, hi_w], axis=1)
+        if not jax.config.jax_enable_x64 and np_dt.itemsize >= 4:
+            # randint computes in int32 without x64: clamp defaulted sides
+            # so a one-sided bound doesn't overflow maxval
+            if not lo_set:
+                lo = max(lo, i32_lo)
+            if not hi_set:
+                hi = min(hi, i32_hi)
+        return jax.random.randint(key, (n,), lo, hi + 1).astype(np_dt)
     if np_dt.itemsize == 8 and wide:
         return jax.random.bits(key, (n, 2), dtype=jnp.uint32)
-    if profile.int_lower is not None:
-        return jax.random.randint(key, (n,), profile.int_lower,
-                                  profile.int_upper + 1).astype(np_dt)
     if profile.distribution == "geometric":
         # geometric via transformed normal (reference builds geometric from
         # a scaled normal, random_distribution_factory.cuh:86-110)

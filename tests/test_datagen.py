@@ -66,3 +66,52 @@ def test_generated_table_roundtrips():
     assert len(batches) == 1
     got = convert_from_rows(batches[0], t.dtypes)
     assert_tables_equivalent(t, got)
+
+
+def test_int_bounds_honored_each_alone():
+    t = create_random_table([INT32], 500,
+                            DataProfile(int_lower=100), seed=3)
+    v = np.asarray(t.columns[0].data)
+    assert v.min() >= 100
+    t = create_random_table([INT32], 500,
+                            DataProfile(int_upper=5), seed=3)
+    v = np.asarray(t.columns[0].data)
+    assert v.max() <= 5
+
+
+def test_int64_bounds_honored():
+    t = create_random_table([INT64], 500,
+                            DataProfile(int_lower=-7, int_upper=9), seed=4)
+    v = np.asarray(t.columns[0].data)
+    if v.ndim == 2:  # wide (no-x64) pair representation
+        lo = v[:, 0].astype(np.uint64)
+        hi = v[:, 1].astype(np.uint64)
+        v = (lo | (hi << np.uint64(32))).view(np.int64)
+    assert v.min() >= -7 and v.max() <= 9
+
+
+def test_int64_bounds_wide_path():
+    """The no-x64 pair path must honor bounds too (TPU-mode regression)."""
+    import jax
+    from spark_rapids_jni_tpu.utils.datagen import _gen_fixed
+    prev = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", False)
+    try:
+        out = _gen_fixed(jax.random.PRNGKey(0), INT64, 300,
+                         DataProfile(int_lower=-4, int_upper=11))
+        out = np.asarray(out)
+        # one-sided bounds must not crash in no-x64 mode either
+        from spark_rapids_jni_tpu.table import INT32 as I32
+        one_sided = np.asarray(_gen_fixed(
+            jax.random.PRNGKey(1), I32, 100, DataProfile(int_lower=100)))
+        assert one_sided.min() >= 100
+        wide_one_sided = np.asarray(_gen_fixed(
+            jax.random.PRNGKey(2), INT64, 100, DataProfile(int_lower=0)))
+        assert wide_one_sided.shape == (100, 2)
+    finally:
+        jax.config.update("jax_enable_x64", prev)
+    pairs = np.asarray(out)
+    assert pairs.shape == (300, 2)
+    v = (pairs[:, 0].astype(np.uint64)
+         | (pairs[:, 1].astype(np.uint64) << np.uint64(32))).view(np.int64)
+    assert v.min() >= -4 and v.max() <= 11

@@ -187,3 +187,27 @@ def test_xxhash64_int_promotes_to_long(rng):
     combined = got[:, 0] | (got[:, 1] << np.uint64(32))
     exp = np.array([xx64_long(int(v), 42) for v in vals], np.uint64)
     np.testing.assert_array_equal(combined, exp)
+
+
+def test_murmur3_wide_double_normalizes_negzero_and_nan():
+    """Wide-mode (no-x64 pair) doubles must hash identically to the scalar
+    path, including -0.0 -> 0.0 and non-canonical NaN canonicalization."""
+    import jax.numpy as jnp
+    vals = np.array([-0.0, 0.0, np.nan, 1.5, -2.25], np.float64)
+    h_scalar = murmur3_hash([Column(FLOAT64, jnp.asarray(vals))])
+
+    bits = vals.copy().view(np.uint64)
+    bits[2] = np.uint64(0x7FF0000000000001)  # non-canonical (signaling) NaN
+    pairs = np.ascontiguousarray(bits).view(np.uint32).reshape(-1, 2)
+    h_wide = murmur3_hash([Column(FLOAT64, jnp.asarray(pairs))])
+    np.testing.assert_array_equal(np.asarray(h_scalar), np.asarray(h_wide))
+    # and -0.0 hashes like +0.0
+    assert np.asarray(h_scalar)[0] == np.asarray(h_scalar)[1]
+
+
+def test_murmur3_float32_nan_canonicalized():
+    import jax.numpy as jnp
+    raw = np.array([0x7FC00000, 0x7F800001, 0xFFC00000], np.uint32)
+    vals = raw.view(np.float32)
+    h = np.asarray(murmur3_hash([Column(FLOAT32, jnp.asarray(vals))]))
+    assert h[0] == h[1] == h[2]

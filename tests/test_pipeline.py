@@ -86,8 +86,9 @@ def test_distributed_query_step(rng, cpu_devices):
     quantity = rng.integers(1, 5, n).astype(np.int32)
 
     step = distributed_query_step(mesh)
-    gk, sums, have, ng = jax.jit(step)(jnp.asarray(sold_date),
-                                       jnp.asarray(quantity))
+    gk, sums, have, ng, overflow = jax.jit(step)(jnp.asarray(sold_date),
+                                                 jnp.asarray(quantity))
+    assert not np.asarray(overflow).any()
     # after the exchange each distinct date lives on exactly one device
     gk, sums, have = np.asarray(gk), np.asarray(sums), np.asarray(have)
     got = {}
@@ -99,3 +100,31 @@ def test_distributed_query_step(rng, cpu_devices):
     for k, v in zip(sold_date, quantity):
         exp[int(k)] = exp.get(int(k), 0) + int(v)
     assert got == exp
+
+
+def test_hash_aggregate_overflow_detectable_and_uncorrupted(rng):
+    """More distinct keys than capacity: kept groups stay correct and
+    num_groups reports the uncapped distinct count."""
+    keys = np.arange(40, dtype=np.int32)
+    vals = np.ones(40, np.int32) * 3
+    gk, sums, have, ng = hash_aggregate_sum(
+        jnp.asarray(keys), jnp.asarray(vals), jnp.ones(40, bool), 16)
+    assert int(ng) == 40            # overflow visible: ng > max_groups
+    gk, sums, have = np.asarray(gk), np.asarray(sums), np.asarray(have)
+    assert have.all()
+    np.testing.assert_array_equal(gk, np.arange(16))
+    np.testing.assert_array_equal(sums, np.full(16, 3))  # no merged tail
+
+
+def test_hash_aggregate_max_sentinel_key_is_valid(rng):
+    """A valid row whose key equals iinfo.max must still aggregate."""
+    big = np.iinfo(np.int32).max
+    keys = np.array([big, 5, big, 7], np.int32)
+    vals = np.array([10, 1, 20, 2], np.int32)
+    mask = np.array([True, True, False, True])
+    gk, sums, have, ng = hash_aggregate_sum(
+        jnp.asarray(keys), jnp.asarray(vals), jnp.asarray(mask), 8)
+    got = {int(k): int(s) for k, s, h in
+           zip(np.asarray(gk), np.asarray(sums), np.asarray(have)) if h}
+    assert got == {5: 1, 7: 2, big: 10}
+    assert int(ng) == 3
