@@ -1,0 +1,10 @@
+"""Fault injection at the PJRT runtime-API boundary (reference
+``src/main/cpp/faultinj/faultinj.cu`` — see :mod:`.injector`)."""
+
+from spark_rapids_jni_tpu.faultinj.injector import (  # noqa: F401
+    DOMAIN_COMPILE, DOMAIN_EXECUTE, DOMAIN_TRANSFER,
+    FI_ASSERT, FI_RETURN_VALUE, FI_TRAP,
+    DeviceAssertError, FatalDeviceError, FaultInjectionError,
+    FaultRule, InjectedRuntimeError,
+    install, installed, reset_device, state, uninstall,
+)

@@ -1,0 +1,31 @@
+"""Deployment entry: run a Python program under fault injection.
+
+The reference tool is injected into an unmodified process by the CUDA driver
+via ``CUDA_INJECTION64_PATH`` (``faultinj/README.md`` "Deployment"); the
+JAX-process analogue is an interpreter-level wrapper::
+
+    FAULT_INJECTOR_CONFIG_PATH=rules.json \
+        python -m spark_rapids_jni_tpu.faultinj app.py [args...]
+
+which installs the PJRT hooks before handing control to ``app.py``.
+"""
+
+import runpy
+import sys
+
+from spark_rapids_jni_tpu.faultinj import install
+
+
+def main(argv) -> int:
+    if not argv:
+        print("usage: python -m spark_rapids_jni_tpu.faultinj "
+              "<script.py> [args...]", file=sys.stderr)
+        return 2
+    install()  # reads FAULT_INJECTOR_CONFIG_PATH
+    sys.argv = argv[:]
+    runpy.run_path(argv[0], run_name="__main__")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -1,0 +1,365 @@
+"""Fault injection at the PJRT/XLA runtime-API boundary.
+
+TPU-native analogue of the reference's CUPTI fault-injection library
+(``src/main/cpp/faultinj/faultinj.cu``): where the reference subscribes to
+every CUDA Runtime/Driver API exit and fires PTX-trap / device-assert /
+return-code-substitution faults per JSON-configured rules, this module
+interposes the three Python-visible PJRT dispatch domains of a JAX process:
+
+- ``compile``  — ``jax._src.compiler.compile_or_get_cached`` (every XLA
+  compile request),
+- ``execute``  — ``jax._src.interpreters.pxla.ExecuteReplicated.__call__``
+  (every launch of a compiled executable),
+- ``transfer`` — ``jax._src.dispatch._batched_device_put_impl`` (every
+  host->device placement).
+
+Rule semantics mirror the reference (``faultinj.cu:142-152, 269-315``):
+lookup precedence exact-function-name -> ``"*"`` wildcard; a rule fires with
+``percent`` probability while its ``interceptionCount`` budget lasts; each
+fire decrements the budget under a lock (reference ``:308-315``).
+
+Injection types (reference ``FaultInjectionType``, ``faultinj.cu:317-340``):
+
+- 0 ``DEVICE_TRAP``  — the PTX ``trap;`` analogue: raises
+  :class:`FatalDeviceError` and marks the device **unusable**: every later
+  intercepted call in any domain raises too, until :func:`reset_device` —
+  modelling a fatal error that takes the accelerator out of service (the
+  exact scenario the reference tool exists to test, ``faultinj/README.md``).
+- 1 ``DEVICE_ASSERT`` — the device-side ``assert(0)`` analogue: raises
+  :class:`DeviceAssertError` for this call only.
+- 2 ``SUBSTITUTE_RETURN`` — replaces the call's result with an error:
+  raises :class:`InjectedRuntimeError` carrying the configured
+  ``substituteReturnCode`` (reference substitutes a ``CUresult``).
+
+Config JSON (hot-reloadable when ``dynamic`` is true — the reference uses an
+inotify watcher thread ``faultinj.cu:419-470``; here a daemon thread polls
+the file mtime):
+
+```json
+{
+  "logLevel": 2,
+  "dynamic": true,
+  "seed": 42,
+  "pjrtCompileFaults":  {"*": {"percent": 0, "injectionType": 0,
+                               "interceptionCount": 1}},
+  "pjrtExecuteFaults":  {"my_computation": {"percent": 100,
+                               "injectionType": 2,
+                               "substituteReturnCode": 13,
+                               "interceptionCount": 2}},
+  "pjrtTransferFaults": {"*": {"percent": 1, "injectionType": 1,
+                               "interceptionCount": 1000}}
+}
+```
+
+Deployment: ``python -m spark_rapids_jni_tpu.faultinj app.py ...`` with
+``FAULT_INJECTOR_CONFIG_PATH`` set (the same env var the reference reads,
+``faultinj.cu:80``), or programmatic :func:`install` / :func:`uninstall`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import random
+import threading
+import time
+from typing import Dict, Optional
+
+logger = logging.getLogger("spark_rapids_jni_tpu.faultinj")
+
+# spdlog numeric levels (trace..off) -> python logging levels
+# (reference reads "logLevel" as an spdlog level, faultinj.cu:379-386)
+_SPDLOG_TO_PY = {0: logging.DEBUG, 1: logging.DEBUG, 2: logging.INFO,
+                 3: logging.WARNING, 4: logging.ERROR, 5: logging.CRITICAL,
+                 6: logging.CRITICAL + 10}
+
+FI_TRAP = 0
+FI_ASSERT = 1
+FI_RETURN_VALUE = 2
+
+DOMAIN_COMPILE = "pjrtCompileFaults"
+DOMAIN_EXECUTE = "pjrtExecuteFaults"
+DOMAIN_TRANSFER = "pjrtTransferFaults"
+_DOMAINS = (DOMAIN_COMPILE, DOMAIN_EXECUTE, DOMAIN_TRANSFER)
+
+
+class FaultInjectionError(RuntimeError):
+    """Base class for every injected failure."""
+
+
+class FatalDeviceError(FaultInjectionError):
+    """Injected fatal fault: the device is unusable until reset
+    (PTX ``trap;`` analogue, reference ``faultinj.cu:135-137``)."""
+
+
+class DeviceAssertError(FaultInjectionError):
+    """Injected device-side assertion failure
+    (``assertKernel`` analogue, reference ``faultinj.cu:139-140``)."""
+
+
+class InjectedRuntimeError(FaultInjectionError):
+    """Injected API error-code substitution (reference ``faultinj.cu:328-337``).
+
+    ``code`` carries the configured ``substituteReturnCode``."""
+
+    def __init__(self, message: str, code: int):
+        super().__init__(message)
+        self.code = code
+
+
+@dataclasses.dataclass
+class FaultRule:
+    """One fault-injection config entry (reference struct semantics,
+    ``faultinj.cu:54-70`` + README schema table)."""
+
+    injection_type: int = FI_TRAP
+    percent: float = 0.0
+    interception_count: int = 0
+    substitute_return_code: int = 1
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "FaultRule":
+        return cls(
+            injection_type=int(obj.get("injectionType", FI_TRAP)),
+            percent=float(obj.get("percent", 0.0)),
+            interception_count=int(obj.get("interceptionCount", 0)),
+            substitute_return_code=int(obj.get("substituteReturnCode", 1)),
+        )
+
+
+class FaultInjectorState:
+    """Global injector state (reference global control block,
+    ``faultinj.cu:54-101``)."""
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.rules: Dict[str, Dict[str, FaultRule]] = {d: {} for d in _DOMAINS}
+        self.dynamic = False
+        self.config_path: Optional[str] = None
+        self.device_dead = False
+        self.rng = random.Random()
+        self.hits: Dict[str, int] = {}       # fired-fault counters per domain
+        self.calls: Dict[str, int] = {}      # intercepted-call counters
+        self._watcher: Optional[threading.Thread] = None
+        self._watcher_stop = threading.Event()
+        self._mtime = 0.0
+
+    # -- config ------------------------------------------------------------
+    def load_config(self, path: str) -> None:
+        with open(path, "r") as f:
+            cfg = json.load(f)
+        self.apply_config(cfg)
+        self.config_path = path
+        try:
+            self._mtime = os.stat(path).st_mtime
+        except OSError:
+            self._mtime = 0.0
+        if self.dynamic:
+            self._start_watcher()
+
+    def apply_config(self, cfg: dict) -> None:
+        with self.lock:
+            level = _SPDLOG_TO_PY.get(int(cfg.get("logLevel", 2)),
+                                      logging.INFO)
+            logger.setLevel(level)
+            self.dynamic = bool(cfg.get("dynamic", False))
+            if "seed" in cfg:
+                self.rng.seed(int(cfg["seed"]))
+            for domain in _DOMAINS:
+                table = {}
+                for name, obj in cfg.get(domain, {}).items():
+                    table[name] = FaultRule.from_json(obj)
+                self.rules[domain] = table
+            logger.info("faultinj config applied: %s",
+                        {d: list(r) for d, r in self.rules.items()})
+
+    # -- hot reload (inotify-thread analogue, faultinj.cu:419-470) ---------
+    def _start_watcher(self) -> None:
+        if self._watcher is not None and self._watcher.is_alive():
+            return
+        self._watcher_stop.clear()
+
+        def watch():
+            while not self._watcher_stop.wait(0.25):
+                path = self.config_path
+                if not path:
+                    continue
+                try:
+                    mtime = os.stat(path).st_mtime
+                except OSError:
+                    continue
+                if mtime != self._mtime:
+                    self._mtime = mtime
+                    try:
+                        with open(path, "r") as f:
+                            self.apply_config(json.load(f))
+                        logger.info("faultinj config reloaded from %s", path)
+                    except (OSError, ValueError) as e:
+                        logger.warning("faultinj config reload failed: %s", e)
+                if not self.dynamic:
+                    return
+
+        self._watcher = threading.Thread(target=watch, daemon=True,
+                                         name="faultinj-reconfig")
+        self._watcher.start()
+
+    def stop_watcher(self) -> None:
+        self._watcher_stop.set()
+        if self._watcher is not None:
+            self._watcher.join(timeout=2.0)
+            self._watcher = None
+
+    # -- matching (cbid -> name -> "*" precedence, faultinj.cu:142-152) ----
+    def lookup(self, domain: str, name: str) -> Optional[FaultRule]:
+        table = self.rules[domain]
+        rule = table.get(name)
+        if rule is None:
+            rule = table.get("*")
+        return rule
+
+    def maybe_inject(self, domain: str, name: str) -> None:
+        """Called on every intercepted API call; raises to inject."""
+        with self.lock:
+            self.calls[domain] = self.calls.get(domain, 0) + 1
+            if self.device_dead:
+                raise FatalDeviceError(
+                    f"faultinj: device unusable (prior fatal fault); "
+                    f"rejected {domain}:{name}")
+            rule = self.lookup(domain, name)
+            if rule is None or rule.interception_count <= 0:
+                return
+            if rule.percent < 100.0:
+                roll = self.rng.uniform(0.0, 100.0)
+                if roll >= rule.percent:
+                    return
+            rule.interception_count -= 1   # budget, faultinj.cu:308-315
+            self.hits[domain] = self.hits.get(domain, 0) + 1
+            itype = rule.injection_type
+        logger.error("faultinj: injecting type=%d into %s:%s",
+                     itype, domain, name)
+        if itype == FI_TRAP:
+            with self.lock:
+                self.device_dead = True
+            raise FatalDeviceError(
+                f"faultinj: fatal device trap injected at {domain}:{name}")
+        if itype == FI_ASSERT:
+            raise DeviceAssertError(
+                f"faultinj: device assert injected at {domain}:{name}")
+        if itype == FI_RETURN_VALUE:
+            raise InjectedRuntimeError(
+                f"faultinj: injected error return at {domain}:{name}",
+                code=rule.substitute_return_code)
+        logger.warning("faultinj: unknown injectionType %d ignored", itype)
+
+
+_STATE = FaultInjectorState()
+_INSTALLED = False
+_SAVED = {}
+# self-rejection guard: the reference skips its own injected kernel launches
+# (faultinj.cu:159, 182-233); here a reentrancy flag per thread.
+_tls = threading.local()
+
+
+def _guarded(domain: str, name_of, orig):
+    def wrapper(*args, **kwargs):
+        if getattr(_tls, "busy", False):
+            return orig(*args, **kwargs)
+        _tls.busy = True
+        try:
+            try:
+                name = name_of(*args, **kwargs)
+            except Exception:
+                name = "?"
+            _STATE.maybe_inject(domain, name)
+        finally:
+            _tls.busy = False
+        return orig(*args, **kwargs)
+
+    wrapper.__wrapped__ = orig
+    return wrapper
+
+
+def install(config_path: Optional[str] = None,
+            config: Optional[dict] = None) -> FaultInjectorState:
+    """Interpose the PJRT dispatch boundary (the ``InitializeInjection``
+    analogue, reference ``faultinj.cu:477-498``)."""
+    global _INSTALLED
+    if config_path is None and config is None:
+        config_path = os.environ.get("FAULT_INJECTOR_CONFIG_PATH")
+    if config_path:
+        _STATE.load_config(config_path)
+    elif config is not None:
+        _STATE.apply_config(config)
+
+    if _INSTALLED:
+        return _STATE
+
+    import jax._src.compiler as _compiler
+    import jax._src.dispatch as _dispatch
+    import jax._src.interpreters.pxla as _pxla
+
+    # every compile request funnels through compile_or_get_cached
+    # (jax calls it via the module attribute, so rebinding intercepts)
+    _SAVED["compile_or_get_cached"] = _compiler.compile_or_get_cached
+    _compiler.compile_or_get_cached = _guarded(
+        DOMAIN_COMPILE,
+        lambda backend, module, *a, **k: _module_name(module),
+        _SAVED["compile_or_get_cached"])
+
+    _SAVED["execute_call"] = _pxla.ExecuteReplicated.__call__
+    _pxla.ExecuteReplicated.__call__ = _guarded(
+        DOMAIN_EXECUTE,
+        lambda self, *a, **k: getattr(self, "name", "?"),
+        _SAVED["execute_call"])
+
+    _SAVED["device_put"] = _dispatch._batched_device_put_impl
+    _dispatch._batched_device_put_impl = _guarded(
+        DOMAIN_TRANSFER,
+        lambda *a, **k: "device_put",
+        _SAVED["device_put"])
+
+    _INSTALLED = True
+    logger.info("faultinj installed (compile/execute/transfer hooks)")
+    return _STATE
+
+
+def _module_name(module) -> str:
+    try:
+        op = module.operation
+        name = op.attributes["sym_name"]
+        return str(name).strip('"')
+    except Exception:
+        return "?"
+
+
+def uninstall() -> None:
+    """Remove the hooks and stop the reload watcher (the ``atexit`` teardown
+    analogue, reference ``faultinj.cu:109-119``)."""
+    global _INSTALLED
+    if not _INSTALLED:
+        return
+    import jax._src.compiler as _compiler
+    import jax._src.dispatch as _dispatch
+    import jax._src.interpreters.pxla as _pxla
+    _compiler.compile_or_get_cached = _SAVED.pop("compile_or_get_cached")
+    _pxla.ExecuteReplicated.__call__ = _SAVED.pop("execute_call")
+    _dispatch._batched_device_put_impl = _SAVED.pop("device_put")
+    _STATE.stop_watcher()
+    _INSTALLED = False
+    logger.info("faultinj uninstalled")
+
+
+def state() -> FaultInjectorState:
+    return _STATE
+
+
+def reset_device() -> None:
+    """Clear the sticky fatal-fault flag (process-restart analogue)."""
+    with _STATE.lock:
+        _STATE.device_dead = False
+
+
+def installed() -> bool:
+    return _INSTALLED

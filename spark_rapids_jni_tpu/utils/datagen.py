@@ -109,13 +109,14 @@ def _gen_fixed(key, dt: DType, n: int, profile: DataProfile) -> jnp.ndarray:
             if np_dt.kind == "u":
                 hi_w = jnp.zeros_like(hi_w)
             return jnp.stack([lo_w, hi_w], axis=1)
-        if not jax.config.jax_enable_x64 and np_dt.itemsize >= 4:
-            # randint computes in int32 without x64: clamp defaulted sides
-            # so a one-sided bound doesn't overflow maxval
-            if not lo_set:
-                lo = max(lo, i32_lo)
-            if not hi_set:
-                hi = min(hi, i32_hi)
+        # randint computes in int64 (x64 on) or int32 (off); clamp both
+        # sides — defaulted OR explicit — so maxval=hi+1 fits that dtype
+        # (the extreme value of the full range is unreachable when bounded;
+        # the unbounded raw-bits path below covers the full range)
+        rinfo = jnp.iinfo(jnp.int64 if jax.config.jax_enable_x64
+                          else jnp.int32)
+        lo = max(lo, int(rinfo.min))
+        hi = min(hi, int(rinfo.max) - 1)
         return jax.random.randint(key, (n,), lo, hi + 1).astype(np_dt)
     if np_dt.itemsize == 8 and wide:
         return jax.random.bits(key, (n, 2), dtype=jnp.uint32)

@@ -115,3 +115,29 @@ def test_int64_bounds_wide_path():
     v = (pairs[:, 0].astype(np.uint64)
          | (pairs[:, 1].astype(np.uint64) << np.uint64(32))).view(np.int64)
     assert v.min() >= -4 and v.max() <= 11
+
+
+def test_one_sided_bounds_extreme_dtypes():
+    """One-sided bounds on 64-bit dtypes (x64 on) and explicit bounds at the
+    int32 max (x64 off) must not overflow randint's compute dtype."""
+    import jax
+    from spark_rapids_jni_tpu.table import UINT64
+    # x64 on (conftest default): defaulted upper side becomes iinfo.max
+    t = create_random_table([INT64], 200, DataProfile(int_lower=0), seed=5)
+    v = np.asarray(t.columns[0].data)
+    if v.ndim == 2:
+        v = (v[:, 0].astype(np.uint64)
+             | (v[:, 1].astype(np.uint64) << np.uint64(32))).view(np.int64)
+    assert v.min() >= 0
+    t = create_random_table([UINT64], 200, DataProfile(int_lower=1), seed=6)
+    # explicit INT32 bound at the dtype max, x64 off (int32 compute)
+    from spark_rapids_jni_tpu.utils.datagen import _gen_fixed
+    prev = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", False)
+    try:
+        v = np.asarray(_gen_fixed(
+            jax.random.PRNGKey(3), INT32, 100,
+            DataProfile(int_lower=2**31 - 16, int_upper=2**31 - 1)))
+        assert v.min() >= 2**31 - 16
+    finally:
+        jax.config.update("jax_enable_x64", prev)

@@ -1,0 +1,204 @@
+"""Fault-injection tests (reference tool behavior: ``faultinj.cu`` rule
+matching/gating; fatal-test isolation: the reference re-runs its
+deliberately-fatal test in a fresh fork, ``pom.xml:517-532`` — here the
+fatal scenario runs in a subprocess)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from spark_rapids_jni_tpu import faultinj
+from spark_rapids_jni_tpu.faultinj.injector import (
+    FaultInjectorState, FaultRule,
+)
+
+
+# ---------------------------------------------------------------------------
+# Pure state-machine tests (no hooks installed)
+# ---------------------------------------------------------------------------
+
+def make_state(cfg):
+    st = FaultInjectorState()
+    st.apply_config(cfg)
+    return st
+
+
+def test_lookup_precedence_exact_over_wildcard():
+    st = make_state({"pjrtExecuteFaults": {
+        "*": {"percent": 100, "injectionType": 1, "interceptionCount": 10},
+        "myfn": {"percent": 0, "injectionType": 1, "interceptionCount": 10},
+    }})
+    # exact rule (percent 0) wins for myfn -> no injection
+    st.maybe_inject("pjrtExecuteFaults", "myfn")
+    # wildcard fires for anything else
+    with pytest.raises(faultinj.DeviceAssertError):
+        st.maybe_inject("pjrtExecuteFaults", "other")
+
+
+def test_percent_zero_never_fires():
+    st = make_state({"pjrtCompileFaults": {
+        "*": {"percent": 0, "injectionType": 0, "interceptionCount": 1000}}})
+    for _ in range(100):
+        st.maybe_inject("pjrtCompileFaults", "f")
+
+
+def test_interception_count_budget():
+    st = make_state({"pjrtExecuteFaults": {
+        "*": {"percent": 100, "injectionType": 1, "interceptionCount": 3}}})
+    fired = 0
+    for _ in range(10):
+        try:
+            st.maybe_inject("pjrtExecuteFaults", "f")
+        except faultinj.DeviceAssertError:
+            fired += 1
+    assert fired == 3  # budget decrement, faultinj.cu:308-315
+
+
+def test_trap_is_sticky_until_reset():
+    st = make_state({"pjrtExecuteFaults": {
+        "f": {"percent": 100, "injectionType": 0, "interceptionCount": 1}}})
+    with pytest.raises(faultinj.FatalDeviceError):
+        st.maybe_inject("pjrtExecuteFaults", "f")
+    # all later calls on any domain rejected: device is out of service
+    with pytest.raises(faultinj.FatalDeviceError):
+        st.maybe_inject("pjrtTransferFaults", "device_put")
+    st.device_dead = False
+    st.maybe_inject("pjrtTransferFaults", "device_put")  # usable again
+
+
+def test_substitute_return_code():
+    st = make_state({"pjrtTransferFaults": {
+        "device_put": {"percent": 100, "injectionType": 2,
+                       "substituteReturnCode": 999,
+                       "interceptionCount": 1}}})
+    with pytest.raises(faultinj.InjectedRuntimeError) as ei:
+        st.maybe_inject("pjrtTransferFaults", "device_put")
+    assert ei.value.code == 999
+
+
+def test_percent_probability_seeded():
+    st = make_state({"seed": 7, "pjrtExecuteFaults": {
+        "*": {"percent": 50, "injectionType": 1,
+              "interceptionCount": 10_000}}})
+    fired = 0
+    for _ in range(1000):
+        try:
+            st.maybe_inject("pjrtExecuteFaults", "f")
+        except faultinj.DeviceAssertError:
+            fired += 1
+    assert 400 < fired < 600  # ~50%
+
+
+# ---------------------------------------------------------------------------
+# Hook integration: real jax compile/execute/transfer interception
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def hooks():
+    faultinj.install(config={})
+    yield faultinj.state()
+    faultinj.reset_device()
+    faultinj.uninstall()
+
+
+def test_execute_interception(hooks):
+    @jax.jit
+    def f(x):
+        return x * 2
+
+    x = jax.block_until_ready(jnp.arange(8))
+    hooks.apply_config({"pjrtExecuteFaults": {
+        "*": {"percent": 100, "injectionType": 1, "interceptionCount": 1}}})
+    with pytest.raises(faultinj.DeviceAssertError):
+        jax.block_until_ready(f(x))
+    # budget exhausted -> next run succeeds
+    assert jax.block_until_ready(f(x))[3] == 6
+
+
+def test_compile_interception_by_name(hooks):
+    hooks.apply_config({"pjrtCompileFaults": {
+        "jit_g_faultinj_test": {"percent": 100, "injectionType": 2,
+                                "substituteReturnCode": 5,
+                                "interceptionCount": 1}}})
+
+    def g_faultinj_test(x):
+        return x + 1
+
+    with pytest.raises(faultinj.InjectedRuntimeError):
+        jax.jit(g_faultinj_test)(jnp.float32(1.0))
+    # other computations compile fine (exact-name rule only)
+    assert int(jax.jit(lambda x: x - 1)(jnp.int32(3))) == 2
+
+
+def test_transfer_interception(hooks):
+    hooks.apply_config({"pjrtTransferFaults": {
+        "device_put": {"percent": 100, "injectionType": 1,
+                       "interceptionCount": 1}}})
+    with pytest.raises(faultinj.DeviceAssertError):
+        jax.device_put(jnp.zeros(4), jax.devices("cpu")[0])
+    jax.device_put(jnp.zeros(4), jax.devices("cpu")[0])  # budget spent
+
+
+def test_hot_reload(tmp_path, hooks):
+    cfg = tmp_path / "fi.json"
+    cfg.write_text(json.dumps({"dynamic": True, "pjrtExecuteFaults": {}}))
+    hooks.load_config(str(cfg))
+    assert hooks.dynamic
+    # rewrite the file with a live rule; watcher polls at 0.25s
+    time.sleep(0.05)
+    cfg.write_text(json.dumps({"dynamic": True, "pjrtExecuteFaults": {
+        "*": {"percent": 100, "injectionType": 1,
+              "interceptionCount": 1}}}))
+    os.utime(cfg)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if hooks.rules["pjrtExecuteFaults"]:
+            break
+        time.sleep(0.05)
+    assert hooks.rules["pjrtExecuteFaults"], "watcher did not reload config"
+    hooks.stop_watcher()
+
+
+# ---------------------------------------------------------------------------
+# Fatal scenario in a fresh process (CudaFatalTest-isolation analogue)
+# ---------------------------------------------------------------------------
+
+def test_fatal_scenario_subprocess(tmp_path):
+    cfg = tmp_path / "fatal.json"
+    cfg.write_text(json.dumps({"pjrtExecuteFaults": {
+        "*": {"percent": 100, "injectionType": 0,
+              "interceptionCount": 1}}}))
+    app = tmp_path / "app.py"
+    app.write_text(textwrap.dedent("""
+        import jax, jax.numpy as jnp
+        from spark_rapids_jni_tpu import faultinj
+        cpu = jax.devices("cpu")[0]
+        jax.config.update("jax_default_device", cpu)
+        f = jax.jit(lambda x: x + 1)
+        try:
+            jax.block_until_ready(f(jnp.arange(4)))
+            raise SystemExit("expected FatalDeviceError")
+        except faultinj.FatalDeviceError:
+            pass
+        # device now out of service: retry must be rejected too
+        try:
+            jax.block_until_ready(f(jnp.arange(4)))
+            raise SystemExit("expected device to stay dead")
+        except faultinj.FatalDeviceError:
+            print("DEVICE-OUT-OF-SERVICE-OK")
+    """))
+    env = dict(os.environ, FAULT_INJECTOR_CONFIG_PATH=str(cfg),
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "spark_rapids_jni_tpu.faultinj", str(app)],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode == 0, proc.stderr
+    assert "DEVICE-OUT-OF-SERVICE-OK" in proc.stdout, proc.stdout
