@@ -1,0 +1,32 @@
+# Top-level build orchestration (the reference's Maven validate-phase role,
+# pom.xml:273-386: native build -> resources -> tests -> package).
+
+PYTHON ?= python
+
+.PHONY: all native native-test test bench package build-info clean
+
+all: native build-info test
+
+native:
+	$(MAKE) -C native
+
+native-test: native
+	$(MAKE) -C native test
+
+# build provenance recorded into the artifact (reference build/build-info
+# writes version/user/revision/branch/date into the jar manifest properties)
+build-info:
+	ci/build-info > spark_rapids_jni_tpu/build_info.properties
+
+test: native
+	$(PYTHON) -m pytest tests/ -q
+
+bench:
+	$(PYTHON) bench.py
+
+package: native build-info
+	$(PYTHON) -m pip wheel --no-deps --no-build-isolation -w dist .
+
+clean:
+	$(MAKE) -C native clean
+	rm -rf dist build *.egg-info

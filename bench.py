@@ -39,15 +39,26 @@ from spark_rapids_jni_tpu.utils import (
 FIXED_DTYPES = [INT64, FLOAT64, INT32, FLOAT32, INT16, INT8, BOOL8]
 
 
-def _time(fn, *, warmup=1, iters=5):
+def _log(msg):
+    print(f"[bench +{time.perf_counter() - _T0:8.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+_T0 = time.perf_counter()
+
+
+def _time(fn, *, warmup=1, iters=5, label=""):
     for _ in range(warmup):
         jax.block_until_ready(fn())
+    _log(f"{label}: warmup (compile) done")
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
         jax.block_until_ready(fn())
         times.append(time.perf_counter() - t0)
-    return float(np.median(times))
+    med = float(np.median(times))
+    _log(f"{label}: median {med * 1e3:.2f} ms over {iters} iters")
+    return med
 
 
 def _table_bytes(table):
@@ -65,16 +76,21 @@ def _table_bytes(table):
 def bench_fixed(num_rows, num_cols=212, use_pallas=None):
     dtypes = cycle_dtypes(FIXED_DTYPES, num_cols)
     layout = compute_row_layout(dtypes)
+    _log(f"fixed {num_rows} rows: generating table")
     table = create_random_table(dtypes, num_rows, seed=42)
     jax.block_until_ready(table)
+    _log(f"fixed {num_rows} rows: table ready")
     out_bytes = num_rows * layout.fixed_row_size
 
-    t_to = _time(lambda: convert_to_rows(table, use_pallas=use_pallas))
-    t_oracle = _time(lambda: convert_to_rows_fixed_width_optimized(table))
+    t_to = _time(lambda: convert_to_rows(table, use_pallas=use_pallas),
+                 label=f"to_rows[{num_rows}]")
+    t_oracle = _time(lambda: convert_to_rows_fixed_width_optimized(table),
+                     label=f"oracle_to_rows[{num_rows}]")
     batches = convert_to_rows(table, use_pallas=use_pallas)
     t_from = _time(lambda: [convert_from_rows(b, dtypes,
                                               use_pallas=use_pallas)
-                            for b in batches])
+                            for b in batches],
+                   label=f"from_rows[{num_rows}]")
     moved = _table_bytes(table) + out_bytes  # read + write per direction
     return {
         "num_rows": num_rows,
@@ -93,13 +109,16 @@ def bench_variable(num_rows, num_cols=155, with_strings=True):
     base = cycle_dtypes(FIXED_DTYPES, num_cols - (25 if with_strings else 0))
     dtypes = base + ([STRING] * 25 if with_strings else [])
     profile = DataProfile(string_len_min=0, string_len_max=32)
+    _log(f"variable {num_rows} rows: generating table")
     table = create_random_table(dtypes, num_rows, profile, seed=42)
     jax.block_until_ready(table)
-    t_to = _time(lambda: convert_to_rows(table), iters=3)
+    _log(f"variable {num_rows} rows: table ready")
+    t_to = _time(lambda: convert_to_rows(table), iters=3,
+                 label=f"var_to_rows[{num_rows}]")
     batches = convert_to_rows(table)
     out_bytes = sum(int(np.asarray(b.offsets)[-1]) for b in batches)
     t_from = _time(lambda: [convert_from_rows(b, dtypes) for b in batches],
-                   iters=3)
+                   iters=3, label=f"var_from_rows[{num_rows}]")
     moved = _table_bytes(table) + out_bytes
     return {
         "num_rows": num_rows,
@@ -124,13 +143,18 @@ def main():
 
     row_axes = [args.rows] if args.rows else ([1_000_000] if args.quick
                                               else [1_000_000, 4_000_000])
+    def _flush():
+        with open("BENCH_DETAILS.json", "w") as f:
+            json.dump(results, f, indent=2)
+
     fixed = []
+    results["fixed_width"] = fixed
     for n in row_axes:
         try:
             fixed.append(bench_fixed(n))
         except Exception as e:  # OOM on big axes shouldn't kill the run
             fixed.append({"num_rows": n, "error": f"{type(e).__name__}: {e}"})
-    results["fixed_width"] = fixed
+        _flush()  # partial results survive a driver timeout
 
     if not args.quick:
         try:
@@ -138,9 +162,7 @@ def main():
         except Exception as e:
             results["variable_width"] = [
                 {"error": f"{type(e).__name__}: {e}"}]
-
-    with open("BENCH_DETAILS.json", "w") as f:
-        json.dump(results, f, indent=2)
+        _flush()
 
     head = next((r for r in fixed if "error" not in r), None)
     if head is None:

@@ -1,0 +1,10 @@
+#!/bin/bash
+# Nightly: premerge + package + benchmark record
+# (reference ci/nightly-build.sh:24-32 = package + deploy).
+set -euxo pipefail
+cd "$(dirname "$0")/.."
+
+ci/premerge.sh
+make build-info
+make package
+python bench.py --quick

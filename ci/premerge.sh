@@ -1,0 +1,11 @@
+#!/bin/bash
+# Premerge gate: build everything and run the full test suite
+# (reference ci/premerge-build.sh:24-30 = mvn verify with tests on).
+set -euxo pipefail
+cd "$(dirname "$0")/.."
+
+make native
+make native-test
+# full python suite on the 8-device virtual CPU mesh (conftest sets it up);
+# bypass the axon TPU relay so CI is hermetic
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q

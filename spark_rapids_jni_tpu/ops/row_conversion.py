@@ -44,6 +44,7 @@ from spark_rapids_jni_tpu.table import (
 from spark_rapids_jni_tpu.ops.row_layout import (
     JCUDF_ROW_ALIGNMENT, MAX_BATCH_BYTES, RowLayout, compute_row_layout,
 )
+from spark_rapids_jni_tpu.utils.tracing import func_range
 
 
 # ---------------------------------------------------------------------------
@@ -305,6 +306,7 @@ def _batch_rows2d(rows2d: jnp.ndarray, layout: RowLayout,
     return out
 
 
+@func_range()
 def convert_to_rows_fixed_width_optimized(
         table: Table, *, size_limit: int = MAX_BATCH_BYTES) -> List[RowsColumn]:
     """Oracle path: fixed-width tables only (parity with the reference legacy
@@ -316,6 +318,7 @@ def convert_to_rows_fixed_width_optimized(
     return _batch_rows2d(rows2d, layout, size_limit)
 
 
+@func_range()
 def convert_from_rows_fixed_width_optimized(
         rows: RowsColumn, dtypes: Sequence[DType]) -> Table:
     layout = compute_row_layout(dtypes)
@@ -330,6 +333,7 @@ def convert_from_rows_fixed_width_optimized(
 # Public API — optimized path (XLA / Pallas)
 # ---------------------------------------------------------------------------
 
+@func_range()
 def convert_to_rows(table: Table, *, size_limit: int = MAX_BATCH_BYTES,
                     use_pallas: Optional[bool] = None) -> List[RowsColumn]:
     """Convert a table to JCUDF row batches (reference ``convert_to_rows``,
@@ -349,6 +353,7 @@ def convert_to_rows(table: Table, *, size_limit: int = MAX_BATCH_BYTES,
     return _batch_rows2d(rows2d, layout, size_limit)
 
 
+@func_range()
 def convert_from_rows(rows: RowsColumn, dtypes: Sequence[DType],
                       *, use_pallas: Optional[bool] = None) -> Table:
     """Convert one batch of JCUDF rows back to a table (reference

@@ -1,3 +1,7 @@
 from spark_rapids_jni_tpu.utils.datagen import (  # noqa: F401
     DataProfile, create_random_table, cycle_dtypes,
 )
+from spark_rapids_jni_tpu.utils.build_info import build_info  # noqa: F401
+from spark_rapids_jni_tpu.utils.tracing import (  # noqa: F401
+    annotate, func_range, trace,
+)

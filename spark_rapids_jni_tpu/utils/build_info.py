@@ -1,0 +1,41 @@
+"""Build provenance (reference ``build/build-info:25-37`` records
+version/user/revision/branch/date/url properties into the jar; here
+``ci/build-info`` writes ``build_info.properties`` into the package and this
+module exposes it, falling back to live git metadata in a source checkout)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from functools import lru_cache
+from typing import Dict
+
+_PROPS = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                      "build_info.properties")
+
+
+def _git(*args: str) -> str:
+    try:
+        out = subprocess.run(
+            ["git", *args], capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(_PROPS))
+        return out.stdout.strip() if out.returncode == 0 else "unknown"
+    except OSError:
+        return "unknown"
+
+
+@lru_cache(maxsize=1)
+def build_info() -> Dict[str, str]:
+    info: Dict[str, str] = {}
+    if os.path.exists(_PROPS):
+        with open(_PROPS) as f:
+            for line in f:
+                line = line.strip()
+                if "=" in line and not line.startswith("#"):
+                    k, _, v = line.partition("=")
+                    info[k] = v
+    info.setdefault("revision", _git("rev-parse", "HEAD"))
+    info.setdefault("branch", _git("rev-parse", "--abbrev-ref", "HEAD"))
+    from spark_rapids_jni_tpu import __version__
+    info.setdefault("version", __version__)
+    return info

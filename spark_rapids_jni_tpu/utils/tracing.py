@@ -1,0 +1,61 @@
+"""Tracing/profiling utilities — the NVTX-range analogue.
+
+The reference annotates every footer API and kernel hot spot with NVTX
+ranges (``CUDF_FUNC_RANGE()``, ``NativeParquetJni.cpp:136,392,...``) and
+exposes a Java-side toggle (``pom.xml:86,488-491``).  The TPU equivalents
+(SURVEY.md §5): ``jax.named_scope`` annotations that show up in XLA/HLO and
+in ``jax.profiler`` traces, plus a trace context manager writing a
+TensorBoard-loadable profile.
+
+Toggle: set ``SRJ_TPU_TRACE=0`` to make :func:`func_range` a no-op (the
+``ai.rapids.cudf.nvtx.enabled`` analogue).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+
+import jax
+
+_ENABLED = os.environ.get("SRJ_TPU_TRACE", "1") != "0"
+
+
+def func_range(name: str | None = None):
+    """Decorator: wrap a function body in a named scope (the
+    ``CUDF_FUNC_RANGE`` analogue).  Scope names appear in HLO op metadata
+    and profiler timelines."""
+
+    def deco(fn):
+        if not _ENABLED:
+            return fn
+        scope = name or f"srj::{fn.__name__}"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with jax.named_scope(scope):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+@contextlib.contextmanager
+def trace(log_dir: str = "/tmp/srj_tpu_trace"):
+    """Capture a ``jax.profiler`` trace around a block (TensorBoard/XProf
+    loadable — the nsight-capture analogue used to tune the reference's
+    kernel constants, ``row_conversion.cu:66-70``)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Host-side trace annotation (``nvtxRangePush``/``Pop`` analogue)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
