@@ -1,0 +1,63 @@
+// Host-native JCUDF row engine: layout calculation, batch planning, and
+// fixed-width encode/decode on host buffers.
+//
+// This is the C++ half the reference keeps in its L3 host-orchestration
+// layer (/root/reference/src/main/cpp/src/row_conversion.cu:1331-1370
+// compute_column_information, :1460-1539 build_batches) plus a CPU
+// encode/decode used for host-staged data and as an independent oracle for
+// the device (XLA/Pallas) paths.  Same contract as the Python calculator
+// (spark_rapids_jni_tpu/ops/row_layout.py): C-struct alignment, validity
+// tail (bit c%8 of byte c/8, 1 = valid), 8-byte row rounding, 1KB fixed-row
+// limit, <=2GB 32-row-aligned batches.
+
+#pragma once
+
+#include <cstdint>
+#include <stdexcept>
+#include <vector>
+
+namespace srj {
+namespace rows {
+
+constexpr int32_t kRowAlignment = 8;
+constexpr int32_t kMaxRowSize = 1024;
+constexpr int64_t kMaxBatchBytes = (1LL << 31) - 1;
+
+struct Layout {
+  std::vector<int32_t> col_starts;
+  std::vector<int32_t> col_sizes;
+  std::vector<uint8_t> is_string;
+  int32_t validity_offset = 0;
+  int32_t validity_bytes = 0;
+  int32_t fixed_row_size = 0;
+
+  int32_t num_columns() const {
+    return static_cast<int32_t>(col_starts.size());
+  }
+};
+
+// itemsizes[i] is the column's fixed byte width; string columns (marked in
+// is_string) take a uint32 (offset, length) pair: 8 bytes, 4-byte aligned.
+Layout compute_layout(const int32_t* itemsizes, const uint8_t* is_string,
+                      int32_t ncols);
+
+// Split [0, nrows) into <=size_limit-byte batches with 32-row-aligned
+// splits; returns batch start offsets plus the end (nrows).
+std::vector<int64_t> plan_fixed_batches(int64_t nrows, int32_t row_size,
+                                        int64_t size_limit = kMaxBatchBytes);
+
+// Encode fixed-width columns into JCUDF rows.  cols[i] points at nrows
+// contiguous little-endian values of itemsize col_sizes[i]; validity[i] is
+// an LSB-first packed bitmask (1 = valid) or nullptr for all-valid.  Writes
+// nrows * fixed_row_size bytes to out.
+void encode_fixed(const Layout& layout, int64_t nrows,
+                  const uint8_t* const* cols,
+                  const uint8_t* const* validity, uint8_t* out);
+
+// Inverse: scatter rows back into column buffers + packed validity masks
+// (each validity_out[i] must hold (nrows+7)/8 bytes; pad bits are zero).
+void decode_fixed(const Layout& layout, int64_t nrows, const uint8_t* rows,
+                  uint8_t* const* cols_out, uint8_t* const* validity_out);
+
+}  // namespace rows
+}  // namespace srj

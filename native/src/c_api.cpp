@@ -11,6 +11,7 @@
 #include <vector>
 
 #include "srj/parquet_footer.hpp"
+#include "srj/row_engine.hpp"
 
 namespace {
 
@@ -95,6 +96,80 @@ int64_t srj_footer_serialize(const srj_footer* f, uint8_t* out,
   } catch (const std::exception& e) {
     set_error(e);
     return -1;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Row engine (layout / batch planning / fixed-width encode+decode)
+// ---------------------------------------------------------------------------
+
+// Compute the JCUDF row layout.  out_starts/out_sizes hold ncols entries;
+// out_meta holds {validity_offset, validity_bytes, fixed_row_size}.
+int srj_row_layout(int32_t ncols, const int32_t* itemsizes,
+                   const uint8_t* is_string, int32_t* out_starts,
+                   int32_t* out_sizes, int32_t* out_meta) {
+  try {
+    srj::rows::Layout l =
+        srj::rows::compute_layout(itemsizes, is_string, ncols);
+    std::memcpy(out_starts, l.col_starts.data(), ncols * sizeof(int32_t));
+    std::memcpy(out_sizes, l.col_sizes.data(), ncols * sizeof(int32_t));
+    out_meta[0] = l.validity_offset;
+    out_meta[1] = l.validity_bytes;
+    out_meta[2] = l.fixed_row_size;
+    return 0;
+  } catch (const std::exception& e) {
+    return set_error(e);
+  }
+}
+
+// Batch plan: writes up to capacity boundary values (starts + final end)
+// into out_bounds; returns the boundary count, or -1 (error / too small).
+int64_t srj_plan_fixed_batches(int64_t nrows, int32_t row_size,
+                               int64_t size_limit, int64_t* out_bounds,
+                               int64_t capacity) {
+  try {
+    std::vector<int64_t> b =
+        srj::rows::plan_fixed_batches(nrows, row_size, size_limit);
+    if (static_cast<int64_t>(b.size()) > capacity) {
+      g_last_error = "bounds buffer too small";
+      return -1;
+    }
+    std::memcpy(out_bounds, b.data(), b.size() * sizeof(int64_t));
+    return static_cast<int64_t>(b.size());
+  } catch (const std::exception& e) {
+    set_error(e);
+    return -1;
+  }
+}
+
+// Fixed-width encode: cols[i] -> nrows little-endian values; validity[i] is
+// an LSB-first packed bitmask or null (all valid); out holds
+// nrows * fixed_row_size bytes.
+int srj_rows_encode_fixed(int32_t ncols, int64_t nrows,
+                          const int32_t* itemsizes, const uint8_t* is_string,
+                          const uint8_t* const* cols,
+                          const uint8_t* const* validity, uint8_t* out) {
+  try {
+    srj::rows::Layout l =
+        srj::rows::compute_layout(itemsizes, is_string, ncols);
+    srj::rows::encode_fixed(l, nrows, cols, validity, out);
+    return 0;
+  } catch (const std::exception& e) {
+    return set_error(e);
+  }
+}
+
+int srj_rows_decode_fixed(int32_t ncols, int64_t nrows,
+                          const int32_t* itemsizes, const uint8_t* is_string,
+                          const uint8_t* rows, uint8_t* const* cols_out,
+                          uint8_t* const* validity_out) {
+  try {
+    srj::rows::Layout l =
+        srj::rows::compute_layout(itemsizes, is_string, ncols);
+    srj::rows::decode_fixed(l, nrows, rows, cols_out, validity_out);
+    return 0;
+  } catch (const std::exception& e) {
+    return set_error(e);
   }
 }
 

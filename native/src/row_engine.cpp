@@ -1,0 +1,136 @@
+#include "srj/row_engine.hpp"
+
+#include <algorithm>
+#include <cstring>
+#include <string>
+
+namespace srj {
+namespace rows {
+
+namespace {
+int64_t round_up(int64_t x, int64_t align) {
+  return (x + align - 1) / align * align;
+}
+}  // namespace
+
+Layout compute_layout(const int32_t* itemsizes, const uint8_t* is_string,
+                      int32_t ncols) {
+  Layout l;
+  l.col_starts.reserve(ncols);
+  l.col_sizes.reserve(ncols);
+  l.is_string.assign(is_string, is_string + ncols);
+  int64_t pos = 0;
+  for (int32_t i = 0; i < ncols; ++i) {
+    int32_t size, align;
+    if (is_string[i]) {
+      size = 8;   // uint32 (offset, length) pair
+      align = 4;
+    } else {
+      size = itemsizes[i];
+      if (size != 1 && size != 2 && size != 4 && size != 8) {
+        throw std::invalid_argument("unsupported column itemsize " +
+                                    std::to_string(size));
+      }
+      align = size;
+    }
+    pos = round_up(pos, align);
+    l.col_starts.push_back(static_cast<int32_t>(pos));
+    l.col_sizes.push_back(size);
+    pos += size;
+  }
+  l.validity_offset = static_cast<int32_t>(pos);
+  l.validity_bytes = (ncols + 7) / 8;
+  l.fixed_row_size = static_cast<int32_t>(
+      round_up(l.validity_offset + l.validity_bytes, kRowAlignment));
+  if (l.fixed_row_size > kMaxRowSize) {
+    throw std::invalid_argument(
+        "row size " + std::to_string(l.fixed_row_size) +
+        " exceeds JCUDF maximum " + std::to_string(kMaxRowSize));
+  }
+  return l;
+}
+
+std::vector<int64_t> plan_fixed_batches(int64_t nrows, int32_t row_size,
+                                        int64_t size_limit) {
+  std::vector<int64_t> bounds{0};
+  if (nrows == 0) {
+    bounds.push_back(0);
+    return bounds;
+  }
+  int64_t max_rows = (size_limit / row_size) / 32 * 32;
+  if (max_rows == 0) {
+    if (nrows <= 32 && nrows * row_size <= size_limit) {
+      max_rows = nrows;
+    } else {
+      throw std::invalid_argument(
+          "size_limit cannot hold a 32-row-aligned batch");
+    }
+  }
+  for (int64_t start = 0; start < nrows;) {
+    int64_t end = std::min(nrows, start + max_rows);
+    bounds.push_back(end);
+    start = end;
+  }
+  return bounds;
+}
+
+void encode_fixed(const Layout& layout, int64_t nrows,
+                  const uint8_t* const* cols,
+                  const uint8_t* const* validity, uint8_t* out) {
+  const int32_t rs = layout.fixed_row_size;
+  const int32_t ncols = layout.num_columns();
+  std::memset(out, 0, static_cast<size_t>(nrows) * rs);
+  for (int32_t c = 0; c < ncols; ++c) {
+    const int32_t start = layout.col_starts[c];
+    const int32_t size = layout.col_sizes[c];
+    const uint8_t* src = cols[c];
+    uint8_t* dst = out + start;
+    for (int64_t r = 0; r < nrows; ++r) {
+      std::memcpy(dst + r * rs, src + r * size, size);
+    }
+  }
+  // validity tail: bit c%8 of byte c/8, 1 = valid
+  for (int64_t r = 0; r < nrows; ++r) {
+    uint8_t* vrow = out + r * rs + layout.validity_offset;
+    for (int32_t c = 0; c < ncols; ++c) {
+      uint8_t valid = 1;
+      if (validity != nullptr && validity[c] != nullptr) {
+        valid = (validity[c][r >> 3] >> (r & 7)) & 1;
+      }
+      vrow[c >> 3] |= static_cast<uint8_t>(valid << (c & 7));
+    }
+  }
+}
+
+void decode_fixed(const Layout& layout, int64_t nrows, const uint8_t* rows,
+                  uint8_t* const* cols_out, uint8_t* const* validity_out) {
+  const int32_t rs = layout.fixed_row_size;
+  const int32_t ncols = layout.num_columns();
+  for (int32_t c = 0; c < ncols; ++c) {
+    const int32_t start = layout.col_starts[c];
+    const int32_t size = layout.col_sizes[c];
+    uint8_t* dst = cols_out[c];
+    for (int64_t r = 0; r < nrows; ++r) {
+      std::memcpy(dst + r * size, rows + r * rs + start, size);
+    }
+  }
+  if (validity_out != nullptr) {
+    const int64_t vbytes = (nrows + 7) / 8;
+    for (int32_t c = 0; c < ncols; ++c) {
+      if (validity_out[c] != nullptr) {
+        std::memset(validity_out[c], 0, vbytes);
+      }
+    }
+    for (int64_t r = 0; r < nrows; ++r) {
+      const uint8_t* vrow = rows + r * rs + layout.validity_offset;
+      for (int32_t c = 0; c < ncols; ++c) {
+        if (validity_out[c] == nullptr) continue;
+        uint8_t valid = (vrow[c >> 3] >> (c & 7)) & 1;
+        validity_out[c][r >> 3] |= static_cast<uint8_t>(valid << (r & 7));
+      }
+    }
+  }
+}
+
+}  // namespace rows
+}  // namespace srj

@@ -1,0 +1,112 @@
+// Self-tests for the host row engine (gtest-free micro-harness matching
+// tests/test_footer.cpp style).
+
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+#include "srj/row_engine.hpp"
+
+using srj::rows::Layout;
+
+static int g_failures = 0;
+
+#define CHECK(cond)                                                 \
+  do {                                                              \
+    if (!(cond)) {                                                  \
+      std::printf("FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);   \
+      ++g_failures;                                                 \
+    }                                                               \
+  } while (0)
+
+static void test_layout_alignment() {
+  // int8, int64, int16 -> starts 0, 8, 16; validity at 18; row 24
+  int32_t sizes[] = {1, 8, 2};
+  uint8_t isstr[] = {0, 0, 0};
+  Layout l = srj::rows::compute_layout(sizes, isstr, 3);
+  CHECK(l.col_starts[0] == 0);
+  CHECK(l.col_starts[1] == 8);
+  CHECK(l.col_starts[2] == 16);
+  CHECK(l.validity_offset == 18);
+  CHECK(l.validity_bytes == 1);
+  CHECK(l.fixed_row_size == 24);
+}
+
+static void test_layout_string_slot() {
+  // int8 then string: pair is 4-byte aligned -> starts 0, 4; validity 12
+  int32_t sizes[] = {1, 8};
+  uint8_t isstr[] = {0, 1};
+  Layout l = srj::rows::compute_layout(sizes, isstr, 2);
+  CHECK(l.col_starts[1] == 4);
+  CHECK(l.col_sizes[1] == 8);
+  CHECK(l.validity_offset == 12);
+  CHECK(l.fixed_row_size == 16);
+}
+
+static void test_layout_row_limit() {
+  std::vector<int32_t> sizes(200, 8);
+  std::vector<uint8_t> isstr(200, 0);
+  bool threw = false;
+  try {
+    srj::rows::compute_layout(sizes.data(), isstr.data(), 200);
+  } catch (const std::invalid_argument&) {
+    threw = true;
+  }
+  CHECK(threw);
+}
+
+static void test_batch_plan() {
+  // 100 rows of 16B, limit 64*16 bytes -> splits of 64 rows (32-aligned)
+  auto b = srj::rows::plan_fixed_batches(100, 16, 64 * 16);
+  CHECK(b.size() == 3);
+  CHECK(b[0] == 0 && b[1] == 64 && b[2] == 100);
+  auto empty = srj::rows::plan_fixed_batches(0, 16, 1 << 20);
+  CHECK(empty.size() == 2 && empty[0] == 0 && empty[1] == 0);
+}
+
+static void test_encode_decode_roundtrip() {
+  // columns: int32 {1,2,3}, int8 {7,8,9} with row 1 invalid
+  int32_t sizes[] = {4, 1};
+  uint8_t isstr[] = {0, 0};
+  Layout l = srj::rows::compute_layout(sizes, isstr, 2);
+  CHECK(l.fixed_row_size == 8);  // 4 + 1 + pad, validity at 5
+
+  int32_t c0[] = {1, 2, 3};
+  uint8_t c1[] = {7, 8, 9};
+  uint8_t v0 = 0b101;  // row 1 invalid
+  uint8_t v1 = 0b111;
+  const uint8_t* cols[] = {reinterpret_cast<const uint8_t*>(c0), c1};
+  const uint8_t* vals[] = {&v0, &v1};
+  std::vector<uint8_t> rows(3 * l.fixed_row_size);
+  srj::rows::encode_fixed(l, 3, cols, vals, rows.data());
+
+  // row 0: 01 00 00 00 | 07 | v=0b11 | pad pad
+  CHECK(rows[0] == 1 && rows[4] == 7 && rows[5] == 0b11);
+  // row 1: col0 invalid -> validity bit 0 clear
+  CHECK(rows[l.fixed_row_size + 5] == 0b10);
+
+  int32_t d0[3];
+  uint8_t d1[3];
+  uint8_t dv0 = 0, dv1 = 0;
+  uint8_t* dcols[] = {reinterpret_cast<uint8_t*>(d0), d1};
+  uint8_t* dvals[] = {&dv0, &dv1};
+  srj::rows::decode_fixed(l, 3, rows.data(), dcols, dvals);
+  CHECK(d0[0] == 1 && d0[1] == 2 && d0[2] == 3);
+  CHECK(d1[0] == 7 && d1[1] == 8 && d1[2] == 9);
+  CHECK(dv0 == 0b101 && dv1 == 0b111);
+}
+
+int main() {
+  test_layout_alignment();
+  test_layout_string_slot();
+  test_layout_row_limit();
+  test_batch_plan();
+  test_encode_decode_roundtrip();
+  if (g_failures == 0) {
+    std::printf("row engine self-tests: all passed\n");
+    return 0;
+  }
+  std::printf("row engine self-tests: %d FAILURES\n", g_failures);
+  return 1;
+}

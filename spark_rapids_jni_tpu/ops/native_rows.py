@@ -1,0 +1,190 @@
+"""ctypes binding to the host-native row engine (``native/src/row_engine.cpp``).
+
+The host-C++ half of the conversion component: layout calculation and batch
+planning (the reference's ``compute_column_information``/``build_batches``
+host logic, ``row_conversion.cu:1331-1370, 1460-1539``) plus a CPU
+encode/decode used for host-staged data and as a third independent
+implementation cross-checked against the XLA and Pallas paths by the tests
+(extending the reference's dual-implementation oracle strategy, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_jni_tpu.parquet import native as _loader
+from spark_rapids_jni_tpu.table import DType
+from spark_rapids_jni_tpu.ops.row_layout import (
+    MAX_BATCH_BYTES, RowLayout,
+)
+
+_configured = False
+
+
+def _lib():
+    global _configured
+    lib = _loader.load()
+    if lib is None:
+        return None
+    if not _configured:
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        u8pp = ctypes.POINTER(u8p)
+        lib.srj_row_layout.restype = ctypes.c_int
+        lib.srj_row_layout.argtypes = [ctypes.c_int32, i32p, u8p, i32p,
+                                       i32p, i32p]
+        lib.srj_plan_fixed_batches.restype = ctypes.c_int64
+        lib.srj_plan_fixed_batches.argtypes = [
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_int64, i64p,
+            ctypes.c_int64]
+        lib.srj_rows_encode_fixed.restype = ctypes.c_int
+        lib.srj_rows_encode_fixed.argtypes = [
+            ctypes.c_int32, ctypes.c_int64, i32p, u8p, u8pp, u8pp, u8p]
+        lib.srj_rows_decode_fixed.restype = ctypes.c_int
+        lib.srj_rows_decode_fixed.argtypes = [
+            ctypes.c_int32, ctypes.c_int64, i32p, u8p, u8p, u8pp, u8pp]
+        _configured = True
+    return lib
+
+
+def native_available() -> bool:
+    return _lib() is not None
+
+
+def _schema_arrays(dtypes: Sequence[DType]):
+    itemsizes = np.array(
+        [8 if dt.is_string else dt.itemsize for dt in dtypes], np.int32)
+    is_string = np.array([1 if dt.is_string else 0 for dt in dtypes],
+                         np.uint8)
+    return itemsizes, is_string
+
+
+def _i32p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _u8p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def compute_row_layout_native(dtypes: Sequence[DType]) -> RowLayout:
+    """Layout via the C++ engine (cross-checked against the Python
+    calculator in tests)."""
+    lib = _lib()
+    if lib is None:
+        raise RuntimeError("native row engine unavailable")
+    dtypes = tuple(dtypes)
+    n = len(dtypes)
+    itemsizes, is_string = _schema_arrays(dtypes)
+    starts = np.zeros(n, np.int32)
+    sizes = np.zeros(n, np.int32)
+    meta = np.zeros(3, np.int32)
+    rc = lib.srj_row_layout(n, _i32p(itemsizes), _u8p(is_string),
+                            _i32p(starts), _i32p(sizes), _i32p(meta))
+    if rc != 0:
+        raise ValueError(_loader.last_error(lib))
+    variable_starts = tuple(
+        int(starts[i]) for i in range(n) if dtypes[i].is_string)
+    return RowLayout(
+        dtypes=dtypes,
+        col_starts=tuple(int(x) for x in starts),
+        col_sizes=tuple(int(x) for x in sizes),
+        variable_starts=variable_starts,
+        validity_offset=int(meta[0]),
+        validity_bytes=int(meta[1]),
+        fixed_row_size=int(meta[2]),
+    )
+
+
+def plan_fixed_batches_native(nrows: int, row_size: int,
+                              size_limit: int = MAX_BATCH_BYTES
+                              ) -> List[Tuple[int, int]]:
+    lib = _lib()
+    if lib is None:
+        raise RuntimeError("native row engine unavailable")
+    # mirror the planner's 32-row-aligned batch size when sizing the buffer
+    max_rows = (size_limit // row_size) // 32 * 32
+    if max_rows == 0:
+        max_rows = 32  # planner's small-nrows fallback
+    cap = max(16, nrows // max_rows + 4)
+    bounds = np.zeros(cap, np.int64)
+    n = lib.srj_plan_fixed_batches(
+        nrows, row_size, size_limit,
+        bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), cap)
+    if n < 0:
+        raise ValueError(_loader.last_error(lib))
+    b = bounds[:n]
+    return list(zip((int(x) for x in b[:-1]), (int(x) for x in b[1:])))
+
+
+def encode_fixed_native(columns: Sequence[np.ndarray],
+                        validity: Sequence[Optional[np.ndarray]],
+                        dtypes: Sequence[DType]) -> np.ndarray:
+    """Encode host numpy columns to JCUDF row bytes.
+
+    ``columns[i]`` is a contiguous native-dtype array; ``validity[i]`` an
+    LSB-first packed uint8 bitmask or None.  Returns uint8[nrows*row_size].
+    """
+    lib = _lib()
+    if lib is None:
+        raise RuntimeError("native row engine unavailable")
+    dtypes = tuple(dtypes)
+    n = len(dtypes)
+    nrows = len(columns[0]) if n else 0
+    itemsizes, is_string = _schema_arrays(dtypes)
+    layout = compute_row_layout_native(dtypes)
+    cols_c = (ctypes.POINTER(ctypes.c_uint8) * n)()
+    keep = []  # hold contiguous buffers alive
+    for i, c in enumerate(columns):
+        c = np.ascontiguousarray(c)
+        keep.append(c)
+        cols_c[i] = _u8p(c.view(np.uint8).reshape(-1))
+    val_c = (ctypes.POINTER(ctypes.c_uint8) * n)()
+    for i, v in enumerate(validity):
+        if v is None:
+            val_c[i] = None
+        else:
+            v = np.ascontiguousarray(v, dtype=np.uint8)
+            keep.append(v)
+            val_c[i] = _u8p(v)
+    out = np.zeros(nrows * layout.fixed_row_size, np.uint8)
+    rc = lib.srj_rows_encode_fixed(n, nrows, _i32p(itemsizes),
+                                   _u8p(is_string), cols_c, val_c, _u8p(out))
+    if rc != 0:
+        raise ValueError(_loader.last_error(lib))
+    return out
+
+
+def decode_fixed_native(rows: np.ndarray, dtypes: Sequence[DType]
+                        ) -> Tuple[List[np.ndarray], List[np.ndarray]]:
+    """Decode JCUDF row bytes back to (columns, packed validity masks)."""
+    lib = _lib()
+    if lib is None:
+        raise RuntimeError("native row engine unavailable")
+    dtypes = tuple(dtypes)
+    n = len(dtypes)
+    layout = compute_row_layout_native(dtypes)
+    rows = np.ascontiguousarray(rows, dtype=np.uint8)
+    if rows.size % layout.fixed_row_size != 0:
+        raise ValueError(
+            f"row buffer size {rows.size} is not a multiple of the "
+            f"{layout.fixed_row_size}-byte row size")
+    nrows = rows.size // layout.fixed_row_size
+    itemsizes, is_string = _schema_arrays(dtypes)
+    cols = [np.zeros(nrows, dt.np_dtype) if not dt.is_string
+            else np.zeros(nrows, np.dtype("<u8"))  # (off,len) pair as u64
+            for dt in dtypes]
+    vals = [np.zeros((nrows + 7) // 8, np.uint8) for _ in dtypes]
+    cols_c = (ctypes.POINTER(ctypes.c_uint8) * n)(
+        *[_u8p(c.view(np.uint8).reshape(-1)) for c in cols])
+    vals_c = (ctypes.POINTER(ctypes.c_uint8) * n)(*[_u8p(v) for v in vals])
+    rc = lib.srj_rows_decode_fixed(n, nrows, _i32p(itemsizes),
+                                   _u8p(is_string), _u8p(rows), cols_c,
+                                   vals_c)
+    if rc != 0:
+        raise ValueError(_loader.last_error(lib))
+    return cols, vals

@@ -20,7 +20,7 @@ def _git(*args: str) -> str:
             ["git", *args], capture_output=True, text=True, timeout=10,
             cwd=os.path.dirname(_PROPS))
         return out.stdout.strip() if out.returncode == 0 else "unknown"
-    except OSError:
+    except (OSError, subprocess.SubprocessError):
         return "unknown"
 
 
