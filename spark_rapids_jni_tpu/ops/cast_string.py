@@ -1,0 +1,353 @@
+"""Spark-compatible string <-> integer casts, TPU-native.
+
+Capability parity with the reference lineage's ``cast_string`` kernel family
+(the component the SURVEY.md §7 scope note lists for the north-star build;
+the snapshot predates it, so semantics follow Spark's CAST):
+
+- leading/trailing whitespace (ASCII <= 0x20) is trimmed;
+- optional ``+``/``-`` sign, then digits; a decimal point truncates toward
+  zero but the fraction must itself be all digits (``'1.9' -> 1``,
+  ``'1.x' -> null``);
+- empty/invalid/overflowing strings produce null (non-ANSI) or are reported
+  in the returned error mask for ANSI mode;
+- input nulls propagate.
+
+TPU-first design: each string's first ``W`` post-trim bytes are gathered
+into a static ``[n, W]`` byte matrix (ragged chars never reach the kernel),
+and the digit accumulation runs in **16-bit limbs held in uint32 lanes** —
+four limbs form the 64-bit magnitude, so the same fully-vectorized code
+serves int8..int64 with exact overflow detection whether or not x64 is
+enabled, and 64-bit results are emitted directly in the framework's
+(lo, hi) uint32-pair representation (see ``Column.from_numpy``).  No
+per-row host loops, no dynamic shapes: everything is one fused XLA program
+over VPU lanes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu.table import (
+    Column, DType, pack_bools,
+)
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+# static window sizes: whitespace trim looks at the first/last TRIM_WIDTH
+# bytes, the numeric body at PARSE_WIDTH bytes after the leading trim.
+# Strings with >TRIM_WIDTH whitespace on either end, or a trimmed body
+# longer than PARSE_WIDTH bytes (>=14 leading zeros on a 19-digit value),
+# are conservatively null — documented deviation from Spark's unbounded
+# scan, pinned by tests.
+PARSE_WIDTH = 32
+TRIM_WIDTH = 32
+
+_INT_BOUNDS = {  # dtype -> positive-magnitude bound 2**(bits-1) - 1
+    1: (1 << 7) - 1,
+    2: (1 << 15) - 1,
+    4: (1 << 31) - 1,
+    8: (1 << 63) - 1,
+}
+
+
+def _limb_const(value: int) -> Tuple[int, int, int, int]:
+    return tuple((value >> (16 * k)) & 0xFFFF for k in range(4))
+
+
+def _gather_window_at(starts: jnp.ndarray, lens: jnp.ndarray,
+                      chars: jnp.ndarray, width: int
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[n, width] uint8 window beginning at ``starts`` (zero padded past
+    each window's ``lens`` bytes)."""
+    n = starts.shape[0]
+    total = chars.shape[0]
+    idx = starts[:, None].astype(jnp.int32) + jnp.arange(
+        width, dtype=jnp.int32)[None, :]
+    in_range = idx < (starts + lens)[:, None]
+    safe = jnp.clip(idx, 0, max(total - 1, 0))
+    if total == 0:
+        ch = jnp.zeros((n, width), jnp.uint8)
+    else:
+        ch = jnp.where(in_range, chars[safe], jnp.uint8(0))
+    return ch, lens
+
+
+def _trim_bounds(offsets: jnp.ndarray, chars: jnp.ndarray, width: int
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Leading/trailing whitespace runs (ASCII <= 0x20, Spark's
+    ``UTF8String.trimAll``) measured in head/tail windows of ``width`` bytes,
+    so padding does not consume the numeric parse window.
+
+    Returns (lead, trail, bounded): ``bounded`` is False when a whitespace
+    run fills its whole window with string left over — the run's true length
+    is unknown and the row must be treated as unparseable.
+    """
+    lens = (offsets[1:] - offsets[:-1]).astype(jnp.int32)
+    total = chars.shape[0]
+    pos = jnp.arange(width, dtype=jnp.int32)[None, :]
+
+    def window(starts):
+        idx = starts[:, None] + pos
+        ok = (idx >= offsets[:-1, None]) & (idx < offsets[1:, None])
+        safe = jnp.clip(idx, 0, max(total - 1, 0))
+        w = jnp.where(ok, chars[safe], jnp.uint8(0)) if total \
+            else jnp.zeros((starts.shape[0], width), jnp.uint8)
+        return w, ok
+
+    head, head_in = window(offsets[:-1].astype(jnp.int32))
+    head_ws = (head <= 0x20) & head_in
+    lead = jnp.sum(jnp.cumprod(head_ws.astype(jnp.int32), axis=1),
+                   axis=1).astype(jnp.int32)
+
+    tail_start = jnp.maximum(offsets[1:].astype(jnp.int32) - width,
+                             offsets[:-1].astype(jnp.int32))
+    tail, tail_in = window(tail_start)
+    # past-end slots (short strings) count as ws so the run reaches the
+    # real chars, then the pad is subtracted back out
+    tail_ws = jnp.where(tail_in, tail <= 0x20, True)
+    run = jnp.sum(
+        jnp.cumprod(tail_ws[:, ::-1].astype(jnp.int32), axis=1),
+        axis=1).astype(jnp.int32)
+    pad = width - jnp.minimum(lens, width)
+    trail = jnp.maximum(run - pad, 0)
+
+    # overlapping windows double-count ws of all/mostly-ws short strings;
+    # clamping to len keeps tlen >= 0 and such rows null out as empty
+    bounded = ~(((lead == width) | (trail == width)) & (lens > width))
+    return lead, jnp.minimum(trail, lens - jnp.minimum(lead, lens)), bounded
+
+
+def _parse_int_magnitude(ch: jnp.ndarray, tlen: jnp.ndarray):
+    """Parse sign/digits/dot from the trimmed window.
+
+    Returns (limbs [n,4] uint32 16-bit limbs of the integer magnitude,
+    negative flag, valid flag, overflow flag).
+    """
+    n, width = ch.shape
+    pos = jnp.arange(width, dtype=jnp.int32)[None, :]
+    in_str = pos < tlen[:, None]
+
+    first = ch[:, 0]
+    has_sign = (first == ord("+")) | (first == ord("-"))
+    negative = first == ord("-")
+    start = has_sign.astype(jnp.int32)
+
+    is_digit = (ch >= ord("0")) & (ch <= ord("9")) & in_str
+    is_dot = (ch == ord(".")) & in_str
+    body = pos >= start[:, None]
+
+    # first dot position (width if none)
+    dot_pos = jnp.min(jnp.where(is_dot, pos, width), axis=1)
+    int_part = body & (pos < dot_pos[:, None]) & in_str
+    frac_part = body & (pos > dot_pos[:, None]) & in_str
+
+    # validity: body is digits + at most one dot; >=1 digit somewhere;
+    # fraction all digits; nonempty; fits the window
+    ok_chars = jnp.all(jnp.where(int_part | frac_part, is_digit, True),
+                       axis=1)
+    one_dot = jnp.sum(is_dot.astype(jnp.int32), axis=1) <= 1
+    any_digit = jnp.any(is_digit, axis=1)
+    nonempty = tlen > start
+    fits = tlen <= width
+    valid = ok_chars & one_dot & any_digit & nonempty & fits
+
+    # accumulate integer-part digits in 16-bit limbs (uint32 lanes)
+    digits = (ch - ord("0")).astype(jnp.uint32)
+    limbs = [jnp.zeros((n,), jnp.uint32) for _ in range(4)]
+    overflow = jnp.zeros((n,), jnp.bool_)
+    for j in range(width):
+        use = int_part[:, j] & is_digit[:, j]
+        d = jnp.where(use, digits[:, j], 0)
+        mul = jnp.where(use, jnp.uint32(10), jnp.uint32(1))
+        carry = d
+        for k in range(4):
+            t = limbs[k] * mul + carry
+            limbs[k] = t & 0xFFFF
+            carry = t >> 16
+        overflow = overflow | (carry != 0)
+    return jnp.stack(limbs, axis=1), negative, valid, overflow
+
+
+def _magnitude_gt(limbs: jnp.ndarray, bound: int) -> jnp.ndarray:
+    """limbs (uint32 [n,4], 16-bit limbs) > bound, exact."""
+    bl = _limb_const(bound)
+    gt = jnp.zeros((limbs.shape[0],), jnp.bool_)
+    eq = jnp.ones((limbs.shape[0],), jnp.bool_)
+    for k in (3, 2, 1, 0):
+        b = jnp.uint32(bl[k])
+        gt = gt | (eq & (limbs[:, k] > b))
+        eq = eq & (limbs[:, k] == b)
+    return gt
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _cast_string_to_int_jit(offsets, chars, itemsize: int, width: int):
+    lead, trail, bounded = _trim_bounds(offsets, chars, TRIM_WIDTH)
+    lens = (offsets[1:] - offsets[:-1]).astype(jnp.int32)
+    tlen = jnp.maximum(lens - lead - trail, 0)
+    # gather the parse window from the post-trim body start
+    ch, _ = _gather_window_at(offsets[:-1].astype(jnp.int32) + lead,
+                              tlen, chars, width)
+    limbs, negative, valid, overflow = _parse_int_magnitude(ch, tlen)
+    valid = valid & bounded
+
+    bound = _INT_BOUNDS[itemsize]
+    too_big = jnp.where(negative,
+                        _magnitude_gt(limbs, bound + 1),
+                        _magnitude_gt(limbs, bound))
+    overflow = overflow | too_big
+    ok = valid & ~overflow
+
+    # assemble 64-bit two's complement from limbs
+    lo = limbs[:, 0] | (limbs[:, 1] << 16)
+    hi = limbs[:, 2] | (limbs[:, 3] << 16)
+    neg_lo = (~lo + 1) & jnp.uint32(0xFFFFFFFF)
+    neg_hi = (~hi + jnp.where(lo == 0, 1, 0).astype(jnp.uint32)) \
+        & jnp.uint32(0xFFFFFFFF)
+    out_lo = jnp.where(negative, neg_lo, lo)
+    out_hi = jnp.where(negative, neg_hi, hi)
+    return out_lo, out_hi, ok
+
+
+@func_range()
+def cast_string_to_int(col: Column, dtype: DType, *, ansi: bool = False
+                       ) -> Tuple[Column, jnp.ndarray]:
+    """CAST(string AS <int type>) with Spark semantics.
+
+    Returns ``(column, error_mask)``: invalid/overflow rows are null in the
+    column; ``error_mask`` marks them for ANSI callers (non-null inputs
+    whose parse failed).  With ``ansi=True`` the mask is checked on host and
+    raises ``ValueError`` — Spark's ANSI CAST exception.
+    """
+    if not col.dtype.is_string:
+        raise ValueError("cast_string_to_int needs a string column")
+    if dtype.kind not in ("int8", "int16", "int32", "int64"):
+        raise ValueError(f"unsupported target dtype {dtype}")
+    out_lo, out_hi, ok = _cast_string_to_int_jit(
+        col.offsets, col.chars, dtype.itemsize, PARSE_WIDTH)
+
+    in_valid = col.valid_bools()
+    error = in_valid & ~ok
+    if ansi:
+        import numpy as np
+        bad = np.asarray(error)
+        if bad.any():
+            raise ValueError(
+                f"ANSI cast failure: {int(bad.sum())} invalid value(s), "
+                f"first at row {int(bad.argmax())}")
+    result_valid = in_valid & ok
+
+    if dtype.itemsize == 8:
+        if jax.config.jax_enable_x64:
+            val64 = (out_lo.astype(jnp.uint64)
+                     | (out_hi.astype(jnp.uint64) << jnp.uint64(32)))
+            data = val64.astype(jnp.int64)
+        else:
+            data = jnp.stack([out_lo, out_hi], axis=1)  # wide pair repr
+    else:
+        bits = 8 * dtype.itemsize
+        val = out_lo.astype(jnp.int32)
+        # sign-extend the low limbs for narrow types
+        val = (val << (32 - bits)) >> (32 - bits)
+        data = val.astype(dtype.np_dtype)
+    return Column(dtype, data, pack_bools(result_valid)), error
+
+
+# ---------------------------------------------------------------------------
+# int -> string
+# ---------------------------------------------------------------------------
+
+MAX_INT64_DIGITS = 20  # including sign slot handled separately
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _int_to_string_jit(data, mode: str):
+    """Digits via 4x16-bit limb divmod-10 (vectorized schoolbook), so the
+    same code covers int64 without x64.  ``mode``: "wide" (uint32-pair
+    input), "i64" (native int64, x64 on), "narrow" (<=32-bit).  Returns
+    (digit matrix [n, W], lengths, negative flags)."""
+    if mode == "i64":
+        u = jax.lax.bitcast_convert_type(data, jnp.uint64)
+        lo = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+        hi = (u >> jnp.uint64(32)).astype(jnp.uint32)
+        mode = "wide"
+    elif mode == "wide":
+        lo = data[:, 0]
+        hi = data[:, 1]
+    if mode == "wide":
+        negative = (hi >> 31) != 0
+        # two's complement negate to get magnitude
+        nlo = (~lo + 1) & jnp.uint32(0xFFFFFFFF)
+        nhi = (~hi + jnp.where(lo == 0, 1, 0).astype(jnp.uint32)) \
+            & jnp.uint32(0xFFFFFFFF)
+        mlo = jnp.where(negative, nlo, lo)
+        mhi = jnp.where(negative, nhi, hi)
+    else:
+        v = data.astype(jnp.int32)
+        negative = v < 0
+        mlo = jnp.where(negative, -v, v).astype(jnp.uint32)
+        mhi = jnp.zeros_like(mlo)
+
+    limbs = [mlo & 0xFFFF, mlo >> 16, mhi & 0xFFFF, mhi >> 16]
+    W = MAX_INT64_DIGITS
+    digs = []
+    for _ in range(W):
+        rem = jnp.zeros_like(limbs[0])
+        new = []
+        for k in (3, 2, 1, 0):
+            cur = (rem << 16) | limbs[k]
+            q = cur // 10
+            rem = cur - q * 10
+            new.append(q)
+        limbs = [new[3], new[2], new[1], new[0]]
+        digs.append(rem)
+    # digs[0] = least significant digit
+    digits = jnp.stack(digs[::-1], axis=1)  # [n, W], most significant first
+    nz = digits != 0
+    first_nz = jnp.argmax(nz, axis=1).astype(jnp.int32)
+    any_nz = jnp.any(nz, axis=1)
+    ndigits = jnp.where(any_nz, W - first_nz, 1)
+    return digits, ndigits.astype(jnp.int32), negative
+
+
+@func_range()
+def cast_int_to_string(col: Column) -> Column:
+    """CAST(<int> AS STRING): decimal formatting, '-' for negatives."""
+    import numpy as np
+    dt = col.dtype
+    if dt.kind not in ("int8", "int16", "int32", "int64"):
+        raise ValueError("cast_int_to_string needs a signed integer column")
+    if col.data.ndim == 2:
+        mode = "wide"
+    elif dt.itemsize == 8:
+        mode = "i64"
+    else:
+        mode = "narrow"
+    digits, ndigits, negative = _int_to_string_jit(col.data, mode)
+    n = col.num_rows
+    W = MAX_INT64_DIGITS
+
+    str_lens = ndigits + negative.astype(jnp.int32)
+    lens_np = np.asarray(str_lens)
+    offsets = np.zeros(n + 1, np.int32)
+    np.cumsum(lens_np, out=offsets[1:])
+    total = int(offsets[-1])
+
+    # write each row's chars: position p in [0, len) maps to sign or digit
+    offs_j = jnp.asarray(offsets)
+    row_ids = jnp.repeat(jnp.arange(n, dtype=jnp.int32), str_lens,
+                         total_repeat_length=total)
+    intra = jnp.arange(total, dtype=jnp.int32) - offs_j[row_ids]
+    is_sign_slot = negative[row_ids] & (intra == 0)
+    digit_idx = (W - ndigits[row_ids]
+                 + intra - negative[row_ids].astype(jnp.int32))
+    digit_idx = jnp.clip(digit_idx, 0, W - 1)
+    dchar = (digits[row_ids, digit_idx] + ord("0")).astype(jnp.uint8)
+    chars = jnp.where(is_sign_slot, jnp.uint8(ord("-")), dchar)
+
+    from spark_rapids_jni_tpu.table import STRING
+    return Column(STRING, jnp.zeros((0,), jnp.uint8),
+                  col.validity, offs_j, chars)

@@ -1,0 +1,155 @@
+"""String<->int cast tests, oracled by a host-side Python reimplementation
+of Spark CAST semantics (trim, sign, dot-truncation, overflow -> null)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import (
+    Column, INT8, INT16, INT32, INT64, STRING,
+)
+from spark_rapids_jni_tpu.ops.cast_string import (
+    cast_int_to_string, cast_string_to_int,
+)
+
+
+def spark_cast_oracle(s, bits):
+    """Host oracle for Spark CAST(string AS int<bits>)."""
+    if s is None:
+        return None
+    # trim ASCII <= 0x20 on both ends (UTF8String.trimAll)
+    i, j = 0, len(s)
+    while i < j and ord(s[i]) <= 0x20:
+        i += 1
+    while j > i and ord(s[j - 1]) <= 0x20:
+        j -= 1
+    t = s[i:j]
+    if not t:
+        return None
+    sign = 1
+    if t[0] in "+-":
+        sign = -1 if t[0] == "-" else 1
+        t = t[1:]
+    if t.count(".") > 1:
+        return None
+    ip, _, fp = t.partition(".")
+    if ip and not all(c in "0123456789" for c in ip):
+        return None
+    if fp and not all(c in "0123456789" for c in fp):
+        return None
+    if not ip and not fp:
+        return None  # no digits at all ('.', '+', '-', '+.')
+    val = sign * int(ip or "0")
+    lo, hi = -(1 << (bits - 1)), (1 << (bits - 1)) - 1
+    if not lo <= val <= hi:
+        return None
+    return val
+
+
+CASES = ["123", "-45", "+7", "  42  ", "1.9", "-1.9", "0", "-0", "",
+         "   ", ".", "1.", ".5", "-.5", "abc", "12a", "a12", "1 2",
+         "127", "128", "-128", "-129", "32767", "32768", "-32768",
+         "2147483647", "2147483648", "-2147483648", "-2147483649",
+         "9223372036854775807", "9223372036854775808",
+         "-9223372036854775808", "-9223372036854775809",
+         "00000000000000000000123", "1.999999", "+.", "-", "+", "--1",
+         "1.2.3", "\t-8\n", "999999999999999999999999999999"]
+
+
+@pytest.mark.parametrize("dtype,bits", [(INT8, 8), (INT16, 16),
+                                        (INT32, 32), (INT64, 64)])
+def test_cast_string_to_int_matches_oracle(dtype, bits):
+    col = Column.strings(CASES)
+    out, err = cast_string_to_int(col, dtype)
+    got = out.to_pylist()
+    exp = [spark_cast_oracle(s, bits) for s in CASES]
+    assert got == exp, [
+        (s, g, e) for s, g, e in zip(CASES, got, exp) if g != e]
+    # error mask marks exactly the non-null inputs that became null
+    err_np = np.asarray(err)
+    assert err_np.tolist() == [e is None for e in exp]
+
+
+def test_cast_null_propagation():
+    col = Column.strings(["1", None, "2"])
+    out, err = cast_string_to_int(col, INT32)
+    assert out.to_pylist() == [1, None, 2]
+    assert not np.asarray(err).any()  # null input is not an error
+
+
+def test_cast_ansi_raises():
+    col = Column.strings(["1", "abc"])
+    with pytest.raises(ValueError, match="ANSI cast failure"):
+        cast_string_to_int(col, INT32, ansi=True)
+
+
+def test_cast_int_to_string_roundtrip():
+    vals = [0, 1, -1, 127, -128, 31415, -27182, 2**31 - 1, -(2**31),
+            2**63 - 1, -(2**63), 10, -10, 1000000]
+    col = Column.from_numpy(np.array(vals, np.int64), INT64)
+    s = cast_int_to_string(col)
+    assert s.to_pylist() == [str(v) for v in vals]
+    # round-trip back through string->int
+    back, err = cast_string_to_int(s, INT64)
+    assert back.to_pylist() == vals
+    assert not np.asarray(err).any()
+
+
+def test_cast_int_to_string_narrow_types():
+    for dtype, vals in [(INT8, [0, -5, 127, -128]),
+                        (INT16, [300, -300, 32767, -32768]),
+                        (INT32, [2**31 - 1, -(2**31), 42])]:
+        col = Column.from_numpy(np.array(vals, dtype.np_dtype), dtype)
+        assert cast_int_to_string(col).to_pylist() == [str(v) for v in vals]
+
+
+def test_cast_int_to_string_null_propagation():
+    col = Column.from_numpy(np.array([5, 6], np.int32), INT32,
+                            valid=np.array([True, False]))
+    assert cast_int_to_string(col).to_pylist() == ["5", None]
+
+
+def test_cast_int64_no_x64_wide_pairs():
+    """TPU-mode regression: 64-bit casts via the uint32-pair representation."""
+    import jax
+    with jax.enable_x64(False):
+        vals = [2**62, -(2**62), 9223372036854775807, -9223372036854775808,
+                0, -1]
+        col = Column.from_numpy(np.array(vals, np.int64), INT64)
+        assert col.data.ndim == 2  # wide pair representation
+        s = cast_int_to_string(col)
+        assert s.to_pylist() == [str(v) for v in vals]
+        back, err = cast_string_to_int(s, INT64)
+        assert back.data.ndim == 2
+        assert back.to_pylist() == vals
+        assert not np.asarray(err).any()
+        # overflow at the 64-bit boundary still detected without x64
+        over, err2 = cast_string_to_int(
+            Column.strings(["9223372036854775808"]), INT64)
+        assert over.to_pylist() == [None] and np.asarray(err2).all()
+
+
+def test_cast_long_strings_whitespace_padding():
+    """Whitespace padding up to TRIM_WIDTH per side parses (raw length may
+    far exceed PARSE_WIDTH); unbounded runs / oversized bodies are null."""
+    cases = [
+        ("123" + " " * 30, 123),          # raw len 33 > PARSE_WIDTH
+        (" " * 30 + "-77" + " " * 30, -77),
+        ("\t" * 32 + "5", None),          # lead fills the trim window
+        ("5" + " " * 33, None),           # trail fills the trim window
+        ("0" * 33 + "9", None),           # body longer than PARSE_WIDTH
+        ("0" * 31 + "9", int("9")),       # body fits exactly (32 <= 32)
+        (" " * 40, None),                 # all whitespace, longer than both
+    ]
+    col = Column.strings([s for s, _ in cases])
+    out, err = cast_string_to_int(col, INT32)
+    assert out.to_pylist() == [e for _, e in cases]
+    assert np.asarray(err).tolist() == [e is None for _, e in cases]
+
+
+def test_cast_rejects_decimal_dtypes():
+    from spark_rapids_jni_tpu import decimal64
+    with pytest.raises(ValueError, match="unsupported target"):
+        cast_string_to_int(Column.strings(["1"]), decimal64(scale=2))
+    col = Column.from_numpy(np.array([123], np.int64), decimal64(scale=2))
+    with pytest.raises(ValueError, match="signed integer"):
+        cast_int_to_string(col)
