@@ -333,21 +333,41 @@ def convert_from_rows_fixed_width_optimized(
 # Public API — optimized path (XLA / Pallas)
 # ---------------------------------------------------------------------------
 
+def _resolve_impl(impl: Optional[str], use_pallas: Optional[bool],
+                  platform: str) -> str:
+    """Pick the fixed-width engine: ``mxu`` (permutation matmul on the
+    systolic array — the TPU hot path), ``xla`` (fused concatenate), or
+    ``pallas`` (explicitly tiled kernel).  Auto: mxu on TPU, xla elsewhere."""
+    if impl is not None:
+        if impl not in ("mxu", "xla", "pallas"):
+            raise ValueError(f"unknown impl {impl!r}; "
+                             "expected 'mxu', 'xla' or 'pallas'")
+        return impl
+    if use_pallas:
+        return "pallas"
+    if use_pallas is not None:  # explicit False
+        return "xla"
+    return "mxu" if platform == "tpu" else "xla"
+
+
 @func_range()
 def convert_to_rows(table: Table, *, size_limit: int = MAX_BATCH_BYTES,
-                    use_pallas: Optional[bool] = None) -> List[RowsColumn]:
+                    use_pallas: Optional[bool] = None,
+                    impl: Optional[str] = None) -> List[RowsColumn]:
     """Convert a table to JCUDF row batches (reference ``convert_to_rows``,
     ``row_conversion.cu:1902-1960``)."""
     layout = compute_row_layout(table.dtypes)
     if layout.has_strings:
         return _to_rows_variable(table, layout, size_limit)
     platform = _platform_of(table)
-    if use_pallas is None:
-        use_pallas = platform == "tpu"
-    if use_pallas:
+    impl = _resolve_impl(impl, use_pallas, platform)
+    if impl == "pallas":
         from spark_rapids_jni_tpu.ops import row_kernels
         rows2d = row_kernels.to_rows_fixed(table, layout,
                                            interpret=platform != "tpu")
+    elif impl == "mxu":
+        from spark_rapids_jni_tpu.ops import row_mxu
+        rows2d = row_mxu.to_rows_fixed(table, layout)
     else:
         rows2d = _to_rows_fixed_jit(table, layout)
     return _batch_rows2d(rows2d, layout, size_limit)
@@ -355,7 +375,8 @@ def convert_to_rows(table: Table, *, size_limit: int = MAX_BATCH_BYTES,
 
 @func_range()
 def convert_from_rows(rows: RowsColumn, dtypes: Sequence[DType],
-                      *, use_pallas: Optional[bool] = None) -> Table:
+                      *, use_pallas: Optional[bool] = None,
+                      impl: Optional[str] = None) -> Table:
     """Convert one batch of JCUDF rows back to a table (reference
     ``convert_from_rows``, ``row_conversion.cu:2032-2250``)."""
     layout = compute_row_layout(dtypes)
@@ -364,12 +385,14 @@ def convert_from_rows(rows: RowsColumn, dtypes: Sequence[DType],
     n = rows.num_rows
     rows2d = rows.data.reshape(n, layout.fixed_row_size)
     platform = _platform_of(rows)
-    if use_pallas is None:
-        use_pallas = platform == "tpu"
-    if use_pallas:
+    impl = _resolve_impl(impl, use_pallas, platform)
+    if impl == "pallas":
         from spark_rapids_jni_tpu.ops import row_kernels
         cols = row_kernels.from_rows_fixed(rows2d, layout,
                                            interpret=platform != "tpu")
+    elif impl == "mxu":
+        from spark_rapids_jni_tpu.ops import row_mxu
+        cols = row_mxu.from_rows_fixed(rows2d, layout)
     else:
         cols = _from_rows_fixed_jit(rows2d, layout)
     return Table(tuple(cols))

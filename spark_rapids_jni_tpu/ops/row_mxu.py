@@ -1,0 +1,301 @@
+"""MXU permutation engine for fixed-width JCUDF row conversion.
+
+The TPU-first redesign of the reference's tiled byte-copy kernels
+(``copy_to_rows`` ``row_conversion.cu:575-693``, ``copy_from_rows``
+``:892-993``, ``copy_validity_to_rows`` ``:710-810``): instead of moving
+bytes through scratch memory with per-warp copies, the whole row encode is
+expressed as ONE int8 matmul on the systolic array.
+
+Key idea: a JCUDF row is a *static byte permutation* of the table's column
+bytes plus an OR-reduction for the validity bitmask.  Both are linear maps
+over GF-free mod-256 integer arithmetic:
+
+- every output data byte has exactly one source byte -> a 0/1 entry in a
+  permutation matrix ``P``;
+- validity byte ``b`` of the row is ``sum_j valid[8b+j] << j`` with
+  ``valid`` in {0,1} -> weighted entries ``1 << j`` in the same matrix
+  (sums stay < 256, so int32 accumulation truncated to uint8 is exact; the
+  int8 cast of weight 128 wraps to -128, which is congruent mod 256).
+
+The table's columns are first packed into a *transposed* ``[W, n] uint32``
+word matrix (one "plane" row per word: 64/32-bit columns bitcast straight
+in, 16-bit pairs and 8-bit quads packed by fused shifts/ors, validity bits
+as 0/1 bytes; the axis-0 concatenate is contiguous copies, never an
+interleave), then one ``dot_general`` contracting lhs dims (0, 2) reads the
+planes' bytes through a lazily-bitcast ``[W, n, 4]`` uint8 view and emits
+the finished ``[n, row_size]`` row matrix on the MXU — the row-major
+interleave the reference pays shared-memory traffic for is absorbed into
+the systolic array's operand load.  The decode direction is the transposed
+permutation producing byte planes ``[W, 4, n]``, recombined into words and
+sliced per column (plane rows are contiguous ``[n]`` vectors).
+
+This plays the role of the reference's hot kernels; the pure-XLA
+concatenate implementation (``row_conversion._assemble_fixed_rows``) and
+the gather-based oracle stay as the independent cross-check paths, the same
+dual-implementation strategy the reference's test suite uses
+(``src/main/cpp/tests/row_conversion.cpp``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.table import Column, DType, Table, pack_bools_2d
+from spark_rapids_jni_tpu.ops.row_layout import RowLayout
+
+
+# ---------------------------------------------------------------------------
+# Word plan: how columns map into the packed uint32 word matrix X
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class WordPlan:
+    """Static layout of the packed word matrix for one schema.
+
+    ``col_word``/``col_byte`` give, per column, the (word, byte-within-word)
+    coordinate of the column's first byte in X.  ``num_words`` is W.
+    ``validity_word``/``validity_byte`` locate the encoded validity section:
+    in the *forward* plan these hold one 0/1 byte per column; in the
+    *inverse* plan they hold the packed validity bytes themselves.
+    """
+
+    num_words: int
+    col_word: Tuple[int, ...]
+    col_byte: Tuple[int, ...]
+    validity_word: Tuple[int, ...]
+    validity_byte: Tuple[int, ...]
+
+
+def _build_word_plan(layout: RowLayout, validity_units: int) -> WordPlan:
+    """Allocate word slots: 8/4-byte columns word-aligned, 2-byte columns
+    packed two per word, 1-byte columns four per word, then
+    ``validity_units`` extra bytes packed four per word."""
+    col_word = [0] * layout.num_columns
+    col_byte = [0] * layout.num_columns
+    w = 0
+    # wide columns first (whole words)
+    for i, dt in enumerate(layout.dtypes):
+        sz = layout.col_sizes[i]
+        if sz == 8:
+            col_word[i], col_byte[i] = w, 0
+            w += 2
+        elif sz == 4:
+            col_word[i], col_byte[i] = w, 0
+            w += 1
+    # 2-byte columns, two per word
+    half = 0
+    for i, dt in enumerate(layout.dtypes):
+        if layout.col_sizes[i] == 2:
+            col_word[i], col_byte[i] = w, 2 * (half & 1)
+            half += 1
+            if half & 1 == 0:
+                w += 1
+    if half & 1:
+        w += 1
+    # 1-byte columns, four per word
+    quad = 0
+    for i, dt in enumerate(layout.dtypes):
+        if layout.col_sizes[i] == 1:
+            col_word[i], col_byte[i] = w, quad & 3
+            quad += 1
+            if quad & 3 == 0:
+                w += 1
+    if quad & 3:
+        w += 1
+    # validity bytes, four per word
+    vw, vb = [], []
+    for j in range(validity_units):
+        vw.append(w + j // 4)
+        vb.append(j % 4)
+    w += (validity_units + 3) // 4
+    return WordPlan(w, tuple(col_word), tuple(col_byte), tuple(vw),
+                    tuple(vb))
+
+
+@functools.lru_cache(maxsize=64)
+def _forward_plan(layout: RowLayout):
+    """Forward (encode) plan + its ``[W, 4, row_size]`` int8 matrix."""
+    plan = _build_word_plan(layout, layout.num_columns)
+    p = np.zeros((plan.num_words, 4, layout.fixed_row_size), dtype=np.uint8)
+    for i in range(layout.num_columns):
+        s, sz = layout.col_starts[i], layout.col_sizes[i]
+        for b in range(sz):
+            w = plan.col_word[i] + (plan.col_byte[i] + b) // 4
+            k = (plan.col_byte[i] + b) % 4
+            p[w, k, s + b] = 1
+    for c in range(layout.num_columns):
+        p[plan.validity_word[c], plan.validity_byte[c],
+          layout.validity_offset + c // 8] = np.uint8(1 << (c % 8))
+    return plan, p.view(np.int8)
+
+
+@functools.lru_cache(maxsize=64)
+def _inverse_plan(layout: RowLayout):
+    """Inverse (decode) plan + its ``[row_size, W, 4]`` int8 matrix."""
+    plan = _build_word_plan(layout, layout.validity_bytes)
+    p = np.zeros((layout.fixed_row_size, plan.num_words, 4), dtype=np.int8)
+    for i in range(layout.num_columns):
+        s, sz = layout.col_starts[i], layout.col_sizes[i]
+        for b in range(sz):
+            w = plan.col_word[i] + (plan.col_byte[i] + b) // 4
+            k = (plan.col_byte[i] + b) % 4
+            p[s + b, w, k] = 1
+    for j in range(layout.validity_bytes):
+        p[layout.validity_offset + j, plan.validity_word[j],
+          plan.validity_byte[j]] = 1
+    return plan, p
+
+
+# ---------------------------------------------------------------------------
+# Column <-> uint32 word helpers
+# ---------------------------------------------------------------------------
+
+def _as_u32(x: jnp.ndarray) -> jnp.ndarray:
+    """Zero-extend any narrow integer/bool column to uint32 bytes-exactly."""
+    if x.dtype == jnp.bool_:
+        x = x.astype(jnp.uint8)
+    unsigned = jnp.dtype(f"uint{x.dtype.itemsize * 8}")
+    if x.dtype != unsigned:
+        x = jax.lax.bitcast_convert_type(x, unsigned)
+    return x.astype(jnp.uint32)
+
+
+def _col_words(col: Column) -> List[jnp.ndarray]:
+    """A column's data as a list of [n] uint32 word arrays (LE order).
+    Partial words (16/8-bit columns) return a single low-justified word."""
+    data = col.data
+    sz = col.dtype.itemsize
+    if sz == 8:
+        if data.ndim == 2:           # no-x64 uint32-pair representation
+            return [data[:, 0].astype(jnp.uint32),
+                    data[:, 1].astype(jnp.uint32)]
+        pair = jax.lax.bitcast_convert_type(data, jnp.uint32)
+        return [pair[:, 0], pair[:, 1]]
+    if sz == 4:
+        return [jax.lax.bitcast_convert_type(data, jnp.uint32)
+                if data.dtype != jnp.uint32 else data]
+    return [_as_u32(data)]
+
+
+def _pack_planes(table: Table, layout: RowLayout, plan: WordPlan,
+                 valid_units: List[jnp.ndarray]) -> jnp.ndarray:
+    """Build the word matrix *transposed*: [W, n] uint32, one row ("plane")
+    per word.  Rows are produced by fused shifts/ors over whole [n]
+    columns and joined with an axis-0 concatenate — contiguous copies, no
+    interleave.  The interleave the reference pays shared-memory traffic
+    for happens inside the MXU's operand load instead (the dot contracts
+    lhs dim 0, reading the transposed operand for free)."""
+    n = table.num_rows
+    words: List = [None] * plan.num_words
+    def _add(w: int, term: jnp.ndarray):
+        words[w] = term if words[w] is None else words[w] | term
+    for i, col in enumerate(table.columns):
+        ws = _col_words(col)
+        for j, word in enumerate(ws):
+            w = plan.col_word[i] + j
+            shift = 8 * plan.col_byte[i]
+            _add(w, word << shift if shift else word)
+    for j, unit in enumerate(valid_units):
+        shift = 8 * plan.validity_byte[j]
+        _add(plan.validity_word[j], unit << shift if shift else unit)
+    zeros = jnp.zeros((n,), jnp.uint32)
+    return jnp.concatenate(
+        [(w if w is not None else zeros)[None, :] for w in words], axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Encode: table -> [n, fixed_row_size] uint8
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _to_rows_mxu_jit(table: Table, layout: RowLayout,
+                     p3: jnp.ndarray) -> jnp.ndarray:
+    plan, _ = _forward_plan(layout)
+    valid_units = [_as_u32(table.column(c).valid_bools())
+                   for c in range(layout.num_columns)]
+    xt = _pack_planes(table, layout, plan, valid_units)    # [W, n] u32
+    xb = jax.lax.bitcast_convert_type(xt, jnp.uint8)       # [W, n, 4] lazy
+    rows = jax.lax.dot_general(
+        xb.astype(jnp.int8), p3,
+        dimension_numbers=(((0, 2), (0, 1)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return rows.astype(jnp.uint8)
+
+
+@functools.lru_cache(maxsize=64)
+def _forward_p3_device(layout: RowLayout) -> jnp.ndarray:
+    return jnp.asarray(_forward_plan(layout)[1])
+
+
+@functools.lru_cache(maxsize=64)
+def _inverse_p3_device(layout: RowLayout) -> jnp.ndarray:
+    return jnp.asarray(_inverse_plan(layout)[1])
+
+
+def to_rows_fixed(table: Table, layout: RowLayout) -> jnp.ndarray:
+    """[n, fixed_row_size] uint8 rows via the MXU permutation matmul."""
+    return _to_rows_mxu_jit(table, layout, _forward_p3_device(layout))
+
+
+# ---------------------------------------------------------------------------
+# Decode: [n, fixed_row_size] uint8 -> columns
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _from_rows_mxu_jit(rows2d: jnp.ndarray, layout: RowLayout,
+                       p3: jnp.ndarray):
+    plan, _ = _inverse_plan(layout)
+    o = jax.lax.dot_general(
+        p3, rows2d.astype(jnp.int8),
+        dimension_numbers=(((0,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)                   # [W, 4, n]
+    o = (o.astype(jnp.uint32) & 0xFF)
+    x = (o[:, 0, :] | (o[:, 1, :] << 8)
+         | (o[:, 2, :] << 16) | (o[:, 3, :] << 24))         # [W, n] words
+
+    # validity planes: bit c of its byte, all columns -> packed masks
+    vcols = []
+    for c in range(layout.num_columns):
+        j = c // 8
+        byte = x[plan.validity_word[j]] >> (8 * plan.validity_byte[j])
+        vcols.append(((byte >> (c % 8)) & 1).astype(jnp.bool_))
+    vmask = pack_bools_2d(jnp.stack(vcols, axis=0))          # [ncols, nb]
+
+    cols = []
+    for i, dt in enumerate(layout.dtypes):
+        sz = layout.col_sizes[i]
+        w0 = plan.col_word[i]
+        if sz == 8:
+            pair = jnp.stack([x[w0], x[w0 + 1]], axis=1)     # [n, 2]
+            if jax.config.jax_enable_x64:
+                # [n, 2] u32 -> [n] u64 (trailing dim merges) -> dtype
+                data = jax.lax.bitcast_convert_type(
+                    jax.lax.bitcast_convert_type(pair, jnp.uint64),
+                    dt.np_dtype)
+            else:
+                data = pair
+        elif sz == 4:
+            data = jax.lax.bitcast_convert_type(x[w0], dt.np_dtype)
+        else:
+            word = x[w0] >> (8 * plan.col_byte[i])
+            if sz == 2:
+                data = jax.lax.bitcast_convert_type(
+                    (word & 0xFFFF).astype(jnp.uint16), dt.np_dtype)
+            else:
+                data = (word & 0xFF).astype(jnp.uint8)
+                if dt.np_dtype != np.uint8:
+                    data = jax.lax.bitcast_convert_type(data, dt.np_dtype)
+        cols.append(Column(dt, data, vmask[i]))
+    return cols
+
+
+def from_rows_fixed(rows2d: jnp.ndarray, layout: RowLayout) -> List[Column]:
+    """Decode a [n, fixed_row_size] uint8 row matrix via the transposed
+    MXU permutation."""
+    return _from_rows_mxu_jit(rows2d, layout, _inverse_p3_device(layout))

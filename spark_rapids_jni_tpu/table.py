@@ -107,15 +107,20 @@ ALL_FIXED_WIDTH = (INT8, INT16, INT32, INT64, UINT8, UINT16, UINT32, UINT64,
 
 def pack_bools(valid: jnp.ndarray) -> jnp.ndarray:
     """Pack a bool[n] array into a uint8[ceil(n/8)] LSB-first bitmask."""
-    n = valid.shape[0]
+    return pack_bools_2d(valid[None, :])[0]
+
+
+def pack_bools_2d(valid: jnp.ndarray) -> jnp.ndarray:
+    """Pack bool[m, n] into uint8[m, ceil(n/8)] LSB-first bitmasks — one
+    fused op for all m masks (compile-time: O(1) in m, unlike m calls to
+    :func:`pack_bools`)."""
+    m, n = valid.shape
     nbytes = (n + 7) // 8
-    padded = jnp.zeros((nbytes * 8,), dtype=jnp.uint8).at[:n].set(
+    padded = jnp.zeros((m, nbytes * 8), dtype=jnp.uint8).at[:, :n].set(
         valid.astype(jnp.uint8))
-    bits = padded.reshape(nbytes, 8)
-    weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))
-    # dot in int32 then cast down; uint8 accumulate is fine (max 255)
-    return jnp.sum(bits.astype(jnp.int32) * weights.astype(jnp.int32),
-                   axis=1).astype(jnp.uint8)
+    bits = padded.reshape(m, nbytes, 8)
+    weights = (jnp.int32(1) << jnp.arange(8, dtype=jnp.int32))
+    return jnp.sum(bits.astype(jnp.int32) * weights, axis=2).astype(jnp.uint8)
 
 
 def unpack_bools(mask: jnp.ndarray, n: int) -> jnp.ndarray:

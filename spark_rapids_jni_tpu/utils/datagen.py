@@ -20,7 +20,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from spark_rapids_jni_tpu.table import Column, DType, STRING, Table, pack_bools
+from spark_rapids_jni_tpu.table import (
+    Column, DType, STRING, Table, pack_bools, pack_bools_2d,
+)
 
 DISTRIBUTIONS = ("uniform", "normal", "geometric")
 
@@ -66,26 +68,32 @@ def _int_bounds(dt: DType, profile: DataProfile):
     return lo, hi
 
 
-def _gen_fixed(key, dt: DType, n: int, profile: DataProfile) -> jnp.ndarray:
+def _gen_fixed(key, dt: DType, shape, profile: DataProfile) -> jnp.ndarray:
+    """Random fixed-width values of any shape (``shape`` may be an int for a
+    single column, or ``(g, n)`` for a whole group of ``g`` same-dtype
+    columns generated in one vector op).  64-bit dtypes under no-x64 grow a
+    trailing axis of 2 uint32 words."""
+    if isinstance(shape, int):
+        shape = (shape,)
     np_dt = dt.np_dtype
     wide = np_dt.itemsize == 8 and not jax.config.jax_enable_x64
     if np_dt.kind == "f":
         if np_dt.itemsize == 8 and wide:
             # generate two uint32 words with a float32 pattern in the high
             # word so values are plausible finite doubles
-            bits = jax.random.bits(key, (n, 2), dtype=jnp.uint32)
+            bits = jax.random.bits(key, (*shape, 2), dtype=jnp.uint32)
             # clamp exponent range to avoid inf/nan: zero the top exponent bit
-            hi = bits[:, 1] & jnp.uint32(0xBFEFFFFF)
-            return jnp.stack([bits[:, 0], hi], axis=1)
+            hi = bits[..., 1] & jnp.uint32(0xBFEFFFFF)
+            return jnp.stack([bits[..., 0], hi], axis=-1)
         if profile.distribution == "normal":
             vals = profile.float_mean + profile.float_std * \
-                jax.random.normal(key, (n,), dtype=jnp.float32)
+                jax.random.normal(key, shape, dtype=jnp.float32)
         else:
-            vals = jax.random.uniform(key, (n,), dtype=jnp.float32,
+            vals = jax.random.uniform(key, shape, dtype=jnp.float32,
                                       minval=-1.0, maxval=1.0)
         return vals.astype(np_dt) if not wide else vals
     if dt.kind == "bool8":
-        return jax.random.bernoulli(key, 0.5, (n,)).astype(jnp.uint8)
+        return jax.random.bernoulli(key, 0.5, shape).astype(jnp.uint8)
     lo_set = profile.int_lower is not None
     hi_set = profile.int_upper is not None
     if lo_set or hi_set:
@@ -101,14 +109,14 @@ def _gen_fixed(key, dt: DType, n: int, profile: DataProfile) -> jnp.ndarray:
                     "int bounds for 64-bit columns must fit in int32 "
                     "when x64 is disabled")
             lo, hi = max(lo, i32_lo), min(hi, i32_hi)
-            vals = jax.random.randint(key, (n,), lo, hi + 1,
+            vals = jax.random.randint(key, shape, lo, hi + 1,
                                       dtype=jnp.int32)
             lo_w = jax.lax.bitcast_convert_type(vals, jnp.uint32)
             hi_w = jnp.where(vals < 0, jnp.uint32(0xFFFFFFFF),
                              jnp.uint32(0))
             if np_dt.kind == "u":
                 hi_w = jnp.zeros_like(hi_w)
-            return jnp.stack([lo_w, hi_w], axis=1)
+            return jnp.stack([lo_w, hi_w], axis=-1)
         # randint computes in int64 (x64 on) or int32 (off); clamp both
         # sides — defaulted OR explicit — so maxval=hi+1 fits that dtype
         # (the extreme value of the full range is unreachable when bounded;
@@ -117,17 +125,17 @@ def _gen_fixed(key, dt: DType, n: int, profile: DataProfile) -> jnp.ndarray:
                           else jnp.int32)
         lo = max(lo, int(rinfo.min))
         hi = min(hi, int(rinfo.max) - 1)
-        return jax.random.randint(key, (n,), lo, hi + 1).astype(np_dt)
+        return jax.random.randint(key, shape, lo, hi + 1).astype(np_dt)
     if np_dt.itemsize == 8 and wide:
-        return jax.random.bits(key, (n, 2), dtype=jnp.uint32)
+        return jax.random.bits(key, (*shape, 2), dtype=jnp.uint32)
     if profile.distribution == "geometric":
         # geometric via transformed normal (reference builds geometric from
         # a scaled normal, random_distribution_factory.cuh:86-110)
         _, hi = _int_bounds(dt, profile)
-        mag = jnp.abs(jax.random.normal(key, (n,))) * max(1, hi // 4)
+        mag = jnp.abs(jax.random.normal(key, shape)) * max(1, hi // 4)
         return jnp.clip(mag, 0, hi).astype(np_dt)
     # uniform over the full dtype range via raw random bits
-    bits = jax.random.bits(key, (n,),
+    bits = jax.random.bits(key, shape,
                            dtype=jnp.dtype(f"uint{np_dt.itemsize * 8}"))
     if np_dt.kind == "i":
         return jax.lax.bitcast_convert_type(bits, np_dt)
@@ -137,35 +145,53 @@ def _gen_fixed(key, dt: DType, n: int, profile: DataProfile) -> jnp.ndarray:
 @functools.partial(jax.jit, static_argnums=(1, 2, 3))
 def _gen_table_jit(key, dtypes, num_rows: int, profile: DataProfile):
     """One fused compile for everything except ragged char buffers: all
-    fixed-width data, validity masks, and string lengths/offsets."""
-    datas = []
-    validities = []
-    str_lens = []
+    fixed-width data, validity masks, and string lengths.
+
+    Columns are generated *grouped by dtype* — one vector op of shape
+    ``[group_size, num_rows]`` per distinct dtype — so the HLO program size
+    scales with the number of distinct dtypes, not the number of columns
+    (a 212-column benchmark table compiles like a 7-column one).
+    """
+    ncols = len(dtypes)
+    datas = [None] * ncols
+    validities = [None] * ncols
+    if profile.null_probability is not None:
+        valid = jax.random.bernoulli(
+            jax.random.fold_in(key, 1), 1.0 - profile.null_probability,
+            (ncols, num_rows))
+        packed = pack_bools_2d(valid)
+        validities = [packed[i] for i in range(ncols)]
+
+    groups: dict = {}
     for i, dt in enumerate(dtypes):
-        kcol = jax.random.fold_in(key, i)
-        kdata, knull = jax.random.split(kcol)
-        validity = None
-        if profile.null_probability is not None:
-            valid = jax.random.bernoulli(
-                knull, 1.0 - profile.null_probability, (num_rows,))
-            validity = pack_bools(valid)
-        validities.append(validity)
-        if dt.is_string:
-            klen, _ = jax.random.split(kdata)
-            if profile.avg_string_len:
-                raw = jnp.abs(jax.random.normal(klen, (num_rows,))) \
-                    * profile.avg_string_len
-                lens = jnp.clip(raw.astype(jnp.int32),
-                                profile.string_len_min,
-                                profile.string_len_max)
-            else:
-                lens = jax.random.randint(
-                    klen, (num_rows,), profile.string_len_min,
-                    profile.string_len_max + 1, dtype=jnp.int32)
-            str_lens.append(lens)
-            datas.append(None)
+        groups.setdefault(dt, []).append(i)
+
+    str_lens = []
+    sidx = [i for i, dt in enumerate(dtypes) if dt.is_string]
+    if sidx:
+        klen = jax.random.fold_in(key, 2)
+        shape = (len(sidx), num_rows)
+        if profile.avg_string_len:
+            raw = jnp.abs(jax.random.normal(klen, shape)) \
+                * profile.avg_string_len
+            lens2d = jnp.clip(raw.astype(jnp.int32),
+                              profile.string_len_min,
+                              profile.string_len_max)
         else:
-            datas.append(_gen_fixed(kdata, dt, num_rows, profile))
+            lens2d = jax.random.randint(
+                klen, shape, profile.string_len_min,
+                profile.string_len_max + 1, dtype=jnp.int32)
+        str_lens = [lens2d[j] for j in range(len(sidx))]
+
+    gi = 0
+    for dt, idxs in groups.items():
+        if dt.is_string:
+            continue
+        arr = _gen_fixed(jax.random.fold_in(key, 100 + gi), dt,
+                         (len(idxs), num_rows), profile)
+        gi += 1
+        for j, i in enumerate(idxs):
+            datas[i] = arr[j]
     return datas, validities, str_lens
 
 
@@ -173,6 +199,22 @@ def _gen_table_jit(key, dtypes, num_rows: int, profile: DataProfile):
 def _gen_chars_jit(key, total: int):
     return jax.random.randint(key, (total,), 97, 123,
                               dtype=jnp.int32).astype(jnp.uint8)
+
+
+@jax.jit
+def _string_offsets_jit(lens2d: jnp.ndarray) -> jnp.ndarray:
+    """[m, n] int32 lengths -> [m, n+1] int32 offsets, all on device (one
+    D2H transfer for every string column instead of one sync each)."""
+    m = lens2d.shape[0]
+    cums = jnp.cumsum(lens2d, axis=1, dtype=jnp.int32)
+    return jnp.concatenate([jnp.zeros((m, 1), jnp.int32), cums], axis=1)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _split_chars_jit(chars: jnp.ndarray, starts, sizes):
+    """Slice one shared char pool into per-column buffers (static sizes)."""
+    return [jax.lax.slice(chars, (s,), (s + z,))
+            for s, z in zip(starts, sizes)]
 
 
 def create_random_table(dtypes: Sequence[DType], num_rows: int,
@@ -190,17 +232,23 @@ def create_random_table(dtypes: Sequence[DType], num_rows: int,
     key = jax.random.PRNGKey(profile.seed if seed is None else seed)
     datas, validities, str_lens = _gen_table_jit(key, dtypes, num_rows,
                                                  profile)
+    char_slices = []
+    offsets_np = None
+    if str_lens:
+        # one D2H sync for all ragged sizes, one char pool, one split compile
+        offsets_np = np.asarray(_string_offsets_jit(jnp.stack(str_lens)))
+        totals = offsets_np[:, -1].astype(np.int64)
+        starts = np.concatenate([[0], np.cumsum(totals)[:-1]])
+        pool = _gen_chars_jit(jax.random.fold_in(key, 3), int(totals.sum()))
+        char_slices = _split_chars_jit(pool, tuple(int(s) for s in starts),
+                                       tuple(int(t) for t in totals))
     cols = []
     si = 0
     for i, dt in enumerate(dtypes):
         if dt.is_string:
-            lens = np.asarray(str_lens[si])
-            offsets = np.zeros(num_rows + 1, dtype=np.int32)
-            np.cumsum(lens, out=offsets[1:])
-            total = int(offsets[-1])
-            chars = _gen_chars_jit(jax.random.fold_in(key, 10_000 + i), total)
             cols.append(Column(dt, jnp.zeros((0,), jnp.uint8),
-                               validities[i], jnp.asarray(offsets), chars))
+                               validities[i], jnp.asarray(offsets_np[si]),
+                               char_slices[si]))
             si += 1
         else:
             cols.append(Column(dt, datas[i], validities[i]))
