@@ -39,7 +39,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_jni_tpu.table import (
-    Column, DType, Table, pack_bools, unpack_bools,
+    Column, DType, Table, pack_bools, pack_bools_2d, slice_table,
+    unpack_bools,
 )
 from spark_rapids_jni_tpu.ops.row_layout import (
     JCUDF_ROW_ALIGNMENT, MAX_BATCH_BYTES, RowLayout, compute_row_layout,
@@ -205,8 +206,12 @@ def _assemble_fixed_rows(table: Table, layout: RowLayout) -> jnp.ndarray:
     return body
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
-def _to_rows_fixed_jit(table: Table, layout: RowLayout) -> jnp.ndarray:
+@functools.partial(jax.jit, static_argnums=(1, 3))
+def _to_rows_fixed_jit(table: Table, layout: RowLayout,
+                       start=0, size=None) -> jnp.ndarray:
+    from spark_rapids_jni_tpu.table import slice_table_dynamic
+    if size is not None and size != table.num_rows:
+        table = slice_table_dynamic(table, start, size)
     return _assemble_fixed_rows(table, layout)
 
 
@@ -361,16 +366,42 @@ def convert_to_rows(table: Table, *, size_limit: int = MAX_BATCH_BYTES,
         return _to_rows_variable(table, layout, size_limit)
     platform = _platform_of(table)
     impl = _resolve_impl(impl, use_pallas, platform)
-    if impl == "pallas":
-        from spark_rapids_jni_tpu.ops import row_kernels
-        rows2d = row_kernels.to_rows_fixed(table, layout,
-                                           interpret=platform != "tpu")
-    elif impl == "mxu":
-        from spark_rapids_jni_tpu.ops import row_mxu
-        rows2d = row_mxu.to_rows_fixed(table, layout)
-    else:
-        rows2d = _to_rows_fixed_jit(table, layout)
-    return _batch_rows2d(rows2d, layout, size_limit)
+
+    def encode(start=0, size=None):
+        if impl == "pallas":
+            from spark_rapids_jni_tpu.ops import row_kernels
+            tbl = (table if size is None
+                   else _slice_table(table, start, start + size))
+            return row_kernels.to_rows_fixed(tbl, layout,
+                                             interpret=platform != "tpu")
+        if impl == "mxu":
+            from spark_rapids_jni_tpu.ops import row_mxu
+            return row_mxu.to_rows_fixed(table, layout, start, size)
+        return _to_rows_fixed_jit(table, layout, jnp.int32(start), size)
+
+    # one batching policy: conversion transients are bounded at <=1GB per
+    # encode even when the caller's size_limit would allow bigger batches
+    n = table.num_rows
+    chunk = min(size_limit, 1 << 30)
+    if len(plan_fixed_batches(n, layout.fixed_row_size, chunk)) == 1:
+        return _batch_rows2d(encode(), layout, size_limit)
+    # multi-batch: encode per batch (sliced inside the jit with a traced
+    # start) so peak memory stays ~one batch of transients, the way the
+    # reference converts per row-batch (row_conversion.cu:1768-1830).
+    # Batches are equal-sized (32-row aligned, <=chunk) so that every full
+    # batch reuses ONE compiled program and transients + held outputs +
+    # the input table fit HBM together.
+    nb = -(-n * layout.fixed_row_size // chunk)
+    per = min((-(-n // nb) + 31) // 32 * 32,
+              chunk // layout.fixed_row_size // 32 * 32)
+    out = []
+    for start in range(0, n, per):
+        size = min(per, n - start)
+        rows2d = encode(start, size)
+        offsets = jnp.arange(size + 1,
+                             dtype=jnp.int32) * layout.fixed_row_size
+        out.append(RowsColumn(rows2d.reshape(-1), offsets))
+    return out
 
 
 @func_range()
@@ -443,14 +474,11 @@ def _to_rows_variable(table: Table, layout: RowLayout,
         offsets = np.zeros(end - start + 1, dtype=np.int32)
         np.cumsum(sizes, out=offsets[1:])
         total_bytes = int(offsets[-1])
-        char_slices = []
-        char_totals = []
-        for c, offs in zip(scol, scol_offsets_np):
-            lo, hi = int(offs[start]), int(offs[end])
-            char_slices.append(jax.lax.dynamic_slice(
-                c.chars, (lo,), (hi - lo,)) if hi > lo
-                else jnp.zeros((0,), jnp.uint8))
-            char_totals.append(hi - lo)
+        los = tuple(int(offs[start]) for offs in scol_offsets_np)
+        char_totals = tuple(int(offs[end]) - lo
+                            for offs, lo in zip(scol_offsets_np, los))
+        char_slices = _slice_chars_batch_jit(
+            [c.chars for c in scol], los, char_totals) if scol else []
         sub = _slice_table(table, start, end)
         data = _to_rows_variable_jit(
             sub, jnp.asarray(offsets), tuple(char_totals), char_slices,
@@ -459,19 +487,7 @@ def _to_rows_variable(table: Table, layout: RowLayout,
     return out
 
 
-def _slice_table(table: Table, start: int, end: int) -> Table:
-    cols = []
-    for c in table.columns:
-        validity = None
-        if c.validity is not None:
-            validity = pack_bools(unpack_bools(c.validity, c.num_rows)[start:end])
-        if c.dtype.is_string:
-            # keep offsets absolute; the jit path rebases against offsets[start]
-            cols.append(Column(c.dtype, c.data, validity,
-                               c.offsets[start:end + 1], c.chars))
-        else:
-            cols.append(Column(c.dtype, c.data[start:end], validity))
-    return Table(tuple(cols))
+_slice_table = functools.partial(jax.jit, static_argnums=(1, 2))(slice_table)
 
 
 @functools.partial(jax.jit, static_argnums=(2, 4, 5))
@@ -479,6 +495,15 @@ def _to_rows_variable_jit(table: Table, row_offsets: jnp.ndarray,
                           char_totals: Tuple[int, ...],
                           char_slices: List[jnp.ndarray],
                           layout: RowLayout, total_bytes: int) -> jnp.ndarray:
+    """Assemble one batch of variable-width rows.
+
+    The blob is built in uint32 *word* space: the fixed sections scatter as
+    whole words (row offsets and ``fixed_end`` are 4-byte aligned), so the
+    index matrix is 4x smaller than a byte-granular scatter — the
+    difference between fitting in HBM and OOM on wide 1M-row tables.  Char
+    bytes scatter-ADD into their word at a byte-lane shift; all writers of
+    a word touch disjoint lanes, so the adds reassemble exact bytes.
+    """
     n = table.num_rows
     scols = _string_cols(table)
     nvar = len(scols)
@@ -495,13 +520,24 @@ def _to_rows_variable_jit(table: Table, row_offsets: jnp.ndarray,
         pairs.append(jnp.stack([str_row_off[:, si].astype(jnp.uint32),
                                 lens[:, si].astype(jnp.uint32)], axis=1))
     F = _assemble_fixed_variable(table, pairs, layout)    # [n, fixed_end]
+    fe_pad = (layout.fixed_end + 3) // 4 * 4
+    if fe_pad != layout.fixed_end:  # pad to whole words (fe is 1-byte gran.)
+        F = jnp.concatenate(
+            [F, jnp.zeros((n, fe_pad - layout.fixed_end), jnp.uint8)], axis=1)
+    # bytes -> words by strided lane slices (a bitcast's [n, fe/4, 4]
+    # intermediate would pad its 4-lane minor dim 32x and OOM)
+    f_words = (F[:, 0::4].astype(jnp.uint32)
+               | (F[:, 1::4].astype(jnp.uint32) << 8)
+               | (F[:, 2::4].astype(jnp.uint32) << 16)
+               | (F[:, 3::4].astype(jnp.uint32) << 24))    # [n, fe/4]
 
-    out = jnp.zeros((total_bytes,), dtype=jnp.uint8)
-    # scatter fixed sections
-    dst = row_offsets[:-1, None] + jnp.arange(layout.fixed_end,
-                                              dtype=jnp.int32)[None, :]
-    out = out.at[dst.reshape(-1)].set(F.reshape(-1))
-    # scatter chars, one repeat+scatter per string column
+    nwords = total_bytes // 4                              # rows 8B-aligned
+    out = jnp.zeros((nwords,), dtype=jnp.uint32)
+    dst_w = (row_offsets[:-1, None] // 4
+             + jnp.arange(fe_pad // 4, dtype=jnp.int32)[None, :])
+    out = out.at[dst_w.reshape(-1)].set(f_words.reshape(-1))
+    # chars: word index + byte-lane shift, scatter-add per string column.
+    # (fixed_end may not be 4-aligned, but rows are: dst_pos is exact.)
     for si, (c, total) in enumerate(zip(scols, char_totals)):
         if total == 0:
             continue
@@ -511,8 +547,11 @@ def _to_rows_variable_jit(table: Table, row_offsets: jnp.ndarray,
                              total_repeat_length=total)
         intra = jnp.arange(total, dtype=jnp.int32) - cum[row_ids]
         dst_pos = row_offsets[row_ids] + str_row_off[row_ids, si] + intra
-        out = out.at[dst_pos].set(char_slices[si])
-    return out
+        vals = char_slices[si].astype(jnp.uint32) \
+            << (8 * (dst_pos % 4)).astype(jnp.uint32)
+        out = out.at[dst_pos // 4].add(vals)
+    from spark_rapids_jni_tpu.ops import row_mxu
+    return row_mxu.words_to_bytes(out, total_bytes)
 
 
 def _assemble_fixed_variable(table: Table, pairs: List[jnp.ndarray],
@@ -542,52 +581,120 @@ def _assemble_fixed_variable(table: Table, pairs: List[jnp.ndarray],
 
 
 def _from_rows_variable(rows: RowsColumn, layout: RowLayout) -> Table:
-    F, validities = _extract_fixed_variable_jit(rows.data, rows.offsets,
-                                                layout)
-    # per-string-column host sync of char totals (reference syncs per column
-    # at row_conversion.cu:2215)
+    # everything except the (data-dependent-size) char gathers happens in
+    # ONE compiled program: per-column eager dispatch would cost hundreds
+    # of runtime round-trips on a remote-tunnel backend
+    datas, masks, f_words, str_lens = _extract_fixed_variable_jit(
+        rows.data, rows.offsets, layout)
+    # ONE host sync for all string columns' char totals (the reference
+    # syncs once per column at row_conversion.cu:2215; batching the sync
+    # and the gather compile makes the data-dependent-shape cost O(1) in
+    # the number of string columns)
+    totals = tuple(
+        int(x) for x in np.asarray(
+            _str_totals_jit(str_lens))) if str_lens else ()
+    str_parts = _gather_all_strings_jit(
+        rows.data, rows.offsets, f_words, tuple(layout.variable_starts),
+        str_lens, totals) if str_lens else []
     cols = []
+    si = 0
     for i, dt in enumerate(layout.dtypes):
-        s = layout.col_starts[i]
-        valid = validities[:, i]
-        validity = pack_bools(valid)
         if dt.is_string:
-            pair_bytes = F[:, s:s + 8].reshape(-1, 2, 4)
-            pair = jax.lax.bitcast_convert_type(pair_bytes, jnp.uint32)
-            str_off = pair[:, 0].astype(jnp.int32)
-            str_len = pair[:, 1].astype(jnp.int32)
-            lens_np = np.asarray(str_len)
-            total = int(lens_np.sum())
-            chars, offsets = _gather_strings_jit(
-                rows.data, rows.offsets, str_off, str_len, total)
-            cols.append(Column(dt, jnp.zeros((0,), jnp.uint8), validity,
+            chars, offsets = str_parts[si]
+            si += 1
+            cols.append(Column(dt, jnp.zeros((0,), jnp.uint8), masks[i],
                                offsets, chars))
         else:
-            sz = layout.col_sizes[i]
-            data = bytes_to_col(F[:, s:s + sz], dt.np_dtype)
-            cols.append(Column(dt, data, validity))
+            cols.append(Column(dt, datas[i], masks[i]))
     return Table(tuple(cols))
+
+
+@jax.jit
+def _str_totals_jit(str_lens):
+    return jnp.stack([jnp.sum(l) for l in str_lens])
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _slice_chars_batch_jit(chars_list, los, sizes):
+    """Slice every string column's char range for one batch in a single
+    compiled program (per-column eager slicing costs a runtime round-trip
+    each on remote-tunnel backends)."""
+    return [jax.lax.dynamic_slice(c, (lo,), (sz,)) if sz
+            else jnp.zeros((0,), jnp.uint8)
+            for c, lo, sz in zip(chars_list, los, sizes)]
+
+
+@functools.partial(jax.jit, static_argnums=(3, 5))
+def _gather_all_strings_jit(data, row_offsets, f_words, var_starts,
+                            str_lens, totals):
+    """Gather every string column's chars in one compiled program."""
+    out = []
+    for si, s in enumerate(var_starts):
+        str_off = f_words[:, s // 4].astype(jnp.int32)
+        out.append(_gather_one_string(data, row_offsets, str_off,
+                                      str_lens[si], totals[si]))
+    return out
+
+
+def _col_from_words(f_words: jnp.ndarray, s: int, dt: DType):
+    """Extract one fixed-width column from per-row uint32 words (byte
+    offset ``s`` in the row; fields are size-aligned by the layout)."""
+    sz = dt.itemsize
+    w0 = s // 4
+    if sz == 8:
+        pair = f_words[:, w0:w0 + 2]
+        if jax.config.jax_enable_x64:
+            return jax.lax.bitcast_convert_type(
+                jax.lax.bitcast_convert_type(pair, jnp.uint64), dt.np_dtype)
+        return pair
+    if sz == 4:
+        return jax.lax.bitcast_convert_type(f_words[:, w0], dt.np_dtype)
+    word = f_words[:, w0] >> (8 * (s % 4))
+    if sz == 2:
+        return jax.lax.bitcast_convert_type(
+            (word & 0xFFFF).astype(jnp.uint16), dt.np_dtype)
+    data = (word & 0xFF).astype(jnp.uint8)
+    if dt.np_dtype != np.uint8:
+        data = jax.lax.bitcast_convert_type(data, dt.np_dtype)
+    return data
 
 
 @functools.partial(jax.jit, static_argnums=(2,))
 def _extract_fixed_variable_jit(data: jnp.ndarray, offsets: jnp.ndarray,
                                 layout: RowLayout):
-    n = offsets.shape[0] - 1
-    idx = offsets[:-1, None] + jnp.arange(layout.fixed_end,
-                                          dtype=jnp.int32)[None, :]
-    F = data[idx]
-    vbytes = F[:, layout.validity_offset:
-               layout.validity_offset + layout.validity_bytes]
-    valid = jnp.stack(
-        [((vbytes[:, i // 8] >> (i % 8)) & 1).astype(jnp.bool_)
-         for i in range(layout.num_columns)], axis=1)
-    return F, valid
+    """Gather per-row fixed sections as uint32 words ([n, fe_pad/4]; a
+    4x smaller index matrix than byte gathers, and no u8[*, 4] tiled
+    intermediates), then extract every column's data and packed validity
+    mask in the same program."""
+    fe_pad = (layout.fixed_end + 3) // 4 * 4
+    nwords = data.shape[0] // 4
+    from spark_rapids_jni_tpu.ops import row_mxu
+    # whole-blob word conversion runs on the MXU at matmul speed, so
+    # converting the (unused) char bytes too is cheap; the alternative —
+    # four byte-plane gathers of just the fixed sections — quadruples the
+    # gather element count, and gathers are the slow primitive here
+    words = row_mxu.bytes_to_words(data, nwords)
+    idx_w = (offsets[:-1, None] // 4
+             + jnp.arange(fe_pad // 4, dtype=jnp.int32)[None, :])
+    f_words = words[jnp.minimum(idx_w, max(nwords - 1, 0))]
+    valid_cols = []
+    for i in range(layout.num_columns):
+        j = layout.validity_offset + i // 8
+        byte = (f_words[:, j // 4] >> (8 * (j % 4))) & 0xFF
+        valid_cols.append(((byte >> (i % 8)) & 1).astype(jnp.bool_))
+    vmask = pack_bools_2d(jnp.stack(valid_cols, axis=0))    # [ncols, nb]
+    masks = [vmask[i] for i in range(layout.num_columns)]
+    datas = [None if dt.is_string
+             else _col_from_words(f_words, layout.col_starts[i], dt)
+             for i, dt in enumerate(layout.dtypes)]
+    str_lens = [(f_words[:, s // 4 + 1].astype(jnp.int32))
+                for s in layout.variable_starts]
+    return datas, masks, f_words, str_lens
 
 
-@functools.partial(jax.jit, static_argnums=(4,))
-def _gather_strings_jit(data: jnp.ndarray, row_offsets: jnp.ndarray,
-                        str_off: jnp.ndarray, str_len: jnp.ndarray,
-                        total: int):
+def _gather_one_string(data: jnp.ndarray, row_offsets: jnp.ndarray,
+                       str_off: jnp.ndarray, str_len: jnp.ndarray,
+                       total: int):
     n = str_len.shape[0]
     offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
                                jnp.cumsum(str_len).astype(jnp.int32)])

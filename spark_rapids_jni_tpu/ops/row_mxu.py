@@ -213,19 +213,34 @@ def _pack_planes(table: Table, layout: RowLayout, plan: WordPlan,
 # Encode: table -> [n, fixed_row_size] uint8
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnums=(1,))
-def _to_rows_mxu_jit(table: Table, layout: RowLayout,
-                     p3: jnp.ndarray) -> jnp.ndarray:
+# The int8 dots accumulate in int32: an unfused convert materializes a
+# temp 4x the byte blob.  Every dot therefore processes rows in slabs
+# (python-unrolled inside the trace) so the i32 temp stays ~1GB and XLA's
+# in-order liveness frees each slab before the next.
+_DOT_CHUNK_ROWS = 512 * 1024
+
+
+@functools.partial(jax.jit, static_argnums=(1, 4))
+def _to_rows_mxu_jit(table: Table, layout: RowLayout, p3: jnp.ndarray,
+                     start=0, size=None) -> jnp.ndarray:
+    from spark_rapids_jni_tpu.table import slice_table_dynamic
+    if size is not None and size != table.num_rows:
+        table = slice_table_dynamic(table, start, size)
     plan, _ = _forward_plan(layout)
     valid_units = [_as_u32(table.column(c).valid_bools())
                    for c in range(layout.num_columns)]
     xt = _pack_planes(table, layout, plan, valid_units)    # [W, n] u32
-    xb = jax.lax.bitcast_convert_type(xt, jnp.uint8)       # [W, n, 4] lazy
-    rows = jax.lax.dot_general(
-        xb.astype(jnp.int8), p3,
-        dimension_numbers=(((0, 2), (0, 1)), ((), ())),
-        preferred_element_type=jnp.int32)
-    return rows.astype(jnp.uint8)
+    n = xt.shape[1]
+    parts = []
+    for s in range(0, max(n, 1), _DOT_CHUNK_ROWS):
+        e = min(n, s + _DOT_CHUNK_ROWS)
+        xb = jax.lax.bitcast_convert_type(xt[:, s:e], jnp.uint8)
+        rows = jax.lax.dot_general(
+            xb.astype(jnp.int8), p3,
+            dimension_numbers=(((0, 2), (0, 1)), ((), ())),
+            preferred_element_type=jnp.int32)
+        parts.append(rows.astype(jnp.uint8))
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
 
 
 @functools.lru_cache(maxsize=64)
@@ -238,9 +253,14 @@ def _inverse_p3_device(layout: RowLayout) -> jnp.ndarray:
     return jnp.asarray(_inverse_plan(layout)[1])
 
 
-def to_rows_fixed(table: Table, layout: RowLayout) -> jnp.ndarray:
-    """[n, fixed_row_size] uint8 rows via the MXU permutation matmul."""
-    return _to_rows_mxu_jit(table, layout, _forward_p3_device(layout))
+def to_rows_fixed(table: Table, layout: RowLayout,
+                  start: int = 0, size=None) -> jnp.ndarray:
+    """[n, fixed_row_size] uint8 rows via the MXU permutation matmul.
+    ``start``/``size`` encode one row-batch, slicing inside the jit (the
+    sub-table is never materialized; ``start`` is traced so equally-sized
+    batches share one executable)."""
+    return _to_rows_mxu_jit(table, layout, _forward_p3_device(layout),
+                            jnp.int32(start), size)
 
 
 # ---------------------------------------------------------------------------
@@ -251,13 +271,18 @@ def to_rows_fixed(table: Table, layout: RowLayout) -> jnp.ndarray:
 def _from_rows_mxu_jit(rows2d: jnp.ndarray, layout: RowLayout,
                        p3: jnp.ndarray):
     plan, _ = _inverse_plan(layout)
-    o = jax.lax.dot_general(
-        p3, rows2d.astype(jnp.int8),
-        dimension_numbers=(((0,), (1,)), ((), ())),
-        preferred_element_type=jnp.int32)                   # [W, 4, n]
-    o = (o.astype(jnp.uint32) & 0xFF)
-    x = (o[:, 0, :] | (o[:, 1, :] << 8)
-         | (o[:, 2, :] << 16) | (o[:, 3, :] << 24))         # [W, n] words
+    n = rows2d.shape[0]
+    parts = []
+    for s in range(0, max(n, 1), _DOT_CHUNK_ROWS):
+        e = min(n, s + _DOT_CHUNK_ROWS)
+        o = jax.lax.dot_general(
+            p3, rows2d[s:e].astype(jnp.int8),
+            dimension_numbers=(((0,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)               # [W, 4, ck]
+        o = (o.astype(jnp.uint32) & 0xFF)
+        parts.append(o[:, 0, :] | (o[:, 1, :] << 8)
+                     | (o[:, 2, :] << 16) | (o[:, 3, :] << 24))
+    x = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
 
     # validity planes: bit c of its byte, all columns -> packed masks
     vcols = []
@@ -299,3 +324,83 @@ def from_rows_fixed(rows2d: jnp.ndarray, layout: RowLayout) -> List[Column]:
     """Decode a [n, fixed_row_size] uint8 row matrix via the transposed
     MXU permutation."""
     return _from_rows_mxu_jit(rows2d, layout, _inverse_p3_device(layout))
+
+
+# ---------------------------------------------------------------------------
+# uint32 words <-> uint8 bytes, on the MXU
+# ---------------------------------------------------------------------------
+#
+# A TPU-tiled ``u8[*, 4]`` array (the shape ``bitcast_convert_type``
+# produces) pads its 4-lane minor dimension to 128 lanes — a 32x memory
+# blowup that OOMs on GB-scale blobs.  A bitcast is only safe when it is
+# *consumed by a dot* (fused into the MXU operand load, never materialized),
+# so the byte<->word conversions are themselves expressed as identity
+# permutation matmuls.
+
+_WB = 128  # words per dot row
+
+
+@functools.lru_cache(maxsize=2)
+def _w2b_p3_np() -> np.ndarray:
+    p = np.zeros((_WB, 4, _WB * 4), dtype=np.int8)
+    for w in range(_WB):
+        for k in range(4):
+            p[w, k, 4 * w + k] = 1
+    return p
+
+
+@functools.lru_cache(maxsize=2)
+def _b2w_p3_np() -> np.ndarray:
+    p = np.zeros((_WB * 4, _WB, 4), dtype=np.int8)
+    for w in range(_WB):
+        for k in range(4):
+            p[4 * w + k, w, k] = 1
+    return p
+
+
+def words_to_bytes(w: jnp.ndarray, total: int) -> jnp.ndarray:
+    """uint32[nw] -> little-endian uint8[total] (total <= 4*nw).
+
+    Call under jit; the permutation matrix inlines as a constant (only
+    numpy is cached, so no tracer can leak between traces).
+    """
+    if total == 0:
+        return jnp.zeros((0,), jnp.uint8)
+    pad = (-w.shape[0]) % _WB
+    if pad:
+        w = jnp.concatenate([w, jnp.zeros((pad,), jnp.uint32)])
+    w2 = w.reshape(-1, _WB)
+    p3 = jnp.asarray(_w2b_p3_np())
+    parts = []
+    for s in range(0, w2.shape[0], _DOT_CHUNK_ROWS):
+        e = min(w2.shape[0], s + _DOT_CHUNK_ROWS)
+        xb = jax.lax.bitcast_convert_type(w2[s:e], jnp.uint8)
+        parts.append(jax.lax.dot_general(
+            xb.astype(jnp.int8), p3,
+            dimension_numbers=(((1, 2), (0, 1)), ((), ())),
+            preferred_element_type=jnp.int32).astype(jnp.uint8))
+    out = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+    return out.reshape(-1)[:total]
+
+
+def bytes_to_words(b: jnp.ndarray, nwords: int) -> jnp.ndarray:
+    """little-endian uint8[nb] -> uint32[nwords] (nwords <= ceil(nb/4)).
+    Call under jit (see :func:`words_to_bytes`)."""
+    if nwords == 0:
+        return jnp.zeros((0,), jnp.uint32)
+    pad = (-b.shape[0]) % (4 * _WB)
+    if pad:
+        b = jnp.concatenate([b, jnp.zeros((pad,), jnp.uint8)])
+    b2 = b.reshape(-1, 4 * _WB)
+    p3 = jnp.asarray(_b2w_p3_np())
+    parts = []
+    for s in range(0, b2.shape[0], _DOT_CHUNK_ROWS):
+        e = min(b2.shape[0], s + _DOT_CHUNK_ROWS)
+        o = jax.lax.dot_general(
+            b2[s:e].astype(jnp.int8), p3,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)              # [ck, _WB, 4]
+        parts.append(jax.lax.bitcast_convert_type(
+            o.astype(jnp.uint8), jnp.uint32))
+    w = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+    return w.reshape(-1)[:nwords]

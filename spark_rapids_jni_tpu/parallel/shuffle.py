@@ -62,6 +62,36 @@ class ShuffleResult:
         return cls(*children)
 
 
+def _pack_buckets(rows2d, pids, num_parts: int, capacity: int):
+    """Sort rows by destination partition into ``[P, capacity, width]``
+    send buckets; returns (send, send_counts, overflow_local)."""
+    n_local = rows2d.shape[0]
+    rs = rows2d.shape[1]
+    order = jnp.argsort(pids, stable=True)
+    pids_sorted = pids[order]
+    rows_sorted = rows2d[order]
+    counts = jnp.bincount(pids, length=num_parts).astype(jnp.int32)
+    starts = jnp.cumsum(counts) - counts
+    rank = jnp.arange(n_local, dtype=jnp.int32) - starts[pids_sorted]
+    overflow_local = jnp.any(counts > capacity)
+    rank = jnp.minimum(rank, capacity - 1)  # clamp (flagged overflow)
+    send = jnp.zeros((num_parts, capacity, rs), rows2d.dtype)
+    send = send.at[pids_sorted, rank].set(rows_sorted)
+    return send, jnp.minimum(counts, capacity), overflow_local
+
+
+def _finish_exchange(recv, recv_counts, overflow_local,
+                     num_parts: int, capacity: int, axis_name: str):
+    """Shared epilogue: slot-validity mask, valid count, global overflow."""
+    rs = recv.shape[-1]
+    slot = jax.lax.broadcasted_iota(jnp.int32, (num_parts, capacity), 1)
+    valid = slot < recv_counts[:, None]
+    num_valid = jnp.sum(recv_counts)
+    overflow = jax.lax.pmax(overflow_local, axis_name)
+    return (recv.reshape(num_parts * capacity, rs),
+            valid.reshape(-1), num_valid, overflow)
+
+
 def bucket_exchange(num_parts: int, capacity: int, axis_name: str):
     """Per-device all-to-all bucket exchange body (run under shard_map).
 
@@ -73,34 +103,59 @@ def bucket_exchange(num_parts: int, capacity: int, axis_name: str):
     """
 
     def body(rows2d, pids):
-        n_local = rows2d.shape[0]
-        rs = rows2d.shape[1]
-        # stable sort rows by destination partition
-        order = jnp.argsort(pids, stable=True)
-        pids_sorted = pids[order]
-        rows_sorted = rows2d[order]
-        counts = jnp.bincount(pids, length=num_parts).astype(jnp.int32)
-        starts = jnp.cumsum(counts) - counts
-        rank = jnp.arange(n_local, dtype=jnp.int32) - starts[pids_sorted]
-        overflow_local = jnp.any(counts > capacity)
-        rank = jnp.minimum(rank, capacity - 1)  # clamp (flagged overflow)
-        send = jnp.zeros((num_parts, capacity, rs), rows2d.dtype)
-        send = send.at[pids_sorted, rank].set(rows_sorted)
-        send_counts = jnp.minimum(counts, capacity)
-
+        send, send_counts, overflow_local = _pack_buckets(
+            rows2d, pids, num_parts, capacity)
         recv = jax.lax.all_to_all(send, axis_name, split_axis=0,
                                   concat_axis=0, tiled=False)
         recv_counts = jax.lax.all_to_all(
             send_counts.reshape(num_parts, 1), axis_name,
             split_axis=0, concat_axis=0, tiled=False).reshape(num_parts)
+        return _finish_exchange(recv, recv_counts, overflow_local,
+                                num_parts, capacity, axis_name)
 
-        slot = jax.lax.broadcasted_iota(jnp.int32,
-                                        (num_parts, capacity), 1)
-        valid = slot < recv_counts[:, None]
-        num_valid = jnp.sum(recv_counts)
-        overflow = jax.lax.pmax(overflow_local, axis_name)
-        return (recv.reshape(num_parts * capacity, rs),
-                valid.reshape(-1), num_valid, overflow)
+    return body
+
+
+def ring_bucket_exchange(num_parts: int, capacity: int, axis_name: str):
+    """Ring variant of :func:`bucket_exchange`: the all-to-all is decomposed
+    into ``P - 1`` shifted ``ppermute`` steps (step ``s`` sends each
+    device's bucket for ``d + s`` directly to ``d + s``).
+
+    Total bytes on the wire match the fused all-to-all, but only ONE bucket
+    is in flight per device per step instead of ``P`` — the right shape
+    when buckets are large (long rows / long sequences) and the fused
+    exchange buffer would not fit.  This is the same decomposition ring
+    attention applies to sequence-parallel KV exchange; XLA overlaps each
+    ppermute with the next step's pack on ICI.
+    """
+
+    def body(rows2d, pids):
+        send, send_counts, overflow_local = _pack_buckets(
+            rows2d, pids, num_parts, capacity)
+        d = jax.lax.axis_index(axis_name)
+        recv = jnp.zeros_like(send)
+        recv_counts = jnp.zeros((num_parts,), jnp.int32)
+        # self bucket stays local
+        recv = jax.lax.dynamic_update_index_in_dim(
+            recv, jax.lax.dynamic_index_in_dim(send, d, 0), d, 0)
+        recv_counts = recv_counts.at[d].set(send_counts[d])
+
+        # python-unrolled: ppermute's permutation must be static, and the
+        # step count (P - 1) is a mesh constant
+        for s in range(1, num_parts):
+            perm = [(i, (i + s) % num_parts) for i in range(num_parts)]
+            tgt = (d + s) % num_parts
+            blk = jax.lax.dynamic_index_in_dim(send, tgt, 0)
+            cnt = jax.lax.dynamic_index_in_dim(send_counts, tgt, 0)
+            got = jax.lax.ppermute(blk, axis_name, perm)
+            got_cnt = jax.lax.ppermute(cnt, axis_name, perm)
+            src = (d - s) % num_parts
+            recv = jax.lax.dynamic_update_index_in_dim(recv, got, src, 0)
+            recv_counts = jax.lax.dynamic_update_slice(
+                recv_counts, got_cnt, (src,))
+
+        return _finish_exchange(recv, recv_counts, overflow_local,
+                                num_parts, capacity, axis_name)
 
     return body
 
@@ -108,7 +163,8 @@ def bucket_exchange(num_parts: int, capacity: int, axis_name: str):
 def shuffle_table_sharded(table: Table, key_cols: Sequence[int],
                           mesh: Mesh, axis_name: str = "data",
                           capacity_factor: float = 2.0,
-                          seed: int = 42) -> ShuffleResult:
+                          seed: int = 42,
+                          method: str = "all_to_all") -> ShuffleResult:
     """Hash-partition a row-sharded fixed-width table across the mesh axis.
 
     Returns per-device padded JCUDF rows; decode with
@@ -122,6 +178,11 @@ def shuffle_table_sharded(table: Table, key_cols: Sequence[int],
     n_local = table.num_rows // num_parts
     capacity = max(8, int(n_local / num_parts * capacity_factor))
 
+    if method not in ("all_to_all", "ring"):
+        raise ValueError(f"unknown shuffle method {method!r}")
+    make_body = (ring_bucket_exchange if method == "ring"
+                 else bucket_exchange)
+
     spec = P(axis_name)
     rep = P()
 
@@ -134,7 +195,7 @@ def shuffle_table_sharded(table: Table, key_cols: Sequence[int],
         rows2d = rc._assemble_fixed_rows(tbl, layout)
         pids = hash_partition_ids(
             [tbl.columns[i] for i in key_cols], num_parts, seed)
-        body = bucket_exchange(num_parts, capacity, axis_name)
+        body = make_body(num_parts, capacity, axis_name)
         rows, valid, num_valid, overflow = body(rows2d, pids)
         return rows, valid, num_valid[None], overflow[None]
 

@@ -113,14 +113,21 @@ def pack_bools(valid: jnp.ndarray) -> jnp.ndarray:
 def pack_bools_2d(valid: jnp.ndarray) -> jnp.ndarray:
     """Pack bool[m, n] into uint8[m, ceil(n/8)] LSB-first bitmasks — one
     fused op for all m masks (compile-time: O(1) in m, unlike m calls to
-    :func:`pack_bools`)."""
+    :func:`pack_bools`).
+
+    Implemented with 8 strided lane slices rather than a reshape to
+    ``[m, nbytes, 8]``: TPU tiling pads an 8-lane minor dimension to 128
+    lanes (16x memory), strided slices stay dense."""
     m, n = valid.shape
     nbytes = (n + 7) // 8
-    padded = jnp.zeros((m, nbytes * 8), dtype=jnp.uint8).at[:, :n].set(
-        valid.astype(jnp.uint8))
-    bits = padded.reshape(m, nbytes, 8)
-    weights = (jnp.int32(1) << jnp.arange(8, dtype=jnp.int32))
-    return jnp.sum(bits.astype(jnp.int32) * weights, axis=2).astype(jnp.uint8)
+    pad = nbytes * 8 - n
+    v = valid.astype(jnp.uint8)
+    if pad:
+        v = jnp.concatenate([v, jnp.zeros((m, pad), jnp.uint8)], axis=1)
+    out = v[:, 0::8]
+    for j in range(1, 8):
+        out = out | (v[:, j::8] << j)
+    return out
 
 
 def unpack_bools(mask: jnp.ndarray, n: int) -> jnp.ndarray:
@@ -268,6 +275,52 @@ class Table:
     def tree_unflatten(cls, aux, children):
         del aux
         return cls(tuple(children))
+
+
+def slice_table(table: Table, start: int, end: int) -> Table:
+    """Row-slice a table (static bounds; usable inside a jit trace).
+
+    String columns keep absolute offsets; consumers rebase against
+    ``offsets[0]`` of the slice."""
+    cols = []
+    for c in table.columns:
+        validity = None
+        if c.validity is not None:
+            validity = pack_bools(
+                unpack_bools(c.validity, c.num_rows)[start:end])
+        if c.dtype.is_string:
+            cols.append(Column(c.dtype, c.data, validity,
+                               c.offsets[start:end + 1], c.chars))
+        else:
+            cols.append(Column(c.dtype, c.data[start:end], validity))
+    return Table(tuple(cols))
+
+
+def slice_table_dynamic(table: Table, start, size: int) -> Table:
+    """Row-slice with a *traced* start and static size: one compiled
+    program serves every equally-sized row batch (the static-start variant
+    would bake each batch offset into its own executable).
+
+    ``start`` must be byte-aligned in validity space (a multiple of 8 —
+    row batches are 32-row aligned): packed masks are sliced as bytes, no
+    full-table unpack/repack."""
+    import jax.lax as lax
+    cols = []
+    for c in table.columns:
+        validity = None
+        if c.validity is not None:
+            validity = lax.dynamic_slice_in_dim(
+                c.validity, start // 8, (size + 7) // 8)
+        if c.dtype.is_string:
+            cols.append(Column(c.dtype, c.data, validity,
+                               lax.dynamic_slice_in_dim(c.offsets, start,
+                                                        size + 1),
+                               c.chars))
+        else:
+            cols.append(Column(c.dtype,
+                               lax.dynamic_slice_in_dim(c.data, start, size),
+                               validity))
+    return Table(tuple(cols))
 
 
 def assert_tables_equivalent(a: Table, b: Table, *, check_nulls: bool = True):

@@ -113,3 +113,24 @@ def test_mxu_no_x64_pair_representation(rng):
         assert t.columns[0].to_pylist() == rt.columns[0].to_pylist()
     finally:
         jax.config.update("jax_enable_x64", prev)
+
+
+def test_multi_batch_roundtrip_with_nulls(rng):
+    """Equal-batch encode with traced-start slicing must preserve values
+    and validity across batch boundaries (incl. a non-multiple-of-8 tail
+    batch)."""
+    dtypes = [INT64, INT32, INT16, INT8, BOOL8]
+    t = _random_table(rng, dtypes, 2003)
+    from spark_rapids_jni_tpu.ops.row_layout import compute_row_layout
+    layout = compute_row_layout(t.dtypes)
+    limit = layout.fixed_row_size * 512  # force ~4 batches, 32-aligned
+    batches = convert_to_rows(t, impl="mxu", size_limit=limit)
+    assert len(batches) > 1
+    assert sum(b.num_rows for b in batches) == 2003
+    parts = [convert_from_rows(b, t.dtypes, impl="mxu") for b in batches]
+    got_cols = []
+    for i in range(t.num_columns):
+        vals = sum((p.columns[i].to_pylist() for p in parts), [])
+        got_cols.append(vals)
+    for i, c in enumerate(t.columns):
+        assert c.to_pylist() == got_cols[i], f"column {i}"

@@ -82,3 +82,28 @@ def test_overflow_flag(rng, mesh):
                                  capacity_factor=8.0 * 8)
     assert not bool(np.asarray(res2.overflow)[0])
     assert int(np.asarray(res2.num_valid).sum()) == n
+
+
+def test_ring_exchange_matches_all_to_all(rng, mesh):
+    """The ring (ppermute-decomposed) exchange must deliver bit-identical
+    buckets to the fused all_to_all exchange."""
+    n = 8 * 64
+    _, ts = _make_sharded(rng, mesh, n)
+    a = shuffle_table_sharded(ts, key_cols=[0], mesh=mesh,
+                              method="all_to_all")
+    r = shuffle_table_sharded(ts, key_cols=[0], mesh=mesh, method="ring")
+    np.testing.assert_array_equal(np.asarray(a.rows), np.asarray(r.rows))
+    np.testing.assert_array_equal(np.asarray(a.row_valid),
+                                  np.asarray(r.row_valid))
+    np.testing.assert_array_equal(np.asarray(a.num_valid),
+                                  np.asarray(r.num_valid))
+    assert not bool(np.asarray(r.overflow)[0])
+
+
+def test_ring_exchange_overflow_flag(rng, mesh):
+    key = np.full(8 * 64, 7, dtype=np.int64)
+    t = Table((Column.from_numpy(key, INT64),))
+    ts = shard_table(t, mesh)
+    res = shuffle_table_sharded(ts, key_cols=[0], mesh=mesh,
+                                capacity_factor=1.0, method="ring")
+    assert bool(np.asarray(res.overflow)[0])
