@@ -59,5 +59,42 @@ void encode_fixed(const Layout& layout, int64_t nrows,
 void decode_fixed(const Layout& layout, int64_t nrows, const uint8_t* rows,
                   uint8_t* const* cols_out, uint8_t* const* validity_out);
 
+// --- variable-width (string) rows -----------------------------------------
+//
+// The exact compact JCUDF wire layout (reference row_conversion.cu:91-153):
+// per row, the fixed-width section (string slots hold uint32 (offset from
+// row start, length) pairs), validity bytes, then every string column's
+// chars tightly packed in column order, the total rounded to 8 bytes.
+// This host engine is the framework's compaction boundary: the TPU path
+// keeps blobs dense, this produces/consumes the byte-exact cudf format.
+
+// Per-row total sizes (8-byte aligned).  str_offsets[s] is string column
+// s's Arrow offsets array, int32[nrows + 1].  Writes nrows entries and
+// returns the blob's total byte count.
+int64_t variable_row_sizes(const Layout& layout, int64_t nrows,
+                           const int32_t* const* str_offsets,
+                           int64_t* out_sizes);
+
+// Encode the compact blob.  cols[i]/validity[i] as in encode_fixed (string
+// positions in cols are ignored); str_offsets/str_chars are indexed by
+// string-column order; row_offsets is the exclusive scan of the sizes
+// (int64[nrows + 1]); out holds row_offsets[nrows] bytes.
+void encode_variable(const Layout& layout, int64_t nrows,
+                     const uint8_t* const* cols,
+                     const uint8_t* const* validity,
+                     const int32_t* const* str_offsets,
+                     const uint8_t* const* str_chars,
+                     const int64_t* row_offsets, uint8_t* out);
+
+// Decode the compact blob.  Pass 1 (str_chars_out == nullptr): fills fixed
+// columns, validity masks, and each string column's offsets
+// (int32[nrows + 1]).  Pass 2: with chars buffers sized from those
+// offsets, copies the chars (cols_out/validity_out may be null then).
+void decode_variable(const Layout& layout, int64_t nrows,
+                     const uint8_t* blob, const int64_t* row_offsets,
+                     uint8_t* const* cols_out, uint8_t* const* validity_out,
+                     int32_t* const* str_offsets_out,
+                     uint8_t* const* str_chars_out);
+
 }  // namespace rows
 }  // namespace srj

@@ -173,4 +173,59 @@ int srj_rows_decode_fixed(int32_t ncols, int64_t nrows,
   }
 }
 
+// Variable-width (string) rows: per-row sizes (returns the blob's total
+// byte count, or -1), exact-compact encode, and two-pass decode.
+int64_t srj_rows_variable_sizes(int32_t ncols, int64_t nrows,
+                                const int32_t* itemsizes,
+                                const uint8_t* is_string,
+                                const int32_t* const* str_offsets,
+                                int64_t* out_sizes) {
+  try {
+    srj::rows::Layout l =
+        srj::rows::compute_layout(itemsizes, is_string, ncols);
+    return srj::rows::variable_row_sizes(l, nrows, str_offsets, out_sizes);
+  } catch (const std::exception& e) {
+    set_error(e);
+    return -1;
+  }
+}
+
+int srj_rows_encode_variable(int32_t ncols, int64_t nrows,
+                             const int32_t* itemsizes,
+                             const uint8_t* is_string,
+                             const uint8_t* const* cols,
+                             const uint8_t* const* validity,
+                             const int32_t* const* str_offsets,
+                             const uint8_t* const* str_chars,
+                             const int64_t* row_offsets, uint8_t* out) {
+  try {
+    srj::rows::Layout l =
+        srj::rows::compute_layout(itemsizes, is_string, ncols);
+    srj::rows::encode_variable(l, nrows, cols, validity, str_offsets,
+                               str_chars, row_offsets, out);
+    return 0;
+  } catch (const std::exception& e) {
+    return set_error(e);
+  }
+}
+
+int srj_rows_decode_variable(int32_t ncols, int64_t nrows,
+                             const int32_t* itemsizes,
+                             const uint8_t* is_string, const uint8_t* blob,
+                             const int64_t* row_offsets,
+                             uint8_t* const* cols_out,
+                             uint8_t* const* validity_out,
+                             int32_t* const* str_offsets_out,
+                             uint8_t* const* str_chars_out) {
+  try {
+    srj::rows::Layout l =
+        srj::rows::compute_layout(itemsizes, is_string, ncols);
+    srj::rows::decode_variable(l, nrows, blob, row_offsets, cols_out,
+                               validity_out, str_offsets_out, str_chars_out);
+    return 0;
+  } catch (const std::exception& e) {
+    return set_error(e);
+  }
+}
+
 }  // extern "C"

@@ -3,6 +3,7 @@
 #include <algorithm>
 #include <cstring>
 #include <string>
+#include <utility>
 
 namespace srj {
 namespace rows {
@@ -10,6 +11,18 @@ namespace rows {
 namespace {
 int64_t round_up(int64_t x, int64_t align) {
   return (x + align - 1) / align * align;
+}
+
+void write_validity_row(const Layout& layout, const uint8_t* const* validity,
+                        int64_t r, uint8_t* vrow) {
+  const int32_t ncols = layout.num_columns();
+  for (int32_t c = 0; c < ncols; ++c) {
+    uint8_t valid = 1;
+    if (validity != nullptr && validity[c] != nullptr) {
+      valid = (validity[c][r >> 3] >> (r & 7)) & 1;
+    }
+    vrow[c >> 3] |= static_cast<uint8_t>(valid << (c & 7));
+  }
 }
 }  // namespace
 
@@ -91,14 +104,8 @@ void encode_fixed(const Layout& layout, int64_t nrows,
   }
   // validity tail: bit c%8 of byte c/8, 1 = valid
   for (int64_t r = 0; r < nrows; ++r) {
-    uint8_t* vrow = out + r * rs + layout.validity_offset;
-    for (int32_t c = 0; c < ncols; ++c) {
-      uint8_t valid = 1;
-      if (validity != nullptr && validity[c] != nullptr) {
-        valid = (validity[c][r >> 3] >> (r & 7)) & 1;
-      }
-      vrow[c >> 3] |= static_cast<uint8_t>(valid << (c & 7));
-    }
+    write_validity_row(layout, validity, r,
+                       out + r * rs + layout.validity_offset);
   }
 }
 
@@ -128,6 +135,137 @@ void decode_fixed(const Layout& layout, int64_t nrows, const uint8_t* rows,
         uint8_t valid = (vrow[c >> 3] >> (c & 7)) & 1;
         validity_out[c][r >> 3] |= static_cast<uint8_t>(valid << (r & 7));
       }
+    }
+  }
+}
+
+namespace {
+
+// string columns' indices in layout order
+std::vector<int32_t> string_cols(const Layout& layout) {
+  std::vector<int32_t> s;
+  for (int32_t c = 0; c < layout.num_columns(); ++c) {
+    if (layout.is_string[c]) s.push_back(c);
+  }
+  return s;
+}
+
+}  // namespace
+
+int64_t variable_row_sizes(const Layout& layout, int64_t nrows,
+                           const int32_t* const* str_offsets,
+                           int64_t* out_sizes) {
+  const std::vector<int32_t> scols = string_cols(layout);
+  const int64_t fixed_end = layout.validity_offset + layout.validity_bytes;
+  int64_t total = 0;
+  for (int64_t r = 0; r < nrows; ++r) {
+    int64_t chars = 0;
+    for (size_t s = 0; s < scols.size(); ++s) {
+      chars += str_offsets[s][r + 1] - str_offsets[s][r];
+    }
+    int64_t size = round_up(fixed_end + chars, kRowAlignment);
+    out_sizes[r] = size;
+    total += size;
+  }
+  return total;
+}
+
+void encode_variable(const Layout& layout, int64_t nrows,
+                     const uint8_t* const* cols,
+                     const uint8_t* const* validity,
+                     const int32_t* const* str_offsets,
+                     const uint8_t* const* str_chars,
+                     const int64_t* row_offsets, uint8_t* out) {
+  const int32_t ncols = layout.num_columns();
+  const std::vector<int32_t> scols = string_cols(layout);
+  const int64_t fixed_end = layout.validity_offset + layout.validity_bytes;
+  std::memset(out, 0, static_cast<size_t>(row_offsets[nrows]));
+  std::vector<std::pair<uint32_t, uint32_t>> pairs(scols.size());
+  for (int64_t r = 0; r < nrows; ++r) {
+    uint8_t* row = out + row_offsets[r];
+    // chars first so the (offset, length) pairs are known when the fixed
+    // section is written
+    uint32_t pos = static_cast<uint32_t>(fixed_end);
+    for (size_t s = 0; s < scols.size(); ++s) {
+      const int32_t lo = str_offsets[s][r];
+      const uint32_t len = static_cast<uint32_t>(str_offsets[s][r + 1] - lo);
+      std::memcpy(row + pos, str_chars[s] + lo, len);
+      pairs[s] = {pos, len};
+      pos += len;
+    }
+    int32_t si = 0;
+    for (int32_t c = 0; c < ncols; ++c) {
+      const int32_t start = layout.col_starts[c];
+      if (layout.is_string[c]) {
+        std::memcpy(row + start, &pairs[si].first, 4);
+        std::memcpy(row + start + 4, &pairs[si].second, 4);
+        ++si;
+      } else {
+        const int32_t size = layout.col_sizes[c];
+        std::memcpy(row + start, cols[c] + r * size, size);
+      }
+    }
+    write_validity_row(layout, validity, r, row + layout.validity_offset);
+  }
+}
+
+void decode_variable(const Layout& layout, int64_t nrows,
+                     const uint8_t* blob, const int64_t* row_offsets,
+                     uint8_t* const* cols_out, uint8_t* const* validity_out,
+                     int32_t* const* str_offsets_out,
+                     uint8_t* const* str_chars_out) {
+  const int32_t ncols = layout.num_columns();
+  const std::vector<int32_t> scols = string_cols(layout);
+  if (str_chars_out == nullptr) {
+    // pass 1: fixed columns, validity, string offsets
+    if (validity_out != nullptr) {
+      const int64_t vbytes = (nrows + 7) / 8;
+      for (int32_t c = 0; c < ncols; ++c) {
+        if (validity_out[c] != nullptr) {
+          std::memset(validity_out[c], 0, vbytes);
+        }
+      }
+    }
+    for (size_t s = 0; s < scols.size(); ++s) str_offsets_out[s][0] = 0;
+    for (int64_t r = 0; r < nrows; ++r) {
+      const uint8_t* row = blob + row_offsets[r];
+      int32_t si = 0;
+      for (int32_t c = 0; c < ncols; ++c) {
+        const int32_t start = layout.col_starts[c];
+        if (layout.is_string[c]) {
+          uint32_t len;
+          std::memcpy(&len, row + start + 4, 4);
+          str_offsets_out[si][r + 1] =
+              str_offsets_out[si][r] + static_cast<int32_t>(len);
+          ++si;
+        } else if (cols_out != nullptr && cols_out[c] != nullptr) {
+          const int32_t size = layout.col_sizes[c];
+          std::memcpy(cols_out[c] + r * size, row + start, size);
+        }
+      }
+      if (validity_out != nullptr) {
+        const uint8_t* vrow = row + layout.validity_offset;
+        for (int32_t c = 0; c < ncols; ++c) {
+          if (validity_out[c] == nullptr) continue;
+          uint8_t valid = (vrow[c >> 3] >> (c & 7)) & 1;
+          validity_out[c][r >> 3] |= static_cast<uint8_t>(valid << (r & 7));
+        }
+      }
+    }
+    return;
+  }
+  // pass 2: chars
+  for (int64_t r = 0; r < nrows; ++r) {
+    const uint8_t* row = blob + row_offsets[r];
+    int32_t si = 0;
+    for (int32_t c = 0; c < ncols; ++c) {
+      if (!layout.is_string[c]) continue;
+      const int32_t start = layout.col_starts[c];
+      uint32_t off, len;
+      std::memcpy(&off, row + start, 4);
+      std::memcpy(&len, row + start + 4, 4);
+      std::memcpy(str_chars_out[si] + str_offsets_out[si][r], row + off, len);
+      ++si;
     }
   }
 }

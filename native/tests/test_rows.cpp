@@ -97,12 +97,66 @@ static void test_encode_decode_roundtrip() {
   CHECK(dv0 == 0b101 && dv1 == 0b111);
 }
 
+void test_variable_roundtrip() {
+  // schema: int32, string, int8
+  int32_t itemsizes[] = {4, 8, 1};
+  uint8_t is_string[] = {0, 1, 0};
+  srj::rows::Layout l = srj::rows::compute_layout(itemsizes, is_string, 3);
+
+  int32_t c0[] = {10, -2, 3};
+  uint8_t c2[] = {7, 8, 9};
+  // strings: "ab", "", "xyz"
+  int32_t soff[] = {0, 2, 2, 5};
+  uint8_t chars[] = {'a', 'b', 'x', 'y', 'z'};
+  const int32_t* soffs[] = {soff};
+  const uint8_t* charss[] = {chars};
+  int64_t sizes[3];
+  int64_t total = srj::rows::variable_row_sizes(l, 3, soffs, sizes);
+  // fixed section: int32 at 0, pair at 4, int8 at 12, validity byte at 13
+  // -> fixed_end 14; per-row round8(14 + chars)
+  CHECK(sizes[0] == 16 && sizes[1] == 16 && sizes[2] == 24);
+  CHECK(total == 56);
+  int64_t roffs[] = {0, sizes[0], sizes[0] + sizes[1], total};
+
+  const uint8_t* cols[] = {reinterpret_cast<const uint8_t*>(c0), nullptr,
+                           c2};
+  uint8_t v0 = 0b101;  // row 1 of col 0 invalid
+  const uint8_t* vals[] = {&v0, nullptr, nullptr};
+  std::vector<uint8_t> blob(total);
+  srj::rows::encode_variable(l, 3, cols, vals, soffs, charss, roffs,
+                             blob.data());
+  // row 0: int32 10 | pair(off=14,len=2) | int8 7 | validity 0b111 | "ab"
+  CHECK(blob[0] == 10 && blob[4] == 14 && blob[8] == 2 && blob[12] == 7);
+  CHECK(blob[13] == 0b111 && blob[14] == 'a' && blob[15] == 'b');
+  CHECK(blob[16 + 13] == 0b110);  // row 1 validity: col0 invalid
+
+  int32_t d0[3];
+  uint8_t d2[3];
+  uint8_t dv0 = 0, dv1 = 0, dv2 = 0;
+  int32_t dsoff[4];
+  uint8_t* dcols[] = {reinterpret_cast<uint8_t*>(d0), nullptr, d2};
+  uint8_t* dvals[] = {&dv0, &dv1, &dv2};
+  int32_t* dsoffs[] = {dsoff};
+  srj::rows::decode_variable(l, 3, blob.data(), roffs, dcols, dvals, dsoffs,
+                             nullptr);
+  CHECK(d0[0] == 10 && d0[1] == -2 && d0[2] == 3);
+  CHECK(d2[0] == 7 && d2[2] == 9);
+  CHECK(dv0 == 0b101 && dv1 == 0b111);
+  CHECK(dsoff[0] == 0 && dsoff[1] == 2 && dsoff[2] == 2 && dsoff[3] == 5);
+  uint8_t dchars[5];
+  uint8_t* dcharss[] = {dchars};
+  srj::rows::decode_variable(l, 3, blob.data(), roffs, nullptr, nullptr,
+                             dsoffs, dcharss);
+  CHECK(dchars[0] == 'a' && dchars[4] == 'z');
+}
+
 int main() {
   test_layout_alignment();
   test_layout_string_slot();
   test_layout_row_limit();
   test_batch_plan();
   test_encode_decode_roundtrip();
+  test_variable_roundtrip();
   if (g_failures == 0) {
     std::printf("row engine self-tests: all passed\n");
     return 0;

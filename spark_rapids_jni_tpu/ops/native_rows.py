@@ -47,6 +47,18 @@ def _lib():
         lib.srj_rows_decode_fixed.restype = ctypes.c_int
         lib.srj_rows_decode_fixed.argtypes = [
             ctypes.c_int32, ctypes.c_int64, i32p, u8p, u8p, u8pp, u8pp]
+        i32pp = ctypes.POINTER(i32p)
+        lib.srj_rows_variable_sizes.restype = ctypes.c_int64
+        lib.srj_rows_variable_sizes.argtypes = [
+            ctypes.c_int32, ctypes.c_int64, i32p, u8p, i32pp, i64p]
+        lib.srj_rows_encode_variable.restype = ctypes.c_int
+        lib.srj_rows_encode_variable.argtypes = [
+            ctypes.c_int32, ctypes.c_int64, i32p, u8p, u8pp, u8pp, i32pp,
+            u8pp, i64p, u8p]
+        lib.srj_rows_decode_variable.restype = ctypes.c_int
+        lib.srj_rows_decode_variable.argtypes = [
+            ctypes.c_int32, ctypes.c_int64, i32p, u8p, u8p, i64p, u8pp,
+            u8pp, i32pp, u8pp]
         _configured = True
     return lib
 
@@ -157,6 +169,130 @@ def encode_fixed_native(columns: Sequence[np.ndarray],
     if rc != 0:
         raise ValueError(_loader.last_error(lib))
     return out
+
+
+def _i64p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def encode_variable_native(columns: Sequence[Optional[np.ndarray]],
+                           validity: Sequence[Optional[np.ndarray]],
+                           str_offsets: Sequence[np.ndarray],
+                           str_chars: Sequence[np.ndarray],
+                           dtypes: Sequence[DType]
+                           ) -> Tuple[np.ndarray, np.ndarray]:
+    """Encode host columns (with strings) into the exact compact JCUDF
+    blob.  ``columns[i]`` is None at string positions; ``str_offsets`` /
+    ``str_chars`` are in string-column order.  Returns
+    (blob uint8[total], row_offsets int64[nrows + 1]) — this is the
+    framework's host-side compaction boundary (the TPU path keeps blobs
+    dense; the reference's GPU writer packs exactly this layout,
+    ``row_conversion.cu:91-153``)."""
+    lib = _lib()
+    if lib is None:
+        raise RuntimeError("native row engine unavailable")
+    dtypes = tuple(dtypes)
+    n = len(dtypes)
+    nstr = sum(1 for dt in dtypes if dt.is_string)
+    if nstr == 0:
+        raise ValueError("use encode_fixed_native for all-fixed schemas")
+    nrows = len(str_offsets[0]) - 1
+    itemsizes, is_string = _schema_arrays(dtypes)
+    keep = []
+    u8p_t = ctypes.POINTER(ctypes.c_uint8)
+    i32p_t = ctypes.POINTER(ctypes.c_int32)
+    soff_c = (i32p_t * nstr)()
+    for s, o in enumerate(str_offsets):
+        o = np.ascontiguousarray(o, dtype=np.int32)
+        keep.append(o)
+        soff_c[s] = _i32p(o)
+    sizes = np.zeros(max(nrows, 1), np.int64)
+    total = lib.srj_rows_variable_sizes(n, nrows, _i32p(itemsizes),
+                                        _u8p(is_string), soff_c,
+                                        _i64p(sizes))
+    if total < 0:
+        raise ValueError(_loader.last_error(lib))
+    row_offsets = np.zeros(nrows + 1, np.int64)
+    np.cumsum(sizes[:nrows], out=row_offsets[1:])
+    cols_c = (u8p_t * n)()
+    for i, c in enumerate(columns):
+        if c is None:
+            cols_c[i] = None
+        else:
+            c = np.ascontiguousarray(c)
+            keep.append(c)
+            cols_c[i] = _u8p(c.view(np.uint8).reshape(-1))
+    val_c = (u8p_t * n)()
+    for i, v in enumerate(validity):
+        if v is None:
+            val_c[i] = None
+        else:
+            v = np.ascontiguousarray(v, dtype=np.uint8)
+            keep.append(v)
+            val_c[i] = _u8p(v)
+    chars_c = (u8p_t * nstr)()
+    for s, ch in enumerate(str_chars):
+        ch = np.ascontiguousarray(ch, dtype=np.uint8)
+        keep.append(ch)
+        chars_c[s] = _u8p(ch)
+    out = np.zeros(int(total), np.uint8)
+    rc = lib.srj_rows_encode_variable(n, nrows, _i32p(itemsizes),
+                                      _u8p(is_string), cols_c, val_c,
+                                      soff_c, chars_c, _i64p(row_offsets),
+                                      _u8p(out))
+    if rc != 0:
+        raise ValueError(_loader.last_error(lib))
+    return out, row_offsets
+
+
+def decode_variable_native(blob: np.ndarray, row_offsets: np.ndarray,
+                           dtypes: Sequence[DType]):
+    """Decode a compact variable-width JCUDF blob.  Returns
+    (columns, validity_masks, str_offsets, str_chars) with string-position
+    columns None; str_* in string-column order."""
+    lib = _lib()
+    if lib is None:
+        raise RuntimeError("native row engine unavailable")
+    dtypes = tuple(dtypes)
+    n = len(dtypes)
+    nstr = sum(1 for dt in dtypes if dt.is_string)
+    nrows = len(row_offsets) - 1
+    if nrows < 0:
+        raise ValueError("row_offsets must have at least one entry")
+    itemsizes, is_string = _schema_arrays(dtypes)
+    blob = np.ascontiguousarray(blob, dtype=np.uint8)
+    row_offsets = np.ascontiguousarray(row_offsets, dtype=np.int64)
+    if nrows and (np.any(np.diff(row_offsets) < 0) or row_offsets[0] != 0
+                  or int(row_offsets[-1]) > blob.size):
+        raise ValueError(
+            f"row_offsets inconsistent with a {blob.size}-byte blob")
+    u8p_t = ctypes.POINTER(ctypes.c_uint8)
+    i32p_t = ctypes.POINTER(ctypes.c_int32)
+    cols = [None if dt.is_string else np.zeros(nrows, dt.np_dtype)
+            for dt in dtypes]
+    vals = [np.zeros((nrows + 7) // 8, np.uint8) for _ in dtypes]
+    soffs = [np.zeros(nrows + 1, np.int32) for _ in range(nstr)]
+    cols_c = (u8p_t * n)(*[None if c is None
+                           else _u8p(c.view(np.uint8).reshape(-1))
+                           for c in cols])
+    vals_c = (u8p_t * n)(*[_u8p(v) for v in vals])
+    soff_c = (i32p_t * max(nstr, 1))(*([_i32p(o) for o in soffs] or [None]))
+    rc = lib.srj_rows_decode_variable(n, nrows, _i32p(itemsizes),
+                                      _u8p(is_string), _u8p(blob),
+                                      _i64p(row_offsets), cols_c, vals_c,
+                                      soff_c, None)
+    if rc != 0:
+        raise ValueError(_loader.last_error(lib))
+    chars = [np.zeros(int(o[-1]), np.uint8) for o in soffs]
+    if nstr:
+        chars_c = (u8p_t * nstr)(*[_u8p(ch) for ch in chars])
+        rc = lib.srj_rows_decode_variable(n, nrows, _i32p(itemsizes),
+                                          _u8p(is_string), _u8p(blob),
+                                          _i64p(row_offsets), None, None,
+                                          soff_c, chars_c)
+        if rc != 0:
+            raise ValueError(_loader.last_error(lib))
+    return cols, vals, soffs, chars
 
 
 def decode_fixed_native(rows: np.ndarray, dtypes: Sequence[DType]
