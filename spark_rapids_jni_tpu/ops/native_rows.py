@@ -262,10 +262,14 @@ def decode_variable_native(blob: np.ndarray, row_offsets: np.ndarray,
     itemsizes, is_string = _schema_arrays(dtypes)
     blob = np.ascontiguousarray(blob, dtype=np.uint8)
     row_offsets = np.ascontiguousarray(row_offsets, dtype=np.int64)
-    if nrows and (np.any(np.diff(row_offsets) < 0) or row_offsets[0] != 0
+    from spark_rapids_jni_tpu.ops.row_layout import compute_row_layout
+    min_row = -(-compute_row_layout(dtypes).fixed_end // 8) * 8
+    if nrows and (np.any(np.diff(row_offsets) < min_row)
+                  or row_offsets[0] != 0
                   or int(row_offsets[-1]) > blob.size):
         raise ValueError(
-            f"row_offsets inconsistent with a {blob.size}-byte blob")
+            f"row_offsets inconsistent with a {blob.size}-byte blob "
+            f"(rows must be >= {min_row} bytes)")
     u8p_t = ctypes.POINTER(ctypes.c_uint8)
     i32p_t = ctypes.POINTER(ctypes.c_int32)
     cols = [None if dt.is_string else np.zeros(nrows, dt.np_dtype)

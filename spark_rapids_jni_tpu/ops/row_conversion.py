@@ -414,17 +414,23 @@ def convert_from_rows(rows: RowsColumn, dtypes: Sequence[DType],
     if layout.has_strings:
         return _from_rows_variable(rows, layout)
     n = rows.num_rows
-    rows2d = rows.data.reshape(n, layout.fixed_row_size)
     platform = _platform_of(rows)
     impl = _resolve_impl(impl, use_pallas, platform)
     if impl == "pallas":
         from spark_rapids_jni_tpu.ops import row_kernels
+        rows2d = rows.data.reshape(n, layout.fixed_row_size)
         cols = row_kernels.from_rows_fixed(rows2d, layout,
                                            interpret=platform != "tpu")
     elif impl == "mxu":
         from spark_rapids_jni_tpu.ops import row_mxu
-        cols = row_mxu.from_rows_fixed(rows2d, layout)
+        if rows.data.size != n * layout.fixed_row_size:
+            raise ValueError(
+                f"row blob holds {rows.data.size} bytes but offsets "
+                f"describe {n} rows of {layout.fixed_row_size}")
+        # flat blob goes straight in; the reshape happens inside the jit
+        cols = row_mxu.from_rows_fixed(rows.data, layout)
     else:
+        rows2d = rows.data.reshape(n, layout.fixed_row_size)
         cols = _from_rows_fixed_jit(rows2d, layout)
     return Table(tuple(cols))
 

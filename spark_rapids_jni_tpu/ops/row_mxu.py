@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import List, Tuple
 
 import jax
@@ -79,13 +80,14 @@ def _build_word_plan(layout: RowLayout, validity_units: int) -> WordPlan:
     col_word = [0] * layout.num_columns
     col_byte = [0] * layout.num_columns
     w = 0
-    # wide columns first (whole words)
+    # 8-byte columns first as ONE contiguous plane block (the decoder
+    # un-planarizes them with a single batched transpose), then 4-byte
     for i, dt in enumerate(layout.dtypes):
-        sz = layout.col_sizes[i]
-        if sz == 8:
+        if layout.col_sizes[i] == 8:
             col_word[i], col_byte[i] = w, 0
             w += 2
-        elif sz == 4:
+    for i, dt in enumerate(layout.dtypes):
+        if layout.col_sizes[i] == 4:
             col_word[i], col_byte[i] = w, 0
             w += 1
     # 2-byte columns, two per word
@@ -210,30 +212,166 @@ def _pack_planes(table: Table, layout: RowLayout, plan: WordPlan,
 
 
 # ---------------------------------------------------------------------------
+# Pallas pack kernel: raw columns -> [W, n] word planes in one HBM pass
+# ---------------------------------------------------------------------------
+#
+# The XLA _pack_planes materializes per-group pieces and then concatenates
+# them (~3x the minimum traffic).  This kernel writes the whole plane
+# matrix in a single pass: per grid step it owns a [W, TILE] VMEM block,
+# copies the pre-transposed 64-bit planes and validity quads through, and
+# assembles the 4/2/1-byte words from raw 1-D column blocks with fused
+# shifts.  Only the 64-bit planarization (one batched transpose) and the
+# validity bit-unpack stay in XLA (Mosaic cannot lane-merge the bit
+# unpack's minor dims).
+
+_PACK_TILE = 1024
+
+
+def _pack_kernel(counts, *refs):
+    n8, n4, n2, n1 = counts
+    i = 0
+    a8t_ref = refs[i] if n8 else None
+    i += 1 if n8 else 0
+    vq_ref = refs[i]
+    i += 1
+    c4 = refs[i:i + n4]; i += n4
+    c2 = refs[i:i + n2]; i += n2
+    c1 = refs[i:i + n1]; i += n1
+    out_ref = refs[-1]
+    r = 0
+    if n8:
+        out_ref[0:2 * n8, :] = a8t_ref[...]
+        r = 2 * n8
+    for j in range(n4):
+        out_ref[r + j, :] = c4[j][...]
+    r += n4
+    for k in range(0, n2, 2):
+        a = c2[k][...].astype(jnp.uint32)
+        w = a | (c2[k + 1][...].astype(jnp.uint32) << 16) \
+            if k + 1 < n2 else a
+        out_ref[r + k // 2, :] = w
+    r += (n2 + 1) // 2
+    for k in range(0, n1, 4):
+        w = c1[k][...].astype(jnp.uint32)
+        for j in range(1, 4):
+            if k + j < n1:
+                w = w | (c1[k + j][...].astype(jnp.uint32) << (8 * j))
+        out_ref[r + k // 4, :] = w
+    r += (n1 + 3) // 4
+    out_ref[r:, :] = vq_ref[...]
+
+
+def _pack_planes_pallas(table: Table, layout: RowLayout,
+                        plan: WordPlan, interpret: bool) -> jnp.ndarray:
+    from jax.experimental import pallas as pl
+    n = table.num_rows
+    cols = table.columns
+    by_size = {8: [], 4: [], 2: [], 1: []}
+    for c in cols:
+        by_size[c.dtype.itemsize].append(c)
+    n8, n4 = len(by_size[8]), len(by_size[4])
+    n2, n1 = len(by_size[2]), len(by_size[1])
+    ncols = layout.num_columns
+    nvw = (ncols + 3) // 4
+    W = plan.num_words
+
+    ins, in_specs = [], []
+    if n8:
+        a8 = jnp.stack([_col_words_pair(c) for c in by_size[8]])
+        a8t = jnp.transpose(a8, (0, 2, 1)).reshape(2 * n8, n)
+        ins.append(a8t)
+        in_specs.append(pl.BlockSpec((2 * n8, _PACK_TILE),
+                                     lambda r: (0, r)))
+    vq = _validity_quads(table, layout)                    # [nvw, n] u32
+    ins.append(vq)
+    in_specs.append(pl.BlockSpec((nvw, _PACK_TILE), lambda r: (0, r)))
+    for c in by_size[4]:
+        d = c.data
+        ins.append(d if d.dtype == jnp.uint32
+                   else jax.lax.bitcast_convert_type(d, jnp.uint32))
+    for c in by_size[2]:
+        ins.append(jax.lax.bitcast_convert_type(c.data, jnp.uint16))
+    for c in by_size[1]:
+        d = c.data
+        ins.append(d.astype(jnp.uint8) if d.dtype == jnp.bool_ else
+                   (d if d.dtype == jnp.uint8
+                    else jax.lax.bitcast_convert_type(d, jnp.uint8)))
+    in_specs += [pl.BlockSpec((_PACK_TILE,), lambda r: (r,))
+                 for _ in range(n4 + n2 + n1)]
+    grid = ((n + _PACK_TILE - 1) // _PACK_TILE,)
+    return pl.pallas_call(
+        functools.partial(_pack_kernel, (n8, n4, n2, n1)),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((W, _PACK_TILE), lambda r: (0, r)),
+        out_shape=jax.ShapeDtypeStruct((W, n), jnp.uint32),
+        interpret=interpret)(*ins)
+
+
+def _col_words_pair(col: Column) -> jnp.ndarray:
+    """A 64-bit column as [n, 2] uint32 words."""
+    data = col.data
+    if data.ndim == 2:
+        return data.astype(jnp.uint32) if data.dtype != jnp.uint32 else data
+    return jax.lax.bitcast_convert_type(data, jnp.uint32)
+
+
+def _validity_quads(table: Table, layout: RowLayout) -> jnp.ndarray:
+    """All columns' validity bits as 0/1 bytes packed 4-per-word: the
+    [ceil(ncols/4), n] uint32 validity planes of the word matrix."""
+    n = table.num_rows
+    nb = (n + 7) // 8
+    masks = jnp.stack(
+        [c.validity if c.validity is not None
+         else jnp.full((nb,), 255, jnp.uint8)
+         for c in table.columns])                            # [ncols, nb]
+    bits = ((masks[:, :, None] >> jnp.arange(8, dtype=jnp.uint8)) & 1)
+    vb = bits.reshape(masks.shape[0], -1)[:, :n]             # [ncols, n] u8
+    pad = (-vb.shape[0]) % 4
+    if pad:
+        vb = jnp.concatenate([vb, jnp.zeros((pad, n), jnp.uint8)], axis=0)
+    return (vb[0::4].astype(jnp.uint32)
+            | (vb[1::4].astype(jnp.uint32) << 8)
+            | (vb[2::4].astype(jnp.uint32) << 16)
+            | (vb[3::4].astype(jnp.uint32) << 24))
+
+
+# ---------------------------------------------------------------------------
 # Encode: table -> [n, fixed_row_size] uint8
 # ---------------------------------------------------------------------------
 
 # The int8 dots accumulate in int32: an unfused convert materializes a
-# temp 4x the byte blob.  Every dot therefore processes rows in slabs
-# (python-unrolled inside the trace) so the i32 temp stays ~1GB and XLA's
-# in-order liveness frees each slab before the next.
-_DOT_CHUNK_ROWS = 512 * 1024
+# temp 4x the byte blob.  Dots therefore process rows in slabs
+# (python-unrolled inside the trace) sized so the i32 temp stays ~4GB —
+# XLA's in-order liveness frees each slab before the next.  Chunking has
+# real cost (operand slices copy, smaller dots pipeline worse), so the
+# slab is as large as the temp budget allows.
+_DOT_CHUNK_ROWS = 512 * 1024  # floor for very wide rows
 
 
-@functools.partial(jax.jit, static_argnums=(1, 4))
+def _dot_chunk_rows(row_size: int) -> int:
+    return max(_DOT_CHUNK_ROWS, (4 << 30) // (row_size * 4))
+
+
+@functools.partial(jax.jit, static_argnums=(1, 4, 5))
 def _to_rows_mxu_jit(table: Table, layout: RowLayout, p3: jnp.ndarray,
-                     start=0, size=None) -> jnp.ndarray:
+                     start=0, size=None, pack: str = "xla") -> jnp.ndarray:
     from spark_rapids_jni_tpu.table import slice_table_dynamic
     if size is not None and size != table.num_rows:
         table = slice_table_dynamic(table, start, size)
     plan, _ = _forward_plan(layout)
-    valid_units = [_as_u32(table.column(c).valid_bools())
-                   for c in range(layout.num_columns)]
-    xt = _pack_planes(table, layout, plan, valid_units)    # [W, n] u32
+    if pack.startswith("pallas"):
+        xt = _pack_planes_pallas(table, layout, plan,
+                                 interpret=pack == "pallas_interpret")
+    else:
+        valid_units = [_as_u32(table.column(c).valid_bools())
+                       for c in range(layout.num_columns)]
+        xt = _pack_planes(table, layout, plan, valid_units)  # [W, n] u32
     n = xt.shape[1]
+    chunk = _dot_chunk_rows(layout.fixed_row_size)
     parts = []
-    for s in range(0, max(n, 1), _DOT_CHUNK_ROWS):
-        e = min(n, s + _DOT_CHUNK_ROWS)
+    for s in range(0, max(n, 1), chunk):
+        e = min(n, s + chunk)
         xb = jax.lax.bitcast_convert_type(xt[:, s:e], jnp.uint8)
         rows = jax.lax.dot_general(
             xb.astype(jnp.int8), p3,
@@ -253,14 +391,34 @@ def _inverse_p3_device(layout: RowLayout) -> jnp.ndarray:
     return jnp.asarray(_inverse_plan(layout)[1])
 
 
+def _platform_of_table(table: Table) -> str:
+    for leaf in jax.tree_util.tree_leaves(table):
+        if isinstance(leaf, jax.Array):
+            try:
+                return next(iter(leaf.devices())).platform
+            except Exception:
+                continue
+    return jax.default_backend()
+
+
 def to_rows_fixed(table: Table, layout: RowLayout,
-                  start: int = 0, size=None) -> jnp.ndarray:
+                  start: int = 0, size=None, pack=None) -> jnp.ndarray:
     """[n, fixed_row_size] uint8 rows via the MXU permutation matmul.
     ``start``/``size`` encode one row-batch, slicing inside the jit (the
     sub-table is never materialized; ``start`` is traced so equally-sized
-    batches share one executable)."""
+    batches share one executable).  ``pack`` selects the plane-matrix
+    builder: the Pallas single-pass kernel (TPU default; SRJ_PALLAS_PACK=0
+    disables) or the XLA piece-wise fallback."""
+    if pack is None:
+        nrows = size if size is not None else table.num_rows
+        if os.environ.get("SRJ_PALLAS_PACK", "1") == "0" \
+                or nrows < _PACK_TILE:  # tiny operands break Mosaic layout
+            pack = "xla"
+        else:
+            platform = _platform_of_table(table)
+            pack = "pallas" if platform == "tpu" else "xla"
     return _to_rows_mxu_jit(table, layout, _forward_p3_device(layout),
-                            jnp.int32(start), size)
+                            jnp.int32(start), size, pack)
 
 
 # ---------------------------------------------------------------------------
@@ -268,13 +426,17 @@ def to_rows_fixed(table: Table, layout: RowLayout,
 # ---------------------------------------------------------------------------
 
 @functools.partial(jax.jit, static_argnums=(1,))
-def _from_rows_mxu_jit(rows2d: jnp.ndarray, layout: RowLayout,
+def _from_rows_mxu_jit(rows_flat: jnp.ndarray, layout: RowLayout,
                        p3: jnp.ndarray):
     plan, _ = _inverse_plan(layout)
+    # reshape inside the jit: an eager reshape is a separate dispatched
+    # copy of the whole blob on remote-tunnel backends
+    rows2d = rows_flat.reshape(-1, layout.fixed_row_size)
     n = rows2d.shape[0]
+    chunk = _dot_chunk_rows(4 * plan.num_words)
     parts = []
-    for s in range(0, max(n, 1), _DOT_CHUNK_ROWS):
-        e = min(n, s + _DOT_CHUNK_ROWS)
+    for s in range(0, max(n, 1), chunk):
+        e = min(n, s + chunk)
         o = jax.lax.dot_general(
             p3, rows2d[s:e].astype(jnp.int8),
             dimension_numbers=(((0,), (1,)), ((), ())),
@@ -292,12 +454,22 @@ def _from_rows_mxu_jit(rows2d: jnp.ndarray, layout: RowLayout,
         vcols.append(((byte >> (c % 8)) & 1).astype(jnp.bool_))
     vmask = pack_bools_2d(jnp.stack(vcols, axis=0))          # [ncols, nb]
 
+    # 64-bit columns sit first in the word plan as one contiguous plane
+    # block: un-planarize them all with ONE batched transpose instead of a
+    # strided [n, 2] interleave per column
+    n8 = sum(1 for sz in layout.col_sizes if sz == 8)
+    pairs8 = None
+    if n8:
+        pairs8 = jnp.transpose(x[:2 * n8].reshape(n8, 2, rows2d.shape[0]),
+                               (0, 2, 1))                    # [n8, n, 2]
     cols = []
+    j8 = 0
     for i, dt in enumerate(layout.dtypes):
         sz = layout.col_sizes[i]
         w0 = plan.col_word[i]
         if sz == 8:
-            pair = jnp.stack([x[w0], x[w0 + 1]], axis=1)     # [n, 2]
+            pair = pairs8[j8]                                # [n, 2]
+            j8 += 1
             if jax.config.jax_enable_x64:
                 # [n, 2] u32 -> [n] u64 (trailing dim merges) -> dtype
                 data = jax.lax.bitcast_convert_type(
@@ -320,10 +492,11 @@ def _from_rows_mxu_jit(rows2d: jnp.ndarray, layout: RowLayout,
     return cols
 
 
-def from_rows_fixed(rows2d: jnp.ndarray, layout: RowLayout) -> List[Column]:
-    """Decode a [n, fixed_row_size] uint8 row matrix via the transposed
-    MXU permutation."""
-    return _from_rows_mxu_jit(rows2d, layout, _inverse_p3_device(layout))
+def from_rows_fixed(rows: jnp.ndarray, layout: RowLayout) -> List[Column]:
+    """Decode JCUDF rows (flat blob or [n, fixed_row_size]) via the
+    transposed MXU permutation."""
+    return _from_rows_mxu_jit(rows.reshape(-1), layout,
+                              _inverse_p3_device(layout))
 
 
 # ---------------------------------------------------------------------------
@@ -372,8 +545,9 @@ def words_to_bytes(w: jnp.ndarray, total: int) -> jnp.ndarray:
     w2 = w.reshape(-1, _WB)
     p3 = jnp.asarray(_w2b_p3_np())
     parts = []
-    for s in range(0, w2.shape[0], _DOT_CHUNK_ROWS):
-        e = min(w2.shape[0], s + _DOT_CHUNK_ROWS)
+    chunk = _dot_chunk_rows(4 * _WB)
+    for s in range(0, w2.shape[0], chunk):
+        e = min(w2.shape[0], s + chunk)
         xb = jax.lax.bitcast_convert_type(w2[s:e], jnp.uint8)
         parts.append(jax.lax.dot_general(
             xb.astype(jnp.int8), p3,
@@ -394,8 +568,9 @@ def bytes_to_words(b: jnp.ndarray, nwords: int) -> jnp.ndarray:
     b2 = b.reshape(-1, 4 * _WB)
     p3 = jnp.asarray(_b2w_p3_np())
     parts = []
-    for s in range(0, b2.shape[0], _DOT_CHUNK_ROWS):
-        e = min(b2.shape[0], s + _DOT_CHUNK_ROWS)
+    chunk = _dot_chunk_rows(4 * _WB)
+    for s in range(0, b2.shape[0], chunk):
+        e = min(b2.shape[0], s + chunk)
         o = jax.lax.dot_general(
             b2[s:e].astype(jnp.int8), p3,
             dimension_numbers=(((1,), (0,)), ((), ())),
