@@ -174,10 +174,7 @@ def _col_words(col: Column) -> List[jnp.ndarray]:
     data = col.data
     sz = col.dtype.itemsize
     if sz == 8:
-        if data.ndim == 2:           # no-x64 uint32-pair representation
-            return [data[:, 0].astype(jnp.uint32),
-                    data[:, 1].astype(jnp.uint32)]
-        pair = jax.lax.bitcast_convert_type(data, jnp.uint32)
+        pair = _col_words_pair(col)
         return [pair[:, 0], pair[:, 1]]
     if sz == 4:
         return [jax.lax.bitcast_convert_type(data, jnp.uint32)
@@ -349,8 +346,8 @@ def _validity_quads(table: Table, layout: RowLayout) -> jnp.ndarray:
 _DOT_CHUNK_ROWS = 512 * 1024  # floor for very wide rows
 
 
-def _dot_chunk_rows(row_size: int) -> int:
-    return max(_DOT_CHUNK_ROWS, (4 << 30) // (row_size * 4))
+def _dot_chunk_rows(row_size: int, budget: int = 4 << 30) -> int:
+    return max(_DOT_CHUNK_ROWS, budget // (row_size * 4))
 
 
 @functools.partial(jax.jit, static_argnums=(1, 4, 5))
@@ -392,13 +389,8 @@ def _inverse_p3_device(layout: RowLayout) -> jnp.ndarray:
 
 
 def _platform_of_table(table: Table) -> str:
-    for leaf in jax.tree_util.tree_leaves(table):
-        if isinstance(leaf, jax.Array):
-            try:
-                return next(iter(leaf.devices())).platform
-            except Exception:
-                continue
-    return jax.default_backend()
+    from spark_rapids_jni_tpu.ops.row_conversion import _platform_of
+    return _platform_of(table)
 
 
 def to_rows_fixed(table: Table, layout: RowLayout,
@@ -433,7 +425,9 @@ def _from_rows_mxu_jit(rows_flat: jnp.ndarray, layout: RowLayout,
     # copy of the whole blob on remote-tunnel backends
     rows2d = rows_flat.reshape(-1, layout.fixed_row_size)
     n = rows2d.shape[0]
-    chunk = _dot_chunk_rows(4 * plan.num_words)
+    # the [W, 4, ck] i32 temp plus its uint32 copy are both live through
+    # the combine, so the inverse runs best with a tighter budget
+    chunk = _dot_chunk_rows(4 * plan.num_words, budget=2 << 30)
     parts = []
     for s in range(0, max(n, 1), chunk):
         e = min(n, s + chunk)
@@ -446,13 +440,19 @@ def _from_rows_mxu_jit(rows_flat: jnp.ndarray, layout: RowLayout,
                      | (o[:, 2, :] << 16) | (o[:, 3, :] << 24))
     x = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
 
-    # validity planes: bit c of its byte, all columns -> packed masks
-    vcols = []
-    for c in range(layout.num_columns):
-        j = c // 8
-        byte = x[plan.validity_word[j]] >> (8 * plan.validity_byte[j])
-        vcols.append(((byte >> (c % 8)) & 1).astype(jnp.bool_))
-    vmask = pack_bools_2d(jnp.stack(vcols, axis=0))          # [ncols, nb]
+    # validity: expand the quad-packed validity byte planes to one bit
+    # plane per column in a handful of big ops (per-column expressions
+    # would cost ~ncols separate fusions)
+    ncols = layout.num_columns
+    vbytes = layout.validity_bytes
+    vw0 = plan.validity_word[0]
+    vwq = (vbytes + 3) // 4
+    vq = x[vw0:vw0 + vwq]                                    # [vwq, n]
+    vb = jnp.stack([(vq >> (8 * k)) & 0xFF for k in range(4)],
+                   axis=1).reshape(vwq * 4, -1)[:vbytes]     # [vbytes, n]
+    bits = jnp.stack([(vb >> b) & 1 for b in range(8)],
+                     axis=1).reshape(vbytes * 8, -1)[:ncols]
+    vmask = pack_bools_2d(bits.astype(jnp.bool_))            # [ncols, nb]
 
     # 64-bit columns sit first in the word plan as one contiguous plane
     # block: un-planarize them all with ONE batched transpose instead of a
