@@ -2,3 +2,6 @@ from spark_rapids_jni_tpu.parallel.mesh import make_mesh, shard_table  # noqa: F
 from spark_rapids_jni_tpu.parallel.shuffle import (  # noqa: F401
     ShuffleResult, shuffle_table_sharded,
 )
+from spark_rapids_jni_tpu.parallel.multihost import (  # noqa: F401
+    global_mesh, init_distributed, stage_table_global,
+)

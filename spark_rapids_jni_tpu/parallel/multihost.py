@@ -1,0 +1,110 @@
+"""Multi-host runner: process bring-up and host->global table staging.
+
+The reference's multi-node story lives in Spark (one GPU per executor
+process, NCCL/UCX above the kernel library — SURVEY.md §2 checklist).  The
+TPU-native equivalent is JAX multi-controller SPMD: every host runs the
+same program, ``jax.distributed.initialize`` wires the processes into one
+runtime, the mesh spans all global devices, and the collectives the
+shuffle/exchange layer emits (``all_to_all``/``ppermute``) ride ICI within
+a slice and DCN across slices — placement is the compiler's job, not a
+communication backend's.
+
+This module is the thin host-runtime half: bring-up (with the TPU-pod env
+auto-detection ``initialize`` already does), a global mesh builder, and
+staging of per-host numpy shards into one globally-sharded Table (the
+JNI-handle-passing boundary of the reference becomes
+``make_array_from_process_local_data``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_jni_tpu.table import Column, Table
+from spark_rapids_jni_tpu.parallel.mesh import make_mesh
+
+_initialized = False
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> int:
+    """Join the multi-process runtime; returns this process's id.
+
+    Single-process (no coordinator configured anywhere) is a no-op so the
+    same program runs unchanged on one host.  On TPU pods
+    ``jax.distributed.initialize`` auto-detects everything from the
+    metadata server; elsewhere pass the coordinator explicitly or set
+    ``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``.
+    """
+    global _initialized
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if not _initialized and (coordinator_address is not None
+                             or (num_processes or 1) > 1):
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+        _initialized = True
+    return jax.process_index()
+
+
+def global_mesh(axis_name: str = "data") -> Mesh:
+    """1-D mesh over every device of every process (ICI-major device
+    order, the default ``jax.devices()`` order)."""
+    return make_mesh(jax.devices(), axis_name)
+
+
+def stage_table_global(host_columns: Sequence[np.ndarray],
+                       dtypes, mesh: Mesh,
+                       validity: Optional[Sequence] = None,
+                       axis_name: str = "data") -> Table:
+    """Build a globally row-sharded Table from THIS process's local numpy
+    shard (every process calls this with its own rows; shards concatenate
+    in process order along the mesh axis).
+
+    Local row counts must be equal across processes and a multiple of 8
+    (packed validity bitmasks shard on byte boundaries).
+    """
+    spec = NamedSharding(mesh, P(axis_name))
+    naxis = mesh.shape[axis_name]
+    nproc = jax.process_count()
+    validity = validity if validity is not None else [None] * len(dtypes)
+    dtypes = tuple(dtypes)
+    if len(host_columns) != len(dtypes) or len(validity) != len(dtypes):
+        raise ValueError(
+            f"{len(host_columns)} columns / {len(validity)} validity "
+            f"entries for {len(dtypes)} dtypes")
+    cols = []
+    for vals, dt, valid in zip(host_columns, dtypes, validity):
+        if dt.is_string:
+            raise ValueError("global staging supports fixed-width columns "
+                             "only (strings ride the row-blob shuffle)")
+        vals = np.asarray(vals)
+        # packed validity bytes must split evenly over the devices this
+        # process feeds (same rule as mesh.shard_table, per process)
+        if len(vals) % (naxis // nproc * 8) != 0:
+            raise ValueError(
+                f"local rows ({len(vals)}) must be a multiple of 8x the "
+                f"process's device count ({naxis // nproc})")
+        # stage pure numpy: no device round trip before the real upload
+        vals = np.ascontiguousarray(vals.astype(dt.np_dtype, copy=False))
+        if dt.itemsize == 8 and not jax.config.jax_enable_x64:
+            vals = vals.view(np.uint32).reshape(-1, 2)
+        data = jax.make_array_from_process_local_data(spec, vals)
+        vmask = None
+        if valid is not None:
+            packed = np.packbits(np.asarray(valid, dtype=bool),
+                                 bitorder="little")
+            vmask = jax.make_array_from_process_local_data(spec, packed)
+        cols.append(Column(dt, data, vmask))
+    return Table(tuple(cols))

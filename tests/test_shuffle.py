@@ -107,3 +107,31 @@ def test_ring_exchange_overflow_flag(rng, mesh):
     res = shuffle_table_sharded(ts, key_cols=[0], mesh=mesh,
                                 capacity_factor=1.0, method="ring")
     assert bool(np.asarray(res.overflow)[0])
+
+
+def test_multihost_staging_single_process(rng, mesh):
+    """Single-process multihost bring-up is a no-op and global staging
+    produces a correctly sharded table (8-device CPU mesh: one process
+    owning all devices, local shard == global table)."""
+    from spark_rapids_jni_tpu.parallel import (
+        init_distributed, stage_table_global,
+    )
+    assert init_distributed() == 0
+    n = 8 * 16
+    key = rng.integers(0, 1 << 20, n, dtype=np.int64)
+    pay = rng.integers(-5, 5, n, dtype=np.int32)
+    valid = rng.random(n) > 0.3
+    t = stage_table_global([key, pay], [INT64, INT32], mesh,
+                           validity=[valid, None])
+    assert t.num_rows == n
+    got = np.asarray(t.columns[0].data)
+    ref = np.asarray(Column.from_numpy(key, INT64).data)
+    np.testing.assert_array_equal(got, ref)
+    np.testing.assert_array_equal(np.asarray(t.columns[0].valid_bools()),
+                                  valid)
+    # staged table flows through the sharded shuffle unchanged (generous
+    # capacity: 16 local rows per device skews hard across 8 buckets)
+    res = shuffle_table_sharded(t, key_cols=[0], mesh=mesh,
+                                capacity_factor=16.0)
+    assert not bool(np.asarray(res.overflow)[0])
+    assert int(np.asarray(res.num_valid).sum()) == n
