@@ -28,10 +28,23 @@ from spark_rapids_jni_tpu.table import Column, Table, pack_bools
 from spark_rapids_jni_tpu.ops.row_layout import RowLayout
 from spark_rapids_jni_tpu.ops import row_conversion as rc
 
-# Rows per grid step.  A 212-column/1KB-row tile at 512 rows is ~0.5MB in
-# VMEM for the output block plus ~the same across inputs — well under the
-# ~16MB budget, large enough to amortize DMA.
+# Rows per grid step.  Mosaic lane-pads every per-column [tile, size]
+# uint8 block to 128 lanes, so VMEM cost is ~(ncols + 2) * tile * 128
+# bytes double-buffered — the tile must shrink as schemas widen or the
+# kernel exceeds the ~16MB VMEM budget (this per-column-block design is
+# the straightforward translation of the reference's tiled kernels; the
+# production TPU path is the MXU engine in row_mxu.py, which avoids the
+# lane padding entirely).
 DEFAULT_TILE_ROWS = 512
+
+
+def _tile_rows_for(ncols: int) -> int:
+    # 6MB of blocks per pipeline stage: pallas double-buffers, so ~12MB of
+    # the ~16MB VMEM at peak.  Floor to 32 rows — uint8 native (32, 128)
+    # tiling keeps blocks sublane-aligned.
+    budget = 6 * 1024 * 1024
+    tile = budget // max(1, (ncols + 2) * 128)
+    return max(32, min(DEFAULT_TILE_ROWS, tile // 32 * 32))
 
 
 def _pad_rows(arr: jnp.ndarray, n_padded: int) -> jnp.ndarray:
@@ -93,9 +106,12 @@ def _to_rows_pallas(table: Table, layout: RowLayout,
 
 
 def to_rows_fixed(table: Table, layout: RowLayout,
-                  tile_rows: int = DEFAULT_TILE_ROWS,
+                  tile_rows: int = 0,
                   interpret: bool = False) -> jnp.ndarray:
-    """[n, fixed_row_size] uint8 row matrix via the Pallas tiled kernel."""
+    """[n, fixed_row_size] uint8 row matrix via the Pallas tiled kernel.
+    ``tile_rows=0`` sizes the tile to the schema's VMEM footprint."""
+    if tile_rows <= 0:
+        tile_rows = _tile_rows_for(layout.num_columns)
     return _to_rows_pallas(table, layout, tile_rows, interpret)
 
 
@@ -149,6 +165,8 @@ def _from_rows_pallas(rows2d: jnp.ndarray, layout: RowLayout,
 
 
 def from_rows_fixed(rows2d: jnp.ndarray, layout: RowLayout,
-                    tile_rows: int = DEFAULT_TILE_ROWS,
+                    tile_rows: int = 0,
                     interpret: bool = False) -> List[Column]:
+    if tile_rows <= 0:
+        tile_rows = _tile_rows_for(layout.num_columns)
     return _from_rows_pallas(rows2d, layout, tile_rows, interpret)
