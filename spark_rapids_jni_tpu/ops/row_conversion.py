@@ -539,9 +539,15 @@ def _to_rows_variable_jit(table: Table, row_offsets: jnp.ndarray,
 
     nwords = total_bytes // 4                              # rows 8B-aligned
     out = jnp.zeros((nwords,), dtype=jnp.uint32)
-    dst_w = (row_offsets[:-1, None] // 4
-             + jnp.arange(fe_pad // 4, dtype=jnp.int32)[None, :])
-    out = out.at[dst_w.reshape(-1)].set(f_words.reshape(-1))
+    if nwords >= fe_pad // 4:  # else: empty batch, nothing to place
+        # one contiguous fe_pad/4-word window per row: a slice-scatter
+        # runs ~4x faster than the equivalent element scatter on TPU
+        out = jax.lax.scatter(
+            out, (row_offsets[:-1, None] // 4).astype(jnp.int32), f_words,
+            jax.lax.ScatterDimensionNumbers(
+                update_window_dims=(1,), inserted_window_dims=(),
+                scatter_dims_to_operand_dims=(0,)),
+            mode=jax.lax.GatherScatterMode.CLIP)
     # chars: word index + byte-lane shift, scatter-add per string column.
     # (fixed_end may not be 4-aligned, but rows are: dst_pos is exact.)
     for si, (c, total) in enumerate(zip(scols, char_totals)):
@@ -672,6 +678,7 @@ def _extract_fixed_variable_jit(data: jnp.ndarray, offsets: jnp.ndarray,
     4x smaller index matrix than byte gathers, and no u8[*, 4] tiled
     intermediates), then extract every column's data and packed validity
     mask in the same program."""
+    n = offsets.shape[0] - 1
     fe_pad = (layout.fixed_end + 3) // 4 * 4
     nwords = data.shape[0] // 4
     from spark_rapids_jni_tpu.ops import row_mxu
@@ -680,9 +687,18 @@ def _extract_fixed_variable_jit(data: jnp.ndarray, offsets: jnp.ndarray,
     # four byte-plane gathers of just the fixed sections — quadruples the
     # gather element count, and gathers are the slow primitive here
     words = row_mxu.bytes_to_words(data, nwords)
-    idx_w = (offsets[:-1, None] // 4
-             + jnp.arange(fe_pad // 4, dtype=jnp.int32)[None, :])
-    f_words = words[jnp.minimum(idx_w, max(nwords - 1, 0))]
+    if nwords < fe_pad // 4:  # empty/degenerate batch
+        f_words = jnp.zeros((n, fe_pad // 4), jnp.uint32)
+    else:
+        # one contiguous window per row (slice gather ~4x faster than the
+        # element gather with an [n, fe/4] index matrix)
+        f_words = jax.lax.gather(
+            words, (offsets[:-1, None] // 4).astype(jnp.int32),
+            jax.lax.GatherDimensionNumbers(
+                offset_dims=(1,), collapsed_slice_dims=(),
+                start_index_map=(0,)),
+            slice_sizes=(fe_pad // 4,),
+            mode=jax.lax.GatherScatterMode.CLIP)
     valid_cols = []
     for i in range(layout.num_columns):
         j = layout.validity_offset + i // 8

@@ -140,3 +140,14 @@ def test_mixed_with_fixed_width_sweep(rng):
     [rows] = convert_to_rows(t)
     got = convert_from_rows(rows, t.dtypes)
     assert_tables_equivalent(t, got)
+
+
+def test_zero_row_string_table_roundtrip():
+    """Empty batches must flow through the slice-window scatter/gather
+    paths (regression: scatter window exceeded a 0-word operand)."""
+    t = Table((Column.from_numpy(np.zeros(0, np.int32), INT32),
+               Column.strings([])))
+    [rows] = convert_to_rows(t)
+    assert rows.num_rows == 0
+    rt = convert_from_rows(rows, t.dtypes)
+    assert rt.num_rows == 0 and rt.num_columns == 2
