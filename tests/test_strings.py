@@ -151,3 +151,26 @@ def test_zero_row_string_table_roundtrip():
     assert rows.num_rows == 0
     rt = convert_from_rows(rows, t.dtypes)
     assert rt.num_rows == 0 and rt.num_columns == 2
+
+
+def test_long_string_fallback_roundtrip():
+    """Columns whose longest string exceeds the largest window bucket use
+    the per-char fallback; mixed with a windowed column in one table."""
+    long = "x" * 5000
+    vals_a = ["short", long, "", "mid" * 10, None]
+    vals_b = ["a", "bb", None, "dddd", "e"]
+    t = Table((Column.strings(vals_a),
+               Column.from_numpy(np.arange(5, dtype=np.int32), INT32),
+               Column.strings(vals_b)))
+    [rows] = convert_to_rows(t)
+    rt = convert_from_rows(rows, t.dtypes)
+    assert rt.to_pydict() == t.to_pydict()
+    # byte-level check via the native decoder (cross-engine)
+    from spark_rapids_jni_tpu.ops.native_rows import (
+        decode_variable_native, native_available)
+    if native_available():
+        cols, vals, soffs, chars = decode_variable_native(
+            np.asarray(rows.data), np.asarray(rows.offsets).astype(np.int64),
+            t.dtypes)
+        got = bytes(chars[0]).decode()
+        assert got == "short" + long + "mid" * 10
