@@ -212,7 +212,8 @@ def _to_rows_fixed_jit(table: Table, layout: RowLayout,
     from spark_rapids_jni_tpu.table import slice_table_dynamic
     if size is not None and size != table.num_rows:
         table = slice_table_dynamic(table, start, size)
-    return _assemble_fixed_rows(table, layout)
+    # flat: the blob contract is 1-D and an eager reshape would copy
+    return _assemble_fixed_rows(table, layout).reshape(-1)
 
 
 def _disassemble_fixed_rows(rows2d: jnp.ndarray,
@@ -384,7 +385,8 @@ def convert_to_rows(table: Table, *, size_limit: int = MAX_BATCH_BYTES,
     n = table.num_rows
     chunk = min(size_limit, 1 << 30)
     if len(plan_fixed_batches(n, layout.fixed_row_size, chunk)) == 1:
-        return _batch_rows2d(encode(), layout, size_limit)
+        offsets = jnp.arange(n + 1, dtype=jnp.int32) * layout.fixed_row_size
+        return [RowsColumn(encode(), offsets)]
     # multi-batch: encode per batch (sliced inside the jit with a traced
     # start) so peak memory stays ~one batch of transients, the way the
     # reference converts per row-batch (row_conversion.cu:1768-1830).
@@ -397,10 +399,9 @@ def convert_to_rows(table: Table, *, size_limit: int = MAX_BATCH_BYTES,
     out = []
     for start in range(0, n, per):
         size = min(per, n - start)
-        rows2d = encode(start, size)
         offsets = jnp.arange(size + 1,
                              dtype=jnp.int32) * layout.fixed_row_size
-        out.append(RowsColumn(rows2d.reshape(-1), offsets))
+        out.append(RowsColumn(encode(start, size), offsets))
     return out
 
 

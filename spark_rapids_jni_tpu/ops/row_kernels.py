@@ -102,14 +102,16 @@ def _to_rows_pallas(table: Table, layout: RowLayout,
                                        jnp.uint8),
         interpret=interpret,
     )(*col_bytes, validity)
-    return rows[:n]
+    # flat: the blob contract is 1-D; flattening inside the jit is free
+    return rows[:n].reshape(-1)
 
 
 def to_rows_fixed(table: Table, layout: RowLayout,
                   tile_rows: int = 0,
                   interpret: bool = False) -> jnp.ndarray:
-    """[n, fixed_row_size] uint8 row matrix via the Pallas tiled kernel.
-    ``tile_rows=0`` sizes the tile to the schema's VMEM footprint."""
+    """Flat uint8 JCUDF rows (n * fixed_row_size) via the Pallas tiled
+    kernel.  ``tile_rows=0`` sizes the tile to the schema's VMEM
+    footprint."""
     if tile_rows <= 0:
         tile_rows = _tile_rows_for(layout.num_columns)
     return _to_rows_pallas(table, layout, tile_rows, interpret)

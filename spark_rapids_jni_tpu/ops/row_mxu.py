@@ -334,7 +334,7 @@ def _validity_quads(table: Table, layout: RowLayout) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
-# Encode: table -> [n, fixed_row_size] uint8
+# Encode: table -> flat uint8 JCUDF rows (n * fixed_row_size)
 # ---------------------------------------------------------------------------
 
 # The int8 dots accumulate in int32: an unfused convert materializes a
@@ -375,7 +375,10 @@ def _to_rows_mxu_jit(table: Table, layout: RowLayout, p3: jnp.ndarray,
             dimension_numbers=(((0, 2), (0, 1)), ((), ())),
             preferred_element_type=jnp.int32)
         parts.append(rows.astype(jnp.uint8))
-    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+    rows = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+    # flatten inside the jit: the blob contract is 1-D and an eager
+    # reshape would dispatch a full-blob copy
+    return rows.reshape(-1)
 
 
 @functools.lru_cache(maxsize=64)
@@ -395,7 +398,7 @@ def _platform_of_table(table: Table) -> str:
 
 def to_rows_fixed(table: Table, layout: RowLayout,
                   start: int = 0, size=None, pack=None) -> jnp.ndarray:
-    """[n, fixed_row_size] uint8 rows via the MXU permutation matmul.
+    """Flat uint8 JCUDF rows (n * fixed_row_size) via the MXU matmul.
     ``start``/``size`` encode one row-batch, slicing inside the jit (the
     sub-table is never materialized; ``start`` is traced so equally-sized
     batches share one executable).  ``pack`` selects the plane-matrix
