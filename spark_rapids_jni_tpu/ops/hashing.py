@@ -11,6 +11,13 @@ contract directly):
   result as the seed for the next column — bit-exact with Spark's
   ``Murmur3_x86_32`` for int/long/float/double/bool/decimal(64) inputs.
 - ``xxhash64``: Spark's ``XxHash64`` expression (seed 42), same chaining.
+- Strings hash their UTF-8 byte stream: murmur3 as Spark's
+  ``hashUnsafeBytes`` (4-byte little-endian blocks, then each tail byte
+  *sign-extended* and mixed as a full block), xxhash64 as ``XXH64``'s full
+  byte-stream (32-byte accumulator chunks, 8-byte stripes, one 4-byte
+  block, byte tail).  Vectorized over a dense ``[n, W]`` padded window (W =
+  max string length in the column) with per-row length masking — no ragged
+  loops, everything stays shape-static for XLA.
 
 All arithmetic is lane-width uint32 (murmur3) so it vectorizes on the TPU
 VPU without 64-bit lanes; xxhash64 runs on emulated uint32 pairs for the
@@ -20,10 +27,11 @@ same reason.  Everything is shape-static and fuses into one XLA program.
 from __future__ import annotations
 
 import functools
-from typing import Sequence
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spark_rapids_jni_tpu.table import Column, Table
 
@@ -105,23 +113,131 @@ def _as_u32_words(col: Column):
     return jax.lax.bitcast_convert_type(as_i32, jnp.uint32)[:, None]
 
 
-def murmur3_hash(table_or_cols, seed: int = DEFAULT_SEED) -> jnp.ndarray:
+# ---------------------------------------------------------------------------
+# String byte-stream windows
+# ---------------------------------------------------------------------------
+
+def _string_window(col: Column, W: int):
+    """Dense padded byte window of a string column: uint8 [n, W] (zeros past
+    each string's length) plus int32 lengths [n].  One contiguous W-byte
+    slice-gather per row — the fast gather shape on TPU (cf. the
+    slice-window gathers in ``row_conversion._extract_fixed_variable_jit``).
+    """
+    offs = col.offsets.astype(jnp.int32)
+    lens = offs[1:] - offs[:-1]
+    n = lens.shape[0]
+    if W == 0:
+        return jnp.zeros((n, 0), jnp.uint8), lens
+    chars = col.chars
+    # pad so a window starting at the last offset stays in bounds
+    padded = jnp.concatenate([chars, jnp.zeros((W,), jnp.uint8)])
+    b = jax.lax.gather(
+        padded, offs[:-1, None],
+        jax.lax.GatherDimensionNumbers(
+            offset_dims=(1,), collapsed_slice_dims=(),
+            start_index_map=(0,)),
+        slice_sizes=(W,), mode=jax.lax.GatherScatterMode.CLIP)
+    mask = jnp.arange(W, dtype=jnp.int32)[None, :] < lens[:, None]
+    return jnp.where(mask, b, jnp.uint8(0)), lens
+
+
+def _bytes_to_u32_lanes(b: jnp.ndarray) -> jnp.ndarray:
+    """[n, W] uint8 (W % 4 == 0) -> [n, W//4] little-endian uint32 words via
+    strided lane slices (a bitcast's [n, W/4, 4] intermediate would pad the
+    4-lane minor dim 32x on TPU)."""
+    return (b[:, 0::4].astype(jnp.uint32)
+            | (b[:, 1::4].astype(jnp.uint32) << 8)
+            | (b[:, 2::4].astype(jnp.uint32) << 16)
+            | (b[:, 3::4].astype(jnp.uint32) << 24))
+
+
+def _byte_at(b: jnp.ndarray, pos: jnp.ndarray) -> jnp.ndarray:
+    """Per-row byte at data-dependent position (clamped; callers mask)."""
+    W = b.shape[1]
+    idx = jnp.clip(pos, 0, W - 1)[:, None]
+    return jnp.take_along_axis(b, idx, axis=1)[:, 0]
+
+
+def _word_at(w: jnp.ndarray, pos: jnp.ndarray) -> jnp.ndarray:
+    nw = w.shape[1]
+    idx = jnp.clip(pos, 0, nw - 1)[:, None]
+    return jnp.take_along_axis(w, idx, axis=1)[:, 0]
+
+
+def _resolve_str_window(cols, max_str_len: Optional[int]) -> int:
+    """Static W for the padded windows.  Host-syncs the offsets when the
+    caller didn't provide a bound — callers under jit/shard_map must pass
+    ``max_str_len`` (the analogue of the reference's host sync before
+    data-dependent kernel planning, ``row_conversion.cu:1521``)."""
+    concrete = all(not isinstance(c.offsets, jax.core.Tracer)
+                   for c in cols if c.dtype.is_string)
+    W = 0
+    if concrete:
+        for col in cols:
+            if col.dtype.is_string and col.offsets.shape[0] > 1:
+                offs = np.asarray(col.offsets)
+                W = max(W, int(np.max(offs[1:] - offs[:-1])))
+    if max_str_len is not None:
+        # an undersized window would silently truncate the byte stream —
+        # validate whenever the offsets are concrete (free in eager mode)
+        if concrete and W > int(max_str_len):
+            raise ValueError(
+                f"max_str_len={max_str_len} < actual max string length {W}")
+        return int(max_str_len)
+    if not concrete:
+        raise ValueError(
+            "string hashing under jit requires an explicit max_str_len")
+    return W
+
+
+def _mm3_string_col(col: Column, h: jnp.ndarray, W: int) -> jnp.ndarray:
+    """Spark ``Murmur3_x86_32.hashUnsafeBytes``: little-endian 4-byte blocks,
+    then each tail byte sign-extended and mixed as its own block, fmix with
+    the byte length."""
+    Wp = (W + 3) // 4 * 4
+    b, lens = _string_window(col, Wp)
+    nblocks = lens // 4
+    hc = h
+    if Wp:
+        words = _bytes_to_u32_lanes(b)
+        for j in range(Wp // 4):
+            mixed = _mm3_mix_h1(hc, words[:, j])
+            hc = jnp.where(j < nblocks, mixed, hc)
+        for t in range(3):
+            pos = nblocks * 4 + t
+            # Java's getByte sign-extends: 0x80.. bytes mix as negative ints
+            byte = _byte_at(b, pos)
+            k1 = jax.lax.bitcast_convert_type(
+                byte.astype(jnp.int8).astype(jnp.int32), jnp.uint32)
+            hc = jnp.where(pos < lens, _mm3_mix_h1(hc, k1), hc)
+    return _mm3_fmix(hc, lens)
+
+
+def murmur3_hash(table_or_cols, seed: int = DEFAULT_SEED,
+                 max_str_len: Optional[int] = None) -> jnp.ndarray:
     """Spark ``Murmur3Hash(cols)``: returns int32 [n].
 
     Null rows of a column leave the running hash unchanged (Spark skips
-    null fields).
+    null fields).  String columns hash their UTF-8 bytes; pass
+    ``max_str_len`` when calling under jit (otherwise it is derived from
+    the offsets with a host sync).
     """
     cols = (table_or_cols.columns if isinstance(table_or_cols, Table)
             else tuple(table_or_cols))
     n = cols[0].num_rows
+    W = _resolve_str_window(cols, max_str_len) \
+        if any(c.dtype.is_string for c in cols) else 0
     h = jnp.full((n,), seed, dtype=jnp.uint32)
     for col in cols:
-        words = _as_u32_words(col)
-        nwords = words.shape[1]
-        hc = h
-        for w in range(nwords):
-            hc = _mm3_mix_h1(hc, words[:, w])
-        hc = _mm3_fmix(hc, nwords * 4)
+        if col.dtype.is_string:
+            hc = _mm3_string_col(col, h, W)
+        else:
+            words = _as_u32_words(col)
+            nwords = words.shape[1]
+            hc = h
+            for w in range(nwords):
+                hc = _mm3_mix_h1(hc, words[:, w])
+            hc = _mm3_fmix(hc, nwords * 4)
         if col.validity is not None:
             h = jnp.where(col.valid_bools(), hc, h)
         else:
@@ -136,9 +252,11 @@ def pmod(hashes: jnp.ndarray, divisor: int) -> jnp.ndarray:
 
 
 def hash_partition_ids(table_or_cols, num_partitions: int,
-                       seed: int = DEFAULT_SEED) -> jnp.ndarray:
+                       seed: int = DEFAULT_SEED,
+                       max_str_len: Optional[int] = None) -> jnp.ndarray:
     """Row -> partition id, exactly as Spark HashPartitioning does."""
-    return pmod(murmur3_hash(table_or_cols, seed), num_partitions)
+    return pmod(murmur3_hash(table_or_cols, seed, max_str_len),
+                num_partitions)
 
 
 # ---------------------------------------------------------------------------
@@ -236,24 +354,119 @@ def _col_u64_blocks(col: Column):
     return (words[:, 1], words[:, 0])  # little-endian pair -> (hi, lo)
 
 
-def xxhash64(table_or_cols, seed: int = DEFAULT_SEED) -> jnp.ndarray:
+def _where64(cond, a, b):
+    return (jnp.where(cond, a[0], b[0]), jnp.where(cond, a[1], b[1]))
+
+
+def _const64(v: int):
+    """A python 64-bit constant as a (hi, lo) uint32 pair."""
+    v &= 0xFFFFFFFFFFFFFFFF
+    return _u64(v >> 32, v & 0xFFFFFFFF)
+
+
+# the primes as plain ints, derived from the single (hi, lo) source above
+_XXP1_I = (_XXP1[0] << 32) | _XXP1[1]
+_XXP2_I = (_XXP2[0] << 32) | _XXP2[1]
+
+
+def _xx64_string_col(col: Column, h, W: int):
+    """Spark ``XXH64.hashUnsafeBytes`` over UTF-8 bytes, seeded by the
+    running hash ``h``: 32-byte accumulator chunks (v1..v4) while
+    ``offset <= len-32``, +length, 8-byte stripes, one 4-byte block if
+    >=4 bytes remain, then single bytes; finally avalanche.  All loops are
+    static over the padded window with per-row masks."""
+    Wp = (W + 7) // 8 * 8
+    b, lens = _string_window(col, Wp)
+    n = lens.shape[0]
+    zeros = jnp.zeros((n,), jnp.uint32)
+    words = _bytes_to_u32_lanes(b) if Wp else jnp.zeros((n, 0), jnp.uint32)
+
+    def w64(j):  # j-th little-endian 8-byte word as (hi, lo)
+        return (words[:, 2 * j + 1], words[:, 2 * j])
+
+    seed = h
+    # --- >=32-byte accumulator path ---
+    nchunks = lens // 32                       # chunks while offset<=len-32
+    if Wp >= 32:
+        v1 = _add64(seed, _const64(_XXP1_I + _XXP2_I))
+        v2 = _add64(seed, _const64(_XXP2_I))
+        v3 = seed
+        v4 = _add64(seed, _const64(-_XXP1_I))
+        for g in range(Wp // 32):
+            active = g < nchunks
+            v1 = _where64(active, _xx_round(v1, w64(4 * g)), v1)
+            v2 = _where64(active, _xx_round(v2, w64(4 * g + 1)), v2)
+            v3 = _where64(active, _xx_round(v3, w64(4 * g + 2)), v3)
+            v4 = _where64(active, _xx_round(v4, w64(4 * g + 3)), v4)
+        big = _add64(_add64(_rotl64(v1, 1), _rotl64(v2, 7)),
+                     _add64(_rotl64(v3, 12), _rotl64(v4, 18)))
+
+        def merge(acc, v):
+            acc = _xor64(acc, _xx_round((zeros, zeros), v))
+            return _add64(_mul64(acc, _u64(*_XXP1)), _u64(*_XXP4))
+        big = merge(merge(merge(merge(big, v1), v2), v3), v4)
+        hash_ = _where64(lens >= 32, big, _add64(seed, _u64(*_XXP5)))
+    else:
+        hash_ = _add64(seed, _u64(*_XXP5))
+    hash_ = _add64(hash_, (zeros, lens.astype(jnp.uint32)))
+
+    # --- 8-byte stripes: longs j in [nchunks*4, lens//8) ---
+    nlongs = lens // 8
+    for j in range(Wp // 8):
+        active = (j >= nchunks * 4) & (j < nlongs)
+        k1 = _xx_round((zeros, zeros), w64(j))
+        upd = _add64(_mul64(_rotl64(_xor64(hash_, k1), 27), _u64(*_XXP1)),
+                     _u64(*_XXP4))
+        hash_ = _where64(active, upd, hash_)
+
+    # --- one 4-byte block if len%8 >= 4 (at u32-word index nlongs*2) ---
+    if Wp:
+        has4 = (lens % 8) >= 4
+        w32 = _word_at(words, nlongs * 2)
+        upd = _add64(_mul64(_rotl64(
+            _xor64(hash_, _mul64((zeros, w32), _u64(*_XXP1))), 23),
+            _u64(*_XXP2)), _u64(*_XXP3))
+        hash_ = _where64(has4, upd, hash_)
+
+        # --- byte tail: positions [nlongs*8 + (4 if has4), len); after the
+        # stripes the remainder is len%8 (0..7) and has4 consumes 4 of it,
+        # so at most 3 bytes can ever be active ---
+        tail_start = nlongs * 8 + jnp.where(has4, 4, 0).astype(jnp.int32)
+        for t in range(3):
+            pos = tail_start + t
+            byte = _byte_at(b, pos).astype(jnp.uint32)
+            upd = _mul64(_rotl64(
+                _xor64(hash_, _mul64((zeros, byte), _u64(*_XXP5))), 11),
+                _u64(*_XXP1))
+            hash_ = _where64(pos < lens, upd, hash_)
+    return _xx_fmix(hash_)
+
+
+def xxhash64(table_or_cols, seed: int = DEFAULT_SEED,
+             max_str_len: Optional[int] = None) -> jnp.ndarray:
     """Spark ``XxHash64(cols)``: returns the hash as uint32 (hi, lo) pair
     stacked into an [n, 2] array (lo word first), chaining per column with
-    null fields skipped."""
+    null fields skipped.  String columns hash their UTF-8 byte stream; pass
+    ``max_str_len`` when calling under jit."""
     cols = (table_or_cols.columns if isinstance(table_or_cols, Table)
             else tuple(table_or_cols))
     n = cols[0].num_rows
+    W = _resolve_str_window(cols, max_str_len) \
+        if any(c.dtype.is_string for c in cols) else 0
     zeros = jnp.zeros((n,), jnp.uint32)
     h = (zeros, zeros + jnp.uint32(seed))  # seed < 2^32 in practice
     for col in cols:
-        blk = _col_u64_blocks(col)
-        # single 8-byte block path: h = seed + P5 + 8 ... per xxhash64 spec
-        hc = _add64(_add64(h, _u64(*_XXP5)), _u64(0, 8))
-        k1 = _xx_round((zeros, zeros), blk)
-        hc = _xor64(hc, k1)
-        hc = _rotl64(hc, 27)
-        hc = _add64(_mul64(hc, _u64(*_XXP1)), _u64(*_XXP4))
-        hc = _xx_fmix(hc)
+        if col.dtype.is_string:
+            hc = _xx64_string_col(col, h, W)
+        else:
+            blk = _col_u64_blocks(col)
+            # single 8-byte block path: h = seed + P5 + 8, per xxhash64 spec
+            hc = _add64(_add64(h, _u64(*_XXP5)), _u64(0, 8))
+            k1 = _xx_round((zeros, zeros), blk)
+            hc = _xor64(hc, k1)
+            hc = _rotl64(hc, 27)
+            hc = _add64(_mul64(hc, _u64(*_XXP1)), _u64(*_XXP4))
+            hc = _xx_fmix(hc)
         if col.validity is not None:
             v = col.valid_bools()
             hc = (jnp.where(v, hc[0], h[0]), jnp.where(v, hc[1], h[1]))

@@ -211,3 +211,161 @@ def test_murmur3_float32_nan_canonicalized():
     vals = raw.view(np.float32)
     h = np.asarray(murmur3_hash([Column(FLOAT32, jnp.asarray(vals))]))
     assert h[0] == h[1] == h[2]
+
+
+# -- string byte-stream hashing ---------------------------------------------
+#
+# Scalar oracles written from the Spark algorithm specs:
+# Murmur3_x86_32.hashUnsafeBytes (4-byte LE blocks + per-byte sign-extended
+# tail) and XXH64.hashUnsafeBytes (32B chunks, 8B stripes, 4B block, bytes).
+
+def mm3_hash_bytes(data: bytes, seed):
+    h1 = seed & MASK32
+    length = len(data)
+    aligned = length - length % 4
+    for i in range(0, aligned, 4):
+        block = int.from_bytes(data[i:i + 4], "little")
+        h1 = mm3_mix_h1(h1, block)
+    for i in range(aligned, length):
+        byte = data[i] - 256 if data[i] >= 128 else data[i]  # sign-extend
+        h1 = mm3_mix_h1(h1, byte & MASK32)
+    return mm3_fmix(h1, length)
+
+
+def xx64_round(acc, inp):
+    return (_rotl((acc + inp * XP2) & MASK64, 31, 64) * XP1) & MASK64
+
+
+def xx64_hash_bytes(data: bytes, seed):
+    length = len(data)
+    offset = 0
+    if length >= 32:
+        v1 = (seed + XP1 + XP2) & MASK64
+        v2 = (seed + XP2) & MASK64
+        v3 = seed & MASK64
+        v4 = (seed - XP1) & MASK64
+        while offset <= length - 32:
+            v1 = xx64_round(v1, int.from_bytes(data[offset:offset + 8], "little"))
+            v2 = xx64_round(v2, int.from_bytes(data[offset + 8:offset + 16], "little"))
+            v3 = xx64_round(v3, int.from_bytes(data[offset + 16:offset + 24], "little"))
+            v4 = xx64_round(v4, int.from_bytes(data[offset + 24:offset + 32], "little"))
+            offset += 32
+        h = (_rotl(v1, 1, 64) + _rotl(v2, 7, 64) + _rotl(v3, 12, 64)
+             + _rotl(v4, 18, 64)) & MASK64
+        for v in (v1, v2, v3, v4):
+            h = ((h ^ xx64_round(0, v)) * XP1 + XP4) & MASK64
+    else:
+        h = (seed + XP5) & MASK64
+    h = (h + length) & MASK64
+    while offset <= length - 8:
+        k1 = xx64_round(0, int.from_bytes(data[offset:offset + 8], "little"))
+        h = (_rotl(h ^ k1, 27, 64) * XP1 + XP4) & MASK64
+        offset += 8
+    if offset + 4 <= length:
+        w = int.from_bytes(data[offset:offset + 4], "little")
+        h = (_rotl(h ^ (w * XP1) & MASK64, 23, 64) * XP2 + XP3) & MASK64
+        offset += 4
+    while offset < length:
+        h = (_rotl(h ^ (data[offset] * XP5) & MASK64, 11, 64) * XP1) & MASK64
+        offset += 1
+    h ^= h >> 33
+    h = (h * XP2) & MASK64
+    h ^= h >> 29
+    h = (h * XP3) & MASK64
+    return h ^ (h >> 32)
+
+
+STR_CASES = [
+    "", "a", "ab", "abc", "abcd", "abcde", "hello world",
+    "exactly-8", "0123456789abcdef",            # 8/16-byte multiples
+    "x" * 31, "y" * 32, "z" * 33,               # around the 32B chunk edge
+    "q" * 40, "w" * 64, "m" * 65, "t" * 100,    # multi-chunk + stripes
+    "é世界",                        # multi-byte UTF-8
+    "\x80\xff\x01 high bytes \x9a",              # sign-extension tail bytes
+]
+
+
+def _str_col(values):
+    return Column.strings(values)
+
+
+def test_murmur3_strings_vs_scalar():
+    col = _str_col(STR_CASES)
+    got = np.asarray(murmur3_hash([col]))
+    exp = [as_i32(mm3_hash_bytes(s.encode("utf-8", "surrogateescape")
+                                 if isinstance(s, str) else s, 42))
+           for s in STR_CASES]
+    np.testing.assert_array_equal(got, exp)
+
+
+def test_murmur3_strings_tail_sign_extension():
+    # a tail byte >= 0x80 must mix as a negative int (Java getByte)
+    col = _str_col(["abcd\x80", "abcd\x01"])
+    got = np.asarray(murmur3_hash([col]))
+    exp = [as_i32(mm3_hash_bytes("abcd\x80".encode(), 42)),
+           as_i32(mm3_hash_bytes(b"abcd\x01", 42))]
+    np.testing.assert_array_equal(got, exp)
+
+
+def test_xxhash64_strings_vs_scalar():
+    col = _str_col(STR_CASES)
+    got = np.asarray(xxhash64([col])).astype(np.uint64)
+    combined = got[:, 0] | (got[:, 1] << np.uint64(32))
+    exp = np.array([xx64_hash_bytes(s.encode("utf-8"), 42)
+                    for s in STR_CASES], np.uint64)
+    np.testing.assert_array_equal(combined, exp)
+
+
+def test_string_hash_null_skips_and_empty_mixes():
+    col = _str_col(["abc", None, ""])
+    got = np.asarray(murmur3_hash([col]))
+    assert got[1] == 42                       # null: hash unchanged (= seed)
+    assert got[2] == as_i32(mm3_hash_bytes(b"", 42))  # empty still mixes
+    assert got[2] != 42
+
+
+def test_string_hash_chained_with_fixed(rng):
+    vals = np.array([7, -3, 100], np.int32)
+    col = _str_col(["spark", "", "tpu-row"])
+    got = np.asarray(murmur3_hash(
+        [Column.from_numpy(vals, INT32), col]))
+    exp = [as_i32(mm3_hash_bytes(s.encode(),
+                                 spark_hash_int(int(v) & MASK32, 42)))
+           for v, s in zip(vals, ["spark", "", "tpu-row"])]
+    np.testing.assert_array_equal(got, exp)
+
+
+def test_xxhash64_strings_random_lengths(rng):
+    import random
+    r = random.Random(7)
+    vals = ["".join(chr(r.randrange(32, 127)) for _ in range(r.randrange(0, 90)))
+            for _ in range(64)]
+    col = _str_col(vals)
+    got = np.asarray(xxhash64([col])).astype(np.uint64)
+    combined = got[:, 0] | (got[:, 1] << np.uint64(32))
+    exp = np.array([xx64_hash_bytes(s.encode(), 42) for s in vals],
+                   np.uint64)
+    np.testing.assert_array_equal(combined, exp)
+
+
+def test_murmur3_strings_random_lengths():
+    import random
+    r = random.Random(11)
+    vals = ["".join(chr(r.randrange(1, 256)) for _ in range(r.randrange(0, 70)))
+            for _ in range(64)]
+    col = _str_col(vals)
+    got = np.asarray(murmur3_hash([col]))
+    exp = [as_i32(mm3_hash_bytes(s.encode("utf-8"), 42)) for s in vals]
+    np.testing.assert_array_equal(got, exp)
+
+
+def test_string_hash_explicit_window_matches():
+    """max_str_len larger than needed must not change results (jit callers
+    pass a static bound)."""
+    col = _str_col(["abc", "defghij", ""])
+    a = np.asarray(murmur3_hash([col]))
+    b = np.asarray(murmur3_hash([col], max_str_len=64))
+    np.testing.assert_array_equal(a, b)
+    xa = np.asarray(xxhash64([col]))
+    xb = np.asarray(xxhash64([col], max_str_len=64))
+    np.testing.assert_array_equal(xa, xb)
