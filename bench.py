@@ -125,7 +125,10 @@ def _table_bytes(table):
     total = 0
     for c in table.columns:
         if c.dtype.is_string:
-            total += c.chars.nbytes + c.offsets.nbytes
+            total += (c.chars2d.nbytes if c.chars2d is not None
+                      else c.chars.nbytes)
+            total += (c.offsets.nbytes if c.offsets is not None
+                      else c.lens.nbytes)
         else:
             total += c.data.nbytes
         if c.validity is not None:
@@ -175,6 +178,10 @@ def bench_fixed(num_rows, num_cols=212, use_pallas=None):
 
 
 def bench_variable(num_rows, num_cols=155, with_strings=True):
+    """The reference's mixed axis: 155 columns +/- 25 string columns
+    (``benchmarks/row_conversion.cpp:75-78, 145-149``).  Strings ride the
+    dense-padded engine (device-native layout), so the whole conversion is
+    static-shape concatenate/slice work."""
     base = cycle_dtypes(FIXED_DTYPES, num_cols - (25 if with_strings else 0))
     dtypes = base + ([STRING] * 25 if with_strings else [])
     profile = DataProfile(string_len_min=0, string_len_max=32)
@@ -194,6 +201,7 @@ def bench_variable(num_rows, num_cols=155, with_strings=True):
         "num_rows": num_rows,
         "num_cols": num_cols,
         "strings": with_strings,
+        "padded_rows": bool(batches[0].is_padded),
         "to_rows_s": t_to,
         "to_rows_GBps": moved / t_to / 1e9,
         "from_rows_s": t_from,
@@ -201,12 +209,109 @@ def bench_variable(num_rows, num_cols=155, with_strings=True):
     }
 
 
+# v5e headline HBM bandwidth, for %-of-peak reporting on memory-bound ops
+_HBM_GBPS = 819.0
+
+
 def _run_axis(axis: str):
     """Run one benchmark axis in this process and print its result JSON."""
     kind, n = axis.split(":")
-    res = (bench_fixed(int(n)) if kind == "fixed"
-           else bench_variable(int(n)))
+    if kind == "fixed":
+        res = bench_fixed(int(n))
+    elif kind == "nostrings":
+        res = bench_variable(int(n), with_strings=False)
+    else:
+        res = bench_variable(int(n))
+    for d in ("to_rows", "from_rows"):
+        if f"{d}_GBps" in res:
+            res[f"{d}_pct_hbm"] = round(
+                100 * res[f"{d}_GBps"] / _HBM_GBPS, 2)
     print("AXIS_RESULT " + json.dumps(res), flush=True)
+
+
+def _verify_fixed(num_rows, num_cols=212):
+    """At-scale on-device correctness: multi-batch roundtrip at the full
+    benchmark axis, byte-compared per batch against the gather oracle and
+    value-compared against the generated table (the reference's
+    Big/Bigger/Biggest + AllTypes tests at 1M-5M rows,
+    ``tests/row_conversion.cpp:332-437``)."""
+    from spark_rapids_jni_tpu.table import (
+        assert_tables_equivalent, slice_table)
+    from spark_rapids_jni_tpu.ops.row_conversion import (
+        _oracle_to_rows_jit, compute_row_layout)
+    dtypes = cycle_dtypes(FIXED_DTYPES, num_cols)
+    layout = compute_row_layout(dtypes)
+    table = create_random_table(dtypes, num_rows, seed=42)
+    jax.block_until_ready(table)
+    _log(f"verify fixed:{num_rows}: table ready")
+    batches = convert_to_rows(table, size_limit=1 << 29)
+    start = 0
+    for bi, b in enumerate(batches):
+        n = b.num_rows
+        sub = slice_table(table, start, start + n)
+        # byte-exact vs the independent gather oracle
+        oracle = _oracle_to_rows_jit(sub, layout)
+        got = np.asarray(b.data).reshape(n, layout.fixed_row_size)
+        np.testing.assert_array_equal(got, np.asarray(oracle),
+                                      err_msg=f"batch {bi} bytes")
+        # decode roundtrip
+        assert_tables_equivalent(sub, convert_from_rows(b, dtypes))
+        start += n
+        _log(f"verify fixed:{num_rows}: batch {bi} ({n} rows) OK")
+    assert start == num_rows
+    print(f"VERIFY_OK fixed:{num_rows} batches={len(batches)}", flush=True)
+
+
+def _verify_variable(num_rows, num_cols=155):
+    """1M-row string-table verification: device roundtrip equivalence plus
+    a byte-exact cross-check of the padded blob through the native C++
+    decoder (the 'ManyStrings' analogue, ``tests/row_conversion.cpp:937``)."""
+    from spark_rapids_jni_tpu.ops.native_rows import (
+        decode_variable_native, native_available)
+    base = cycle_dtypes(FIXED_DTYPES, num_cols - 25)
+    dtypes = base + [STRING] * 25
+    profile = DataProfile(string_len_min=0, string_len_max=32)
+    table = create_random_table(dtypes, num_rows, profile, seed=42)
+    jax.block_until_ready(table)
+    _log(f"verify variable:{num_rows}: table ready")
+    batches = convert_to_rows(table)
+    start = 0
+    sidx = [i for i, dt in enumerate(dtypes) if dt.is_string]
+    for bi, b in enumerate(batches):
+        n = b.num_rows
+        got = convert_from_rows(b, dtypes)
+        # value comparison against the source slice (host, vectorized)
+        for i in sidx[:3] + list(range(0, num_cols - 25, 40)):
+            src = table.columns[i]
+            dst = got.columns[i]
+            if src.dtype.is_string:
+                np.testing.assert_array_equal(
+                    np.asarray(src.chars2d)[start:start + n],
+                    np.asarray(dst.chars2d)[:, :src.chars2d.shape[1]],
+                    err_msg=f"batch {bi} string col {i}")
+            else:
+                sv = np.asarray(src.data)[start:start + n]
+                dv = np.asarray(dst.data)
+                valid = np.asarray(src.valid_bools())[start:start + n]
+                m = valid[:, None] if sv.ndim == 2 else valid
+                np.testing.assert_array_equal(
+                    np.where(m, sv, 0), np.where(m, dv, 0),
+                    err_msg=f"batch {bi} col {i}")
+        if bi == 0 and native_available():
+            # native C++ decoder cross-check on the first batch
+            cols, valid, soffs, chars = decode_variable_native(
+                np.asarray(b.data), np.asarray(b.offsets).astype(np.int64),
+                dtypes)
+            exp = table.columns[sidx[0]].to_arrow()
+            eoffs = np.asarray(exp.offsets)[start:start + n + 1]
+            np.testing.assert_array_equal(soffs[0], eoffs - eoffs[0])
+            np.testing.assert_array_equal(
+                chars[0], np.asarray(exp.chars)[eoffs[0]:eoffs[-1]])
+            _log(f"verify variable:{num_rows}: native cross-check OK")
+        start += n
+        _log(f"verify variable:{num_rows}: batch {bi} ({n} rows) OK")
+    print(f"VERIFY_OK variable:{num_rows} batches={len(batches)}",
+          flush=True)
 
 
 def _axis_subprocess(axis: str, timeout_s: int = 540):
@@ -236,7 +341,19 @@ def main():
     ap.add_argument("--rows", type=int, default=None)
     ap.add_argument("--one", type=str, default=None,
                     help="run one axis in-process, e.g. fixed:1000000")
+    ap.add_argument("--verify", type=str, default=None, nargs="?",
+                    const="all",
+                    help="at-scale correctness instead of timing: "
+                         "'fixed:4000000', 'variable:1000000', or 'all'")
     args = ap.parse_args()
+
+    if args.verify:
+        targets = (["fixed:4000000", "variable:1000000"]
+                   if args.verify == "all" else [args.verify])
+        for t in targets:
+            kind, n = t.split(":")
+            (_verify_fixed if kind == "fixed" else _verify_variable)(int(n))
+        return
 
     if args.one:
         _run_axis(args.one)
@@ -260,12 +377,12 @@ def main():
         _flush()  # partial results survive a driver timeout
 
     if not args.quick:
-        # the reference skips its string axes above 1M rows for memory
-        # (benchmarks/row_conversion.cpp:105); we bound the axis further
-        # because XLA:TPU executes the ragged scatter/gather path at only
-        # ~10M elem/s — the dense-padded string redesign tracked in
-        # README "roadmap" lifts this
-        results["variable_width"] = [_axis_subprocess("variable:100000")]
+        # the reference's mixed axes: 155 cols with strings at 1M rows
+        # (it skips strings >1M for memory, benchmarks/row_conversion.cpp:105)
+        # and the no-strings variant; strings run on the dense-padded engine
+        results["variable_width"] = [_axis_subprocess("variable:1000000")]
+        _flush()
+        results["no_strings_155col"] = [_axis_subprocess("nostrings:1000000")]
         _flush()
 
     head = next((r for r in fixed if "error" not in r), None)

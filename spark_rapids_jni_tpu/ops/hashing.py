@@ -33,7 +33,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from spark_rapids_jni_tpu.table import Column, Table
+from spark_rapids_jni_tpu.table import (
+    Column, Table, bytes2d_to_words as _bytes_to_u32_lanes,
+)
 
 _C1 = jnp.uint32(0xCC9E2D51)
 _C2 = jnp.uint32(0x1B873593)
@@ -119,36 +121,12 @@ def _as_u32_words(col: Column):
 
 def _string_window(col: Column, W: int):
     """Dense padded byte window of a string column: uint8 [n, W] (zeros past
-    each string's length) plus int32 lengths [n].  One contiguous W-byte
-    slice-gather per row — the fast gather shape on TPU (cf. the
-    slice-window gathers in ``row_conversion._extract_fixed_variable_jit``).
-    """
-    offs = col.offsets.astype(jnp.int32)
-    lens = offs[1:] - offs[:-1]
-    n = lens.shape[0]
-    if W == 0:
-        return jnp.zeros((n, 0), jnp.uint8), lens
-    chars = col.chars
-    # pad so a window starting at the last offset stays in bounds
-    padded = jnp.concatenate([chars, jnp.zeros((W,), jnp.uint8)])
-    b = jax.lax.gather(
-        padded, offs[:-1, None],
-        jax.lax.GatherDimensionNumbers(
-            offset_dims=(1,), collapsed_slice_dims=(),
-            start_index_map=(0,)),
-        slice_sizes=(W,), mode=jax.lax.GatherScatterMode.CLIP)
-    mask = jnp.arange(W, dtype=jnp.int32)[None, :] < lens[:, None]
-    return jnp.where(mask, b, jnp.uint8(0)), lens
+    each string's length) plus int32 lengths [n].  Dense-padded columns are
+    a static slice/pad; Arrow columns fall back to a per-row slice-window
+    gather (slow on TPU — hot paths should pass padded columns)."""
+    return col.chars_window(W), col.str_lens()
 
 
-def _bytes_to_u32_lanes(b: jnp.ndarray) -> jnp.ndarray:
-    """[n, W] uint8 (W % 4 == 0) -> [n, W//4] little-endian uint32 words via
-    strided lane slices (a bitcast's [n, W/4, 4] intermediate would pad the
-    4-lane minor dim 32x on TPU)."""
-    return (b[:, 0::4].astype(jnp.uint32)
-            | (b[:, 1::4].astype(jnp.uint32) << 8)
-            | (b[:, 2::4].astype(jnp.uint32) << 16)
-            | (b[:, 3::4].astype(jnp.uint32) << 24))
 
 
 def _byte_at(b: jnp.ndarray, pos: jnp.ndarray) -> jnp.ndarray:
@@ -165,29 +143,40 @@ def _word_at(w: jnp.ndarray, pos: jnp.ndarray) -> jnp.ndarray:
 
 
 def _resolve_str_window(cols, max_str_len: Optional[int]) -> int:
-    """Static W for the padded windows.  Host-syncs the offsets when the
-    caller didn't provide a bound — callers under jit/shard_map must pass
-    ``max_str_len`` (the analogue of the reference's host sync before
-    data-dependent kernel planning, ``row_conversion.cu:1521``)."""
-    concrete = all(not isinstance(c.offsets, jax.core.Tracer)
+    """Static W for the padded windows.
+
+    Dense-padded columns carry their width statically (``chars2d.shape[1]``)
+    so they resolve under jit/shard_map with no sync.  Arrow columns
+    host-sync the offsets unless the caller provides ``max_str_len`` (the
+    analogue of the reference's host sync before data-dependent kernel
+    planning, ``row_conversion.cu:1521``)."""
+    def _len_arr(c):  # offsets, or per-row lens for sharded padded columns
+        return c.offsets if c.offsets is not None else c.lens
+
+    concrete = all(not isinstance(_len_arr(c), jax.core.Tracer)
                    for c in cols if c.dtype.is_string)
-    W = 0
+    actual_max = 0
     if concrete:
         for col in cols:
-            if col.dtype.is_string and col.offsets.shape[0] > 1:
-                offs = np.asarray(col.offsets)
-                W = max(W, int(np.max(offs[1:] - offs[:-1])))
+            if col.dtype.is_string and col.num_rows:
+                lens = np.asarray(col.str_lens())
+                actual_max = max(actual_max, int(lens.max()))
     if max_str_len is not None:
         # an undersized window would silently truncate the byte stream —
         # validate whenever the offsets are concrete (free in eager mode)
-        if concrete and W > int(max_str_len):
-            raise ValueError(
-                f"max_str_len={max_str_len} < actual max string length {W}")
+        if concrete and actual_max > int(max_str_len):
+            raise ValueError(f"max_str_len={max_str_len} < actual max "
+                             f"string length {actual_max}")
         return int(max_str_len)
-    if not concrete:
-        raise ValueError(
-            "string hashing under jit requires an explicit max_str_len")
-    return W
+    if concrete:
+        return actual_max
+    if all(c.is_padded for c in cols if c.dtype.is_string):
+        # padded width >= every length; bytes past a length are zero, so a
+        # wider window hashes identically
+        return max((c.chars2d.shape[1] for c in cols if c.dtype.is_string),
+                   default=0)
+    raise ValueError("string hashing on Arrow-layout columns under jit "
+                     "requires an explicit max_str_len")
 
 
 def _mm3_string_col(col: Column, h: jnp.ndarray, W: int) -> jnp.ndarray:

@@ -25,11 +25,13 @@ def make_mesh(devices: Optional[Sequence] = None,
 
 
 def shard_table(table: Table, mesh: Mesh, axis_name: str = "data") -> Table:
-    """Shard a fixed-width table's rows across the mesh axis.
+    """Shard a table's rows across the mesh axis.
 
-    Row counts must divide the axis size (pad upstream); string columns are
-    not shardable this way (their ragged chars ride the row-blob shuffle
-    path instead, see ``shuffle.py``).
+    Row counts must divide the axis size (pad upstream).  String columns
+    must be dense-padded (``chars2d``): the char matrix and per-row lengths
+    shard row-wise like any fixed-width column, while Arrow-layout ragged
+    chars cannot (their offsets array has ``n + 1`` entries and the char
+    buffer splits at data-dependent positions).
     """
     naxis = mesh.shape[axis_name]
     if table.num_rows % (naxis * 8) != 0:
@@ -40,11 +42,19 @@ def shard_table(table: Table, mesh: Mesh, axis_name: str = "data") -> Table:
     vspec = NamedSharding(mesh, P(axis_name))
     cols = []
     for c in table.columns:
-        if c.dtype.is_string:
-            raise ValueError("shard_table supports fixed-width columns only")
-        data = jax.device_put(c.data, spec)
         validity = None
         if c.validity is not None:
             validity = jax.device_put(c.validity, vspec)
-        cols.append(Column(c.dtype, data, validity))
+        if c.dtype.is_string:
+            if not c.is_padded:
+                raise ValueError(
+                    "shard_table requires dense-padded string columns "
+                    "(Column.to_padded / strings_padded)")
+            cols.append(Column(
+                c.dtype, c.data, validity, None, None,
+                jax.device_put(c.chars2d, spec),
+                jax.device_put(c.str_lens(), spec)))
+        else:
+            cols.append(Column(c.dtype, jax.device_put(c.data, spec),
+                               validity))
     return Table(tuple(cols))

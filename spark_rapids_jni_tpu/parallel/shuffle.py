@@ -22,14 +22,14 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
-from spark_rapids_jni_tpu.table import Table
+from spark_rapids_jni_tpu.table import Column, Table
 from spark_rapids_jni_tpu.ops.row_layout import compute_row_layout
 from spark_rapids_jni_tpu.ops import row_conversion as rc
 from spark_rapids_jni_tpu.ops.hashing import hash_partition_ids
@@ -51,15 +51,17 @@ class ShuffleResult:
     row_valid: jnp.ndarray
     num_valid: jnp.ndarray
     overflow: jnp.ndarray
+    # static: padded string-slot widths the rows were encoded with (None
+    # for fixed-width tables); decode_shuffle_result reads them from here
+    str_widths: Optional[Tuple[int, ...]] = None
 
     def tree_flatten(self):
         return (self.rows, self.row_valid, self.num_valid,
-                self.overflow), None
+                self.overflow), self.str_widths
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        del aux
-        return cls(*children)
+        return cls(*children, aux)
 
 
 def _pack_buckets(rows2d, pids, num_parts: int, capacity: int):
@@ -160,23 +162,46 @@ def ring_bucket_exchange(num_parts: int, capacity: int, axis_name: str):
     return body
 
 
+def _string_layout_of(table: Table, layout):
+    """(slot_starts, fe_pad, row_size, widths) for string tables, or
+    ``None`` row params for fixed-width ones."""
+    if not layout.has_strings:
+        return None, None, layout.fixed_row_size, None
+    scols = [c for c in table.columns if c.dtype.is_string]
+    if not all(c.is_padded for c in scols):
+        raise ValueError(
+            "string shuffle requires dense-padded string columns "
+            "(Column.to_padded / strings_padded); Arrow-layout chars "
+            "cannot cross the static-shape exchange")
+    widths = tuple(c.chars2d.shape[1] for c in scols)
+    slot_starts, fe_pad, row_size = rc.padded_variable_layout(layout, widths)
+    return slot_starts, fe_pad, row_size, widths
+
+
 def shuffle_table_sharded(table: Table, key_cols: Sequence[int],
                           mesh: Mesh, axis_name: str = "data",
                           capacity_factor: float = 2.0,
                           seed: int = 42,
                           method: str = "all_to_all") -> ShuffleResult:
-    """Hash-partition a row-sharded fixed-width table across the mesh axis.
+    """Hash-partition a row-sharded table across the mesh axis.
 
-    Returns per-device padded JCUDF rows; decode with
-    :func:`decode_shuffle_result`.
+    Fixed-width tables exchange fixed-size JCUDF rows; string tables
+    exchange dense-padded variable-width rows (uniform ``row_size`` =
+    fixed section + one padded slot per string column) — the static-shape
+    wire format the all-to-all needs, self-describing via each row's
+    (offset, length) pairs.  Decode with :func:`decode_shuffle_result`.
     """
     layout = compute_row_layout(table.dtypes)
-    if layout.has_strings:
-        raise NotImplementedError(
-            "string shuffle rides variable-width row blobs (planned)")
+    slot_starts, fe_pad, row_size, widths = _string_layout_of(table, layout)
     num_parts = mesh.shape[axis_name]
     n_local = table.num_rows // num_parts
+    # per-device slot count (num_parts * capacity) must land on a byte
+    # boundary: decode packs validity bitmasks per device and concatenates
+    # them across the mesh, so a non-multiple-of-8 count would misalign
+    # every later device's bits
     capacity = max(8, int(n_local / num_parts * capacity_factor))
+    while (capacity * num_parts) % 8:
+        capacity += 1
 
     if method not in ("all_to_all", "ring"):
         raise ValueError(f"unknown shuffle method {method!r}")
@@ -192,7 +217,11 @@ def shuffle_table_sharded(table: Table, key_cols: Sequence[int],
         out_specs=(spec, spec, spec, rep),
         check_vma=False)
     def run(tbl):
-        rows2d = rc._assemble_fixed_rows(tbl, layout)
+        if widths is not None:
+            rows2d = rc.padded_rows2d(tbl, layout, slot_starts, fe_pad,
+                                      row_size)
+        else:
+            rows2d = rc._assemble_fixed_rows(tbl, layout)
         pids = hash_partition_ids(
             [tbl.columns[i] for i in key_cols], num_parts, seed)
         body = make_body(num_parts, capacity, axis_name)
@@ -200,21 +229,62 @@ def shuffle_table_sharded(table: Table, key_cols: Sequence[int],
         return rows, valid, num_valid[None], overflow[None]
 
     rows, valid, num_valid, overflow = jax.jit(run)(table)
-    return ShuffleResult(rows, valid, num_valid, overflow)
+    return ShuffleResult(rows, valid, num_valid, overflow, widths)
 
 
 def decode_shuffle_result(result: ShuffleResult, dtypes,
-                          mesh: Mesh, axis_name: str = "data"):
+                          mesh: Mesh, axis_name: str = "data",
+                          str_widths=None):
     """Per-device decode of shuffled rows back to a (padded) table plus the
-    validity-of-slot mask; aggregations downstream mask with ``row_valid``."""
+    validity-of-slot mask; aggregations downstream mask with ``row_valid``.
+
+    String slot widths come from the result itself (``ShuffleResult
+    .str_widths``); ``str_widths`` overrides for foreign blobs.  Invalid
+    slots decode as empty strings (their rows are all-zero, so every pair
+    length is 0)."""
     layout = compute_row_layout(dtypes)
+    spec = P(axis_name)
+    if str_widths is None:
+        str_widths = result.str_widths
+
+    if not layout.has_strings:
+        @functools.partial(shard_map, mesh=mesh, in_specs=(spec,),
+                           out_specs=spec, check_vma=False)
+        def run(rows):
+            return Table(tuple(rc._disassemble_fixed_rows(rows, layout)))
+
+        return jax.jit(run)(result.rows)
+
+    widths = tuple(str_widths)
+    nstr = len(widths)
 
     @functools.partial(
-        shard_map, mesh=mesh,
-        in_specs=(P(axis_name),),
-        out_specs=P(axis_name),
-        check_vma=False)
+        shard_map, mesh=mesh, in_specs=(spec,),
+        out_specs=(spec,) * 3, check_vma=False)
     def run(rows):
-        return Table(tuple(rc._disassemble_fixed_rows(rows, layout)))
+        m = rows.shape[0]
+        datas, masks, str_parts = rc.padded_cols_from_rows(
+            rows.reshape(-1), layout, widths, m)
+        # string offsets are per-device prefix sums — lens concatenate
+        # across devices, offsets would not; globalize outside
+        lens = [p[1][1:] - p[1][:-1] for p in str_parts]
+        chars = [p[0] for p in str_parts]
+        return (tuple(d for d in datas if d is not None),
+                tuple(masks), tuple(chars) + tuple(lens))
 
-    return jax.jit(run)(result.rows)
+    fixed_datas, masks, str_out = jax.jit(run)(result.rows)
+    chars2ds, lens = str_out[:nstr], str_out[nstr:]
+    cols = []
+    fi = si = 0
+    for i, dt in enumerate(layout.dtypes):
+        if dt.is_string:
+            offsets = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32),
+                 jnp.cumsum(lens[si]).astype(jnp.int32)])
+            cols.append(Column(dt, jnp.zeros((0,), jnp.uint8),
+                               masks[i], offsets, None, chars2ds[si]))
+            si += 1
+        else:
+            cols.append(Column(dt, fixed_datas[fi], masks[i]))
+            fi += 1
+    return Table(tuple(cols))

@@ -12,9 +12,17 @@ Design (TPU-first, not a cudf port):
   (reference ``row_conversion.cu:753-777`` reads ``bitmask_type`` words with
   LSB = first row) but is stored byte-granular, which is what the JCUDF row
   format itself uses.
-- Strings use Arrow layout: ``offsets`` (int32, ``num_rows + 1``) into a flat
-  ``chars`` uint8 buffer (cudf ``strings_column_view``, used by reference
-  ``row_conversion.cu:216-261``).
+- Strings have TWO device representations:
+  * **Arrow layout** — ``offsets`` (int32, ``num_rows + 1``) into a flat
+    ``chars`` uint8 buffer (cudf ``strings_column_view``, used by reference
+    ``row_conversion.cu:216-261``).  This is the *host/wire* layout.
+  * **Dense-padded layout** — ``offsets`` plus ``chars2d`` uint8
+    ``[num_rows, W]`` (W = padded max length, multiple of 4; bytes past each
+    string's length are zero).  This is the *device-native* layout: XLA:TPU
+    executes per-row dynamic-start gathers/scatters ~100x slower than
+    static-shape slices and concatenates (measured on v5e), so every device
+    hot path (row conversion, hashing, shuffle) runs on the padded form and
+    raggedness only materializes at the host boundary.
 """
 
 from __future__ import annotations
@@ -136,6 +144,17 @@ def unpack_bools(mask: jnp.ndarray, n: int) -> jnp.ndarray:
     return bits.reshape(-1)[:n].astype(jnp.bool_)
 
 
+def bytes2d_to_words(b: jnp.ndarray) -> jnp.ndarray:
+    """[n, W] uint8 (W % 4 == 0) -> [n, W//4] little-endian uint32 words via
+    strided lane slices (a bitcast's [n, W/4, 4] intermediate would pad the
+    4-lane minor dim 32x on TPU).  Shared by row decode, row encode, and
+    string hashing — keep the lane-combine strategy in this one place."""
+    return (b[:, 0::4].astype(jnp.uint32)
+            | (b[:, 1::4].astype(jnp.uint32) << 8)
+            | (b[:, 2::4].astype(jnp.uint32) << 16)
+            | (b[:, 3::4].astype(jnp.uint32) << 24))
+
+
 # ---------------------------------------------------------------------------
 # Column
 # ---------------------------------------------------------------------------
@@ -147,7 +166,9 @@ class Column:
 
     Fixed width: ``data`` has shape ``[num_rows]`` with the logical dtype.
     String: ``data`` is unused (kept as a 0-length placeholder), ``offsets``
-    is int32 ``[num_rows + 1]`` and ``chars`` is uint8 ``[total_bytes]``.
+    is int32 ``[num_rows + 1]``, and chars are EITHER Arrow (``chars`` uint8
+    ``[total_bytes]``) or dense-padded (``chars2d`` uint8 ``[num_rows, W]``,
+    zero past each length) — see the module docstring for when each is used.
     ``validity`` is a packed uint8 bitmask ``[ceil(num_rows / 8)]`` or None
     (all rows valid).
     """
@@ -157,6 +178,10 @@ class Column:
     validity: Optional[jnp.ndarray] = None
     offsets: Optional[jnp.ndarray] = None
     chars: Optional[jnp.ndarray] = None
+    chars2d: Optional[jnp.ndarray] = None
+    # dense-padded columns may carry per-row lengths [n] INSTEAD of offsets
+    # [n+1]: lengths shard row-wise across a mesh axis, offsets cannot
+    lens: Optional[jnp.ndarray] = None
 
     # -- construction -----------------------------------------------------
 
@@ -177,34 +202,136 @@ class Column:
         return Column(dtype, data, validity)
 
     @staticmethod
-    def strings(values: Sequence[Optional[str]]) -> "Column":
-        """Build a string column from Python strings (None => null)."""
+    def _encode_strings(values: Sequence[Optional[str]]):
         enc = [(s.encode("utf-8") if s is not None else b"") for s in values]
         lens = np.fromiter((len(b) for b in enc), dtype=np.int32,
                            count=len(enc))
         offsets = np.zeros(len(enc) + 1, dtype=np.int32)
         np.cumsum(lens, out=offsets[1:])
-        chars = np.frombuffer(b"".join(enc), dtype=np.uint8).copy()
         validity = None
         if any(s is None for s in values):
             valid = np.fromiter((s is not None for s in values), dtype=bool,
                                 count=len(values))
             validity = pack_bools(jnp.asarray(valid))
+        return enc, lens, offsets, validity
+
+    @staticmethod
+    def strings(values: Sequence[Optional[str]]) -> "Column":
+        """Build an Arrow-layout string column from Python strings
+        (None => null)."""
+        enc, lens, offsets, validity = Column._encode_strings(values)
+        chars = np.frombuffer(b"".join(enc), dtype=np.uint8).copy()
         return Column(STRING, jnp.zeros((0,), jnp.uint8), validity,
                       jnp.asarray(offsets), jnp.asarray(chars))
+
+    @staticmethod
+    def strings_padded(values: Sequence[Optional[str]],
+                       pad_to: Optional[int] = None) -> "Column":
+        """Build a dense-padded string column (device-native layout)."""
+        enc, lens, offsets, validity = Column._encode_strings(values)
+        W = _padded_width(int(lens.max()) if len(lens) else 0, pad_to)
+        mat = np.zeros((len(enc), W), np.uint8)
+        for i, b in enumerate(enc):
+            mat[i, :len(b)] = np.frombuffer(b, np.uint8)
+        return Column(STRING, jnp.zeros((0,), jnp.uint8), validity,
+                      jnp.asarray(offsets), None, jnp.asarray(mat))
 
     # -- properties -------------------------------------------------------
 
     @property
     def num_rows(self) -> int:
         if self.dtype.is_string:
+            if self.chars2d is not None:
+                return self.chars2d.shape[0]
             return self.offsets.shape[0] - 1
         return self.data.shape[0]
+
+    @property
+    def is_padded(self) -> bool:
+        """True for dense-padded string columns (``chars2d`` present)."""
+        return self.chars2d is not None
 
     def valid_bools(self) -> jnp.ndarray:
         if self.validity is None:
             return jnp.ones((self.num_rows,), dtype=jnp.bool_)
         return unpack_bools(self.validity, self.num_rows)
+
+    def str_lens(self) -> jnp.ndarray:
+        """Per-row string byte lengths, int32 [n]."""
+        if self.lens is not None:
+            return self.lens.astype(jnp.int32)
+        offs = self.offsets.astype(jnp.int32)
+        return offs[1:] - offs[:-1]
+
+
+    # -- string representation conversion ----------------------------------
+
+    def to_padded(self, pad_to: Optional[int] = None) -> "Column":
+        """Arrow -> dense-padded, via the host (numpy): per-row dynamic-start
+        gathers are ~100x slower than a host round-trip on XLA:TPU, so the
+        conversion is explicitly a boundary operation, not a device kernel."""
+        if not self.dtype.is_string or self.is_padded:
+            return self
+        offs = np.asarray(self.offsets).astype(np.int64)
+        # sliced columns share the parent's chars buffer with non-rebased
+        # offsets: take only this column's range and rebase to zero
+        chars = np.asarray(self.chars)[offs[0]:offs[-1]]
+        offs = offs - offs[0]
+        lens = offs[1:] - offs[:-1]
+        n = len(lens)
+        W = _padded_width(int(lens.max()) if n else 0, pad_to)
+        mat = np.zeros((n, W), np.uint8)
+        if chars.size:
+            # vectorized ragged->padded: scatter chars at row*W + intra
+            rows = np.repeat(np.arange(n, dtype=np.int64), lens)
+            intra = np.arange(len(chars), dtype=np.int64) - \
+                np.repeat(offs[:-1], lens)
+            mat.reshape(-1)[rows * W + intra] = chars
+        return Column(self.dtype, self.data, self.validity,
+                      jnp.asarray((offs).astype(np.int32)), None,
+                      jnp.asarray(mat))
+
+    def to_arrow(self) -> "Column":
+        """Dense-padded -> Arrow, via the host (see :meth:`to_padded`)."""
+        if not self.dtype.is_string or not self.is_padded:
+            return self
+        mat = np.asarray(self.chars2d)
+        lens = np.asarray(self.str_lens())
+        W = mat.shape[1]
+        mask = np.arange(W)[None, :] < lens[:, None]
+        chars = mat[mask]  # row-major selection = concatenated strings
+        offsets = np.zeros(len(lens) + 1, np.int32)
+        np.cumsum(lens, out=offsets[1:])
+        return Column(self.dtype, self.data, self.validity,
+                      jnp.asarray(offsets), jnp.asarray(chars), None)
+
+    def chars_window(self, W: int) -> jnp.ndarray:
+        """Padded byte window uint8 [n, W] (zero past lengths) in any
+        representation.  Static slice/pad for padded columns; for Arrow
+        columns a per-row slice-window gather (slow on TPU — hot paths
+        should convert with :meth:`to_padded` first)."""
+        n = self.num_rows
+        if W == 0:
+            return jnp.zeros((n, 0), jnp.uint8)
+        if self.is_padded:
+            have = self.chars2d.shape[1]
+            if have == W:
+                return self.chars2d
+            if have > W:
+                return self.chars2d[:, :W]
+            return jnp.concatenate(
+                [self.chars2d, jnp.zeros((n, W - have), jnp.uint8)], axis=1)
+        offs = self.offsets.astype(jnp.int32)
+        lens = offs[1:] - offs[:-1]
+        padded = jnp.concatenate([self.chars, jnp.zeros((W,), jnp.uint8)])
+        b = jax.lax.gather(
+            padded, offs[:-1, None],
+            jax.lax.GatherDimensionNumbers(
+                offset_dims=(1,), collapsed_slice_dims=(),
+                start_index_map=(0,)),
+            slice_sizes=(W,), mode=jax.lax.GatherScatterMode.CLIP)
+        mask = jnp.arange(W, dtype=jnp.int32)[None, :] < lens[:, None]
+        return jnp.where(mask, b, jnp.uint8(0))
 
     # -- host conversion (tests / debugging) -------------------------------
 
@@ -212,6 +339,11 @@ class Column:
         n = self.num_rows
         valid = np.asarray(self.valid_bools())
         if self.dtype.is_string:
+            if self.is_padded:
+                mat = np.asarray(self.chars2d)
+                lens = np.asarray(self.str_lens())
+                return [bytes(mat[i, :lens[i]]).decode("utf-8")
+                        if valid[i] else None for i in range(n)]
             offs = np.asarray(self.offsets)
             chars = np.asarray(self.chars).tobytes()
             return [chars[offs[i]:offs[i + 1]].decode("utf-8")
@@ -227,13 +359,22 @@ class Column:
     # -- pytree ------------------------------------------------------------
 
     def tree_flatten(self):
-        children = (self.data, self.validity, self.offsets, self.chars)
+        children = (self.data, self.validity, self.offsets, self.chars,
+                    self.chars2d, self.lens)
         return children, self.dtype
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        data, validity, offsets, chars = children
-        return cls(aux, data, validity, offsets, chars)
+        return cls(aux, *children)
+
+
+def _padded_width(max_len: int, pad_to: Optional[int]) -> int:
+    """Padded char-matrix width: caller override or max length, rounded up
+    to a multiple of 4 so char slots stay uint32-word aligned."""
+    W = max(max_len, 0) if pad_to is None else int(pad_to)
+    if W < max_len:
+        raise ValueError(f"pad_to={W} < longest string {max_len}")
+    return (W + 3) // 4 * 4
 
 
 # ---------------------------------------------------------------------------
@@ -290,7 +431,13 @@ def slice_table(table: Table, start: int, end: int) -> Table:
                 unpack_bools(c.validity, c.num_rows)[start:end])
         if c.dtype.is_string:
             cols.append(Column(c.dtype, c.data, validity,
-                               c.offsets[start:end + 1], c.chars))
+                               c.offsets[start:end + 1]
+                               if c.offsets is not None else None,
+                               c.chars,
+                               c.chars2d[start:end]
+                               if c.chars2d is not None else None,
+                               c.lens[start:end]
+                               if c.lens is not None else None))
         else:
             cols.append(Column(c.dtype, c.data[start:end], validity))
     return Table(tuple(cols))
@@ -314,8 +461,14 @@ def slice_table_dynamic(table: Table, start, size: int) -> Table:
         if c.dtype.is_string:
             cols.append(Column(c.dtype, c.data, validity,
                                lax.dynamic_slice_in_dim(c.offsets, start,
-                                                        size + 1),
-                               c.chars))
+                                                        size + 1)
+                               if c.offsets is not None else None,
+                               c.chars,
+                               lax.dynamic_slice_in_dim(c.chars2d, start,
+                                                        size)
+                               if c.chars2d is not None else None,
+                               lax.dynamic_slice_in_dim(c.lens, start, size)
+                               if c.lens is not None else None))
         else:
             cols.append(Column(c.dtype,
                                lax.dynamic_slice_in_dim(c.data, start, size),

@@ -47,6 +47,9 @@ class DataProfile:
     string_len_min: int = 0
     string_len_max: int = 32
     avg_string_len: Optional[int] = None  # geometric mean when set
+    # "padded" (device-native dense [n, W] chars, zero host syncs) or
+    # "arrow" (ragged offsets+chars, one host sync for the total sizes)
+    string_layout: str = "padded"
     seed: int = 0
 
 
@@ -167,6 +170,7 @@ def _gen_table_jit(key, dtypes, num_rows: int, profile: DataProfile):
         groups.setdefault(dt, []).append(i)
 
     str_lens = []
+    str_mats = None
     sidx = [i for i, dt in enumerate(dtypes) if dt.is_string]
     if sidx:
         klen = jax.random.fold_in(key, 2)
@@ -182,6 +186,16 @@ def _gen_table_jit(key, dtypes, num_rows: int, profile: DataProfile):
                 klen, shape, profile.string_len_min,
                 profile.string_len_max + 1, dtype=jnp.int32)
         str_lens = [lens2d[j] for j in range(len(sidx))]
+        if profile.string_layout == "padded":
+            # dense-padded char matrices, fully on device: random lowercase
+            # bytes masked to zero past each length — no host sync at all
+            W = (profile.string_len_max + 3) // 4 * 4
+            mats = jax.random.randint(
+                jax.random.fold_in(key, 3), (len(sidx), num_rows, W),
+                97, 123, dtype=jnp.int32).astype(jnp.uint8)
+            mask = jnp.arange(W, dtype=jnp.int32)[None, None, :] \
+                < lens2d[:, :, None]
+            str_mats = jnp.where(mask, mats, jnp.uint8(0))
 
     gi = 0
     for dt, idxs in groups.items():
@@ -192,7 +206,7 @@ def _gen_table_jit(key, dtypes, num_rows: int, profile: DataProfile):
         gi += 1
         for j, i in enumerate(idxs):
             datas[i] = arr[j]
-    return datas, validities, str_lens
+    return datas, validities, str_lens, str_mats
 
 
 @functools.partial(jax.jit, static_argnums=(1,))
@@ -230,11 +244,14 @@ def create_random_table(dtypes: Sequence[DType], num_rows: int,
     profile = profile or default_profile()
     dtypes = tuple(dtypes)
     key = jax.random.PRNGKey(profile.seed if seed is None else seed)
-    datas, validities, str_lens = _gen_table_jit(key, dtypes, num_rows,
-                                                 profile)
+    datas, validities, str_lens, str_mats = _gen_table_jit(
+        key, dtypes, num_rows, profile)
     char_slices = []
     offsets_np = None
-    if str_lens:
+    offsets_dev = None
+    if str_lens and str_mats is not None:
+        offsets_dev = _string_offsets_jit(jnp.stack(str_lens))
+    elif str_lens:
         # one D2H sync for all ragged sizes, one char pool, one split compile
         offsets_np = np.asarray(_string_offsets_jit(jnp.stack(str_lens)))
         totals = offsets_np[:, -1].astype(np.int64)
@@ -246,9 +263,15 @@ def create_random_table(dtypes: Sequence[DType], num_rows: int,
     si = 0
     for i, dt in enumerate(dtypes):
         if dt.is_string:
-            cols.append(Column(dt, jnp.zeros((0,), jnp.uint8),
-                               validities[i], jnp.asarray(offsets_np[si]),
-                               char_slices[si]))
+            if str_mats is not None:
+                cols.append(Column(dt, jnp.zeros((0,), jnp.uint8),
+                                   validities[i], offsets_dev[si],
+                                   None, str_mats[si]))
+            else:
+                cols.append(Column(dt, jnp.zeros((0,), jnp.uint8),
+                                   validities[i],
+                                   jnp.asarray(offsets_np[si]),
+                                   char_slices[si]))
             si += 1
         else:
             cols.append(Column(dt, datas[i], validities[i]))

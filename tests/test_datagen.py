@@ -26,10 +26,22 @@ def test_deterministic_by_seed():
     c = create_random_table(dtypes, 100, seed=8)
     np.testing.assert_array_equal(np.asarray(a.columns[0].data),
                                   np.asarray(b.columns[0].data))
-    np.testing.assert_array_equal(np.asarray(a.columns[2].chars),
-                                  np.asarray(b.columns[2].chars))
+    assert a.columns[2].is_padded  # device-native layout is the default
+    np.testing.assert_array_equal(np.asarray(a.columns[2].chars2d),
+                                  np.asarray(b.columns[2].chars2d))
     assert not np.array_equal(np.asarray(a.columns[0].data),
                               np.asarray(c.columns[0].data))
+
+
+def test_arrow_layout_opt_in():
+    t = create_random_table([STRING], 50,
+                            DataProfile(string_layout="arrow"), seed=7)
+    col = t.columns[0]
+    assert not col.is_padded and col.chars is not None
+    tp = create_random_table([STRING], 50, seed=7)
+    # same seed, both layouts: identical length distributions
+    np.testing.assert_array_equal(np.asarray(col.offsets),
+                                  np.asarray(tp.columns[0].offsets))
 
 
 def test_null_probability():

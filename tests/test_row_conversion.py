@@ -86,8 +86,9 @@ def concat_tables(parts):
         datas = [p.columns[i] for p in parts]
         valid = jnp.concatenate([c.valid_bools() for c in datas])
         if dt.is_string:
-            chars = jnp.concatenate([c.chars for c in datas])
-            offs = [np.asarray(c.offsets) for c in datas]
+            arrow = [c.to_arrow() for c in datas]
+            chars = jnp.concatenate([c.chars for c in arrow])
+            offs = [np.asarray(c.offsets) for c in arrow]
             out = [offs[0]]
             base = offs[0][-1]
             for o in offs[1:]:

@@ -153,6 +153,105 @@ def test_zero_row_string_table_roundtrip():
     assert rt.num_rows == 0 and rt.num_columns == 2
 
 
+# ---------------------------------------------------------------------------
+# Dense-padded engine (device-native layout; VERDICT r1 item 2)
+# ---------------------------------------------------------------------------
+
+def test_padded_roundtrip_matches_compact_logically(rng):
+    n = 1000
+    vals_a = _random_strings(rng, n)
+    vals_b = _random_strings(rng, n, max_len=60)
+    ints = rng.integers(-100, 100, n, dtype=np.int32)
+    t_pad = Table((Column.strings_padded(vals_a),
+                   Column.from_numpy(ints, INT32),
+                   Column.strings_padded(vals_b)))
+    t_arrow = Table((Column.strings(vals_a),
+                     Column.from_numpy(ints, INT32),
+                     Column.strings(vals_b)))
+    [rp] = convert_to_rows(t_pad)
+    [rc] = convert_to_rows(t_arrow)
+    assert rp.is_padded and not rc.is_padded
+    got_p = convert_from_rows(rp, t_pad.dtypes)
+    got_c = convert_from_rows(rc, t_arrow.dtypes)
+    assert got_p.to_pydict() == got_c.to_pydict() == t_pad.to_pydict()
+
+
+def test_padded_blob_is_self_describing_jcudf(rng):
+    """A padded blob decodes on the *compact* (pair-following) decoder:
+    the pairs make it valid JCUDF regardless of slack."""
+    from spark_rapids_jni_tpu.ops.row_conversion import (
+        RowsColumn, _from_rows_variable, compute_row_layout)
+    t = Table((Column.strings_padded(["hello", "", None, "worlds!"]),
+               Column.from_numpy(np.arange(4, dtype=np.int32), INT32)))
+    [rows] = convert_to_rows(t)
+    # strip the padded markers: force the generic pair-following decoder
+    generic = RowsColumn(rows.data, rows.offsets)
+    got = _from_rows_variable(generic, compute_row_layout(t.dtypes))
+    assert got.to_pydict() == t.to_pydict()
+
+
+def test_compact_rows_host_byte_exact(rng):
+    """Host compaction of a padded blob equals the compact encoder's wire
+    bytes exactly."""
+    from spark_rapids_jni_tpu.ops.row_conversion import compact_rows_host
+    n = 500
+    vals = _random_strings(rng, n, max_len=24)
+    ints = rng.integers(0, 1 << 30, n, dtype=np.int32)
+    t_pad = Table((Column.from_numpy(ints, INT32),
+                   Column.strings_padded(vals)))
+    t_arrow = Table((Column.from_numpy(ints, INT32),
+                     Column.strings(vals)))
+    [rp] = convert_to_rows(t_pad)
+    [rc] = convert_to_rows(t_arrow)
+    compacted = compact_rows_host(rp, t_pad.dtypes)
+    np.testing.assert_array_equal(np.asarray(compacted.offsets),
+                                  np.asarray(rc.offsets))
+    np.testing.assert_array_equal(np.asarray(compacted.data),
+                                  np.asarray(rc.data))
+
+
+def test_padded_native_decoder_cross_check(rng):
+    """The native C++ decoder reads a padded blob via its pairs (the
+    cross-engine boundary check, VERDICT r1 done-criterion)."""
+    from spark_rapids_jni_tpu.ops.native_rows import (
+        decode_variable_native, native_available)
+    if not native_available():
+        import pytest
+        pytest.skip("native library not built")
+    n = 257
+    vals = _random_strings(rng, n)
+    t = Table((Column.strings_padded(vals),
+               Column.from_numpy(rng.integers(-9, 9, n, np.int8), INT8)))
+    [rows] = convert_to_rows(t)
+    cols, valid, soffs, chars = decode_variable_native(
+        np.asarray(rows.data), np.asarray(rows.offsets).astype(np.int64),
+        t.dtypes)
+    exp = t.columns[0].to_arrow()
+    np.testing.assert_array_equal(soffs[0], np.asarray(exp.offsets))
+    np.testing.assert_array_equal(chars[0], np.asarray(exp.chars))
+
+
+def test_padded_batching_equal_sized(rng):
+    n = 1000
+    t = Table((Column.strings_padded(_random_strings(rng, n, max_len=30)),
+               Column.from_numpy(rng.integers(0, 100, n, dtype=np.int32),
+                                 INT32)))
+    batches = convert_to_rows(t, size_limit=16 * 1024)
+    assert len(batches) > 1
+    for b in batches[:-1]:
+        assert b.num_rows % 32 == 0
+        assert int(np.asarray(b.offsets)[-1]) <= 16 * 1024
+    parts = [convert_from_rows(b, t.dtypes) for b in batches]
+    assert_tables_equivalent(t, concat_tables(parts))
+
+
+def test_padded_all_null_and_empty():
+    t = Table((Column.strings_padded([None, "", None]),))
+    [rows] = convert_to_rows(t)
+    got = convert_from_rows(rows, t.dtypes)
+    assert got.columns[0].to_pylist() == [None, "", None]
+
+
 def test_long_string_fallback_roundtrip():
     """Columns whose longest string exceeds the largest window bucket use
     the per-char fallback; mixed with a windowed column in one table."""
