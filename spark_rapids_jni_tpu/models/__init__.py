@@ -1,4 +1,5 @@
 from spark_rapids_jni_tpu.models.pipeline import (  # noqa: F401
-    filter_mask, hash_aggregate_sum, project, sort_merge_join,
-    flagship_query_step, distributed_query_step,
+    filter_mask, hash_aggregate_sum, hash_aggregate_sum_multi, project,
+    sort_merge_join, sort_merge_join_dup,
+    flagship_query_step, distributed_query_step, distributed_q72_step,
 )

@@ -113,6 +113,64 @@ def hash_aggregate_sum(keys: jnp.ndarray, values: jnp.ndarray,
     return gkeys, sums, have, num_groups
 
 
+def _lexsort_live_last(keys, mask):
+    """Stable lexicographic order over multiple int key arrays (first key
+    is the major one), with masked-out rows pushed to the end via max-key
+    sentinels.  Returns (order, sorted_keys, sorted_live)."""
+    n = keys[0].shape[0]
+    ks = [jnp.where(mask, k, jnp.iinfo(k.dtype).max) for k in keys]
+    order = jnp.arange(n, dtype=jnp.int32)
+    for k in reversed(ks):       # chained stable sorts = lexicographic
+        order = order[jnp.argsort(k[order], stable=True)]
+    return order, [k[order] for k in ks], mask[order]
+
+
+def hash_aggregate_sum_multi(keys: Sequence[jnp.ndarray],
+                             values: Sequence[jnp.ndarray],
+                             mask: jnp.ndarray, max_groups: int):
+    """Multi-key, multi-measure group-by-sum with static output capacity
+    (the TPC-DS q72 aggregate shape: GROUP BY item, warehouse, week).
+
+    ``keys``: int arrays defining the composite group key; ``values``:
+    measures summed per group.  Returns (group_keys_list[max_groups each],
+    sums_list, have mask, num_groups) with the same overflow contract as
+    :func:`hash_aggregate_sum` — ``num_groups`` counts ALL distinct live
+    composite keys, so callers detect capacity overflow on the host."""
+    n = keys[0].shape[0]
+    if n == 0:  # a zero-row partition must aggregate to "no groups"
+        z = jnp.zeros((max_groups,), jnp.int32)
+        return ([z.astype(k.dtype) for k in keys],
+                [z.astype(v.dtype) for v in values],
+                jnp.zeros((max_groups,), jnp.bool_), jnp.int32(0))
+    order, ks, live = _lexsort_live_last(list(keys), mask)
+    vs = [jnp.where(live, v[order], 0) for v in values]
+    changed = jnp.zeros((n - 1,), jnp.bool_) if n > 1 else None
+    for k in ks:
+        if n > 1:
+            changed = changed | (k[1:] != k[:-1])
+    is_new = jnp.concatenate(
+        [jnp.ones((1,), jnp.int32),
+         changed.astype(jnp.int32) if n > 1 else jnp.zeros((0,), jnp.int32)])
+    seg = jnp.cumsum(is_new) - 1
+    in_range = seg < max_groups
+    seg_c = jnp.where(in_range, seg, max_groups)
+    contrib = live & in_range
+    sums = [jax.ops.segment_sum(jnp.where(contrib, v, 0), seg_c,
+                                num_segments=max_groups + 1)[:max_groups]
+            for v in vs]
+    first_idx = jax.ops.segment_min(
+        jnp.arange(n, dtype=jnp.int32), seg_c,
+        num_segments=max_groups + 1)[:max_groups]
+    have = jax.ops.segment_max(contrib.astype(jnp.int32), seg_c,
+                               num_segments=max_groups + 1)[:max_groups] > 0
+    safe = jnp.minimum(first_idx, n - 1)
+    gkeys = [jnp.where(have, k[safe], 0) for k in ks]
+    seg_live = jax.ops.segment_sum(live.astype(jnp.int32), seg,
+                                   num_segments=n) > 0
+    num_groups = jnp.sum(seg_live.astype(jnp.int32))
+    return gkeys, sums, have, num_groups
+
+
 # ---------------------------------------------------------------------------
 # Join (build: unique sorted keys; probe: binary search)
 # ---------------------------------------------------------------------------
@@ -131,6 +189,47 @@ def sort_merge_join(build_keys: jnp.ndarray, build_payload: jnp.ndarray,
     pos = jnp.minimum(pos, bk.shape[0] - 1)
     matched = bk[pos] == probe_keys
     return bp[pos], matched
+
+
+def sort_merge_join_dup(build_keys: jnp.ndarray,
+                        build_payload: jnp.ndarray,
+                        probe_keys: jnp.ndarray,
+                        capacity: int):
+    """Inner equi-join where the build side may hold DUPLICATE keys (q72's
+    inventory join: many inventory rows per item).
+
+    One probe row emits one output row per matching build row.  Output is
+    a static ``capacity``-slot buffer with the shuffle's overflow contract:
+    returns (probe_idx[capacity], build_payload_out[capacity],
+    slot_valid[capacity], total_matches, overflow).  ``probe_idx[j]`` maps
+    output slot j back to its probe row for payload gathers; when
+    ``overflow`` is True the caller must retry with more capacity.
+    """
+    nb = build_keys.shape[0]
+    npk = probe_keys.shape[0]
+    if nb == 0 or npk == 0:  # empty side: zero matches, no gather crash
+        z32 = jnp.zeros((capacity,), jnp.int32)
+        return (z32, jnp.zeros((capacity,), build_payload.dtype),
+                jnp.zeros((capacity,), jnp.bool_), jnp.int32(0),
+                jnp.bool_(False))
+    order = jnp.argsort(build_keys)
+    bk = build_keys[order]
+    bp = build_payload[order]
+    lo = jnp.searchsorted(bk, probe_keys, side="left")
+    hi = jnp.searchsorted(bk, probe_keys, side="right")
+    counts = (hi - lo).astype(jnp.int32)
+    starts = jnp.cumsum(counts) - counts
+    total = jnp.sum(counts)
+    overflow = total > capacity
+    slots = jnp.arange(capacity, dtype=jnp.int32)
+    # slot -> probe row: last start <= slot (vectorized binary search)
+    probe_idx = jnp.searchsorted(starts, slots, side="right") \
+        .astype(jnp.int32) - 1
+    probe_idx = jnp.clip(probe_idx, 0, probe_keys.shape[0] - 1)
+    within = slots - starts[probe_idx]
+    valid = (slots < total) & (within < counts[probe_idx])
+    bidx = jnp.clip(lo[probe_idx] + within, 0, nb - 1)
+    return probe_idx, jnp.where(valid, bp[bidx], 0), valid, total, overflow
 
 
 # ---------------------------------------------------------------------------
@@ -192,3 +291,55 @@ def distributed_query_step(mesh, axis_name="data",
     spec = P(axis_name)
     return shard_map(step, mesh=mesh, in_specs=(spec, spec),
                      out_specs=spec, check_vma=False)
+
+
+def distributed_q72_step(mesh, axis_name="data",
+                         capacity_factor: float = 8.0,
+                         join_expansion: int = 4,
+                         max_groups: int = MAX_GROUPS):
+    """The full TPC-DS q72 shape (BASELINE.json's named config), distributed:
+
+    catalog_sales-like rows (item, week, quantity) hash-exchange by item
+    across the mesh; each device joins its rows against a REPLICATED
+    inventory build side with duplicate item keys
+    (:func:`sort_merge_join_dup`), filters to under-stocked matches
+    (inv_qty < quantity), and multi-key aggregates COUNT and SUM(quantity)
+    by (item, week) (:func:`hash_aggregate_sum_multi`).
+
+    Returns a function (item, week, qty, build_item, build_inv) ->
+    (gitem, gweek, counts, qsums, have, num_groups, overflow) per device;
+    ``overflow`` ORs the shuffle-bucket and join-capacity overflows so the
+    host can retry with more slack.
+    """
+    from jax.sharding import PartitionSpec as P
+    from spark_rapids_jni_tpu.parallel.shuffle import bucket_exchange
+    from spark_rapids_jni_tpu.table import INT32
+    num_parts = mesh.shape[axis_name]
+
+    def step(item_key, week, quantity, build_item, build_inv):
+        n_local = item_key.shape[0]
+        capacity = max(8, int(capacity_factor * n_local / num_parts))
+        pids = pmod(murmur3_hash([Column(INT32, item_key)]), num_parts)
+        payload = jnp.stack([item_key, week, quantity], axis=1)
+        exchange = bucket_exchange(num_parts, capacity, axis_name)
+        recv, valid, _, x_overflow = exchange(payload, pids)
+        r_item, r_week, r_qty = recv[:, 0], recv[:, 1], recv[:, 2]
+
+        join_cap = recv.shape[0] * join_expansion
+        pidx, inv_q, jvalid, _, j_overflow = sort_merge_join_dup(
+            build_item, build_inv, r_item, join_cap)
+        live = jvalid & valid[pidx] & (inv_q < r_qty[pidx])
+        gkeys, sums, have, num_groups = hash_aggregate_sum_multi(
+            [r_item[pidx], r_week[pidx]],
+            [jnp.ones_like(inv_q), r_qty[pidx]],
+            live, max_groups)
+        overflow = x_overflow | j_overflow
+        return (gkeys[0], gkeys[1], sums[0], sums[1], have,
+                num_groups[None], overflow[None])
+
+    from jax import shard_map
+    spec = P(axis_name)
+    rep = P()
+    return shard_map(step, mesh=mesh,
+                     in_specs=(spec, spec, spec, rep, rep),
+                     out_specs=(spec,) * 6 + (spec,), check_vma=False)

@@ -1,6 +1,7 @@
 """Query-operator tests: numpy oracles for group-by / join / the flagship
 pipeline, plus the distributed exchange+aggregate step on the 8-device mesh."""
 
+import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -128,3 +129,138 @@ def test_hash_aggregate_max_sentinel_key_is_valid(rng):
            zip(np.asarray(gk), np.asarray(sums), np.asarray(have)) if h}
     assert got == {5: 1, 7: 2, big: 10}
     assert int(ng) == 3
+
+
+# ---------------------------------------------------------------------------
+# Multi-key aggregate + duplicate-key join + the q72 distributed shape
+# ---------------------------------------------------------------------------
+
+def test_hash_aggregate_sum_multi_matches_numpy(rng):
+    from spark_rapids_jni_tpu.models import hash_aggregate_sum_multi
+    n = 700
+    k1 = rng.integers(0, 9, n).astype(np.int32)
+    k2 = rng.integers(0, 7, n).astype(np.int32)
+    v1 = rng.integers(-50, 50, n).astype(np.int32)
+    v2 = rng.integers(0, 10, n).astype(np.int32)
+    mask = rng.random(n) > 0.2
+    gkeys, sums, have, ng = jax.jit(
+        lambda *a: hash_aggregate_sum_multi(a[:2], a[2:4], a[4], 128))(
+        jnp.asarray(k1), jnp.asarray(k2), jnp.asarray(v1), jnp.asarray(v2),
+        jnp.asarray(mask))
+    exp = {}
+    for i in range(n):
+        if mask[i]:
+            key = (int(k1[i]), int(k2[i]))
+            a, b = exp.get(key, (0, 0))
+            exp[key] = (a + int(v1[i]), b + int(v2[i]))
+    got = {}
+    g1, g2 = np.asarray(gkeys[0]), np.asarray(gkeys[1])
+    s1, s2 = np.asarray(sums[0]), np.asarray(sums[1])
+    hv = np.asarray(have)
+    for j in range(len(hv)):
+        if hv[j]:
+            got[(int(g1[j]), int(g2[j]))] = (int(s1[j]), int(s2[j]))
+    assert got == exp
+    assert int(np.asarray(ng)) == len(exp)
+
+
+def test_hash_aggregate_sum_multi_overflow_contract(rng):
+    from spark_rapids_jni_tpu.models import hash_aggregate_sum_multi
+    n = 200
+    k1 = np.arange(n, dtype=np.int32)   # every row its own group
+    k2 = np.zeros(n, np.int32)
+    v = np.ones(n, np.int32)
+    gkeys, sums, have, ng = hash_aggregate_sum_multi(
+        [jnp.asarray(k1), jnp.asarray(k2)], [jnp.asarray(v)],
+        jnp.ones(n, bool), 16)
+    assert int(np.asarray(ng)) == n          # overflow detectable
+    # surviving groups are the 16 smallest keys, uncorrupted
+    np.testing.assert_array_equal(np.asarray(gkeys[0]), np.arange(16))
+    np.testing.assert_array_equal(np.asarray(sums[0]), np.ones(16))
+
+
+def test_sort_merge_join_dup_matches_numpy(rng):
+    from spark_rapids_jni_tpu.models import sort_merge_join_dup
+    nb, np_ = 300, 120
+    bk = rng.integers(0, 40, nb).astype(np.int32)     # heavy duplication
+    bp = rng.integers(-99, 99, nb).astype(np.int32)
+    pk = rng.integers(0, 50, np_).astype(np.int32)    # some keys unmatched
+    cap = 4096
+    pidx, bpo, valid, total, overflow = jax.jit(
+        functools.partial(sort_merge_join_dup, capacity=cap))(
+        jnp.asarray(bk), jnp.asarray(bp), jnp.asarray(pk))
+    assert not bool(np.asarray(overflow))
+    got = sorted((int(pk[p]), int(b))
+                 for p, b, v in zip(np.asarray(pidx), np.asarray(bpo),
+                                    np.asarray(valid)) if v)
+    exp = sorted((int(k), int(bp[j])) for k in pk
+                 for j in range(nb) if bk[j] == k)
+    assert got == exp
+    assert int(np.asarray(total)) == len(exp)
+
+
+def test_sort_merge_join_dup_overflow(rng):
+    from spark_rapids_jni_tpu.models import sort_merge_join_dup
+    bk = np.zeros(50, np.int32)    # every probe matches all 50
+    bp = np.arange(50, dtype=np.int32)
+    pk = np.zeros(10, np.int32)
+    _, _, valid, total, overflow = sort_merge_join_dup(
+        jnp.asarray(bk), jnp.asarray(bp), jnp.asarray(pk), 100)
+    assert bool(np.asarray(overflow))
+    assert int(np.asarray(total)) == 500
+    assert int(np.asarray(valid).sum()) == 100  # capacity-bounded, flagged
+
+
+def test_distributed_q72_step(rng, cpu_devices):
+    """The q72-shaped config end to end on the 8-device mesh: exchange ->
+    duplicate-key join -> filter -> multi-key aggregate, vs a numpy oracle."""
+    from spark_rapids_jni_tpu.models import distributed_q72_step
+    mesh = make_mesh(cpu_devices[:8])
+    n = 8 * 128
+    item = rng.integers(0, 24, n).astype(np.int32)
+    week = rng.integers(0, 4, n).astype(np.int32)
+    qty = rng.integers(1, 10, n).astype(np.int32)
+    nb = 96
+    b_item = rng.integers(0, 24, nb).astype(np.int32)   # duplicate keys
+    b_inv = rng.integers(0, 8, nb).astype(np.int32)
+
+    step = distributed_q72_step(mesh)
+    gi, gw, cnt, qs, have, ng, overflow = jax.jit(step)(
+        jnp.asarray(item), jnp.asarray(week), jnp.asarray(qty),
+        jnp.asarray(b_item), jnp.asarray(b_inv))
+    assert not np.asarray(overflow).any()
+
+    exp = {}
+    for i in range(n):
+        for j in range(nb):
+            if b_item[j] == item[i] and b_inv[j] < qty[i]:
+                key = (int(item[i]), int(week[i]))
+                c, s = exp.get(key, (0, 0))
+                exp[key] = (c + 1, s + int(qty[i]))
+    got = {}
+    gi, gw = np.asarray(gi).reshape(-1), np.asarray(gw).reshape(-1)
+    cnt, qs = np.asarray(cnt).reshape(-1), np.asarray(qs).reshape(-1)
+    hv = np.asarray(have).reshape(-1)
+    for j in range(len(hv)):
+        if hv[j]:
+            key = (int(gi[j]), int(gw[j]))
+            assert key not in got, "group split across devices"
+            got[key] = (int(cnt[j]), int(qs[j]))
+    assert got == exp
+
+
+def test_empty_inputs_do_not_crash():
+    """Zero-row partitions and empty join sides (review regression)."""
+    from spark_rapids_jni_tpu.models import (
+        hash_aggregate_sum_multi, sort_merge_join_dup)
+    z32 = jnp.zeros((0,), jnp.int32)
+    gkeys, sums, have, ng = hash_aggregate_sum_multi(
+        [z32, z32], [z32], jnp.zeros((0,), bool), 8)
+    assert int(np.asarray(ng)) == 0 and not np.asarray(have).any()
+    pidx, bpo, valid, total, ovf = sort_merge_join_dup(
+        z32, z32, jnp.arange(5, dtype=jnp.int32), 16)
+    assert int(np.asarray(total)) == 0 and not np.asarray(valid).any()
+    pidx, bpo, valid, total, ovf = sort_merge_join_dup(
+        jnp.arange(5, dtype=jnp.int32), jnp.arange(5, dtype=jnp.int32),
+        z32, 16)
+    assert int(np.asarray(total)) == 0 and not bool(np.asarray(ovf))
