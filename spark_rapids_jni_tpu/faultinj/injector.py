@@ -174,6 +174,20 @@ class FaultInjectorState:
                 self.rules[domain] = table
             logger.info("faultinj config applied: %s",
                         {d: list(r) for d, r in self.rules.items()})
+        # armed rules must see every dispatch: flush jit fast paths that
+        # were established before this config landed (the C++ pjit cache
+        # would otherwise execute below the Python hooks — see install())
+        if _INSTALLED and any(self.rules.get(d) for d in _DOMAINS):
+            try:
+                import jax
+                jax.clear_caches()
+            except Exception:  # config can be applied before jax init
+                pass
+
+    def has_active_rules(self, domain: str) -> bool:
+        with self.lock:
+            return any(r.interception_count > 0
+                       for r in self.rules.get(domain, {}).values())
 
     # -- hot reload (inotify-thread analogue, faultinj.cu:419-470) ---------
     def _start_watcher(self) -> None:
@@ -213,11 +227,18 @@ class FaultInjectorState:
 
     # -- matching (cbid -> name -> "*" precedence, faultinj.cu:142-152) ----
     def lookup(self, domain: str, name: str) -> Optional[FaultRule]:
+        """Exact name, then dotted prefixes (``device_put.tpu`` falls back
+        to ``device_put``), then the ``*`` wildcard."""
         table = self.rules[domain]
-        rule = table.get(name)
-        if rule is None:
-            rule = table.get("*")
-        return rule
+        probe = name
+        while True:
+            rule = table.get(probe)
+            if rule is not None:
+                return rule
+            if "." not in probe:
+                break
+            probe = probe.rsplit(".", 1)[0]
+        return table.get("*")
 
     def maybe_inject(self, domain: str, name: str) -> None:
         """Called on every intercepted API call; raises to inject."""
@@ -299,6 +320,7 @@ def install(config_path: Optional[str] = None,
     import jax._src.compiler as _compiler
     import jax._src.dispatch as _dispatch
     import jax._src.interpreters.pxla as _pxla
+    import jax._src.pjit as _pjit
 
     # every compile request funnels through compile_or_get_cached
     # (jax calls it via the module attribute, so rebinding intercepts)
@@ -314,14 +336,39 @@ def install(config_path: Optional[str] = None,
         lambda self, *a, **k: getattr(self, "name", "?"),
         _SAVED["execute_call"])
 
+    # The C++ pjit fast path executes cached computations entirely below
+    # Python (measured: 3 of 5 repeat invocations bypass the hook above).
+    # While execute-domain rules are armed, refuse to hand jax the
+    # fastpath data so EVERY invocation routes through the interposed
+    # Python dispatch — the closest Python can get to the reference's
+    # CUPTI guarantee of seeing every runtime API call (faultinj.cu:154).
+    _SAVED["fastpath_data"] = _pjit._get_fastpath_data
+
+    def _gated_fastpath(*args, **kwargs):
+        if _STATE.has_active_rules(DOMAIN_EXECUTE) \
+                or _STATE.device_dead:
+            return None
+        return _SAVED["fastpath_data"](*args, **kwargs)
+
+    _pjit._get_fastpath_data = _gated_fastpath
+
+    def _transfer_name(*xs, **kwargs):
+        # real per-call names: target platform qualifies the API name, so
+        # rules can target e.g. "device_put.tpu" (dotted-prefix fallback
+        # keeps plain "device_put" rules matching every transfer)
+        devices = kwargs.get("devices")
+        try:
+            return f"device_put.{devices[0].platform}"
+        except Exception:
+            return "device_put"
+
     _SAVED["device_put"] = _dispatch._batched_device_put_impl
     _dispatch._batched_device_put_impl = _guarded(
-        DOMAIN_TRANSFER,
-        lambda *a, **k: "device_put",
-        _SAVED["device_put"])
+        DOMAIN_TRANSFER, _transfer_name, _SAVED["device_put"])
 
     _INSTALLED = True
-    logger.info("faultinj installed (compile/execute/transfer hooks)")
+    logger.info("faultinj installed (compile/execute/transfer hooks; "
+                "jit fast path gated while execute rules are armed)")
     return _STATE
 
 
@@ -343,9 +390,11 @@ def uninstall() -> None:
     import jax._src.compiler as _compiler
     import jax._src.dispatch as _dispatch
     import jax._src.interpreters.pxla as _pxla
+    import jax._src.pjit as _pjit
     _compiler.compile_or_get_cached = _SAVED.pop("compile_or_get_cached")
     _pxla.ExecuteReplicated.__call__ = _SAVED.pop("execute_call")
     _dispatch._batched_device_put_impl = _SAVED.pop("device_put")
+    _pjit._get_fastpath_data = _SAVED.pop("fastpath_data")
     _STATE.stop_watcher()
     _INSTALLED = False
     logger.info("faultinj uninstalled")

@@ -202,3 +202,72 @@ def test_fatal_scenario_subprocess(tmp_path):
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     assert proc.returncode == 0, proc.stderr
     assert "DEVICE-OUT-OF-SERVICE-OK" in proc.stdout, proc.stdout
+
+
+def test_execute_interception_survives_jit_fast_path(hooks):
+    """Repeat invocations of an already-compiled function must still be
+    interceptable: the C++ pjit fast path executes below the Python hooks,
+    so armed execute rules gate it off (regression for the deep-hook
+    requirement; without the gate 3 of 5 repeat calls bypass injection)."""
+    @jax.jit
+    def f(x):
+        return x + 10
+
+    x = jnp.arange(4)
+    # establish the fast path with several warm calls, no rules armed
+    for _ in range(4):
+        jax.block_until_ready(f(x))
+    hooks.apply_config({"pjrtExecuteFaults": {
+        "*": {"percent": 100, "injectionType": 1, "interceptionCount": 2}}})
+    for _ in range(2):
+        with pytest.raises(faultinj.DeviceAssertError):
+            jax.block_until_ready(f(x))
+    # budget exhausted -> fast path re-enables and calls succeed again
+    for _ in range(3):
+        assert int(jax.block_until_ready(f(x))[1]) == 11
+
+
+def test_transfer_names_carry_platform(hooks):
+    """Transfers report real per-call names (device_put.<platform>) with
+    dotted-prefix fallback, not one constant name."""
+    cpu = jax.devices("cpu")[0]
+    hooks.apply_config({"pjrtTransferFaults": {
+        "device_put.cpu": {"percent": 100, "injectionType": 1,
+                           "interceptionCount": 1}}})
+    with pytest.raises(faultinj.DeviceAssertError):
+        jax.device_put(jnp.zeros(3), cpu)
+    # plain "device_put" rules still match via prefix fallback
+    hooks.apply_config({"pjrtTransferFaults": {
+        "device_put": {"percent": 100, "injectionType": 1,
+                       "interceptionCount": 1}}})
+    with pytest.raises(faultinj.DeviceAssertError):
+        jax.device_put(jnp.zeros(3), cpu)
+
+
+def test_fatal_child_process_does_not_poison_parent(tmp_path):
+    """CudaFatalTest-isolation analogue (reference pom.xml:517-532): the
+    deliberately-fatal scenario runs in a forked process that DIES, and
+    the parent keeps a working backend."""
+    cfg = tmp_path / "fatal.json"
+    cfg.write_text(json.dumps({"pjrtExecuteFaults": {
+        "*": {"percent": 100, "injectionType": 0,
+              "interceptionCount": 1}}}))
+    app = tmp_path / "die.py"
+    app.write_text(textwrap.dedent("""
+        import jax, jax.numpy as jnp
+        from spark_rapids_jni_tpu import faultinj
+        jax.config.update("jax_default_device", jax.devices("cpu")[0])
+        # unhandled FatalDeviceError must kill the process
+        jax.block_until_ready(jax.jit(lambda x: x * 2)(jnp.arange(4)))
+    """))
+    env = dict(os.environ, FAULT_INJECTOR_CONFIG_PATH=str(cfg),
+               JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run(
+        [sys.executable, "-m", "spark_rapids_jni_tpu.faultinj", str(app)],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode != 0, "fatal fault must kill the child"
+    assert "FatalDeviceError" in proc.stderr
+    # parent backend unaffected by the child's death
+    assert int(jax.block_until_ready(
+        jax.jit(lambda x: x + 1)(jnp.int32(1)))) == 2
