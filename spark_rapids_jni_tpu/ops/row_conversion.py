@@ -390,10 +390,12 @@ def convert_to_rows(table: Table, *, size_limit: int = MAX_BATCH_BYTES,
     def encode(start=0, size=None):
         if impl == "pallas":
             from spark_rapids_jni_tpu.ops import row_kernels
-            tbl = (table if size is None
-                   else _slice_table(table, start, start + size))
-            return row_kernels.to_rows_fixed(tbl, layout,
-                                             interpret=platform != "tpu")
+            if size is None:
+                return row_kernels.to_rows_fixed(
+                    table, layout, interpret=platform != "tpu")
+            return row_kernels.to_rows_fixed_batch(
+                table, layout, jnp.int32(start), size,
+                interpret=platform != "tpu")
         if impl == "mxu":
             from spark_rapids_jni_tpu.ops import row_mxu
             return row_mxu.to_rows_fixed(table, layout, start, size)
@@ -661,14 +663,12 @@ def compact_rows_host(rows: RowsColumn, dtypes: Sequence[DType]) -> RowsColumn:
         out[(out_offs[:-1, None] + s + np.arange(4)[None, :]).reshape(-1)] \
             = pb.reshape(-1)
     # chars: ragged scatter via repeat (C-speed on host)
+    from spark_rapids_jni_tpu.table import ragged_positions
     for si, (s, w) in enumerate(zip(slot_starts, rows.str_widths)):
         l = lens[:, si]
-        total = int(l.sum())
-        if total == 0:
+        if int(l.sum()) == 0:
             continue
-        rows_r = np.repeat(np.arange(n, dtype=np.int64), l)
-        intra = np.arange(total, dtype=np.int64) - \
-            np.repeat((np.cumsum(l) - l), l)
+        rows_r, intra = ragged_positions(l)
         src = rows_r * rs + s + intra
         dst = out_offs[rows_r] + fe + within[rows_r, si] + intra
         out[dst] = blob.reshape(-1)[src]
@@ -924,16 +924,24 @@ def _extract_fixed_variable_jit(data: jnp.ndarray, offsets: jnp.ndarray,
     return datas, masks, f_words, str_lens
 
 
+def _validity_from_fwords(f_words: jnp.ndarray,
+                          layout: RowLayout) -> jnp.ndarray:
+    """Per-column packed validity masks [ncols, ceil(n/8)] from per-row
+    fixed-section words (see ``packed_masks_from_byte_planes`` for why
+    this avoids per-column stacks)."""
+    from spark_rapids_jni_tpu.table import (
+        byte_planes_from_word_planes, packed_masks_from_byte_planes)
+    vo, vb = layout.validity_offset, layout.validity_bytes
+    w0, w1 = vo // 4, (vo + vb + 3) // 4
+    vbT = byte_planes_from_word_planes(f_words[:, w0:w1].T, vb, vo % 4)
+    return packed_masks_from_byte_planes(vbT, layout.num_columns)
+
+
 def _cols_from_fwords(f_words: jnp.ndarray, layout: RowLayout):
     """Extract every column's data, packed validity mask, and string
     lengths from per-row fixed-section words [n, fe_pad/4] (shared by the
     compact-gather and padded-slice decode paths)."""
-    valid_cols = []
-    for i in range(layout.num_columns):
-        j = layout.validity_offset + i // 8
-        byte = (f_words[:, j // 4] >> (8 * (j % 4))) & 0xFF
-        valid_cols.append(((byte >> (i % 8)) & 1).astype(jnp.bool_))
-    vmask = pack_bools_2d(jnp.stack(valid_cols, axis=0))    # [ncols, nb]
+    vmask = _validity_from_fwords(f_words, layout)          # [ncols, nb]
     masks = [vmask[i] for i in range(layout.num_columns)]
     datas = [None if dt.is_string
              else _col_from_words(f_words, layout.col_starts[i], dt)

@@ -117,6 +117,18 @@ def to_rows_fixed(table: Table, layout: RowLayout,
     return _to_rows_pallas(table, layout, tile_rows, interpret)
 
 
+@functools.partial(jax.jit, static_argnums=(1, 3, 4))
+def to_rows_fixed_batch(table: Table, layout: RowLayout, start,
+                        size: int, interpret: bool = False) -> jnp.ndarray:
+    """One row-batch via the Pallas kernel, sliced inside the jit with a
+    *traced* start so every equal-sized batch reuses one executable (the
+    static-slice variant compiled one program per batch)."""
+    from spark_rapids_jni_tpu.table import slice_table_dynamic
+    if size != table.num_rows:
+        table = slice_table_dynamic(table, start, size)
+    return to_rows_fixed(table, layout, interpret=interpret)
+
+
 # ---------------------------------------------------------------------------
 # from rows
 # ---------------------------------------------------------------------------

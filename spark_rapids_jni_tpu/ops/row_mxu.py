@@ -221,7 +221,7 @@ def _pack_planes(table: Table, layout: RowLayout, plan: WordPlan,
 # validity bit-unpack stay in XLA (Mosaic cannot lane-merge the bit
 # unpack's minor dims).
 
-_PACK_TILE = 1024
+_PACK_TILE = 2048  # measured best on v5e (4096+ exceeds VMEM and fails)
 
 
 def _pack_kernel(counts, *refs):
@@ -337,12 +337,11 @@ def _validity_quads(table: Table, layout: RowLayout) -> jnp.ndarray:
 # Encode: table -> flat uint8 JCUDF rows (n * fixed_row_size)
 # ---------------------------------------------------------------------------
 
-# The int8 dots accumulate in int32: an unfused convert materializes a
-# temp 4x the byte blob.  Dots therefore process rows in slabs
-# (python-unrolled inside the trace) sized so the i32 temp stays ~4GB —
-# XLA's in-order liveness frees each slab before the next.  Chunking has
-# real cost (operand slices copy, smaller dots pipeline worse), so the
-# slab is as large as the temp budget allows.
+# The dots request int8 output (``preferred_element_type=jnp.int8``):
+# every output byte is a mod-256 sum, so the int8 wraparound is exactly
+# the intended arithmetic and the i32 accumulator never leaves the MXU —
+# measured, this removes a 4x-blob HLO temp and the row-slab chunk loop
+# the i32 epilogue needed.
 _DOT_CHUNK_ROWS = 512 * 1024  # floor for very wide rows
 
 
@@ -364,21 +363,14 @@ def _to_rows_mxu_jit(table: Table, layout: RowLayout, p3: jnp.ndarray,
         valid_units = [_as_u32(table.column(c).valid_bools())
                        for c in range(layout.num_columns)]
         xt = _pack_planes(table, layout, plan, valid_units)  # [W, n] u32
-    n = xt.shape[1]
-    chunk = _dot_chunk_rows(layout.fixed_row_size)
-    parts = []
-    for s in range(0, max(n, 1), chunk):
-        e = min(n, s + chunk)
-        xb = jax.lax.bitcast_convert_type(xt[:, s:e], jnp.uint8)
-        rows = jax.lax.dot_general(
-            xb.astype(jnp.int8), p3,
-            dimension_numbers=(((0, 2), (0, 1)), ((), ())),
-            preferred_element_type=jnp.int32)
-        parts.append(rows.astype(jnp.uint8))
-    rows = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+    xb = jax.lax.bitcast_convert_type(xt, jnp.uint8)
+    rows = jax.lax.dot_general(
+        xb.astype(jnp.int8), p3,
+        dimension_numbers=(((0, 2), (0, 1)), ((), ())),
+        preferred_element_type=jnp.int8)
     # flatten inside the jit: the blob contract is 1-D and an eager
     # reshape would dispatch a full-blob copy
-    return rows.reshape(-1)
+    return jax.lax.bitcast_convert_type(rows, jnp.uint8).reshape(-1)
 
 
 @functools.lru_cache(maxsize=64)
@@ -427,35 +419,25 @@ def _from_rows_mxu_jit(rows_flat: jnp.ndarray, layout: RowLayout,
     # reshape inside the jit: an eager reshape is a separate dispatched
     # copy of the whole blob on remote-tunnel backends
     rows2d = rows_flat.reshape(-1, layout.fixed_row_size)
-    n = rows2d.shape[0]
-    # the [W, 4, ck] i32 temp plus its uint32 copy are both live through
-    # the combine, so the inverse runs best with a tighter budget
-    chunk = _dot_chunk_rows(4 * plan.num_words, budget=2 << 30)
-    parts = []
-    for s in range(0, max(n, 1), chunk):
-        e = min(n, s + chunk)
-        o = jax.lax.dot_general(
-            p3, rows2d[s:e].astype(jnp.int8),
-            dimension_numbers=(((0,), (1,)), ((), ())),
-            preferred_element_type=jnp.int32)               # [W, 4, ck]
-        o = (o.astype(jnp.uint32) & 0xFF)
-        parts.append(o[:, 0, :] | (o[:, 1, :] << 8)
-                     | (o[:, 2, :] << 16) | (o[:, 3, :] << 24))
-    x = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+    o = jax.lax.dot_general(
+        p3, rows2d.astype(jnp.int8),
+        dimension_numbers=(((0,), (1,)), ((), ())),
+        preferred_element_type=jnp.int8)                    # [W, 4, n]
+    ou = jax.lax.bitcast_convert_type(o, jnp.uint8).astype(jnp.uint32)
+    x = (ou[:, 0, :] | (ou[:, 1, :] << 8)
+         | (ou[:, 2, :] << 16) | (ou[:, 3, :] << 24))       # [W, n]
 
     # validity: expand the quad-packed validity byte planes to one bit
-    # plane per column in a handful of big ops (per-column expressions
-    # would cost ~ncols separate fusions)
+    # plane per column (shared TPU-safe expansion; see
+    # ``packed_masks_from_byte_planes``)
+    from spark_rapids_jni_tpu.table import (
+        byte_planes_from_word_planes, packed_masks_from_byte_planes)
     ncols = layout.num_columns
     vbytes = layout.validity_bytes
     vw0 = plan.validity_word[0]
     vwq = (vbytes + 3) // 4
-    vq = x[vw0:vw0 + vwq]                                    # [vwq, n]
-    vb = jnp.stack([(vq >> (8 * k)) & 0xFF for k in range(4)],
-                   axis=1).reshape(vwq * 4, -1)[:vbytes]     # [vbytes, n]
-    bits = jnp.stack([(vb >> b) & 1 for b in range(8)],
-                     axis=1).reshape(vbytes * 8, -1)[:ncols]
-    vmask = pack_bools_2d(bits.astype(jnp.bool_))            # [ncols, nb]
+    vb = byte_planes_from_word_planes(x[vw0:vw0 + vwq], vbytes)
+    vmask = packed_masks_from_byte_planes(vb, ncols)         # [ncols, nb]
 
     # 64-bit columns sit first in the word plan as one contiguous plane
     # block: un-planarize them all with ONE batched transpose instead of a

@@ -25,6 +25,20 @@ from spark_rapids_jni_tpu.ops import (
 from spark_rapids_jni_tpu.table import assert_tables_equivalent
 
 
+@pytest.fixture(params=["x64", "no_x64"])
+def x64_both(request):
+    """Run a test under both 64-bit modes: x64 (host default) and no-x64
+    (the only representation on real TPU — 64-bit columns as uint32
+    pairs).  The shape sweep takes this fixture so the TPU-real mode gets
+    the full sweep, not just the dedicated no-x64 tests."""
+    import jax
+    if request.param == "no_x64":
+        with jax.enable_x64(False):
+            yield request.param
+    else:
+        yield request.param
+
+
 def make_table(rng, dtypes, num_rows, null_pattern=None):
     """null_pattern: None (no mask), 'all', 'none', 'most', 'few' valid
     (reference AllTypesLarge patterns, row_conversion.cpp:587-707)."""
@@ -159,36 +173,36 @@ def test_oracle_matches_numpy_reference(rng):
 # Shape sweep (reference fixtures)
 # --------------------------------------------------------------------------
 
-def test_single(rng):
+def test_single(rng, x64_both):
     roundtrip_check(make_table(rng, [INT32], 1))
 
 
-def test_tall(rng):
+def test_tall(rng, x64_both):
     roundtrip_check(make_table(rng, [INT64], 4096))
 
 
-def test_wide(rng):
+def test_wide(rng, x64_both):
     roundtrip_check(make_table(rng, [INT32] * 100, 1))
 
 
-def test_single_byte_wide(rng):
+def test_single_byte_wide(rng, x64_both):
     roundtrip_check(make_table(rng, [INT8] * 100, 10))
 
 
-def test_non_power_of_two(rng):
+def test_non_power_of_two(rng, x64_both):
     # reference: 6*1024+557 rows x 131 cols (row_conversion.cpp:297-330)
     dtypes = ([INT64, FLOAT64, INT32, FLOAT32, INT16, INT8, BOOL8] * 19)[:131]
     roundtrip_check(make_table(rng, dtypes, 6 * 1024 + 557, "most"))
 
 
 @pytest.mark.parametrize("pattern", [None, "all", "none", "most", "few"])
-def test_all_types_null_patterns(rng, pattern):
+def test_all_types_null_patterns(rng, x64_both, pattern):
     dtypes = [INT8, INT16, INT32, INT64, FLOAT32, FLOAT64, BOOL8,
               UINT32, decimal32(2), decimal64(5)]
     roundtrip_check(make_table(rng, dtypes, 997, pattern))
 
 
-def test_big(rng):
+def test_big(rng, x64_both):
     # scaled-down Big (reference uses 1M+; CPU suite keeps it fast)
     dtypes = ([INT64, INT32, INT16, INT8, FLOAT32, FLOAT64, BOOL8] * 4)[:28]
     roundtrip_check(make_table(rng, dtypes, 50_000, "most"))
