@@ -34,6 +34,10 @@ struct Layout {
   int32_t num_columns() const {
     return static_cast<int32_t>(col_starts.size());
   }
+
+  // end of fixed data + validity, before 8-byte row rounding; string
+  // chars start at or after this offset
+  int32_t fixed_end() const { return validity_offset + validity_bytes; }
 };
 
 // itemsizes[i] is the column's fixed byte width; string columns (marked in

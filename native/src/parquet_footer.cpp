@@ -484,12 +484,14 @@ std::vector<uint8_t> Footer::serialize_file() const {
   std::vector<uint8_t> body = thrift::write_struct(meta);
   std::vector<uint8_t> out;
   out.reserve(body.size() + 12);
-  const char magic[4] = {'P', 'A', 'R', '1'};
-  out.insert(out.end(), magic, magic + 4);
+  const uint8_t magic[4] = {'P', 'A', 'R', '1'};
+  // byte-wise appends: gcc 12 -O3 raises a spurious stringop-overflow on
+  // the equivalent range insert of a 4-byte array
+  for (uint8_t b : magic) out.push_back(b);
   out.insert(out.end(), body.begin(), body.end());
   uint32_t n = static_cast<uint32_t>(body.size());
   for (int k = 0; k < 4; ++k) out.push_back(static_cast<uint8_t>(n >> (8 * k)));
-  out.insert(out.end(), magic, magic + 4);
+  for (uint8_t b : magic) out.push_back(b);
   return out;
 }
 

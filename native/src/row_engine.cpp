@@ -229,14 +229,28 @@ void decode_variable(const Layout& layout, int64_t nrows,
     for (size_t s = 0; s < scols.size(); ++s) str_offsets_out[s][0] = 0;
     for (int64_t r = 0; r < nrows; ++r) {
       const uint8_t* row = blob + row_offsets[r];
+      const int64_t row_extent = row_offsets[r + 1] - row_offsets[r];
+      if (row_extent < layout.fixed_end()) {
+        throw std::runtime_error("row " + std::to_string(r) +
+                                 " shorter than its fixed section");
+      }
       int32_t si = 0;
       for (int32_t c = 0; c < ncols; ++c) {
         const int32_t start = layout.col_starts[c];
         if (layout.is_string[c]) {
           uint32_t len;
           std::memcpy(&len, row + start + 4, 4);
-          str_offsets_out[si][r + 1] =
-              str_offsets_out[si][r] + static_cast<int32_t>(len);
+          // accumulate in int64: hostile lengths must not signed-overflow
+          // the int32 Arrow offsets
+          const int64_t next =
+              static_cast<int64_t>(str_offsets_out[si][r]) +
+              static_cast<int64_t>(len);
+          if (len > static_cast<uint64_t>(row_extent) ||
+              next > INT32_MAX) {
+            throw std::runtime_error("row " + std::to_string(r) +
+                                     " string length out of range");
+          }
+          str_offsets_out[si][r + 1] = static_cast<int32_t>(next);
           ++si;
         } else if (cols_out != nullptr && cols_out[c] != nullptr) {
           const int32_t size = layout.col_sizes[c];
@@ -254,9 +268,14 @@ void decode_variable(const Layout& layout, int64_t nrows,
     }
     return;
   }
-  // pass 2: chars
+  // pass 2: chars.  off/len are read from the blob itself (this is the
+  // wire/compaction boundary), so validate each against the row's extent
+  // before touching memory: a malformed or hostile blob must fail, not
+  // read out of bounds.
   for (int64_t r = 0; r < nrows; ++r) {
     const uint8_t* row = blob + row_offsets[r];
+    const uint64_t row_extent =
+        static_cast<uint64_t>(row_offsets[r + 1] - row_offsets[r]);
     int32_t si = 0;
     for (int32_t c = 0; c < ncols; ++c) {
       if (!layout.is_string[c]) continue;
@@ -264,6 +283,11 @@ void decode_variable(const Layout& layout, int64_t nrows,
       uint32_t off, len;
       std::memcpy(&off, row + start, 4);
       std::memcpy(&len, row + start + 4, 4);
+      if (off < static_cast<uint32_t>(layout.fixed_end()) ||
+          static_cast<uint64_t>(off) + len > row_extent) {
+        throw std::runtime_error("row " + std::to_string(r) +
+                                 " string (offset, length) outside row");
+      }
       std::memcpy(str_chars_out[si] + str_offsets_out[si][r], row + off, len);
       ++si;
     }

@@ -78,6 +78,13 @@ def stage_table_global(host_columns: Sequence[np.ndarray],
     spec = NamedSharding(mesh, P(axis_name))
     naxis = mesh.shape[axis_name]
     nproc = jax.process_count()
+    if naxis % nproc != 0 or naxis // nproc == 0:
+        # uneven device distributions would silently mis-validate local row
+        # counts below (and naxis < nproc would divide by zero)
+        raise ValueError(
+            f"mesh axis size ({naxis}) must be a positive multiple of the "
+            f"process count ({nproc}); uneven per-process device counts "
+            "are not supported by global staging")
     validity = validity if validity is not None else [None] * len(dtypes)
     dtypes = tuple(dtypes)
     if len(host_columns) != len(dtypes) or len(validity) != len(dtypes):

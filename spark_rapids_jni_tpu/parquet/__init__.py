@@ -26,6 +26,7 @@ import struct as _struct
 from typing import List, Optional, Sequence, Tuple
 
 from spark_rapids_jni_tpu.parquet import native as _native
+from spark_rapids_jni_tpu.utils.tracing import func_range
 from spark_rapids_jni_tpu.parquet.pyfooter import (
     PyFooter, TAG_LIST, TAG_MAP, TAG_STRUCT, TAG_VALUE,
 )
@@ -135,16 +136,19 @@ class ParquetFooter:
     def engine(self) -> str:
         return "native" if self._handle is not None else "python"
 
+    @func_range()
     def num_rows(self) -> int:
         if self._handle is not None:
             return _native.load().srj_footer_num_rows(self._handle)
         return self._py.num_rows()
 
+    @func_range()
     def num_columns(self) -> int:
         if self._handle is not None:
             return _native.load().srj_footer_num_columns(self._handle)
         return self._py.num_columns()
 
+    @func_range()
     def serialize_thrift_file(self) -> bytes:
         """PAR1 + thrift footer + u32-LE length + PAR1."""
         if self._handle is not None:
@@ -180,6 +184,7 @@ def _strip_framing(buffer: bytes) -> bytes:
     return buffer
 
 
+@func_range()
 def read_and_filter(buffer: bytes, part_offset: int, part_length: int,
                     schema: StructElement, ignore_case: bool = False,
                     *, engine: str = "auto") -> ParquetFooter:
