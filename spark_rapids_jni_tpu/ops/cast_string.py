@@ -40,8 +40,9 @@ from spark_rapids_jni_tpu.utils.tracing import func_range
 # bytes, the numeric body at PARSE_WIDTH bytes after the leading trim.
 # Strings with >TRIM_WIDTH whitespace on either end, or a trimmed body
 # longer than PARSE_WIDTH bytes (>=14 leading zeros on a 19-digit value),
-# are conservatively null — documented deviation from Spark's unbounded
-# scan, pinned by tests.
+# are *punted to an exact host-side parse* — the device kernel stays
+# static-shape for the overwhelming majority and the rare unbounded tail
+# keeps full Spark semantics (no wire-visible deviation).
 PARSE_WIDTH = 32
 TRIM_WIDTH = 32
 
@@ -192,6 +193,8 @@ def _cast_string_to_int_jit(offsets, chars, itemsize: int, width: int):
     ch, _ = _gather_window_at(offsets[:-1].astype(jnp.int32) + lead,
                               tlen, chars, width)
     limbs, negative, valid, overflow = _parse_int_magnitude(ch, tlen)
+    # rows the static windows cannot decide exactly -> host fallback
+    punted = (~bounded) | (tlen > width)
     valid = valid & bounded
 
     bound = _INT_BOUNDS[itemsize]
@@ -209,7 +212,40 @@ def _cast_string_to_int_jit(offsets, chars, itemsize: int, width: int):
         & jnp.uint32(0xFFFFFFFF)
     out_lo = jnp.where(negative, neg_lo, lo)
     out_hi = jnp.where(negative, neg_hi, hi)
-    return out_lo, out_hi, ok
+    return out_lo, out_hi, ok, punted
+
+
+def _host_parse_punted(raw: bytes, itemsize: int):
+    """Exact Spark CAST semantics for the rare rows the static device
+    windows punt on (same grammar as :func:`_parse_int_magnitude`, with
+    unbounded trim/body).  Returns the value, or None for null."""
+    i, j = 0, len(raw)
+    while i < j and raw[i] <= 0x20:
+        i += 1
+    while j > i and raw[j - 1] <= 0x20:
+        j -= 1
+    body = raw[i:j]
+    if not body:
+        return None
+    neg = body[:1] == b"-"
+    if body[:1] in (b"+", b"-"):
+        body = body[1:]
+    dot = body.find(b".")
+    if dot >= 0:
+        ipart, frac = body[:dot], body[dot + 1:]
+        if b"." in frac:
+            return None
+    else:
+        ipart, frac = body, b""
+    if (ipart and not ipart.isdigit()) or (frac and not frac.isdigit()):
+        return None
+    if not (ipart + frac):
+        return None
+    mag = int(ipart) if ipart else 0
+    bound = _INT_BOUNDS[itemsize]
+    if mag > (bound + 1 if neg else bound):
+        return None
+    return -mag if neg else mag
 
 
 @func_range()
@@ -226,19 +262,16 @@ def cast_string_to_int(col: Column, dtype: DType, *, ansi: bool = False
         raise ValueError("cast_string_to_int needs a string column")
     if dtype.kind not in ("int8", "int16", "int32", "int64"):
         raise ValueError(f"unsupported target dtype {dtype}")
-    out_lo, out_hi, ok = _cast_string_to_int_jit(
+    if col.is_padded:
+        # the trim/parse windows index the ragged chars buffer; padded
+        # columns convert at this host boundary (cast inputs are
+        # parquet-read strings, which arrive Arrow-shaped anyway)
+        col = col.to_arrow()
+    out_lo, out_hi, ok, punted = _cast_string_to_int_jit(
         col.offsets, col.chars, dtype.itemsize, PARSE_WIDTH)
 
     in_valid = col.valid_bools()
     error = in_valid & ~ok
-    if ansi:
-        import numpy as np
-        bad = np.asarray(error)
-        if bad.any():
-            raise ValueError(
-                f"ANSI cast failure: {int(bad.sum())} invalid value(s), "
-                f"first at row {int(bad.argmax())}")
-    result_valid = in_valid & ok
 
     if dtype.itemsize == 8:
         if jax.config.jax_enable_x64:
@@ -253,6 +286,49 @@ def cast_string_to_int(col: Column, dtype: DType, *, ansi: bool = False
         # sign-extend the low limbs for narrow types
         val = (val << (32 - bits)) >> (32 - bits)
         data = val.astype(dtype.np_dtype)
+
+    import numpy as np
+    punted_live = punted & in_valid
+    if isinstance(punted_live, jax.core.Tracer):
+        # under an outer jit the host fallback cannot run: punted rows
+        # stay conservatively null (eager calls — the normal operator
+        # dispatch — get exact semantics)
+        has_punts = False
+    else:
+        # ONE scalar readback gates the rare path; the non-punting common
+        # case stays a single small sync, never a full-array transfer
+        has_punts = bool(jnp.any(punted_live))
+    if has_punts:
+        punted_np = np.asarray(punted_live)
+        # exact host parse for the unbounded tail, patched back in
+        offs = np.asarray(col.offsets)
+        chars_np = np.asarray(col.chars)
+        data_np = np.array(np.asarray(data))
+        ok_np = np.array(np.asarray(ok))
+        for r in np.nonzero(punted_np)[0]:
+            val = _host_parse_punted(
+                chars_np[offs[r]:offs[r + 1]].tobytes(), dtype.itemsize)
+            if val is None:
+                ok_np[r] = False
+                continue
+            ok_np[r] = True
+            if dtype.itemsize == 8 and data_np.ndim == 2:
+                two = val & 0xFFFFFFFFFFFFFFFF
+                data_np[r, 0] = two & 0xFFFFFFFF
+                data_np[r, 1] = two >> 32
+            else:
+                data_np[r] = val
+        data = jnp.asarray(data_np)
+        ok = jnp.asarray(ok_np)
+        error = in_valid & ~ok
+
+    if ansi:
+        bad = np.asarray(error)
+        if bad.any():
+            raise ValueError(
+                f"ANSI cast failure: {int(bad.sum())} invalid value(s), "
+                f"first at row {int(bad.argmax())}")
+    result_valid = in_valid & ok
     return Column(dtype, data, pack_bools(result_valid)), error
 
 

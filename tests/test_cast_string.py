@@ -129,21 +129,48 @@ def test_cast_int64_no_x64_wide_pairs():
 
 
 def test_cast_long_strings_whitespace_padding():
-    """Whitespace padding up to TRIM_WIDTH per side parses (raw length may
-    far exceed PARSE_WIDTH); unbounded runs / oversized bodies are null."""
+    """Whitespace padding up to TRIM_WIDTH per side parses on device; rows
+    past the static windows take the exact host fallback — Spark's
+    unbounded semantics with no wire-visible deviation."""
     cases = [
         ("123" + " " * 30, 123),          # raw len 33 > PARSE_WIDTH
         (" " * 30 + "-77" + " " * 30, -77),
-        ("\t" * 32 + "5", None),          # lead fills the trim window
-        ("5" + " " * 33, None),           # trail fills the trim window
-        ("0" * 33 + "9", None),           # body longer than PARSE_WIDTH
+        ("\t" * 32 + "5", 5),             # lead fills the trim window
+        ("5" + " " * 33, 5),              # trail fills the trim window
+        ("0" * 33 + "9", 9),              # body longer than PARSE_WIDTH
         ("0" * 31 + "9", int("9")),       # body fits exactly (32 <= 32)
-        (" " * 40, None),                 # all whitespace, longer than both
+        (" " * 40, None),                 # all whitespace -> empty -> null
+        (" " * 40 + "2147483648", None),  # host path still bounds-checks
+        (" " * 40 + "12.75", 12),         # host path truncates fractions
+        (" " * 40 + "1.x", None),         # host path rejects bad fractions
+        ("0" * 40 + "123", 123),          # long zero-prefixed body
     ]
     col = Column.strings([s for s, _ in cases])
     out, err = cast_string_to_int(col, INT32)
     assert out.to_pylist() == [e for _, e in cases]
     assert np.asarray(err).tolist() == [e is None for _, e in cases]
+
+
+def test_cast_host_fallback_int64_pair_repr():
+    """Punted rows patch correctly into the no-x64 uint32-pair data."""
+    import jax
+    with jax.enable_x64(False):
+        col = Column.strings([" " * 40 + "-9223372036854775808",
+                              " " * 40 + "9223372036854775807",
+                              "7"])
+        out, err = cast_string_to_int(col, INT64)
+        assert out.to_pylist() == [-(2 ** 63), 2 ** 63 - 1, 7]
+        assert not np.asarray(err).any()
+
+
+def test_cast_ansi_after_fallback():
+    """ANSI mode raises only for rows that fail the exact host parse."""
+    col = Column.strings([" " * 40 + "11"])
+    out, err = cast_string_to_int(col, INT32, ansi=True)
+    assert out.to_pylist() == [11]
+    with pytest.raises(ValueError, match="ANSI"):
+        cast_string_to_int(Column.strings([" " * 40 + "x"]), INT32,
+                           ansi=True)
 
 
 def test_cast_rejects_decimal_dtypes():
