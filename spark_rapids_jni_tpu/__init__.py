@@ -29,7 +29,7 @@ from spark_rapids_jni_tpu.table import (  # noqa: F401
     INT8, INT16, INT32, INT64,
     UINT8, UINT16, UINT32, UINT64,
     FLOAT32, FLOAT64, BOOL8, STRING,
-    decimal32, decimal64,
+    decimal32, decimal64, list_, struct_,
 )
 
 __version__ = "0.1.0"

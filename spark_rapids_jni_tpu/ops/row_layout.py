@@ -78,6 +78,13 @@ def compute_row_layout(dtypes: Sequence[DType]) -> RowLayout:
     variable_starts = []
     pos = 0
     for dt in dtypes:
+        if getattr(dt, "is_nested", False):
+            # parity with the reference: the JCUDF row format carries
+            # fixed-width and string columns only (nested types are read
+            # via ParquetFooter pruning but never cross the row boundary;
+            # cudf raises the same way)
+            raise ValueError(
+                f"JCUDF rows do not support nested column type {dt.kind}")
         if dt.is_string:
             size, align = 8, 4  # uint32 offset + uint32 length
         else:

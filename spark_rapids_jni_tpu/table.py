@@ -48,19 +48,35 @@ class DType:
     compound-type handling in ``row_conversion.cu:1342-1351``); ``scale`` is
     used by decimal types (cudf stores decimal scale out-of-band, reference
     ``RowConversionJni.cpp:43-66`` passes it as a parallel int array).
+    Nested types (``list``/``struct``) carry their child types in
+    ``children`` — the cudf nested-column analogue the ParquetFooter schema
+    DSL selects into (reference ``ParquetFooter.java:62-93``).
     """
 
     kind: str
     itemsize: int
     scale: int = 0
+    children: tuple = ()
 
     @property
     def is_string(self) -> bool:
         return self.kind == "string"
 
     @property
+    def is_list(self) -> bool:
+        return self.kind == "list"
+
+    @property
+    def is_struct(self) -> bool:
+        return self.kind == "struct"
+
+    @property
+    def is_nested(self) -> bool:
+        return self.kind in ("list", "struct")
+
+    @property
     def is_fixed_width(self) -> bool:
-        return not self.is_string
+        return not (self.is_string or self.is_nested)
 
     @property
     def np_dtype(self) -> np.dtype:
@@ -103,6 +119,16 @@ def decimal32(scale: int = 0) -> DType:
 
 def decimal64(scale: int = 0) -> DType:
     return DType("decimal64", 8, scale)
+
+
+def list_(child: DType) -> DType:
+    """LIST<child> (cudf ``lists_column_view`` analogue)."""
+    return DType("list", 4, 0, (child,))
+
+
+def struct_(*fields: DType) -> DType:
+    """STRUCT<fields...> (cudf ``structs_column_view`` analogue)."""
+    return DType("struct", 0, 0, tuple(fields))
 
 
 ALL_FIXED_WIDTH = (INT8, INT16, INT32, INT64, UINT8, UINT16, UINT32, UINT64,
@@ -221,6 +247,10 @@ class Column:
     # dense-padded columns may carry per-row lengths [n] INSTEAD of offsets
     # [n+1]: lengths shard row-wise across a mesh axis, offsets cannot
     lens: Optional[jnp.ndarray] = None
+    # nested columns: LIST holds one child (the flattened values, addressed
+    # by ``offsets``); STRUCT holds one child per field (cudf
+    # lists/structs_column_view analogue)
+    children: tuple = ()
 
     # -- construction -----------------------------------------------------
 
@@ -264,6 +294,42 @@ class Column:
                       jnp.asarray(offsets), jnp.asarray(chars))
 
     @staticmethod
+    def list_of(values: Sequence, child_dtype: DType) -> "Column":
+        """Build a LIST column from Python sequences (None => null row).
+
+        ``child_dtype`` may itself be nested; children build recursively.
+        """
+        valid = [v is not None for v in values]
+        lens = np.fromiter((len(v) if v is not None else 0 for v in values),
+                           dtype=np.int32, count=len(values))
+        offsets = np.zeros(len(values) + 1, np.int32)
+        np.cumsum(lens, out=offsets[1:])
+        flat = [x for v in values if v is not None for x in v]
+        child = _column_from_python(flat, child_dtype)
+        validity = None if all(valid) \
+            else pack_bools(jnp.asarray(np.array(valid, bool)))
+        return Column(list_(child_dtype), jnp.zeros((0,), jnp.uint8),
+                      validity, jnp.asarray(offsets), children=(child,))
+
+    @staticmethod
+    def struct_of(fields: Sequence["Column"],
+                  valid: Optional[np.ndarray] = None) -> "Column":
+        """Build a STRUCT column from equal-length field columns."""
+        fields = tuple(fields)
+        if not fields:
+            raise ValueError("struct needs at least one field")
+        n = fields[0].num_rows
+        for f in fields:
+            if f.num_rows != n:
+                raise ValueError("struct fields must have equal row counts")
+        validity = None
+        if valid is not None:
+            validity = pack_bools(jnp.asarray(np.asarray(valid, bool)))
+        return Column(struct_(*(f.dtype for f in fields)),
+                      jnp.zeros((0,), jnp.uint8), validity,
+                      children=fields)
+
+    @staticmethod
     def strings_padded(values: Sequence[Optional[str]],
                        pad_to: Optional[int] = None) -> "Column":
         """Build a dense-padded string column (device-native layout)."""
@@ -283,6 +349,11 @@ class Column:
             if self.chars2d is not None:
                 return self.chars2d.shape[0]
             return self.offsets.shape[0] - 1
+        if self.dtype.is_list:
+            return self.offsets.shape[0] - 1
+        if self.dtype.is_struct:
+            return self.children[0].num_rows if self.children \
+                else self.data.shape[0]
         return self.data.shape[0]
 
     @property
@@ -375,6 +446,15 @@ class Column:
     def to_pylist(self):
         n = self.num_rows
         valid = np.asarray(self.valid_bools())
+        if self.dtype.is_list:
+            offs = np.asarray(self.offsets)
+            child = self.children[0].to_pylist()
+            return [child[offs[i]:offs[i + 1]] if valid[i] else None
+                    for i in range(n)]
+        if self.dtype.is_struct:
+            fields = [c.to_pylist() for c in self.children]
+            return [tuple(f[i] for f in fields) if valid[i] else None
+                    for i in range(n)]
         if self.dtype.is_string:
             if self.is_padded:
                 mat = np.asarray(self.chars2d)
@@ -397,12 +477,35 @@ class Column:
 
     def tree_flatten(self):
         children = (self.data, self.validity, self.offsets, self.chars,
-                    self.chars2d, self.lens)
+                    self.chars2d, self.lens, self.children)
         return children, self.dtype
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         return cls(aux, *children)
+
+
+def _column_from_python(values, dtype: DType) -> "Column":
+    """Recursive Python-value constructor shared by the nested builders."""
+    if dtype.is_list:
+        return Column.list_of(values, dtype.children[0])
+    if dtype.is_struct:
+        fields = []
+        for fi, fdt in enumerate(dtype.children):
+            fields.append(_column_from_python(
+                [None if v is None else v[fi] for v in values], fdt))
+        valid = None
+        if any(v is None for v in values):
+            valid = np.array([v is not None for v in values], bool)
+        return Column.struct_of(fields, valid)
+    if dtype.is_string:
+        return Column.strings(values)
+    vals = np.asarray([0 if v is None else v for v in values],
+                      dtype=dtype.np_dtype)
+    valid = None
+    if any(v is None for v in values):
+        valid = np.array([v is not None for v in values], bool)
+    return Column.from_numpy(vals, dtype, valid)
 
 
 def _padded_width(max_len: int, pad_to: Optional[int]) -> int:
@@ -466,6 +569,18 @@ def slice_table(table: Table, start: int, end: int) -> Table:
         if c.validity is not None:
             validity = pack_bools(
                 unpack_bools(c.validity, c.num_rows)[start:end])
+        if c.dtype.is_list:
+            # child stays whole; sliced offsets address into it (consumers
+            # rebase against offsets[0], like string slices)
+            cols.append(Column(c.dtype, c.data, validity,
+                               c.offsets[start:end + 1],
+                               children=c.children))
+            continue
+        if c.dtype.is_struct:
+            sub = slice_table(Table(c.children), start, end)
+            cols.append(Column(c.dtype, c.data, validity,
+                               children=tuple(sub.columns)))
+            continue
         if c.dtype.is_string:
             cols.append(Column(c.dtype, c.data, validity,
                                c.offsets[start:end + 1]

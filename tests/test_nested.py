@@ -1,0 +1,86 @@
+"""Nested (list/struct) column model tests — the cudf nested-column
+analogue (L1 completeness; the ParquetFooter schema DSL selects into these
+shapes, reference ParquetFooter.java:62-93).  JCUDF rows reject nested
+types exactly as the reference's conversion layer does."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import (
+    Column, INT32, INT64, STRING, Table, list_, struct_,
+)
+from spark_rapids_jni_tpu.ops import compute_row_layout
+from spark_rapids_jni_tpu.table import slice_table
+
+
+def test_list_column_roundtrip():
+    vals = [[1, 2, 3], [], None, [42]]
+    col = Column.list_of(vals, INT32)
+    assert col.dtype.is_list and col.dtype.children == (INT32,)
+    assert col.num_rows == 4
+    assert col.to_pylist() == [[1, 2, 3], [], None, [42]]
+
+
+def test_list_of_strings():
+    vals = [["a", "bb"], None, ["c"]]
+    col = Column.list_of(vals, STRING)
+    assert col.to_pylist() == [["a", "bb"], None, ["c"]]
+
+
+def test_nested_list_of_list():
+    vals = [[[1], [2, 3]], [], [[4, 5, 6]]]
+    col = Column.list_of(vals, list_(INT32))
+    assert col.to_pylist() == [[[1], [2, 3]], [], [[4, 5, 6]]]
+
+
+def test_struct_column():
+    a = Column.from_numpy(np.array([1, 2, 3], np.int32), INT32)
+    b = Column.strings(["x", "y", None])
+    col = Column.struct_of([a, b], valid=np.array([True, False, True]))
+    assert col.dtype.is_struct
+    assert col.to_pylist() == [(1, "x"), None, (3, None)]
+
+
+def test_struct_of_list():
+    inner = Column.list_of([[1], [2, 3], []], INT64)
+    other = Column.from_numpy(np.arange(3, dtype=np.int32), INT32)
+    col = Column.struct_of([inner, other])
+    assert col.to_pylist() == [([1], 0), ([2, 3], 1), ([], 2)]
+
+
+def test_struct_field_length_mismatch():
+    a = Column.from_numpy(np.arange(3, dtype=np.int32), INT32)
+    b = Column.from_numpy(np.arange(4, dtype=np.int32), INT32)
+    with pytest.raises(ValueError, match="equal row counts"):
+        Column.struct_of([a, b])
+
+
+def test_nested_columns_are_pytrees():
+    import jax
+    col = Column.list_of([[1, 2], [3]], INT32)
+    leaves = jax.tree_util.tree_leaves(col)
+    assert any(getattr(l, "shape", None) == (3,) for l in leaves)
+    rebuilt = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(col), leaves)
+    assert rebuilt.to_pylist() == [[1, 2], [3]]
+
+
+def test_slice_table_nested():
+    lst = Column.list_of([[1], [2, 3], [4], []], INT32)
+    st = Column.struct_of(
+        [Column.from_numpy(np.arange(4, dtype=np.int32), INT32)])
+    t = slice_table(Table((lst, st)), 1, 3)
+    # sliced list offsets stay absolute into the shared child (same
+    # contract as string slices); consumers rebase as needed
+    offs = np.asarray(t.columns[0].offsets)
+    child = t.columns[0].children[0].to_pylist()
+    got = [child[offs[i]:offs[i + 1]] for i in range(2)]
+    assert got == [[2, 3], [4]]
+    assert t.columns[1].to_pylist() == [(1,), (2,)]
+
+
+def test_jcudf_rows_reject_nested():
+    with pytest.raises(ValueError, match="nested"):
+        compute_row_layout([INT32, list_(INT32)])
+    with pytest.raises(ValueError, match="nested"):
+        compute_row_layout([struct_(INT32)])
