@@ -229,14 +229,43 @@ def _run_axis(axis: str):
     print("AXIS_RESULT " + json.dumps(res), flush=True)
 
 
+import jax.numpy as jnp
+
+
+@jax.jit
+def _tables_equal_jit(a, b):
+    """Device-side table equivalence -> one boolean scalar (pulling whole
+    tables over the axon tunnel runs at ~27MB/s; a scalar is free)."""
+    ok = jnp.bool_(True)
+    for ca, cb in zip(a.columns, b.columns):
+        va = ca.valid_bools()
+        vb = cb.valid_bools()
+        ok = ok & jnp.all(va == vb)
+        if ca.dtype.is_string:
+            la, lb = ca.str_lens(), cb.str_lens()
+            ok = ok & jnp.all(jnp.where(va, la, 0) == jnp.where(vb, lb, 0))
+            wa = ca.chars_window(max(ca.chars2d.shape[1]
+                                     if ca.is_padded else 0,
+                                     cb.chars2d.shape[1]
+                                     if cb.is_padded else 0))
+            wb = cb.chars_window(wa.shape[1])
+            m = va[:, None]
+            ok = ok & jnp.all(jnp.where(m, wa, 0) == jnp.where(m, wb, 0))
+        else:
+            da, db = ca.data, cb.data
+            m = va[:, None] if da.ndim == 2 else va
+            ok = ok & jnp.all(jnp.where(m, da, 0) == jnp.where(m, db, 0))
+    return ok
+
+
 def _verify_fixed(num_rows, num_cols=212):
     """At-scale on-device correctness: multi-batch roundtrip at the full
     benchmark axis, byte-compared per batch against the gather oracle and
     value-compared against the generated table (the reference's
     Big/Bigger/Biggest + AllTypes tests at 1M-5M rows,
-    ``tests/row_conversion.cpp:332-437``)."""
-    from spark_rapids_jni_tpu.table import (
-        assert_tables_equivalent, slice_table)
+    ``tests/row_conversion.cpp:332-437``).  All comparisons reduce on
+    device; only scalars cross the tunnel."""
+    from spark_rapids_jni_tpu.table import slice_table
     from spark_rapids_jni_tpu.ops.row_conversion import (
         _oracle_to_rows_jit, compute_row_layout)
     dtypes = cycle_dtypes(FIXED_DTYPES, num_cols)
@@ -246,28 +275,32 @@ def _verify_fixed(num_rows, num_cols=212):
     _log(f"verify fixed:{num_rows}: table ready")
     batches = convert_to_rows(table, size_limit=1 << 29)
     start = 0
+    eq_bytes = jax.jit(lambda a, b: jnp.all(a.reshape(-1) == b.reshape(-1)))
     for bi, b in enumerate(batches):
         n = b.num_rows
         sub = slice_table(table, start, start + n)
-        # byte-exact vs the independent gather oracle
+        # byte-exact vs the independent gather oracle (device compare)
         oracle = _oracle_to_rows_jit(sub, layout)
-        got = np.asarray(b.data).reshape(n, layout.fixed_row_size)
-        np.testing.assert_array_equal(got, np.asarray(oracle),
-                                      err_msg=f"batch {bi} bytes")
-        # decode roundtrip
-        assert_tables_equivalent(sub, convert_from_rows(b, dtypes))
+        assert bool(eq_bytes(b.data, oracle)), f"batch {bi} bytes differ"
+        # decode roundtrip, device compare
+        got = convert_from_rows(b, dtypes)
+        assert bool(_tables_equal_jit(sub, got)), \
+            f"batch {bi} roundtrip mismatch"
         start += n
         _log(f"verify fixed:{num_rows}: batch {bi} ({n} rows) OK")
     assert start == num_rows
     print(f"VERIFY_OK fixed:{num_rows} batches={len(batches)}", flush=True)
 
 
-def _verify_variable(num_rows, num_cols=155):
-    """1M-row string-table verification: device roundtrip equivalence plus
-    a byte-exact cross-check of the padded blob through the native C++
-    decoder (the 'ManyStrings' analogue, ``tests/row_conversion.cpp:937``)."""
+def _verify_variable(num_rows, num_cols=155, native_rows=50_000):
+    """1M-row string-table verification: device roundtrip equivalence per
+    batch (scalar pulls only), plus a byte-exact cross-check of the first
+    ``native_rows`` rows of the padded blob through the native C++ decoder
+    (the 'ManyStrings' analogue, ``tests/row_conversion.cpp:937``; bounded
+    because host pulls ride a ~27MB/s tunnel)."""
     from spark_rapids_jni_tpu.ops.native_rows import (
         decode_variable_native, native_available)
+    from spark_rapids_jni_tpu.table import slice_table
     base = cycle_dtypes(FIXED_DTYPES, num_cols - 25)
     dtypes = base + [STRING] * 25
     profile = DataProfile(string_len_min=0, string_len_max=32)
@@ -280,34 +313,22 @@ def _verify_variable(num_rows, num_cols=155):
     for bi, b in enumerate(batches):
         n = b.num_rows
         got = convert_from_rows(b, dtypes)
-        # value comparison against the source slice (host, vectorized)
-        for i in sidx[:3] + list(range(0, num_cols - 25, 40)):
-            src = table.columns[i]
-            dst = got.columns[i]
-            if src.dtype.is_string:
-                np.testing.assert_array_equal(
-                    np.asarray(src.chars2d)[start:start + n],
-                    np.asarray(dst.chars2d)[:, :src.chars2d.shape[1]],
-                    err_msg=f"batch {bi} string col {i}")
-            else:
-                sv = np.asarray(src.data)[start:start + n]
-                dv = np.asarray(dst.data)
-                valid = np.asarray(src.valid_bools())[start:start + n]
-                m = valid[:, None] if sv.ndim == 2 else valid
-                np.testing.assert_array_equal(
-                    np.where(m, sv, 0), np.where(m, dv, 0),
-                    err_msg=f"batch {bi} col {i}")
+        sub = slice_table(table, start, start + n)
+        assert bool(_tables_equal_jit(sub, got)), \
+            f"batch {bi} roundtrip mismatch"
         if bi == 0 and native_available():
-            # native C++ decoder cross-check on the first batch
+            # native C++ decoder cross-check on a bounded row range
+            k = min(native_rows, n)
+            rs = b.row_size
+            blob = np.asarray(b.data[:k * rs])
+            offs = (np.arange(k + 1, dtype=np.int64) * rs)
             cols, valid, soffs, chars = decode_variable_native(
-                np.asarray(b.data), np.asarray(b.offsets).astype(np.int64),
-                dtypes)
-            exp = table.columns[sidx[0]].to_arrow()
-            eoffs = np.asarray(exp.offsets)[start:start + n + 1]
-            np.testing.assert_array_equal(soffs[0], eoffs - eoffs[0])
-            np.testing.assert_array_equal(
-                chars[0], np.asarray(exp.chars)[eoffs[0]:eoffs[-1]])
-            _log(f"verify variable:{num_rows}: native cross-check OK")
+                blob, offs, dtypes)
+            exp = slice_table(table, 0, k).columns[sidx[0]].to_arrow()
+            np.testing.assert_array_equal(soffs[0], np.asarray(exp.offsets))
+            np.testing.assert_array_equal(chars[0], np.asarray(exp.chars))
+            _log(f"verify variable:{num_rows}: native cross-check OK "
+                 f"({k} rows)")
         start += n
         _log(f"verify variable:{num_rows}: batch {bi} ({n} rows) OK")
     print(f"VERIFY_OK variable:{num_rows} batches={len(batches)}",
