@@ -1,0 +1,130 @@
+"""Encode v3 experiment: split dot — 64-bit pairs contract directly from
+their [n8, n, 2] stack (no plane transpose), everything else through a
+reduced pack kernel."""
+import time, functools, gc, glob, gzip, json
+import numpy as np, jax, jax.numpy as jnp
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+from jax.experimental import pallas as pl
+from spark_rapids_jni_tpu import *
+from spark_rapids_jni_tpu.ops.row_conversion import (
+    compute_row_layout, _oracle_to_rows_jit)
+from spark_rapids_jni_tpu.ops import row_mxu
+from spark_rapids_jni_tpu.ops.row_mxu import (
+    _forward_plan, _pack_kernel, _validity_quads, _col_words_pair,
+    _PACK_TILE)
+from spark_rapids_jni_tpu.table import slice_table
+from spark_rapids_jni_tpu.utils import create_random_table, cycle_dtypes
+
+N = 1_000_000
+dtypes = cycle_dtypes([INT64, FLOAT64, INT32, FLOAT32, INT16, INT8, BOOL8], 212)
+layout = compute_row_layout(dtypes)
+rs = layout.fixed_row_size
+table = create_random_table(dtypes, N, seed=42)
+jax.block_until_ready(table)
+
+def sync(x):
+    np.asarray(jax.tree_util.tree_leaves(x)[-1].reshape(-1)[:1])
+
+plan, pfull = _forward_plan(layout)
+pfull = np.array(pfull)
+n8cols = [i for i, sz in enumerate(layout.col_sizes) if sz == 8]
+n8 = len(n8cols)
+p_small_np = pfull[2 * n8:].copy()          # drop the 8-byte plane rows
+p8_np = np.zeros((n8, 8, rs), np.int8)
+for k, i in enumerate(n8cols):
+    s = layout.col_starts[i]
+    for b in range(8):
+        p8_np[k, b, s + b] = 1
+p_small_d = jnp.asarray(p_small_np)
+p8_d = jnp.asarray(p8_np)
+W_small = p_small_np.shape[0]
+
+
+def _pack_small(table, layout):
+    """Pack kernel over 4/2/1-byte + validity only (no 8-byte input)."""
+    n = table.num_rows
+    cols = [c for c in table.columns if c.dtype.itemsize != 8]
+    by_size = {4: [], 2: [], 1: []}
+    for c in cols:
+        by_size[c.dtype.itemsize].append(c)
+    n4, n2, n1 = len(by_size[4]), len(by_size[2]), len(by_size[1])
+    ncols = layout.num_columns
+    nvw = (ncols + 3) // 4
+
+    ins, in_specs = [], []
+    vq = _validity_quads(table, layout)
+    ins.append(vq)
+    in_specs.append(pl.BlockSpec((nvw, _PACK_TILE), lambda r: (0, r)))
+    for c in by_size[4]:
+        d = c.data
+        ins.append(d if d.dtype == jnp.uint32
+                   else jax.lax.bitcast_convert_type(d, jnp.uint32))
+    for c in by_size[2]:
+        ins.append(jax.lax.bitcast_convert_type(c.data, jnp.uint16))
+    for c in by_size[1]:
+        d = c.data
+        ins.append(d.astype(jnp.uint8) if d.dtype == jnp.bool_ else
+                   (d if d.dtype == jnp.uint8
+                    else jax.lax.bitcast_convert_type(d, jnp.uint8)))
+    in_specs += [pl.BlockSpec((_PACK_TILE,), lambda r: (r,))
+                 for _ in range(n4 + n2 + n1)]
+    grid = ((n + _PACK_TILE - 1) // _PACK_TILE,)
+    return pl.pallas_call(
+        functools.partial(_pack_kernel, (0, n4, n2, n1)),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((W_small, _PACK_TILE), lambda r: (0, r)),
+        out_shape=jax.ShapeDtypeStruct((W_small, n), jnp.uint32))(*ins)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def encode_split(table, layout):
+    xt = _pack_small(table, layout)
+    xb = jax.lax.bitcast_convert_type(xt, jnp.uint8)
+    rows_small = jax.lax.dot_general(
+        xb.astype(jnp.int8), p_small_d,
+        dimension_numbers=(((0, 2), (0, 1)), ((), ())),
+        preferred_element_type=jnp.int8)
+    a8 = jnp.stack([_col_words_pair(table.columns[i]) for i in n8cols])
+    a8b = jax.lax.bitcast_convert_type(a8, jnp.uint8).reshape(n8, -1, 8)
+    rows8 = jax.lax.dot_general(
+        a8b.astype(jnp.int8), p8_d,
+        dimension_numbers=(((0, 2), (0, 1)), ((), ())),
+        preferred_element_type=jnp.int8)
+    return jax.lax.bitcast_convert_type(rows_small + rows8,
+                                        jnp.uint8).reshape(-1)
+
+
+def bench(f, label, iters=4):
+    out = f(); sync(out)
+    t0 = time.perf_counter()
+    for _ in range(4): sync(out)
+    rt = (time.perf_counter() - t0) / 4
+    del out; gc.collect()
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter(); o = f(); sync(o); del o; gc.collect()
+        ts.append(time.perf_counter() - t0)
+    print(f"{label}: {max(float(np.median(ts))-rt,1e-9)*1e3:.1f} ms",
+          flush=True)
+
+
+sub = slice_table(table, 0, 10_048)
+got = np.asarray(encode_split(sub, layout)).reshape(-1, rs)
+exp = np.asarray(_oracle_to_rows_jit(sub, layout))
+np.testing.assert_array_equal(got, exp)
+print("split-dot encode matches oracle", flush=True)
+
+bench(lambda: row_mxu.to_rows_fixed(table, layout), "encode current")
+bench(lambda: encode_split(table, layout), "encode split-dot")
+
+with jax.profiler.trace("/tmp/jxtrace_split"):
+    o = encode_split(table, layout); sync(o); del o
+files = sorted(glob.glob("/tmp/jxtrace_split/**/*.trace.json.gz",
+                         recursive=True))
+with gzip.open(files[-1]) as f:
+    tr = json.load(f)
+tot = sum(e["dur"] for e in tr["traceEvents"]
+          if e.get("ph") == "X" and "encode_split" in e.get("name", ""))
+print(f"split-dot device time: {tot/1000:.1f} ms", flush=True)

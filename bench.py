@@ -149,19 +149,43 @@ def bench_fixed(num_rows, num_cols=212, use_pallas=None):
 
     t_to = _time(lambda: convert_to_rows(table, use_pallas=use_pallas),
                  label=f"to_rows[{num_rows}]", sync_each=big)
-    # oracle is a full-table single-shot gather: unbatched by design, so
-    # it is only run on axes where the whole gather fits HBM
     t_oracle = None
     if not big:
         t_oracle = _time(
             lambda: convert_to_rows_fixed_width_optimized(table),
             label=f"oracle_to_rows[{num_rows}]")
+    else:
+        # large axes run the oracle per equal-sized batch with a traced
+        # start (single-shot would exceed HBM), so the dual-path
+        # cross-check covers the largest axis too
+        from spark_rapids_jni_tpu.ops.row_conversion import (
+            _oracle_to_rows_batch_jit)
+        per = 1 << 20
+
+        def oracle_batched():
+            return [_oracle_to_rows_batch_jit(table, layout, s,
+                                              min(per, num_rows - s))
+                    for s in range(0, num_rows, per)]
+        t_oracle = _time(oracle_batched,
+                         label=f"oracle_to_rows[{num_rows}]",
+                         sync_each=True)
     batches = convert_to_rows(table, use_pallas=use_pallas)
+    moved = _table_bytes(table) + out_bytes  # read + write per direction
+    # decode phases only need the blobs: free the source table so the 4M
+    # axis (table + batches + decode transients) stays inside HBM
+    del table
     t_from = _time(lambda: [convert_from_rows(b, dtypes,
                                               use_pallas=use_pallas)
                             for b in batches],
                    label=f"from_rows[{num_rows}]", sync_each=big)
-    moved = _table_bytes(table) + out_bytes  # read + write per direction
+    # grouped (dtype-major) decode: the wide-output fast path consumers
+    # use when they touch a handful of columns, reported alongside the
+    # per-column-materializing standard decode
+    from spark_rapids_jni_tpu.ops import row_mxu
+    t_from_g = _time(
+        lambda: [row_mxu.from_rows_fixed_grouped(b.data, layout)
+                 for b in batches],
+        label=f"from_rows_grouped[{num_rows}]", sync_each=big)
     res = {
         "num_rows": num_rows,
         "num_cols": num_cols,
@@ -170,6 +194,8 @@ def bench_fixed(num_rows, num_cols=212, use_pallas=None):
         "to_rows_GBps": moved / t_to / 1e9,
         "from_rows_s": t_from,
         "from_rows_GBps": moved / t_from / 1e9,
+        "from_rows_grouped_s": t_from_g,
+        "from_rows_grouped_GBps": moved / t_from_g / 1e9,
     }
     if t_oracle is not None:
         res["oracle_to_rows_s"] = t_oracle
@@ -244,6 +270,11 @@ def _tables_equal_jit(a, b):
         if ca.dtype.is_string:
             la, lb = ca.str_lens(), cb.str_lens()
             ok = ok & jnp.all(jnp.where(va, la, 0) == jnp.where(vb, lb, 0))
+            if not (ca.is_padded or cb.is_padded):
+                # a zero-width window would compare no bytes at all —
+                # refuse rather than report a vacuous VERIFY_OK
+                raise ValueError("_tables_equal_jit needs at least one "
+                                 "dense-padded string column per pair")
             wa = ca.chars_window(max(ca.chars2d.shape[1]
                                      if ca.is_padded else 0,
                                      cb.chars2d.shape[1]

@@ -22,6 +22,7 @@ The schema-selection DSL mirrors the reference's builders
 from __future__ import annotations
 
 import ctypes
+import os
 import struct as _struct
 from typing import List, Optional, Sequence, Tuple
 
@@ -121,6 +122,46 @@ def flatten_schema(schema: StructElement,
 # Footer handle
 # ---------------------------------------------------------------------------
 
+class _HandleDebug:
+    """Native-handle leak tracker (the ``ai.rapids.refcount.debug``
+    analogue, reference ``pom.xml:87,489``): with ``SRJ_HANDLE_DEBUG=1``
+    every open footer handle records its creation stack, and leaked
+    (never-closed) handles are reported at interpreter exit."""
+
+    def __init__(self):
+        import atexit
+        self.enabled = os.environ.get("SRJ_HANDLE_DEBUG", "0") == "1"
+        self.live = {}
+        if self.enabled:
+            atexit.register(self.report)
+
+    def opened(self, obj) -> None:
+        if self.enabled:
+            import traceback
+            self.live[id(obj)] = "".join(traceback.format_stack(limit=8))
+
+    def closed(self, obj) -> None:
+        if self.enabled:
+            self.live.pop(id(obj), None)
+
+    def report(self) -> None:
+        if self.live:
+            import sys
+            print(f"[srj] {len(self.live)} leaked ParquetFooter "
+                  "handle(s); creation stacks:", file=sys.stderr)
+            for tb in self.live.values():
+                print(tb, file=sys.stderr)
+
+
+_handle_debug = _HandleDebug()
+
+
+def live_handle_count() -> int:
+    """Open (unclosed) footer handles being tracked (0 unless
+    SRJ_HANDLE_DEBUG=1)."""
+    return len(_handle_debug.live)
+
+
 class ParquetFooter:
     """A parsed + filtered footer (reference ``ParquetFooter`` handle class).
 
@@ -131,6 +172,8 @@ class ParquetFooter:
     def __init__(self, native_handle: Optional[int], py_impl: Optional[PyFooter]):
         self._handle = native_handle
         self._py = py_impl
+        if native_handle is not None:
+            _handle_debug.opened(self)
 
     @property
     def engine(self) -> str:
@@ -166,6 +209,7 @@ class ParquetFooter:
         if self._handle is not None:
             _native.load().srj_footer_close(self._handle)
             self._handle = None
+            _handle_debug.closed(self)
         self._py = None
 
     def __enter__(self) -> "ParquetFooter":

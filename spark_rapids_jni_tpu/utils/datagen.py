@@ -50,6 +50,10 @@ class DataProfile:
     # "padded" (device-native dense [n, W] chars, zero host syncs) or
     # "arrow" (ragged offsets+chars, one host sync for the total sizes)
     string_layout: str = "padded"
+    # nested columns (reference generate_input.hpp:120-190 list params)
+    list_len_min: int = 0
+    list_len_max: int = 4
+    null_probability_nested: Optional[float] = 0.01
     seed: int = 0
 
 
@@ -132,11 +136,16 @@ def _gen_fixed(key, dt: DType, shape, profile: DataProfile) -> jnp.ndarray:
     if np_dt.itemsize == 8 and wide:
         return jax.random.bits(key, (*shape, 2), dtype=jnp.uint32)
     if profile.distribution == "geometric":
-        # geometric via transformed normal (reference builds geometric from
-        # a scaled normal, random_distribution_factory.cuh:86-110)
+        # exact geometric via inverse CDF: X = floor(ln(U)/ln(1-p)); p set
+        # so the mean sits at ~1/4 of the dtype range, the same shape the
+        # reference's scaled-normal approximation targets
+        # (random_distribution_factory.cuh:86-110)
         _, hi = _int_bounds(dt, profile)
-        mag = jnp.abs(jax.random.normal(key, shape)) * max(1, hi // 4)
-        return jnp.clip(mag, 0, hi).astype(np_dt)
+        span = max(2, min(hi, 1 << 30))
+        p = min(0.5, 4.0 / span)
+        u = jax.random.uniform(key, shape, minval=1e-7, maxval=1.0)
+        x = jnp.floor(jnp.log(u) / np.log1p(-p))
+        return jnp.clip(x, 0, hi).astype(np_dt)
     # uniform over the full dtype range via raw random bits
     bits = jax.random.bits(key, shape,
                            dtype=jnp.dtype(f"uint{np_dt.itemsize * 8}"))
@@ -244,6 +253,8 @@ def create_random_table(dtypes: Sequence[DType], num_rows: int,
     profile = profile or default_profile()
     dtypes = tuple(dtypes)
     key = jax.random.PRNGKey(profile.seed if seed is None else seed)
+    if any(getattr(dt, "is_nested", False) for dt in dtypes):
+        return _create_random_table_nested(dtypes, num_rows, profile, key)
     datas, validities, str_lens, str_mats = _gen_table_jit(
         key, dtypes, num_rows, profile)
     char_slices = []
@@ -275,4 +286,59 @@ def create_random_table(dtypes: Sequence[DType], num_rows: int,
             si += 1
         else:
             cols.append(Column(dt, datas[i], validities[i]))
+    return Table(tuple(cols))
+
+
+def _gen_one_column(key, dt: DType, num_rows: int,
+                    profile: DataProfile) -> Column:
+    """Recursive single-column generator covering nested types (reference
+    ``generate_input.hpp`` list/struct nesting params ``:120-190``).
+
+    Nested generation runs column-at-a-time (no cross-column fusion): the
+    benchmark hot path is flat tables via ``_gen_table_jit``; nested
+    tables feed the data-model/footer tests."""
+    from spark_rapids_jni_tpu.table import list_, struct_  # noqa: F401
+    knull, kdata = jax.random.split(key)
+    validity = None
+    if profile.null_probability_nested is not None:
+        valid = jax.random.bernoulli(
+            knull, 1.0 - profile.null_probability_nested, (num_rows,))
+        validity = pack_bools(valid)
+    if dt.is_list:
+        lens = jax.random.randint(
+            jax.random.fold_in(kdata, 1), (num_rows,),
+            profile.list_len_min, profile.list_len_max + 1,
+            dtype=jnp.int32)
+        offsets_dev = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(lens)])
+        total = int(np.asarray(offsets_dev)[-1])  # host sync (ragged size)
+        child = _gen_one_column(jax.random.fold_in(kdata, 2),
+                                dt.children[0], total, profile)
+        return Column(dt, jnp.zeros((0,), jnp.uint8), validity,
+                      offsets_dev, children=(child,))
+    if dt.is_struct:
+        fields = tuple(
+            _gen_one_column(jax.random.fold_in(kdata, 10 + i), fdt,
+                            num_rows, profile)
+            for i, fdt in enumerate(dt.children))
+        return Column(dt, jnp.zeros((0,), jnp.uint8), validity,
+                      children=fields)
+    if dt.is_string:
+        sub = create_random_table([dt], num_rows, profile,
+                                  seed=int(jax.random.randint(
+                                      kdata, (), 0, 1 << 30)))
+        c = sub.columns[0]
+        return Column(dt, c.data, validity, c.offsets, c.chars, c.chars2d,
+                      c.lens)
+    data = _gen_fixed(kdata, dt, num_rows, profile)
+    return Column(dt, data, validity)
+
+
+def _create_random_table_nested(dtypes, num_rows: int,
+                                profile: DataProfile, key) -> Table:
+    cols = [
+        _gen_one_column(jax.random.fold_in(key, 1000 + i), dt, num_rows,
+                        profile)
+        for i, dt in enumerate(dtypes)
+    ]
     return Table(tuple(cols))

@@ -153,3 +153,34 @@ def test_one_sided_bounds_extreme_dtypes():
         assert v.min() >= 2**31 - 16
     finally:
         jax.config.update("jax_enable_x64", prev)
+
+
+def test_geometric_distribution_shape():
+    t = create_random_table([INT32], 20_000,
+                            DataProfile(distribution="geometric"), seed=9)
+    v = np.asarray(t.columns[0].data).astype(np.float64)
+    assert v.min() >= 0
+    # geometric/exponential shape: median = ln2 * mean, long right tail
+    assert 0.6 < np.median(v) / v.mean() < 0.8
+    assert v.max() > 3 * v.mean()
+
+
+def test_nested_datagen_roundtrip():
+    from spark_rapids_jni_tpu import list_, struct_, INT64
+    dtypes = [list_(INT32), struct_(INT32, STRING), INT64]
+    t = create_random_table(dtypes, 200, seed=5)
+    assert t.num_rows == 200
+    col = t.columns[0]
+    offs = np.asarray(col.offsets)
+    # offsets cover every generated child element; null rows still occupy
+    # their generated extent (their values are simply masked out)
+    assert offs[-1] == int(np.asarray(col.children[0].num_rows))
+    assert (np.diff(offs) >= 0).all()
+    vals = col.to_pylist()
+    assert all(v is None or isinstance(v, list) for v in vals)
+    sv = t.columns[1].to_pylist()
+    assert all(v is None or (isinstance(v, tuple) and len(v) == 2)
+               for v in sv)
+    # deterministic by seed
+    t2 = create_random_table(dtypes, 200, seed=5)
+    assert t.columns[1].to_pylist() == t2.columns[1].to_pylist()

@@ -440,3 +440,21 @@ class TestCrossImpl:
         assert fn.serialize_thrift_file() == fp.serialize_thrift_file()
         fn.close()
         fp.close()
+
+
+def test_handle_debug_tracks_leaks(monkeypatch):
+    """SRJ_HANDLE_DEBUG tracks open native handles (the refcount-debug
+    analogue, reference pom.xml:87,489); close() clears the record."""
+    import pytest
+    from spark_rapids_jni_tpu import parquet as pq
+    from spark_rapids_jni_tpu.parquet import native as _native
+    if _native.load() is None:
+        pytest.skip("native engine unavailable")
+    monkeypatch.setattr(pq._handle_debug, "enabled", True)
+    raw = write_struct(flat_footer(["a", "b"]))
+    before = pq.live_handle_count()
+    footer = read_and_filter(raw, 0, 1 << 40, select("a"), engine="native")
+    assert footer.engine == "native"
+    assert pq.live_handle_count() == before + 1
+    footer.close()
+    assert pq.live_handle_count() == before
