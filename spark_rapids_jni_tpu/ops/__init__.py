@@ -10,3 +10,14 @@ from spark_rapids_jni_tpu.ops.row_conversion import (  # noqa: F401
     convert_to_rows_fixed_width_optimized,
     convert_from_rows_fixed_width_optimized,
 )
+from spark_rapids_jni_tpu.ops.hashing import (  # noqa: F401
+    hash_partition_ids, murmur3_hash, xxhash64,
+)
+from spark_rapids_jni_tpu.ops.zorder import (  # noqa: F401
+    interleave_bits, zorder_sort_indices,
+)
+from spark_rapids_jni_tpu.ops.decimal import (  # noqa: F401
+    add_decimal128, decimal128, decimal128_from_ints, decimal128_to_ints,
+    mul_decimal128, sub_decimal128,
+)
+from spark_rapids_jni_tpu.ops import membership  # noqa: F401

@@ -214,6 +214,8 @@ def murmur3_hash(table_or_cols, seed: int = DEFAULT_SEED,
     cols = (table_or_cols.columns if isinstance(table_or_cols, Table)
             else tuple(table_or_cols))
     n = cols[0].num_rows
+    from spark_rapids_jni_tpu.utils import metrics
+    metrics.op("murmur3_hash", rows=n)
     W = _resolve_str_window(cols, max_str_len) \
         if any(c.dtype.is_string for c in cols) else 0
     h = jnp.full((n,), seed, dtype=jnp.uint32)

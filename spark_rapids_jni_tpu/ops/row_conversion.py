@@ -46,6 +46,7 @@ from spark_rapids_jni_tpu.ops.row_layout import (
     JCUDF_ROW_ALIGNMENT, MAX_BATCH_BYTES, RowLayout, compute_row_layout,
 )
 from spark_rapids_jni_tpu.utils.tracing import func_range
+from spark_rapids_jni_tpu.utils import metrics
 
 
 # ---------------------------------------------------------------------------
@@ -393,6 +394,7 @@ def convert_to_rows(table: Table, *, size_limit: int = MAX_BATCH_BYTES,
     (static shapes end to end).  Arrow-layout string columns take the
     compact wire-exact path (per-row scatter; slow on TPU, fine on CPU)."""
     layout = compute_row_layout(table.dtypes)
+    metrics.op("convert_to_rows", rows=table.num_rows)
     if layout.has_strings:
         if all(c.is_padded for c in _string_cols(table)):
             return _to_rows_variable_padded(table, layout, size_limit)
@@ -446,6 +448,8 @@ def convert_from_rows(rows: RowsColumn, dtypes: Sequence[DType],
     """Convert one batch of JCUDF rows back to a table (reference
     ``convert_from_rows``, ``row_conversion.cu:2032-2250``)."""
     layout = compute_row_layout(dtypes)
+    metrics.op("convert_from_rows", rows=rows.num_rows,
+               bytes_=rows.data.size)
     if layout.has_strings:
         if rows.is_padded:
             return _from_rows_variable_padded(rows, layout)

@@ -229,6 +229,9 @@ def shuffle_table_sharded(table: Table, key_cols: Sequence[int],
         return rows, valid, num_valid[None], overflow[None]
 
     rows, valid, num_valid, overflow = jax.jit(run)(table)
+    from spark_rapids_jni_tpu.utils import metrics
+    metrics.op("shuffle_table_sharded", rows=table.num_rows,
+               bytes_=table.num_rows * row_size)
     return ShuffleResult(rows, valid, num_valid, overflow, widths)
 
 

@@ -1,0 +1,190 @@
+"""North-star kernel families beyond the snapshot: zorder, decimal128
+arithmetic, membership (bloom) filters — validated against host oracles."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import Column, INT32, UINT16, FLOAT32, Table
+
+
+# -- zorder -----------------------------------------------------------------
+
+def _morton_oracle(keys):
+    """Python bit-interleave oracle: keys = list of uint32 arrays."""
+    k = len(keys)
+    n = len(keys[0])
+    out = []
+    for i in range(n):
+        z = 0
+        for p in range(32 * k):
+            bit = (int(keys[p % k][i]) >> (31 - p // k)) & 1
+            z = (z << 1) | bit
+        out.append(z)
+    return out
+
+
+def test_interleave_bits_matches_oracle(rng):
+    from spark_rapids_jni_tpu.ops.zorder import interleave_bits
+    n = 200
+    a = rng.integers(-2**31, 2**31, n, dtype=np.int32)
+    b = rng.integers(0, 2**16, n, dtype=np.uint16)
+    cols = [Column.from_numpy(a, INT32), Column.from_numpy(b, UINT16)]
+    z = np.asarray(interleave_bits(cols)).astype(np.uint64)
+    got = [(int(z[i, 0]) << 32) | int(z[i, 1]) for i in range(n)]
+    # oracle over the orderable-mapped keys
+    ka = (a.astype(np.int64) ^ (1 << 31)).astype(np.uint32)
+    kb = b.astype(np.uint32)
+    exp = _morton_oracle([ka, kb])
+    assert got == exp
+
+
+def test_zorder_sort_clusters(rng):
+    from spark_rapids_jni_tpu.ops.zorder import zorder_sort_indices
+    n = 512
+    x = rng.integers(0, 1 << 20, n, dtype=np.int32)
+    y = rng.integers(0, 1 << 20, n, dtype=np.int32)
+    cols = [Column.from_numpy(x, INT32), Column.from_numpy(y, INT32)]
+    order = np.asarray(zorder_sort_indices(cols))
+    assert sorted(order.tolist()) == list(range(n))
+    # z-sorted neighbors are closer in (x, y) than random order on average
+    xo, yo = x[order].astype(np.int64), y[order].astype(np.int64)
+    d_sorted = (np.abs(np.diff(xo)) + np.abs(np.diff(yo))).mean()
+    d_orig = (np.abs(np.diff(x.astype(np.int64)))
+              + np.abs(np.diff(y.astype(np.int64)))).mean()
+    assert d_sorted < d_orig * 0.5
+
+
+def test_zorder_float_total_order(rng):
+    from spark_rapids_jni_tpu.ops.zorder import zorder_sort_indices
+    vals = np.array([3.5, -1.25, 0.0, -0.0, 2e9, -7.5], np.float32)
+    order = np.asarray(zorder_sort_indices(
+        [Column.from_numpy(vals, FLOAT32)]))
+    assert np.all(np.diff(vals[order]) >= 0)  # single-key zorder == sort
+
+
+# -- decimal128 -------------------------------------------------------------
+
+def test_decimal128_add_sub_matches_python(rng):
+    from spark_rapids_jni_tpu.ops.decimal import (
+        add_decimal128, sub_decimal128, decimal128_from_ints,
+        decimal128_to_ints)
+    import random
+    r = random.Random(3)
+    a = [r.randrange(-10**37, 10**37) for _ in range(100)]
+    b = [r.randrange(-10**37, 10**37) for _ in range(100)]
+    ca = decimal128_from_ints(a, scale=2)
+    cb = decimal128_from_ints(b, scale=2)
+    out, ovf = add_decimal128(ca, cb)
+    assert not np.asarray(ovf).any()
+    got = decimal128_to_ints(out)
+    assert got == [x + y for x, y in zip(a, b)]
+    out, ovf = sub_decimal128(ca, cb)
+    assert decimal128_to_ints(out) == [x - y for x, y in zip(a, b)]
+
+
+def test_decimal128_add_overflow_flags():
+    from spark_rapids_jni_tpu.ops.decimal import (
+        add_decimal128, decimal128_from_ints, decimal128_to_ints)
+    big = 10 ** 38 - 1
+    ca = decimal128_from_ints([big, -big, 5], scale=0)
+    cb = decimal128_from_ints([1, -1, 7], scale=0)
+    out, ovf = add_decimal128(ca, cb)
+    assert np.asarray(ovf).tolist() == [True, True, False]
+    assert decimal128_to_ints(out) == [None, None, 12]
+
+
+def test_decimal128_mul_matches_python():
+    from spark_rapids_jni_tpu.ops.decimal import (
+        mul_decimal128, decimal128_from_ints, decimal128_to_ints)
+    import random
+    r = random.Random(9)
+    a = [r.randrange(-10**18, 10**18) for _ in range(64)] + [0, -1, 10**19]
+    b = [r.randrange(-10**18, 10**18) for _ in range(64)] + [5, -1, 10**19]
+    ca = decimal128_from_ints(a, scale=1)
+    cb = decimal128_from_ints(b, scale=3)
+    out, ovf = mul_decimal128(ca, cb)
+    assert out.dtype.scale == 4
+    got = decimal128_to_ints(out)
+    for x, y, g, o in zip(a, b, got, np.asarray(ovf)):
+        exact = x * y
+        if abs(exact) > 10 ** 38 - 1:
+            assert o and g is None
+        else:
+            assert not o and g == exact
+
+
+def test_decimal128_mul_overflow_256bit():
+    from spark_rapids_jni_tpu.ops.decimal import (
+        mul_decimal128, decimal128_from_ints)
+    big = 10 ** 37
+    out, ovf = mul_decimal128(decimal128_from_ints([big]),
+                              decimal128_from_ints([big]))
+    assert np.asarray(ovf).tolist() == [True]
+
+
+def test_decimal128_null_propagation():
+    from spark_rapids_jni_tpu.ops.decimal import (
+        add_decimal128, decimal128_from_ints, decimal128_to_ints)
+    ca = decimal128_from_ints([1, 2], valid=[True, False])
+    cb = decimal128_from_ints([10, 20])
+    out, ovf = add_decimal128(ca, cb)
+    assert decimal128_to_ints(out) == [11, None]
+    assert not np.asarray(ovf).any()  # null is not overflow
+
+
+# -- membership (bloom) filter ----------------------------------------------
+
+def test_membership_no_false_negatives(rng):
+    from spark_rapids_jni_tpu.ops import membership
+    build_keys = rng.integers(0, 1 << 30, 500, dtype=np.int32)
+    filt = membership.build([Column.from_numpy(build_keys, INT32)])
+    probe = np.concatenate([build_keys[:100],
+                            rng.integers(1 << 30, 1 << 31, 400,
+                                         dtype=np.int32)])
+    got = np.asarray(membership.might_contain(
+        filt, [Column.from_numpy(probe, INT32)]))
+    assert got[:100].all()                     # never a false negative
+    # essentially no false positives at 32-bit hash collision rates
+    assert got[100:].sum() <= 2
+
+
+def test_membership_string_keys():
+    from spark_rapids_jni_tpu.ops import membership
+    build = Column.strings_padded(["apple", "banana", "cherry"])
+    filt = membership.build([build])
+    probe = Column.strings_padded(["banana", "durian", "apple", ""])
+    got = np.asarray(membership.might_contain(filt, [probe]))
+    assert got.tolist() == [True, False, True, False]
+
+
+def test_membership_capacity_and_nulls(rng):
+    from spark_rapids_jni_tpu.ops import membership
+    keys = np.array([5, 5, 7, 9], np.int32)
+    col = Column.from_numpy(keys, INT32,
+                            valid=np.array([1, 1, 1, 0], bool))
+    filt = membership.build([col], capacity=16)
+    assert bool(np.asarray(filt.has_null))
+    assert int(np.asarray(filt.num_distinct)) == 2  # {5, 7}; null dropped
+    got = np.asarray(membership.might_contain(
+        filt, [Column.from_numpy(np.array([5, 7, 9, 11], np.int32),
+                                 INT32)]))
+    assert got.tolist() == [True, True, False, False]
+
+
+def test_membership_empty_build_side():
+    from spark_rapids_jni_tpu.ops import membership
+    filt = membership.build([Column.from_numpy(np.zeros(0, np.int32),
+                                               INT32)])
+    got = np.asarray(membership.might_contain(
+        filt, [Column.from_numpy(np.array([1, 2], np.int32), INT32)]))
+    assert not got.any()
+
+
+def test_membership_distinct_count_with_leading_nulls():
+    """num_distinct must come from the sorted array, not original-order
+    validity (review regression)."""
+    from spark_rapids_jni_tpu.ops import membership
+    col = Column.from_numpy(np.array([100, 200, 5, 9], np.int32), INT32,
+                            valid=np.array([0, 0, 1, 1], bool))
+    filt = membership.build([col])
+    assert int(np.asarray(filt.num_distinct)) == 2

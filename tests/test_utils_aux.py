@@ -30,3 +30,30 @@ def test_build_info_has_core_keys():
     info = build_info()
     assert "version" in info and "revision" in info
     assert info["version"] == "0.1.0"
+
+
+def test_metrics_registry_counts_operators():
+    import numpy as np
+    from spark_rapids_jni_tpu import Column, Table, INT32
+    from spark_rapids_jni_tpu.ops import convert_to_rows, convert_from_rows
+    from spark_rapids_jni_tpu.ops.hashing import murmur3_hash
+    from spark_rapids_jni_tpu.utils import metrics
+    metrics.reset()
+    metrics.enable()
+    try:
+        t = Table((Column.from_numpy(np.arange(64, dtype=np.int32), INT32),))
+        [rows] = convert_to_rows(t)
+        convert_from_rows(rows, t.dtypes)
+        murmur3_hash(t)
+        snap = metrics.snapshot()
+        assert snap["convert_to_rows.calls"] == 1
+        assert snap["convert_to_rows.rows"] == 64
+        assert snap["convert_from_rows.bytes"] == int(np.asarray(rows.offsets)[-1])
+        assert snap["murmur3_hash.rows"] == 64
+    finally:
+        metrics.disable()
+        metrics.reset()
+    # disabled: zero overhead path records nothing
+    murmur3_hash(Table((Column.from_numpy(np.arange(4, dtype=np.int32),
+                                          INT32),)))
+    assert metrics.snapshot() == {}
