@@ -21,3 +21,4 @@ from spark_rapids_jni_tpu.ops.decimal import (  # noqa: F401
     mul_decimal128, sub_decimal128,
 )
 from spark_rapids_jni_tpu.ops import membership  # noqa: F401
+from spark_rapids_jni_tpu.ops.get_json import get_json_object  # noqa: F401

@@ -1,0 +1,397 @@
+"""``get_json_object``: JSON path extraction over string columns.
+
+Capability parity with the reference lineage's ``get_json_object`` kernel
+(Spark's ``GetJsonObject`` expression; not in the mounted snapshot — built
+to the Spark contract directly) for object-key paths ``$.k1.k2...``.
+
+TPU-native design: the JSON tokenizer is a character automaton run as one
+``lax.scan`` over the padded char axis — each scan step advances every
+row's state with pure vector ops (the scan carry holds, per row: string/
+escape flags, brace depth, how many path segments are matched, key-match
+progress, and the capture span).  No per-row control flow, no ragged
+indexing; the only data-dependent addressing is the final value
+extraction, one windowed ``take_along_axis`` per call.
+
+Rows whose extracted value is a JSON string containing escape sequences
+take an exact host-side fallback (``json.loads``), gated by one scalar
+readback — the same punt-to-host pattern ``cast_string_to_int`` uses for
+its unbounded tail.
+
+Semantics (matching Spark):
+- result is the raw JSON text of the value (objects/arrays/numbers/
+  literals), or the *content* of a string value (quotes stripped,
+  escapes decoded);
+- missing path, invalid JSON, or non-object traversal -> null;
+- input nulls propagate.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.table import Column, STRING, pack_bools
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+
+def _parse_path(path: str) -> List[bytes]:
+    """``$.a.b`` -> [b"a", b"b"].  Object keys only (array subscripts are
+    not supported in this version; Spark returns null for unsupported
+    paths rather than erroring, but we raise to avoid silent nulls)."""
+    if not path.startswith("$"):
+        raise ValueError(f"JSON path must start with '$': {path!r}")
+    rest = path[1:]
+    if not rest:
+        raise ValueError("the identity path '$' is not supported")
+    segs: List[bytes] = []
+    for part in rest.split("."):
+        if part == "" and not segs:
+            continue
+        if part == "" or "[" in part or "]" in part:
+            raise ValueError(f"unsupported JSON path segment {part!r} "
+                             "(object keys only)")
+        segs.append(part.encode("utf-8"))
+    if not segs:
+        raise ValueError(f"empty JSON path: {path!r}")
+    return segs
+
+
+def _scan_automaton(ch: jnp.ndarray, segs: Tuple[bytes, ...],
+                    max_key_len: int):
+    """Run the tokenizer over ``ch [n, W]``; returns per-row capture
+    (start, end, found, bad) positions into the padded window."""
+    n, W = ch.shape
+    L = len(segs)
+    # static per-level key byte matrix [L, max_key_len] + lengths
+    seg_bytes = np.zeros((L, max_key_len), np.uint8)
+    seg_lens = np.zeros((L,), np.int32)
+    for i, s in enumerate(segs):
+        seg_bytes[i, :len(s)] = np.frombuffer(s, np.uint8)
+        seg_lens[i] = len(s)
+    segb = jnp.asarray(seg_bytes)
+    segl = jnp.asarray(seg_lens)
+
+    i32 = jnp.int32
+    z = jnp.zeros((n,), i32)
+    carry0 = dict(
+        in_str=z, esc=z, depth=z,
+        matched=z,            # path segments fully matched on the stack
+        in_key=z,             # currently scanning an object key at the
+                              # match frontier (depth == matched + 1)
+        key_pos=z,            # bytes of the key consumed
+        key_ok=z + 1,         # key still equals the target segment
+        await_colon=z,        # key closed, expecting ':'
+        capturing=z,          # inside the target value
+        cap_depth=z,          # depth at capture start
+        start=z - 1, end=z - 1,
+        found=z, bad=z,
+    )
+
+    def step(c, pos_and_char):
+        pos, x = pos_and_char          # x: [n] uint8 at position pos
+        xs = x.astype(i32)
+        is_q = xs == ord('"')
+        is_bs = xs == ord("\\")
+        is_ws = (xs == 32) | (xs == 9) | (xs == 10) | (xs == 13)
+        is_open = (xs == ord("{")) | (xs == ord("["))
+        is_close = (xs == ord("}")) | (xs == ord("]"))
+        is_colon = xs == ord(":")
+        is_comma = xs == ord(",")
+
+        in_str, esc = c["in_str"], c["esc"]
+        eff_q = is_q & (esc == 0)
+        new_in_str = jnp.where(eff_q, 1 - in_str, in_str)
+        new_esc = ((in_str == 1) & (esc == 0) & is_bs).astype(i32)
+
+        depth = c["depth"]
+        outside = in_str == 0
+        new_depth = depth + jnp.where(outside & is_open, 1, 0) \
+            - jnp.where(outside & is_close, 1, 0)
+
+        frontier = c["matched"] + 1
+        at_frontier = depth == frontier
+
+        # --- key scanning at the frontier ---
+        # a quote opens a KEY only in key position (right after '{' or ','
+        # of the frontier object) — without this, string VALUES equal to
+        # the path segment would be scanned as keys
+        key_opening = outside & eff_q & (c["expect_key"] == 1) \
+            & (c["in_key"] == 0) & (c["await_colon"] == 0) \
+            & (c["capturing"] == 0) & (c["found"] == 0) & at_frontier
+        in_key = c["in_key"]
+        key_pos = c["key_pos"]
+        key_ok = c["key_ok"]
+        # char inside a key (in_str was 1 when we entered this char)
+        key_char = (in_key == 1) & (in_str == 1) & ~(eff_q & (esc == 0))
+        seg_idx = jnp.clip(c["matched"], 0, L - 1)
+        expect = segb[seg_idx, jnp.clip(key_pos, 0, max_key_len - 1)] \
+            .astype(i32)
+        this_len = segl[seg_idx]
+        ok_char = key_char & (key_pos < this_len) & (xs == expect) \
+            & (esc == 0)
+        key_ok = jnp.where(key_char,
+                           jnp.where(ok_char, key_ok, 0), key_ok)
+        # escapes in keys: conservatively no-match (Spark keys rarely
+        # escape; an escaped key can only fail to match our literal path)
+        key_ok = jnp.where(key_char & (esc == 1), 0, key_ok)
+        key_pos = jnp.where(key_char, key_pos + 1, key_pos)
+        # key closes on its terminating quote
+        key_closing = (in_key == 1) & eff_q & (in_str == 1)
+        full_match = key_closing & (key_ok == 1) & (key_pos == this_len)
+        await_colon = jnp.where(key_closing,
+                                jnp.where(full_match, 1, 0),
+                                c["await_colon"])
+        in_key = jnp.where(key_opening, 1,
+                           jnp.where(key_closing, 0, in_key))
+        key_pos = jnp.where(key_opening, 0, key_pos)
+        key_ok = jnp.where(key_opening, 1, key_ok)
+
+        # --- value entry after a matched key's colon ---
+        saw_colon = (c["await_colon"] == 1) & outside & is_colon
+        await_colon = jnp.where(saw_colon, 0, await_colon)
+        pending = c.get("pending", z) | jnp.where(saw_colon, 1, 0)
+        # first non-ws char after the colon starts the value
+        value_starts = (pending == 1) & ~is_ws \
+            & ~(jnp.where(saw_colon, 1, 0) == 1)
+        # (the colon char itself is consumed this step; value chars begin
+        # on a LATER step, so exclude the colon step)
+        matched = c["matched"]
+        is_last = matched == (L - 1)
+        # intermediate segment: the value must be an object to descend
+        descend = value_starts & ~is_last & (xs == ord("{")) \
+            & (c["capturing"] == 0) & (c["found"] == 0)
+        deadend = value_starts & ~is_last & (xs != ord("{")) \
+            & (c["capturing"] == 0) & (c["found"] == 0)
+        start_cap = value_starts & is_last & (c["capturing"] == 0) \
+            & (c["found"] == 0)
+        matched = matched + jnp.where(descend, 1, 0)
+        # a matched intermediate object closing retracts the frontier —
+        # otherwise sibling subtrees would match the remaining segments
+        unmatch = outside & is_close & (c["capturing"] == 0) \
+            & (c["matched"] > 0) & (new_depth == c["matched"]) \
+            & (c["found"] == 0)
+        matched = matched - jnp.where(unmatch, 1, 0)
+        pending2 = jnp.where(value_starts | deadend, 0, pending)
+        bad = c["bad"] | jnp.where(deadend, 1, 0)
+
+        # key-position tracking for the (possibly updated) frontier: '{'
+        # opening the frontier object or ',' inside it puts us in key
+        # position; anything else that is not whitespace leaves it
+        new_frontier = matched + 1
+        opens_frontier = outside & is_open & (xs == ord("{")) \
+            & (new_depth == new_frontier)
+        comma_frontier = outside & is_comma & (depth == new_frontier) \
+            & (c["capturing"] == 0)
+        expect_key = c["expect_key"]
+        expect_key = jnp.where(opens_frontier | comma_frontier, 1,
+                               jnp.where(key_opening
+                                         | (~is_ws & (in_str == 0)
+                                            & ~eff_q & ~is_open
+                                            & ~is_comma),
+                                         0, expect_key))
+
+        capturing = c["capturing"]
+        start = jnp.where(start_cap, pos, c["start"])
+        cap_depth = jnp.where(start_cap, depth, c["cap_depth"])
+        cap_is_str = jnp.where(start_cap,
+                               (xs == ord('"')).astype(i32),
+                               c["cap_is_str"])
+        capturing = jnp.where(start_cap, 1, capturing)
+
+        # --- capture end: value ends when, at the capture depth and
+        # outside strings, a comma/close appears (for scalars), or when
+        # the bracket that opened the value closes (for containers).
+        # Track: scalar value -> ends at first outside comma/close at
+        # cap_depth; container -> new_depth < cap_depth + ... simpler:
+        # value text ends when outside & depth returns to cap_depth after
+        # having consumed at least one char AND the current char is a
+        # terminator (comma or close at cap_depth), or for containers when
+        # new_depth == cap_depth - 0 after the matching close.
+        started = (capturing == 1) & (start >= 0) & (c["found"] == 0)
+        # container case: the char that brings depth back to cap_depth
+        # FROM above, i.e. is_close with depth == cap_depth + 1 ... but the
+        # opening char itself raised depth AFTER start; detect end when
+        # outside & is_close & (new_depth == cap_depth - 0) & pos > start
+        cont_end = started & outside & is_close \
+            & (new_depth == cap_depth) & (pos > start)
+        scalar_term = started & (cap_is_str == 0) & outside \
+            & (is_comma | is_close) & (depth == cap_depth) & (pos > start)
+        str_end = started & (cap_is_str == 1) & eff_q & (in_str == 1) \
+            & (pos > start)
+        # (string values: their terminating quote, inclusive)
+        ends_now = cont_end | scalar_term | str_end
+        # scalar_term ends BEFORE the terminator char; others include it
+        end_pos = jnp.where(scalar_term & ~cont_end & ~str_end, pos,
+                            pos + 1)
+        end = jnp.where(ends_now, end_pos, c["end"])
+        found = c["found"] | jnp.where(ends_now, 1, 0)
+        capturing = jnp.where(ends_now, 0, capturing)
+
+        out = dict(in_str=new_in_str, esc=new_esc, depth=new_depth,
+                   matched=matched, in_key=in_key, key_pos=key_pos,
+                   key_ok=key_ok, await_colon=await_colon,
+                   capturing=capturing, cap_depth=cap_depth,
+                   cap_is_str=cap_is_str, expect_key=expect_key,
+                   start=start, end=end, found=found, bad=bad,
+                   pending=pending2)
+        return out, None
+
+    carry0["pending"] = z
+    carry0["cap_is_str"] = z
+    carry0["expect_key"] = z
+    pos = jnp.arange(W, dtype=i32)
+    final, _ = jax.lax.scan(step, carry0, (pos, ch.T))
+    # unterminated scalar at end-of-string: value runs to the char length
+    return final
+
+
+@func_range()
+def get_json_object(col: Column, path: str,
+                    max_str_len: Optional[int] = None) -> Column:
+    """Spark ``get_json_object(json, path)`` for object-key paths.
+
+    Returns a dense-padded string column; null where the path is missing
+    or the JSON is malformed along the scanned prefix."""
+    if not col.dtype.is_string:
+        raise ValueError("get_json_object needs a string column")
+    segs = tuple(_parse_path(path))
+    if col.is_padded:
+        W = col.chars2d.shape[1]
+    elif max_str_len is not None:
+        W = (int(max_str_len) + 3) // 4 * 4
+    else:
+        lens = np.asarray(col.str_lens())
+        W = ((int(lens.max()) if lens.size else 0) + 3) // 4 * 4
+    ch = col.chars_window(W)
+    lens = col.str_lens()
+    mkl = max((len(s) for s in segs), default=1)
+    st = _scan_automaton(ch, segs, mkl)
+
+    start, end = st["start"], st["end"]
+    # a capture still open at end-of-string means truncated JSON: null
+    # (Spark's streaming parser hits EOF and returns null), so only
+    # properly terminated captures count
+    found = (st["found"] == 1) & (st["capturing"] == 0)
+    ok = found & (st["bad"] == 0) & (start >= 0) & (end > start)
+
+    # string values: strip the surrounding quotes
+    first = _at(ch, jnp.clip(start, 0, W - 1))
+    is_strval = ok & (first == ord('"'))
+    vstart = jnp.where(is_strval, start + 1, start)
+    vend = jnp.where(is_strval, end - 1, end)
+    out_len = jnp.clip(vend - vstart, 0, W)
+
+    # left-justify the value into its own padded matrix (the one
+    # data-dependent addressing step)
+    idx = jnp.clip(vstart[:, None]
+                   + jnp.arange(W, dtype=jnp.int32)[None, :], 0, W - 1)
+    vals = jnp.take_along_axis(ch, idx, axis=1)
+    mask = jnp.arange(W, dtype=jnp.int32)[None, :] < out_len[:, None]
+    vals = jnp.where(mask, vals, jnp.uint8(0))
+    # scalar tokens: trim trailing whitespace picked up before the
+    # terminator (`{ "a" : 7 }` captures "7 ", Spark returns "7");
+    # string contents keep their spaces
+    ws = (vals == 32) | (vals == 9) | (vals == 10) | (vals == 13)
+    iota1 = jnp.arange(1, W + 1, dtype=jnp.int32)[None, :]
+    last_nonws = jnp.max(jnp.where(mask & ~ws, iota1, 0), axis=1)
+    out_len = jnp.where(is_strval, out_len, last_nonws)
+    mask = jnp.arange(W, dtype=jnp.int32)[None, :] < out_len[:, None]
+    vals = jnp.where(mask, vals, jnp.uint8(0))
+
+    valid = col.valid_bools() & ok
+    lens_out = jnp.where(valid, out_len, 0).astype(jnp.int32)
+    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(lens_out).astype(jnp.int32)])
+    result = Column(STRING, jnp.zeros((0,), jnp.uint8),
+                    pack_bools(valid), offsets, None, vals)
+
+    # two row classes take the exact host path (one scalar readback gate,
+    # the cast_string punt pattern): string values containing escapes
+    # (must decode), and container values (Spark returns NORMALIZED json —
+    # re-serialized without insignificant whitespace — not the raw slice)
+    has_bs = jnp.any(jnp.where(mask, vals == ord("\\"), False), axis=1) \
+        & is_strval & valid
+    is_container = valid & ((first == ord("{")) | (first == ord("[")))
+    needs_host = has_bs | is_container
+    if isinstance(needs_host, jax.core.Tracer):
+        # under an outer jit the host fixup cannot run: degrade punted
+        # rows to null (never emit raw un-normalized/un-decoded text) —
+        # the cast_string conservative-null precedent
+        valid2 = valid & ~needs_host
+        lens2 = jnp.where(valid2, out_len, 0).astype(jnp.int32)
+        offsets2 = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32),
+             jnp.cumsum(lens2).astype(jnp.int32)])
+        return Column(STRING, jnp.zeros((0,), jnp.uint8),
+                      pack_bools(valid2), offsets2, None,
+                      jnp.where(valid2[:, None], vals, jnp.uint8(0)))
+    if bool(jnp.any(needs_host)):
+        result = _host_fixup(result, col, path, np.asarray(needs_host))
+    return result
+
+
+def _at(b: jnp.ndarray, pos: jnp.ndarray) -> jnp.ndarray:
+    return jnp.take_along_axis(b, pos[:, None], axis=1)[:, 0].astype(
+        jnp.int32)
+
+
+def _host_fixup(result: Column, src: Column, path: str,
+                rows: np.ndarray) -> Column:
+    """Exact host re-extraction (json.loads) for rows the device slice
+    cannot finish: escaped string values (decode) and container values
+    (Spark-normalized re-serialization).  Patches chars2d/lens in place;
+    the matrix widens if a normalized container outgrows the window."""
+    segs = [s.decode() for s in _parse_path(path)]
+    mat = np.array(np.asarray(result.chars2d))
+    offs = np.asarray(result.offsets)
+    lens = (offs[1:] - offs[:-1]).astype(np.int64).copy()
+    valid = np.array(np.asarray(result.valid_bools()))
+    flagged = np.nonzero(rows)[0]
+    # pull only the flagged rows' source text (a full-column to_pylist
+    # would transfer the whole chars matrix for a handful of punts)
+    if src.is_padded:
+        sub = np.asarray(src.chars2d[jnp.asarray(flagged)])
+        sub_lens = np.asarray(src.str_lens())[flagged]
+        src_text = {int(r): bytes(sub[i, :sub_lens[i]]).decode(
+            "utf-8", "replace") for i, r in enumerate(flagged)}
+    else:
+        o = np.asarray(src.offsets)
+        chars = np.asarray(src.chars)
+        src_text = {int(r): bytes(chars[o[r]:o[r + 1]]).decode(
+            "utf-8", "replace") for r in flagged}
+    patches = {}
+    for r in flagged:
+        try:
+            obj = json.loads(src_text[int(r)])
+            for s in segs:
+                if not isinstance(obj, dict):
+                    raise KeyError(s)
+                obj = obj[s]
+            if isinstance(obj, str):
+                text = obj
+            else:
+                text = json.dumps(obj, separators=(",", ":"))
+            patches[r] = text.encode("utf-8")
+        except Exception:
+            valid[r] = False
+            lens[r] = 0
+            mat[r] = 0
+    if patches:
+        need = max(len(b) for b in patches.values())
+        if need > mat.shape[1]:
+            grow = (need + 3) // 4 * 4 - mat.shape[1]
+            mat = np.concatenate(
+                [mat, np.zeros((mat.shape[0], grow), np.uint8)], axis=1)
+        for r, b in patches.items():
+            mat[r] = 0
+            mat[r, :len(b)] = np.frombuffer(b, np.uint8)
+            lens[r] = len(b)
+    offsets = np.zeros(len(lens) + 1, np.int32)
+    np.cumsum(lens, out=offsets[1:])
+    return Column(STRING, jnp.zeros((0,), jnp.uint8),
+                  pack_bools(jnp.asarray(valid)), jnp.asarray(offsets),
+                  None, jnp.asarray(mat))

@@ -1,0 +1,202 @@
+"""get_json_object tests vs a Python json oracle (Spark semantics:
+raw JSON text for non-strings, unquoted/unescaped content for strings,
+null for missing paths / invalid JSON)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import Column
+from spark_rapids_jni_tpu.ops.get_json import get_json_object
+
+
+def oracle(docs, path):
+    segs = path[2:].split(".")
+    out = []
+    for d in docs:
+        if d is None:
+            out.append(None)
+            continue
+        try:
+            obj = json.loads(d)
+            for s in segs:
+                if not isinstance(obj, dict):
+                    raise KeyError
+                obj = obj[s]
+        except Exception:
+            out.append(None)
+            continue
+        if isinstance(obj, str):
+            out.append(obj)
+        elif obj is True:
+            out.append("true")
+        elif obj is False:
+            out.append("false")
+        elif obj is None:
+            out.append("null")
+        elif isinstance(obj, (dict, list)):
+            out.append(json.dumps(obj, separators=(",", ":")))
+        else:
+            out.append(json.dumps(obj))
+    return out
+
+
+def check(docs, path, padded=True):
+    col = Column.strings_padded(docs) if padded else Column.strings(docs)
+    got = get_json_object(col, path).to_pylist()
+    exp = oracle(docs, path)
+    assert got == exp, f"path={path}: {got} != {exp}"
+
+
+def test_flat_values():
+    docs = ['{"a": 1, "b": "two", "c": true}',
+            '{"a": -2.5, "b": "", "c": false}',
+            '{"b": "x"}',
+            '{"a": null}']
+    check(docs, "$.a")
+    check(docs, "$.b")
+    check(docs, "$.c")
+
+
+def test_missing_and_invalid():
+    docs = ['{"a": 1}', 'not json at all', '', '{"x": {"a": 5}}', None]
+    check(docs, "$.a")
+
+
+def test_nested_paths():
+    docs = ['{"a": {"b": {"c": 42}}}',
+            '{"a": {"b": {"c": "deep"}}}',
+            '{"a": {"b": 7}}',
+            '{"a": 1}']
+    check(docs, "$.a.b.c")
+    check(docs, "$.a.b")
+
+
+def test_values_are_containers():
+    docs = ['{"a": {"x": 1, "y": [1,2,3]}, "b": 2}',
+            '{"a": [1, {"z": 3}], "b": "s"}']
+    col = Column.strings_padded(docs)
+    got = get_json_object(col, "$.a").to_pylist()
+    # container text compares semantically (whitespace may differ)
+    exp = [json.dumps(json.loads(d)["a"], separators=(",", ":"))
+           for d in docs]
+    assert [json.loads(g) for g in got] == [json.loads(e) for e in exp]
+
+
+def test_tricky_strings():
+    docs = ['{"a": "has \\"quotes\\" inside", "b": 1}',
+            '{"a": "brace } and ] inside", "b": 2}',
+            '{"a": "comma, colon: here", "b": 3}',
+            '{"a": "backslash \\\\ end", "b": 4}',
+            '{"a": "unicode \\u00e9", "b": 5}']
+    check(docs, "$.a")
+    check(docs, "$.b")
+
+
+def test_key_lookalikes():
+    # a nested object contains the same key at a deeper level; only the
+    # depth-correct key matches
+    docs = ['{"x": {"a": "inner"}, "a": "outer"}',
+            '{"a": "first", "x": {"a": "inner"}}']
+    check(docs, "$.a")
+
+
+def test_key_as_string_value():
+    # the path key appearing as a VALUE must not match
+    docs = ['{"k": "a", "a": 9}', '{"k": "a:1"}']
+    check(docs, "$.a")
+
+
+def test_whitespace_and_last_value():
+    docs = ['{ "a" : 7 }', '{"b":1,"a":8}', '{"a":9}',
+            '{\n  "a"\t: "sp"  }']
+    check(docs, "$.a")
+
+
+def test_nulls_propagate_and_empty():
+    docs = [None, '{"a": 1}', None]
+    check(docs, "$.a")
+
+
+def test_arrow_input_and_bad_paths():
+    check(['{"a": 3}'], "$.a", padded=False)
+    with pytest.raises(ValueError):
+        get_json_object(Column.strings_padded(['{}']), "$.a[0]")
+    with pytest.raises(ValueError):
+        get_json_object(Column.strings_padded(['{}']), "a.b")
+    with pytest.raises(ValueError):
+        get_json_object(Column.strings_padded(['{}']), "$")
+
+
+def test_long_mixed_batch(rng):
+    import random
+    r = random.Random(5)
+    docs = []
+    for _ in range(200):
+        kind = r.randrange(5)
+        if kind == 0:
+            docs.append(json.dumps({"a": r.randrange(-99, 99),
+                                    "b": "v" * r.randrange(0, 8)}))
+        elif kind == 1:
+            docs.append(json.dumps({"b": 1}))
+        elif kind == 2:
+            docs.append(json.dumps({"a": {"c": r.randrange(9)}}))
+        elif kind == 3:
+            docs.append("{bad")
+        else:
+            docs.append(json.dumps({"a": [1, 2, {"d": "x"}]}))
+    col = Column.strings_padded(docs)
+    got = get_json_object(col, "$.a").to_pylist()
+    exp = oracle(docs, "$.a")
+    # containers compare semantically
+    for g, e in zip(got, exp):
+        if e is not None and e[:1] in "[{":
+            assert g is not None and json.loads(g) == json.loads(e)
+        else:
+            assert g == e
+
+
+def test_value_string_not_scanned_as_key():
+    """A string VALUE equal to the path key must not match (review
+    regression: '9' was returned)."""
+    check(['{"k": "a", "b": 9}'], "$.a")          # -> null
+    check(['{"k": "a", "a": 9}'], "$.a")          # real key still found
+
+
+def test_sibling_subtree_does_not_match():
+    """After a matched intermediate object closes, deeper segments must
+    not match keys in sibling subtrees (review regression)."""
+    check(['{"a": {"x": 1}, "b": {"c": 2}}'], "$.a.c")   # -> null
+    check(['{"b": {"c": 2}, "a": {"c": 3}}'], "$.a.c")   # -> 3
+
+
+def test_truncated_json_is_null():
+    """Unterminated values mean invalid JSON -> null (review regression)."""
+    check(['{"a": 7', '{"a": "x', '{"a": {"b": 1}', '{"a": 7}'], "$.a")
+
+
+def test_duplicate_keys_first_match_wins():
+    """Spark's streaming evaluator emits the first occurrence (python's
+    json.loads keeps the last, so this is pinned explicitly, not via the
+    oracle)."""
+    col = Column.strings_padded(['{"a": 1, "a": 2}'])
+    assert get_json_object(col, "$.a").to_pylist() == ["1"]
+
+
+def test_traced_caller_degrades_to_null():
+    """Under an outer jit the host fixup cannot run: punted rows (escaped
+    strings, containers) become null rather than raw text (review
+    regression)."""
+    import jax
+    col = Column.strings_padded(['{"a": {"b": 1}}', '{"a": "x\\\\ny"}',
+                                 '{"a": 5}'])
+
+    def f(c):
+        out = get_json_object(c, "$.a")
+        return out.chars2d, out.valid_bools()
+
+    chars2d, valid = jax.jit(f)(col)
+    assert np.asarray(valid).tolist() == [False, False, True]
+    got = bytes(np.asarray(chars2d)[2][:1]).decode()
+    assert got == "5"
