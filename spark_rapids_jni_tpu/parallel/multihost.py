@@ -67,13 +67,18 @@ def global_mesh(axis_name: str = "data") -> Mesh:
 def stage_table_global(host_columns: Sequence[np.ndarray],
                        dtypes, mesh: Mesh,
                        validity: Optional[Sequence] = None,
-                       axis_name: str = "data") -> Table:
+                       axis_name: str = "data",
+                       str_pad_to: int = 32) -> Table:
     """Build a globally row-sharded Table from THIS process's local numpy
     shard (every process calls this with its own rows; shards concatenate
     in process order along the mesh axis).
 
     Local row counts must be equal across processes and a multiple of 8
-    (packed validity bitmasks shard on byte boundaries).
+    (packed validity bitmasks shard on byte boundaries).  STRING columns
+    take a list of ``str | None`` per row and stage in the dense-padded
+    device layout; ``str_pad_to`` is the padded width and must be the SAME
+    on every process (it shapes the global array) and at least the longest
+    local string.
     """
     spec = NamedSharding(mesh, P(axis_name))
     naxis = mesh.shape[axis_name]
@@ -94,8 +99,28 @@ def stage_table_global(host_columns: Sequence[np.ndarray],
     cols = []
     for vals, dt, valid in zip(host_columns, dtypes, validity):
         if dt.is_string:
-            raise ValueError("global staging supports fixed-width columns "
-                             "only (strings ride the row-blob shuffle)")
+            from spark_rapids_jni_tpu.table import Column as _C
+            local = _C.strings_padded(list(vals), pad_to=str_pad_to)
+            n = local.num_rows
+            if n % (naxis // nproc * 8) != 0:
+                raise ValueError(
+                    f"local rows ({n}) must be a multiple of 8x the "
+                    f"process's device count ({naxis // nproc})")
+            chars2d = jax.make_array_from_process_local_data(
+                spec, np.asarray(local.chars2d))
+            lens = jax.make_array_from_process_local_data(
+                spec, np.asarray(local.str_lens()))
+            vmask = None
+            if valid is not None:
+                packed = np.packbits(np.asarray(valid, dtype=bool),
+                                     bitorder="little")
+                vmask = jax.make_array_from_process_local_data(spec, packed)
+            elif local.validity is not None:
+                vmask = jax.make_array_from_process_local_data(
+                    spec, np.asarray(local.validity))
+            cols.append(Column(dt, local.data, vmask, None, None,
+                               chars2d, lens))
+            continue
         vals = np.asarray(vals)
         # packed validity bytes must split evenly over the devices this
         # process feeds (same rule as mesh.shard_table, per process)

@@ -235,3 +235,32 @@ def test_multihost_staging_single_process(rng, mesh):
                                 capacity_factor=16.0)
     assert not bool(np.asarray(res.overflow)[0])
     assert int(np.asarray(res.num_valid).sum()) == n
+
+
+def test_multihost_staging_with_strings(rng, mesh):
+    """Global staging accepts padded string columns: chars2d and lens
+    shard row-wise; the staged table flows through the string shuffle."""
+    from spark_rapids_jni_tpu.parallel import (
+        init_distributed, stage_table_global)
+    from spark_rapids_jni_tpu import STRING
+    assert init_distributed() == 0
+    n = 8 * 16
+    alphabet = list("abcdefgh")
+    vals = ["".join(rng.choice(alphabet, int(rng.integers(0, 9))))
+            for _ in range(n)]
+    pay = rng.integers(-9, 9, n, dtype=np.int32)
+    t = stage_table_global([vals, pay], [STRING, INT32], mesh,
+                           str_pad_to=12)
+    assert t.columns[0].is_padded
+    assert t.columns[0].to_pylist() == vals
+    res = shuffle_table_sharded(t, key_cols=[0], mesh=mesh,
+                                capacity_factor=8.0)
+    assert not bool(np.asarray(res.overflow)[0])
+    assert int(np.asarray(res.num_valid).sum()) == n
+    out = decode_shuffle_result(res, t.dtypes, mesh)
+    mask = np.asarray(res.row_valid)
+    got = sorted((s or "", int(p)) for s, p, m in
+                 zip(out.columns[0].to_pylist(),
+                     np.asarray(out.columns[1].data), mask) if m)
+    exp = sorted((v, int(p)) for v, p in zip(vals, pay))
+    assert got == exp
