@@ -37,8 +37,12 @@ from spark_rapids_jni_tpu.table import (
     Column, Table, bytes2d_to_words as _bytes_to_u32_lanes,
 )
 
-_C1 = jnp.uint32(0xCC9E2D51)
-_C2 = jnp.uint32(0x1B873593)
+# np (not jnp) scalars: module import must never create a device array —
+# an eager jnp constant here dispatches to the default backend at import
+# time, which breaks hermetic CPU-only entry points when the default
+# backend (e.g. a TPU plugin with a mismatched libtpu) cannot initialize.
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
 DEFAULT_SEED = 42
 
 

@@ -29,6 +29,110 @@ def test_dryrun_multichip_8():
     graft.dryrun_multichip(8)
 
 
+_POISONED_DRYRUN = """
+import jax  # imports, but does NOT initialize, the backends
+from jax._src import dispatch as jdispatch
+from jax._src.interpreters import pxla
+
+# The r1/r2 gate machine's TPU backend INITIALIZED fine but every op on it
+# failed (libtpu client/terminal mismatch).  Reproduce exactly that: guard
+# the two dispatch domains (same hook points as faultinj) so any execute
+# or host->device placement that targets a non-CPU device raises — a
+# module-level eager jnp constant, a stray jnp.asarray outside
+# default_device(cpu), anything.  Guard against vacuity first: on a host
+# with no non-CPU plugin registered, nothing could ever trip the poison,
+# so the run must say so loudly rather than pass for the wrong reason.
+# (Registered factories are inspectable without initializing backends —
+# jax.default_backend() would initialize them and defeat the dryrun's
+# self-provisioning.)
+from jax._src import xla_bridge as _xb
+if all(name == "cpu" for name in _xb._backend_factories):
+    raise SystemExit(
+        "POISON_VACUOUS: only the cpu backend is registered; this "
+        "machine cannot exercise the broken-default-backend scenario")
+def _fail(what, devs):
+    raise RuntimeError(
+        "FAILED_PRECONDITION: %s targeted non-CPU device(s) %r "
+        "(simulated libtpu mismatch)" % (what, devs))
+
+_orig_exec = pxla.ExecuteReplicated.__call__
+def _guarded_exec(self, *args):
+    bad = [d for d in self._local_devices if d.platform != "cpu"]
+    if bad:
+        _fail("execute", bad)
+    return _orig_exec(self, *args)
+pxla.ExecuteReplicated.__call__ = _guarded_exec
+
+def _target_platforms(dev_or_sharding):
+    if dev_or_sharding is None:
+        return []
+    ds = getattr(dev_or_sharding, "device_set", None)  # Sharding
+    if ds is not None:
+        return [d.platform for d in ds]
+    p = getattr(dev_or_sharding, "platform", None)     # Device
+    return [p] if isinstance(p, str) else []
+
+_orig_dp = jdispatch._batched_device_put_impl
+def _guarded_dp(*xs, devices, srcs, copy_semantics, dst_avals):
+    for d in devices:
+        bad = [p for p in _target_platforms(d) if p != "cpu"]
+        if bad:
+            _fail("device_put", bad)
+    return _orig_dp(*xs, devices=devices, srcs=srcs,
+                    copy_semantics=copy_semantics, dst_avals=dst_avals)
+jdispatch._batched_device_put_impl = _guarded_dp
+
+from __graft_entry__ import dryrun_multichip
+dryrun_multichip(8)
+print("DRYRUN_OK")
+"""
+
+
+def _run_bare_subprocess(code):
+    import subprocess
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    return subprocess.run([sys.executable, "-c", code], cwd=repo, env=env,
+                          capture_output=True, text=True, timeout=600)
+
+
+def test_dryrun_bare_env_subprocess():
+    """dryrun_multichip(8) must pass in a BARE process.
+
+    The round-1 and round-2 gate failures were invisible in-process: this
+    conftest pre-provisions 8 CPU devices via XLA_FLAGS, so any test here
+    runs in exactly the configuration the driver does NOT have.  Scrub
+    XLA_FLAGS / JAX_PLATFORMS and run the dryrun in a fresh interpreter —
+    the entry point must self-provision its CPU mesh via
+    ``jax_num_cpu_devices``.
+    """
+    proc = _run_bare_subprocess(
+        "from __graft_entry__ import dryrun_multichip; "
+        "dryrun_multichip(8); print('DRYRUN_OK')")
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "DRYRUN_OK" in proc.stdout
+
+
+def test_dryrun_bare_env_subprocess_broken_default_backend():
+    """The dryrun must pass even when every non-CPU backend CANNOT init.
+
+    A healthy local default backend masks accidental default-backend
+    dispatch (e.g. a module-level eager ``jnp.uint32`` constant executed
+    at package import) — the subprocess above goes green while the gate
+    machine, whose TPU plugin has a libtpu mismatch, still fails.  Here
+    the subprocess replaces every non-CPU backend factory with one that
+    raises, so ANY op reaching the default backend fails the test.
+    """
+    proc = _run_bare_subprocess(_POISONED_DRYRUN)
+    if "POISON_VACUOUS" in proc.stdout + proc.stderr:
+        pytest.skip("no non-CPU backend registered on this host; the "
+                    "poison cannot be exercised")
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "DRYRUN_OK" in proc.stdout
+
+
 def test_dryrun_hermetic_with_poisoned_default_backend(monkeypatch):
     """dryrun_multichip must never require default-backend init to succeed.
 
