@@ -401,6 +401,54 @@ def convert_to_rows(table: Table, *, size_limit: int = MAX_BATCH_BYTES,
         return _to_rows_variable(table, layout, size_limit)
     platform = _platform_of(table)
     impl = _resolve_impl(impl, use_pallas, platform)
+    n = table.num_rows
+    # one batching policy: conversion transients are bounded at <=1GB per
+    # encode even when the caller's size_limit would allow bigger batches.
+    # (With the fused encoder the transients are VMEM-only; the chunk then
+    # just caps each output batch so int32 offsets stay valid.)
+    chunk = min(size_limit, 1 << 30)
+
+    # TPU hot path: fused single-pass Pallas encoder.  XLA prep (64-bit
+    # planes + validity quads) runs ONCE; every batch reads the full
+    # columns in place at a prefetched tile offset — no per-batch slice
+    # copies and no [W, n] plane round trip through HBM.
+    import os as _os
+    from spark_rapids_jni_tpu.ops import row_mxu
+    align = row_mxu._FUSE_TILE
+    max_per = chunk // layout.fixed_row_size // align * align
+    # the fused encoder's full-table prep (64-bit planes + validity quads)
+    # stays resident across batches; cap it so memory-constrained tables
+    # keep the old batch-sliced path (SRJ_PALLAS_PACK=0 also opts out,
+    # same escape hatch as the pack kernel)
+    prep_bytes = sum(8 * n for c in table.columns
+                     if c.dtype.itemsize == 8) \
+        + 4 * ((layout.num_columns + 3) // 4) * n
+    prep_ok = prep_bytes <= int(_os.environ.get(
+        "SRJ_FUSED_PREP_CAP", str(4 << 30)))
+    # single-batch tables stay on the one-jit XLA pack+dot path below —
+    # measured fastest there (~90 GB/s at 1M; the plane round trip hides
+    # under XLA's scheduling).  The fused encoder wins only when batching
+    # would force per-batch slice copies + repeated prep.
+    if (impl == "mxu" and platform == "tpu" and n >= align and max_per
+            and n * layout.fixed_row_size > chunk
+            and prep_ok
+            and _os.environ.get("SRJ_PALLAS_PACK", "1") != "0"):
+        # the fused kernel's transients are VMEM-only, so batches can run
+        # up to the int32-offset cap rather than the 1GB transient bound
+        # the XLA paths need (clamped: offsets are int32 regardless of
+        # the caller's size_limit)
+        chunk = min(size_limit, MAX_BATCH_BYTES)
+        max_per = chunk // layout.fixed_row_size // align * align
+        enc = row_mxu.FixedEncoder(table, layout)
+        nb = -(-n * layout.fixed_row_size // chunk)
+        per = min((-(-n // nb) + align - 1) // align * align, max_per)
+        out = []
+        for start in range(0, n, per):
+            size = min(per, n - start)
+            offsets = jnp.arange(size + 1,
+                                 dtype=jnp.int32) * layout.fixed_row_size
+            out.append(RowsColumn(enc.encode(start, size), offsets))
+        return out
 
     def encode(start=0, size=None):
         if impl == "pallas":
@@ -416,10 +464,6 @@ def convert_to_rows(table: Table, *, size_limit: int = MAX_BATCH_BYTES,
             return row_mxu.to_rows_fixed(table, layout, start, size)
         return _to_rows_fixed_jit(table, layout, jnp.int32(start), size)
 
-    # one batching policy: conversion transients are bounded at <=1GB per
-    # encode even when the caller's size_limit would allow bigger batches
-    n = table.num_rows
-    chunk = min(size_limit, 1 << 30)
     if len(plan_fixed_batches(n, layout.fixed_row_size, chunk)) == 1:
         offsets = jnp.arange(n + 1, dtype=jnp.int32) * layout.fixed_row_size
         return [RowsColumn(encode(), offsets)]

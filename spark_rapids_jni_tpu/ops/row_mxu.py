@@ -378,11 +378,6 @@ def _forward_p3_device(layout: RowLayout) -> jnp.ndarray:
     return jnp.asarray(_forward_plan(layout)[1])
 
 
-@functools.lru_cache(maxsize=64)
-def _inverse_p3_device(layout: RowLayout) -> jnp.ndarray:
-    return jnp.asarray(_inverse_plan(layout)[1])
-
-
 def _platform_of_table(table: Table) -> str:
     from spark_rapids_jni_tpu.ops.row_conversion import _platform_of
     return _platform_of(table)
@@ -409,23 +404,202 @@ def to_rows_fixed(table: Table, layout: RowLayout,
 
 
 # ---------------------------------------------------------------------------
+# Fused single-pass encode: pack + dot in one Pallas kernel
+# ---------------------------------------------------------------------------
+#
+# The two-stage engine above writes the [W, n] plane matrix to HBM and the
+# dot reads it back — a full extra round trip of the whole table.  The
+# fused kernel builds the plane block in VMEM scratch and feeds the MXU
+# directly: per row tile it assembles [W, TILE] words (same packing as
+# ``_pack_kernel``), splits them into 4 byte-planes with vector shifts,
+# and accumulates 4 int8 dots against the byte-sliced permutation matrix
+# (p3 rearranged k-major, [4, W, row_size]) into the [TILE, row_size]
+# output block.  The 1KB JCUDF row cap bounds every VMEM buffer.
+#
+# Batching rides scalar prefetch: the batch's start row (in TILE units)
+# is a prefetched scalar consumed by the input index maps, so a batch
+# encode reads the FULL table's columns in place — no per-batch slice
+# copies, and equal-sized batches share one executable.
+
+_FUSE_TILE = 1024
+
+
+def _fused_encode_kernel(counts, *refs):
+    n8, n4, n2, n1 = counts
+    i = 1  # refs[0] is the prefetched start scalar (consumed by index maps)
+    a8t_ref = refs[i] if n8 else None
+    i += 1 if n8 else 0
+    vq_ref = refs[i]; i += 1
+    c4 = refs[i:i + n4]; i += n4
+    c2 = refs[i:i + n2]; i += n2
+    c1 = refs[i:i + n1]; i += n1
+    p3k_ref = refs[i]; i += 1
+    out_ref = refs[i]; i += 1
+    plane_ref = refs[i]
+    r = 0
+    if n8:
+        plane_ref[0:2 * n8, :] = a8t_ref[...]
+        r = 2 * n8
+    for j in range(n4):
+        plane_ref[r + j, :] = c4[j][...]
+    r += n4
+    for k in range(0, n2, 2):
+        a = c2[k][...].astype(jnp.uint32)
+        w = a | (c2[k + 1][...].astype(jnp.uint32) << 16) \
+            if k + 1 < n2 else a
+        plane_ref[r + k // 2, :] = w
+    r += (n2 + 1) // 2
+    for k in range(0, n1, 4):
+        w = c1[k][...].astype(jnp.uint32)
+        for j in range(1, 4):
+            if k + j < n1:
+                w = w | (c1[k + j][...].astype(jnp.uint32) << (8 * j))
+        plane_ref[r + k // 4, :] = w
+    r += (n1 + 3) // 4
+    plane_ref[r:, :] = vq_ref[...]
+    planes = plane_ref[...]
+    acc = None
+    for k in range(4):
+        bk = ((planes >> (8 * k)) & 0xFF).astype(jnp.int8)
+        d = jax.lax.dot_general(
+            bk, p3k_ref[k], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)  # Mosaic needs 32-bit acc
+        acc = d if acc is None else acc + d
+    out_ref[...] = acc.astype(jnp.uint8)  # int32 -> u8 wraps mod 256
+
+
+@functools.lru_cache(maxsize=64)
+def _forward_p3k_np(layout: RowLayout) -> np.ndarray:
+    """Forward permutation matrix rearranged byte-major: [4, W, row_size]."""
+    p = _forward_plan(layout)[1]                 # [W, 4, rs] int8
+    return np.ascontiguousarray(np.transpose(p, (1, 0, 2)))
+
+
+def _split_by_size(table: Table):
+    by_size = {8: [], 4: [], 2: [], 1: []}
+    for c in table.columns:
+        by_size[c.dtype.itemsize].append(c)
+    return by_size
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _fused_prep_jit(table: Table, layout: RowLayout):
+    """Once-per-table XLA precompute the fused kernel streams from: the
+    64-bit plane block (one batched transpose) and the validity quads.
+    Multi-batch encodes reuse these across every batch.  The 4/2/1-byte
+    columns deliberately do NOT pass through here: returning their
+    bitcast views from a jit would force a full copy of every column;
+    the encode jit bitcasts them inline instead (aliasable)."""
+    by_size = _split_by_size(table)
+    n8 = len(by_size[8])
+    n = table.num_rows
+    if n8:
+        a8 = jnp.stack([_col_words_pair(c) for c in by_size[8]])
+        a8t = jnp.transpose(a8, (0, 2, 1)).reshape(2 * n8, n)
+    else:
+        a8t = jnp.zeros((0, n), jnp.uint32)
+    vq = _validity_quads(table, layout)
+    return a8t, vq
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6, 7))
+def _fused_encode_jit(a8t, vq, c4, c2, c1, layout: RowLayout,
+                      size: int, interpret: bool,
+                      start_tiles) -> jnp.ndarray:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    plan = _forward_plan(layout)[0]
+    W = plan.num_words
+    rs = layout.fixed_row_size
+    n8 = a8t.shape[0] // 2
+    n4, n2, n1 = len(c4), len(c2), len(c1)
+    nvw = vq.shape[0]
+    T = _FUSE_TILE
+
+    c4 = [d if d.dtype == jnp.uint32
+          else jax.lax.bitcast_convert_type(d, jnp.uint32) for d in c4]
+    c2 = [d if d.dtype == jnp.uint16
+          else jax.lax.bitcast_convert_type(d, jnp.uint16) for d in c2]
+    c1 = [d.astype(jnp.uint8) if d.dtype == jnp.bool_ else
+          (d if d.dtype == jnp.uint8
+           else jax.lax.bitcast_convert_type(d, jnp.uint8)) for d in c1]
+
+    ins, in_specs = [], []
+    if n8:
+        ins.append(a8t)
+        in_specs.append(pl.BlockSpec((2 * n8, T), lambda i, s: (0, s[0] + i)))
+    ins.append(vq)
+    in_specs.append(pl.BlockSpec((nvw, T), lambda i, s: (0, s[0] + i)))
+    ins.extend(c4 + c2 + c1)
+    in_specs += [pl.BlockSpec((T,), lambda i, s: (s[0] + i,))
+                 for _ in range(n4 + n2 + n1)]
+    ins.append(jnp.asarray(_forward_p3k_np(layout)))
+    in_specs.append(pl.BlockSpec((4, W, rs), lambda i, s: (0, 0, 0)))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=((size + T - 1) // T,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((T, rs), lambda i, s: (i, 0)),
+        scratch_shapes=[pltpu.VMEM((W, T), jnp.uint32)],
+    )
+    out = pl.pallas_call(
+        functools.partial(_fused_encode_kernel, (n8, n4, n2, n1)),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((size, rs), jnp.uint8),
+        interpret=interpret,
+    )(jnp.asarray(start_tiles, jnp.int32).reshape(1), *ins)
+    return out.reshape(-1)
+
+
+class FixedEncoder:
+    """Batched fused encoder over one table: XLA prep (64-bit planes +
+    validity quads) runs once, each ``encode(start, size)`` is a single
+    fused Pallas pass reading the full columns in place (``start`` must
+    be a multiple of ``_FUSE_TILE``)."""
+
+    def __init__(self, table: Table, layout: RowLayout,
+                 interpret: bool = False):
+        self.layout = layout
+        self.interpret = interpret
+        self.a8t, self.vq = _fused_prep_jit(table, layout)
+        by_size = _split_by_size(table)
+        self.c4 = [c.data for c in by_size[4]]
+        self.c2 = [c.data for c in by_size[2]]
+        self.c1 = [c.data for c in by_size[1]]
+
+    def encode(self, start: int = 0, size: int = None) -> jnp.ndarray:
+        n = self.vq.shape[1]
+        if size is None:
+            size = n - start
+        if start % _FUSE_TILE:
+            raise ValueError(f"start {start} not {_FUSE_TILE}-aligned")
+        if start + size > n:
+            raise ValueError(
+                f"batch [{start}, {start + size}) exceeds {n} rows")
+        return _fused_encode_jit(self.a8t, self.vq, self.c4, self.c2,
+                                 self.c1, self.layout, size,
+                                 self.interpret, start // _FUSE_TILE)
+
+
+# ---------------------------------------------------------------------------
 # Decode: [n, fixed_row_size] uint8 -> columns
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnums=(1,))
+@functools.partial(jax.jit, static_argnums=(1, 2))
 def _from_rows_mxu_jit(rows_flat: jnp.ndarray, layout: RowLayout,
-                       p3: jnp.ndarray):
+                       mode: str = "xla"):
     plan, _ = _inverse_plan(layout)
     # reshape inside the jit: an eager reshape is a separate dispatched
     # copy of the whole blob on remote-tunnel backends
     rows2d = rows_flat.reshape(-1, layout.fixed_row_size)
-    o = jax.lax.dot_general(
-        p3, rows2d.astype(jnp.int8),
-        dimension_numbers=(((0,), (1,)), ((), ())),
-        preferred_element_type=jnp.int8)                    # [W, 4, n]
-    ou = jax.lax.bitcast_convert_type(o, jnp.uint8).astype(jnp.uint32)
-    x = (ou[:, 0, :] | (ou[:, 1, :] << 8)
-         | (ou[:, 2, :] << 16) | (ou[:, 3, :] << 24))       # [W, n]
+    if mode == "xla":
+        # numpy constant (NOT the cached device-array helper: jnp.asarray
+        # inside a trace would cache a tracer in the lru_cache and leak)
+        x = _decode_planes(rows2d, layout, _inverse_plan(layout)[1])
+    else:
+        x = _decode_planes_pallas_jit(rows_flat, layout,
+                                      mode == "pallas_interpret")
 
     # validity: expand the quad-packed validity byte planes to one bit
     # plane per column (shared TPU-safe expansion; see
@@ -477,11 +651,90 @@ def _from_rows_mxu_jit(rows_flat: jnp.ndarray, layout: RowLayout,
     return cols
 
 
-def from_rows_fixed(rows: jnp.ndarray, layout: RowLayout) -> List[Column]:
+def _decode_mode(rows: jnp.ndarray, layout: RowLayout,
+                 mode: str = None) -> str:
+    if mode is not None:
+        return mode
+    n = rows.size // layout.fixed_row_size
+    if n < _FUSE_TILE:   # tiny operands break Mosaic layout (as in pack)
+        return "xla"
+    from spark_rapids_jni_tpu.ops.row_conversion import _platform_of
+    return "pallas" if _platform_of(rows) == "tpu" else "xla"
+
+
+def from_rows_fixed(rows: jnp.ndarray, layout: RowLayout,
+                    mode: str = None) -> List[Column]:
     """Decode JCUDF rows (flat blob or [n, fixed_row_size]) via the
-    transposed MXU permutation."""
+    transposed MXU permutation (fused Pallas planes kernel on TPU)."""
     return _from_rows_mxu_jit(rows.reshape(-1), layout,
-                              _inverse_p3_device(layout))
+                              _decode_mode(rows, layout, mode))
+
+
+# ---------------------------------------------------------------------------
+# Fused decode-to-planes: dot + byte recombine in one Pallas kernel
+# ---------------------------------------------------------------------------
+#
+# The XLA decode dot emits [W, 4, n] int8 and recombines through a uint32
+# upcast — a 4x-blob temp written and read back (the dominant decode
+# cost).  The fused kernel produces the [W, TILE] u32 plane block directly:
+# one dot of the k-major inverse permutation ([4W, rs], byte-plane k in
+# rows kW..(k+1)W) against the row tile, then an in-VMEM shift-or of the
+# four [W, TILE] int32 quadrants.  HBM traffic: read blob once, write
+# planes once.
+
+@functools.lru_cache(maxsize=64)
+def _inverse_p3k_np(layout: RowLayout) -> np.ndarray:
+    """Inverse permutation rearranged k-major 2-D: [4*W, row_size]."""
+    p = _inverse_plan(layout)[1]                 # [rs, W, 4] int8
+    return np.ascontiguousarray(
+        np.transpose(p, (2, 1, 0)).reshape(-1, p.shape[0]))
+
+
+def _fused_decode_kernel(W, p3_ref, rows_ref, out_ref):
+    o = jax.lax.dot_general(
+        p3_ref[...], rows_ref[...].astype(jnp.int8),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)        # [4W, T]
+    x = (o[0 * W:1 * W] & 0xFF).astype(jnp.uint32) \
+        | ((o[1 * W:2 * W] & 0xFF).astype(jnp.uint32) << 8) \
+        | ((o[2 * W:3 * W] & 0xFF).astype(jnp.uint32) << 16) \
+        | ((o[3 * W:4 * W] & 0xFF).astype(jnp.uint32) << 24)
+    out_ref[...] = x
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _decode_planes_pallas_jit(rows_flat: jnp.ndarray, layout: RowLayout,
+                              interpret: bool) -> jnp.ndarray:
+    from jax.experimental import pallas as pl
+    plan = _inverse_plan(layout)[0]
+    W = plan.num_words
+    rs = layout.fixed_row_size
+    rows2d = rows_flat.reshape(-1, rs)
+    n = rows2d.shape[0]
+    T = _FUSE_TILE
+    p3 = jnp.asarray(_inverse_p3k_np(layout))
+    return pl.pallas_call(
+        functools.partial(_fused_decode_kernel, W),
+        grid=((n + T - 1) // T,),
+        in_specs=[pl.BlockSpec((4 * W, rs), lambda i: (0, 0)),
+                  pl.BlockSpec((T, rs), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((W, T), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((W, n), jnp.uint32),
+        interpret=interpret)(p3, rows2d)
+
+
+def _decode_planes(rows2d: jnp.ndarray, layout: RowLayout, p3) -> jnp.ndarray:
+    """[n, rs] u8 rows -> [W, n] u32 word planes (call under jit).
+
+    XLA path: dot to [W, 4, n] int8 then recombine (the planes round-trip
+    a u32 upcast).  Used off-TPU and as the fused kernel's oracle."""
+    o = jax.lax.dot_general(
+        p3, rows2d.astype(jnp.int8),
+        dimension_numbers=(((0,), (1,)), ((), ())),
+        preferred_element_type=jnp.int8)                    # [W, 4, n]
+    ou = jax.lax.bitcast_convert_type(o, jnp.uint8).astype(jnp.uint32)
+    return (ou[:, 0, :] | (ou[:, 1, :] << 8)
+            | (ou[:, 2, :] << 16) | (ou[:, 3, :] << 24))    # [W, n]
 
 
 # ---------------------------------------------------------------------------
@@ -653,21 +906,21 @@ def _group_order(layout: RowLayout):
     return tuple(order)
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
+@functools.partial(jax.jit, static_argnums=(1, 2))
 def _from_rows_grouped_jit(rows_flat: jnp.ndarray, layout: RowLayout,
-                           p3: jnp.ndarray):
+                           mode: str = "xla"):
     from spark_rapids_jni_tpu.table import (
         byte_planes_from_word_planes, packed_masks_from_byte_planes)
     plan, _ = _inverse_plan(layout)
     rows2d = rows_flat.reshape(-1, layout.fixed_row_size)
     n = rows2d.shape[0]
-    o = jax.lax.dot_general(
-        p3, rows2d.astype(jnp.int8),
-        dimension_numbers=(((0,), (1,)), ((), ())),
-        preferred_element_type=jnp.int8)                    # [W, 4, n]
-    ou = jax.lax.bitcast_convert_type(o, jnp.uint8).astype(jnp.uint32)
-    x = (ou[:, 0, :] | (ou[:, 1, :] << 8)
-         | (ou[:, 2, :] << 16) | (ou[:, 3, :] << 24))       # [W, n]
+    if mode == "xla":
+        # numpy constant (NOT the cached device-array helper: jnp.asarray
+        # inside a trace would cache a tracer in the lru_cache and leak)
+        x = _decode_planes(rows2d, layout, _inverse_plan(layout)[1])
+    else:
+        x = _decode_planes_pallas_jit(rows_flat, layout,
+                                      mode == "pallas_interpret")
 
     counts = {8: 0, 4: 0, 2: 0, 1: 0}
     for sz in layout.col_sizes:
@@ -702,10 +955,10 @@ def _from_rows_grouped_jit(rows_flat: jnp.ndarray, layout: RowLayout,
     return g8, g4, g2, g1, vmask
 
 
-def from_rows_fixed_grouped(rows: jnp.ndarray,
-                            layout: RowLayout) -> GroupedColumns:
+def from_rows_fixed_grouped(rows: jnp.ndarray, layout: RowLayout,
+                            mode: str = None) -> GroupedColumns:
     """Decode JCUDF rows to the dtype-major grouped backing (5 wide
     outputs instead of one buffer per column)."""
     g8, g4, g2, g1, vmask = _from_rows_grouped_jit(
-        rows.reshape(-1), layout, _inverse_p3_device(layout))
+        rows.reshape(-1), layout, _decode_mode(rows, layout, mode))
     return GroupedColumns(g8, g4, g2, g1, vmask, layout)

@@ -31,6 +31,19 @@ def rng():
     return np.random.default_rng(42)
 
 
+@pytest.fixture(params=["x64", "no_x64"])
+def x64_both(request):
+    """Run a test under both 64-bit modes: x64 (host default) and no-x64
+    (the only representation on real TPU — 64-bit columns as uint32
+    pairs).  Shared here so any suite with explicit pair-handling
+    branches can take it; see each suite for which tests request it."""
+    if request.param == "no_x64":
+        with jax.enable_x64(False):
+            yield request.param
+    else:
+        yield request.param
+
+
 @pytest.fixture
 def cpu_devices():
     assert len(CPU_DEVICES) >= 8, "need 8 virtual CPU devices"

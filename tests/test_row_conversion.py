@@ -25,18 +25,8 @@ from spark_rapids_jni_tpu.ops import (
 from spark_rapids_jni_tpu.table import assert_tables_equivalent
 
 
-@pytest.fixture(params=["x64", "no_x64"])
-def x64_both(request):
-    """Run a test under both 64-bit modes: x64 (host default) and no-x64
-    (the only representation on real TPU — 64-bit columns as uint32
-    pairs).  The shape sweep takes this fixture so the TPU-real mode gets
-    the full sweep, not just the dedicated no-x64 tests."""
-    import jax
-    if request.param == "no_x64":
-        with jax.enable_x64(False):
-            yield request.param
-    else:
-        yield request.param
+# x64_both lives in conftest.py now (shared by the string/MXU/hashing/
+# shuffle suites too)
 
 
 def make_table(rng, dtypes, num_rows, null_pattern=None):

@@ -174,3 +174,69 @@ def test_grouped_decode_matches_standard(rng):
     # single-column materialization agrees too
     np.testing.assert_array_equal(np.asarray(g.column(4).data),
                                   np.asarray(std.columns[4].data))
+
+
+def test_fused_encoder_matches_xla(rng, x64_both):
+    """The fused single-pass pack+dot encoder (interpret mode on CPU)
+    must produce byte-identical rows to the XLA path, including batch
+    encodes at tile-aligned offsets and partial tail tiles."""
+    from spark_rapids_jni_tpu.ops import row_mxu
+    from spark_rapids_jni_tpu.ops.row_layout import compute_row_layout
+    T = row_mxu._FUSE_TILE
+    for dts, n in [
+        (ALL_FIXED, 2 * T + 313),
+        ([INT8], T + 33),
+        ([INT64, INT64], T + 50),
+        ([INT16, INT16, INT16], T + 257),
+    ]:
+        t = _random_table(rng, dts, n)
+        layout = compute_row_layout(t.dtypes)
+        want = np.asarray(
+            row_mxu.to_rows_fixed(t, layout, pack="xla")).reshape(n, -1)
+        enc = row_mxu.FixedEncoder(t, layout, interpret=True)
+        got = np.asarray(enc.encode(0, n)).reshape(n, -1)
+        np.testing.assert_array_equal(got, want,
+                                      err_msg=f"schema {dts[:3]} n={n}")
+        if n >= 2 * T:
+            got_b = np.asarray(enc.encode(T, T)).reshape(T, -1)
+            np.testing.assert_array_equal(got_b, want[T:2 * T])
+        tail = n - n // T * T
+        got_t = np.asarray(
+            enc.encode(n // T * T, tail)).reshape(tail, -1)
+        np.testing.assert_array_equal(got_t, want[n // T * T:])
+
+
+def test_fused_encoder_rejects_unaligned_start(rng):
+    from spark_rapids_jni_tpu.ops import row_mxu
+    from spark_rapids_jni_tpu.ops.row_layout import compute_row_layout
+    t = _random_table(rng, [INT32], row_mxu._FUSE_TILE * 2)
+    layout = compute_row_layout(t.dtypes)
+    enc = row_mxu.FixedEncoder(t, layout, interpret=True)
+    with pytest.raises(ValueError, match="aligned"):
+        enc.encode(7, 100)
+
+
+def test_fused_decode_planes_matches_xla(rng, x64_both):
+    """The fused decode-to-planes kernel must reproduce the XLA
+    dot+recombine path for both the per-column and grouped decoders."""
+    import jax.numpy as jnp
+    from spark_rapids_jni_tpu.ops import row_mxu
+    from spark_rapids_jni_tpu.ops.row_layout import compute_row_layout
+    dtypes = cycle_dtypes(ALL_FIXED, 29)
+    n = row_mxu._FUSE_TILE + 409
+    t = _random_table(rng, dtypes, n)
+    layout = compute_row_layout(t.dtypes)
+    blob = row_mxu.to_rows_fixed(t, layout, pack="xla")
+    cols_x = row_mxu.from_rows_fixed(blob, layout, mode="xla")
+    cols_p = row_mxu.from_rows_fixed(blob, layout,
+                                     mode="pallas_interpret")
+    for a, b in zip(cols_x, cols_p):
+        np.testing.assert_array_equal(np.asarray(a.data),
+                                      np.asarray(b.data))
+        np.testing.assert_array_equal(np.asarray(a.validity),
+                                      np.asarray(b.validity))
+    g_x = row_mxu.from_rows_fixed_grouped(blob, layout, mode="xla")
+    g_p = row_mxu.from_rows_fixed_grouped(blob, layout,
+                                          mode="pallas_interpret")
+    for a, b in zip(g_x.tree_flatten()[0], g_p.tree_flatten()[0]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
