@@ -1,5 +1,8 @@
 from spark_rapids_jni_tpu.models.pipeline import (  # noqa: F401
-    filter_mask, hash_aggregate_sum, hash_aggregate_sum_multi, project,
-    sort_merge_join, sort_merge_join_dup,
+    filter_mask, hash_aggregate_sum, hash_aggregate_sum_multi,
+    hash_aggregate_multi, project,
+    sort_merge_join, sort_merge_join_dup, sort_merge_join_left,
+    join_semi_mask,
     flagship_query_step, distributed_query_step, distributed_q72_step,
+    distributed_q95_step,
 )

@@ -131,44 +131,12 @@ def hash_aggregate_sum_multi(keys: Sequence[jnp.ndarray],
     """Multi-key, multi-measure group-by-sum with static output capacity
     (the TPC-DS q72 aggregate shape: GROUP BY item, warehouse, week).
 
-    ``keys``: int arrays defining the composite group key; ``values``:
-    measures summed per group.  Returns (group_keys_list[max_groups each],
-    sums_list, have mask, num_groups) with the same overflow contract as
-    :func:`hash_aggregate_sum` — ``num_groups`` counts ALL distinct live
-    composite keys, so callers detect capacity overflow on the host."""
-    n = keys[0].shape[0]
-    if n == 0:  # a zero-row partition must aggregate to "no groups"
-        z = jnp.zeros((max_groups,), jnp.int32)
-        return ([z.astype(k.dtype) for k in keys],
-                [z.astype(v.dtype) for v in values],
-                jnp.zeros((max_groups,), jnp.bool_), jnp.int32(0))
-    order, ks, live = _lexsort_live_last(list(keys), mask)
-    vs = [jnp.where(live, v[order], 0) for v in values]
-    changed = jnp.zeros((n - 1,), jnp.bool_) if n > 1 else None
-    for k in ks:
-        if n > 1:
-            changed = changed | (k[1:] != k[:-1])
-    is_new = jnp.concatenate(
-        [jnp.ones((1,), jnp.int32),
-         changed.astype(jnp.int32) if n > 1 else jnp.zeros((0,), jnp.int32)])
-    seg = jnp.cumsum(is_new) - 1
-    in_range = seg < max_groups
-    seg_c = jnp.where(in_range, seg, max_groups)
-    contrib = live & in_range
-    sums = [jax.ops.segment_sum(jnp.where(contrib, v, 0), seg_c,
-                                num_segments=max_groups + 1)[:max_groups]
-            for v in vs]
-    first_idx = jax.ops.segment_min(
-        jnp.arange(n, dtype=jnp.int32), seg_c,
-        num_segments=max_groups + 1)[:max_groups]
-    have = jax.ops.segment_max(contrib.astype(jnp.int32), seg_c,
-                               num_segments=max_groups + 1)[:max_groups] > 0
-    safe = jnp.minimum(first_idx, n - 1)
-    gkeys = [jnp.where(have, k[safe], 0) for k in ks]
-    seg_live = jax.ops.segment_sum(live.astype(jnp.int32), seg,
-                                   num_segments=n) > 0
-    num_groups = jnp.sum(seg_live.astype(jnp.int32))
-    return gkeys, sums, have, num_groups
+    Thin wrapper over :func:`hash_aggregate_multi` with every measure
+    summed; same overflow contract (``num_groups`` counts ALL distinct
+    live composite keys, so callers detect capacity overflow on the
+    host)."""
+    return hash_aggregate_multi(keys, [(v, "sum") for v in values],
+                                mask, max_groups)
 
 
 # ---------------------------------------------------------------------------
@@ -230,6 +198,154 @@ def sort_merge_join_dup(build_keys: jnp.ndarray,
     valid = (slots < total) & (within < counts[probe_idx])
     bidx = jnp.clip(lo[probe_idx] + within, 0, nb - 1)
     return probe_idx, jnp.where(valid, bp[bidx], 0), valid, total, overflow
+
+
+def join_semi_mask(build_keys: jnp.ndarray,
+                   probe_keys: jnp.ndarray) -> jnp.ndarray:
+    """Left-semi existence mask: True where a probe key appears in the
+    build side (duplicates allowed).  The left-anti mask is its negation.
+
+    The q95 shape is built on this (EXISTS subqueries against
+    web_returns); unlike the inner joins no output buffer or capacity is
+    needed — existence joins are overflow-free by construction."""
+    if build_keys.shape[0] == 0:
+        return jnp.zeros(probe_keys.shape, jnp.bool_)
+    bk = jnp.sort(build_keys)
+    lo = jnp.searchsorted(bk, probe_keys, side="left")
+    hi = jnp.searchsorted(bk, probe_keys, side="right")
+    return hi > lo
+
+
+def sort_merge_join_left(build_keys: jnp.ndarray,
+                         build_payload: jnp.ndarray,
+                         probe_keys: jnp.ndarray,
+                         capacity: int):
+    """Left outer equi-join against a build side with duplicate keys.
+
+    Like :func:`sort_merge_join_dup` but every probe row emits at least
+    one output slot; unmatched probes emit one slot with ``matched``
+    False and a zero payload (the caller null-fills).  Returns
+    (probe_idx, payload_out, slot_valid, matched, total_rows, overflow).
+    """
+    npk = probe_keys.shape[0]
+    if npk == 0:
+        z32 = jnp.zeros((capacity,), jnp.int32)
+        return (z32, jnp.zeros((capacity,), build_payload.dtype),
+                jnp.zeros((capacity,), jnp.bool_),
+                jnp.zeros((capacity,), jnp.bool_), jnp.int32(0),
+                jnp.bool_(False))
+    nb = build_keys.shape[0]
+    if nb == 0:
+        slots = jnp.arange(capacity, dtype=jnp.int32)
+        valid = slots < npk
+        pidx = jnp.minimum(slots, npk - 1)
+        return (pidx, jnp.zeros((capacity,), build_payload.dtype),
+                valid, jnp.zeros((capacity,), jnp.bool_),
+                jnp.int32(npk), jnp.bool_(npk > capacity))
+    order = jnp.argsort(build_keys)
+    bk = build_keys[order]
+    bp = build_payload[order]
+    lo = jnp.searchsorted(bk, probe_keys, side="left")
+    hi = jnp.searchsorted(bk, probe_keys, side="right")
+    matches = (hi - lo).astype(jnp.int32)
+    counts = jnp.maximum(matches, 1)          # unmatched emit one null row
+    starts = jnp.cumsum(counts) - counts
+    total = jnp.sum(counts)
+    overflow = total > capacity
+    slots = jnp.arange(capacity, dtype=jnp.int32)
+    probe_idx = jnp.searchsorted(starts, slots, side="right") \
+        .astype(jnp.int32) - 1
+    probe_idx = jnp.clip(probe_idx, 0, npk - 1)
+    within = slots - starts[probe_idx]
+    valid = (slots < total) & (within < counts[probe_idx])
+    matched = valid & (within < matches[probe_idx])
+    bidx = jnp.clip(lo[probe_idx] + within, 0, nb - 1)
+    payload = jnp.where(matched, bp[bidx], 0)
+    return probe_idx, payload, valid, matched, total, overflow
+
+
+# ---------------------------------------------------------------------------
+# Generalized multi-measure aggregate (sum / count / min / max / avg)
+# ---------------------------------------------------------------------------
+
+_AGG_OPS = ("sum", "count", "min", "max", "avg")
+
+
+def hash_aggregate_multi(keys: Sequence[jnp.ndarray],
+                         measures: Sequence,
+                         mask: jnp.ndarray, max_groups: int):
+    """Multi-key group-by with mixed measures — the NDS aggregate surface
+    (q95: COUNT + SUM; min/max/avg appear across the suite).
+
+    ``measures``: sequence of ``(values, op)`` with op in
+    ``{"sum", "count", "min", "max", "avg"}`` (count ignores its values
+    array; avg divides as float32).  Same capacity/overflow contract as
+    :func:`hash_aggregate_sum_multi`: ``num_groups`` counts ALL distinct
+    live composite keys, so the host detects ``num_groups > max_groups``.
+    """
+    for _, op in measures:
+        if op not in _AGG_OPS:
+            raise ValueError(f"unknown aggregate op {op!r}")
+    n = keys[0].shape[0]
+    if n == 0:
+        z = jnp.zeros((max_groups,), jnp.int32)
+        outs = []
+        for v, op in measures:
+            dt = jnp.float32 if op == "avg" else \
+                (jnp.int32 if op == "count" else v.dtype)
+            outs.append(jnp.zeros((max_groups,), dt))
+        return ([z.astype(k.dtype) for k in keys], outs,
+                jnp.zeros((max_groups,), jnp.bool_), jnp.int32(0))
+    order, ks, live = _lexsort_live_last(list(keys), mask)
+    changed = jnp.zeros((n - 1,), jnp.bool_) if n > 1 else None
+    for k in ks:
+        if n > 1:
+            changed = changed | (k[1:] != k[:-1])
+    is_new = jnp.concatenate(
+        [jnp.ones((1,), jnp.int32),
+         changed.astype(jnp.int32) if n > 1 else jnp.zeros((0,), jnp.int32)])
+    seg = jnp.cumsum(is_new) - 1
+    in_range = seg < max_groups
+    seg_c = jnp.where(in_range, seg, max_groups)
+    contrib = live & in_range
+    nseg = max_groups + 1
+    counts = jax.ops.segment_sum(contrib.astype(jnp.int32), seg_c,
+                                 num_segments=nseg)[:max_groups]
+    outs = []
+    for v, op in measures:
+        vo = v[order]
+        if op == "count":
+            outs.append(counts)
+            continue
+        if op in ("sum", "avg"):
+            s = jax.ops.segment_sum(jnp.where(contrib, vo, 0), seg_c,
+                                    num_segments=nseg)[:max_groups]
+            if op == "avg":
+                s = s.astype(jnp.float32) / jnp.maximum(counts, 1) \
+                    .astype(jnp.float32)
+            outs.append(s)
+            continue
+        if jnp.issubdtype(vo.dtype, jnp.floating):
+            ident = jnp.array(jnp.inf if op == "min" else -jnp.inf,
+                              vo.dtype)
+        else:
+            info = jnp.iinfo(vo.dtype)
+            ident = jnp.array(info.max if op == "min" else info.min,
+                              vo.dtype)
+        masked = jnp.where(contrib, vo, ident)
+        red = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+        r = red(masked, seg_c, num_segments=nseg)[:max_groups]
+        outs.append(jnp.where(counts > 0, r, 0))
+    have = counts > 0
+    first_idx = jax.ops.segment_min(
+        jnp.arange(n, dtype=jnp.int32), seg_c,
+        num_segments=nseg)[:max_groups]
+    safe = jnp.minimum(first_idx, n - 1)
+    gkeys = [jnp.where(have, k[safe], 0) for k in ks]
+    seg_live = jax.ops.segment_sum(live.astype(jnp.int32), seg,
+                                   num_segments=n) > 0
+    num_groups = jnp.sum(seg_live.astype(jnp.int32))
+    return gkeys, outs, have, num_groups
 
 
 # ---------------------------------------------------------------------------
@@ -333,7 +449,10 @@ def distributed_q72_step(mesh, axis_name="data",
             [r_item[pidx], r_week[pidx]],
             [jnp.ones_like(inv_q), r_qty[pidx]],
             live, max_groups)
-        overflow = x_overflow | j_overflow
+        # aggregate capacity overflow is an overflow like any other: the
+        # drivers check ONE flag before trusting the partials
+        # (num_groups still reports the true distinct-key count)
+        overflow = x_overflow | j_overflow | (num_groups > max_groups)
         return (gkeys[0], gkeys[1], sums[0], sums[1], have,
                 num_groups[None], overflow[None])
 
@@ -343,3 +462,50 @@ def distributed_q72_step(mesh, axis_name="data",
     return shard_map(step, mesh=mesh,
                      in_specs=(spec, spec, spec, rep, rep),
                      out_specs=(spec,) * 6 + (spec,), check_vma=False)
+
+
+def distributed_q95_step(mesh, axis_name="data",
+                         capacity_factor: float = 8.0,
+                         max_groups: int = MAX_GROUPS):
+    """The TPC-DS q95 shape (BASELINE.json names q95 alongside q72),
+    distributed: web_sales-like rows (order, ship_date, net) hash-exchange
+    by order key across the mesh; each device keeps orders that EXIST in
+    the replicated returned-orders list (left-semi,
+    :func:`join_semi_mask`) and multi-key aggregates COUNT(order) and
+    SUM(net) by ship_date with min/max net per group
+    (:func:`hash_aggregate_multi`).
+
+    Returns a function (order, ship_date, net_i32, returned_orders) ->
+    (gdate, counts, net_sums, net_min, net_max, have, num_groups,
+    overflow) per device.  ``overflow`` ORs the shuffle-bucket and
+    aggregate-capacity overflows (semi joins cannot overflow)."""
+    from jax.sharding import PartitionSpec as P
+    from spark_rapids_jni_tpu.parallel.shuffle import bucket_exchange
+    from spark_rapids_jni_tpu.table import INT32
+    num_parts = mesh.shape[axis_name]
+
+    def step(order_key, ship_date, net, returned_orders):
+        n_local = order_key.shape[0]
+        capacity = max(8, int(capacity_factor * n_local / num_parts))
+        pids = pmod(murmur3_hash([Column(INT32, order_key)]), num_parts)
+        payload = jnp.stack([order_key, ship_date, net], axis=1)
+        exchange = bucket_exchange(num_parts, capacity, axis_name)
+        recv, valid, _, x_overflow = exchange(payload, pids)
+        r_order, r_date, r_net = recv[:, 0], recv[:, 1], recv[:, 2]
+
+        live = valid & join_semi_mask(returned_orders, r_order)
+        gkeys, outs, have, num_groups = hash_aggregate_multi(
+            [r_date],
+            [(r_order, "count"), (r_net, "sum"), (r_net, "min"),
+             (r_net, "max")],
+            live, max_groups)
+        overflow = x_overflow | (num_groups > max_groups)
+        return (gkeys[0], outs[0], outs[1], outs[2], outs[3], have,
+                num_groups[None], overflow[None])
+
+    from jax import shard_map
+    spec = P(axis_name)
+    rep = P()
+    return shard_map(step, mesh=mesh,
+                     in_specs=(spec, spec, spec, rep),
+                     out_specs=(spec,) * 7 + (spec,), check_vma=False)
