@@ -1,6 +1,8 @@
 from spark_rapids_jni_tpu.ops.row_layout import RowLayout, compute_row_layout  # noqa: F401
 from spark_rapids_jni_tpu.ops.cast_string import (  # noqa: F401
     cast_int_to_string,
+    cast_string_to_decimal128,
+    cast_string_to_float,
     cast_string_to_int,
 )
 from spark_rapids_jni_tpu.ops.row_conversion import (  # noqa: F401
@@ -18,7 +20,8 @@ from spark_rapids_jni_tpu.ops.zorder import (  # noqa: F401
 )
 from spark_rapids_jni_tpu.ops.decimal import (  # noqa: F401
     add_decimal128, decimal128, decimal128_from_ints, decimal128_to_ints,
-    mul_decimal128, sub_decimal128,
+    decimal128_to_strings, div_decimal128, mul_decimal128,
+    rescale_decimal128, sub_decimal128,
 )
 from spark_rapids_jni_tpu.ops import membership  # noqa: F401
 from spark_rapids_jni_tpu.ops.get_json import get_json_object  # noqa: F401

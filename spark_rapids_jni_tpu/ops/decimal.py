@@ -114,6 +114,85 @@ def _gt_limbs_const(a: jnp.ndarray, bound: Tuple[int, ...]) -> jnp.ndarray:
     return gt
 
 
+def _sub_limbs(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Unsigned a - b over matching limb counts (mod 2^(32L))."""
+    L = a.shape[1]
+    outs = []
+    borrow = jnp.zeros(a.shape[:1], jnp.uint32)
+    for k in range(L):
+        d = a[:, k] - b[:, k]
+        b1 = (a[:, k] < b[:, k]).astype(jnp.uint32)
+        d2 = d - borrow
+        b2 = (d < borrow).astype(jnp.uint32)
+        outs.append(d2)
+        borrow = b1 + b2
+    return jnp.stack(outs, axis=1)
+
+
+def _geq_limbs(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Unsigned a >= b (same limb count), MSB-first scan."""
+    ge = jnp.ones(a.shape[:1], jnp.bool_)
+    decided = jnp.zeros(a.shape[:1], jnp.bool_)
+    for k in range(a.shape[1] - 1, -1, -1):
+        ge = jnp.where(~decided & (a[:, k] != b[:, k]),
+                       a[:, k] > b[:, k], ge)
+        decided = decided | (a[:, k] != b[:, k])
+    return ge
+
+
+def _divmod_limbs(num: jnp.ndarray, den: jnp.ndarray,
+                  num_bits: int = None):
+    """Vectorized unsigned long division: [n, Ln] // [n, Ld].
+
+    Restoring binary division, MSB-first — ``num_bits`` iterations of
+    fully static [n]-lane work under ``lax.fori_loop`` (TPU-friendly: no
+    data-dependent control flow; every row runs the same schedule).
+    Divisor rows equal to zero are UNDEFINED (every trial subtraction
+    "succeeds", yielding an all-ones quotient): callers MUST mask
+    div-by-zero rows upstream, substituting a nonzero divisor, as
+    ``div_decimal128`` does.  Returns (quot [n, Ln], rem [n, Ld])."""
+    n, Ln = num.shape
+    Ld = den.shape[1]
+    bits = num_bits if num_bits is not None else 32 * Ln
+    Lr = Ld + 1
+    den_ext = jnp.concatenate(
+        [den, jnp.zeros((n, Lr - Ld), jnp.uint32)], axis=1)
+    lanesQ = jnp.arange(Ln, dtype=jnp.int32)[None, :]
+
+    def body(j, state):
+        q, rem = state
+        i = bits - 1 - j
+        limb = i // 32
+        sh = jnp.uint32(i % 32)
+        bit = (jax.lax.dynamic_index_in_dim(
+            num, limb, axis=1, keepdims=False) >> sh) & 1
+        # rem = (rem << 1) | bit
+        hi_bits = rem >> 31
+        rem = rem << 1
+        rem = rem.at[:, 1:].set(rem[:, 1:] | hi_bits[:, :-1])
+        rem = rem.at[:, 0].set(rem[:, 0] | bit)
+        ge = _geq_limbs(rem, den_ext)
+        rem = jnp.where(ge[:, None], _sub_limbs(rem, den_ext), rem)
+        qbit = (ge.astype(jnp.uint32) << sh)[:, None]
+        q = jnp.where(lanesQ == limb, q | qbit, q)
+        return q, rem
+
+    q0 = jnp.zeros((n, Ln), jnp.uint32)
+    r0 = jnp.zeros((n, Lr), jnp.uint32)
+    q, rem = jax.lax.fori_loop(0, bits, body, (q0, r0))
+    return q, rem[:, :Ld]
+
+
+def _pow10_limbs(k: int, L: int) -> Tuple[int, ...]:
+    v = 10 ** k
+    return tuple((v >> (32 * j)) & 0xFFFFFFFF for j in range(L))
+
+
+def _const_limbs(limbs: Tuple[int, ...], n: int) -> jnp.ndarray:
+    return jnp.broadcast_to(
+        jnp.asarray(np.array(limbs, np.uint32))[None, :], (n, len(limbs)))
+
+
 def _mul_limbs_wide(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Unsigned [n, 4] x [n, 4] -> exact [n, 8] product via 16-bit
     half-limbs (uint32 lane multiplies keep only 32 bits, so partial
@@ -180,6 +259,123 @@ def sub_decimal128(a: Column, b: Column):
     scale = _check_scales(a, b)
     nb = Column(b.dtype, _neg_limbs(b.data), b.validity)
     return add_decimal128(a, nb)
+
+
+def rescale_decimal128(col: Column, new_scale: int):
+    """Change a decimal128 column's scale with Spark semantics: scaling
+    up multiplies the unscaled value by 10^d (overflow-checked); scaling
+    down divides by 10^d rounding HALF_UP on the magnitude (Spark's
+    ``Decimal.changePrecision`` / the reference lineage's
+    ``decimal_utils`` rescale).  Returns (column at new_scale, overflow
+    mask); overflow rows are null."""
+    if col.dtype.kind != "decimal128":
+        raise ValueError("decimal128 operand required")
+    n = col.data.shape[0]
+    d = new_scale - col.dtype.scale
+    mag, neg = _abs_limbs(col.data)
+    if d == 0:
+        return (Column(decimal128(new_scale), col.data, col.validity),
+                jnp.zeros((n,), jnp.bool_))
+    if d > 0:
+        if d > MAX_PRECISION:
+            nonzero = jnp.any(mag != 0, axis=1)
+            res = jnp.zeros_like(mag)
+            overflow = nonzero
+        else:
+            wide = _mul_limbs_wide(mag, _const_limbs(
+                _pow10_limbs(d, 4), n))
+            res = wide[:, :4]
+            overflow = jnp.any(wide[:, 4:] != 0, axis=1) \
+                | _gt_limbs_const(res, _BOUND_LIMBS)
+    else:
+        k = -d
+        if k > MAX_PRECISION:
+            # magnitude < 10^38 <= half of any 10^k here: rounds to zero
+            res = jnp.zeros_like(mag)
+            overflow = jnp.zeros((n,), jnp.bool_)
+        else:
+            # HALF_UP: (m + 10^k/2) // 10^k over a 5-limb numerator
+            num5 = jnp.concatenate(
+                [mag, jnp.zeros((n, 1), jnp.uint32)], axis=1)
+            half = _const_limbs(
+                tuple((5 * 10 ** (k - 1) >> (32 * j)) & 0xFFFFFFFF
+                      for j in range(5)), n)
+            num5 = _add_limbs(num5, half)
+            q, _ = _divmod_limbs(num5, _const_limbs(
+                _pow10_limbs(k, 5), n), num_bits=160)
+            res = q[:, :4]
+            overflow = jnp.zeros((n,), jnp.bool_)  # division shrinks
+    signed = jnp.where(neg[:, None], _neg_limbs(res), res)
+    valid = col.valid_bools() & ~overflow
+    return (Column(decimal128(new_scale), signed, pack_bools(valid)),
+            overflow & col.valid_bools())
+
+
+def div_decimal128(a: Column, b: Column, result_scale: int = 6):
+    """Checked a / b with Spark divide semantics: the quotient is
+    computed exactly at ``result_scale`` with HALF_UP rounding on the
+    magnitude (Spark ``Decimal./`` under ``DECIMAL(38, s)`` operands;
+    result_scale defaults to Spark's division minimum of 6).
+
+    Division by zero and magnitude overflow set the overflow mask and
+    null the row (the caller raises under ANSI).  Requires
+    ``result_scale - a.scale + b.scale`` in [0, 38] — the exact-numerator
+    window 256-bit limbs can hold."""
+    if a.dtype.kind != "decimal128" or b.dtype.kind != "decimal128":
+        raise ValueError("decimal128 operands required")
+    e = result_scale - a.dtype.scale + b.dtype.scale
+    if not 0 <= e <= MAX_PRECISION:
+        raise ValueError(
+            f"unsupported scale shift {e} (result_scale {result_scale} "
+            f"with operand scales {a.dtype.scale}, {b.dtype.scale})")
+    n = a.data.shape[0]
+    aa, na = _abs_limbs(a.data)
+    bb, nb = _abs_limbs(b.data)
+    div_zero = jnp.all(bb == 0, axis=1)
+    # numerator = |a| * 10^e exactly (<= 10^76 < 2^256)
+    num8 = _mul_limbs_wide(aa, _const_limbs(_pow10_limbs(e, 4), n))
+    safe_den = jnp.where(div_zero[:, None],
+                         jnp.concatenate(
+                             [jnp.ones((n, 1), jnp.uint32),
+                              jnp.zeros((n, 3), jnp.uint32)], axis=1),
+                         bb)
+    q8, rem = _divmod_limbs(num8, safe_den, num_bits=256)
+    # HALF_UP: round away from zero when 2*rem >= divisor
+    rem5 = jnp.concatenate([rem, jnp.zeros((n, 1), jnp.uint32)], axis=1)
+    twice = _add_limbs(rem5, rem5)
+    den5 = jnp.concatenate([safe_den, jnp.zeros((n, 1), jnp.uint32)],
+                           axis=1)
+    round_up = _geq_limbs(twice, den5)
+    one = jnp.concatenate([jnp.ones((n, 1), jnp.uint32),
+                           jnp.zeros((n, 7), jnp.uint32)], axis=1)
+    q8 = jnp.where(round_up[:, None], _add_limbs(q8, one), q8)
+    overflow = div_zero | jnp.any(q8[:, 4:] != 0, axis=1) \
+        | _gt_limbs_const(q8[:, :4], _BOUND_LIMBS)
+    neg = na != nb
+    signed = jnp.where(neg[:, None], _neg_limbs(q8[:, :4]), q8[:, :4])
+    valid = a.valid_bools() & b.valid_bools() & ~overflow
+    return (Column(decimal128(result_scale), signed, pack_bools(valid)),
+            overflow & a.valid_bools() & b.valid_bools())
+
+
+def decimal128_to_strings(col: Column) -> List:
+    """Decimal column -> decimal strings (host boundary, like
+    ``compact_rows_host``): fixed-point rendering at the column's scale;
+    None for null rows (Spark ``Decimal.toString``)."""
+    scale = col.dtype.scale
+    out = []
+    for v in decimal128_to_ints(col):
+        if v is None:
+            out.append(None)
+            continue
+        sign = "-" if v < 0 else ""
+        m = abs(v)
+        if scale <= 0:
+            out.append(sign + str(m * 10 ** (-scale)))
+            continue
+        s = str(m).rjust(scale + 1, "0")
+        out.append(f"{sign}{s[:-scale]}.{s[-scale:]}")
+    return out
 
 
 def mul_decimal128(a: Column, b: Column):

@@ -2,7 +2,8 @@
 
 Capability parity with the reference lineage's ``get_json_object`` kernel
 (Spark's ``GetJsonObject`` expression; not in the mounted snapshot — built
-to the Spark contract directly) for object-key paths ``$.k1.k2...``.
+to the Spark contract directly) for object-key and array-subscript paths
+(``$.k1.k2``, ``$.a[0].b``, ``$[1][2]``).
 
 TPU-native design: the JSON tokenizer is a character automaton run as one
 ``lax.scan`` over the padded char axis — each scan step advances every
@@ -38,42 +39,66 @@ from spark_rapids_jni_tpu.table import Column, STRING, pack_bools
 from spark_rapids_jni_tpu.utils.tracing import func_range
 
 
-def _parse_path(path: str) -> List[bytes]:
-    """``$.a.b`` -> [b"a", b"b"].  Object keys only (array subscripts are
-    not supported in this version; Spark returns null for unsupported
-    paths rather than erroring, but we raise to avoid silent nulls)."""
+def _parse_path(path: str):
+    """``$.a[0].b`` -> [b"a", 0, b"b"]: bytes for object keys, int for
+    array subscripts (``$[1].x`` and chained ``[i][j]`` work too).
+    ``[*]`` wildcards are not supported; we raise rather than silently
+    null (Spark nulls unsupported paths)."""
+    import re
     if not path.startswith("$"):
         raise ValueError(f"JSON path must start with '$': {path!r}")
     rest = path[1:]
     if not rest:
         raise ValueError("the identity path '$' is not supported")
-    segs: List[bytes] = []
-    for part in rest.split("."):
-        if part == "" and not segs:
-            continue
-        if part == "" or "[" in part or "]" in part:
-            raise ValueError(f"unsupported JSON path segment {part!r} "
-                             "(object keys only)")
-        segs.append(part.encode("utf-8"))
+    segs: List = []
+    pos = 0
+    tok = re.compile(r"\.([^.\[\]]+)|\[(\d+)\]")
+    while pos < len(rest):
+        m = tok.match(rest, pos)
+        if not m:
+            raise ValueError(f"unsupported JSON path syntax at "
+                             f"{rest[pos:]!r} in {path!r} "
+                             "(keys and [integer] subscripts only)")
+        if m.group(1) is not None:
+            segs.append(m.group(1).encode("utf-8"))
+        else:
+            segs.append(int(m.group(2)))
+        pos = m.end()
     if not segs:
         raise ValueError(f"empty JSON path: {path!r}")
     return segs
 
 
-def _scan_automaton(ch: jnp.ndarray, segs: Tuple[bytes, ...],
+def _scan_automaton(ch: jnp.ndarray, segs: Tuple,
                     max_key_len: int):
     """Run the tokenizer over ``ch [n, W]``; returns per-row capture
-    (start, end, found, bad) positions into the padded window."""
+    (start, end, found, bad) positions into the padded window.
+
+    Segments are bytes (object key) or int (array subscript).  Index
+    levels ride the same frontier machinery: entering the frontier array
+    arms an element counter in the carry; commas at the array's depth
+    advance it, and when it reaches the subscript the next element value
+    is treated exactly like a matched key's value (descend / capture /
+    dead-end by the next segment's type)."""
     n, W = ch.shape
     L = len(segs)
-    # static per-level key byte matrix [L, max_key_len] + lengths
+    # static per-level key byte matrix [L, max_key_len] + lengths, plus
+    # index-segment markers/targets (key levels get len-0 dummy keys)
     seg_bytes = np.zeros((L, max_key_len), np.uint8)
     seg_lens = np.zeros((L,), np.int32)
+    seg_isidx = np.zeros((L,), np.int32)
+    seg_tgt = np.zeros((L,), np.int32)
     for i, s in enumerate(segs):
-        seg_bytes[i, :len(s)] = np.frombuffer(s, np.uint8)
-        seg_lens[i] = len(s)
+        if isinstance(s, int):
+            seg_isidx[i] = 1
+            seg_tgt[i] = s
+        else:
+            seg_bytes[i, :len(s)] = np.frombuffer(s, np.uint8)
+            seg_lens[i] = len(s)
     segb = jnp.asarray(seg_bytes)
     segl = jnp.asarray(seg_lens)
+    segix = jnp.asarray(seg_isidx)
+    segtg = jnp.asarray(seg_tgt)
 
     i32 = jnp.int32
     z = jnp.zeros((n,), i32)
@@ -87,6 +112,8 @@ def _scan_automaton(ch: jnp.ndarray, segs: Tuple[bytes, ...],
         await_colon=z,        # key closed, expecting ':'
         capturing=z,          # inside the target value
         cap_depth=z,          # depth at capture start
+        elem_count=z,         # elements passed in the frontier array
+        elem_pending=z,       # target element's value starts next
         start=z - 1, end=z - 1,
         found=z, bad=z,
     )
@@ -155,37 +182,63 @@ def _scan_automaton(ch: jnp.ndarray, segs: Tuple[bytes, ...],
         await_colon = jnp.where(saw_colon, 0, await_colon)
         pending = c.get("pending", z) | jnp.where(saw_colon, 1, 0)
         # first non-ws char after the colon starts the value
-        value_starts = (pending == 1) & ~is_ws \
+        key_value_starts = (pending == 1) & ~is_ws \
             & ~(jnp.where(saw_colon, 1, 0) == 1)
         # (the colon char itself is consumed this step; value chars begin
         # on a LATER step, so exclude the colon step)
+
+        # --- element entry at an index-segment frontier array ---
+        fr_is_idx = segix[seg_idx] == 1
+        elem_value_starts = (c["elem_pending"] == 1) & fr_is_idx \
+            & outside & ~is_ws & ~is_comma & ~is_close \
+            & (depth == c["matched"] + 1) & (c["capturing"] == 0) \
+            & (c["found"] == 0)
+        value_starts = key_value_starts | elem_value_starts
+
         matched = c["matched"]
         is_last = matched == (L - 1)
-        # intermediate segment: the value must be an object to descend
-        descend = value_starts & ~is_last & (xs == ord("{")) \
+        # intermediate segment: the value must be the container kind the
+        # NEXT segment needs ('{' before a key, '[' before a subscript)
+        next_is_idx = segix[jnp.clip(matched + 1, 0, L - 1)] == 1
+        expected_open = jnp.where(next_is_idx, i32(ord("[")),
+                                  i32(ord("{")))
+        descend = value_starts & ~is_last & (xs == expected_open) \
             & (c["capturing"] == 0) & (c["found"] == 0)
-        deadend = value_starts & ~is_last & (xs != ord("{")) \
+        deadend = value_starts & ~is_last & (xs != expected_open) \
             & (c["capturing"] == 0) & (c["found"] == 0)
         start_cap = value_starts & is_last & (c["capturing"] == 0) \
             & (c["found"] == 0)
         matched = matched + jnp.where(descend, 1, 0)
-        # a matched intermediate object closing retracts the frontier —
-        # otherwise sibling subtrees would match the remaining segments
-        unmatch = outside & is_close & (c["capturing"] == 0) \
+        # a descended-into container closing without a find exhausts the
+        # committed search space: Spark's streaming parser binds to the
+        # FIRST matching key and never backtracks to later duplicates,
+        # so the row is null from here on (bad), not re-matched
+        exhausted = outside & is_close & (c["capturing"] == 0) \
             & (c["matched"] > 0) & (new_depth == c["matched"]) \
             & (c["found"] == 0)
-        matched = matched - jnp.where(unmatch, 1, 0)
         pending2 = jnp.where(value_starts | deadend, 0, pending)
-        bad = c["bad"] | jnp.where(deadend, 1, 0)
+        bad = c["bad"] | jnp.where(deadend | exhausted, 1, 0)
+
+        # element counter: commas at the frontier array's depth advance
+        # it; the value after comma #k is element k
+        elem_comma = outside & is_comma & fr_is_idx \
+            & (depth == c["matched"] + 1) & (c["capturing"] == 0) \
+            & (c["found"] == 0)
+        tgt = segtg[seg_idx]
+        elem_count = c["elem_count"] + jnp.where(elem_comma, 1, 0)
+        elem_pending = jnp.where(
+            elem_comma, (elem_count == tgt).astype(i32),
+            jnp.where(elem_value_starts, 0, c["elem_pending"]))
 
         # key-position tracking for the (possibly updated) frontier: '{'
         # opening the frontier object or ',' inside it puts us in key
         # position; anything else that is not whitespace leaves it
         new_frontier = matched + 1
+        new_fr_idx = segix[jnp.clip(matched, 0, L - 1)] == 1
         opens_frontier = outside & is_open & (xs == ord("{")) \
-            & (new_depth == new_frontier)
+            & (new_depth == new_frontier) & ~new_fr_idx
         comma_frontier = outside & is_comma & (depth == new_frontier) \
-            & (c["capturing"] == 0)
+            & (c["capturing"] == 0) & ~new_fr_idx
         expect_key = c["expect_key"]
         expect_key = jnp.where(opens_frontier | comma_frontier, 1,
                                jnp.where(key_opening
@@ -193,6 +246,16 @@ def _scan_automaton(ch: jnp.ndarray, segs: Tuple[bytes, ...],
                                             & ~eff_q & ~is_open
                                             & ~is_comma),
                                          0, expect_key))
+
+        # entering the frontier array (a descend's '[', or the root '['
+        # when the path starts with a subscript) arms the counter
+        arr_open = outside & (xs == ord("[")) & new_fr_idx \
+            & (new_depth == matched + 1) & (c["capturing"] == 0) \
+            & (c["found"] == 0)
+        new_tgt = segtg[jnp.clip(matched, 0, L - 1)]
+        elem_count = jnp.where(arr_open, 0, elem_count)
+        elem_pending = jnp.where(arr_open, (new_tgt == 0).astype(i32),
+                                 elem_pending)
 
         capturing = c["capturing"]
         start = jnp.where(start_cap, pos, c["start"])
@@ -236,6 +299,7 @@ def _scan_automaton(ch: jnp.ndarray, segs: Tuple[bytes, ...],
                    key_ok=key_ok, await_colon=await_colon,
                    capturing=capturing, cap_depth=cap_depth,
                    cap_is_str=cap_is_str, expect_key=expect_key,
+                   elem_count=elem_count, elem_pending=elem_pending,
                    start=start, end=end, found=found, bad=bad,
                    pending=pending2)
         return out, None
@@ -252,7 +316,8 @@ def _scan_automaton(ch: jnp.ndarray, segs: Tuple[bytes, ...],
 @func_range()
 def get_json_object(col: Column, path: str,
                     max_str_len: Optional[int] = None) -> Column:
-    """Spark ``get_json_object(json, path)`` for object-key paths.
+    """Spark ``get_json_object(json, path)`` for object-key and
+    ``[i]`` array-subscript paths.
 
     Returns a dense-padded string column; null where the path is missing
     or the JSON is malformed along the scanned prefix."""
@@ -268,7 +333,7 @@ def get_json_object(col: Column, path: str,
         W = ((int(lens.max()) if lens.size else 0) + 3) // 4 * 4
     ch = col.chars_window(W)
     lens = col.str_lens()
-    mkl = max((len(s) for s in segs), default=1)
+    mkl = max((len(s) for s in segs if isinstance(s, bytes)), default=1)
     st = _scan_automaton(ch, segs, mkl)
 
     start, end = st["start"], st["end"]
@@ -345,7 +410,8 @@ def _host_fixup(result: Column, src: Column, path: str,
     cannot finish: escaped string values (decode) and container values
     (Spark-normalized re-serialization).  Patches chars2d/lens in place;
     the matrix widens if a normalized container outgrows the window."""
-    segs = [s.decode() for s in _parse_path(path)]
+    segs = [s.decode() if isinstance(s, bytes) else s
+            for s in _parse_path(path)]
     mat = np.array(np.asarray(result.chars2d))
     offs = np.asarray(result.offsets)
     lens = (offs[1:] - offs[:-1]).astype(np.int64).copy()
@@ -363,14 +429,31 @@ def _host_fixup(result: Column, src: Column, path: str,
         chars = np.asarray(src.chars)
         src_text = {int(r): bytes(chars[o[r]:o[r + 1]]).decode(
             "utf-8", "replace") for r in flagged}
+    # streaming-compatible decode: FIRST occurrence wins for duplicate
+    # keys (matching the device automaton and Spark's streaming parser),
+    # and a valid JSON prefix with a malformed tail still extracts
+    # (raw_decode stops at the first complete value)
+    def _first_wins(pairs):
+        d = {}
+        for k, v in pairs:
+            if k not in d:
+                d[k] = v
+        return d
+
+    decoder = json.JSONDecoder(object_pairs_hook=_first_wins)
     patches = {}
     for r in flagged:
         try:
-            obj = json.loads(src_text[int(r)])
+            obj, _ = decoder.raw_decode(src_text[int(r)].lstrip())
             for s in segs:
-                if not isinstance(obj, dict):
-                    raise KeyError(s)
-                obj = obj[s]
+                if isinstance(s, int):
+                    if not isinstance(obj, list) or s >= len(obj):
+                        raise KeyError(s)
+                    obj = obj[s]
+                else:
+                    if not isinstance(obj, dict):
+                        raise KeyError(s)
+                    obj = obj[s]
             if isinstance(obj, str):
                 text = obj
             else:

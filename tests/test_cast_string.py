@@ -180,3 +180,145 @@ def test_cast_rejects_decimal_dtypes():
     col = Column.from_numpy(np.array([123], np.int64), decimal64(scale=2))
     with pytest.raises(ValueError, match="signed integer"):
         cast_int_to_string(col)
+
+
+# ---------------------------------------------------------------------------
+# string -> float
+# ---------------------------------------------------------------------------
+
+FLOAT_CASES = [
+    "1.5", "-2.25", "+3", "0", ".5", "-.5", "5.", "1e3", "1E-2",
+    "2.5e+10", "  7.125  ", "\t-8\n", "1.7976931348623157e308",
+    "4.9e-324", "123456789.123456789", "1.5f", "2.5D", "3d",
+    "inf", "-inf", "+inf", "Infinity", "-INFINITY", "NaN", "nan",
+    "", "  ", "abc", "1.2.3", "1e", "e5", "++1", "1,5", ".", "-",
+    "0x10", "1 2", "--5", "1e+-3", "9" * 50, "1." + "0" * 60 + "5",
+]
+
+
+def _oracle_float(s):
+    t = s.strip(" \t\r\n\x0b\x0c\x00")
+    # python's strip of <=0x20 analogue
+    i, j = 0, len(s)
+    while i < j and ord(s[i]) <= 0x20:
+        i += 1
+    while j > i and ord(s[j - 1]) <= 0x20:
+        j -= 1
+    t = s[i:j]
+    if not t:
+        return None
+    low = t.lower()
+    body = low[1:] if low[:1] in "+-" else low
+    if body in ("inf", "infinity"):
+        return float("-inf") if low[0] == "-" else float("inf")
+    if low in ("nan", "+nan"):
+        return float("nan")
+    if body[-1:] in ("f", "d"):
+        t = t[:-1]
+    import re
+    if not re.fullmatch(r"[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?", t):
+        return None
+    return float(t)
+
+
+@pytest.mark.parametrize("dt", ["float64", "float32"])
+def test_cast_string_to_float_matches_oracle(dt):
+    from spark_rapids_jni_tpu import FLOAT32, FLOAT64
+    from spark_rapids_jni_tpu.ops import cast_string_to_float
+    target = FLOAT64 if dt == "float64" else FLOAT32
+    col = Column.strings(FLOAT_CASES)
+    res, err = cast_string_to_float(col, target)
+    got = res.to_pylist()
+    err = np.asarray(err)
+    for i, s in enumerate(FLOAT_CASES):
+        want = _oracle_float(s)
+        if want is None:
+            assert got[i] is None and err[i], repr(s)
+            continue
+        assert not err[i], repr(s)
+        if dt == "float32":
+            want = float(np.float32(want))
+        if want != want:  # nan
+            assert got[i] != got[i], repr(s)
+        else:
+            assert got[i] == want, (repr(s), got[i], want)
+
+
+def test_cast_string_to_float_nulls_and_ansi():
+    from spark_rapids_jni_tpu import FLOAT64
+    from spark_rapids_jni_tpu.ops import cast_string_to_float
+    col = Column.strings(["1.5", None, "bad"])
+    res, err = cast_string_to_float(col, FLOAT64)
+    assert res.to_pylist() == [1.5, None, None]
+    assert np.asarray(err).tolist() == [False, False, True]
+    with pytest.raises(ValueError, match="ANSI"):
+        cast_string_to_float(col, FLOAT64, ansi=True)
+
+
+# ---------------------------------------------------------------------------
+# string -> decimal128
+# ---------------------------------------------------------------------------
+
+DEC_CASES = [
+    "0", "1", "-1", "123.45", "-123.45", "0.005", "-0.005", "1.005",
+    "2.675", "  42  ", "+7.1", "1e2", "1.5e3", "-2.5e-3", "123e-2",
+    ".5", "5.", "99999999999999999999999999999999999999",
+    "-99999999999999999999999999999999999999",
+    "1" + "0" * 38,          # overflow
+    "0.00000000000000000000000000000000000001",   # rounds at scale
+    "", "abc", "1.2.3", "--1", "1e", "12x",
+    "9" * 60,                 # punted (window) + overflow
+    "0" * 45 + "7.25",        # punted, valid
+]
+
+
+def _oracle_decimal(s, scale):
+    import re
+    i, j = 0, len(s)
+    while i < j and ord(s[i]) <= 0x20:
+        i += 1
+    while j > i and ord(s[j - 1]) <= 0x20:
+        j -= 1
+    t = s[i:j]
+    m = re.fullmatch(r"([+-]?)(\d*)(?:\.(\d*))?(?:[eE]([+-]?\d+))?", t)
+    if not m or not (m.group(2) or m.group(3)):
+        return None
+    sign = -1 if m.group(1) == "-" else 1
+    unscaled = int((m.group(2) or "0") + (m.group(3) or ""))
+    shift = scale - len(m.group(3) or "") + int(m.group(4) or 0)
+    if shift >= 0:
+        v = unscaled * 10 ** shift
+    else:
+        d = 10 ** (-shift)
+        q, r = divmod(unscaled, d)
+        v = q + (1 if 2 * r >= d else 0)
+    if v > 10 ** 38 - 1:
+        return None
+    return sign * v
+
+
+@pytest.mark.parametrize("scale", [0, 2, 6, 38])
+def test_cast_string_to_decimal_matches_oracle(scale):
+    from spark_rapids_jni_tpu.ops import (
+        cast_string_to_decimal128, decimal128_to_ints)
+    col = Column.strings(DEC_CASES)
+    res, err = cast_string_to_decimal128(col, scale)
+    got = decimal128_to_ints(res)
+    err = np.asarray(err)
+    assert res.dtype.scale == scale
+    for i, s in enumerate(DEC_CASES):
+        want = _oracle_decimal(s, scale)
+        if want is None:
+            assert got[i] is None and err[i], (repr(s), scale, got[i])
+        else:
+            assert not err[i], (repr(s), scale)
+            assert got[i] == want, (repr(s), scale, got[i], want)
+
+
+def test_cast_string_to_decimal_ansi_and_nulls():
+    from spark_rapids_jni_tpu.ops import cast_string_to_decimal128
+    col = Column.strings(["1.5", None, "x"])
+    res, err = cast_string_to_decimal128(col, 2)
+    assert np.asarray(err).tolist() == [False, False, True]
+    with pytest.raises(ValueError, match="ANSI"):
+        cast_string_to_decimal128(col, 2, ansi=True)

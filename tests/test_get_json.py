@@ -121,8 +121,9 @@ def test_nulls_propagate_and_empty():
 
 def test_arrow_input_and_bad_paths():
     check(['{"a": 3}'], "$.a", padded=False)
-    with pytest.raises(ValueError):
-        get_json_object(Column.strings_padded(['{}']), "$.a[0]")
+    # array subscripts are supported now; on an empty object they miss
+    assert get_json_object(Column.strings_padded(['{}']),
+                           "$.a[0]").to_pylist() == [None]
     with pytest.raises(ValueError):
         get_json_object(Column.strings_padded(['{}']), "a.b")
     with pytest.raises(ValueError):
@@ -200,3 +201,114 @@ def test_traced_caller_degrades_to_null():
     assert np.asarray(valid).tolist() == [False, False, True]
     got = bytes(np.asarray(chars2d)[2][:1]).decode()
     assert got == "5"
+
+
+# ---------------------------------------------------------------------------
+# array subscripts
+# ---------------------------------------------------------------------------
+
+def _spark_oracle(doc, segs):
+    """Reference semantics via Python json (first-match object keys)."""
+    try:
+        obj = json.loads(doc)
+    except Exception:
+        return None
+    for s in segs:
+        if isinstance(s, int):
+            if not isinstance(obj, list) or s >= len(obj):
+                return None
+            obj = obj[s]
+        else:
+            if not isinstance(obj, dict) or s not in obj:
+                return None
+            obj = obj[s]
+    if isinstance(obj, str):
+        return obj
+    return json.dumps(obj, separators=(",", ":"))
+
+
+@pytest.mark.parametrize("padded", [False, True])
+def test_array_subscripts_basic(padded):
+    docs = [
+        '{"a": [1, 2, 3]}',
+        '{"a": [10, 20, 30]}',
+        '{"a": []}',
+        '{"a": [1]}',
+        '{"a": {"b": 1}}',          # not an array -> null
+        '{"a": ["x", "y"]}',
+        '{"a": [[1, 2], [3, 4]]}',
+        '{"a": [{"b": 5}, {"b": 6}]}',
+        None,
+    ]
+    col = (Column.strings_padded(docs) if padded
+           else Column.strings(docs))
+    got = get_json_object(col, "$.a[1]").to_pylist()
+    want = [None if d is None else _spark_oracle(d, ["a", 1])
+            for d in docs]
+    assert got == want, (got, want)
+
+
+def test_array_subscript_then_key():
+    docs = [
+        '{"a": [{"b": 1}, {"b": 2}, {"b": 3}]}',
+        '{"a": [{"x": 1}, {"b": 22}]}',
+        '{"a": [{"b": 1}]}',              # index 1 out of range
+        '{"a": [5, {"b": 7}]}',
+        '{"a": "nope"}',
+    ]
+    col = Column.strings(docs)
+    got = get_json_object(col, "$.a[1].b").to_pylist()
+    want = [_spark_oracle(d, ["a", 1, "b"]) for d in docs]
+    assert got == want, (got, want)
+
+
+def test_root_array_and_chained_subscripts():
+    docs = [
+        '[10, 20, 30]',
+        '[[1, 2], [3, 4]]',
+        '[{"k": "v"}, {"k": "w"}]',
+        '{"not": "array"}',
+        '[5]',
+    ]
+    col = Column.strings(docs)
+    got0 = get_json_object(col, "$[0]").to_pylist()
+    assert got0 == [_spark_oracle(d, [0]) for d in docs]
+    got11 = get_json_object(col, "$[1][1]").to_pylist()
+    assert got11 == [_spark_oracle(d, [1, 1]) for d in docs]
+    gotk = get_json_object(col, "$[1].k").to_pylist()
+    assert gotk == [_spark_oracle(d, [1, "k"]) for d in docs]
+
+
+def test_array_elements_with_tricky_contents():
+    docs = [
+        '{"a": ["x,y", "z"]}',            # comma inside string element
+        '{"a": [",", "]", "["]}',         # brackets/commas as strings
+        '{"a": [ 1 , 2 , 3 ]}',           # whitespace everywhere
+        '{"a": [[1, [2, 5]], 9]}',        # nested arrays skipped whole
+        '{"b": [9, 9], "a": [1, 2]}',     # sibling array first
+        '{"a": [true, false, null]}',
+        # (numbers written canonically: the oracle round-trips through
+        # json.loads/dumps, while the kernel — like Spark — returns the
+        # raw scalar text, e.g. '1.5e3' stays '1.5e3')
+        '{"a": [1500.0, -2]}',
+    ]
+    col = Column.strings(docs)
+    for pth, segs in [("$.a[0]", ["a", 0]), ("$.a[1]", ["a", 1]),
+                      ("$.a[2]", ["a", 2])]:
+        got = get_json_object(col, pth).to_pylist()
+        want = [_spark_oracle(d, segs) for d in docs]
+        assert got == want, (pth, got, want)
+
+
+def test_subscript_path_parse_errors():
+    col = Column.strings(['{"a": [1]}'])
+    for bad in ("$.a[*]", "$.a[", "$.a[x]", "$.a[-1]", "$.a[0", "$a["):
+        with pytest.raises(ValueError):
+            get_json_object(col, bad)
+
+
+def test_big_index_and_many_elements():
+    docs = ['{"a": [%s]}' % ", ".join(str(i) for i in range(30))]
+    col = Column.strings(docs)
+    assert get_json_object(col, "$.a[29]").to_pylist() == ["29"]
+    assert get_json_object(col, "$.a[30]").to_pylist() == [None]

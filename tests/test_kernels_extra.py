@@ -188,3 +188,101 @@ def test_membership_distinct_count_with_leading_nulls():
                             valid=np.array([0, 0, 1, 1], bool))
     filt = membership.build([col])
     assert int(np.asarray(filt.num_distinct)) == 2
+
+
+# ---------------------------------------------------------------------------
+# decimal128 divide + rescale (round-trip vs Python exact arithmetic)
+# ---------------------------------------------------------------------------
+
+def _half_up_div(num: int, den: int) -> int:
+    """Round-half-up (away from zero) division of Python ints."""
+    sign = -1 if (num < 0) != (den < 0) else 1
+    n, d = abs(num), abs(den)
+    q, r = divmod(n, d)
+    if 2 * r >= d:
+        q += 1
+    return sign * q
+
+
+def test_decimal128_rescale_matches_python(rng):
+    from spark_rapids_jni_tpu.ops import (
+        decimal128_from_ints, decimal128_to_ints, rescale_decimal128)
+    vals = [0, 1, -1, 5, -5, 44, 45, 54, 55, -45, -55, 12345678901234567,
+            -98765432109876543, 10 ** 37, -(10 ** 37), 10 ** 38 - 1,
+            -(10 ** 38 - 1)] + [int(x) for x in
+                                rng.integers(-10 ** 15, 10 ** 15, 20)]
+    for old_s, new_s in [(2, 2), (2, 6), (6, 2), (0, 4), (4, 0),
+                        (2, 0), (0, 38), (38, 0)]:
+        col = decimal128_from_ints(vals, old_s)
+        res, ovf = rescale_decimal128(col, new_s)
+        got = decimal128_to_ints(res)
+        ovf = np.asarray(ovf)
+        d = new_s - old_s
+        for i, v in enumerate(vals):
+            if d >= 0:
+                exact = v * 10 ** d
+                if abs(exact) > 10 ** 38 - 1:
+                    assert ovf[i] and got[i] is None, (old_s, new_s, v)
+                    continue
+            else:
+                exact = _half_up_div(v, 10 ** (-d))
+            assert not ovf[i], (old_s, new_s, v)
+            assert got[i] == exact, (old_s, new_s, v, got[i], exact)
+
+
+def test_decimal128_div_matches_python(rng):
+    from spark_rapids_jni_tpu.ops import (
+        decimal128_from_ints, decimal128_to_ints, div_decimal128)
+    a_vals = [1, -1, 100, 7, -7, 10 ** 20, -(10 ** 20), 355,
+              10 ** 38 - 1] + [int(x) for x in
+                               rng.integers(-10 ** 12, 10 ** 12, 15)]
+    b_vals = [3, 7, -3, 9, 11, 113, -113, 10 ** 10, 2] + [
+        int(x) or 1 for x in rng.integers(-10 ** 6, 10 ** 6, 15)]
+    sa, sb, rs = 2, 4, 6
+    a = decimal128_from_ints(a_vals, sa)
+    b = decimal128_from_ints(b_vals, sb)
+    res, ovf = div_decimal128(a, b, rs)
+    got = decimal128_to_ints(res)
+    ovf = np.asarray(ovf)
+    e = rs - sa + sb
+    for i, (x, y) in enumerate(zip(a_vals, b_vals)):
+        exact = _half_up_div(x * 10 ** e, y)
+        if abs(exact) > 10 ** 38 - 1:
+            assert ovf[i] and got[i] is None, (x, y)
+        else:
+            assert not ovf[i], (x, y)
+            assert got[i] == exact, (x, y, got[i], exact)
+
+
+def test_decimal128_div_by_zero_nulls():
+    from spark_rapids_jni_tpu.ops import (
+        decimal128_from_ints, decimal128_to_ints, div_decimal128)
+    a = decimal128_from_ints([10, 20, 30], 0)
+    b = decimal128_from_ints([2, 0, 5], 0)
+    res, ovf = div_decimal128(a, b, 0)
+    got = decimal128_to_ints(res)
+    assert np.asarray(ovf).tolist() == [False, True, False]
+    assert got[0] == 5 and got[1] is None and got[2] == 6
+
+
+def test_decimal128_div_overflow():
+    from spark_rapids_jni_tpu.ops import (
+        decimal128_from_ints, div_decimal128)
+    big = 10 ** 38 - 1
+    a = decimal128_from_ints([big], 0)
+    b = decimal128_from_ints([1], 6)   # e = 6 - 0 + 6 = 12 -> overflow
+    res, ovf = div_decimal128(a, b, 6)
+    assert bool(np.asarray(ovf)[0])
+
+
+def test_decimal128_to_strings():
+    from spark_rapids_jni_tpu.ops import (
+        decimal128_from_ints, decimal128_to_strings)
+    col = decimal128_from_ints([12345, -12345, 5, 0, None and 0 or 7],
+                               2, valid=[1, 1, 1, 1, 0])
+    assert decimal128_to_strings(col) == [
+        "123.45", "-123.45", "0.05", "0.00", None]
+    col0 = decimal128_from_ints([42, -7], 0)
+    assert decimal128_to_strings(col0) == ["42", "-7"]
+    coln = decimal128_from_ints([42], -2)
+    assert decimal128_to_strings(coln) == ["4200"]
