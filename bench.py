@@ -203,17 +203,48 @@ def bench_fixed(num_rows, num_cols=212, use_pallas=None):
     return res
 
 
-def bench_variable(num_rows, num_cols=155, with_strings=True):
+def bench_variable(num_rows, num_cols=155, with_strings=True,
+                   skewed=False):
     """The reference's mixed axis: 155 columns +/- 25 string columns
     (``benchmarks/row_conversion.cpp:75-78, 145-149``).  Strings ride the
     dense-padded engine (device-native layout), so the whole conversion is
-    static-shape concatenate/slice work."""
+    static-shape concatenate/slice work.
+
+    ``skewed``: the TPC-DS-ish skew shape — 1% of rows are 2KB outliers.
+    The device matrices stay at the 32B cap (the width-cap policy moves
+    outlier bytes to host tails), so throughput must hold near the
+    uniform profile instead of paying a ~64x padded-width blowup."""
     base = cycle_dtypes(FIXED_DTYPES, num_cols - (25 if with_strings else 0))
     dtypes = base + ([STRING] * 25 if with_strings else [])
-    profile = DataProfile(string_len_min=0, string_len_max=32)
-    _log(f"variable {num_rows} rows: generating table")
+    profile = DataProfile(string_len_min=0, string_len_max=32,
+                          string_outlier_frac=0.01 if skewed else 0.0,
+                          string_outlier_len=2048)
+    _log(f"variable {num_rows} rows (skewed={skewed}): generating table")
     table = create_random_table(dtypes, num_rows, profile, seed=42)
     jax.block_until_ready(table)
+    if skewed:
+        # prove the skew path end to end before timing: an outlier row's
+        # full 2KB string must survive the device roundtrip via its tail
+        from spark_rapids_jni_tpu.table import string_tail
+        scol = next(c for c in table.columns if c.dtype.is_string
+                    and string_tail(c))
+        sval = np.asarray(scol.valid_bools())
+        r = next((rr for rr in string_tail(scol) if sval[rr]), None)
+        assert r is not None, "no valid outlier row to verify"
+        batches = convert_to_rows(table)
+        start = 0
+        for b in batches:
+            nb = b.num_rows
+            if start <= r < start + nb:
+                back = convert_from_rows(b, dtypes)
+                col_i = [i for i, c in enumerate(table.columns)
+                         if c is scol][0]
+                got = back.columns[col_i].to_pylist()[r - start]
+                want = string_tail(scol)[r].decode("utf-8")
+                assert got == want, "skewed roundtrip lost tail bytes"
+                break
+            start += nb
+        _log(f"variable skewed: outlier roundtrip verified (row {r})")
     _log(f"variable {num_rows} rows: table ready")
     t_to = _time(lambda: convert_to_rows(table), iters=12,
                  label=f"var_to_rows[{num_rows}]", sync_each=True)
@@ -227,6 +258,7 @@ def bench_variable(num_rows, num_cols=155, with_strings=True):
         "num_rows": num_rows,
         "num_cols": num_cols,
         "strings": with_strings,
+        "skewed": skewed,
         "padded_rows": bool(batches[0].is_padded),
         "to_rows_s": t_to,
         "to_rows_GBps": moved / t_to / 1e9,
@@ -246,6 +278,8 @@ def _run_axis(axis: str):
         res = bench_fixed(int(n))
     elif kind == "nostrings":
         res = bench_variable(int(n), with_strings=False)
+    elif kind == "skewed":
+        res = bench_variable(int(n), skewed=True)
     else:
         res = bench_variable(int(n))
     for d in ("to_rows", "from_rows"):
@@ -433,6 +467,9 @@ def main():
         # (it skips strings >1M for memory, benchmarks/row_conversion.cpp:105)
         # and the no-strings variant; strings run on the dense-padded engine
         results["variable_width"] = [_axis_subprocess("variable:1000000")]
+        _flush()
+        results["variable_width_skewed"] = [
+            _axis_subprocess("skewed:1000000")]
         _flush()
         results["no_strings_155col"] = [_axis_subprocess("nostrings:1000000")]
         _flush()

@@ -9,7 +9,9 @@ Run:  python examples/end_to_end.py      (CPU mesh; works anywhere)
 """
 
 import os
+import sys
 
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 
 import jax  # noqa: E402
@@ -44,7 +46,8 @@ def main():
     # 1. parquet footer: parse + prune a footer to the columns we read
     #    (synthetic footer via the test helpers; in production this buffer
     #    comes from the tail of a parquet file)
-    from tests.test_parquet_footer import flat_footer, write_struct
+    from spark_rapids_jni_tpu.parquet.testing import flat_footer
+    from spark_rapids_jni_tpu.parquet.thrift_dom import write_struct
     raw = write_struct(flat_footer(["item", "week", "qty", "extra"],
                                    rows_per_group=(1000, 1000)))
     sel = (StructElement.builder()

@@ -30,6 +30,7 @@ from spark_rapids_jni_tpu.table import (  # noqa: F401
     UINT8, UINT16, UINT32, UINT64,
     FLOAT32, FLOAT64, BOOL8, STRING,
     decimal32, decimal64, list_, struct_,
+    attach_string_tail, string_tail,
 )
 
 __version__ = "0.1.0"

@@ -210,9 +210,11 @@ def _scan_automaton(ch: jnp.ndarray, segs: Tuple,
             & (c["found"] == 0)
         matched = matched + jnp.where(descend, 1, 0)
         # a descended-into container closing without a find exhausts the
-        # committed search space: Spark's streaming parser binds to the
-        # FIRST matching key and never backtracks to later duplicates,
-        # so the row is null from here on (bad), not re-matched
+        # committed search space: this framework's documented duplicate-
+        # key semantics bind to the FIRST matching key with no
+        # backtracking (the r2 review's direction — device automaton and
+        # host fixup must agree; Spark itself emits degenerate output for
+        # duplicate keys, which are invalid JSON in practice)
         exhausted = outside & is_close & (c["capturing"] == 0) \
             & (c["matched"] > 0) & (new_depth == c["matched"]) \
             & (c["found"] == 0)
@@ -325,6 +327,19 @@ def get_json_object(col: Column, path: str,
         raise ValueError("get_json_object needs a string column")
     segs = tuple(_parse_path(path))
     if col.is_padded:
+        from spark_rapids_jni_tpu.table import string_tail
+        lens_np = np.asarray(col.str_lens()) \
+            if not isinstance(col.str_lens(), jax.core.Tracer) else None
+        if string_tail(col) is not None or (
+                lens_np is not None and lens_np.size
+                and int(lens_np.max()) > col.chars2d.shape[1]):
+            # width-capped documents are truncated on device; scanning
+            # them would silently null (or mis-parse) rows whose answer
+            # lives past the cap — same loud-failure contract as
+            # to_arrow/to_pylist/compact_rows_host
+            raise ValueError(
+                "get_json_object on a width-capped string column would "
+                "scan truncated documents; to_arrow() the column first")
         W = col.chars2d.shape[1]
     elif max_str_len is not None:
         W = (int(max_str_len) + 3) // 4 * 4

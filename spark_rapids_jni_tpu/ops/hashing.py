@@ -157,12 +157,18 @@ def _resolve_str_window(cols, max_str_len: Optional[int]) -> int:
     def _len_arr(c):  # offsets, or per-row lens for sharded padded columns
         return c.offsets if c.offsets is not None else c.lens
 
+    from spark_rapids_jni_tpu.table import string_tail
     concrete = all(not isinstance(_len_arr(c), jax.core.Tracer)
                    for c in cols if c.dtype.is_string)
     actual_max = 0
     if concrete:
         for col in cols:
             if col.dtype.is_string and col.num_rows:
+                if col.is_padded and string_tail(col):
+                    # width-capped column: the device window is the cap;
+                    # longer rows are host-patched by the hash functions
+                    actual_max = max(actual_max, col.chars2d.shape[1])
+                    continue
                 lens = np.asarray(col.str_lens())
                 actual_max = max(actual_max, int(lens.max()))
     if max_str_len is not None:
@@ -206,6 +212,44 @@ def _mm3_string_col(col: Column, h: jnp.ndarray, W: int) -> jnp.ndarray:
     return _mm3_fmix(hc, lens)
 
 
+def _tail_subcolumn(tail) -> Column:
+    """The overflow tail as a small dense-padded column (k rows at the
+    tail's own width) — re-hashed by the NORMAL device kernel with
+    per-row entry states, so the patch path is the same code as the hot
+    path (no parallel host implementation to drift)."""
+    from spark_rapids_jni_tpu.table import STRING, ragged_positions
+    lens = tail.lens()
+    k = len(lens)
+    Wt = (int(lens.max()) + 3) // 4 * 4
+    mat = np.zeros((k, Wt), np.uint8)
+    rep, intra = ragged_positions(lens)
+    mat[rep, intra] = tail.data
+    offsets = np.zeros(k + 1, np.int32)
+    np.cumsum(lens, out=offsets[1:])
+    return Column(STRING, jnp.zeros((0,), jnp.uint8), None,
+                  jnp.asarray(offsets), None, jnp.asarray(mat))
+
+
+def _patch_capped_rows(col: Column, hc, h_entry, kernel_fn, scatter_fn):
+    """Replace hash values of a capped column's tail rows: gather each
+    row's entry state, run the device hash kernel over the tail
+    sub-column, scatter the results back."""
+    from spark_rapids_jni_tpu.table import string_tail
+    tail = string_tail(col) if col.is_padded else None
+    if tail is None or not len(tail):
+        return hc
+    if any(isinstance(x, jax.core.Tracer)
+           for x in jax.tree_util.tree_leaves((hc, h_entry))):
+        raise ValueError(
+            "hashing a width-capped string column requires eager "
+            "execution (host tail patch); convert with to_arrow() or "
+            "drop the cap before jit")
+    sub = _tail_subcolumn(tail)
+    rows = jnp.asarray(tail.rows.astype(np.int32))
+    vals = kernel_fn(sub, rows)
+    return scatter_fn(hc, rows, vals)
+
+
 def murmur3_hash(table_or_cols, seed: int = DEFAULT_SEED,
                  max_str_len: Optional[int] = None) -> jnp.ndarray:
     """Spark ``Murmur3Hash(cols)``: returns int32 [n].
@@ -213,7 +257,8 @@ def murmur3_hash(table_or_cols, seed: int = DEFAULT_SEED,
     Null rows of a column leave the running hash unchanged (Spark skips
     null fields).  String columns hash their UTF-8 bytes; pass
     ``max_str_len`` when calling under jit (otherwise it is derived from
-    the offsets with a host sync).
+    the offsets with a host sync).  Width-capped padded columns hash
+    their device window and host-patch the tail rows (eager only).
     """
     cols = (table_or_cols.columns if isinstance(table_or_cols, Table)
             else tuple(table_or_cols))
@@ -223,9 +268,18 @@ def murmur3_hash(table_or_cols, seed: int = DEFAULT_SEED,
     W = _resolve_str_window(cols, max_str_len) \
         if any(c.dtype.is_string for c in cols) else 0
     h = jnp.full((n,), seed, dtype=jnp.uint32)
+
+    def _mm3_kernel(sub, rows):
+        return _mm3_string_col(sub, h[rows], sub.chars2d.shape[1])
+
+    def _mm3_scatter(hc, rows, vals):
+        return hc.at[rows].set(vals)
+
     for col in cols:
         if col.dtype.is_string:
             hc = _mm3_string_col(col, h, W)
+            hc = _patch_capped_rows(col, hc, h, _mm3_kernel,
+                                    _mm3_scatter)
         else:
             words = _as_u32_words(col)
             nwords = words.shape[1]
@@ -450,9 +504,19 @@ def xxhash64(table_or_cols, seed: int = DEFAULT_SEED,
         if any(c.dtype.is_string for c in cols) else 0
     zeros = jnp.zeros((n,), jnp.uint32)
     h = (zeros, zeros + jnp.uint32(seed))  # seed < 2^32 in practice
+
+    def _xx_kernel(sub, rows):
+        return _xx64_string_col(sub, (h[0][rows], h[1][rows]),
+                                sub.chars2d.shape[1])
+
+    def _xx_scatter(hc, rows, vals):
+        return (hc[0].at[rows].set(vals[0]),
+                hc[1].at[rows].set(vals[1]))
+
     for col in cols:
         if col.dtype.is_string:
             hc = _xx64_string_col(col, h, W)
+            hc = _patch_capped_rows(col, hc, h, _xx_kernel, _xx_scatter)
         else:
             blk = _col_u64_blocks(col)
             # single 8-byte block path: h = seed + P5 + 8, per xxhash64 spec

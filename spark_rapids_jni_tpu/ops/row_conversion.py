@@ -606,6 +606,29 @@ def _to_rows_padded_jit(table: Table, layout: RowLayout,
                          row_size).reshape(-1)
 
 
+def _batch_string_tails(scols: List[Column], start: int,
+                        end: int) -> Optional[dict]:
+    """Per-string-column overflow tails for batch rows [start, end),
+    rebased to batch-local row indices: {si: StringTail} (vectorized
+    range slice — no per-entry work)."""
+    from spark_rapids_jni_tpu.table import string_tail
+    tails = {}
+    for si, c in enumerate(scols):
+        t = string_tail(c)
+        if t is None or not len(t):
+            continue
+        sub = t.slice_range(start, end)
+        if sub is not None:
+            tails[si] = sub
+    return tails or None
+
+
+def _attach_rows_tails(rows: RowsColumn, tails: Optional[dict]):
+    if tails:
+        object.__setattr__(rows, "_string_tails", tails)
+    return rows
+
+
 def _to_rows_variable_padded(table: Table, layout: RowLayout,
                              size_limit: int) -> List[RowsColumn]:
     scols = _string_cols(table)
@@ -621,7 +644,9 @@ def _to_rows_variable_padded(table: Table, layout: RowLayout,
     out = []
     if len(plan_fixed_batches(n, row_size, chunk)) == 1:
         offsets = jnp.arange(n + 1, dtype=jnp.int32) * row_size
-        return [RowsColumn(encode(), offsets, row_size, widths)]
+        return [_attach_rows_tails(
+            RowsColumn(encode(), offsets, row_size, widths),
+            _batch_string_tails(scols, 0, n))]
     # equal-sized 32-row-aligned batches sharing one compiled program
     # (same policy as the fixed-width path)
     nb = -(-n * row_size // chunk)
@@ -630,8 +655,9 @@ def _to_rows_variable_padded(table: Table, layout: RowLayout,
     for start in range(0, n, per):
         size = min(per, n - start)
         offsets = jnp.arange(size + 1, dtype=jnp.int32) * row_size
-        out.append(RowsColumn(encode(start, size), offsets, row_size,
-                              widths))
+        out.append(_attach_rows_tails(
+            RowsColumn(encode(start, size), offsets, row_size, widths),
+            _batch_string_tails(scols, start, start + size)))
     return out
 
 
@@ -675,16 +701,21 @@ def padded_cols_from_rows(data: jnp.ndarray, layout: RowLayout,
 
 
 def _from_rows_variable_padded(rows: RowsColumn, layout: RowLayout) -> Table:
+    from spark_rapids_jni_tpu.table import attach_string_tail
     datas, masks, str_parts = _from_rows_padded_jit(
         rows.data, layout, rows.str_widths)
+    tails = getattr(rows, "_string_tails", None) or {}
     cols = []
     si = 0
     for i, dt in enumerate(layout.dtypes):
         if dt.is_string:
             chars2d, offsets = str_parts[si]
+            col = Column(dt, jnp.zeros((0,), jnp.uint8), masks[i],
+                         offsets, None, chars2d)
+            if si in tails:
+                attach_string_tail(col, tails[si])
             si += 1
-            cols.append(Column(dt, jnp.zeros((0,), jnp.uint8), masks[i],
-                               offsets, None, chars2d))
+            cols.append(col)
         else:
             cols.append(Column(dt, datas[i], masks[i]))
     return Table(tuple(cols))
@@ -723,16 +754,30 @@ def compact_rows_host(rows: RowsColumn, dtypes: Sequence[DType]) -> RowsColumn:
         pb = pair_vals[:, si:si + 1].copy().view(np.uint8)   # [n, 4] LE
         out[(out_offs[:-1, None] + s + np.arange(4)[None, :]).reshape(-1)] \
             = pb.reshape(-1)
-    # chars: ragged scatter via repeat (C-speed on host)
+    # chars: ragged scatter via repeat (C-speed on host).  Width-capped
+    # batches hold only each row's first ``w`` bytes in the slot; the
+    # overflow tails supply the rest (true lengths came from the pairs)
     from spark_rapids_jni_tpu.table import ragged_positions
+    tails = getattr(rows, "_string_tails", None) or {}
     for si, (s, w) in enumerate(zip(slot_starts, rows.str_widths)):
         l = lens[:, si]
         if int(l.sum()) == 0:
             continue
-        rows_r, intra = ragged_positions(l)
+        capped = np.minimum(l, w)
+        if int(l.max(initial=0)) > w and si not in tails:
+            raise ValueError(
+                f"string column {si} has rows longer than its padded "
+                f"width {w} but no overflow tail attached; refusing to "
+                "emit truncated wire bytes")
+        rows_r, intra = ragged_positions(capped)
         src = rows_r * rs + s + intra
         dst = out_offs[rows_r] + fe + within[rows_r, si] + intra
         out[dst] = blob.reshape(-1)[src]
+        t = tails.get(si)
+        if t is not None and len(t):
+            trep, tintra = ragged_positions(t.lens())
+            tr = t.rows[trep]
+            out[out_offs[tr] + fe + within[tr, si] + tintra] = t.data
     return RowsColumn(jnp.asarray(out),
                       jnp.asarray(out_offs.astype(np.int32)))
 

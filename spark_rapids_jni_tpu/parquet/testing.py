@@ -1,0 +1,108 @@
+"""Synthetic parquet-footer builders (thrift-DOM level).
+
+Shared by the test suite and ``examples/end_to_end.py``: build footer
+metadata structurally — schema elements, column chunks, row groups —
+without needing a parquet writer (the reference builds test inputs with
+cudf column wrappers; footers here are metadata-only, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from spark_rapids_jni_tpu.parquet import StructElement, ValueElement
+from spark_rapids_jni_tpu.parquet.pyfooter import (
+    CC_META_DATA, CMD_DATA_PAGE_OFFSET, CMD_DICTIONARY_PAGE_OFFSET,
+    CMD_TOTAL_COMPRESSED_SIZE, FMD_COLUMN_ORDERS, FMD_CREATED_BY,
+    FMD_NUM_ROWS, FMD_ROW_GROUPS, FMD_SCHEMA, FMD_VERSION, RG_COLUMNS,
+    RG_FILE_OFFSET, RG_NUM_ROWS, RG_TOTAL_COMPRESSED_SIZE,
+    RG_TOTAL_BYTE_SIZE, SE_CONVERTED_TYPE, SE_NAME, SE_NUM_CHILDREN,
+    SE_REPETITION, SE_TYPE,
+)
+from spark_rapids_jni_tpu.parquet.thrift_dom import TList, TStruct, TType
+
+
+def se(name, ptype=None, num_children=None, converted=None,
+       repetition=None):
+    """One SchemaElement."""
+    s = TStruct()
+    if ptype is not None:
+        s.set(SE_TYPE, TType.I32, ptype)
+    if repetition is not None:
+        s.set(SE_REPETITION, TType.I32, repetition)
+    s.set(SE_NAME, TType.BINARY, name.encode())
+    if num_children is not None:
+        s.set(SE_NUM_CHILDREN, TType.I32, num_children)
+    if converted is not None:
+        s.set(SE_CONVERTED_TYPE, TType.I32, converted)
+    return s
+
+
+def chunk(data_off, comp_size, dict_off=None, with_meta=True,
+          file_offset=None):
+    """One ColumnChunk (+ metadata unless ``with_meta`` is False)."""
+    cc = TStruct()
+    cc.set(2, TType.I64,
+           file_offset if file_offset is not None else data_off)
+    if with_meta:
+        md = TStruct()
+        md.set(1, TType.I32, 2)  # type INT64 (arbitrary)
+        md.set(CMD_TOTAL_COMPRESSED_SIZE, TType.I64, comp_size)
+        md.set(CMD_DATA_PAGE_OFFSET, TType.I64, data_off)
+        if dict_off is not None:
+            md.set(CMD_DICTIONARY_PAGE_OFFSET, TType.I64, dict_off)
+        cc.set(CC_META_DATA, TType.STRUCT, md)
+    return cc
+
+
+def row_group(chunks, num_rows, total_compressed=None, file_offset=None):
+    rg = TStruct()
+    rg.set(RG_COLUMNS, TType.LIST, TList(TType.STRUCT, chunks))
+    rg.set(RG_TOTAL_BYTE_SIZE, TType.I64,
+           sum(c.at(CC_META_DATA).at(CMD_TOTAL_COMPRESSED_SIZE)
+               for c in chunks if c.has(CC_META_DATA)) or 0)
+    rg.set(RG_NUM_ROWS, TType.I64, num_rows)
+    if file_offset is not None:
+        rg.set(RG_FILE_OFFSET, TType.I64, file_offset)
+    if total_compressed is not None:
+        rg.set(RG_TOTAL_COMPRESSED_SIZE, TType.I64, total_compressed)
+    return rg
+
+
+def file_meta(schema_elems, groups, created_by=b"srj",
+              column_orders=None):
+    m = TStruct()
+    m.set(FMD_VERSION, TType.I32, 1)
+    m.set(FMD_SCHEMA, TType.LIST, TList(TType.STRUCT, schema_elems))
+    m.set(FMD_NUM_ROWS, TType.I64,
+          sum(g.at(RG_NUM_ROWS) for g in groups) if groups else 0)
+    m.set(FMD_ROW_GROUPS, TType.LIST, TList(TType.STRUCT, groups))
+    m.set(FMD_CREATED_BY, TType.BINARY, created_by)
+    if column_orders is not None:
+        m.set(FMD_COLUMN_ORDERS, TType.LIST,
+              TList(TType.STRUCT, column_orders))
+    return m
+
+
+def flat_footer(col_names, rows_per_group=(100,), types=None):
+    """root + N leaf columns, one chunk per column per group."""
+    n = len(col_names)
+    types = types or [2] * n
+    schema = [se("root", num_children=n)]
+    for name, t in zip(col_names, types):
+        schema.append(se(name, ptype=t))
+    groups = []
+    off = 4
+    for rows in rows_per_group:
+        chunks = []
+        for _ in range(n):
+            chunks.append(chunk(off, 100))
+            off += 100
+        groups.append(row_group(chunks, rows, total_compressed=100 * n))
+    return file_meta(schema, groups)
+
+
+def select(*names):
+    """Flat column-selection schema for ``read_and_filter``."""
+    b = StructElement.builder()
+    for n in names:
+        b.add_child(n, ValueElement())
+    return b.build()

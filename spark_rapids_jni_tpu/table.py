@@ -331,15 +331,32 @@ class Column:
 
     @staticmethod
     def strings_padded(values: Sequence[Optional[str]],
-                       pad_to: Optional[int] = None) -> "Column":
-        """Build a dense-padded string column (device-native layout)."""
+                       pad_to: Optional[int] = None,
+                       width_cap=None) -> "Column":
+        """Build a dense-padded string column (device-native layout).
+
+        ``width_cap``: cap the padded width at this many bytes (or
+        ``"auto"`` for a quantile policy) — the skew defence: one 2KB
+        outlier in a column of 16B strings would otherwise inflate every
+        padded row ~128x.  Rows longer than the cap keep their TRUE
+        length in ``offsets`` but only their first W bytes on device;
+        the full bytes live in a host-side tail (see
+        :func:`string_tail`) that boundary consumers (``to_arrow``,
+        ``to_pylist``, ``compact_rows_host``, hashing) patch from."""
         enc, lens, offsets, validity = Column._encode_strings(values)
         W = _padded_width(int(lens.max()) if len(lens) else 0, pad_to)
+        W, tail_rows = _apply_width_cap(lens, W, width_cap)
         mat = np.zeros((len(enc), W), np.uint8)
+        tail = {}
         for i, b in enumerate(enc):
-            mat[i, :len(b)] = np.frombuffer(b, np.uint8)
-        return Column(STRING, jnp.zeros((0,), jnp.uint8), validity,
-                      jnp.asarray(offsets), None, jnp.asarray(mat))
+            mat[i, :min(len(b), W)] = np.frombuffer(b, np.uint8)[:W]
+            if len(b) > W:
+                tail[i] = b
+        col = Column(STRING, jnp.zeros((0,), jnp.uint8), validity,
+                     jnp.asarray(offsets), None, jnp.asarray(mat))
+        if tail:
+            attach_string_tail(col, tail)
+        return col
 
     # -- properties -------------------------------------------------------
 
@@ -376,10 +393,14 @@ class Column:
 
     # -- string representation conversion ----------------------------------
 
-    def to_padded(self, pad_to: Optional[int] = None) -> "Column":
+    def to_padded(self, pad_to: Optional[int] = None,
+                  width_cap=None) -> "Column":
         """Arrow -> dense-padded, via the host (numpy): per-row dynamic-start
         gathers are ~100x slower than a host round-trip on XLA:TPU, so the
-        conversion is explicitly a boundary operation, not a device kernel."""
+        conversion is explicitly a boundary operation, not a device kernel.
+
+        ``width_cap`` (bytes or ``"auto"``): skew defence, see
+        :meth:`strings_padded`."""
         if not self.dtype.is_string or self.is_padded:
             return self
         offs = np.asarray(self.offsets).astype(np.int64)
@@ -390,14 +411,22 @@ class Column:
         lens = offs[1:] - offs[:-1]
         n = len(lens)
         W = _padded_width(int(lens.max()) if n else 0, pad_to)
+        W, tail_rows = _apply_width_cap(lens, W, width_cap)
         mat = np.zeros((n, W), np.uint8)
         if chars.size:
-            # vectorized ragged->padded: scatter chars at row*W + intra
-            rows, intra = ragged_positions(lens)
-            mat.reshape(-1)[rows * W + intra] = chars
-        return Column(self.dtype, self.data, self.validity,
-                      jnp.asarray((offs).astype(np.int32)), None,
-                      jnp.asarray(mat))
+            # vectorized ragged->padded: scatter the first W bytes of
+            # each row at row*W + intra
+            rows, intra = ragged_positions(np.minimum(lens, W))
+            src = offs[rows] + intra
+            mat.reshape(-1)[rows * W + intra] = chars[src]
+        col = Column(self.dtype, self.data, self.validity,
+                     jnp.asarray((offs).astype(np.int32)), None,
+                     jnp.asarray(mat))
+        if len(tail_rows):
+            tail = {int(r): bytes(chars[offs[r]:offs[r + 1]])
+                    for r in tail_rows}
+            attach_string_tail(col, tail)
+        return col
 
     def to_arrow(self) -> "Column":
         """Dense-padded -> Arrow, via the host (see :meth:`to_padded`)."""
@@ -406,12 +435,21 @@ class Column:
         mat = np.asarray(self.chars2d)
         lens = np.asarray(self.str_lens())
         W = mat.shape[1]
-        mask = np.arange(W)[None, :] < lens[:, None]
-        chars = mat[mask]  # row-major selection = concatenated strings
-        offsets = np.zeros(len(lens) + 1, np.int32)
+        tail = _require_string_tail(self, lens, W)
+        capped = np.minimum(lens, W)
+        mask = np.arange(W)[None, :] < capped[:, None]
+        offsets = np.zeros(len(lens) + 1, np.int64)
         np.cumsum(lens, out=offsets[1:])
+        chars = np.zeros(int(offsets[-1]), np.uint8)
+        if capped.sum():
+            rows, intra = ragged_positions(capped)
+            chars[offsets[rows] + intra] = mat[mask]
+        if tail is not None and len(tail):
+            trep, tintra = ragged_positions(tail.lens())
+            chars[offsets[tail.rows[trep]] + tintra] = tail.data
         return Column(self.dtype, self.data, self.validity,
-                      jnp.asarray(offsets), jnp.asarray(chars), None)
+                      jnp.asarray(offsets.astype(np.int32)),
+                      jnp.asarray(chars), None)
 
     def chars_window(self, W: int) -> jnp.ndarray:
         """Padded byte window uint8 [n, W] (zero past lengths) in any
@@ -459,7 +497,10 @@ class Column:
             if self.is_padded:
                 mat = np.asarray(self.chars2d)
                 lens = np.asarray(self.str_lens())
-                return [bytes(mat[i, :lens[i]]).decode("utf-8")
+                tail = _require_string_tail(self, lens, mat.shape[1]) \
+                    or {}
+                return [(tail[i].decode("utf-8") if i in tail
+                         else bytes(mat[i, :lens[i]]).decode("utf-8"))
                         if valid[i] else None for i in range(n)]
             offs = np.asarray(self.offsets)
             chars = np.asarray(self.chars).tobytes()
@@ -515,6 +556,139 @@ def _padded_width(max_len: int, pad_to: Optional[int]) -> int:
     if W < max_len:
         raise ValueError(f"pad_to={W} < longest string {max_len}")
     return (W + 3) // 4 * 4
+
+
+# ---------------------------------------------------------------------------
+# Width-capped padding: the skew defence
+# ---------------------------------------------------------------------------
+#
+# A dense-padded column sizes every row to the longest string; one 2KB
+# outlier in a 16B-average column inflates memory and device compute
+# ~100x.  A width cap bounds the device matrix and moves the rare long
+# rows' full bytes to a HOST-side tail: offsets/lens keep TRUE lengths
+# (self-describing), chars2d holds each row's first W bytes.  The tail
+# rides OUTSIDE the pytree (plain attribute) — device code never sees it
+# and jit caching is unaffected.  Because true lengths stay visible,
+# a consumer that needs full bytes can always detect a capped column
+# (max len > matrix width) and REFUSES to proceed silently when the tail
+# attribute was lost (e.g. a reconstruction from jit outputs that forgot
+# to re-attach it): loud failure instead of silent truncation.
+
+def _apply_width_cap(lens: np.ndarray, W: int, width_cap):
+    """Resolve a width-cap policy.  Returns (W, tail_row_indices)."""
+    if width_cap is None or len(lens) == 0 or W == 0:
+        return W, np.zeros((0,), np.int64)
+    if width_cap == "auto":
+        # quantile policy: pad to the p99 length (word-aligned; "lower"
+        # so a <=1% outlier tail cannot drag the quantile onto itself);
+        # only worth capping when the tail would have inflated the
+        # matrix 2x+
+        p99 = int(np.quantile(lens, 0.99, method="lower"))
+        cap = max(4, (p99 + 3) // 4 * 4)
+        if cap * 2 > W:
+            return W, np.zeros((0,), np.int64)
+    else:
+        cap = max(4, (int(width_cap) + 3) // 4 * 4)
+        if cap >= W:
+            return W, np.zeros((0,), np.int64)
+    tail_rows = np.nonzero(lens > cap)[0]
+    return cap, tail_rows
+
+
+class StringTail:
+    """Host-side overflow store of a width-capped padded column: the FULL
+    bytes of every row longer than the padded width, in vectorized form
+    (``rows`` int64 [k] ascending, ``offsets`` int64 [k+1], ``data``
+    uint8 [total]).  Dict-like access for row lookups; vectorized
+    ``slice_range`` for batching (a 1%-outlier 1M-row column holds 10k
+    entries per column — per-entry Python loops do not scale)."""
+
+    __slots__ = ("rows", "offsets", "data")
+
+    def __init__(self, rows, offsets, data):
+        self.rows = np.asarray(rows, np.int64)
+        self.offsets = np.asarray(offsets, np.int64)
+        self.data = np.asarray(data, np.uint8)
+
+    @staticmethod
+    def from_dict(d: dict) -> "StringTail":
+        rows = np.array(sorted(d), np.int64)
+        lens = np.array([len(d[int(r)]) for r in rows], np.int64)
+        offsets = np.zeros(len(rows) + 1, np.int64)
+        np.cumsum(lens, out=offsets[1:])
+        data = np.frombuffer(b"".join(d[int(r)] for r in rows), np.uint8)
+        return StringTail(rows, offsets, data.copy())
+
+    def __len__(self):
+        return len(self.rows)
+
+    def __iter__(self):
+        return iter(int(r) for r in self.rows)
+
+    def __contains__(self, row):
+        i = np.searchsorted(self.rows, row)
+        return i < len(self.rows) and self.rows[i] == row
+
+    def get(self, row):
+        i = int(np.searchsorted(self.rows, row))
+        if i >= len(self.rows) or self.rows[i] != row:
+            return None
+        return self.data[self.offsets[i]:self.offsets[i + 1]].tobytes()
+
+    def __getitem__(self, row):
+        b = self.get(row)
+        if b is None:
+            raise KeyError(row)
+        return b
+
+    def items(self):
+        for i, r in enumerate(self.rows):
+            yield int(r), \
+                self.data[self.offsets[i]:self.offsets[i + 1]].tobytes()
+
+    def lens(self) -> np.ndarray:
+        return self.offsets[1:] - self.offsets[:-1]
+
+    def slice_range(self, start: int, end: int) -> Optional["StringTail"]:
+        """Entries with start <= row < end, rebased to row-start (all
+        numpy, no per-entry work)."""
+        i0 = int(np.searchsorted(self.rows, start))
+        i1 = int(np.searchsorted(self.rows, end))
+        if i0 == i1:
+            return None
+        offs = self.offsets[i0:i1 + 1]
+        return StringTail(self.rows[i0:i1] - start, offs - offs[0],
+                          self.data[offs[0]:offs[-1]])
+
+
+def attach_string_tail(col: "Column", tail) -> "Column":
+    """Attach the host-side overflow tail of a width-capped padded column
+    (a :class:`StringTail`, or a {row: full utf-8 bytes} dict)."""
+    if isinstance(tail, dict):
+        tail = StringTail.from_dict(tail)
+    object.__setattr__(col, "_string_tail", tail)
+    return col
+
+
+def string_tail(col: "Column") -> Optional[StringTail]:
+    """The column's overflow tail, or None (not capped / tail lost)."""
+    return getattr(col, "_string_tail", None)
+
+
+def _require_string_tail(col: "Column", lens: np.ndarray, W: int):
+    """Tail dict for boundary consumers; raises when rows exceed the
+    padded width but the tail is missing (lost through a reconstruction
+    that did not re-attach it) — never silently truncate."""
+    if len(lens) == 0 or int(lens.max(initial=0)) <= W:
+        return string_tail(col)
+    tail = string_tail(col)
+    if tail is None:
+        raise ValueError(
+            f"width-capped string column (max len {int(lens.max())} > "
+            f"padded width {W}) has no overflow tail attached; it was "
+            "likely reconstructed without attach_string_tail — refusing "
+            "to silently truncate")
+    return tail
 
 
 # ---------------------------------------------------------------------------

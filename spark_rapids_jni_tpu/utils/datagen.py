@@ -47,6 +47,12 @@ class DataProfile:
     string_len_min: int = 0
     string_len_max: int = 32
     avg_string_len: Optional[int] = None  # geometric mean when set
+    # skew: a fraction of rows become long outliers (e.g. 0.01 at 2KB —
+    # the TPC-DS-ish skew shape).  Padded columns keep their device
+    # matrix at string_len_max (the width cap) and carry outlier bytes
+    # in the host tail (see ``Column.strings_padded``).
+    string_outlier_frac: float = 0.0
+    string_outlier_len: int = 2048
     # "padded" (device-native dense [n, W] chars, zero host syncs) or
     # "arrow" (ragged offsets+chars, one host sync for the total sizes)
     string_layout: str = "padded"
@@ -194,6 +200,10 @@ def _gen_table_jit(key, dtypes, num_rows: int, profile: DataProfile):
             lens2d = jax.random.randint(
                 klen, shape, profile.string_len_min,
                 profile.string_len_max + 1, dtype=jnp.int32)
+        if profile.string_outlier_frac:
+            om = jax.random.bernoulli(jax.random.fold_in(klen, 7),
+                                      profile.string_outlier_frac, shape)
+            lens2d = jnp.where(om, profile.string_outlier_len, lens2d)
         str_lens = [lens2d[j] for j in range(len(sidx))]
         if profile.string_layout == "padded":
             # dense-padded char matrices, fully on device: random lowercase
@@ -272,12 +282,39 @@ def create_random_table(dtypes: Sequence[DType], num_rows: int,
                                        tuple(int(t) for t in totals))
     cols = []
     si = 0
+    rng_tail = np.random.default_rng(
+        (profile.seed if seed is None else seed) ^ 0x7A11)
     for i, dt in enumerate(dtypes):
         if dt.is_string:
             if str_mats is not None:
-                cols.append(Column(dt, jnp.zeros((0,), jnp.uint8),
-                                   validities[i], offsets_dev[si],
-                                   None, str_mats[si]))
+                col = Column(dt, jnp.zeros((0,), jnp.uint8),
+                             validities[i], offsets_dev[si],
+                             None, str_mats[si])
+                if profile.string_outlier_frac:
+                    # outlier rows exceed the padded width: their full
+                    # bytes live in the host tail (width-cap contract) —
+                    # assembled vectorized (10k+ entries at 1% x 1M rows)
+                    lens = np.asarray(col.str_lens()).astype(np.int64)
+                    W = col.chars2d.shape[1]
+                    tail_rows = np.nonzero(lens > W)[0]
+                    if len(tail_rows):
+                        from spark_rapids_jni_tpu.table import (
+                            StringTail, attach_string_tail,
+                            ragged_positions)
+                        tl = lens[tail_rows]
+                        offs = np.zeros(len(tl) + 1, np.int64)
+                        np.cumsum(tl, out=offs[1:])
+                        data = rng_tail.integers(
+                            97, 123, int(offs[-1]),
+                            dtype=np.int32).astype(np.uint8)
+                        # heads must match the device matrix bytes
+                        head = np.asarray(col.chars2d)[tail_rows]
+                        rep, intra = ragged_positions(
+                            np.full(len(tl), W, np.int64))
+                        data[offs[rep] + intra] = head.reshape(-1)
+                        attach_string_tail(
+                            col, StringTail(tail_rows, offs, data))
+                cols.append(col)
             else:
                 cols.append(Column(dt, jnp.zeros((0,), jnp.uint8),
                                    validities[i],

@@ -271,3 +271,46 @@ def test_fatal_child_process_does_not_poison_parent(tmp_path):
     # parent backend unaffected by the child's death
     assert int(jax.block_until_ready(
         jax.jit(lambda x: x + 1)(jnp.int32(1)))) == 2
+
+
+def test_canary_real_operator_crosses_every_domain(hooks):
+    """Drift canary (r2 review): a 100% ``*`` rule per domain must
+    intercept REAL operator traffic — not just the micro-tests above.
+    If a jax upgrade renames a hook point, install() fails loudly; if a
+    new dispatch path routes AROUND a still-existing hook (the pjit
+    fast-path class of drift), this canary is what catches it."""
+    import numpy as np
+    from spark_rapids_jni_tpu import Column, Table, INT32
+    from spark_rapids_jni_tpu.ops import convert_to_rows
+
+    def tiny_table(tag):
+        # fresh shapes per domain so nothing is served from caches
+        n = 64 + tag
+        return Table((Column.from_numpy(
+            np.arange(n, dtype=np.int32), INT32),))
+
+    # transfer: table build itself places host arrays
+    hooks.apply_config({"pjrtTransferFaults": {
+        "*": {"percent": 100, "injectionType": 1,
+              "interceptionCount": 1}}})
+    with pytest.raises(faultinj.DeviceAssertError):
+        jax.block_until_ready(convert_to_rows(tiny_table(0))[0].data)
+    hooks.apply_config({"pjrtTransferFaults": {}})
+
+    # compile: a fresh shape forces a compile request
+    hooks.apply_config({"pjrtCompileFaults": {
+        "*": {"percent": 100, "injectionType": 2,
+              "substituteReturnCode": 7, "interceptionCount": 1}}})
+    with pytest.raises(faultinj.InjectedRuntimeError):
+        jax.block_until_ready(convert_to_rows(tiny_table(8))[0].data)
+    hooks.apply_config({"pjrtCompileFaults": {}})
+
+    # execute: warm once, then the armed rule must still see the call
+    # (fast-path gating regression rides along)
+    t = tiny_table(16)
+    jax.block_until_ready(convert_to_rows(t)[0].data)
+    hooks.apply_config({"pjrtExecuteFaults": {
+        "*": {"percent": 100, "injectionType": 1,
+              "interceptionCount": 1}}})
+    with pytest.raises(faultinj.DeviceAssertError):
+        jax.block_until_ready(convert_to_rows(t)[0].data)

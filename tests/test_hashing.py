@@ -89,7 +89,7 @@ def test_murmur3_int_vs_scalar(rng):
     np.testing.assert_array_equal(got, exp)
 
 
-def test_murmur3_long_vs_scalar(rng):
+def test_murmur3_long_vs_scalar(rng, x64_both):
     vals = rng.integers(-2**63, 2**63, 200, dtype=np.int64)
     t = Table((Column.from_numpy(vals, INT64),))
     got = np.asarray(murmur3_hash(t))
@@ -97,7 +97,7 @@ def test_murmur3_long_vs_scalar(rng):
     np.testing.assert_array_equal(got, exp)
 
 
-def test_murmur3_multi_column_chaining(rng):
+def test_murmur3_multi_column_chaining(rng, x64_both):
     a = rng.integers(-100, 100, 50, dtype=np.int32)
     b = rng.integers(-2**62, 2**62, 50, dtype=np.int64)
     t = Table((Column.from_numpy(a, INT32), Column.from_numpy(b, INT64)))
@@ -120,7 +120,7 @@ def test_murmur3_floats_hash_as_bits(rng):
     assert got[2] == got[3]
 
 
-def test_murmur3_double_and_bool(rng):
+def test_murmur3_double_and_bool(rng, x64_both):
     d = np.array([3.14159, -1e300, 0.0], np.float64)
     bl = np.array([1, 0, 1], np.uint8)
     t = Table((Column.from_numpy(d, FLOAT64), Column.from_numpy(bl, BOOL8)))
@@ -171,7 +171,7 @@ def test_hash_partition_ids_range(rng):
     assert counts.min() > 50
 
 
-def test_xxhash64_long_vs_scalar(rng):
+def test_xxhash64_long_vs_scalar(rng, x64_both):
     vals = rng.integers(-2**63, 2**63, 100, dtype=np.int64)
     t = Table((Column.from_numpy(vals, INT64),))
     got = np.asarray(xxhash64(t)).astype(np.uint64)
@@ -289,7 +289,7 @@ def _str_col(values):
     return Column.strings(values)
 
 
-def test_murmur3_strings_vs_scalar():
+def test_murmur3_strings_vs_scalar(x64_both):
     col = _str_col(STR_CASES)
     got = np.asarray(murmur3_hash([col]))
     exp = [as_i32(mm3_hash_bytes(s.encode("utf-8", "surrogateescape")
@@ -324,7 +324,7 @@ def test_string_hash_null_skips_and_empty_mixes():
     assert got[2] != 42
 
 
-def test_string_hash_chained_with_fixed(rng):
+def test_string_hash_chained_with_fixed(rng, x64_both):
     vals = np.array([7, -3, 100], np.int32)
     col = _str_col(["spark", "", "tpu-row"])
     got = np.asarray(murmur3_hash(
@@ -335,7 +335,7 @@ def test_string_hash_chained_with_fixed(rng):
     np.testing.assert_array_equal(got, exp)
 
 
-def test_xxhash64_strings_random_lengths(rng):
+def test_xxhash64_strings_random_lengths(rng, x64_both):
     import random
     r = random.Random(7)
     vals = ["".join(chr(r.randrange(32, 127)) for _ in range(r.randrange(0, 90)))

@@ -29,7 +29,7 @@ def _make_sharded(rng, mesh, n):
     return t, shard_table(t, mesh)
 
 
-def test_shuffle_delivers_all_rows_once(rng, mesh):
+def test_shuffle_delivers_all_rows_once(rng, mesh, x64_both):
     n = 8 * 64
     t, ts = _make_sharded(rng, mesh, n)
     res = shuffle_table_sharded(ts, key_cols=[0], mesh=mesh)
@@ -84,7 +84,7 @@ def test_overflow_flag(rng, mesh):
     assert int(np.asarray(res2.num_valid).sum()) == n
 
 
-def test_ring_exchange_matches_all_to_all(rng, mesh):
+def test_ring_exchange_matches_all_to_all(rng, mesh, x64_both):
     """The ring (ppermute-decomposed) exchange must deliver bit-identical
     buckets to the fused all_to_all exchange."""
     n = 8 * 64
@@ -128,7 +128,7 @@ def _make_string_sharded(rng, mesh, n, null_prob=0.1):
     return vals, pay, t, shard_table(t, mesh)
 
 
-def test_string_shuffle_delivers_all_rows_once(rng, mesh):
+def test_string_shuffle_delivers_all_rows_once(rng, mesh, x64_both):
     n = 8 * 64
     vals, pay, t, ts = _make_string_sharded(rng, mesh, n)
     res = shuffle_table_sharded(ts, key_cols=[0], mesh=mesh,
@@ -175,7 +175,7 @@ def test_string_shuffle_lands_on_spark_partition(rng, mesh):
     assert seen == n
 
 
-def test_string_shuffle_mixed_key(rng, mesh):
+def test_string_shuffle_mixed_key(rng, mesh, x64_both):
     """Composite (int, string) keys hash with Spark chaining."""
     n = 8 * 32
     vals, pay, t, ts = _make_string_sharded(rng, mesh, n, null_prob=0.0)

@@ -129,7 +129,7 @@ def test_unicode_strings_roundtrip():
     assert got.columns[0].to_pylist() == ["héllo", "wörld", "日本語", "🎉🎊"]
 
 
-def test_mixed_with_fixed_width_sweep(rng):
+def test_mixed_with_fixed_width_sweep(rng, x64_both):
     dtypes_fixed = [INT64, INT32, INT8]
     n = 257
     t_fixed = make_table(rng, dtypes_fixed, n, "most")
@@ -157,7 +157,7 @@ def test_zero_row_string_table_roundtrip():
 # Dense-padded engine (device-native layout; VERDICT r1 item 2)
 # ---------------------------------------------------------------------------
 
-def test_padded_roundtrip_matches_compact_logically(rng):
+def test_padded_roundtrip_matches_compact_logically(rng, x64_both):
     n = 1000
     vals_a = _random_strings(rng, n)
     vals_b = _random_strings(rng, n, max_len=60)
@@ -273,3 +273,103 @@ def test_long_string_fallback_roundtrip():
             t.dtypes)
         got = bytes(chars[0]).decode()
         assert got == "short" + long + "mid" * 10
+
+
+# ---------------------------------------------------------------------------
+# width-capped padding (the skew defence)
+# ---------------------------------------------------------------------------
+
+def _skewed_values(rng, n=300, outlier_len=900):
+    vals = ["v%d" % i * int(rng.integers(1, 6)) for i in range(n)]
+    for r in (7, 123, 250):
+        vals[r] = "Z" * outlier_len
+    vals[50] = None
+    return vals
+
+
+def test_width_cap_roundtrip_and_boundaries(rng, x64_both):
+    from spark_rapids_jni_tpu import Column, Table, INT32, string_tail
+    from spark_rapids_jni_tpu.ops import convert_to_rows, convert_from_rows
+    from spark_rapids_jni_tpu.ops.row_conversion import compact_rows_host
+    vals = _skewed_values(rng)
+    col = Column.strings_padded(vals, width_cap=32)
+    assert col.chars2d.shape[1] == 32
+    assert sorted(string_tail(col)) == [7, 123, 250]
+    assert col.to_pylist() == vals
+    assert col.to_arrow().to_pylist() == vals
+
+    t = Table((Column.from_numpy(
+        np.arange(len(vals), dtype=np.int32), INT32), col))
+    batches = convert_to_rows(t)
+    back = convert_from_rows(batches[0], t.dtypes)
+    assert back.columns[1].to_pylist() == vals
+    # wire bytes equal the uncapped encoding's
+    full = convert_to_rows(Table((t.columns[0],
+                                  Column.strings_padded(vals))))
+    w_cap = compact_rows_host(batches[0], t.dtypes)
+    w_full = compact_rows_host(full[0], t.dtypes)
+    np.testing.assert_array_equal(np.asarray(w_cap.data),
+                                  np.asarray(w_full.data))
+
+
+def test_width_cap_auto_policy(rng):
+    from spark_rapids_jni_tpu import Column, string_tail
+    vals = _skewed_values(rng, outlier_len=2000)
+    col = Column.strings_padded(vals, width_cap="auto")
+    assert col.chars2d.shape[1] < 2000
+    assert len(string_tail(col)) == 3
+    # near-uniform lengths: auto declines to cap (no tail)
+    uni = ["abcd"] * 100
+    col2 = Column.strings_padded(uni, width_cap="auto")
+    assert string_tail(col2) is None
+    # arrow -> padded honors the cap too
+    col3 = Column.strings(vals).to_padded(width_cap=32)
+    assert col3.chars2d.shape[1] == 32
+    assert col3.to_pylist() == vals
+
+
+def test_width_cap_hashing_matches_uncapped(rng, x64_both):
+    from spark_rapids_jni_tpu import Column
+    from spark_rapids_jni_tpu.ops.hashing import murmur3_hash, xxhash64
+    vals = _skewed_values(rng)
+    capped = Column.strings_padded(vals, width_cap=32)
+    full = Column.strings_padded(vals)
+    np.testing.assert_array_equal(np.asarray(murmur3_hash([capped])),
+                                  np.asarray(murmur3_hash([full])))
+    np.testing.assert_array_equal(np.asarray(xxhash64([capped])),
+                                  np.asarray(xxhash64([full])))
+
+
+def test_width_cap_tail_loss_is_loud(rng):
+    from spark_rapids_jni_tpu import Column
+    vals = _skewed_values(rng)
+    col = Column.strings_padded(vals, width_cap=32)
+    stripped = Column(col.dtype, col.data, col.validity, col.offsets,
+                      None, col.chars2d)
+    with pytest.raises(ValueError, match="tail"):
+        stripped.to_pylist()
+    with pytest.raises(ValueError, match="tail"):
+        stripped.to_arrow()
+
+
+def test_datagen_skewed_profile(rng):
+    from spark_rapids_jni_tpu.utils import DataProfile, create_random_table
+    from spark_rapids_jni_tpu.table import string_tail
+    from spark_rapids_jni_tpu import STRING, INT32
+    profile = DataProfile(string_len_min=0, string_len_max=32,
+                          string_outlier_frac=0.05,
+                          string_outlier_len=500)
+    t = create_random_table([INT32, STRING, STRING], 2000, profile,
+                            seed=3)
+    for c in t.columns[1:]:
+        assert c.chars2d.shape[1] == 32
+        tail = string_tail(c)
+        assert tail is not None and len(tail)
+        assert all(len(b) == 500 for _, b in tail.items())
+        # roundtrip through pylist decodes tails
+        vals = c.to_pylist()
+        lens = np.asarray(c.str_lens())
+        for r in list(tail)[:3]:
+            v = vals[r]
+            if v is not None:
+                assert len(v.encode()) == lens[r] == 500
