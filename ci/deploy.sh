@@ -1,0 +1,41 @@
+#!/bin/bash
+# Deploy the built wheel (+ native .so + provenance) to an artifact
+# repository — the reference's ci/deploy.sh analogue (it deploys jars
+# with classifiers to a maven SERVER_URL; wheels replace jars here).
+#
+# Used environment(s):
+#   DEPLOY_URL:  Where to deploy. Either a directory path / file:// URL
+#                (artifact promotion with sha256 manifest — works in any
+#                sandbox) or an https package-index URL (uploaded with
+#                twine, which must be installed; TWINE_* env applies).
+#   DRY_RUN:     true => print what would be deployed and exit 0.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DEPLOY_URL=${DEPLOY_URL:?set DEPLOY_URL (directory, file:// or https://)}
+DRY_RUN=${DRY_RUN:-false}
+
+make package
+mapfile -t WHEELS < <(ls dist/*.whl)
+[ ${#WHEELS[@]} -gt 0 ] || { echo "no wheels in dist/"; exit 1; }
+
+if [ "$DRY_RUN" = true ]; then
+    printf 'would deploy to %s:\n' "$DEPLOY_URL"
+    printf '  %s\n' "${WHEELS[@]}"
+    exit 0
+fi
+
+case "$DEPLOY_URL" in
+    https://*)
+        command -v twine >/dev/null || {
+            echo "https deploy needs twine installed"; exit 1; }
+        twine upload --repository-url "$DEPLOY_URL" "${WHEELS[@]}"
+        ;;
+    *)
+        DEST=${DEPLOY_URL#file://}
+        mkdir -p "$DEST"
+        cp "${WHEELS[@]}" "$DEST/"
+        ( cd "$DEST" && sha256sum *.whl > SHA256SUMS )
+        echo "deployed ${#WHEELS[@]} wheel(s) to $DEST"
+        ;;
+esac

@@ -9,6 +9,7 @@ from spark_rapids_jni_tpu.ops.row_conversion import (  # noqa: F401
     RowsColumn,
     convert_to_rows,
     convert_from_rows,
+    convert_from_rows_grouped,
     convert_to_rows_fixed_width_optimized,
     convert_from_rows_fixed_width_optimized,
 )

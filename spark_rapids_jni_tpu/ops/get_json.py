@@ -330,7 +330,10 @@ def get_json_object(col: Column, path: str,
         from spark_rapids_jni_tpu.table import string_tail
         lens_np = np.asarray(col.str_lens()) \
             if not isinstance(col.str_lens(), jax.core.Tracer) else None
-        if string_tail(col) is not None or (
+        # the `capped` flag rides pytree aux, so this refusal also fires
+        # under jit, where the host tail cannot exist
+        if getattr(col, "capped", False) \
+                or string_tail(col) is not None or (
                 lens_np is not None and lens_np.size
                 and int(lens_np.max()) > col.chars2d.shape[1]):
             # width-capped documents are truncated on device; scanning

@@ -158,6 +158,17 @@ def _resolve_str_window(cols, max_str_len: Optional[int]) -> int:
         return c.offsets if c.offsets is not None else c.lens
 
     from spark_rapids_jni_tpu.table import string_tail
+    for col in cols:
+        if col.dtype.is_string and getattr(col, "capped", False) \
+                and (string_tail(col) is None
+                     or isinstance(_len_arr(col), jax.core.Tracer)):
+            # the flag survives tracing via pytree aux; the host tail
+            # does not — and without it the hash of a capped row would
+            # silently cover zero-truncated bytes
+            raise ValueError(
+                "hashing a width-capped string column requires eager "
+                "execution with its overflow tail attached; to_arrow() "
+                "the column (or drop the cap) first")
     concrete = all(not isinstance(_len_arr(c), jax.core.Tracer)
                    for c in cols if c.dtype.is_string)
     actual_max = 0
@@ -170,7 +181,17 @@ def _resolve_str_window(cols, max_str_len: Optional[int]) -> int:
                     actual_max = max(actual_max, col.chars2d.shape[1])
                     continue
                 lens = np.asarray(col.str_lens())
-                actual_max = max(actual_max, int(lens.max()))
+                col_max = int(lens.max())
+                actual_max = max(actual_max, col_max)
+                if col.is_padded and col_max > col.chars2d.shape[1]:
+                    # rows longer than the padded matrix with no tail:
+                    # the tail was lost; hashing zero-truncated bytes
+                    # would silently mis-partition (loud-failure
+                    # contract, see table._require_string_tail)
+                    raise ValueError(
+                        "string column has rows longer than its padded "
+                        "width but no overflow tail attached; refusing "
+                        "to hash truncated bytes")
     if max_str_len is not None:
         # an undersized window would silently truncate the byte stream —
         # validate whenever the offsets are concrete (free in eager mode)

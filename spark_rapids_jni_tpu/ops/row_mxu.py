@@ -826,84 +826,76 @@ def bytes_to_words(b: jnp.ndarray, nwords: int) -> jnp.ndarray:
 # The standard decode materializes one device buffer per column; XLA emits
 # ~one kernel per output, and at 212 columns the per-kernel overhead
 # dominates (measured ~85 kernels, most of the 70ms/GB decode time).  The
-# grouped decode returns ONE wide array per width class — the same fully
-# decoded bytes organized dtype-major — plus the packed validity matrix.
-# Consumers slice single columns on demand (`GroupedColumns.column`), one
-# cheap copy per column they actually touch (a Spark plan typically reads
-# a handful), instead of materializing all 212 up front.
+# grouped decode keeps the decode's [W, n] word-plane matrix AS the table
+# backing — every byte fully decoded and organized dtype-major (the word
+# plan orders 64-bit pairs first, then 4/2/1-byte packed words, exactly
+# a dtype-major layout) — plus the packed validity matrix.  Consumers
+# extract single columns on demand (`GroupedColumns.column`): one cheap
+# slice/shift per column they actually touch (a Spark plan typically
+# reads a handful), instead of materializing all 212 up front.  Measured:
+# materializing per-class wide arrays eagerly cost ~3x the planes kernel
+# itself; holding the planes makes grouped decode = one fused kernel +
+# the validity unpack.
 
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class GroupedColumns:
     """Dtype-major decoded table backing.
 
-    ``g8``: uint32 [n8, n, 2] (64-bit values as LE word pairs),
-    ``g4``: uint32 [n4, n], ``g2``: uint32 [n2, n] (low 16 bits),
-    ``g1``: uint32 [n1, n] (low 8 bits), ``vmask``: uint8 [ncols, nb].
-    ``order[i]`` maps column i -> (width_class, index_within_class).
+    ``planes``: uint32 [W, n] decode word-planes (the inverse word plan:
+    64-bit columns as adjacent lo/hi plane pairs first, then 4-byte
+    planes, 16-bit halves packed two per plane, bytes four per plane);
+    ``vmask``: uint8 [ncols, ceil(n/8)] packed validity.
     """
 
-    g8: jnp.ndarray
-    g4: jnp.ndarray
-    g2: jnp.ndarray
-    g1: jnp.ndarray
+    planes: jnp.ndarray
     vmask: jnp.ndarray
     layout: RowLayout = None
 
     @property
     def num_rows(self) -> int:
-        # every group carries the exact n; vmask's byte count rounds up
-        for arr, axis in ((self.g4, 1), (self.g8, 1), (self.g2, 1),
-                          (self.g1, 1)):
-            if arr.size:
-                return arr.shape[axis]
-        return self.vmask.shape[1] * 8  # degenerate: no data columns
+        return self.planes.shape[1]
 
     def tree_flatten(self):
-        return (self.g8, self.g4, self.g2, self.g1, self.vmask), self.layout
+        return (self.planes, self.vmask), self.layout
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         return cls(*children, aux)
 
     def column(self, i: int) -> Column:
-        """Materialize one column (one slice + bitcast dispatch)."""
+        """Materialize one column (a plane slice + shift/bitcast)."""
         layout = self.layout
+        plan = _inverse_plan(layout)[0]
         dt = layout.dtypes[i]
-        cls_, k = _group_order(layout)[i]
+        sz = layout.col_sizes[i]
+        w0 = plan.col_word[i]
+        x = self.planes
         validity = self.vmask[i]
-        if cls_ == 8:
-            pair = self.g8[k]                      # [n, 2] u32
+        if sz == 8:
+            pair = jnp.stack([x[w0], x[w0 + 1]], axis=1)   # [n, 2] u32
             if jax.config.jax_enable_x64:
                 data = jax.lax.bitcast_convert_type(
                     jax.lax.bitcast_convert_type(pair, jnp.uint64),
                     dt.np_dtype)
             else:
                 data = pair
-        elif cls_ == 4:
-            data = jax.lax.bitcast_convert_type(self.g4[k], dt.np_dtype)
-        elif cls_ == 2:
-            data = jax.lax.bitcast_convert_type(
-                self.g2[k].astype(jnp.uint16), dt.np_dtype)
+        elif sz == 4:
+            data = jax.lax.bitcast_convert_type(x[w0], dt.np_dtype)
         else:
-            data = self.g1[k].astype(jnp.uint8)
-            if dt.np_dtype != np.uint8:
-                data = jax.lax.bitcast_convert_type(data, dt.np_dtype)
+            word = x[w0] >> (8 * plan.col_byte[i])
+            if sz == 2:
+                data = jax.lax.bitcast_convert_type(
+                    (word & 0xFFFF).astype(jnp.uint16), dt.np_dtype)
+            else:
+                data = (word & 0xFF).astype(jnp.uint8)
+                if dt.np_dtype != np.uint8:
+                    data = jax.lax.bitcast_convert_type(data, dt.np_dtype)
         return Column(dt, data, validity)
 
     def to_table(self) -> Table:
         return Table(tuple(self.column(i)
                            for i in range(self.layout.num_columns)))
-
-
-@functools.lru_cache(maxsize=64)
-def _group_order(layout: RowLayout):
-    counters = {8: 0, 4: 0, 2: 0, 1: 0}
-    order = []
-    for sz in layout.col_sizes:
-        order.append((sz, counters[sz]))
-        counters[sz] += 1
-    return tuple(order)
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2))
@@ -913,7 +905,6 @@ def _from_rows_grouped_jit(rows_flat: jnp.ndarray, layout: RowLayout,
         byte_planes_from_word_planes, packed_masks_from_byte_planes)
     plan, _ = _inverse_plan(layout)
     rows2d = rows_flat.reshape(-1, layout.fixed_row_size)
-    n = rows2d.shape[0]
     if mode == "xla":
         # numpy constant (NOT the cached device-array helper: jnp.asarray
         # inside a trace would cache a tracer in the lru_cache and leak)
@@ -921,44 +912,19 @@ def _from_rows_grouped_jit(rows_flat: jnp.ndarray, layout: RowLayout,
     else:
         x = _decode_planes_pallas_jit(rows_flat, layout,
                                       mode == "pallas_interpret")
-
-    counts = {8: 0, 4: 0, 2: 0, 1: 0}
-    for sz in layout.col_sizes:
-        counts[sz] += 1
-    n8, n4, n2, n1 = counts[8], counts[4], counts[2], counts[1]
-    pos = 0
-    g8 = jnp.transpose(x[:2 * n8].reshape(n8, 2, n), (0, 2, 1)) \
-        if n8 else jnp.zeros((0, n, 2), jnp.uint32)
-    pos += 2 * n8
-    g4 = x[pos:pos + n4]
-    pos += n4
-    w2 = (n2 + 1) // 2
-    if n2:
-        sh = jnp.tile(jnp.arange(2, dtype=jnp.uint32) * 16, w2)[:, None]
-        g2 = ((jnp.repeat(x[pos:pos + w2], 2, axis=0) >> sh)
-              & 0xFFFF)[:n2]
-    else:
-        g2 = jnp.zeros((0, n), jnp.uint32)
-    pos += w2
-    w1 = (n1 + 3) // 4
-    if n1:
-        g1 = byte_planes_from_word_planes(x[pos:pos + w1], n1)
-    else:
-        g1 = jnp.zeros((0, n), jnp.uint32)
-    pos += w1
-
     vbytes = layout.validity_bytes
     vw0 = plan.validity_word[0]
     vwq = (vbytes + 3) // 4
     vb = byte_planes_from_word_planes(x[vw0:vw0 + vwq], vbytes)
     vmask = packed_masks_from_byte_planes(vb, layout.num_columns)
-    return g8, g4, g2, g1, vmask
+    return x, vmask
 
 
 def from_rows_fixed_grouped(rows: jnp.ndarray, layout: RowLayout,
                             mode: str = None) -> GroupedColumns:
-    """Decode JCUDF rows to the dtype-major grouped backing (5 wide
-    outputs instead of one buffer per column)."""
-    g8, g4, g2, g1, vmask = _from_rows_grouped_jit(
+    """Decode JCUDF rows to the dtype-major grouped backing: the
+    ``[W, n]`` word-plane matrix plus packed validity, columns extracted
+    lazily (instead of one buffer per column)."""
+    planes, vmask = _from_rows_grouped_jit(
         rows.reshape(-1), layout, _decode_mode(rows, layout, mode))
-    return GroupedColumns(g8, g4, g2, g1, vmask, layout)
+    return GroupedColumns(planes, vmask, layout)

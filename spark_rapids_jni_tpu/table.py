@@ -251,6 +251,12 @@ class Column:
     # by ``offsets``); STRUCT holds one child per field (cudf
     # lists/structs_column_view analogue)
     children: tuple = ()
+    # True for width-capped padded string columns (an overflow tail was
+    # attached, see ``attach_string_tail``).  Rides in the pytree AUX so
+    # tracing preserves it even though the host-side tail itself cannot
+    # cross into jit — traced consumers that need full bytes check this
+    # flag and refuse loudly instead of scanning truncated data.
+    capped: bool = False
 
     # -- construction -----------------------------------------------------
 
@@ -519,11 +525,15 @@ class Column:
     def tree_flatten(self):
         children = (self.data, self.validity, self.offsets, self.chars,
                     self.chars2d, self.lens, self.children)
-        return children, self.dtype
+        return children, (self.dtype, self.capped)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(aux, *children)
+        if isinstance(aux, tuple):
+            dtype, capped = aux
+        else:  # pre-capped-flag pytrees
+            dtype, capped = aux, False
+        return cls(dtype, *children, capped=capped)
 
 
 def _column_from_python(values, dtype: DType) -> "Column":
@@ -667,6 +677,7 @@ def attach_string_tail(col: "Column", tail) -> "Column":
     if isinstance(tail, dict):
         tail = StringTail.from_dict(tail)
     object.__setattr__(col, "_string_tail", tail)
+    object.__setattr__(col, "capped", True)
     return col
 
 

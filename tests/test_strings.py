@@ -373,3 +373,26 @@ def test_datagen_skewed_profile(rng):
             v = vals[r]
             if v is not None:
                 assert len(v.encode()) == lens[r] == 500
+
+
+def test_width_cap_refusals_survive_jit(rng):
+    """The `capped` flag rides pytree aux, so hashing / get_json refuse
+    capped columns even under jit (where the host tail cannot exist) —
+    and hashing refuses eagerly when the tail attribute was lost."""
+    import jax
+    from spark_rapids_jni_tpu import Column
+    from spark_rapids_jni_tpu.ops.hashing import murmur3_hash
+    from spark_rapids_jni_tpu.ops.get_json import get_json_object
+    vals = _skewed_values(rng)
+    col = Column.strings_padded(vals, width_cap=32)
+
+    with pytest.raises(ValueError, match="eager|tail"):
+        jax.jit(lambda c: murmur3_hash([c]))(col)
+    with pytest.raises(ValueError, match="capped"):
+        get_json_object(col, "$.a")
+
+    # lost tail (manual reconstruction): loud, not silently truncated
+    stripped = Column(col.dtype, col.data, col.validity, col.offsets,
+                      None, col.chars2d)
+    with pytest.raises(ValueError, match="tail"):
+        murmur3_hash([stripped])
