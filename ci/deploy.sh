@@ -31,8 +31,19 @@ case "$DEPLOY_URL" in
             echo "https deploy needs twine installed"; exit 1; }
         twine upload --repository-url "$DEPLOY_URL" "${WHEELS[@]}"
         ;;
-    *)
+    file://*)
         DEST=${DEPLOY_URL#file://}
+        ;&
+    *://*)
+        if [ -z "${DEST:-}" ]; then
+            # an unrecognized scheme must not silently become a local dir
+            echo "unsupported DEPLOY_URL scheme: $DEPLOY_URL" \
+                 "(use https://, file://, or a directory path)" >&2
+            exit 1
+        fi
+        ;&
+    *)
+        DEST=${DEST:-$DEPLOY_URL}
         mkdir -p "$DEST"
         cp "${WHEELS[@]}" "$DEST/"
         ( cd "$DEST" && sha256sum *.whl > SHA256SUMS )

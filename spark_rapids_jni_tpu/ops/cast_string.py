@@ -30,6 +30,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spark_rapids_jni_tpu.table import (
     Column, DType, pack_bools,
@@ -914,3 +915,412 @@ def cast_int_to_string(col: Column) -> Column:
     from spark_rapids_jni_tpu.table import STRING
     return Column(STRING, jnp.zeros((0,), jnp.uint8),
                   col.validity, offs_j, chars)
+
+
+# ---------------------------------------------------------------------------
+# string -> date / timestamp
+# ---------------------------------------------------------------------------
+#
+# Spark CAST temporal grammar (Cast.stringToDate / stringToTimestamp,
+# UTC session zone):
+#   date:      [+-]y{1,7} | yyyy-[m]m | yyyy-[m]m-[d]d, with anything
+#              after 'T' or ' ' following a full date ignored
+#   timestamp: the date forms, optionally followed by
+#              [T| ][h]h:[m]m:[s]s[.f{1,6}][Z|UTC|[+-][h]h[:[m]m]]
+# Region-id zones are not supported (rows parse as invalid rather than
+# resolving a tz database).  All parsing is vectorized over the trimmed
+# window: per-field spans are found by sequential separator scans, field
+# values by positional powers-of-ten — static shapes throughout.
+
+TEMPORAL_PARSE_WIDTH = 40
+
+
+def _field_value(ch, dig, s, e):
+    """Integer value of digits in [s, e) per row (0 when empty); also
+    returns all-digits flag and length."""
+    W = ch.shape[1]
+    pos = jnp.arange(W, dtype=jnp.int32)[None, :]
+    in_f = (pos >= s[:, None]) & (pos < e[:, None])
+    flen = e - s
+    is_digit = (ch >= ord("0")) & (ch <= ord("9"))
+    ok = jnp.all(jnp.where(in_f, is_digit, True), axis=1)
+    p10 = jnp.asarray(np.power(10, np.arange(8), dtype=np.int64)
+                      .astype(np.int32))
+    expo = jnp.clip(e[:, None] - 1 - pos, 0, 7)
+    val = jnp.sum(jnp.where(in_f & is_digit, dig * p10[expo], 0), axis=1)
+    return val.astype(jnp.int32), ok, flen
+
+
+def _next_sep(ch, mask, start):
+    """First position >= start where mask is True (W when none)."""
+    W = ch.shape[1]
+    pos = jnp.arange(W, dtype=jnp.int32)[None, :]
+    hit = mask & (pos >= start[:, None])
+    return jnp.min(jnp.where(hit, pos, W), axis=1).astype(jnp.int32)
+
+
+def _days_from_civil(y, m, d):
+    """Proleptic-Gregorian days since 1970-01-01 (Hinnant's algorithm),
+    int32 vector arithmetic (valid for |year| <= ~500k)."""
+    y = y - (m <= 2)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400                                   # [0, 399]
+    mp = (m + 9) % 12                                     # Mar=0..Feb=11
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _is_leap(y):
+    return ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+
+
+def _days_in_month(y, m):
+    base = jnp.asarray(np.array(
+        [31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31], np.int32))
+    dim = base[jnp.clip(m - 1, 0, 11)]
+    return jnp.where((m == 2) & _is_leap(y), 29, dim)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _parse_temporal_jit(offsets, chars, width: int, want_time: bool):
+    """Shared date/timestamp field extraction.  Returns a dict of field
+    arrays + validity flags (all [n])."""
+    lead, trail, bounded = _trim_bounds(offsets, chars, TRIM_WIDTH)
+    lens = (offsets[1:] - offsets[:-1]).astype(jnp.int32)
+    tlen = jnp.maximum(lens - lead - trail, 0)
+    ch, _ = _gather_window_at(offsets[:-1].astype(jnp.int32) + lead,
+                              tlen, chars, width)
+    n = ch.shape[0]
+    i32 = jnp.int32
+    pos = jnp.arange(width, dtype=i32)[None, :]
+    in_str = pos < tlen[:, None]
+    dig = jnp.where((ch >= ord("0")) & (ch <= ord("9")),
+                    ch - ord("0"), 0).astype(i32)
+    punted = (~bounded) | (tlen > width)
+
+    first = ch[:, 0]
+    has_sign = (first == ord("+")) | (first == ord("-"))
+    neg_year = first == ord("-")
+    s0 = has_sign.astype(i32)
+
+    dash = (ch == ord("-")) & in_str
+    # year: [s0, dash1); month: (dash1, dash2); day: (dash2, date_end)
+    d1 = _next_sep(ch, dash, s0 + 1)
+    sep_dt = ((ch == ord("T")) | (ch == ord(" "))) & in_str
+    t_at = _next_sep(ch, sep_dt, s0)
+    y_end = jnp.minimum(jnp.minimum(d1, tlen), t_at)
+    year, y_ok, y_len = _field_value(ch, dig, s0, y_end)
+    year = jnp.where(neg_year, -year, year)
+    have_month = d1 < jnp.minimum(tlen, t_at)
+    d2 = _next_sep(ch, dash, d1 + 1)
+    m_end = jnp.minimum(jnp.minimum(d2, tlen), t_at)
+    month, m_ok, m_len = _field_value(ch, dig, d1 + 1, m_end)
+    have_day = d2 < jnp.minimum(tlen, t_at)
+    date_end = jnp.minimum(tlen, t_at)
+    day, dd_ok, d_len = _field_value(ch, dig, d2 + 1, date_end)
+
+    month_f = jnp.where(have_month, month, 1)
+    day_f = jnp.where(have_day, day, 1)
+    date_ok = y_ok & (y_len >= 1) & (y_len <= 7) \
+        & jnp.where(have_month, m_ok & (m_len >= 1) & (m_len <= 2), True) \
+        & jnp.where(have_day, dd_ok & (d_len >= 1) & (d_len <= 2), True) \
+        & (~have_day | have_month) \
+        & (month_f >= 1) & (month_f <= 12) \
+        & (day_f >= 1) & (day_f <= _days_in_month(year, month_f)) \
+        & ~((~have_month) & has_sign & (y_len == 0))
+    # a 'T'/' ' is only legal after a complete y-m-d date
+    has_t = t_at < tlen
+    date_ok = date_ok & (~has_t | (have_month & have_day))
+    # int32-day range guard: _days_from_civil wraps beyond ~year 5.8M
+    # (Spark's own catalyst DATE is int32 days and cannot hold it either)
+    date_ok = date_ok & (year >= -5_000_000) & (year <= 5_000_000)
+
+    out = dict(year=year, month=month_f, day=day_f, date_ok=date_ok,
+               punted=punted, tlen=tlen, has_time=jnp.zeros((n,), bool),
+               hour=jnp.zeros((n,), i32), minute=jnp.zeros((n,), i32),
+               sec=jnp.zeros((n,), i32), micros=jnp.zeros((n,), i32),
+               tz_min=jnp.zeros((n,), i32),
+               time_ok=jnp.ones((n,), bool))
+    if not want_time:
+        return out
+
+    colon = (ch == ord(":")) & in_str
+    ts = t_at + 1                                     # time start
+    has_time = has_t & (ts < tlen)
+    # a tz intro can follow ANY time prefix (Spark fills missing
+    # minute/second segments with zero: '12', '12:34', '12:34:56' all
+    # parse); search it from the time start
+    dotm = (ch == ord(".")) & in_str
+    tzm = ((ch == ord("+")) | (ch == ord("-")) | (ch == ord("Z"))
+           | (ch == ord("U"))) & in_str
+    tz_at = _next_sep(ch, tzm, ts)
+    t_end = jnp.minimum(tz_at, tlen)                  # end of hms[.f]
+    c1 = _next_sep(ch, colon, ts)
+    hour, h_ok, h_len = _field_value(ch, dig, ts,
+                                     jnp.minimum(c1, t_end))
+    have_min = c1 < t_end
+    c2 = _next_sep(ch, colon, c1 + 1)
+    minute, mi_ok, mi_len = _field_value(ch, dig, c1 + 1,
+                                         jnp.minimum(c2, t_end))
+    have_sec = c2 < t_end
+    dot_at = _next_sep(ch, dotm, c2 + 1)
+    s_end = jnp.minimum(dot_at, t_end)
+    sec, s_ok, s_len = _field_value(ch, dig, c2 + 1, s_end)
+    # fraction: digits after '.', up to the tz intro / end
+    f_end = t_end
+    frac, f_ok, f_len = _field_value(ch, dig, dot_at + 1, f_end)
+    has_frac = dot_at < t_end
+    p10 = jnp.asarray(np.power(10, np.arange(8), dtype=np.int64)
+                      .astype(np.int32))
+    micros = frac * p10[jnp.clip(6 - f_len, 0, 7)]
+
+    # timezone: Z | UTC | [+-][h]h[:[m]m]
+    has_tz = tz_at < tlen
+    tzc = ch[jnp.arange(n), jnp.clip(tz_at, 0, width - 1)]
+    is_z = tzc == ord("Z")
+    # 'UTC' literal
+    u_ok = jnp.ones((n,), bool)
+    for j, c in enumerate("UTC"):
+        at = jnp.clip(tz_at + j, 0, width - 1)
+        u_ok = u_ok & (ch[jnp.arange(n), at] == ord(c))
+    is_utc = (tzc == ord("U")) & u_ok & (tlen == tz_at + 3)
+    tz_sign = jnp.where(tzc == ord("-"), -1, 1).astype(i32)
+    is_off = (tzc == ord("+")) | (tzc == ord("-"))
+    tc = _next_sep(ch, colon, tz_at + 1)
+    tzh, tzh_ok, tzh_len = _field_value(ch, dig, tz_at + 1,
+                                        jnp.minimum(tc, tlen))
+    has_tzmin = tc < tlen
+    tzmin, tzmin_ok, tzmin_len = _field_value(ch, dig, tc + 1, tlen)
+    tzmin_eff = jnp.where(has_tzmin, tzmin, 0)
+    tz_ok = jnp.where(
+        is_z, tlen == tz_at + 1,
+        jnp.where(is_utc, True,
+                  jnp.where(is_off,
+                            tzh_ok & (tzh_len >= 1) & (tzh_len <= 2)
+                            # ZoneOffset caps at +/-18:00 exactly
+                            & (tzh * 60 + tzmin_eff <= 18 * 60)
+                            & jnp.where(has_tzmin,
+                                        tzmin_ok & (tzmin_len == 2)
+                                        & (tzmin <= 59), True),
+                            ~has_tz)))
+    tz_min_total = jnp.where(
+        is_off, tz_sign * (tzh * 60 + jnp.where(has_tzmin, tzmin, 0)),
+        0)
+
+    time_ok = jnp.where(
+        has_time,
+        h_ok & (h_len >= 1) & (h_len <= 2) & (hour <= 23)
+        & jnp.where(have_min,
+                    mi_ok & (mi_len >= 1) & (mi_len <= 2)
+                    & (minute <= 59), True)
+        & jnp.where(have_sec,
+                    s_ok & (s_len >= 1) & (s_len <= 2) & (sec <= 59),
+                    ~has_frac)   # a fraction needs a seconds field
+        & (have_min | ~have_sec)
+        & jnp.where(has_frac, f_ok & (f_len >= 1) & (f_len <= 6), True)
+        & tz_ok,
+        # date-only timestamp: nothing (or a bare 'T') after the date
+        ~has_t | (t_at + 1 >= tlen))
+    minute_f = jnp.where(has_time & have_min, minute, 0)
+    sec_f = jnp.where(has_time & have_sec, sec, 0)
+    out.update(has_time=has_time, hour=jnp.where(has_time, hour, 0),
+               minute=minute_f, sec=sec_f,
+               micros=jnp.where(has_time & has_frac, micros, 0),
+               tz_min=jnp.where(has_time, tz_min_total, 0),
+               time_ok=time_ok)
+    return out
+
+
+@func_range()
+def cast_string_to_date(col: Column, *, ansi: bool = False
+                        ) -> Tuple[Column, jnp.ndarray]:
+    """CAST(string AS DATE) with Spark semantics: returns an int32
+    days-since-epoch column + error mask (invalid rows null)."""
+    from spark_rapids_jni_tpu.table import DATE32
+    if not col.dtype.is_string:
+        raise ValueError("cast_string_to_date needs a string column")
+    if col.is_padded:
+        if isinstance(col.chars2d, jax.core.Tracer):
+            raise ValueError("cast_string_to_date: call eagerly")
+        col = col.to_arrow()
+    f = _parse_temporal_jit(col.offsets, col.chars,
+                            TEMPORAL_PARSE_WIDTH, False)
+    ok = f["date_ok"] & ~f["punted"] & (f["tlen"] > 0)
+    days = _days_from_civil(f["year"], f["month"], f["day"])
+    in_valid = col.valid_bools()
+    days, ok = _patch_temporal_punts(col, f["punted"], in_valid, days,
+                                     ok, _host_parse_date, "i32")
+    error = in_valid & ~ok
+    if not isinstance(error, jax.core.Tracer):
+        import numpy as np
+        if ansi and np.asarray(error).any():
+            bad = np.asarray(error)
+            raise ValueError(
+                f"ANSI cast failure: {int(bad.sum())} invalid date(s), "
+                f"first at row {int(bad.argmax())}")
+    return (Column(DATE32, days.astype(jnp.int32),
+                   pack_bools(in_valid & ok)), error)
+
+
+@func_range()
+def cast_string_to_timestamp(col: Column, *, ansi: bool = False
+                             ) -> Tuple[Column, jnp.ndarray]:
+    """CAST(string AS TIMESTAMP) with Spark semantics (UTC session
+    zone): int64 microseconds since epoch + error mask.  Offset zones
+    (Z/UTC/+hh:mm) are supported; region-id zones parse as invalid."""
+    from spark_rapids_jni_tpu.table import TIMESTAMP64
+    from spark_rapids_jni_tpu.ops.hashing import _add64, _mul64, _u64
+    if not col.dtype.is_string:
+        raise ValueError("cast_string_to_timestamp needs a string column")
+    if col.is_padded:
+        if isinstance(col.chars2d, jax.core.Tracer):
+            raise ValueError("cast_string_to_timestamp: call eagerly")
+        col = col.to_arrow()
+    f = _parse_temporal_jit(col.offsets, col.chars,
+                            TEMPORAL_PARSE_WIDTH, True)
+    ok = f["date_ok"] & f["time_ok"] & ~f["punted"] & (f["tlen"] > 0)
+    days = _days_from_civil(f["year"], f["month"], f["day"])
+    secs_of_day = f["hour"] * 3600 + f["minute"] * 60 + f["sec"] \
+        - f["tz_min"] * 60
+
+    def to_pair(x):  # sign-extended int32 -> (hi, lo) two's complement
+        u = jax.lax.bitcast_convert_type(x.astype(jnp.int32), jnp.uint32)
+        hi = jax.lax.bitcast_convert_type(x >> 31, jnp.uint32)
+        return (hi, u)
+
+    # micros = (days*86400 + secs_of_day) * 1e6 + frac  (mod-2^64 pair
+    # arithmetic == two's complement for signed values)
+    total_s = _add64(_mul64(to_pair(days), _u64(0, 86400)),
+                     to_pair(secs_of_day))
+    micros = _add64(_mul64(total_s, _u64(0, 1_000_000)),
+                    to_pair(f["micros"]))
+    if jax.config.jax_enable_x64:
+        data = (micros[0].astype(jnp.uint64) << jnp.uint64(32)
+                | micros[1].astype(jnp.uint64)).astype(jnp.int64)
+    else:
+        data = jnp.stack([micros[1], micros[0]], axis=1)  # LE pair repr
+    in_valid = col.valid_bools()
+    data, ok = _patch_temporal_punts(col, f["punted"], in_valid, data,
+                                     ok, _host_parse_timestamp, "i64")
+    error = in_valid & ~ok
+    if not isinstance(error, jax.core.Tracer):
+        import numpy as np
+        if ansi and np.asarray(error).any():
+            bad = np.asarray(error)
+            raise ValueError(
+                f"ANSI cast failure: {int(bad.sum())} invalid "
+                f"timestamp(s), first at row {int(bad.argmax())}")
+    return (Column(TIMESTAMP64, data, pack_bools(in_valid & ok)), error)
+
+
+def _host_parse_date(raw: bytes):
+    """Exact unbounded-grammar date parse for punted rows."""
+    import re
+    i, j = 0, len(raw)
+    while i < j and raw[i] <= 0x20:
+        i += 1
+    while j > i and raw[j - 1] <= 0x20:
+        j -= 1
+    try:
+        t = raw[i:j].decode("ascii")
+    except UnicodeDecodeError:
+        return None
+    m = re.fullmatch(
+        r"([+-]?\d{1,7})(?:-(\d{1,2})(?:-(\d{1,2})([T ].*)?)?)?", t)
+    if not m:
+        return None
+    y = int(m.group(1))
+    mo = int(m.group(2) or 1)
+    d = int(m.group(3) or 1)
+    if not (1 <= mo <= 12) or abs(y) > 5_000_000:
+        return None
+    base = [31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31]
+    leap = (y % 4 == 0 and y % 100 != 0) or y % 400 == 0
+    dim = 29 if (mo == 2 and leap) else base[mo - 1]
+    if not 1 <= d <= dim:
+        return None
+    yy = y - (mo <= 2)
+    era = (yy if yy >= 0 else yy - 399) // 400
+    yoe = yy - era * 400
+    mp = (mo + 9) % 12
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _host_parse_timestamp(raw: bytes):
+    """Exact unbounded-grammar timestamp parse for punted rows."""
+    import re
+    i, j = 0, len(raw)
+    while i < j and raw[i] <= 0x20:
+        i += 1
+    while j > i and raw[j - 1] <= 0x20:
+        j -= 1
+    try:
+        t = raw[i:j].decode("ascii")
+    except UnicodeDecodeError:
+        return None
+    m = re.fullmatch(
+        r"([+-]?\d{1,7})-(\d{1,2})-(\d{1,2})"
+        r"(?:[T ](?:(\d{1,2})(?::(\d{1,2})(?::(\d{1,2})"
+        r"(?:\.(\d{1,6}))?)?)?"
+        r"(Z|UTC|[+-]\d{1,2}(?::\d{2})?)?)?)?", t)
+    if not m:
+        # year / year-month forms are valid timestamps too — but unlike
+        # the DATE cast, nothing after the date may be ignored here
+        m2 = re.fullmatch(r"([+-]?\d{1,7})(?:-(\d{1,2}))?", t)
+        if not m2:
+            return None
+        days = _host_parse_date(
+            f"{m2.group(1)}-{m2.group(2) or 1}-1".encode())
+        return None if days is None else days * 86400 * 1_000_000
+    date_part = f"{m.group(1)}-{m.group(2)}-{m.group(3)}"
+    days = _host_parse_date(date_part.encode())
+    if days is None:
+        return None
+    h = int(m.group(4) or 0)
+    mi = int(m.group(5) or 0)
+    sec = int(m.group(6) or 0)
+    frac = m.group(7) or ""
+    us = int(frac.ljust(6, "0")) if frac else 0
+    if h > 23 or mi > 59 or sec > 59:
+        return None
+    off_min = 0
+    tz = m.group(8)
+    if tz and tz not in ("Z", "UTC"):
+        sign = -1 if tz[0] == "-" else 1
+        hh, _, mm = tz[1:].partition(":")
+        off_min = sign * (int(hh) * 60 + int(mm or 0))
+        if abs(off_min) > 18 * 60:
+            return None
+    secs = days * 86400 + h * 3600 + mi * 60 + sec - off_min * 60
+    return secs * 1_000_000 + us
+
+
+def _patch_temporal_punts(col, punted, in_valid, data, ok, host_fn,
+                          kind):
+    """Exact host parse for rows the static windows punt on (unbounded
+    trim / overlong tails), patched back in — the same pattern as the
+    numeric casts.  Under jit, punted rows stay conservatively null."""
+    punted_live = punted & in_valid
+    if isinstance(punted_live, jax.core.Tracer) \
+            or not bool(jnp.any(punted_live)):
+        return data, ok
+    offs = np.asarray(col.offsets)
+    chars_np = np.asarray(col.chars)
+    data_np = np.array(np.asarray(data))
+    ok_np = np.array(np.asarray(ok))
+    for r in np.nonzero(np.asarray(punted_live))[0]:
+        v = host_fn(chars_np[offs[r]:offs[r + 1]].tobytes())
+        if v is None:
+            ok_np[r] = False
+            continue
+        ok_np[r] = True
+        if kind == "i64" and data_np.ndim == 2:
+            two = v & 0xFFFFFFFFFFFFFFFF
+            data_np[r, 0] = two & 0xFFFFFFFF
+            data_np[r, 1] = two >> 32
+        else:
+            data_np[r] = v
+    return jnp.asarray(data_np), jnp.asarray(ok_np)

@@ -95,13 +95,19 @@ def might_contain(filt: MembershipFilter, cols: Sequence[Column],
                   seed: int = 42,
                   max_str_len: Optional[int] = None) -> jnp.ndarray:
     """Per-row membership test (the ``BloomFilterMightContain`` analogue):
-    True when the probe key's hash is present (or the probe key is null —
-    Spark's might-contain returns null for null input, which joins treat
-    as no-match; callers AND with validity as needed)."""
+    True when the probe key's hash is present.  Null probe rows are
+    always False — Spark's might-contain returns null for null input,
+    which joins treat as no-match (without the explicit mask, a null
+    row's hash chain would sit at the seed value and could match by
+    accident)."""
     h = murmur3_hash(cols, seed, max_str_len)
     if filt.hashes.shape[0] == 0:
         # empty build side (normal in dynamic pruning): nothing matches
         return jnp.zeros(h.shape, jnp.bool_)
     pos = jnp.searchsorted(filt.hashes, h)
     pos = jnp.minimum(pos, filt.hashes.shape[0] - 1)
-    return filt.hashes[pos] == h
+    result = filt.hashes[pos] == h
+    for c in cols:
+        if c.validity is not None:
+            result = result & c.valid_bools()
+    return result

@@ -94,6 +94,7 @@ _NP_DTYPES = {
     "uint64": np.uint64,
     "float32": np.float32, "float64": np.float64,
     "bool8": np.uint8,
+    "date32": np.int32, "timestamp_us": np.int64,
     "decimal32": np.int32, "decimal64": np.int64,
     # strings cross the row boundary as a uint32 (offset, length) pair
     "string": np.uint8,
@@ -111,6 +112,10 @@ FLOAT32 = DType("float32", 4)
 FLOAT64 = DType("float64", 8)
 BOOL8 = DType("bool8", 1)
 STRING = DType("string", 8)
+# Spark temporal types: DATE = int32 days since epoch, TIMESTAMP = int64
+# microseconds since epoch UTC (cudf TIMESTAMP_DAYS / _MICROSECONDS)
+DATE32 = DType("date32", 4)
+TIMESTAMP64 = DType("timestamp_us", 8)
 
 
 def decimal32(scale: int = 0) -> DType:

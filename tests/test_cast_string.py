@@ -322,3 +322,166 @@ def test_cast_string_to_decimal_ansi_and_nulls():
     assert np.asarray(err).tolist() == [False, False, True]
     with pytest.raises(ValueError, match="ANSI"):
         cast_string_to_decimal128(col, 2, ansi=True)
+
+
+# ---------------------------------------------------------------------------
+# string -> date / timestamp
+# ---------------------------------------------------------------------------
+
+DATE_CASES = [
+    "2023-01-15", "1970-01-01", "1969-12-31", "2000-02-29", "1900-02-28",
+    "2023-1-5", "2023-12", "2023", "+2023", "-0044", "0001-01-01",
+    "9999-12-31", "  2016-07-07  ", "2023-01-15T12:34:56", "2023-01-15 x",
+    "2023-01-15Tanything",
+    "2023-02-29", "2023-13-01", "2023-00-10", "2023-01-32", "2023-01-00",
+    "1900-02-29", "", "abc", "2023-", "2023--05", "20a3", "12:30:00",
+    "2023-01-15x",
+    "+2023-05-01", "-0044-03-15",                   # signed with month/day
+    "+9999999",                                     # int32-day overflow
+    "2023-01-15T" + "y" * 45,                       # punted: tail ignored
+    " " * 40 + "2016-07-07",                        # punted: long trim
+]
+
+
+def _oracle_date(s):
+    import datetime, re
+    i, j = 0, len(s)
+    while i < j and ord(s[i]) <= 0x20:
+        i += 1
+    while j > i and ord(s[j - 1]) <= 0x20:
+        j -= 1
+    t = s[i:j]
+    m = re.fullmatch(
+        r"([+-]?\d{1,7})(?:-(\d{1,2})(?:-(\d{1,2})([T ].*)?)?)?", t)
+    if not m:
+        return None
+    y = int(m.group(1))
+    mo = int(m.group(2) or 1)
+    d = int(m.group(3) or 1)
+    if not (1 <= mo <= 12) or abs(y) > 5_000_000:
+        return None
+    try:
+        if y < 1:  # python datetime can't do year<=0; use civil formula
+            from tests.test_cast_string import _days_civil_py
+            if d > _days_in_month_py(y, mo):
+                return None
+            return _days_civil_py(y, mo, d)
+        dt = datetime.date(y, mo, d)
+    except ValueError:
+        return None
+    return (dt - datetime.date(1970, 1, 1)).days
+
+
+def _days_in_month_py(y, m):
+    base = [31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31]
+    leap = (y % 4 == 0 and y % 100 != 0) or y % 400 == 0
+    return 29 if (m == 2 and leap) else base[m - 1]
+
+
+def _days_civil_py(y, m, d):
+    y -= m <= 2
+    era = (y if y >= 0 else y - 399) // 400
+    yoe = y - era * 400
+    mp = (m + 9) % 12
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def test_cast_string_to_date_matches_oracle(x64_both):
+    from spark_rapids_jni_tpu.ops import cast_string_to_date
+    col = Column.strings(DATE_CASES)
+    res, err = cast_string_to_date(col)
+    got = res.to_pylist()
+    err = np.asarray(err)
+    for i, s in enumerate(DATE_CASES):
+        want = _oracle_date(s)
+        if want is None:
+            assert got[i] is None and err[i], (repr(s), got[i])
+        else:
+            assert not err[i] and got[i] == want, (repr(s), got[i], want)
+
+
+TS_CASES = [
+    "2023-01-15 12:34:56", "2023-01-15T12:34:56", "2023-01-15",
+    "2023-01-15 00:00:00.5", "2023-01-15 23:59:59.999999",
+    "2023-01-15 12:34:56Z", "2023-01-15 12:34:56UTC",
+    "2023-01-15 12:34:56+05:30", "2023-01-15 12:34:56-08:00",
+    "2023-01-15 12:34:56+5", "1969-12-31 23:59:59.123",
+    "1970-01-01 00:00:00", "  2016-07-07 7:3:1  ",
+    "2023-01-15 24:00:00", "2023-01-15 12:60:00", "2023-01-15 12:34:61",
+    "2023-01-15 12:34:56.1234567", "2023-01-15 12:34",
+    "2023-01-15 12:34:56 PST", "bad", "",
+    "2023-01-15 12:34", "2023-01-15 12",            # partial times
+    "2023-01-15 12:34:56+18:30",                    # beyond ZoneOffset max
+    "2023-01-15 12:34:56+18:00", "2023-01-15 12+05:30",
+    "2023-01-15T" + "x" * 45,                       # punted: tail ignored
+    " " * 40 + "2023-01-15 06:07:08",               # punted: long trim
+]
+
+
+def _oracle_ts(s):
+    import datetime, re
+    i, j = 0, len(s)
+    while i < j and ord(s[i]) <= 0x20:
+        i += 1
+    while j > i and ord(s[j - 1]) <= 0x20:
+        j -= 1
+    t = s[i:j]
+    m = re.fullmatch(
+        r"(\d{4})-(\d{1,2})-(\d{1,2})"
+        r"(?:[T ](?:(\d{1,2})(?::(\d{1,2})(?::(\d{1,2})"
+        r"(?:\.(\d{1,6}))?)?)?"
+        r"(Z|UTC|[+-]\d{1,2}(?::\d{2})?)?)?)?", t)
+    if not m:
+        return None
+    y, mo, d = int(m.group(1)), int(m.group(2)), int(m.group(3))
+    h = int(m.group(4) or 0)
+    mi = int(m.group(5) or 0)
+    sec = int(m.group(6) or 0)
+    frac = m.group(7) or ""
+    us = int(frac.ljust(6, "0")) if frac else 0
+    tz = m.group(8)
+    if h > 23 or mi > 59 or sec > 59:
+        return None
+    try:
+        dt = datetime.date(y, mo, d)
+    except ValueError:
+        return None
+    days = (dt - datetime.date(1970, 1, 1)).days
+    off_min = 0
+    if tz and tz not in ("Z", "UTC"):
+        sign = -1 if tz[0] == "-" else 1
+        hh, _, mm = tz[1:].partition(":")
+        off_min = sign * (int(hh) * 60 + int(mm or 0))
+        if abs(off_min) > 18 * 60:
+            return None
+    secs = days * 86400 + h * 3600 + mi * 60 + sec - off_min * 60
+    return secs * 1_000_000 + us
+
+
+def test_cast_string_to_timestamp_matches_oracle(x64_both):
+    from spark_rapids_jni_tpu.ops import cast_string_to_timestamp
+    col = Column.strings(TS_CASES)
+    res, err = cast_string_to_timestamp(col)
+    got = res.to_pylist()
+    err = np.asarray(err)
+    for i, s in enumerate(TS_CASES):
+        want = _oracle_ts(s)
+        if want is None:
+            assert got[i] is None and err[i], (repr(s), got[i])
+        else:
+            assert not err[i] and got[i] == want, (repr(s), got[i], want)
+
+
+def test_cast_temporal_nulls_and_ansi():
+    from spark_rapids_jni_tpu.ops import (
+        cast_string_to_date, cast_string_to_timestamp)
+    col = Column.strings(["2023-01-15", None, "nope"])
+    res, err = cast_string_to_date(col)
+    assert res.to_pylist()[1] is None and res.to_pylist()[2] is None
+    assert np.asarray(err).tolist() == [False, False, True]
+    with pytest.raises(ValueError, match="ANSI"):
+        cast_string_to_date(col, ansi=True)
+    with pytest.raises(ValueError, match="ANSI"):
+        cast_string_to_timestamp(col, ansi=True)
