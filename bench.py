@@ -338,20 +338,27 @@ def _verify_fixed(num_rows, num_cols=212):
     table = create_random_table(dtypes, num_rows, seed=42)
     jax.block_until_ready(table)
     _log(f"verify fixed:{num_rows}: table ready")
-    batches = convert_to_rows(table, size_limit=1 << 29)
+    # 256MB batches: the per-batch gather-oracle transients scale with
+    # batch rows, and at 4M the table + all blobs + a 512MB-batch
+    # oracle's index matrices exceed HBM together
+    batches = convert_to_rows(table, size_limit=1 << 28)
     start = 0
     eq_bytes = jax.jit(lambda a, b: jnp.all(a.reshape(-1) == b.reshape(-1)))
-    for bi, b in enumerate(batches):
+    for bi in range(len(batches)):
+        b = batches[bi]
         n = b.num_rows
         sub = slice_table(table, start, start + n)
         # byte-exact vs the independent gather oracle (device compare)
         oracle = _oracle_to_rows_jit(sub, layout)
         assert bool(eq_bytes(b.data, oracle)), f"batch {bi} bytes differ"
+        del oracle
         # decode roundtrip, device compare
         got = convert_from_rows(b, dtypes)
         assert bool(_tables_equal_jit(sub, got)), \
             f"batch {bi} roundtrip mismatch"
         start += n
+        batches[bi] = None  # free checked blobs as we go (HBM headroom)
+        del b, sub, got
         _log(f"verify fixed:{num_rows}: batch {bi} ({n} rows) OK")
     assert start == num_rows
     print(f"VERIFY_OK fixed:{num_rows} batches={len(batches)}", flush=True)

@@ -274,8 +274,17 @@ void decode_variable(const Layout& layout, int64_t nrows,
   // read out of bounds.
   for (int64_t r = 0; r < nrows; ++r) {
     const uint8_t* row = blob + row_offsets[r];
-    const uint64_t row_extent =
-        static_cast<uint64_t>(row_offsets[r + 1] - row_offsets[r]);
+    // re-check the fixed-section bound here too, SIGNED (as pass 1
+    // does): a caller invoking the chars pass via the C ABI without a
+    // prior pass-1 call — truncated rows, or non-monotonic offsets
+    // whose negative extent would wrap an unsigned compare — must not
+    // read the (offset, length) pair itself out of bounds
+    const int64_t extent_s = row_offsets[r + 1] - row_offsets[r];
+    if (extent_s < static_cast<int64_t>(layout.fixed_end())) {
+      throw std::runtime_error("row " + std::to_string(r) +
+                               " shorter than its fixed section");
+    }
+    const uint64_t row_extent = static_cast<uint64_t>(extent_s);
     int32_t si = 0;
     for (int32_t c = 0; c < ncols; ++c) {
       if (!layout.is_string[c]) continue;

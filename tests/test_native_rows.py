@@ -166,3 +166,42 @@ def test_native_variable_roundtrip_and_cross_engine():
     np.testing.assert_array_equal(chars[0], str_ch)
     got_valid = np.unpackbits(vals[0], bitorder="little")[:n].astype(bool)
     np.testing.assert_array_equal(got_valid, valid)
+
+
+def test_decode_variable_pass2_truncated_row_rejected(rng):
+    """The chars pass must re-check the fixed-section bound itself (r2
+    advisor: invoked via the C ABI without a prior pass-1 call, it read
+    the (offset, length) pair before validating the row extent).  The
+    Python wrapper validates offsets up front, so this drives the raw C
+    ABI straight into pass 2 with a truncated and a NON-MONOTONIC row —
+    both must return an error, not read out of bounds."""
+    import ctypes
+    from spark_rapids_jni_tpu import Column, INT32, STRING, Table
+    from spark_rapids_jni_tpu.ops import convert_to_rows
+    from spark_rapids_jni_tpu.ops import native_rows as nrm
+    lib = nrm._lib()
+    t = Table((Column.from_numpy(np.arange(3, dtype=np.int32), INT32),
+               Column.strings(["aa", "bbb", "c"])))
+    [rows] = convert_to_rows(t)
+    blob = np.ascontiguousarray(np.asarray(rows.data), dtype=np.uint8)
+    offs = np.asarray(rows.offsets).astype(np.int64)
+    itemsizes, is_string = nrm._schema_arrays(t.dtypes)
+    nrows = 3
+    soffs = np.zeros(nrows + 1, np.int32)
+    chars_buf = np.zeros(64, np.uint8)
+    u8p_t = ctypes.POINTER(ctypes.c_uint8)
+    i32p_t = ctypes.POINTER(ctypes.c_int32)
+    soff_c = (i32p_t * 1)(nrm._i32p(soffs))
+    chars_c = (u8p_t * 1)(nrm._u8p(chars_buf))
+
+    for desc, mutate in (
+            ("truncated", lambda o: o.__setitem__(2, o[1] + 4)),
+            ("non-monotonic", lambda o: o.__setitem__(2, o[1] - 8))):
+        bad = offs.copy()
+        mutate(bad)
+        rc = lib.srj_rows_decode_variable(
+            2, nrows, nrm._i32p(itemsizes), nrm._u8p(is_string),
+            nrm._u8p(blob), nrm._i64p(bad), None, None, soff_c, chars_c)
+        assert rc != 0, f"{desc} row accepted by the chars pass"
+        assert "shorter than its fixed section" in \
+            nrm._loader.last_error(lib), desc
