@@ -27,4 +27,6 @@ from spark_rapids_jni_tpu.ops.decimal import (  # noqa: F401
     rescale_decimal128, sub_decimal128,
 )
 from spark_rapids_jni_tpu.ops import membership  # noqa: F401
+from spark_rapids_jni_tpu.ops import spark_bloom  # noqa: F401
+from spark_rapids_jni_tpu.ops.spark_bloom import SparkBloomFilter  # noqa: F401
 from spark_rapids_jni_tpu.ops.get_json import get_json_object  # noqa: F401

@@ -19,6 +19,10 @@ positives allowed, false negatives never) but swaps the data structure:
   with a single broadcast, like the reference broadcasts its bloom buffer.
 
 ``build``/``might_contain`` mirror the reference's aggregate/probe split.
+For the Spark boundary — a cluster handing over (or expecting) real
+``BloomFilterImpl`` bytes — use :mod:`ops.spark_bloom`, which is bit-
+and wire-compatible with Spark's sketch format; this module stays the
+TPU-native hot path inside the plan.
 """
 
 from __future__ import annotations

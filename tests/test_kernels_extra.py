@@ -286,3 +286,138 @@ def test_decimal128_to_strings():
     assert decimal128_to_strings(col0) == ["42", "-7"]
     coln = decimal128_from_ints([42], -2)
     assert decimal128_to_strings(coln) == ["4200"]
+
+
+# ---------------------------------------------------------------------------
+# Spark wire-compatible bloom filter
+# ---------------------------------------------------------------------------
+
+def _py_mm3_long(v, seed):
+    """Scalar reference of Murmur3_x86_32.hashLong (Spark sketch)."""
+    M = 0xFFFFFFFF
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (32 - r))) & M
+
+    def mix(h1, k1):
+        k1 = (k1 * 0xCC9E2D51) & M
+        k1 = rotl(k1, 15)
+        k1 = (k1 * 0x1B873593) & M
+        h1 ^= k1
+        h1 = rotl(h1, 13)
+        return (h1 * 5 + 0xE6546B64) & M
+
+    two = v & 0xFFFFFFFFFFFFFFFF
+    h1 = mix(seed & M, two & M)
+    h1 = mix(h1, two >> 32)
+    h1 ^= 8
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & M
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & M
+    return (h1 ^ (h1 >> 16)) & M
+
+
+def _py_bloom_bits(v, k, num_bits):
+    h1 = _py_mm3_long(v, 0)
+    h2 = _py_mm3_long(v, h1)
+    out = []
+    for i in range(1, k + 1):
+        c = (h1 + i * h2) & 0xFFFFFFFF
+        if c >= 1 << 31:  # int32 negative -> Spark flips the bits
+            c = (~c) & 0xFFFFFFFF
+        out.append(c % num_bits)
+    return out
+
+
+def test_spark_bloom_matches_scalar_reference(rng):
+    from spark_rapids_jni_tpu.ops.spark_bloom import (
+        SparkBloomFilter, _bit_indexes)
+    vals = np.array([0, 1, -1, 42, 2 ** 40, -(2 ** 40),
+                     int(rng.integers(-2 ** 62, 2 ** 62))], np.int64)
+    f = SparkBloomFilter.optimal(100, 0.03)
+    idx = _bit_indexes(vals.view(np.uint64), f.num_hash_functions,
+                       f.num_bits)
+    for r, v in enumerate(vals):
+        assert idx[r].tolist() == _py_bloom_bits(
+            int(v), f.num_hash_functions, f.num_bits), int(v)
+
+
+def test_spark_bloom_build_probe_merge(rng):
+    from spark_rapids_jni_tpu import Column, INT64
+    from spark_rapids_jni_tpu.ops.spark_bloom import SparkBloomFilter
+    keys = rng.integers(-10 ** 12, 10 ** 12, 500).astype(np.int64)
+    probe_hit = keys[::3]
+    probe_miss = rng.integers(2 * 10 ** 12, 3 * 10 ** 12,
+                              2000).astype(np.int64)
+    f = SparkBloomFilter.optimal(len(keys), 0.01)
+    f.put(Column.from_numpy(keys, INT64))
+    # no false negatives, ever
+    assert f.might_contain(
+        Column.from_numpy(probe_hit, INT64)).all()
+    # false-positive rate in the right ballpark for fpp=0.01
+    fp = f.might_contain(Column.from_numpy(probe_miss, INT64)).mean()
+    assert fp < 0.05, fp
+    # nulls probe False
+    got = f.might_contain(Column.from_numpy(
+        np.array([keys[0], keys[1]], np.int64), INT64,
+        valid=np.array([True, False])))
+    assert got.tolist() == [True, False]
+    # merge is a union
+    keys2 = rng.integers(10 ** 13, 2 * 10 ** 13, 100).astype(np.int64)
+    f2 = SparkBloomFilter.optimal(len(keys), 0.01)
+    f2.put(Column.from_numpy(keys2, INT64))
+    f.merge(f2)
+    assert f.might_contain(Column.from_numpy(keys2, INT64)).all()
+
+
+def test_spark_bloom_serialization_roundtrip(rng):
+    from spark_rapids_jni_tpu import Column, INT64
+    from spark_rapids_jni_tpu.ops.spark_bloom import SparkBloomFilter
+    keys = rng.integers(-10 ** 9, 10 ** 9, 200).astype(np.int64)
+    f = SparkBloomFilter.optimal(200, 0.03)
+    f.put(Column.from_numpy(keys, INT64))
+    blob = f.serialize()
+    # V1 header: version, k, numWords, big-endian
+    import struct
+    ver, k, nwords = struct.unpack_from(">iii", blob, 0)
+    assert ver == 1 and k == f.num_hash_functions
+    assert nwords == len(f.words)
+    g = SparkBloomFilter.deserialize(blob)
+    np.testing.assert_array_equal(g.words, f.words)
+    assert g.might_contain(Column.from_numpy(keys, INT64)).all()
+    with pytest.raises(ValueError, match="truncated"):
+        SparkBloomFilter.deserialize(blob[:10 + 8])
+
+
+def test_spark_bloom_pair_representation(rng):
+    """no-x64 uint32-pair longs hash identically to native int64."""
+    import jax
+    from spark_rapids_jni_tpu import Column, INT64
+    from spark_rapids_jni_tpu.ops.spark_bloom import SparkBloomFilter
+    keys = rng.integers(-10 ** 12, 10 ** 12, 64).astype(np.int64)
+    f = SparkBloomFilter.optimal(64, 0.03)
+    f.put(Column.from_numpy(keys, INT64))
+    with jax.enable_x64(False):
+        col_pair = Column.from_numpy(keys, INT64)
+        assert col_pair.data.ndim == 2
+        assert f.might_contain(col_pair).all()
+
+
+def test_spark_bloom_sizing_matches_spark_create():
+    """k must come from the UN-rounded optimalNumOfBits (Spark's
+    create()); hostile headers must be rejected."""
+    import math
+    from spark_rapids_jni_tpu.ops.spark_bloom import SparkBloomFilter
+    for n, fpp in [(10, 0.03), (100, 0.01), (1, 0.5), (1000, 0.03)]:
+        f = SparkBloomFilter.optimal(n, fpp)
+        bits = max(1, int(-n * math.log(fpp) / (math.log(2) ** 2)))
+        k_spark = max(1, round(bits / n * math.log(2)))
+        assert f.num_hash_functions == k_spark, (n, fpp)
+        assert len(f.words) == (bits + 63) // 64, (n, fpp)
+    import struct
+    for bad in (struct.pack(">iii", 1, 0, 2) + b"\0" * 16,
+                struct.pack(">iii", 1, 3, -1),
+                b"\0" * 8):
+        with pytest.raises(ValueError):
+            SparkBloomFilter.deserialize(bad)
