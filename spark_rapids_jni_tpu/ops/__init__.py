@@ -1,11 +1,13 @@
 from spark_rapids_jni_tpu.ops.row_layout import RowLayout, compute_row_layout  # noqa: F401
 from spark_rapids_jni_tpu.ops.cast_string import (  # noqa: F401
+    cast_date_to_string,
     cast_int_to_string,
     cast_string_to_date,
     cast_string_to_decimal128,
     cast_string_to_float,
     cast_string_to_int,
     cast_string_to_timestamp,
+    cast_timestamp_to_string,
 )
 from spark_rapids_jni_tpu.ops.row_conversion import (  # noqa: F401
     RowsColumn,

@@ -961,9 +961,12 @@ def _next_sep(ch, mask, start):
 
 def _days_from_civil(y, m, d):
     """Proleptic-Gregorian days since 1970-01-01 (Hinnant's algorithm),
-    int32 vector arithmetic (valid for |year| <= ~500k)."""
+    int32 vector arithmetic (valid for |year| <= ~500k).  Python ``//``
+    floors, so the era needs NO truncating-division compensation (the
+    textbook ``y - 399`` adjustment would double-compensate and shift
+    pre-year-0 era boundaries)."""
     y = y - (m <= 2)
-    era = jnp.where(y >= 0, y, y - 399) // 400
+    era = y // 400
     yoe = y - era * 400                                   # [0, 399]
     mp = (m + 9) % 12                                     # Mar=0..Feb=11
     doy = (153 * mp + 2) // 5 + d - 1
@@ -1241,7 +1244,7 @@ def _host_parse_date(raw: bytes):
     if not 1 <= d <= dim:
         return None
     yy = y - (mo <= 2)
-    era = (yy if yy >= 0 else yy - 399) // 400
+    era = yy // 400
     yoe = yy - era * 400
     mp = (mo + 9) % 12
     doy = (153 * mp + 2) // 5 + d - 1
@@ -1324,3 +1327,135 @@ def _patch_temporal_punts(col, punted, in_valid, data, ok, host_fn,
         else:
             data_np[r] = v
     return jnp.asarray(data_np), jnp.asarray(ok_np)
+
+
+# ---------------------------------------------------------------------------
+# date / timestamp -> string
+# ---------------------------------------------------------------------------
+
+def _civil_from_days(days, xp=jnp):
+    """Inverse of :func:`_days_from_civil`: days -> (y, m, d).
+
+    One implementation serves the device (``xp=jnp``) and the host
+    formatter (``xp=np``, exact int64).  NOTE: Hinnant's published
+    algorithm compensates for C's TRUNCATING division; Python's ``//``
+    already floors, so ``era = z // 146097`` directly (the textbook
+    ``z - 146096`` adjustment would shift every pre-0000-03-01 date by
+    a day)."""
+    z = days + 719468
+    era = z // 146097
+    doe = z - era * 146097                                # [0, 146096]
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)       # [0, 365]
+    mp = (5 * doy + 2) // 153                             # [0, 11]
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = xp.where(mp < 10, mp + 3, mp - 9)
+    return xp.where(m <= 2, y + 1, y), m, d
+
+
+def _write_digits(out, at, value, ndigits):
+    """Write ``value`` as ``ndigits`` zero-padded chars at column ``at``
+    of the [n, W] byte matrix (static columns)."""
+    for j in range(ndigits):
+        div = 10 ** (ndigits - 1 - j)
+        out = out.at[:, at + j].set(
+            (value // div % 10 + ord("0")).astype(jnp.uint8))
+    return out
+
+
+@jax.jit
+def _date_to_string_jit(days):
+    """int32 days -> ('yyyy-MM-dd' byte matrix [n, 10], in_range mask)
+    (Spark's Date.toString rendering for years 1..9999)."""
+    y, m, d = _civil_from_days(days.astype(jnp.int32))
+    n = days.shape[0]
+    out = jnp.zeros((n, 10), jnp.uint8)
+    out = _write_digits(out, 0, y, 4)
+    out = out.at[:, 4].set(ord("-"))
+    out = _write_digits(out, 5, m, 2)
+    out = out.at[:, 7].set(ord("-"))
+    out = _write_digits(out, 8, d, 2)
+    return out, (y >= 1) & (y <= 9999)
+
+
+@func_range()
+def cast_date_to_string(col: Column) -> Column:
+    """CAST(date AS STRING): 'yyyy-MM-dd' (years outside 1..9999 render
+    null — Spark widens the format there; bound your dates or format on
+    host for archaeology/astronomy ranges)."""
+    from spark_rapids_jni_tpu.table import STRING
+    if col.dtype.kind != "date32":
+        raise ValueError("cast_date_to_string needs a date32 column")
+    days = col.data.astype(jnp.int32)
+    mat, in_range = _date_to_string_jit(days)
+    valid = col.valid_bools() & in_range
+    lens = jnp.where(valid, 10, 0).astype(jnp.int32)
+    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(lens).astype(jnp.int32)])
+    return Column(STRING, jnp.zeros((0,), jnp.uint8), pack_bools(valid),
+                  offsets, None, jnp.where(valid[:, None], mat, 0))
+
+
+@func_range()
+def cast_timestamp_to_string(col: Column) -> Column:
+    """CAST(timestamp AS STRING), UTC: 'yyyy-MM-dd HH:mm:ss[.ffffff]'
+    with the fraction's trailing zeros trimmed, as Spark renders.
+
+    Host-boundary op (vectorized numpy; rendered strings leave the
+    device anyway): exact int64 arithmetic regardless of x64 mode."""
+    from spark_rapids_jni_tpu.table import STRING
+    if col.dtype.kind != "timestamp_us":
+        raise ValueError(
+            "cast_timestamp_to_string needs a timestamp_us column")
+    data = np.asarray(col.data)
+    if data.ndim == 2:                      # no-x64 uint32 pairs
+        micros = np.ascontiguousarray(data).view(np.int64).reshape(-1)
+    else:
+        micros = data.astype(np.int64)
+    days, us = np.divmod(micros, 86_400_000_000)   # floor: negatives ok
+    y, m, d = _civil_from_days(days, xp=np)        # exact host int64
+    sec, usec = np.divmod(us, 1_000_000)
+    hh, rem_s = np.divmod(sec, 3600)
+    mi, ss = np.divmod(rem_s, 60)
+
+    in_range = (y >= 1) & (y <= 9999)
+    n = len(micros)
+    mat = np.full((n, 26), ord("0"), np.uint8)
+
+    def put(at, val, nd):
+        v = val.astype(np.int64)
+        for j in range(nd):
+            mat[:, at + j] = v // (10 ** (nd - 1 - j)) % 10 + ord("0")
+
+    put(0, y, 4)
+    mat[:, 4] = ord("-")
+    put(5, m, 2)
+    mat[:, 7] = ord("-")
+    put(8, d, 2)
+    mat[:, 10] = ord(" ")
+    put(11, hh, 2)
+    mat[:, 13] = ord(":")
+    put(14, mi, 2)
+    mat[:, 16] = ord(":")
+    put(17, ss, 2)
+    mat[:, 19] = ord(".")
+    put(20, usec, 6)
+    # length: trim the fraction's trailing zeros; drop '.' when zero
+    frac_digits = np.full(n, 6, np.int64)
+    u = usec.copy()
+    for _ in range(6):
+        trim = (frac_digits > 0) & (u % 10 == 0)
+        u = np.where(trim, u // 10, u)
+        frac_digits = np.where(trim, frac_digits - 1, frac_digits)
+    lens = np.where(usec == 0, 19, 20 + frac_digits)
+    lens = np.where(in_range, lens, 0)
+    pos = np.arange(26)[None, :]
+    mat = np.where(pos < lens[:, None], mat, 0).astype(np.uint8)
+    valid = np.asarray(col.valid_bools()) & in_range
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum(lens * valid, out=offsets[1:])
+    return Column(STRING, jnp.zeros((0,), jnp.uint8),
+                  pack_bools(jnp.asarray(valid)),
+                  jnp.asarray(offsets.astype(np.int32)), None,
+                  jnp.asarray(np.where(valid[:, None], mat, 0)))

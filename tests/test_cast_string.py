@@ -380,7 +380,7 @@ def _days_in_month_py(y, m):
 
 def _days_civil_py(y, m, d):
     y -= m <= 2
-    era = (y if y >= 0 else y - 399) // 400
+    era = y // 400  # python // floors: no truncation compensation
     yoe = y - era * 400
     mp = (m + 9) % 12
     doy = (153 * mp + 2) // 5 + d - 1
@@ -485,3 +485,47 @@ def test_cast_temporal_nulls_and_ansi():
         cast_string_to_date(col, ansi=True)
     with pytest.raises(ValueError, match="ANSI"):
         cast_string_to_timestamp(col, ansi=True)
+
+
+def test_temporal_to_string_roundtrip(rng, x64_both):
+    """date/timestamp -> string renders Spark's formats and roundtrips
+    through the string->temporal casts."""
+    import datetime
+    from spark_rapids_jni_tpu.ops import (
+        cast_date_to_string, cast_timestamp_to_string,
+        cast_string_to_date, cast_string_to_timestamp)
+    from spark_rapids_jni_tpu.table import DATE32, TIMESTAMP64
+
+    days = np.array([0, -1, 19372, -719162, 2932896], np.int32)
+    # (1970-01-01, 1969-12-31, 2023-01-15, 0001-01-01, 9999-12-31)
+    col = Column.from_numpy(days, DATE32)
+    s = cast_date_to_string(col)
+    want = [(datetime.date(1970, 1, 1)
+             + datetime.timedelta(int(d))).isoformat() for d in days]
+    assert s.to_pylist() == want
+    back, err = cast_string_to_date(s)
+    assert not np.asarray(err).any()
+    assert np.asarray(back.data).tolist() == days.tolist()
+
+    micros = np.array([0, 1673740800000000, 1673766296250000,
+                       -1500000, 86399999999, -86400000000], np.int64)
+    tcol = Column.from_numpy(micros, TIMESTAMP64)
+    ts = cast_timestamp_to_string(tcol)
+    got = ts.to_pylist()
+    assert got[0] == "1970-01-01 00:00:00"
+    assert got[1] == "2023-01-15 00:00:00"
+    assert got[2] == "2023-01-15 07:04:56.25"
+    assert got[3] == "1969-12-31 23:59:58.5"
+    assert got[4] == "1970-01-01 23:59:59.999999"
+    assert got[5] == "1969-12-31 00:00:00"
+    back_ts, err = cast_string_to_timestamp(ts)
+    assert not np.asarray(err).any()
+    back_np = np.asarray(back_ts.data)
+    if back_np.ndim == 2:
+        back_np = np.ascontiguousarray(back_np).view(np.int64).reshape(-1)
+    assert back_np.tolist() == micros.tolist()
+
+    # out-of-render-range years null out
+    far = Column.from_numpy(np.array([4_000_000, -800_000], np.int32),
+                            DATE32)
+    assert cast_date_to_string(far).to_pylist() == [None, None]
