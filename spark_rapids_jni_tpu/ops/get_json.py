@@ -39,11 +39,15 @@ from spark_rapids_jni_tpu.table import Column, STRING, pack_bools
 from spark_rapids_jni_tpu.utils.tracing import func_range
 
 
+WILDCARD = object()   # the [*] path segment
+
+
 def _parse_path(path: str):
     """``$.a[0].b`` -> [b"a", 0, b"b"]: bytes for object keys, int for
-    array subscripts (``$[1].x`` and chained ``[i][j]`` work too).
-    ``[*]`` wildcards are not supported; we raise rather than silently
-    null (Spark nulls unsupported paths)."""
+    array subscripts (``$[1].x`` and chained ``[i][j]`` work too), the
+    ``WILDCARD`` sentinel for ``[*]`` (wildcard paths are evaluated on
+    the host — multiple matches per row defeat the single-capture device
+    automaton)."""
     import re
     if not path.startswith("$"):
         raise ValueError(f"JSON path must start with '$': {path!r}")
@@ -52,17 +56,19 @@ def _parse_path(path: str):
         raise ValueError("the identity path '$' is not supported")
     segs: List = []
     pos = 0
-    tok = re.compile(r"\.([^.\[\]]+)|\[(\d+)\]")
+    tok = re.compile(r"\.([^.\[\]]+)|\[(\d+)\]|\[(\*)\]")
     while pos < len(rest):
         m = tok.match(rest, pos)
         if not m:
             raise ValueError(f"unsupported JSON path syntax at "
                              f"{rest[pos:]!r} in {path!r} "
-                             "(keys and [integer] subscripts only)")
+                             "(keys, [integer] and [*] only)")
         if m.group(1) is not None:
             segs.append(m.group(1).encode("utf-8"))
-        else:
+        elif m.group(2) is not None:
             segs.append(int(m.group(2)))
+        else:
+            segs.append(WILDCARD)
         pos = m.end()
     if not segs:
         raise ValueError(f"empty JSON path: {path!r}")
@@ -326,6 +332,17 @@ def get_json_object(col: Column, path: str,
     if not col.dtype.is_string:
         raise ValueError("get_json_object needs a string column")
     segs = tuple(_parse_path(path))
+    if any(s is WILDCARD for s in segs):
+        # [*] can yield several matches per row; the single-capture scan
+        # cannot express that, so wildcard paths evaluate on the host
+        # (Spark semantics: 0 matches -> null, 1 -> the value, many ->
+        # a JSON array of the matches)
+        if any(isinstance(leaf, jax.core.Tracer)
+               for leaf in jax.tree_util.tree_leaves(col)):
+            raise ValueError(
+                "wildcard ([*]) JSON paths are host-evaluated: call "
+                "get_json_object eagerly, not under jit")
+        return _eval_wildcard_host(col, segs)
     if col.is_padded:
         from spark_rapids_jni_tpu.table import string_tail
         lens_np = np.asarray(col.str_lens()) \
@@ -447,35 +464,23 @@ def _host_fixup(result: Column, src: Column, path: str,
         chars = np.asarray(src.chars)
         src_text = {int(r): bytes(chars[o[r]:o[r + 1]]).decode(
             "utf-8", "replace") for r in flagged}
-    # streaming-compatible decode: FIRST occurrence wins for duplicate
-    # keys (matching the device automaton and Spark's streaming parser),
-    # and a valid JSON prefix with a malformed tail still extracts
+    # streaming-compatible decode (see _spark_decoder), prefix-tolerant:
+    # a valid JSON prefix with a malformed tail still extracts
     # (raw_decode stops at the first complete value)
-    def _first_wins(pairs):
-        d = {}
-        for k, v in pairs:
-            if k not in d:
-                d[k] = v
-        return d
-
-    decoder = json.JSONDecoder(object_pairs_hook=_first_wins)
+    decoder = _spark_decoder()
     patches = {}
     for r in flagged:
         try:
             obj, _ = decoder.raw_decode(src_text[int(r)].lstrip())
-            for s in segs:
-                if isinstance(s, int):
-                    if not isinstance(obj, list) or s >= len(obj):
-                        raise KeyError(s)
-                    obj = obj[s]
-                else:
-                    if not isinstance(obj, dict):
-                        raise KeyError(s)
-                    obj = obj[s]
+            matches = _walk_path(obj, segs)
+            if not matches:
+                raise KeyError(path)
+            obj = matches[0]
             if isinstance(obj, str):
                 text = obj
             else:
-                text = json.dumps(obj, separators=(",", ":"))
+                text = json.dumps(obj, separators=(",", ":"),
+                                  ensure_ascii=False)
             patches[r] = text.encode("utf-8")
         except Exception:
             valid[r] = False
@@ -496,3 +501,79 @@ def _host_fixup(result: Column, src: Column, path: str,
     return Column(STRING, jnp.zeros((0,), jnp.uint8),
                   pack_bools(jnp.asarray(valid)), jnp.asarray(offsets),
                   None, jnp.asarray(mat))
+
+
+def _spark_decoder() -> json.JSONDecoder:
+    """Streaming-compatible decoder: FIRST occurrence wins for duplicate
+    keys, matching the device automaton (shared by the host fixup and
+    the wildcard evaluator)."""
+    def _first_wins(pairs):
+        d = {}
+        for k, v in pairs:
+            if k not in d:
+                d[k] = v
+        return d
+
+    return json.JSONDecoder(object_pairs_hook=_first_wins)
+
+
+def _walk_path(obj, segs):
+    """All matches of ``segs`` under ``obj`` (first-wins duplicate keys
+    come from the decoder; wildcards fan out over list elements)."""
+    if not segs:
+        return [obj]
+    s, rest = segs[0], segs[1:]
+    if s is WILDCARD:
+        if not isinstance(obj, list):
+            return []
+        out = []
+        for el in obj:
+            out.extend(_walk_path(el, rest))
+        return out
+    if isinstance(s, int):
+        if not isinstance(obj, list) or s >= len(obj):
+            return []
+        return _walk_path(obj[s], rest)
+    key = s.decode() if isinstance(s, bytes) else s
+    if not isinstance(obj, dict) or key not in obj:
+        return []
+    return _walk_path(obj[key], rest)
+
+
+def _eval_wildcard_host(col: Column, segs) -> Column:
+    """Host evaluation of a wildcard path over the whole column (Spark
+    match-collection semantics; the same first-wins/prefix-tolerant
+    decoder as :func:`_host_fixup`)."""
+    decoder = _spark_decoder()
+    # pull raw bytes (decode with "replace" per row like _host_fixup:
+    # one invalid-UTF-8 row must null, not abort the whole column)
+    arrow = col.to_arrow()
+    offs = np.asarray(arrow.offsets)
+    chars = np.asarray(arrow.chars)
+    in_valid = np.asarray(col.valid_bools())
+    n = col.num_rows
+    out: List[Optional[str]] = []
+    for r in range(n):
+        if not in_valid[r]:
+            out.append(None)
+            continue
+        t = bytes(chars[offs[r]:offs[r + 1]]).decode("utf-8", "replace")
+        try:
+            obj, _ = decoder.raw_decode(t.lstrip())
+        except Exception:
+            out.append(None)
+            continue
+        matches = _walk_path(obj, list(segs))
+        if not matches:
+            out.append(None)
+        elif len(matches) == 1:
+            m = matches[0]
+            out.append(m if isinstance(m, str)
+                       else json.dumps(m, separators=(",", ":"),
+                                       ensure_ascii=False))
+        else:
+            # several matches render as a JSON array (strings quoted)
+            out.append("[" + ",".join(
+                json.dumps(m, separators=(",", ":"), ensure_ascii=False)
+                for m in matches) + "]")
+    return Column.strings_padded(out)

@@ -302,7 +302,8 @@ def test_array_elements_with_tricky_contents():
 
 def test_subscript_path_parse_errors():
     col = Column.strings(['{"a": [1]}'])
-    for bad in ("$.a[*]", "$.a[", "$.a[x]", "$.a[-1]", "$.a[0", "$a["):
+    # ($.a[*] is supported now — host-evaluated; see test_wildcard_paths)
+    for bad in ("$.a[", "$.a[x]", "$.a[-1]", "$.a[0", "$a["):
         with pytest.raises(ValueError):
             get_json_object(col, bad)
 
@@ -312,3 +313,58 @@ def test_big_index_and_many_elements():
     col = Column.strings(docs)
     assert get_json_object(col, "$.a[29]").to_pylist() == ["29"]
     assert get_json_object(col, "$.a[30]").to_pylist() == [None]
+
+
+def test_wildcard_paths():
+    """[*] collects matches host-side with Spark's rendering: 0 -> null,
+    1 -> the bare value, many -> a JSON array (strings quoted)."""
+    docs = [
+        '{"a": [1, 2, 3]}',
+        '{"a": [1]}',
+        '{"a": []}',
+        '{"a": "not-an-array"}',
+        '{"a": [{"b": 1}, {"b": 2}, {"x": 9}]}',
+        '{"a": [["x", "y"], ["z"]]}',
+        '{"a": [{"b": "s1"}, {"b": "s2"}]}',
+        None,
+        'bad json',
+    ]
+    col = Column.strings(docs)
+    got = get_json_object(col, "$.a[*]").to_pylist()
+    assert got[0] == "[1,2,3]"
+    assert got[1] == "1"
+    assert got[2] is None
+    assert got[3] is None
+    assert got[4] == '[{"b":1},{"b":2},{"x":9}]'
+    assert got[7] is None and got[8] is None
+
+    got_b = get_json_object(col, "$.a[*].b").to_pylist()
+    assert got_b[4] == "[1,2]"
+    assert got_b[6] == '["s1","s2"]'
+    assert got_b[0] is None
+
+    got_0 = get_json_object(col, "$.a[*][0]").to_pylist()
+    assert got_0[5] == '["x","z"]'
+
+    # single string match renders bare (unquoted)
+    one = Column.strings(['{"a": [{"b": "only"}]}'])
+    assert get_json_object(one, "$.a[*].b").to_pylist() == ["only"]
+
+
+def test_wildcard_non_ascii_and_bad_utf8():
+    """Wildcard rendering keeps raw UTF-8 (Spark/Jackson style, no
+    \\uXXXX escapes) and one invalid-UTF-8 row nulls without aborting
+    the column."""
+    col = Column.strings(['{"a": ["café", "b"]}'])
+    assert get_json_object(col, "$.a[*]").to_pylist() == ['["café","b"]']
+    # invalid utf-8 bytes in one row
+    good = '{"a": [1, 2]}'.encode()
+    bad = b'{"a": [1\xff]}'
+    chars = np.frombuffer(good + bad, np.uint8)
+    offsets = np.array([0, len(good), len(good) + len(bad)], np.int32)
+    import jax.numpy as jnp
+    from spark_rapids_jni_tpu import STRING
+    raw = Column(STRING, jnp.zeros((0,), jnp.uint8), None,
+                 jnp.asarray(offsets), jnp.asarray(chars))
+    got = get_json_object(raw, "$.a[*]").to_pylist()
+    assert got[0] == "[1,2]" and got[1] is None
