@@ -2,7 +2,7 @@ from spark_rapids_jni_tpu.models.pipeline import (  # noqa: F401
     filter_mask, hash_aggregate_sum, hash_aggregate_sum_multi,
     hash_aggregate_multi, project,
     sort_merge_join, sort_merge_join_dup, sort_merge_join_left,
-    join_semi_mask,
+    join_semi_mask, merge_aggregate_partials, sort_order,
     flagship_query_step, distributed_query_step, distributed_q72_step,
     distributed_q95_step,
 )

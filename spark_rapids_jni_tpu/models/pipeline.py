@@ -113,12 +113,25 @@ def hash_aggregate_sum(keys: jnp.ndarray, values: jnp.ndarray,
     return gkeys, sums, have, num_groups
 
 
-def _lexsort_live_last(keys, mask):
+def _lexsort_live_last(keys, mask, descending=None):
     """Stable lexicographic order over multiple int key arrays (first key
     is the major one), with masked-out rows pushed to the end via max-key
-    sentinels.  Returns (order, sorted_keys, sorted_live)."""
+    sentinels.  ``descending[i]`` reverses key i via the ``~k`` bijection
+    (order-reversing for signed AND unsigned ints, no overflow).
+
+    Sentinel caveat: a LIVE key equal to the sentinel's preimage — dtype
+    max ascending, dtype min descending — ties with masked rows and may
+    interleave with them; consumers that must distinguish carry liveness
+    alongside (``mask[order]``), as the aggregates here do.
+
+    Returns (order, sorted_transformed_keys, sorted_live)."""
     n = keys[0].shape[0]
-    ks = [jnp.where(mask, k, jnp.iinfo(k.dtype).max) for k in keys]
+    desc = descending or [False] * len(keys)
+    ks = []
+    for k, d in zip(keys, desc):
+        if d:
+            k = ~k
+        ks.append(jnp.where(mask, k, jnp.iinfo(k.dtype).max))
     order = jnp.arange(n, dtype=jnp.int32)
     for k in reversed(ks):       # chained stable sorts = lexicographic
         order = order[jnp.argsort(k[order], stable=True)]
@@ -509,3 +522,63 @@ def distributed_q95_step(mesh, axis_name="data",
     return shard_map(step, mesh=mesh,
                      in_specs=(spec, spec, spec, rep),
                      out_specs=(spec,) * 7 + (spec,), check_vma=False)
+
+
+def sort_order(keys: Sequence[jnp.ndarray],
+               mask: Optional[jnp.ndarray] = None,
+               descending: Optional[Sequence[bool]] = None) -> jnp.ndarray:
+    """Row order for a multi-key ORDER BY: stable lexicographic sort over
+    int key arrays (first key major), masked-out rows last.
+
+    ``descending[i]`` flips key i's direction.  Returns int32 [n] gather
+    indices (apply with ``data[order]``; liveness travels as
+    ``mask[order]`` — see :func:`_lexsort_live_last` for the sentinel
+    tie caveat at the extreme key value)."""
+    n = keys[0].shape[0]
+    if mask is None:
+        mask = jnp.ones((n,), jnp.bool_)
+    if descending is not None and len(descending) != len(keys):
+        raise ValueError("descending flags must match the key count")
+    return _lexsort_live_last(list(keys), mask, descending)[0]
+
+
+def merge_aggregate_partials(partials, ops: Sequence[str]):
+    """Combine per-device partial aggregates into final groups (the
+    second phase of Spark's partial/final aggregation — q95's exchange
+    partitions by ORDER key, so a ship-date group's pieces land on
+    several devices and must merge).
+
+    ``partials``: iterable of (gkeys_list, outs_list, have) triples as
+    the distributed steps return (arrays may carry leading device axes;
+    they are flattened).  ``ops``: the measure ops, matching
+    :func:`hash_aggregate_multi` (``avg`` partials cannot merge — carry
+    sum and count and divide here instead).  Host-side: final groups are
+    small.  Returns (keys_tuple -> [merged measures]) dict."""
+    import numpy as np
+    for op in ops:
+        if op == "avg":
+            raise ValueError(
+                "avg partials do not merge; aggregate sum and count "
+                "partials and divide after merging")
+        if op not in _AGG_OPS:
+            raise ValueError(f"unknown aggregate op {op!r}")
+    out = {}
+    for gkeys, outs, have in partials:
+        hv = np.asarray(have).reshape(-1)
+        gk = [np.asarray(k).reshape(-1) for k in gkeys]
+        ms = [np.asarray(m).reshape(-1) for m in outs]
+        for j in np.nonzero(hv)[0]:
+            key = tuple(int(k[j]) for k in gk)
+            vals = [m[j] for m in ms]
+            if key not in out:
+                out[key] = list(vals)
+                continue
+            acc = out[key]
+            for i, op in enumerate(ops):
+                if op in ("sum", "count"):
+                    acc[i] = acc[i] + vals[i]
+                elif op == "min":
+                    acc[i] = min(acc[i], vals[i])
+                else:
+                    acc[i] = max(acc[i], vals[i])
+    return out

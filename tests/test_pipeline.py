@@ -424,3 +424,58 @@ def test_distributed_q95_step(rng, cpu_devices):
             got[key] = (c0 + int(cnt[j]), s0 + int(s[j]),
                         min(mn0, int(mn[j])), max(mx0, int(mx[j])))
     assert got == exp
+
+
+def test_sort_order_multi_key(rng):
+    from spark_rapids_jni_tpu.models import sort_order
+    a = rng.integers(0, 5, 100).astype(np.int32)
+    b = rng.integers(-50, 50, 100).astype(np.int32)
+    mask = rng.random(100) > 0.2
+    order = np.asarray(sort_order([jnp.asarray(a), jnp.asarray(b)],
+                                  jnp.asarray(mask)))
+    live = int(mask.sum())
+    got = list(zip(a[order][:live].tolist(), b[order][:live].tolist()))
+    want = sorted((int(x), int(y))
+                  for x, y, m in zip(a, b, mask) if m)
+    assert got == want
+    assert not mask[order][live:].any()
+    # descending major key
+    order_d = np.asarray(sort_order(
+        [jnp.asarray(a), jnp.asarray(b)], jnp.asarray(mask),
+        descending=[True, False]))
+    got_d = list(zip(a[order_d][:live].tolist(),
+                     b[order_d][:live].tolist()))
+    want_d = sorted(((int(x), int(y))
+                     for x, y, m in zip(a, b, mask) if m),
+                    key=lambda t: (-t[0], t[1]))
+    assert got_d == want_d
+
+
+def test_merge_aggregate_partials(rng):
+    from spark_rapids_jni_tpu.models import (
+        hash_aggregate_multi, merge_aggregate_partials)
+    n = 300
+    keys = rng.integers(0, 9, n).astype(np.int32)
+    vals = rng.integers(-40, 40, n).astype(np.int32)
+    mask = rng.random(n) > 0.25
+    # two "devices": split rows, aggregate partially, then merge
+    partials = []
+    for lo, hi in ((0, 150), (150, 300)):
+        gk, outs, have, _ = hash_aggregate_multi(
+            [jnp.asarray(keys[lo:hi])],
+            [(jnp.asarray(vals[lo:hi]), "sum"),
+             (jnp.asarray(vals[lo:hi]), "count"),
+             (jnp.asarray(vals[lo:hi]), "min"),
+             (jnp.asarray(vals[lo:hi]), "max")],
+            jnp.asarray(mask[lo:hi]), 32)
+        partials.append((gk, outs, have))
+    merged = merge_aggregate_partials(partials,
+                                      ["sum", "count", "min", "max"])
+    for k in np.unique(keys[mask]):
+        sel = mask & (keys == k)
+        s, c, mn, mx = merged[(int(k),)]
+        assert s == vals[sel].sum() and c == sel.sum()
+        assert mn == vals[sel].min() and mx == vals[sel].max()
+    assert len(merged) == len(np.unique(keys[mask]))
+    with pytest.raises(ValueError, match="avg"):
+        merge_aggregate_partials(partials, ["avg"] * 4)
