@@ -593,25 +593,7 @@ def _from_rows_mxu_jit(rows_flat: jnp.ndarray, layout: RowLayout,
     # reshape inside the jit: an eager reshape is a separate dispatched
     # copy of the whole blob on remote-tunnel backends
     rows2d = rows_flat.reshape(-1, layout.fixed_row_size)
-    if mode == "xla":
-        # numpy constant (NOT the cached device-array helper: jnp.asarray
-        # inside a trace would cache a tracer in the lru_cache and leak)
-        x = _decode_planes(rows2d, layout, _inverse_plan(layout)[1])
-    else:
-        x = _decode_planes_pallas_jit(rows_flat, layout,
-                                      mode == "pallas_interpret")
-
-    # validity: expand the quad-packed validity byte planes to one bit
-    # plane per column (shared TPU-safe expansion; see
-    # ``packed_masks_from_byte_planes``)
-    from spark_rapids_jni_tpu.table import (
-        byte_planes_from_word_planes, packed_masks_from_byte_planes)
-    ncols = layout.num_columns
-    vbytes = layout.validity_bytes
-    vw0 = plan.validity_word[0]
-    vwq = (vbytes + 3) // 4
-    vb = byte_planes_from_word_planes(x[vw0:vw0 + vwq], vbytes)
-    vmask = packed_masks_from_byte_planes(vb, ncols)         # [ncols, nb]
+    x, vmask = _planes_and_vmask(rows_flat, layout, mode)
 
     # 64-bit columns sit first in the word plan as one contiguous plane
     # block: un-planarize them all with ONE batched transpose instead of a
@@ -690,7 +672,20 @@ def _inverse_p3k_np(layout: RowLayout) -> np.ndarray:
         np.transpose(p, (2, 1, 0)).reshape(-1, p.shape[0]))
 
 
-def _fused_decode_kernel(W, p3_ref, rows_ref, out_ref):
+@functools.lru_cache(maxsize=2)
+def _pack_w_np(T: int) -> np.ndarray:
+    """[T, T/8] int8 bit-pack weights: packing 8 consecutive rows into a
+    validity byte is a matmul over the row axis (1<<t at (8j+t, j);
+    int8 wraps 128 to -128, congruent mod 256)."""
+    w = np.zeros((T, T // 8), np.uint8)
+    for j in range(T // 8):
+        for t in range(8):
+            w[8 * j + t, j] = 1 << t
+    return w.view(np.int8)
+
+
+def _fused_decode_kernel(W, ncols, vw0, vbytes, p3_ref, w8_ref,
+                         rows_ref, x_ref, vm_ref, bits_ref):
     o = jax.lax.dot_general(
         p3_ref[...], rows_ref[...].astype(jnp.int8),
         (((1,), (1,)), ((), ())),
@@ -699,28 +694,61 @@ def _fused_decode_kernel(W, p3_ref, rows_ref, out_ref):
         | ((o[1 * W:2 * W] & 0xFF).astype(jnp.uint32) << 8) \
         | ((o[2 * W:3 * W] & 0xFF).astype(jnp.uint32) << 16) \
         | ((o[3 * W:4 * W] & 0xFF).astype(jnp.uint32) << 24)
-    out_ref[...] = x
+    x_ref[...] = x
+    # validity: unpack the quad-packed bytes to one 0/1 row per column,
+    # then bit-pack 8 rows per byte with the MXU (the XLA pack stage
+    # this replaces was ~half of grouped-decode time)
+    for b in range(vbytes):
+        vb = (x[vw0 + b // 4] >> (8 * (b % 4))) & 0xFF
+        for j in range(8):
+            c = 8 * b + j
+            if c >= ncols:
+                break
+            bits_ref[c, :] = ((vb >> j) & 1).astype(jnp.int8)
+    vm = jax.lax.dot_general(
+        bits_ref[...], w8_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)        # [ncols, T/8]
+    vm_ref[...] = vm.astype(jnp.uint8)
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2))
 def _decode_planes_pallas_jit(rows_flat: jnp.ndarray, layout: RowLayout,
-                              interpret: bool) -> jnp.ndarray:
+                              interpret: bool):
+    """One fused kernel: blob -> ([W, n] u32 word planes,
+    [ncols, ceil(n/8)] packed validity)."""
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
     plan = _inverse_plan(layout)[0]
     W = plan.num_words
     rs = layout.fixed_row_size
     rows2d = rows_flat.reshape(-1, rs)
     n = rows2d.shape[0]
+    ncols = layout.num_columns
+    vbytes = layout.validity_bytes
+    vw0 = plan.validity_word[0]
     T = _FUSE_TILE
     p3 = jnp.asarray(_inverse_p3k_np(layout))
-    return pl.pallas_call(
-        functools.partial(_fused_decode_kernel, W),
+    w8 = jnp.asarray(_pack_w_np(T))
+    nb = (n + 7) // 8
+    x, vm = pl.pallas_call(
+        functools.partial(_fused_decode_kernel, W, ncols, vw0, vbytes),
         grid=((n + T - 1) // T,),
         in_specs=[pl.BlockSpec((4 * W, rs), lambda i: (0, 0)),
+                  pl.BlockSpec((T, T // 8), lambda i: (0, 0)),
                   pl.BlockSpec((T, rs), lambda i: (i, 0))],
-        out_specs=pl.BlockSpec((W, T), lambda i: (0, i)),
-        out_shape=jax.ShapeDtypeStruct((W, n), jnp.uint32),
-        interpret=interpret)(p3, rows2d)
+        out_specs=[pl.BlockSpec((W, T), lambda i: (0, i)),
+                   pl.BlockSpec((ncols, T // 8), lambda i: (0, i))],
+        out_shape=[jax.ShapeDtypeStruct((W, n), jnp.uint32),
+                   jax.ShapeDtypeStruct((ncols, nb), jnp.uint8)],
+        scratch_shapes=[pltpu.VMEM((ncols, T), jnp.int8)],
+        interpret=interpret)(p3, w8, rows2d)
+    if n % 8:
+        # the last validity byte mixes valid rows with the partial
+        # tile's garbage rows: mask bits past n (XLA zeroes them)
+        tail = jnp.full((nb,), 255, jnp.uint8) \
+            .at[nb - 1].set((1 << (n % 8)) - 1)
+        vm = vm & tail[None, :]
+    return x, vm
 
 
 def _decode_planes(rows2d: jnp.ndarray, layout: RowLayout, p3) -> jnp.ndarray:
@@ -898,26 +926,32 @@ class GroupedColumns:
                            for i in range(self.layout.num_columns)))
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
-def _from_rows_grouped_jit(rows_flat: jnp.ndarray, layout: RowLayout,
-                           mode: str = "xla"):
+def _planes_and_vmask(rows_flat, layout: RowLayout, mode: str):
+    """Decode planes + packed validity via the mode's engine: the fused
+    Pallas kernel emits both in one pass; the XLA path packs validity
+    with the shared bit-plane helpers."""
+    if mode != "xla":
+        return _decode_planes_pallas_jit(rows_flat, layout,
+                                         mode == "pallas_interpret")
     from spark_rapids_jni_tpu.table import (
         byte_planes_from_word_planes, packed_masks_from_byte_planes)
-    plan, _ = _inverse_plan(layout)
+    plan = _inverse_plan(layout)[0]
     rows2d = rows_flat.reshape(-1, layout.fixed_row_size)
-    if mode == "xla":
-        # numpy constant (NOT the cached device-array helper: jnp.asarray
-        # inside a trace would cache a tracer in the lru_cache and leak)
-        x = _decode_planes(rows2d, layout, _inverse_plan(layout)[1])
-    else:
-        x = _decode_planes_pallas_jit(rows_flat, layout,
-                                      mode == "pallas_interpret")
+    # numpy constant (NOT the cached device-array helper: jnp.asarray
+    # inside a trace would cache a tracer in the lru_cache and leak)
+    x = _decode_planes(rows2d, layout, _inverse_plan(layout)[1])
     vbytes = layout.validity_bytes
     vw0 = plan.validity_word[0]
     vwq = (vbytes + 3) // 4
     vb = byte_planes_from_word_planes(x[vw0:vw0 + vwq], vbytes)
     vmask = packed_masks_from_byte_planes(vb, layout.num_columns)
     return x, vmask
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _from_rows_grouped_jit(rows_flat: jnp.ndarray, layout: RowLayout,
+                           mode: str = "xla"):
+    return _planes_and_vmask(rows_flat, layout, mode)
 
 
 def from_rows_fixed_grouped(rows: jnp.ndarray, layout: RowLayout,
