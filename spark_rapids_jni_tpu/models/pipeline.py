@@ -569,7 +569,10 @@ def merge_aggregate_partials(partials, ops: Sequence[str]):
         ms = [np.asarray(m).reshape(-1) for m in outs]
         for j in np.nonzero(hv)[0]:
             key = tuple(int(k[j]) for k in gk)
-            vals = [m[j] for m in ms]
+            # Python scalars, not numpy: int32 SUM/COUNT partials must
+            # merge with arbitrary precision (Spark's final aggregation
+            # widens to long), not wrap at the numpy dtype
+            vals = [m[j].item() for m in ms]
             if key not in out:
                 out[key] = list(vals)
                 continue

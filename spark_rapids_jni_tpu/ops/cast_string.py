@@ -351,9 +351,12 @@ def _cast_string_to_float_jit(offsets, chars, width: int):
 
     Validates Spark's float grammar over the trimmed window —
     ``[sign] (digits[.digits] | .digits) [eE[sign]digits] [fFdD]`` — and
-    classifies the special literals (``inf``/``+inf``/``-inf``/
-    ``infinity``/``nan``, case-insensitive, Spark
-    ``processFloatingPointSpecialLiterals``).  The numeric value itself
+    classifies the special literals with Spark's two-stage semantics:
+    Java ``Float.parseFloat`` first (case-SENSITIVE ``[+-]?NaN`` /
+    ``[+-]?Infinity``), then ``processFloatingPointSpecialLiterals`` on
+    the lowercased trim (case-insensitive inf/infinity any sign, but
+    ``nan`` only UNSIGNED).  Hex float literals (``0x1p3`` — Java
+    parseFloat accepts them) punt to the host parser.  The numeric value itself
     is produced on the host by exact strtod over the same window (the
     decimal->binary correctly-rounded conversion is host work; device
     owns shape/validity).  Returns (window, tlen, valid, special_cls,
@@ -371,10 +374,11 @@ def _cast_string_to_float_jit(offsets, chars, width: int):
         | ((ch >= ord("a")) & (ch <= ord("z")))
     low = jnp.where(is_alpha, ch | 0x20, ch)
 
-    def lit(s, start):
+    def lit(s, start, mat=None):
         m = jnp.ones((n,), jnp.bool_)
+        src = low if mat is None else mat
         for j, c in enumerate(s):
-            m = m & (low[:, start + j] == ord(c)) \
+            m = m & (src[:, start + j] == ord(c)) \
                 if start + j < width else jnp.zeros((n,), jnp.bool_)
         return m
 
@@ -383,15 +387,21 @@ def _cast_string_to_float_jit(offsets, chars, width: int):
     negative = first == ord("-")
     s0 = has_sign.astype(jnp.int32)
     body_len = tlen - s0
-    # specials measured after the sign
+    # specials measured after the sign.  Spark's two-stage behavior:
+    # Java Float.parseFloat first (case-SENSITIVE, accepts signed 'NaN'
+    # and 'Infinity'), then processFloatingPointSpecialLiterals on the
+    # lowercased trim — whose nan arm matches only the unsigned literal.
+    # Net: inf/infinity are case-insensitive with optional sign; nan is
+    # case-insensitive only UNSIGNED, while '+NaN'/'-NaN' must be
+    # exact-case to parse.
     inf3 = jnp.zeros((n,), jnp.bool_)
     inf8 = jnp.zeros((n,), jnp.bool_)
-    nan3 = jnp.zeros((n,), jnp.bool_)
+    nan3 = lit("nan", 0) & (body_len == 3) & ~has_sign
     for st in (0, 1):
         sel = s0 == st
         inf3 = inf3 | (sel & lit("inf", st) & (body_len == 3))
         inf8 = inf8 | (sel & lit("infinity", st) & (body_len == 8))
-        nan3 = nan3 | (sel & lit("nan", st) & (body_len == 3) & ~negative)
+        nan3 = nan3 | (sel & lit("NaN", st, ch) & (body_len == 3))
     is_inf = inf3 | inf8
     special_cls = jnp.where(nan3, 3,
                             jnp.where(is_inf & negative, 2,
@@ -430,7 +440,13 @@ def _cast_string_to_float_jit(offsets, chars, width: int):
         True)
     finite_ok = mant_ok & one_dot & dot_in_mant & (mant_digits > 0) \
         & exp_ok & (glen > s0)
-    punted = (~bounded) | (tlen > width)
+    # Java parseFloat also accepts hex literals (0x1.8p1): the digit
+    # grammar cannot value them, so they ride the host punt path
+    x_at = jnp.clip(s0 + 1, 0, width - 1)
+    is_hex = (ch[jnp.arange(n), jnp.clip(s0, 0, width - 1)] == ord("0")) \
+        & ((ch[jnp.arange(n), x_at] | 0x20) == ord("x")) \
+        & (body_len > 2)
+    punted = (~bounded) | (tlen > width) | is_hex
     valid = jnp.where(special_cls > 0, True, finite_ok) & ~punted
     return ch, tlen, valid, special_cls, has_suffix, punted
 
@@ -439,9 +455,10 @@ def _cast_string_to_float_jit(offsets, chars, width: int):
 def cast_string_to_float(col: Column, dtype: DType, *,
                          ansi: bool = False) -> Tuple[Column, jnp.ndarray]:
     """CAST(string AS FLOAT/DOUBLE) with Spark semantics: trimmed input,
-    float grammar with optional f/d suffix, case-insensitive
-    inf/infinity/nan literals; invalid rows null (non-ANSI) or raise
-    (ANSI).  Device validates; exact strtod runs on host over the fixed
+    float grammar with optional f/d suffix (hex literals included),
+    inf/infinity case-insensitive with optional sign, nan
+    case-insensitive only unsigned plus exact-case ``[+-]?NaN`` (Java
+    parseFloat); invalid rows null (non-ANSI) or raise (ANSI).  Device validates; exact strtod runs on host over the fixed
     windows (one vectorized numpy cast, no per-row loop).  Eager-only:
     under an outer jit, raises (call before entering jit)."""
     import numpy as np
@@ -540,8 +557,8 @@ def cast_string_to_float(col: Column, dtype: DType, *,
                 if txt[-1:] in "fFdD":
                     txt = txt[:-1]
                 try:
-                    f = Fraction(txt)
-                except ValueError:
+                    f = _exact_fraction(txt)
+                except (ValueError, ZeroDivisionError):
                     continue
                 best, best_d, best_even = None, None, False
                 for cand in (cd[r], out[r], cu[r]):
@@ -565,6 +582,39 @@ def cast_string_to_float(col: Column, dtype: DType, *,
             jnp.asarray(error))
 
 
+# Java hex float literal (Double.parseDouble grammar): mandatory binary
+# exponent; >=1 significand hex digit enforced by the group check below.
+# ONE regex serves both the parse path and the f32 fixup so the two
+# cannot drift apart.
+_JAVA_HEX_RE = None
+
+
+def _java_hex_match(txt: str):
+    global _JAVA_HEX_RE
+    if _JAVA_HEX_RE is None:
+        import re
+        _JAVA_HEX_RE = re.compile(
+            r"([+-]?)0[xX]([0-9a-fA-F]*)\.?([0-9a-fA-F]*)[pP]([+-]?\d+)")
+    m = _JAVA_HEX_RE.fullmatch(txt)
+    if m and (m.group(2) or m.group(3)):
+        return m
+    return None
+
+
+def _exact_fraction(txt: str):
+    """Exact rational value of a decimal OR Java-hex float literal (the
+    f32 double-rounding fixup must not silently skip hex rows —
+    ``Fraction`` itself cannot parse hex text)."""
+    from fractions import Fraction
+    m = _java_hex_match(txt)
+    if m:
+        sign, whole, frac, exp = m.groups()
+        v = Fraction(int((whole or "0") + frac, 16), 16 ** len(frac)) \
+            * Fraction(2) ** int(exp)
+        return -v if sign == "-" else v
+    return Fraction(txt)
+
+
 def _host_parse_float(raw: bytes):
     i, j = 0, len(raw)
     while i < j and raw[i] <= 0x20:
@@ -579,7 +629,10 @@ def _host_parse_float(raw: bytes):
     stripped = low[1:] if low[:1] in (b"+", b"-") else low
     if stripped in (b"inf", b"infinity"):
         return sign * float("inf")
-    if low in (b"nan", b"+nan"):
+    # nan: case-insensitive only unsigned (Spark's lowercase special
+    # list); a signed form needs Java parseFloat's exact-case 'NaN'
+    if low == b"nan" or (
+            body[1:] if body[:1] in (b"+", b"-") else body) == b"NaN":
         return float("nan")
     if stripped[-1:] in (b"f", b"d"):
         stripped = stripped[:-1]
@@ -591,6 +644,12 @@ def _host_parse_float(raw: bytes):
     except UnicodeDecodeError:
         return None
     import re
+    if _java_hex_match(txt):
+        try:
+            return float.fromhex(txt)
+        except OverflowError:
+            # Java overflows to signed Infinity, fromhex raises
+            return float("-inf") if txt[:1] == "-" else float("inf")
     if not re.fullmatch(r"[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?", txt):
         return None
     return float(txt)
@@ -1196,6 +1255,26 @@ def cast_string_to_timestamp(col: Column, *, ansi: bool = False
     # arithmetic == two's complement for signed values)
     total_s = _add64(_mul64(to_pair(days), _u64(0, 86400)),
                      to_pair(secs_of_day))
+    # exact int64-microsecond range (total_s itself is exact: the DATE
+    # cast's +/-5M-year bound keeps |total_s| < 2^48).  Beyond the edge
+    # the *1e6 would wrap mod 2^64 and mark a silently-wrong timestamp
+    # valid where Spark's instantToMicros overflows; those rows null.
+    ts_hi = jax.lax.bitcast_convert_type(total_s[0], jnp.int32)
+    ts_lo = total_s[1]
+
+    def _le(C):  # total_s <= C (C a python int in int64 range)
+        return (ts_hi < jnp.int32(C >> 32)) \
+            | ((ts_hi == jnp.int32(C >> 32))
+               & (ts_lo <= jnp.uint32(C & 0xFFFFFFFF)))
+
+    def _ge(C):
+        return (ts_hi > jnp.int32(C >> 32)) \
+            | ((ts_hi == jnp.int32(C >> 32))
+               & (ts_lo >= jnp.uint32(C & 0xFFFFFFFF)))
+
+    _MAXS, _MINS = 9223372036854, -9223372036855  # int64 edge seconds
+    ok = ok & (_le(_MAXS - 1) | (_le(_MAXS) & (f["micros"] <= 775807))) \
+        & (_ge(_MINS + 1) | (_ge(_MINS) & (f["micros"] >= 224192)))
     micros = _add64(_mul64(total_s, _u64(0, 1_000_000)),
                     to_pair(f["micros"]))
     if jax.config.jax_enable_x64:
@@ -1277,7 +1356,8 @@ def _host_parse_timestamp(raw: bytes):
             return None
         days = _host_parse_date(
             f"{m2.group(1)}-{m2.group(2) or 1}-1".encode())
-        return None if days is None else days * 86400 * 1_000_000
+        return None if days is None else _ts_in_i64(
+            days * 86400 * 1_000_000)
     date_part = f"{m.group(1)}-{m.group(2)}-{m.group(3)}"
     days = _host_parse_date(date_part.encode())
     if days is None:
@@ -1298,7 +1378,13 @@ def _host_parse_timestamp(raw: bytes):
         if abs(off_min) > 18 * 60:
             return None
     secs = days * 86400 + h * 3600 + mi * 60 + sec - off_min * 60
-    return secs * 1_000_000 + us
+    return _ts_in_i64(secs * 1_000_000 + us)
+
+
+def _ts_in_i64(micros):
+    """None past the int64-microsecond edge (Spark's instantToMicros
+    overflows there; rows null rather than wrap)."""
+    return micros if -(1 << 63) <= micros < (1 << 63) else None
 
 
 def _patch_temporal_punts(col, punted, in_valid, data, ok, host_fn,

@@ -83,7 +83,9 @@ def _as_u32_words(col: Column):
     dt = col.dtype
     if dt.is_string:
         raise NotImplementedError(
-            "string hashing requires the byte-stream path (planned)")
+            "string columns hash via the byte-stream kernel "
+            "(_mm3_string_col); murmur3_hash dispatches there — this "
+            "word-normalization helper covers fixed-width columns only")
     k = dt.np_dtype.itemsize
     if dt.np_dtype.kind == "f":
         if k == 8 and data.ndim == 2:

@@ -191,6 +191,9 @@ FLOAT_CASES = [
     "2.5e+10", "  7.125  ", "\t-8\n", "1.7976931348623157e308",
     "4.9e-324", "123456789.123456789", "1.5f", "2.5D", "3d",
     "inf", "-inf", "+inf", "Infinity", "-INFINITY", "NaN", "nan",
+    "nAn", "+nan", "+NAN", "-nan", "+NaN", "-NaN", "NaNf",
+    "0x1p1", "0x1.8p1", "-0x1.8p-2", "0X1P3", "0x1p1f", "  0x1p1  ",
+    "0x1f", "0xp1", "0x1.8", "0x1p1024", "-0x1p1024", "0x1p-1080",
     "", "  ", "abc", "1.2.3", "1e", "e5", "++1", "1,5", ".", "-",
     "0x10", "1 2", "--5", "1e+-3", "9" * 50, "1." + "0" * 60 + "5",
 ]
@@ -211,11 +214,21 @@ def _oracle_float(s):
     body = low[1:] if low[:1] in "+-" else low
     if body in ("inf", "infinity"):
         return float("-inf") if low[0] == "-" else float("inf")
-    if low in ("nan", "+nan"):
+    # Spark two-stage: lowercase special list matches only unsigned
+    # 'nan'; Java parseFloat accepts exact-case '[+-]?NaN'
+    if low == "nan" or (t[1:] if t[:1] in "+-" else t) == "NaN":
         return float("nan")
     if body[-1:] in ("f", "d"):
         t = t[:-1]
     import re
+    # Java hex float literal (mandatory binary exponent, >=1 hex digit)
+    if re.fullmatch(
+            r"[+-]?0[xX]([0-9a-fA-F]+\.?[0-9a-fA-F]*"
+            r"|\.[0-9a-fA-F]+)[pP][+-]?\d+", t):
+        try:
+            return float.fromhex(t)
+        except OverflowError:  # Java overflows to signed Infinity
+            return float("-inf") if t[:1] == "-" else float("inf")
     if not re.fullmatch(r"[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?", t):
         return None
     return float(t)
@@ -242,6 +255,23 @@ def test_cast_string_to_float_matches_oracle(dt):
             assert got[i] != got[i], repr(s)
         else:
             assert got[i] == want, (repr(s), got[i], want)
+
+
+def test_cast_string_to_float32_hex_double_rounding():
+    """A hex literal whose true value sits just above an f32 rounding
+    midpoint (resolvable only past f64 precision) must round like Java
+    Float.parseFloat, not through the f64 intermediate."""
+    from spark_rapids_jni_tpu import FLOAT32
+    from spark_rapids_jni_tpu.ops import cast_string_to_float
+    # 1 + 2^-24 + 2^-76: f64 rounds to exactly the f32 midpoint 1+2^-24,
+    # which ties-to-even DOWN to 1.0; the true value is above the
+    # midpoint so f32 must be 1 + 2^-23
+    s = "0x1.0000010000000000001p0"
+    res, err = cast_string_to_float(Column.strings([s]), FLOAT32)
+    assert not np.asarray(err)[0]
+    got = np.float32(res.to_pylist()[0])
+    want = np.float32(1.0) + np.float32(2.0) ** -23
+    assert got == want, (got.tobytes().hex(), want.tobytes().hex())
 
 
 def test_cast_string_to_float_nulls_and_ansi():
@@ -472,6 +502,43 @@ def test_cast_string_to_timestamp_matches_oracle(x64_both):
             assert got[i] is None and err[i], (repr(s), got[i])
         else:
             assert not err[i] and got[i] == want, (repr(s), got[i], want)
+
+
+def test_cast_string_to_timestamp_year_overflow(x64_both):
+    """Instants past the int64-microsecond range null rather than
+    wrapping mod 2^64 (the DATE cast's +/-5M-year bound is far beyond
+    it), on both the device path and the whitespace-punted host path —
+    exact to the microsecond at both edges."""
+    from spark_rapids_jni_tpu.ops import cast_string_to_timestamp
+    pad = " " * 64  # > TRIM_WIDTH: forces the host punt path
+    i64max, i64min = (1 << 63) - 1, -(1 << 63)
+    # max instant 294247-01-10T04:00:54.775807, min -290308-12-21T19:59:05.224192
+    top, bot = "+294247-01-10T04:00:54", "-290308-12-21T19:59:05"
+    valid = {f"{top}.775807": i64max, f"{bot}.224192": i64min,
+             "290000-01-01": None, "-290000-01-01": None}
+    invalid = [f"{top}.775808", f"{bot}.224191", "+294248-01-01",
+               "-290309-01-01", "2999999-01-01", "-2999999-06-15"]
+    cases, wants = [], []
+    for s, w in valid.items():
+        cases += [s, pad + s + pad]       # device path + host punt path
+        wants += [w, w]
+    for s in invalid:
+        cases += [s, pad + s + pad]
+        wants += ["BAD", "BAD"]
+    col = Column.strings(cases)
+    res, err = cast_string_to_timestamp(col)
+    got = res.to_pylist()
+    err = np.asarray(err)
+    for i, (s, w) in enumerate(zip(cases, wants)):
+        if w == "BAD":
+            assert got[i] is None and err[i], (repr(s), got[i])
+        else:
+            assert not err[i] and got[i] is not None, (repr(s), got[i])
+            if w is not None:
+                assert got[i] == w, (repr(s), got[i], w)
+    # device and host-punt paths agree on every case
+    for i in range(0, len(cases), 2):
+        assert got[i] == got[i + 1], (cases[i], got[i], got[i + 1])
 
 
 def test_cast_temporal_nulls_and_ansi():
