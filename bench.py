@@ -66,9 +66,12 @@ def _sync(out):
     ``jax.block_until_ready`` does not actually wait on remote-tunnel
     backends (axon), so fetch one element: device programs execute
     in-order, so materializing the last output proves all prior work done.
+    (Scalar INDEXING, not ``reshape(-1)[:1]``: an eager flatten of a 2-D
+    tiled array dispatches a full relayout copy — measured 50 ms on a
+    [221, 1M] plane matrix — that would poison every timing.)
     """
     leaf = jax.tree_util.tree_leaves(out)[-1]
-    np.asarray(leaf.reshape(-1)[:1])
+    np.asarray(leaf[(0,) * leaf.ndim])
 
 
 def _time(fn, *, iters=24, label="", sync_each=False):
@@ -272,8 +275,8 @@ _HBM_GBPS = 819.0
 
 
 def _calibrate_hbm():
-    """Fixed HBM-copy calibration: slope-time one 1GB device-to-device
-    copy (256M u32 add) and report its effective GB/s (2GB moved).
+    """Fixed HBM-copy calibration: slope-time a 256MB device-to-device
+    copy (64M u32 add) and report its effective GB/s (512MB moved).
 
     The axon tunnel's speed varies across sessions (round 3 measured the
     SAME code 1.8x slower than round 2 had recorded), so every
@@ -370,7 +373,7 @@ def _verify_fixed(num_rows, num_cols=212):
     # oracle's index matrices exceed HBM together
     batches = convert_to_rows(table, size_limit=1 << 28)
     start = 0
-    eq_bytes = jax.jit(lambda a, b: jnp.all(a.reshape(-1) == b.reshape(-1)))
+    eq_bytes = jax.jit(lambda a, b: jnp.all(a == b.reshape(a.shape)))
     for bi in range(len(batches)):
         b = batches[bi]
         n = b.num_rows
@@ -419,7 +422,7 @@ def _verify_variable(num_rows, num_cols=155, native_rows=50_000):
             # native C++ decoder cross-check on a bounded row range
             k = min(native_rows, n)
             rs = b.row_size
-            blob = np.asarray(b.data[:k * rs])
+            blob = np.asarray(b.rows2d(rs)[:k]).reshape(-1)
             offs = (np.arange(k + 1, dtype=np.int64) * rs)
             cols, valid, soffs, chars = decode_variable_native(
                 blob, offs, dtypes)

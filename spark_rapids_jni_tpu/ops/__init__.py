@@ -13,9 +13,13 @@ from spark_rapids_jni_tpu.ops.row_conversion import (  # noqa: F401
     RowsColumn,
     convert_to_rows,
     convert_from_rows,
+    convert_to_rows_grouped,
     convert_from_rows_grouped,
     convert_to_rows_fixed_width_optimized,
     convert_from_rows_fixed_width_optimized,
+)
+from spark_rapids_jni_tpu.ops.row_mxu import (  # noqa: F401
+    GroupedColumns, table_to_grouped,
 )
 from spark_rapids_jni_tpu.ops.hashing import (  # noqa: F401
     hash_partition_ids, murmur3_hash, xxhash64,

@@ -285,7 +285,7 @@ def cast_string_to_int(col: Column, dtype: DType, *, ansi: bool = False
                      | (out_hi.astype(jnp.uint64) << jnp.uint64(32)))
             data = val64.astype(jnp.int64)
         else:
-            data = jnp.stack([out_lo, out_hi], axis=1)  # wide pair repr
+            data = jnp.stack([out_lo, out_hi], axis=0)  # [2, n] plane pair
     else:
         bits = 8 * dtype.itemsize
         val = out_lo.astype(jnp.int32)
@@ -320,8 +320,8 @@ def cast_string_to_int(col: Column, dtype: DType, *, ansi: bool = False
             ok_np[r] = True
             if dtype.itemsize == 8 and data_np.ndim == 2:
                 two = val & 0xFFFFFFFFFFFFFFFF
-                data_np[r, 0] = two & 0xFFFFFFFF
-                data_np[r, 1] = two >> 32
+                data_np[0, r] = two & 0xFFFFFFFF   # [2, n] plane pair
+                data_np[1, r] = two >> 32
             else:
                 data_np[r] = val
         data = jnp.asarray(data_np)
@@ -575,8 +575,8 @@ def cast_string_to_float(col: Column, dtype: DType, *,
     elif jax.config.jax_enable_x64:
         data = jnp.asarray(vals)
     else:
-        pair = vals.view(np.uint32).reshape(n, 2)  # LE pairs
-        data = jnp.asarray(pair)
+        from spark_rapids_jni_tpu.table import pair_from_np64
+        data = jnp.asarray(pair_from_np64(vals))   # [2, n] plane pair
     result_valid = jnp.asarray(in_valid & valid_np)
     return (Column(dtype, data, pack_bools(result_valid)),
             jnp.asarray(error))
@@ -898,8 +898,8 @@ def _int_to_string_jit(data, mode: str):
         hi = (u >> jnp.uint64(32)).astype(jnp.uint32)
         mode = "wide"
     elif mode == "wide":
-        lo = data[:, 0]
-        hi = data[:, 1]
+        lo = data[0]                                # [2, n] plane pair
+        hi = data[1]
     if mode == "wide":
         negative = (hi >> 31) != 0
         # two's complement negate to get magnitude
@@ -1281,7 +1281,7 @@ def cast_string_to_timestamp(col: Column, *, ansi: bool = False
         data = (micros[0].astype(jnp.uint64) << jnp.uint64(32)
                 | micros[1].astype(jnp.uint64)).astype(jnp.int64)
     else:
-        data = jnp.stack([micros[1], micros[0]], axis=1)  # LE pair repr
+        data = jnp.stack([micros[1], micros[0]], axis=0)  # [2, n] (lo, hi)
     in_valid = col.valid_bools()
     data, ok = _patch_temporal_punts(col, f["punted"], in_valid, data,
                                      ok, _host_parse_timestamp, "i64")
@@ -1408,8 +1408,8 @@ def _patch_temporal_punts(col, punted, in_valid, data, ok, host_fn,
         ok_np[r] = True
         if kind == "i64" and data_np.ndim == 2:
             two = v & 0xFFFFFFFFFFFFFFFF
-            data_np[r, 0] = two & 0xFFFFFFFF
-            data_np[r, 1] = two >> 32
+            data_np[0, r] = two & 0xFFFFFFFF       # [2, n] plane pair
+            data_np[1, r] = two >> 32
         else:
             data_np[r] = v
     return jnp.asarray(data_np), jnp.asarray(ok_np)
@@ -1495,8 +1495,9 @@ def cast_timestamp_to_string(col: Column) -> Column:
         raise ValueError(
             "cast_timestamp_to_string needs a timestamp_us column")
     data = np.asarray(col.data)
-    if data.ndim == 2:                      # no-x64 uint32 pairs
-        micros = np.ascontiguousarray(data).view(np.int64).reshape(-1)
+    if data.ndim == 2:                      # no-x64 [2, n] plane pairs
+        from spark_rapids_jni_tpu.table import pair_to_np64
+        micros = pair_to_np64(data, np.int64)
     else:
         micros = data.astype(np.int64)
     days, us = np.divmod(micros, 86_400_000_000)   # floor: negatives ok

@@ -72,12 +72,15 @@ def _mm3_fmix(h1, length):
 
 
 def _as_u32_words(col: Column):
-    """A column's Spark-normalized little-endian uint32 words, [n, w].
+    """A column's Spark-normalized little-endian uint32 words as a LIST
+    of [n] vectors (lo word first).
 
     Spark normalizes: bool/byte/short/int -> int (one 4-byte block);
     long -> two blocks; float -> int bits; double -> long bits.
     Floats normalize -0.0 to 0.0 (Spark uses the raw bits of the value,
     but -0.0 == 0.0 normalization happens upstream in cudf/Spark hashing).
+    64-bit columns are stored plane-major ([2, n] lo/hi), so their words
+    are row slices — no interleave/transpose anywhere in the hash path.
     """
     data = col.data
     dt = col.dtype
@@ -89,10 +92,9 @@ def _as_u32_words(col: Column):
     k = dt.np_dtype.itemsize
     if dt.np_dtype.kind == "f":
         if k == 8 and data.ndim == 2:
-            # wide-mode double stored as (lo, hi) uint32 pairs: normalize
-            # -0.0 and NaN at the bit level so TPU (no-x64) hashes agree
-            # with the x64/Spark path
-            lo, hi = data[:, 0], data[:, 1]
+            # plane-pair double: normalize -0.0 and NaN at the bit level
+            # so TPU (no-x64) hashes agree with the x64/Spark path
+            lo, hi = data[0], data[1]
             exp_all_ones = (hi & jnp.uint32(0x7FF00000)) == jnp.uint32(0x7FF00000)
             mant_nonzero = ((hi & jnp.uint32(0x000FFFFF)) | lo) != 0
             is_nan = exp_all_ones & mant_nonzero
@@ -100,25 +102,26 @@ def _as_u32_words(col: Column):
             hi = jnp.where(is_nan, jnp.uint32(0x7FF80000),
                            jnp.where(is_negzero, jnp.uint32(0), hi))
             lo = jnp.where(is_nan | is_negzero, jnp.uint32(0), lo)
-            return jnp.stack([lo, hi], axis=1)
+            return [lo, hi]
         # -0.0 -> 0.0 and NaN -> canonical quiet NaN, as Java's
         # floatToIntBits/doubleToLongBits produce for Spark
         data = jnp.where(data == 0.0, jnp.zeros_like(data), data)
         data = jnp.where(jnp.isnan(data), jnp.full_like(data, jnp.nan), data)
         if k == 4:
-            return jax.lax.bitcast_convert_type(data, jnp.uint32)[:, None]
+            return [jax.lax.bitcast_convert_type(data, jnp.uint32)]
         pair = jax.lax.bitcast_convert_type(
             jax.lax.bitcast_convert_type(data, jnp.uint64).reshape(-1, 1),
-            jnp.uint32)
-        return pair.reshape(-1, 2)
-    if data.ndim == 2:  # int64 uint32 pairs (64-bit without x64): raw bits
-        return data
+            jnp.uint32).reshape(-1, 2)
+        return [pair[:, 0], pair[:, 1]]
+    if data.ndim == 2:  # int64 plane pairs (64-bit without x64): raw bits
+        return [data[0], data[1]]
     if k == 8:
-        return jax.lax.bitcast_convert_type(
+        pair = jax.lax.bitcast_convert_type(
             data.reshape(-1, 1), jnp.uint32).reshape(-1, 2)
+        return [pair[:, 0], pair[:, 1]]
     # bool/int8/int16/int32 -> sign-extend to int32, reinterpret
     as_i32 = data.astype(jnp.int32)
-    return jax.lax.bitcast_convert_type(as_i32, jnp.uint32)[:, None]
+    return [jax.lax.bitcast_convert_type(as_i32, jnp.uint32)]
 
 
 # ---------------------------------------------------------------------------
@@ -305,11 +308,10 @@ def murmur3_hash(table_or_cols, seed: int = DEFAULT_SEED,
                                     _mm3_scatter)
         else:
             words = _as_u32_words(col)
-            nwords = words.shape[1]
             hc = h
-            for w in range(nwords):
-                hc = _mm3_mix_h1(hc, words[:, w])
-            hc = _mm3_fmix(hc, nwords * 4)
+            for w in words:
+                hc = _mm3_mix_h1(hc, w)
+            hc = _mm3_fmix(hc, len(words) * 4)
         if col.validity is not None:
             h = jnp.where(col.valid_bools(), hc, h)
         else:
@@ -416,14 +418,14 @@ def _col_u64_blocks(col: Column):
     """Spark XxHash64 normalization: every fixed-width value becomes one
     8-byte block (long); float->int bits->long, double->long bits."""
     words = _as_u32_words(col)
-    if words.shape[1] == 1:
+    if len(words) == 1:
         # sign-extend int32 word to 64 bits
-        lo = words[:, 0]
+        lo = words[0]
         hi = jnp.where(
             jax.lax.bitcast_convert_type(lo, jnp.int32) < 0,
             jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
         return (hi, lo)
-    return (words[:, 1], words[:, 0])  # little-endian pair -> (hi, lo)
+    return (words[1], words[0])  # little-endian pair -> (hi, lo)
 
 
 def _where64(cond, a, b):

@@ -53,15 +53,24 @@ from spark_rapids_jni_tpu.utils import metrics
 # Byte views
 # ---------------------------------------------------------------------------
 
-def col_to_bytes(data: jnp.ndarray) -> jnp.ndarray:
+def col_to_bytes(data: jnp.ndarray, dt: DType = None) -> jnp.ndarray:
     """View a fixed-width column as little-endian bytes, shape [n, itemsize].
 
-    2-D input is a 64-bit column stored as uint32 pairs (the no-x64/TPU
-    representation, see ``Column.from_numpy``).
+    ``dt`` disambiguates 2-D data: an 8-byte dtype means [2, n] uint32
+    plane pairs (the no-x64/TPU representation, see ``Column.from_numpy``
+    — the row-major byte view needs one transpose, oracle/fallback-path
+    cost only); anything else (decimal128's [n, 4] limbs) is already
+    row-major.  Without ``dt`` a 2-row 2-D array is assumed plane-pair.
     """
-    if data.ndim == 2:  # [n, 2] uint32 pairs -> [n, 8]
-        n = data.shape[0]
-        return jax.lax.bitcast_convert_type(data, jnp.uint8).reshape(n, -1)
+    if data.ndim == 2:
+        is_pair = (dt.itemsize == 8 if dt is not None
+                   else data.shape[0] == 2)
+        if is_pair:  # [2, n] uint32 planes -> [n, 8]
+            n = data.shape[1]
+            return jax.lax.bitcast_convert_type(
+                data.T, jnp.uint8).reshape(n, -1)
+        return jax.lax.bitcast_convert_type(
+            data, jnp.uint8).reshape(data.shape[0], -1)
     if data.dtype == jnp.bool_:
         data = data.astype(jnp.uint8)
     if data.dtype.itemsize == 1:
@@ -69,13 +78,17 @@ def col_to_bytes(data: jnp.ndarray) -> jnp.ndarray:
     return jax.lax.bitcast_convert_type(data, jnp.uint8)
 
 
-def bytes_to_col(b: jnp.ndarray, np_dtype: np.dtype) -> jnp.ndarray:
+def bytes_to_col(b: jnp.ndarray, np_dtype, dt: DType = None) -> jnp.ndarray:
     """Inverse of :func:`col_to_bytes`: [n, itemsize] uint8 -> [n] dtype
-    (or [n, 2] uint32 pairs for 64-bit dtypes when x64 is disabled)."""
+    (or [2, n] uint32 plane pairs for 64-bit dtypes when x64 is
+    disabled; [n, 4] uint32 limbs for decimal128)."""
+    if dt is not None and dt.kind == "decimal128":
+        return jax.lax.bitcast_convert_type(
+            b.reshape(-1, 4, 4), jnp.uint32)
     target = jnp.dtype(np_dtype)
     if target.itemsize == 8 and not jax.config.jax_enable_x64:
         return jax.lax.bitcast_convert_type(
-            b.reshape(-1, 2, 4), jnp.uint32)
+            b.reshape(-1, 2, 4), jnp.uint32).T
     if target.itemsize == 1:
         return jax.lax.bitcast_convert_type(b[:, 0], target)
     return jax.lax.bitcast_convert_type(b, target)
@@ -122,7 +135,12 @@ class RowsColumn:
     logical content to the compact wire form, with per-row slack).  Padded
     batches decode via static slices instead of per-row gathers."""
 
-    data: jnp.ndarray      # uint8 [total_bytes]
+    data: jnp.ndarray      # uint8: [num_rows, row_bytes] device-native,
+                           # or flat [total_bytes] (wire/oracle form).
+                           # Uniform-size batches stay 2-D on device --
+                           # flattening a tiled uint8 matrix is a
+                           # measured ~17.5 ms/GB relayout the host/wire
+                           # boundary alone should pay.
     offsets: jnp.ndarray   # int32 [num_rows + 1]
     row_size: Optional[int] = None
     str_widths: Optional[Tuple[int, ...]] = None
@@ -135,9 +153,17 @@ class RowsColumn:
     def is_padded(self) -> bool:
         return self.row_size is not None
 
+    def rows2d(self, row_size: int) -> jnp.ndarray:
+        """[n, row_size] view (2-D passthrough; flat blobs reshape --
+        call under jit where possible, see ``data`` comment)."""
+        if self.data.ndim == 2:
+            return self.data
+        return self.data.reshape(-1, row_size)
+
     def row_bytes(self, i: int) -> bytes:
         offs = np.asarray(self.offsets)
-        return np.asarray(self.data)[offs[i]:offs[i + 1]].tobytes()
+        return np.asarray(self.data).reshape(-1)[
+            offs[i]:offs[i + 1]].tobytes()
 
     def tree_flatten(self):
         return (self.data, self.offsets), (self.row_size, self.str_widths)
@@ -225,8 +251,9 @@ def _to_rows_fixed_jit(table: Table, layout: RowLayout,
     from spark_rapids_jni_tpu.table import slice_table_dynamic
     if size is not None and size != table.num_rows:
         table = slice_table_dynamic(table, start, size)
-    # flat: the blob contract is 1-D and an eager reshape would copy
-    return _assemble_fixed_rows(table, layout).reshape(-1)
+    # 2-D [n, rs]: blobs stay unflattened on device (all fixed-path
+    # engines agree on the shape so cross-engine byte compares line up)
+    return _assemble_fixed_rows(table, layout)
 
 
 def _disassemble_fixed_rows(rows2d: jnp.ndarray,
@@ -242,7 +269,8 @@ def _disassemble_fixed_rows(rows2d: jnp.ndarray,
         validity = pack_bools(valid.astype(jnp.bool_))
         if dt.is_string:
             raise ValueError("string columns require the variable-width path")
-        data = bytes_to_col(byte_slice, dt.np_dtype)
+        data = bytes_to_col(byte_slice, None if dt.kind == "decimal128"
+                            else dt.np_dtype, dt)
         cols.append(Column(dt, data, validity))
     return cols
 
@@ -289,7 +317,7 @@ def _oracle_to_rows_batch_jit(table: Table, layout: RowLayout,
 @functools.partial(jax.jit, static_argnums=(1,))
 def _oracle_to_rows_jit(table: Table, layout: RowLayout) -> jnp.ndarray:
     packed = jnp.concatenate(
-        [col_to_bytes(c.data) for c in table.columns], axis=1)
+        [col_to_bytes(c.data, c.dtype) for c in table.columns], axis=1)
     vb = _validity_row_bytes(table, layout)
     src, vsrc = _oracle_gather_maps(layout)
     src_j = jnp.asarray(np.maximum(src, 0))
@@ -317,7 +345,8 @@ def _oracle_from_rows_jit(rows2d: jnp.ndarray, layout: RowLayout):
         byte_slice = flat[idx]
         vbyte = flat[row_base + layout.validity_offset + i // 8]
         valid = ((vbyte >> (i % 8)) & 1).astype(jnp.bool_)
-        data = bytes_to_col(byte_slice, dt.np_dtype)
+        data = bytes_to_col(byte_slice, None if dt.kind == "decimal128"
+                            else dt.np_dtype, dt)
         cols.append(Column(dt, data, pack_bools(valid)))
     return Table(tuple(cols))
 
@@ -332,7 +361,7 @@ def _batch_rows2d(rows2d: jnp.ndarray, layout: RowLayout,
     rs = layout.fixed_row_size
     out = []
     for start, end in plan_fixed_batches(n, rs, size_limit):
-        chunk = rows2d[start:end].reshape(-1)
+        chunk = rows2d[start:end]            # 2-D batch (see RowsColumn)
         offsets = jnp.arange(end - start + 1, dtype=jnp.int32) * rs
         out.append(RowsColumn(chunk, offsets))
     return out
@@ -356,8 +385,7 @@ def convert_from_rows_fixed_width_optimized(
     layout = compute_row_layout(dtypes)
     if layout.has_strings:
         raise ValueError("fixed-width-optimized path does not support strings")
-    n = rows.num_rows
-    rows2d = rows.data.reshape(n, layout.fixed_row_size)
+    rows2d = rows.rows2d(layout.fixed_row_size)
     return _oracle_from_rows_jit(rows2d, layout)
 
 
@@ -408,47 +436,32 @@ def convert_to_rows(table: Table, *, size_limit: int = MAX_BATCH_BYTES,
     # just caps each output batch so int32 offsets stay valid.)
     chunk = min(size_limit, 1 << 30)
 
-    # TPU hot path: fused single-pass Pallas encoder.  XLA prep (64-bit
-    # planes + validity quads) runs ONCE; every batch reads the full
-    # columns in place at a prefetched tile offset — no per-batch slice
-    # copies and no [W, n] plane round trip through HBM.
+    # TPU hot path: fused single-pass Pallas encoder reading the
+    # plane-pair columns and packed validity masks in place at a
+    # prefetched tile offset — no per-batch slice copies, no prep
+    # transpose, no plane round trip through HBM.
     import os as _os
     from spark_rapids_jni_tpu.ops import row_mxu
     align = row_mxu._FUSE_TILE
     max_per = chunk // layout.fixed_row_size // align * align
-    # the fused encoder's full-table prep (64-bit planes + validity quads)
-    # stays resident across batches; cap it so memory-constrained tables
-    # keep the old batch-sliced path (SRJ_PALLAS_PACK=0 also opts out,
-    # same escape hatch as the pack kernel)
-    prep_bytes = sum(8 * n for c in table.columns
-                     if c.dtype.itemsize == 8) \
-        + 4 * ((layout.num_columns + 3) // 4) * n
+    # the fused encoder packs the table ONCE into its plane-major
+    # backing (a full-table-sized copy resident across every batch) and
+    # runs one kernel per batch.  Cap that resident prep so tables near
+    # the HBM budget keep the batch-sliced XLA path (SRJ_PALLAS_PACK=0
+    # also opts out, same escape hatch as the pack kernel)
+    prep_bytes = sum(c.data.nbytes for c in table.columns) \
+        + ((layout.num_columns * n) // 8)
     prep_ok = prep_bytes <= int(_os.environ.get(
         "SRJ_FUSED_PREP_CAP", str(4 << 30)))
-    # single-batch tables stay on the one-jit XLA pack+dot path below —
-    # measured fastest there (~90 GB/s at 1M; the plane round trip hides
-    # under XLA's scheduling).  The fused encoder wins only when batching
-    # would force per-batch slice copies + repeated prep.
     if (impl == "mxu" and platform == "tpu" and n >= align and max_per
-            and n * layout.fixed_row_size > chunk
             and prep_ok
             and _os.environ.get("SRJ_PALLAS_PACK", "1") != "0"):
-        # the fused kernel's transients are VMEM-only, so batches can run
-        # up to the int32-offset cap rather than the 1GB transient bound
-        # the XLA paths need (clamped: offsets are int32 regardless of
-        # the caller's size_limit)
-        chunk = min(size_limit, MAX_BATCH_BYTES)
-        max_per = chunk // layout.fixed_row_size // align * align
-        enc = row_mxu.FixedEncoder(table, layout)
-        nb = -(-n * layout.fixed_row_size // chunk)
-        per = min((-(-n // nb) + align - 1) // align * align, max_per)
-        out = []
-        for start in range(0, n, per):
-            size = min(per, n - start)
-            offsets = jnp.arange(size + 1,
-                                 dtype=jnp.int32) * layout.fixed_row_size
-            out.append(RowsColumn(enc.encode(start, size), offsets))
-        return out
+        # pack once, then delegate to the grouped batch planner (the
+        # fused kernel's transients are VMEM-only, so batches run up to
+        # the int32-offset cap rather than the 1GB transient bound the
+        # XLA paths need)
+        return convert_to_rows_grouped(row_mxu.table_to_grouped(
+            table, layout), size_limit=size_limit)
 
     def encode(start=0, size=None):
         if impl == "pallas":
@@ -503,7 +516,7 @@ def convert_from_rows(rows: RowsColumn, dtypes: Sequence[DType],
     impl = _resolve_impl(impl, use_pallas, platform)
     if impl == "pallas":
         from spark_rapids_jni_tpu.ops import row_kernels
-        rows2d = rows.data.reshape(n, layout.fixed_row_size)
+        rows2d = rows.rows2d(layout.fixed_row_size)
         cols = row_kernels.from_rows_fixed(rows2d, layout,
                                            interpret=platform != "tpu")
     elif impl == "mxu":
@@ -512,12 +525,48 @@ def convert_from_rows(rows: RowsColumn, dtypes: Sequence[DType],
             raise ValueError(
                 f"row blob holds {rows.data.size} bytes but offsets "
                 f"describe {n} rows of {layout.fixed_row_size}")
-        # flat blob goes straight in; the reshape happens inside the jit
+        # 2-D blobs go straight in; flat wire blobs reshape inside the jit
         cols = row_mxu.from_rows_fixed(rows.data, layout)
     else:
-        rows2d = rows.data.reshape(n, layout.fixed_row_size)
+        rows2d = rows.rows2d(layout.fixed_row_size)
         cols = _from_rows_fixed_jit(rows2d, layout)
     return Table(tuple(cols))
+
+
+@func_range()
+def convert_to_rows_grouped(gc, *, size_limit: int = MAX_BATCH_BYTES
+                            ) -> List[RowsColumn]:
+    """Convert a plane-major :class:`GroupedColumns` backing straight to
+    JCUDF row batches — the encode twin of
+    :func:`convert_from_rows_grouped`: one fused kernel per batch, HBM
+    traffic exactly planes in + blob out (no per-column extraction).
+
+    Build the backing with ``row_mxu.table_to_grouped(table)`` or get it
+    from a grouped decode; a decode→compute→encode pipeline never leaves
+    the plane-major form."""
+    from spark_rapids_jni_tpu.ops import row_mxu
+    layout = gc.layout
+    n = gc.num_rows
+    metrics.op("convert_to_rows_grouped", rows=n)
+    rs = layout.fixed_row_size
+    align = row_mxu._FUSE_TILE
+    chunk = min(size_limit, MAX_BATCH_BYTES)
+    per_max = chunk // rs // align * align
+    if n == 0 or n < align or per_max == 0:
+        # tiny tables: materialize and take the standard path
+        return convert_to_rows(gc.to_table(), size_limit=size_limit)
+    nb = -(-n * rs // chunk)
+    per = min((-(-n // nb) + align - 1) // align * align, per_max)
+    out = []
+    platform = _platform_of(gc.planes)
+    for start in range(0, n, per):
+        size = min(per, n - start)
+        offsets = jnp.arange(size + 1, dtype=jnp.int32) * rs
+        out.append(RowsColumn(
+            row_mxu.to_rows_fixed_grouped(gc, start, size,
+                                          interpret=platform != "tpu"),
+            offsets))
+    return out
 
 
 @func_range()
@@ -622,8 +671,8 @@ def _to_rows_padded_jit(table: Table, layout: RowLayout,
     from spark_rapids_jni_tpu.table import slice_table_dynamic
     if size is not None and size != table.num_rows:
         table = slice_table_dynamic(table, start, size)
-    return padded_rows2d(table, layout, slot_starts, fe_pad,
-                         row_size).reshape(-1)
+    # 2-D [n, row_size]: blobs stay unflattened on device
+    return padded_rows2d(table, layout, slot_starts, fe_pad, row_size)
 
 
 def _batch_string_tails(scols: List[Column], start: int,
@@ -685,8 +734,9 @@ def _to_rows_variable_padded(table: Table, layout: RowLayout,
 def _from_rows_padded_jit(data: jnp.ndarray, layout: RowLayout,
                           str_widths: Tuple[int, ...]):
     row_size = padded_variable_layout(layout, str_widths)[2]
-    return padded_cols_from_rows(data, layout, str_widths,
-                                 data.shape[0] // row_size)
+    n = data.shape[0] if data.ndim == 2 \
+        else data.shape[0] // row_size
+    return padded_cols_from_rows(data, layout, str_widths, n)
 
 
 def padded_cols_from_rows(data: jnp.ndarray, layout: RowLayout,
@@ -701,7 +751,7 @@ def padded_cols_from_rows(data: jnp.ndarray, layout: RowLayout,
     temps)."""
     slot_starts, fe_pad, row_size = padded_variable_layout(
         layout, str_widths)
-    rows2d = data.reshape(n, row_size)
+    rows2d = data if data.ndim == 2 else data.reshape(n, row_size)
     f_words = bytes2d_to_words(rows2d[:, :fe_pad])        # [n, fe_pad/4]
     datas, masks, str_lens = _cols_from_fwords(f_words, layout)
     str_parts = []
@@ -931,7 +981,7 @@ def _assemble_fixed_variable(table: Table, pairs: List[jnp.ndarray],
                 pairs[si], jnp.uint8).reshape(n, 8))
             si += 1
         else:
-            pieces.append(col_to_bytes(col.data))
+            pieces.append(col_to_bytes(col.data, col.dtype))
         pos = start + size
     if layout.validity_offset > pos:
         pieces.append(jnp.zeros((n, layout.validity_offset - pos), jnp.uint8))
@@ -987,6 +1037,8 @@ def _slice_chars_batch_jit(chars_list, los, sizes):
 def _gather_all_strings_jit(data, row_offsets, f_words, var_starts,
                             str_lens, totals):
     """Gather every string column's chars in one compiled program."""
+    if data.ndim == 2:  # device-native 2-D blob: wire-flatten in-jit
+        data = data.reshape(-1)
     out = []
     for si, s in enumerate(var_starts):
         str_off = f_words[:, s // 4].astype(jnp.int32)
@@ -1000,12 +1052,14 @@ def _col_from_words(f_words: jnp.ndarray, s: int, dt: DType):
     offset ``s`` in the row; fields are size-aligned by the layout)."""
     sz = dt.itemsize
     w0 = s // 4
+    if sz == 16:  # decimal128: 4 words per row -> [n, 4] limbs
+        return f_words[:, w0:w0 + 4]
     if sz == 8:
         pair = f_words[:, w0:w0 + 2]
         if jax.config.jax_enable_x64:
             return jax.lax.bitcast_convert_type(
                 jax.lax.bitcast_convert_type(pair, jnp.uint64), dt.np_dtype)
-        return pair
+        return pair.T  # [2, n] plane-pair Column layout
     if sz == 4:
         return jax.lax.bitcast_convert_type(f_words[:, w0], dt.np_dtype)
     word = f_words[:, w0] >> (8 * (s % 4))
@@ -1025,6 +1079,8 @@ def _extract_fixed_variable_jit(data: jnp.ndarray, offsets: jnp.ndarray,
     4x smaller index matrix than byte gathers, and no u8[*, 4] tiled
     intermediates), then extract every column's data and packed validity
     mask in the same program."""
+    if data.ndim == 2:  # device-native 2-D blob: wire-flatten in-jit
+        data = data.reshape(-1)
     n = offsets.shape[0] - 1
     fe_pad = (layout.fixed_end + 3) // 4 * 4
     nwords = data.shape[0] // 4

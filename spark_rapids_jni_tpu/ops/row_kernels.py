@@ -79,7 +79,7 @@ def _to_rows_pallas(table: Table, layout: RowLayout,
     n_padded = max(tile_rows, (n + tile_rows - 1) // tile_rows * tile_rows)
     grid = (n_padded // tile_rows,)
 
-    col_bytes = [_pad_rows(rc.col_to_bytes(c.data), n_padded)
+    col_bytes = [_pad_rows(rc.col_to_bytes(c.data, c.dtype), n_padded)
                  for c in table.columns]
     validity = _pad_rows(rc._validity_row_bytes(table, layout), n_padded)
 
@@ -103,7 +103,7 @@ def _to_rows_pallas(table: Table, layout: RowLayout,
         interpret=interpret,
     )(*col_bytes, validity)
     # flat: the blob contract is 1-D; flattening inside the jit is free
-    return rows[:n].reshape(-1)
+    return rows[:n]  # 2-D [n, rs] (blobs stay unflattened)
 
 
 def to_rows_fixed(table: Table, layout: RowLayout,
@@ -173,7 +173,7 @@ def _from_rows_pallas(rows2d: jnp.ndarray, layout: RowLayout,
     for i, dt in enumerate(layout.dtypes):
         b = byte_cols[i][:n]
         valid = ((vbytes[:n, i // 8] >> (i % 8)) & 1).astype(jnp.bool_)
-        data = rc.bytes_to_col(b, dt.np_dtype)
+        data = rc.bytes_to_col(b, None if dt.kind == 'decimal128' else dt.np_dtype, dt)
         cols.append(Column(dt, data, pack_bools(valid)))
     return cols
 

@@ -161,8 +161,9 @@ class SparkBloomFilter:
 def _col_to_u64(col: Column):
     """A long-compatible column's values as uint64 bits + validity."""
     data = np.asarray(col.data)
-    if data.ndim == 2:                       # no-x64 uint32 pairs
-        vals = np.ascontiguousarray(data).view(np.uint64).reshape(-1)
+    if data.ndim == 2:                       # no-x64 [2, n] plane pairs
+        from spark_rapids_jni_tpu.table import pair_to_np64
+        vals = pair_to_np64(data, np.uint64)
     elif data.dtype.itemsize == 8:
         vals = data.view(np.uint64)
     else:

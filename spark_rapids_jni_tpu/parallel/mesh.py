@@ -24,6 +24,30 @@ def make_mesh(devices: Optional[Sequence] = None,
     return Mesh(np.array(devices), (axis_name,))
 
 
+def table_partition_specs(table: Table, axis_name: str = "data") -> Table:
+    """A Table-shaped pytree of ``PartitionSpec``s sharding rows over
+    ``axis_name`` — for ``shard_map`` in_specs: most leaves shard axis 0,
+    but 64-bit plane-pair columns ([2, n]) carry rows on axis 1 with the
+    two word planes replicated."""
+    cols = []
+    row = P(axis_name)
+    for c in table.columns:
+        dspec = P(None, axis_name) \
+            if (c.data.ndim == 2 and c.dtype.itemsize == 8) else row
+        cols.append(Column(
+            c.dtype, dspec,
+            row if c.validity is not None else None,
+            row if c.offsets is not None else None,
+            row if c.chars is not None else None,
+            row if c.chars2d is not None else None,
+            row if c.lens is not None else None,
+            tuple(table_partition_specs(Table(c.children),
+                                        axis_name).columns)
+            if c.children else (),
+            capped=c.capped))
+    return Table(tuple(cols))
+
+
 def shard_table(table: Table, mesh: Mesh, axis_name: str = "data") -> Table:
     """Shard a table's rows across the mesh axis.
 
@@ -55,6 +79,10 @@ def shard_table(table: Table, mesh: Mesh, axis_name: str = "data") -> Table:
                 jax.device_put(c.chars2d, spec),
                 jax.device_put(c.str_lens(), spec)))
         else:
-            cols.append(Column(c.dtype, jax.device_put(c.data, spec),
+            dspec = spec
+            if c.data.ndim == 2 and c.dtype.itemsize == 8:
+                # [2, n] plane pairs: rows on axis 1, planes replicated
+                dspec = NamedSharding(mesh, P(None, axis_name))
+            cols.append(Column(c.dtype, jax.device_put(c.data, dspec),
                                validity))
     return Table(tuple(cols))

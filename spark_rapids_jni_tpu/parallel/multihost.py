@@ -131,8 +131,13 @@ def stage_table_global(host_columns: Sequence[np.ndarray],
         # stage pure numpy: no device round trip before the real upload
         vals = np.ascontiguousarray(vals.astype(dt.np_dtype, copy=False))
         if dt.itemsize == 8 and not jax.config.jax_enable_x64:
-            vals = vals.view(np.uint32).reshape(-1, 2)
-        data = jax.make_array_from_process_local_data(spec, vals)
+            from spark_rapids_jni_tpu.table import pair_from_np64
+            # [2, n] plane pairs: rows live on axis 1, planes replicate
+            data = jax.make_array_from_process_local_data(
+                NamedSharding(mesh, P(None, axis_name)),
+                pair_from_np64(vals))
+        else:
+            data = jax.make_array_from_process_local_data(spec, vals)
         vmask = None
         if valid is not None:
             packed = np.packbits(np.asarray(valid, dtype=bool),

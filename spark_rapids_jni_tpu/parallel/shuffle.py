@@ -210,10 +210,11 @@ def shuffle_table_sharded(table: Table, key_cols: Sequence[int],
 
     spec = P(axis_name)
     rep = P()
+    from spark_rapids_jni_tpu.parallel.mesh import table_partition_specs
 
     @functools.partial(
         shard_map, mesh=mesh,
-        in_specs=(spec,),
+        in_specs=(table_partition_specs(table, axis_name),),
         out_specs=(spec, spec, spec, rep),
         check_vma=False)
     def run(tbl):
@@ -250,9 +251,17 @@ def decode_shuffle_result(result: ShuffleResult, dtypes,
     if str_widths is None:
         str_widths = result.str_widths
 
+    def _data_spec(dt):
+        # 64-bit plane-pair columns ([2, n]) shard rows on axis 1
+        wide = dt.itemsize == 8 and not jax.config.jax_enable_x64
+        return P(None, axis_name) if wide else spec
+
     if not layout.has_strings:
+        out_tree = Table(tuple(Column(dt, _data_spec(dt), spec)
+                               for dt in layout.dtypes))
+
         @functools.partial(shard_map, mesh=mesh, in_specs=(spec,),
-                           out_specs=spec, check_vma=False)
+                           out_specs=out_tree, check_vma=False)
         def run(rows):
             return Table(tuple(rc._disassemble_fixed_rows(rows, layout)))
 
@@ -260,10 +269,13 @@ def decode_shuffle_result(result: ShuffleResult, dtypes,
 
     widths = tuple(str_widths)
     nstr = len(widths)
+    fixed_specs = tuple(_data_spec(dt) for dt in layout.dtypes
+                        if not dt.is_string)
 
     @functools.partial(
         shard_map, mesh=mesh, in_specs=(spec,),
-        out_specs=(spec,) * 3, check_vma=False)
+        out_specs=(fixed_specs, (spec,) * layout.num_columns,
+                   (spec,) * (2 * nstr)), check_vma=False)
     def run(rows):
         m = rows.shape[0]
         datas, masks, str_parts = rc.padded_cols_from_rows(

@@ -214,6 +214,42 @@ def ragged_positions(lens: np.ndarray):
     return rows, intra
 
 
+# ---------------------------------------------------------------------------
+# 64-bit plane pairs (the no-x64 representation: [2, n] uint32, lo/hi)
+# ---------------------------------------------------------------------------
+
+def pair_lo_hi(data: jnp.ndarray):
+    """(lo, hi) [n] uint32 vectors of a [2, n] plane-pair column."""
+    return data[0], data[1]
+
+
+def pair_from_lo_hi(lo: jnp.ndarray, hi: jnp.ndarray) -> jnp.ndarray:
+    """Build the [2, n] plane-pair representation from lo/hi words."""
+    return jnp.stack([lo, hi], axis=0)
+
+
+def pair_to_np64(data, np_dtype) -> np.ndarray:
+    """Host view of a [2, n] plane-pair column as native 64-bit values."""
+    a = np.asarray(data)
+    return np.ascontiguousarray(a.T).view(np_dtype).reshape(-1)
+
+
+def pair_from_np64(vals: np.ndarray) -> np.ndarray:
+    """Native 64-bit numpy values -> [2, n] uint32 plane pairs (host)."""
+    return np.ascontiguousarray(
+        np.asarray(vals).view(np.uint32).reshape(-1, 2).T)
+
+
+def pair_to_dtype(pair: jnp.ndarray, np_dtype) -> jnp.ndarray:
+    """[2, n] plane pair -> the dtype's device representation: under x64
+    a native 64-bit [n] array, otherwise the pair itself (identity)."""
+    if jax.config.jax_enable_x64:
+        return jax.lax.bitcast_convert_type(
+            jax.lax.bitcast_convert_type(pair.T, jnp.uint64),
+            np_dtype)
+    return pair
+
+
 def bytes2d_to_words(b: jnp.ndarray) -> jnp.ndarray:
     """[n, W] uint8 (W % 4 == 0) -> [n, W//4] little-endian uint32 words via
     strided lane slices (a bitcast's [n, W/4, 4] intermediate would pad the
@@ -271,9 +307,13 @@ class Column:
         vals = np.ascontiguousarray(np.asarray(values, dtype=dtype.np_dtype))
         if dtype.itemsize == 8 and not jax.config.jax_enable_x64:
             # TPU has no native 64-bit lanes and without x64 JAX would
-            # silently downcast; store as little-endian uint32 pairs [n, 2].
-            # Row conversion only moves bytes, so this is lossless.
-            data = jnp.asarray(vals.view(np.uint32).reshape(-1, 2))
+            # silently downcast; store PLANE-MAJOR as [2, n] uint32 (row
+            # 0 = low words, row 1 = high words).  Plane-major is the
+            # device-native layout: the row-conversion kernels read/write
+            # word planes directly (no planarization transpose), and
+            # elementwise consumers take lo/hi as contiguous [n] rows.
+            data = jnp.asarray(
+                np.ascontiguousarray(vals.view(np.uint32).reshape(-1, 2).T))
         else:
             data = jnp.asarray(vals)
         validity = None
@@ -382,7 +422,9 @@ class Column:
         if self.dtype.is_struct:
             return self.children[0].num_rows if self.children \
                 else self.data.shape[0]
-        return self.data.shape[0]
+        if self.data.ndim == 2 and self.dtype.itemsize == 8:
+            return self.data.shape[1]  # [2, n] 64-bit plane pairs
+        return self.data.shape[0]      # incl. [n, 4] decimal128 limbs
 
     @property
     def is_padded(self) -> bool:
@@ -518,9 +560,9 @@ class Column:
             return [chars[offs[i]:offs[i + 1]].decode("utf-8")
                     if valid[i] else None for i in range(n)]
         vals = np.asarray(self.data)
-        if vals.ndim == 2:  # 64-bit column stored as uint32 pairs
-            vals = np.ascontiguousarray(vals).view(
-                self.dtype.np_dtype).reshape(-1)
+        if vals.ndim == 2 and self.dtype.itemsize == 8:
+            # 64-bit column stored as [2, n] plane pairs
+            vals = pair_to_np64(vals, self.dtype.np_dtype)
         if self.dtype.kind == "bool8":
             return [bool(vals[i]) if valid[i] else None for i in range(n)]
         return [vals[i].item() if valid[i] else None for i in range(n)]
@@ -781,7 +823,12 @@ def slice_table(table: Table, start: int, end: int) -> Table:
                                c.lens[start:end]
                                if c.lens is not None else None))
         else:
-            cols.append(Column(c.dtype, c.data[start:end], validity))
+            # 64-bit plane pairs [2, n] slice rows on the LAST axis;
+            # everything else (incl. [n, 4] decimal128 limbs) on axis 0
+            if c.data.ndim == 2 and c.dtype.itemsize == 8:
+                cols.append(Column(c.dtype, c.data[:, start:end], validity))
+            else:
+                cols.append(Column(c.dtype, c.data[start:end], validity))
     return Table(tuple(cols))
 
 
@@ -812,8 +859,10 @@ def slice_table_dynamic(table: Table, start, size: int) -> Table:
                                lax.dynamic_slice_in_dim(c.lens, start, size)
                                if c.lens is not None else None))
         else:
+            ax = 1 if (c.data.ndim == 2 and c.dtype.itemsize == 8) else 0
             cols.append(Column(c.dtype,
-                               lax.dynamic_slice_in_dim(c.data, start, size),
+                               lax.dynamic_slice_in_dim(c.data, start,
+                                                        size, axis=ax),
                                validity))
     return Table(tuple(cols))
 
@@ -837,8 +886,11 @@ def assert_tables_equivalent(a: Table, b: Table, *, check_nulls: bool = True):
             da = np.asarray(ca.data)
             db = np.asarray(cb.data)
             if check_nulls:
-                ma = va[:, None] if da.ndim == 2 else va
-                mb = vb[:, None] if db.ndim == 2 else vb
+                pairish = ca.dtype.itemsize == 8
+                ma = (va[None, :] if pairish else va[:, None]) \
+                    if da.ndim == 2 else va
+                mb = (vb[None, :] if pairish else vb[:, None]) \
+                    if db.ndim == 2 else vb
                 da = np.where(ma, da, 0)
                 db = np.where(mb, db, 0)
             np.testing.assert_array_equal(da, db, err_msg=f"column {i} data")

@@ -84,8 +84,9 @@ def _int_bounds(dt: DType, profile: DataProfile):
 def _gen_fixed(key, dt: DType, shape, profile: DataProfile) -> jnp.ndarray:
     """Random fixed-width values of any shape (``shape`` may be an int for a
     single column, or ``(g, n)`` for a whole group of ``g`` same-dtype
-    columns generated in one vector op).  64-bit dtypes under no-x64 grow a
-    trailing axis of 2 uint32 words."""
+    columns generated in one vector op).  64-bit dtypes under no-x64 grow
+    a plane axis of 2 uint32 words BEFORE the row axis (``[..., 2, n]``,
+    the Column plane-pair layout)."""
     if isinstance(shape, int):
         shape = (shape,)
     np_dt = dt.np_dtype
@@ -97,7 +98,7 @@ def _gen_fixed(key, dt: DType, shape, profile: DataProfile) -> jnp.ndarray:
             bits = jax.random.bits(key, (*shape, 2), dtype=jnp.uint32)
             # clamp exponent range to avoid inf/nan: zero the top exponent bit
             hi = bits[..., 1] & jnp.uint32(0xBFEFFFFF)
-            return jnp.stack([bits[..., 0], hi], axis=-1)
+            return jnp.stack([bits[..., 0], hi], axis=-2)
         if profile.distribution == "normal":
             vals = profile.float_mean + profile.float_std * \
                 jax.random.normal(key, shape, dtype=jnp.float32)
@@ -129,7 +130,7 @@ def _gen_fixed(key, dt: DType, shape, profile: DataProfile) -> jnp.ndarray:
                              jnp.uint32(0))
             if np_dt.kind == "u":
                 hi_w = jnp.zeros_like(hi_w)
-            return jnp.stack([lo_w, hi_w], axis=-1)
+            return jnp.stack([lo_w, hi_w], axis=-2)
         # randint computes in int64 (x64 on) or int32 (off); clamp both
         # sides — defaulted OR explicit — so maxval=hi+1 fits that dtype
         # (the extreme value of the full range is unreachable when bounded;
@@ -140,7 +141,8 @@ def _gen_fixed(key, dt: DType, shape, profile: DataProfile) -> jnp.ndarray:
         hi = min(hi, int(rinfo.max) - 1)
         return jax.random.randint(key, shape, lo, hi + 1).astype(np_dt)
     if np_dt.itemsize == 8 and wide:
-        return jax.random.bits(key, (*shape, 2), dtype=jnp.uint32)
+        return jax.random.bits(key, (*shape[:-1], 2, shape[-1]),
+                               dtype=jnp.uint32)
     if profile.distribution == "geometric":
         # exact geometric via inverse CDF: X = floor(ln(U)/ln(1-p)); p set
         # so the mean sits at ~1/4 of the dtype range, the same shape the

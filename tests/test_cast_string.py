@@ -588,8 +588,9 @@ def test_temporal_to_string_roundtrip(rng, x64_both):
     back_ts, err = cast_string_to_timestamp(ts)
     assert not np.asarray(err).any()
     back_np = np.asarray(back_ts.data)
-    if back_np.ndim == 2:
-        back_np = np.ascontiguousarray(back_np).view(np.int64).reshape(-1)
+    if back_np.ndim == 2:  # [2, n] plane pairs
+        from spark_rapids_jni_tpu.table import pair_to_np64
+        back_np = pair_to_np64(back_np, np.int64)
     assert back_np.tolist() == micros.tolist()
 
     # out-of-render-range years null out

@@ -95,9 +95,9 @@ def test_int64_bounds_honored():
     t = create_random_table([INT64], 500,
                             DataProfile(int_lower=-7, int_upper=9), seed=4)
     v = np.asarray(t.columns[0].data)
-    if v.ndim == 2:  # wide (no-x64) pair representation
-        lo = v[:, 0].astype(np.uint64)
-        hi = v[:, 1].astype(np.uint64)
+    if v.ndim == 2:  # wide (no-x64) [2, n] plane-pair representation
+        lo = v[0].astype(np.uint64)
+        hi = v[1].astype(np.uint64)
         v = (lo | (hi << np.uint64(32))).view(np.int64)
     assert v.min() >= -7 and v.max() <= 9
 
@@ -119,13 +119,13 @@ def test_int64_bounds_wide_path():
         assert one_sided.min() >= 100
         wide_one_sided = np.asarray(_gen_fixed(
             jax.random.PRNGKey(2), INT64, 100, DataProfile(int_lower=0)))
-        assert wide_one_sided.shape == (100, 2)
+        assert wide_one_sided.shape == (2, 100)
     finally:
         jax.config.update("jax_enable_x64", prev)
     pairs = np.asarray(out)
-    assert pairs.shape == (300, 2)
-    v = (pairs[:, 0].astype(np.uint64)
-         | (pairs[:, 1].astype(np.uint64) << np.uint64(32))).view(np.int64)
+    assert pairs.shape == (2, 300)
+    v = (pairs[0].astype(np.uint64)
+         | (pairs[1].astype(np.uint64) << np.uint64(32))).view(np.int64)
     assert v.min() >= -4 and v.max() <= 11
 
 
@@ -138,8 +138,8 @@ def test_one_sided_bounds_extreme_dtypes():
     t = create_random_table([INT64], 200, DataProfile(int_lower=0), seed=5)
     v = np.asarray(t.columns[0].data)
     if v.ndim == 2:
-        v = (v[:, 0].astype(np.uint64)
-             | (v[:, 1].astype(np.uint64) << np.uint64(32))).view(np.int64)
+        v = (v[0].astype(np.uint64)
+             | (v[1].astype(np.uint64) << np.uint64(32))).view(np.int64)
     assert v.min() >= 0
     t = create_random_table([UINT64], 200, DataProfile(int_lower=1), seed=6)
     # explicit INT32 bound at the dtype max, x64 off (int32 compute)

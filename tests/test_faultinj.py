@@ -42,6 +42,41 @@ def test_lookup_precedence_exact_over_wildcard():
         st.maybe_inject("pjrtExecuteFaults", "other")
 
 
+def test_lookup_precedence_prefix_chain():
+    """Parity with the reference's cbid -> functionName -> '*' lookup
+    (faultinj.cu:142-152): here the chain is exact -> dotted prefixes
+    (most-specific first) -> '*', walked one segment at a time."""
+    st = make_state({"pjrtTransferFaults": {
+        "device_put.tpu.h2d": {"percent": 0, "injectionType": 1,
+                               "interceptionCount": 10},
+        "device_put": {"percent": 100, "injectionType": 2,
+                       "substituteReturnCode": 7,
+                       "interceptionCount": 10},
+        "*": {"percent": 100, "injectionType": 1,
+              "interceptionCount": 10},
+    }})
+    # deepest exact match wins (percent 0 -> no fire)
+    st.maybe_inject("pjrtTransferFaults", "device_put.tpu.h2d")
+    # unknown leaf walks up: device_put.tpu.d2h -> device_put.tpu ->
+    # device_put (substitute rule), NOT the wildcard assert
+    with pytest.raises(faultinj.InjectedRuntimeError):
+        st.maybe_inject("pjrtTransferFaults", "device_put.tpu.d2h")
+    # names outside the prefix family fall through to '*'
+    with pytest.raises(faultinj.DeviceAssertError):
+        st.maybe_inject("pjrtTransferFaults", "host_to_device")
+
+
+def test_lookup_no_match_returns_none():
+    st = make_state({"pjrtExecuteFaults": {
+        "jit_f": {"percent": 100, "injectionType": 1,
+                  "interceptionCount": 10}}})
+    assert st.lookup("pjrtExecuteFaults", "jit_g") is None
+    # a dotted name whose root has no rule also misses (no wildcard)
+    assert st.lookup("pjrtExecuteFaults", "jit_g.tpu") is None
+    # and domains are independent namespaces
+    assert st.lookup("pjrtCompileFaults", "jit_f") is None
+
+
 def test_percent_zero_never_fires():
     st = make_state({"pjrtCompileFaults": {
         "*": {"percent": 0, "injectionType": 0, "interceptionCount": 1000}}})

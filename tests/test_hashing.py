@@ -198,7 +198,8 @@ def test_murmur3_wide_double_normalizes_negzero_and_nan():
 
     bits = vals.copy().view(np.uint64)
     bits[2] = np.uint64(0x7FF0000000000001)  # non-canonical (signaling) NaN
-    pairs = np.ascontiguousarray(bits).view(np.uint32).reshape(-1, 2)
+    pairs = np.ascontiguousarray(
+        np.ascontiguousarray(bits).view(np.uint32).reshape(-1, 2).T)
     h_wide = murmur3_hash([Column(FLOAT64, jnp.asarray(pairs))])
     np.testing.assert_array_equal(np.asarray(h_scalar), np.asarray(h_wide))
     # and -0.0 hashes like +0.0

@@ -225,3 +225,48 @@ def test_pallas_kernel_matches_xla(rng):
     ta = convert_from_rows(a[0], dtypes, use_pallas=False)
     tb = convert_from_rows(b[0], dtypes, use_pallas=True)
     assert_tables_equivalent(ta, tb)
+
+
+def test_decimal128_row_roundtrip_all_engines(x64_both):
+    """decimal128 ([n, 4] uint32 limb) columns cross the JCUDF row
+    boundary on every engine: 16-byte slots aligned to 16 (reference
+    compute_column_information aligns to col_size,
+    row_conversion.cu:1350), 4 plane words in the grouped backing."""
+    from spark_rapids_jni_tpu.ops.decimal import (
+        decimal128_from_ints, decimal128_to_ints)
+    from spark_rapids_jni_tpu.ops import (
+        convert_to_rows, convert_from_rows, convert_from_rows_grouped,
+        convert_to_rows_fixed_width_optimized,
+        convert_from_rows_fixed_width_optimized)
+    vals = [0, 1, -1, 10 ** 38 - 1, -(10 ** 38 - 1), 12345678901234567890]
+    t = Table((Column.from_numpy(np.arange(6, dtype=np.int32), INT32,
+                                 valid=np.array([1, 1, 0, 1, 1, 1], bool)),
+               decimal128_from_ints(vals, 2),
+               Column.from_numpy(np.arange(6, dtype=np.int8), INT8)))
+    expect_dec = decimal128_to_ints(t.columns[1])
+    for impl in ("xla", "mxu"):
+        [rows] = convert_to_rows(t, impl=impl)
+        back = convert_from_rows(rows, t.dtypes, impl=impl)
+        assert decimal128_to_ints(back.columns[1]) == expect_dec, impl
+        assert back.columns[0].to_pylist() == t.columns[0].to_pylist()
+    # oracle engine pair
+    [orows] = convert_to_rows_fixed_width_optimized(t)
+    oback = convert_from_rows_fixed_width_optimized(orows, t.dtypes)
+    assert decimal128_to_ints(oback.columns[1]) == expect_dec
+    # oracle bytes == optimized bytes (the dual-implementation contract)
+    [xrows] = convert_to_rows(t, impl="xla")
+    np.testing.assert_array_equal(
+        np.asarray(orows.data).reshape(-1),
+        np.asarray(xrows.data).reshape(-1))
+    # grouped backing: 4 plane rows per decimal column, lazy extraction
+    gc = convert_from_rows_grouped(xrows, t.dtypes)
+    assert decimal128_to_ints(gc.column(1)) == expect_dec
+
+
+def test_decimal128_sixteen_byte_alignment():
+    """A 1-byte column before a decimal128 forces 15 padding bytes."""
+    from spark_rapids_jni_tpu.ops.decimal import decimal128
+    from spark_rapids_jni_tpu.ops import compute_row_layout
+    lay = compute_row_layout([INT8, decimal128(0), INT8])
+    assert lay.col_starts == (0, 16, 32)
+    assert lay.col_sizes == (1, 16, 1)

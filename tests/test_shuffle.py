@@ -21,6 +21,16 @@ def mesh(cpu_devices):
     return make_mesh(cpu_devices[:8])
 
 
+def _rows(col):
+    """Per-row numpy values of a column (resolves the no-x64 [2, n]
+    plane-pair representation of 64-bit columns)."""
+    from spark_rapids_jni_tpu.table import pair_to_np64
+    v = np.asarray(col.data)
+    if v.ndim == 2 and col.dtype.itemsize == 8:
+        v = pair_to_np64(v, col.dtype.np_dtype)
+    return v
+
+
 def _make_sharded(rng, mesh, n):
     key = rng.integers(0, 1 << 30, n, dtype=np.int64)
     payload = rng.integers(-2**31, 2**31, n, dtype=np.int32)
@@ -38,12 +48,11 @@ def test_shuffle_delivers_all_rows_once(rng, mesh, x64_both):
 
     out = decode_shuffle_result(res, t.dtypes, mesh)
     mask = np.asarray(res.row_valid)
-    got_keys = np.asarray(out.columns[0].data)
-    # 64-bit no-x64 pair representation (x64 on in tests -> plain int64)
+    got_keys = _rows(out.columns[0])
     got_pairs = sorted(zip(got_keys[mask].tolist(),
-                           np.asarray(out.columns[1].data)[mask].tolist()))
-    exp_pairs = sorted(zip(np.asarray(t.columns[0].data).tolist(),
-                           np.asarray(t.columns[1].data).tolist()))
+                           _rows(out.columns[1])[mask].tolist()))
+    exp_pairs = sorted(zip(_rows(t.columns[0]).tolist(),
+                           _rows(t.columns[1]).tolist()))
     assert got_pairs == exp_pairs
 
 
@@ -53,7 +62,7 @@ def test_rows_land_on_spark_partition(rng, mesh):
     res = shuffle_table_sharded(ts, key_cols=[0], mesh=mesh)
     out = decode_shuffle_result(res, t.dtypes, mesh)
     mask = np.asarray(res.row_valid)
-    keys = np.asarray(out.columns[0].data)
+    keys = _rows(out.columns[0])
 
     # expected partition per key via the same public hash API
     t_keys = Table((t.columns[0],))
