@@ -131,7 +131,10 @@ def _lexsort_live_last(keys, mask, descending=None):
     for k, d in zip(keys, desc):
         if d:
             k = ~k
-        ks.append(jnp.where(mask, k, jnp.iinfo(k.dtype).max))
+        # typed sentinel: a bare python uint32-max literal overflows
+        # int32 weak typing under no-x64
+        ks.append(jnp.where(mask, k, jnp.array(jnp.iinfo(k.dtype).max,
+                                               k.dtype)))
     order = jnp.arange(n, dtype=jnp.int32)
     for k in reversed(ks):       # chained stable sorts = lexicographic
         order = order[jnp.argsort(k[order], stable=True)]
@@ -299,65 +302,9 @@ def hash_aggregate_multi(keys: Sequence[jnp.ndarray],
     for _, op in measures:
         if op not in _AGG_OPS:
             raise ValueError(f"unknown aggregate op {op!r}")
-    n = keys[0].shape[0]
-    if n == 0:
-        z = jnp.zeros((max_groups,), jnp.int32)
-        outs = []
-        for v, op in measures:
-            dt = jnp.float32 if op == "avg" else \
-                (jnp.int32 if op == "count" else v.dtype)
-            outs.append(jnp.zeros((max_groups,), dt))
-        return ([z.astype(k.dtype) for k in keys], outs,
-                jnp.zeros((max_groups,), jnp.bool_), jnp.int32(0))
-    order, ks, live = _lexsort_live_last(list(keys), mask)
-    changed = jnp.zeros((n - 1,), jnp.bool_) if n > 1 else None
-    for k in ks:
-        if n > 1:
-            changed = changed | (k[1:] != k[:-1])
-    is_new = jnp.concatenate(
-        [jnp.ones((1,), jnp.int32),
-         changed.astype(jnp.int32) if n > 1 else jnp.zeros((0,), jnp.int32)])
-    seg = jnp.cumsum(is_new) - 1
-    in_range = seg < max_groups
-    seg_c = jnp.where(in_range, seg, max_groups)
-    contrib = live & in_range
-    nseg = max_groups + 1
-    counts = jax.ops.segment_sum(contrib.astype(jnp.int32), seg_c,
-                                 num_segments=nseg)[:max_groups]
-    outs = []
-    for v, op in measures:
-        vo = v[order]
-        if op == "count":
-            outs.append(counts)
-            continue
-        if op in ("sum", "avg"):
-            s = jax.ops.segment_sum(jnp.where(contrib, vo, 0), seg_c,
-                                    num_segments=nseg)[:max_groups]
-            if op == "avg":
-                s = s.astype(jnp.float32) / jnp.maximum(counts, 1) \
-                    .astype(jnp.float32)
-            outs.append(s)
-            continue
-        if jnp.issubdtype(vo.dtype, jnp.floating):
-            ident = jnp.array(jnp.inf if op == "min" else -jnp.inf,
-                              vo.dtype)
-        else:
-            info = jnp.iinfo(vo.dtype)
-            ident = jnp.array(info.max if op == "min" else info.min,
-                              vo.dtype)
-        masked = jnp.where(contrib, vo, ident)
-        red = jax.ops.segment_min if op == "min" else jax.ops.segment_max
-        r = red(masked, seg_c, num_segments=nseg)[:max_groups]
-        outs.append(jnp.where(counts > 0, r, 0))
-    have = counts > 0
-    first_idx = jax.ops.segment_min(
-        jnp.arange(n, dtype=jnp.int32), seg_c,
-        num_segments=nseg)[:max_groups]
-    safe = jnp.minimum(first_idx, n - 1)
-    gkeys = [jnp.where(have, k[safe], 0) for k in ks]
-    seg_live = jax.ops.segment_sum(live.astype(jnp.int32), seg,
-                                   num_segments=n) > 0
-    num_groups = jnp.sum(seg_live.astype(jnp.int32))
+    gkeys, outs, _, have, num_groups = _hash_aggregate_nulls(
+        list(keys), [(v, op, None) for v, op in measures], mask,
+        max_groups)
     return gkeys, outs, have, num_groups
 
 
@@ -585,3 +532,373 @@ def merge_aggregate_partials(partials, ops: Sequence[str]):
                 else:
                     acc[i] = max(acc[i], vals[i])
     return out
+
+# ---------------------------------------------------------------------------
+# Columnar (Table / GroupedColumns) operator layer with Spark null
+# semantics
+# ---------------------------------------------------------------------------
+#
+# The raw-array kernels above are the compute cores; these wrappers lift
+# them to columns-with-validity, implementing the semantics Spark layers
+# above the reference's kernels (SURVEY.md §1):
+#
+# - GROUP BY uses null-safe equality: null keys group TOGETHER (one
+#   group per composite null pattern), and the output key column is null
+#   for that group.
+# - COUNT(*) counts live rows; COUNT(col) counts non-null values.
+# - SUM / MIN / MAX / AVG skip null values, and a group with no non-null
+#   input yields NULL (not zero).
+# - Join keys never match on null (null != null), on either side.
+#
+# Sources are duck-typed on ``.column(i)``: a Table materializes
+# nothing; a GroupedColumns extracts lazily from its plane backing —
+# called under jit, the extraction slices fuse into the consumer, so a
+# decode->aggregate pipeline never materializes per-column arrays.
+
+
+def _key_subarrays(col: Column):
+    """A key column as sortable integer word arrays (major first).
+
+    32-bit-and-narrower keys are one array; 64-bit plane-pair keys
+    expand to (hi as signed int32, lo as uint32) — lexicographically
+    equal to the int64 order."""
+    data = col.data
+    if data.ndim == 2 and col.dtype.itemsize == 8:
+        lo, hi = data[0], data[1]
+        return [jax.lax.bitcast_convert_type(hi, jnp.int32), lo]
+    return [data]  # incl. native 64-bit under x64 (argsort handles i64)
+
+
+def _source_column(source, i: int) -> Column:
+    return source.column(i) if callable(getattr(source, "column", None)) \
+        else source.columns[i]
+
+
+def _source_num_rows(source) -> int:
+    return source.num_rows
+
+
+def hash_aggregate_table(source, key_idxs: Sequence[int],
+                         measures: Sequence, max_groups: int,
+                         mask: Optional[jnp.ndarray] = None):
+    """Group-by over a Table or GroupedColumns with Spark null
+    semantics.
+
+    ``measures``: sequence of ``(col_idx_or_None, op)`` — ``None``
+    column means COUNT(*).  Returns ``(result_table, have, num_groups)``
+    where ``result_table``'s columns are the key columns followed by one
+    column per measure, each with proper validity (null-key groups show
+    null keys; empty SUM/MIN/MAX/AVG show null).  ``have`` flags live
+    group slots; ``num_groups`` is the uncapped distinct-key count (the
+    overflow contract of :func:`hash_aggregate_multi`).
+    """
+    from spark_rapids_jni_tpu.table import pack_bools, INT32
+    n = _source_num_rows(source)
+    live = jnp.ones((n,), jnp.bool_) if mask is None else mask
+
+    key_cols = [_source_column(source, i) for i in key_idxs]
+    sort_keys = []     # expanded arrays driving grouping equality
+    per_key = []       # (packed_bits_or_0, n_subarrays) bookkeeping
+    for c in key_cols:
+        kv = c.valid_bools()
+        null_flag = (~kv).astype(jnp.int32)
+        subs = _key_subarrays(c)
+        bits = 8 * c.dtype.itemsize
+        if len(subs) == 1 and bits <= 16:
+            # narrow key: pack (null_flag << bits) | zext(data) into ONE
+            # int32 sort key — halves the chained stable argsorts (the
+            # aggregate's dominant cost at row scale)
+            u = subs[0]
+            if u.dtype == jnp.bool_:
+                u = u.astype(jnp.uint8)
+            uns = jnp.dtype(f"uint{bits}")
+            if u.dtype != uns:
+                u = jax.lax.bitcast_convert_type(u, uns)
+            packed = (null_flag << bits) \
+                | jnp.where(kv, u.astype(jnp.int32), 0)
+            sort_keys.append(packed)
+            per_key.append((bits, 1))
+            continue
+        # the null flag leads its key's subarrays: null-safe equality
+        # (two rows group together iff both null or both equal), with
+        # data zeroed under null so garbage cannot split the null group
+        sort_keys.append(null_flag)
+        sort_keys.extend(
+            jnp.where(kv, s, jnp.zeros_like(s)) for s in subs)
+        per_key.append((0, len(subs)))
+
+    mcore = []
+    for idx, op in measures:
+        if op not in _AGG_OPS:
+            raise ValueError(f"unknown aggregate op {op!r}")
+        if idx is None:  # COUNT(*)
+            mcore.append((jnp.zeros((n,), jnp.int32), "count", None))
+            continue
+        c = _source_column(source, idx)
+        if c.data.ndim == 2:
+            raise NotImplementedError(
+                "64-bit measure columns need the pair-sum kernel; "
+                "widen on the host after partial aggregation")
+        mcore.append((c.data, op, c.valid_bools()))
+
+    gkeys, outs, metas, have, num_groups = _hash_aggregate_nulls(
+        sort_keys, mcore, live, max_groups)
+
+    out_cols = []
+    ki = 0
+    for c, (packed_bits, nsub) in zip(key_cols, per_key):
+        if packed_bits:
+            pk = gkeys[ki]; ki += 1
+            gnull = pk >> packed_bits
+            raw = (pk & ((1 << packed_bits) - 1)).astype(
+                jnp.dtype(f"uint{packed_bits}"))
+            data = raw if c.data.dtype == raw.dtype else \
+                (raw.astype(jnp.uint8).astype(jnp.bool_)
+                 if c.data.dtype == jnp.bool_
+                 else jax.lax.bitcast_convert_type(raw, c.data.dtype))
+        else:
+            gnull = gkeys[ki]; ki += 1
+            subs = gkeys[ki:ki + nsub]; ki += nsub
+            if nsub == 2:  # 64-bit plane pair: (hi signed, lo)
+                data = jnp.stack(
+                    [subs[1], jax.lax.bitcast_convert_type(subs[0],
+                                                           jnp.uint32)],
+                    axis=0)
+            else:
+                data = subs[0].astype(c.data.dtype) \
+                    if subs[0].dtype != c.data.dtype else subs[0]
+        valid = have & (gnull == 0)
+        out_cols.append(Column(c.dtype, data, pack_bools(valid)))
+    for (idx, op), out, meta in zip(measures, outs, metas):
+        from spark_rapids_jni_tpu.table import DType
+        if op == "count":
+            dt, valid = INT32, have          # COUNT is never null
+        else:
+            src = _source_column(source, idx)
+            dt = DType("float32", 4) if op == "avg" else src.dtype
+            valid = have & meta              # null when no non-null input
+        out_cols.append(Column(dt, out, pack_bools(valid)))
+    return Table(tuple(out_cols)), have, num_groups
+
+
+def _hash_aggregate_nulls(sort_keys, measures, live, max_groups: int):
+    """Core of :func:`hash_aggregate_table`: like
+    :func:`hash_aggregate_multi` but with per-measure validity.
+    ``measures``: (values, op, valid_or_None).  Returns (sorted group
+    key arrays, measure outputs, per-measure non-empty flags, have,
+    num_groups)."""
+    n = live.shape[0]
+    if n == 0:
+        mg = max_groups
+        gkeys = [jnp.zeros((mg,), k.dtype) for k in sort_keys]
+        outs, metas = [], []
+        for v, op, _ in measures:
+            dt = jnp.float32 if op == "avg" else \
+                (jnp.int32 if op == "count" else v.dtype)
+            outs.append(jnp.zeros((mg,), dt))
+            metas.append(None if op == "count"
+                         else jnp.zeros((mg,), jnp.bool_))
+        return (gkeys, outs, metas, jnp.zeros((mg,), jnp.bool_),
+                jnp.int32(0))
+    order, ks, lv = _lexsort_live_last(list(sort_keys), live)
+    changed = jnp.zeros((n - 1,), jnp.bool_) if n > 1 else None
+    for k in ks:
+        if n > 1:
+            changed = changed | (k[1:] != k[:-1])
+    is_new = jnp.concatenate(
+        [jnp.ones((1,), jnp.int32),
+         changed.astype(jnp.int32) if n > 1 else jnp.zeros((0,), jnp.int32)])
+    seg = jnp.cumsum(is_new) - 1
+    in_range = seg < max_groups
+    seg_c = jnp.where(in_range, seg, max_groups)
+    contrib = lv & in_range
+    nseg = max_groups + 1
+    star_counts = jax.ops.segment_sum(contrib.astype(jnp.int32), seg_c,
+                                      num_segments=nseg)[:max_groups]
+    outs, metas = [], []
+    for v, op, vvalid in measures:
+        vo = v[order]
+        mvalid = contrib if vvalid is None else contrib & vvalid[order]
+        nn = jax.ops.segment_sum(mvalid.astype(jnp.int32), seg_c,
+                                 num_segments=nseg)[:max_groups]
+        if op == "count":
+            # COUNT(*) when vvalid is None, COUNT(col) otherwise
+            outs.append(star_counts if vvalid is None else nn)
+            metas.append(None)
+            continue
+        if op in ("sum", "avg"):
+            s = jax.ops.segment_sum(jnp.where(mvalid, vo, 0), seg_c,
+                                    num_segments=nseg)[:max_groups]
+            if op == "avg":
+                s = s.astype(jnp.float32) / jnp.maximum(nn, 1) \
+                    .astype(jnp.float32)
+            outs.append(s)
+        else:
+            if jnp.issubdtype(vo.dtype, jnp.floating):
+                ident = jnp.array(jnp.inf if op == "min" else -jnp.inf,
+                                  vo.dtype)
+            else:
+                info = jnp.iinfo(vo.dtype)
+                ident = jnp.array(info.max if op == "min" else info.min,
+                                  vo.dtype)
+            red = jax.ops.segment_min if op == "min" \
+                else jax.ops.segment_max
+            r = red(jnp.where(mvalid, vo, ident), seg_c,
+                    num_segments=nseg)[:max_groups]
+            outs.append(jnp.where(nn > 0, r, 0))
+        metas.append(nn > 0)
+    have = star_counts > 0
+    first_idx = jax.ops.segment_min(
+        jnp.arange(n, dtype=jnp.int32), seg_c,
+        num_segments=nseg)[:max_groups]
+    safe = jnp.minimum(first_idx, n - 1)
+    gkeys = [jnp.where(have, k[safe], 0) for k in ks]
+    seg_live = jax.ops.segment_sum(lv.astype(jnp.int32), seg,
+                                   num_segments=n) > 0
+    num_groups = jnp.sum(seg_live.astype(jnp.int32))
+    return gkeys, outs, metas, have, num_groups
+
+
+# -- null-aware join wrappers ------------------------------------------------
+
+def _join_key_and_valid(source, idx: int):
+    c = _source_column(source, idx)
+    if c.data.ndim == 2:
+        raise NotImplementedError(
+            "64-bit join keys: probe via two int32 word joins or cast")
+    return c.data, c.valid_bools()
+
+
+def join_semi_mask_table(build, build_key: int, probe,
+                         probe_key: int) -> jnp.ndarray:
+    """Left-semi existence mask with Spark null semantics: null probe
+    keys never match; null build keys match nothing."""
+    bk, bv = _join_key_and_valid(build, build_key)
+    pk, pv = _join_key_and_valid(probe, probe_key)
+    # exclude null build rows: move them to a sentinel AND bound-check
+    # probe matches against the count of real rows (a live probe equal
+    # to the sentinel cannot false-match: its hits are range-checked
+    # against the non-null prefix)
+    big = jnp.iinfo(bk.dtype).max
+    bks = jnp.sort(jnp.where(bv, bk, big))
+    n_real = jnp.sum(bv.astype(jnp.int32))
+    lo = jnp.searchsorted(bks, pk, side="left")
+    hi = jnp.searchsorted(bks, pk, side="right")
+    return pv & (jnp.minimum(hi, n_real) > lo)
+
+
+def join_inner_table(build, build_key: int, build_payload: int,
+                     probe, probe_key: int, capacity: int):
+    """Inner join (duplicate build keys allowed) with null-key
+    exclusion on both sides.  Returns (probe_idx, payload, payload_valid,
+    slot_valid, total, overflow) — like :func:`sort_merge_join_dup` plus
+    the gathered payload's own validity (a matched row whose payload is
+    null stays in the join output with ``payload_valid`` False, exactly
+    Spark's inner-join-then-project semantics)."""
+    bk, bv = _join_key_and_valid(build, build_key)
+    pk, pv = _join_key_and_valid(probe, probe_key)
+    bpc = _source_column(build, build_payload)
+    bp = bpc.data
+    bpv = bpc.valid_bools()
+    big = jnp.array(jnp.iinfo(bk.dtype).max, bk.dtype)
+    # null build rows park at the key sentinel; sorting by validity
+    # FIRST (valid rows leading) and then stably by key guarantees that
+    # within the sentinel key value every real row precedes every
+    # parked null row, so the count-bounded gather window [lo, lo+cnt)
+    # can only cover real rows even when a live key equals dtype max
+    order0 = jnp.argsort((~bv).astype(jnp.int32), stable=True)
+    k1 = jnp.where(bv, bk, big)[order0]
+    order = order0[jnp.argsort(k1, stable=True)]
+    bks = jnp.where(bv, bk, big)[order]
+    bps = bp[order]
+    bpvs = bpv[order]
+    n_real = jnp.sum(bv.astype(jnp.int32))
+    lo = jnp.searchsorted(bks, pk, side="left")
+    hi = jnp.minimum(jnp.searchsorted(bks, pk, side="right"), n_real)
+    counts = jnp.maximum(hi - lo, 0).astype(jnp.int32)
+    counts = jnp.where(pv, counts, 0)       # null probes emit nothing
+    starts = jnp.cumsum(counts) - counts
+    total = jnp.sum(counts)
+    overflow = total > capacity
+    slots = jnp.arange(capacity, dtype=jnp.int32)
+    probe_idx = jnp.searchsorted(starts, slots, side="right") \
+        .astype(jnp.int32) - 1
+    probe_idx = jnp.clip(probe_idx, 0, pk.shape[0] - 1)
+    within = slots - starts[probe_idx]
+    valid = (slots < total) & (within < counts[probe_idx])
+    bidx = jnp.clip(lo[probe_idx] + within, 0, bks.shape[0] - 1)
+    return (probe_idx, jnp.where(valid, bps[bidx], 0),
+            valid & bpvs[bidx], valid, total, overflow)
+
+
+def distributed_q72_table_step(mesh, axis_name="data",
+                               capacity_factor: float = 8.0,
+                               join_expansion: int = 4,
+                               max_groups: int = MAX_GROUPS):
+    """The q72 shape over TABLES: row-sharded (item, week, quantity)
+    columns WITH validity hash-exchange across the mesh (null flags ride
+    the payload), join a replicated build Table with null-key exclusion,
+    and aggregate with :func:`hash_aggregate_table` semantics — the
+    null-aware twin of :func:`distributed_q72_step`.
+
+    Takes (probe_table, build_table) sharded/replicated per
+    ``table_partition_specs``; returns (result_table, have, num_groups,
+    overflow) per device.  Null-key probe rows never join, so no
+    null-key groups cross devices (the host partial merge stays
+    key-numeric); null quantities drop at the filter (NULL comparisons
+    are not true) and null inventory payloads drop the same way.
+    """
+    from jax.sharding import PartitionSpec as P
+    from spark_rapids_jni_tpu.parallel.shuffle import bucket_exchange
+    from spark_rapids_jni_tpu.parallel.mesh import table_partition_specs
+    from spark_rapids_jni_tpu.table import INT32, pack_bools
+    num_parts = mesh.shape[axis_name]
+
+    def step(tbl, build):
+        item, week, qty = tbl.columns[0], tbl.columns[1], tbl.columns[2]
+        n_local = item.num_rows
+        capacity = max(8, int(capacity_factor * n_local / num_parts))
+        pids = pmod(murmur3_hash([Column(INT32, item.data)]), num_parts)
+        flags = item.valid_bools().astype(jnp.int32) \
+            | (week.valid_bools().astype(jnp.int32) << 1) \
+            | (qty.valid_bools().astype(jnp.int32) << 2)
+        payload = jnp.stack([item.data, week.data, qty.data, flags],
+                            axis=1)
+        exchange = bucket_exchange(num_parts, capacity, axis_name)
+        recv, slot_valid, _, x_overflow = exchange(payload, pids)
+        r_item, r_week, r_qty, r_flags = (recv[:, j] for j in range(4))
+        iv = slot_valid & ((r_flags & 1) != 0)
+        wv = slot_valid & ((r_flags & 2) != 0)
+        qv = slot_valid & ((r_flags & 4) != 0)
+
+        probe = Table((Column(INT32, r_item, pack_bools(iv)),))
+        join_cap = recv.shape[0] * join_expansion
+        pidx, inv_q, inv_valid, jvalid, _, j_overflow = join_inner_table(
+            build, 0, 1, probe, 0, join_cap)
+        live = jvalid & slot_valid[pidx] & qv[pidx] & inv_valid \
+            & (inv_q < r_qty[pidx])
+        joined = Table((
+            Column(INT32, r_item[pidx], pack_bools(iv[pidx])),
+            Column(INT32, r_week[pidx], pack_bools(wv[pidx])),
+            Column(INT32, r_qty[pidx], pack_bools(qv[pidx])),
+        ))
+        res, have, num_groups = hash_aggregate_table(
+            joined, key_idxs=[0, 1],
+            measures=[(None, "count"), (2, "sum")],
+            max_groups=max_groups, mask=live)
+        overflow = x_overflow | j_overflow | (num_groups > max_groups)
+        return res, have, num_groups[None], overflow[None]
+
+    from jax import shard_map
+    from spark_rapids_jni_tpu.table import INT32 as _I32
+    spec = P(axis_name)
+    # result table: 2 key columns + COUNT + SUM, each (data, validity)
+    out_tree = Table(tuple(Column(_I32, spec, spec) for _ in range(4)))
+    # input columns must CARRY validity arrays (all-valid columns pass
+    # np.ones masks): shard_map specs are structural
+    in_probe = Table(tuple(Column(_I32, spec, spec) for _ in range(3)))
+    in_build = Table(tuple(Column(_I32, P(), P()) for _ in range(2)))
+    return shard_map(step, mesh=mesh,
+                     in_specs=(in_probe, in_build),
+                     out_specs=(out_tree, spec, spec, spec),
+                     check_vma=False)

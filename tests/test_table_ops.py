@@ -1,0 +1,364 @@
+"""Table/GroupedColumns operator layer: Spark null semantics
+cross-checked against a pure-numpy oracle (the reference inherits these
+semantics from Spark above it — SURVEY.md §1 layer map)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import Column, Table, INT32, INT64, FLOAT32
+from spark_rapids_jni_tpu.models.pipeline import (
+    hash_aggregate_table, join_inner_table, join_semi_mask_table,
+)
+
+
+def _oracle_groupby(keys, key_valid, measures, live=None):
+    """dict: composite key tuple (None for null) -> per-measure value."""
+    n = len(keys[0])
+    live = np.ones(n, bool) if live is None else live
+    groups = {}
+    for r in range(n):
+        if not live[r]:
+            continue
+        kt = tuple(None if not kv[r] else int(k[r])
+                   for k, kv in zip(keys, key_valid))
+        g = groups.setdefault(kt, [])
+        g.append(r)
+    out = {}
+    for kt, rows in groups.items():
+        vals = []
+        for vcol, vvalid, op in measures:
+            if op == "count_star":
+                vals.append(len(rows))
+                continue
+            nn = [vcol[r] for r in rows if vvalid[r]]
+            if op == "count":
+                vals.append(len(nn))
+            elif not nn:
+                vals.append(None)          # SUM/MIN/MAX/AVG of empty
+            elif op == "sum":
+                vals.append(sum(int(x) for x in nn))
+            elif op == "min":
+                vals.append(min(nn))
+            elif op == "max":
+                vals.append(max(nn))
+            elif op == "avg":
+                vals.append(float(sum(float(x) for x in nn) / len(nn)))
+        out[kt] = vals
+    return out
+
+
+def test_aggregate_null_semantics_vs_oracle(rng):
+    n = 500
+    keys = rng.integers(0, 12, n).astype(np.int32)
+    kvalid = rng.random(n) > 0.2            # ~20% null keys
+    vals = rng.integers(-100, 100, n).astype(np.int32)
+    vvalid = rng.random(n) > 0.3
+    t = Table((Column.from_numpy(keys, INT32, valid=kvalid),
+               Column.from_numpy(vals, INT32, valid=vvalid)))
+    res, have, num_groups = hash_aggregate_table(
+        t, key_idxs=[0],
+        measures=[(None, "count"), (1, "count"), (1, "sum"),
+                  (1, "min"), (1, "max"), (1, "avg")],
+        max_groups=64)
+    oracle = _oracle_groupby(
+        [keys], [kvalid],
+        [(vals, vvalid, "count_star"), (vals, vvalid, "count"),
+         (vals, vvalid, "sum"), (vals, vvalid, "min"),
+         (vals, vvalid, "max"), (vals, vvalid, "avg")])
+    assert int(np.asarray(num_groups)) == len(oracle)
+    hv = np.asarray(have)
+    gk = res.columns[0].to_pylist()
+    cols = [res.columns[i].to_pylist() for i in range(1, 7)]
+    got = {}
+    for j in np.nonzero(hv)[0]:
+        key = (gk[j],)                       # None for the null group
+        got[key] = [c[j] for c in cols]
+    for kt, exp in oracle.items():
+        g = got[kt]
+        for gi, (gv, ev) in enumerate(zip(g, exp)):
+            if gi == 5 and ev is not None:   # avg: float compare
+                assert gv == pytest.approx(ev), (kt, gi)
+            else:
+                assert gv == ev, (kt, gi, g, exp)
+    assert set(got) == set(oracle)
+
+
+def test_aggregate_multi_key_null_safe_grouping(rng):
+    n = 300
+    k1 = rng.integers(0, 4, n).astype(np.int32)
+    v1 = rng.random(n) > 0.3
+    k2 = rng.integers(0, 3, n).astype(np.int32)
+    v2 = rng.random(n) > 0.3
+    vals = rng.integers(0, 50, n).astype(np.int32)
+    t = Table((Column.from_numpy(k1, INT32, valid=v1),
+               Column.from_numpy(k2, INT32, valid=v2),
+               Column.from_numpy(vals, INT32)))
+    res, have, num_groups = hash_aggregate_table(
+        t, key_idxs=[0, 1], measures=[(2, "sum"), (None, "count")],
+        max_groups=64)
+    ones = np.ones(n, bool)
+    oracle = _oracle_groupby([k1, k2], [v1, v2],
+                             [(vals, ones, "sum"),
+                              (vals, ones, "count_star")])
+    assert int(np.asarray(num_groups)) == len(oracle)
+    hv = np.asarray(have)
+    g1 = res.columns[0].to_pylist()
+    g2 = res.columns[1].to_pylist()
+    sums = res.columns[2].to_pylist()
+    counts = res.columns[3].to_pylist()
+    got = {(g1[j], g2[j]): [sums[j], counts[j]]
+           for j in np.nonzero(hv)[0]}
+    assert got == oracle
+
+
+def test_aggregate_int64_keys_no_x64():
+    """64-bit keys group via their (hi, lo) plane pair expansion."""
+    import jax
+    with jax.enable_x64(False):
+        keys = np.array([2**40, -1, 2**40, -1, 7, 2**40], np.int64)
+        vals = np.array([1, 2, 3, 4, 5, 6], np.int32)
+        t = Table((Column.from_numpy(keys, INT64),
+                   Column.from_numpy(vals, INT32)))
+        res, have, num_groups = hash_aggregate_table(
+            t, key_idxs=[0], measures=[(1, "sum")], max_groups=8)
+        hv = np.asarray(have)
+        gk = res.columns[0].to_pylist()
+        sums = res.columns[1].to_pylist()
+        got = {gk[j]: sums[j] for j in np.nonzero(hv)[0]}
+        assert got == {2**40: 10, -1: 6, 7: 5}
+
+
+def test_aggregate_from_grouped_backing(rng):
+    """A GroupedColumns source aggregates identically to its Table —
+    lazy plane extraction, no per-column materialization step."""
+    from spark_rapids_jni_tpu.ops.row_mxu import table_to_grouped
+    n = 400
+    keys = rng.integers(0, 9, n).astype(np.int32)
+    kvalid = rng.random(n) > 0.15
+    vals = rng.integers(0, 100, n).astype(np.int32)
+    vvalid = rng.random(n) > 0.25
+    t = Table((Column.from_numpy(keys, INT32, valid=kvalid),
+               Column.from_numpy(vals, INT32, valid=vvalid)))
+    gc = table_to_grouped(t)
+    import jax
+    agg = jax.jit(lambda g: hash_aggregate_table(
+        g, key_idxs=[0], measures=[(1, "sum"), (1, "count")],
+        max_groups=32))
+    res_g, have_g, ng_g = agg(gc)
+    res_t, have_t, ng_t = hash_aggregate_table(
+        t, key_idxs=[0], measures=[(1, "sum"), (1, "count")],
+        max_groups=32)
+    assert int(np.asarray(ng_g)) == int(np.asarray(ng_t))
+    for cg, ct in zip(res_g.columns, res_t.columns):
+        assert cg.to_pylist() == ct.to_pylist()
+
+
+def test_join_null_keys_never_match(rng):
+    bkeys = np.array([1, 2, 2, 3, 0], np.int32)
+    bvalid = np.array([1, 1, 0, 1, 0], bool)     # one null dup of key 2
+    bpay = np.array([10, 20, 21, 30, 99], np.int32)
+    pkeys = np.array([2, 3, 0, 5, 2], np.int32)
+    pvalid = np.array([1, 1, 0, 1, 1], bool)     # probe row 2 is null
+    build = Table((Column.from_numpy(bkeys, INT32, valid=bvalid),
+                   Column.from_numpy(bpay, INT32)))
+    probe = Table((Column.from_numpy(pkeys, INT32, valid=pvalid),))
+    pidx, pay, pay_valid, valid, total, overflow = join_inner_table(
+        build, 0, 1, probe, 0, capacity=16)
+    assert not bool(np.asarray(overflow))
+    got = sorted(zip(np.asarray(pidx)[np.asarray(valid)].tolist(),
+                     np.asarray(pay)[np.asarray(valid)].tolist()))
+    # probe 0 (key 2) matches only the NON-null build row 1; probe 4 too
+    assert got == [(0, 20), (1, 30), (4, 20)]
+    sm = np.asarray(join_semi_mask_table(build, 0, probe, 0))
+    assert sm.tolist() == [True, True, False, False, True]
+
+
+def test_join_sentinel_key_with_null_build(rng):
+    """A live probe key equal to int32 max must not false-match the
+    null build rows parked at the sentinel."""
+    big = np.iinfo(np.int32).max
+    build = Table((Column.from_numpy(np.array([1, big], np.int32),
+                                     valid=np.array([1, 0], bool),
+                                     dtype=INT32),
+                   Column.from_numpy(np.array([5, 6], np.int32), INT32)))
+    probe = Table((Column.from_numpy(np.array([big, 1], np.int32),
+                                     INT32),))
+    sm = np.asarray(join_semi_mask_table(build, 0, probe, 0))
+    assert sm.tolist() == [False, True]
+    pidx, pay, pay_valid, valid, total, _ = join_inner_table(
+        build, 0, 1, probe, 0, capacity=8)
+    got = sorted(zip(np.asarray(pidx)[np.asarray(valid)].tolist(),
+                     np.asarray(pay)[np.asarray(valid)].tolist()))
+    assert got == [(1, 5)]
+
+
+def test_distributed_q72_table_step_nulls(rng, cpu_devices):
+    """The Table-level q72 step: validity rides the exchange, null keys
+    never join, null quantities/inventories drop at the filter; totals
+    match a numpy oracle computed from the nullable inputs."""
+    import jax
+    from spark_rapids_jni_tpu.parallel import make_mesh, shard_table
+    from spark_rapids_jni_tpu.models.pipeline import (
+        distributed_q72_table_step)
+    mesh = make_mesh(cpu_devices[:8])
+    n = 8 * 64
+    item = rng.integers(0, 10, n).astype(np.int32)
+    iv = rng.random(n) > 0.15
+    week = rng.integers(0, 3, n).astype(np.int32)
+    wv = rng.random(n) > 0.1
+    qty = rng.integers(1, 6, n).astype(np.int32)
+    qv = rng.random(n) > 0.2
+    bi = rng.integers(0, 12, 40).astype(np.int32)
+    biv = rng.random(40) > 0.1
+    binv = rng.integers(0, 5, 40).astype(np.int32)
+    binvv = rng.random(40) > 0.1
+
+    t = shard_table(Table((
+        Column.from_numpy(item, INT32, valid=iv),
+        Column.from_numpy(week, INT32, valid=wv),
+        Column.from_numpy(qty, INT32, valid=qv))), mesh)
+    build = Table((Column.from_numpy(bi, INT32, valid=biv),
+                   Column.from_numpy(binv, INT32, valid=binvv)))
+    step = jax.jit(distributed_q72_table_step(mesh))
+    res, have, ng, ovf = step(t, build)
+    assert not np.asarray(ovf).any()
+
+    # numpy oracle over the nullable inputs
+    exp = {}
+    for r in range(n):
+        if not (iv[r] and qv[r]):
+            continue
+        for b in range(40):
+            if not (biv[b] and binvv[b]) or bi[b] != item[r]:
+                continue
+            if binv[b] < qty[r]:
+                key = (int(item[r]), int(week[r]) if wv[r] else None)
+                c, s = exp.get(key, (0, 0))
+                exp[key] = (c + 1, s + int(qty[r]))
+    hv = np.asarray(have).reshape(-1)
+    gitem = res.columns[0].to_pylist()
+    gweek = res.columns[1].to_pylist()
+    counts = res.columns[2].to_pylist()
+    sums = res.columns[3].to_pylist()
+    got = {}
+    for j in np.nonzero(hv)[0]:
+        key = (gitem[j], gweek[j])
+        c, s = got.get(key, (0, 0))
+        got[key] = (c + counts[j], s + (sums[j] or 0))
+    assert got == exp
+
+
+def test_grouped_survives_shuffle_roundtrip(rng, cpu_devices):
+    """The plane-major backing crosses a mesh shuffle: per-device lazy
+    extraction feeds the row encode, rows exchange, and the receive side
+    decodes straight back to planes — content preserved."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax import shard_map
+    from spark_rapids_jni_tpu.parallel import make_mesh
+    from spark_rapids_jni_tpu.parallel.shuffle import bucket_exchange
+    from spark_rapids_jni_tpu.ops.row_mxu import (
+        GroupedColumns, table_to_grouped, _planes_and_vmask)
+    from spark_rapids_jni_tpu.ops.row_conversion import (
+        _assemble_fixed_rows, compute_row_layout)
+    from spark_rapids_jni_tpu.ops.hashing import hash_partition_ids
+    mesh = make_mesh(cpu_devices[:8])
+    n = 8 * 64
+    keys = (1 + rng.integers(0, (1 << 20) - 1, n)).astype(np.int32)
+    pay = rng.integers(-50, 50, n).astype(np.int32)
+    pv = rng.random(n) > 0.2
+    t = Table((Column.from_numpy(keys, INT32),
+               Column.from_numpy(pay, INT32, valid=pv)))
+    layout = compute_row_layout(t.dtypes)
+    gc = table_to_grouped(t)
+    # shard the plane-major backing itself: rows on the planes' axis 1
+    pspec = NamedSharding(mesh, P(None, "data"))
+    gc_sh = GroupedColumns(jax.device_put(gc.planes, pspec),
+                           jax.device_put(gc.vmask, pspec), gc.layout)
+
+    import functools
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(None, "data"), P(None, "data")),
+        out_specs=(P(None, "data"), P(None, "data")),
+        check_vma=False)
+    def roundtrip(planes, vmask):
+        local = GroupedColumns(planes, vmask, gc.layout)
+        tbl = local.to_table()           # lazy extraction, fuses in-jit
+        rows2d = _assemble_fixed_rows(tbl, layout)
+        pids = hash_partition_ids([tbl.columns[0]], 8)
+        exchange = bucket_exchange(8, capacity=128, axis_name="data")
+        recv, slot_valid, _, overflow = exchange(rows2d, pids)
+        # dead exchange slots are all-zero rows: their JCUDF validity
+        # bits are zero, so they decode as all-null rows naturally
+        planes2, vmask2 = _planes_and_vmask(recv, layout, "xla")
+        return planes2, vmask2
+
+    planes2, vmask2 = jax.jit(roundtrip)(gc_sh.planes, gc_sh.vmask)
+    out = GroupedColumns(planes2, vmask2, gc.layout).to_table()
+    # every (key, payload) pair survives exactly once; dead slots decode
+    # as all-null rows (key None) and are dropped
+    got = [(k, p) for k, p in zip(out.columns[0].to_pylist(),
+                                  out.columns[1].to_pylist())
+           if k is not None]
+    exp = [(int(k), int(p) if v else None)
+           for k, p, v in zip(keys, pay, pv)]
+    assert sorted(got, key=str) == sorted(exp, key=str)
+
+
+def test_aggregate_narrow_key_packed_path(rng):
+    """int8/int16/bool keys ride the packed single-sort path and agree
+    with the oracle, nulls included."""
+    from spark_rapids_jni_tpu import INT8, INT16, BOOL8
+    n = 400
+    for dt, lo, hi in [(INT8, -128, 128), (INT16, -3000, 3000),
+                       (BOOL8, 0, 2)]:
+        keys = rng.integers(lo, hi, n).astype(dt.np_dtype)
+        kvalid = rng.random(n) > 0.2
+        vals = rng.integers(0, 50, n).astype(np.int32)
+        t = Table((Column.from_numpy(keys, dt, valid=kvalid),
+                   Column.from_numpy(vals, INT32)))
+        res, have, ng = hash_aggregate_table(
+            t, key_idxs=[0], measures=[(1, "sum"), (None, "count")],
+            max_groups=512)
+        ones = np.ones(n, bool)
+        oracle = _oracle_groupby([keys], [kvalid],
+                                 [(vals, ones, "sum"),
+                                  (vals, ones, "count_star")])
+        assert int(np.asarray(ng)) == len(oracle), dt
+        hv = np.asarray(have)
+        gk = res.columns[0].to_pylist()
+        sums = res.columns[1].to_pylist()
+        cnts = res.columns[2].to_pylist()
+        got = {(None if gk[j] is None else int(gk[j]),):
+               [sums[j], cnts[j]] for j in np.nonzero(hv)[0]}
+        ok = {(None if k[0] is None else int(k[0]),): v
+              for k, v in oracle.items()}
+        assert got == ok, dt
+
+
+def test_join_sentinel_interleave_with_duplicates():
+    """Null build rows parked at the sentinel must order strictly AFTER
+    real rows whose key IS dtype max — the gather window may only cover
+    real rows."""
+    big = np.iinfo(np.int32).max
+    build = Table((
+        Column.from_numpy(np.array([big, 7, big], np.int32), INT32,
+                          valid=np.array([1, 0, 1], bool)),
+        Column.from_numpy(np.array([5, 99, 6], np.int32), INT32)))
+    probe = Table((Column.from_numpy(np.array([big], np.int32), INT32),))
+    pidx, pay, pay_valid, valid, total, _ = join_inner_table(
+        build, 0, 1, probe, 0, capacity=8)
+    got = sorted(np.asarray(pay)[np.asarray(valid)].tolist())
+    assert got == [5, 6], got
+
+
+def test_aggregate_empty_source():
+    t = Table((Column.from_numpy(np.zeros(0, np.int32), INT32),
+               Column.from_numpy(np.zeros(0, np.int32), INT32)))
+    res, have, ng = hash_aggregate_table(
+        t, key_idxs=[0], measures=[(1, "sum"), (None, "count")],
+        max_groups=8)
+    assert int(np.asarray(ng)) == 0
+    assert not np.asarray(have).any()
