@@ -779,7 +779,7 @@ def join_semi_mask_table(build, build_key: int, probe,
     # probe matches against the count of real rows (a live probe equal
     # to the sentinel cannot false-match: its hits are range-checked
     # against the non-null prefix)
-    big = jnp.iinfo(bk.dtype).max
+    big = jnp.array(jnp.iinfo(bk.dtype).max, bk.dtype)
     bks = jnp.sort(jnp.where(bv, bk, big))
     n_real = jnp.sum(bv.astype(jnp.int32))
     lo = jnp.searchsorted(bks, pk, side="left")
@@ -841,16 +841,16 @@ def distributed_q72_table_step(mesh, axis_name="data",
     and aggregate with :func:`hash_aggregate_table` semantics — the
     null-aware twin of :func:`distributed_q72_step`.
 
-    Takes (probe_table, build_table) sharded/replicated per
-    ``table_partition_specs``; returns (result_table, have, num_groups,
-    overflow) per device.  Null-key probe rows never join, so no
+    Takes (probe_table, build_table) — probe row-sharded, build
+    replicated; every column must CARRY a validity array (shard_map
+    specs are structural; pass all-ones masks for non-null columns).
+    Returns (result_table, have, num_groups, overflow) per device.  Null-key probe rows never join, so no
     null-key groups cross devices (the host partial merge stays
     key-numeric); null quantities drop at the filter (NULL comparisons
     are not true) and null inventory payloads drop the same way.
     """
     from jax.sharding import PartitionSpec as P
     from spark_rapids_jni_tpu.parallel.shuffle import bucket_exchange
-    from spark_rapids_jni_tpu.parallel.mesh import table_partition_specs
     from spark_rapids_jni_tpu.table import INT32, pack_bools
     num_parts = mesh.shape[axis_name]
 

@@ -730,30 +730,39 @@ def _to_rows_variable_padded(table: Table, layout: RowLayout,
     return out
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
 def _from_rows_padded_jit(data: jnp.ndarray, layout: RowLayout,
-                          str_widths: Tuple[int, ...]):
+                          str_widths: Tuple[int, ...],
+                          mode: str = "xla"):
     row_size = padded_variable_layout(layout, str_widths)[2]
     n = data.shape[0] if data.ndim == 2 \
         else data.shape[0] // row_size
-    return padded_cols_from_rows(data, layout, str_widths, n)
+    return padded_cols_from_rows(data, layout, str_widths, n, mode)
 
 
 def padded_cols_from_rows(data: jnp.ndarray, layout: RowLayout,
-                          str_widths: Tuple[int, ...], n: int):
+                          str_widths: Tuple[int, ...], n: int,
+                          mode: str = "xla"):
     """Decode a flat padded blob of ``n`` rows into (datas, masks,
-    [(chars2d, offsets)]) with static slices only (traceable; used by the
-    public decode and by per-device shuffle decode).
+    [(chars2d, offsets)]) (traceable; used by the public decode and by
+    per-device shuffle decode).
 
-    All byte movement is static 2-D slicing of ``[n, row_size]`` plus
-    strided lane combines — the blob never round-trips through the MXU
-    word converters (measured: that doubled decode traffic with 4x i32
-    temps)."""
+    ``mode`` picks the fixed-section engine: ``"pallas"`` (TPU hot
+    path) runs the fused planes kernel — string slots decode as
+    (offset, length) u32 plane PAIRS and every column extraction is a
+    contiguous plane-row slice; ``"xla"`` keeps the static-slice +
+    strided-lane-combine path (CPU / tiny batches)."""
     slot_starts, fe_pad, row_size = padded_variable_layout(
         layout, str_widths)
     rows2d = data if data.ndim == 2 else data.reshape(n, row_size)
-    f_words = bytes2d_to_words(rows2d[:, :fe_pad])        # [n, fe_pad/4]
-    datas, masks, str_lens = _cols_from_fwords(f_words, layout)
+    if mode != "xla":
+        from spark_rapids_jni_tpu.ops import row_mxu
+        x, vmask = row_mxu.var_fixed_planes(
+            rows2d, layout, interpret=mode == "pallas_interpret")
+        datas, masks, str_lens = _cols_from_planes(x, vmask, layout)
+    else:
+        f_words = bytes2d_to_words(rows2d[:, :fe_pad])    # [n, fe_pad/4]
+        datas, masks, str_lens = _cols_from_fwords(f_words, layout)
     str_parts = []
     for si, (s, w) in enumerate(zip(slot_starts, str_widths)):
         l = str_lens[si]
@@ -772,8 +781,12 @@ def padded_cols_from_rows(data: jnp.ndarray, layout: RowLayout,
 
 def _from_rows_variable_padded(rows: RowsColumn, layout: RowLayout) -> Table:
     from spark_rapids_jni_tpu.table import attach_string_tail
+    from spark_rapids_jni_tpu.ops import row_mxu
+    mode = "pallas" if (_platform_of(rows) == "tpu"
+                        and rows.num_rows >= row_mxu._FUSE_TILE) \
+        else "xla"
     datas, masks, str_parts = _from_rows_padded_jit(
-        rows.data, layout, rows.str_widths)
+        rows.data, layout, rows.str_widths, mode)
     tails = getattr(rows, "_string_tails", None) or {}
     cols = []
     si = 0
@@ -1117,6 +1130,46 @@ def _validity_from_fwords(f_words: jnp.ndarray,
     w0, w1 = vo // 4, (vo + vb + 3) // 4
     vbT = byte_planes_from_word_planes(f_words[:, w0:w1].T, vb, vo % 4)
     return packed_masks_from_byte_planes(vbT, layout.num_columns)
+
+
+def _cols_from_planes(x: jnp.ndarray, vmask: jnp.ndarray,
+                      layout: RowLayout):
+    """Extract every column's data, packed validity mask, and string
+    lengths from decoded word planes [W, n] (the variable-width twin of
+    ``row_mxu._from_rows_mxu_jit``'s extraction; string slots are
+    (offset, length) plane pairs)."""
+    from spark_rapids_jni_tpu.ops import row_mxu
+    from spark_rapids_jni_tpu.table import pair_to_dtype
+    plan = row_mxu._inverse_plan(layout)[0]
+    masks = [vmask[i] for i in range(layout.num_columns)]
+    datas = []
+    str_lens = []
+    for i, dt in enumerate(layout.dtypes):
+        w0 = plan.col_word[i]
+        if dt.is_string:
+            datas.append(None)
+            str_lens.append(jax.lax.bitcast_convert_type(
+                x[w0 + 1], jnp.int32))            # hi plane = length
+            continue
+        sz = layout.col_sizes[i]
+        if sz == 16:
+            datas.append(x[w0:w0 + 4].T)
+        elif sz == 8:
+            datas.append(pair_to_dtype(x[w0:w0 + 2], dt.np_dtype))
+        elif sz == 4:
+            datas.append(jax.lax.bitcast_convert_type(x[w0],
+                                                      dt.np_dtype))
+        else:
+            word = x[w0] >> (8 * plan.col_byte[i])
+            if sz == 2:
+                datas.append(jax.lax.bitcast_convert_type(
+                    (word & 0xFFFF).astype(jnp.uint16), dt.np_dtype))
+            else:
+                d = (word & 0xFF).astype(jnp.uint8)
+                if dt.np_dtype != np.uint8:
+                    d = jax.lax.bitcast_convert_type(d, dt.np_dtype)
+                datas.append(d)
+    return datas, masks, str_lens
 
 
 def _cols_from_fwords(f_words: jnp.ndarray, layout: RowLayout):

@@ -737,31 +737,38 @@ def _from_rows_mxu_jit(rows: jnp.ndarray, layout: RowLayout,
     plan, _ = _inverse_plan(layout)
     x, vmask = _planes_and_vmask(_rows2d(rows, layout), layout, mode)
 
-    # 64-bit columns sit first in the word plan as one contiguous plane
-    # block, and the Column layout IS plane-major ([2, n] lo/hi): each
-    # column is a 2-row slice of the decoded planes, no un-planarize
-    from spark_rapids_jni_tpu.table import pair_to_dtype
+    # every column is one extraction from the decoded planes (the
+    # Column layout is plane-major, so 64-bit pairs are 2-row slices)
     cols = []
     for i, dt in enumerate(layout.dtypes):
-        sz = layout.col_sizes[i]
-        w0 = plan.col_word[i]
-        if sz == 16:  # decimal128: 4 plane rows -> [n, 4] limbs
-            data = x[w0:w0 + 4].T
-        elif sz == 8:
-            data = pair_to_dtype(x[w0:w0 + 2], dt.np_dtype)
-        elif sz == 4:
-            data = jax.lax.bitcast_convert_type(x[w0], dt.np_dtype)
-        else:
-            word = x[w0] >> (8 * plan.col_byte[i])
-            if sz == 2:
-                data = jax.lax.bitcast_convert_type(
-                    (word & 0xFFFF).astype(jnp.uint16), dt.np_dtype)
-            else:
-                data = (word & 0xFF).astype(jnp.uint8)
-                if dt.np_dtype != np.uint8:
-                    data = jax.lax.bitcast_convert_type(data, dt.np_dtype)
+        data = extract_plane_column(x, plan, layout, i)
         cols.append(Column(dt, data, vmask[i]))
     return cols
+
+
+def extract_plane_column(x: jnp.ndarray, plan, layout: RowLayout,
+                         i: int) -> jnp.ndarray:
+    """One column's data from decoded word planes [W, n] (shared by
+    GroupedColumns.column, the fixed decode, and the variable-width
+    plane decode -- the single source of truth for plane extraction)."""
+    from spark_rapids_jni_tpu.table import pair_to_dtype
+    dt = layout.dtypes[i]
+    sz = layout.col_sizes[i]
+    w0 = plan.col_word[i]
+    if sz == 16:  # decimal128: 4 plane rows -> [n, 4] limbs
+        return x[w0:w0 + 4].T
+    if sz == 8:
+        return pair_to_dtype(x[w0:w0 + 2], dt.np_dtype)
+    if sz == 4:
+        return jax.lax.bitcast_convert_type(x[w0], dt.np_dtype)
+    word = x[w0] >> (8 * plan.col_byte[i])
+    if sz == 2:
+        return jax.lax.bitcast_convert_type(
+            (word & 0xFFFF).astype(jnp.uint16), dt.np_dtype)
+    data = (word & 0xFF).astype(jnp.uint8)
+    if dt.np_dtype != np.uint8:
+        data = jax.lax.bitcast_convert_type(data, dt.np_dtype)
+    return data
 
 
 def _rows2d(rows: jnp.ndarray, layout: RowLayout) -> jnp.ndarray:
@@ -806,9 +813,18 @@ def from_rows_fixed(rows: jnp.ndarray, layout: RowLayout,
 # planes once.
 
 @functools.lru_cache(maxsize=64)
-def _inverse_p3k_np(layout: RowLayout) -> np.ndarray:
-    """Inverse permutation rearranged k-major 2-D: [4*W, row_size]."""
+def _inverse_p3k_np(layout: RowLayout, row_size: int = 0) -> np.ndarray:
+    """Inverse permutation rearranged k-major 2-D: [4*W, row_size].
+
+    ``row_size`` > fixed_row_size pads the minor dim with zero columns:
+    the variable-width padded row is a fixed JCUDF layout at a wider
+    stride (string slots = (offset, length) u32 pairs; the char slots
+    past ``fixed_end`` contribute nothing to the planes)."""
     p = _inverse_plan(layout)[1]                 # [rs, W, 4] int8
+    if row_size and row_size > p.shape[0]:
+        p = np.concatenate(
+            [p, np.zeros((row_size - p.shape[0],) + p.shape[1:],
+                         np.int8)], axis=0)
     return np.ascontiguousarray(
         np.transpose(p, (2, 1, 0)).reshape(-1, p.shape[0]))
 
@@ -852,23 +868,26 @@ def _fused_decode_kernel(W, ncols, vw0, vbytes, p3_ref, w8_ref,
     vm_ref[...] = vm.astype(jnp.uint8)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
 def _decode_planes_pallas_jit(rows: jnp.ndarray, layout: RowLayout,
-                              interpret: bool):
+                              interpret: bool, row_size: int = 0):
     """One fused kernel: blob -> ([W, n] u32 word planes,
-    [ncols, ceil(n/8)] packed validity)."""
+    [ncols, ceil(n/8)] packed validity).  ``row_size`` overrides the
+    row stride for padded variable-width rows (see ``_inverse_p3k_np``:
+    char slots decode to nothing; string slots become u32 plane
+    pairs)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     plan = _inverse_plan(layout)[0]
     W = plan.num_words
-    rs = layout.fixed_row_size
-    rows2d = _rows2d(rows, layout)
+    rs = row_size or layout.fixed_row_size
+    rows2d = rows if rows.ndim == 2 else rows.reshape(-1, rs)
     n = rows2d.shape[0]
     ncols = layout.num_columns
     vbytes = layout.validity_bytes
     vw0 = plan.validity_word[0]
     T = _FUSE_TILE
-    p3 = jnp.asarray(_inverse_p3k_np(layout))
+    p3 = jnp.asarray(_inverse_p3k_np(layout, rs))
     w8 = jnp.asarray(_pack_w_np(T))
     nb = (n + 7) // 8
     x, vm = pl.pallas_call(
@@ -1037,32 +1056,25 @@ class GroupedColumns:
         layout = self.layout
         plan = _inverse_plan(layout)[0]
         dt = layout.dtypes[i]
-        sz = layout.col_sizes[i]
-        w0 = plan.col_word[i]
-        x = self.planes
+        data = extract_plane_column(self.planes, plan, layout, i)
         validity = self.vmask[i]
-        if sz == 16:  # decimal128: 4 plane rows -> [n, 4] limbs
-            data = x[w0:w0 + 4].T
-        elif sz == 8:
-            from spark_rapids_jni_tpu.table import pair_to_dtype
-            # the Column layout is plane-major: a 2-row slice IS the data
-            data = pair_to_dtype(x[w0:w0 + 2], dt.np_dtype)
-        elif sz == 4:
-            data = jax.lax.bitcast_convert_type(x[w0], dt.np_dtype)
-        else:
-            word = x[w0] >> (8 * plan.col_byte[i])
-            if sz == 2:
-                data = jax.lax.bitcast_convert_type(
-                    (word & 0xFFFF).astype(jnp.uint16), dt.np_dtype)
-            else:
-                data = (word & 0xFF).astype(jnp.uint8)
-                if dt.np_dtype != np.uint8:
-                    data = jax.lax.bitcast_convert_type(data, dt.np_dtype)
         return Column(dt, data, validity)
 
     def to_table(self) -> Table:
         return Table(tuple(self.column(i)
                            for i in range(self.layout.num_columns)))
+
+
+def var_fixed_planes(rows2d: jnp.ndarray, layout: RowLayout,
+                     interpret: bool = False):
+    """Planes decode of padded VARIABLE-width rows' fixed section: one
+    fused kernel emits the [W, n] word planes (string slots as (offset,
+    length) u32 plane pairs) + [ncols, n/8] packed validity — the
+    grouped-decode treatment applied to string tables (column
+    extraction from plane ROWS is contiguous, where the per-row word
+    matrix forced lane-strided slices)."""
+    return _decode_planes_pallas_jit(rows2d, layout, interpret,
+                                     rows2d.shape[1])
 
 
 def _planes_and_vmask(rows, layout: RowLayout, mode: str):
