@@ -321,12 +321,52 @@ def _calibrate_hbm():
             "pct_hbm": round(100 * moved / t / 1e9 / _HBM_GBPS, 2)}
 
 
+def bench_json_wildcard(num_rows):
+    """1M-row trailing-[*] get_json_object: all-device (three lax.scan
+    automaton passes; no per-row Python anywhere).  Oracle-checks a
+    sample against the host walker first."""
+    from spark_rapids_jni_tpu import Column
+    from spark_rapids_jni_tpu.ops import get_json_object
+    from spark_rapids_jni_tpu.ops.get_json import (
+        _eval_wildcard_host, _parse_path)
+    rng = np.random.default_rng(7)
+    # compact machine-generated docs, mixed element counts
+    kinds = rng.integers(0, 4, num_rows)
+    a = rng.integers(0, 100, num_rows)
+    b = rng.integers(0, 100, num_rows)
+    docs = np.where(
+        kinds == 0, '{"a":[],"k":1}',
+        np.where(kinds == 1, '{"a":[__A__]}',
+                 np.where(kinds == 2, '{"a":[__A__,__B__],"x":2}',
+                          '{"b":[__A__]}'))).astype(object)
+    docs = [d.replace("__A__", str(av)).replace("__B__", str(bv))
+            for d, av, bv in zip(docs, a, b)]
+    _log(f"json {num_rows}: docs built")
+    sample = Column.strings(docs[:2000])
+    got = get_json_object(sample, "$.a[*]").to_pylist()
+    exp = _eval_wildcard_host(sample, _parse_path("$.a[*]")).to_pylist()
+    assert got == exp, "device wildcard diverges from the host oracle"
+    _log(f"json {num_rows}: oracle check OK")
+    col = Column.strings_padded(docs)
+    jax.block_until_ready(col.chars2d)
+    t = _time(lambda: get_json_object(col, "$.a[*]"), iters=12,
+              label=f"json_wildcard[{num_rows}]", sync_each=True)
+    nbytes = col.chars2d.size
+    return {"num_rows": num_rows, "path": "$.a[*]",
+            "wildcard_s": t, "wildcard_Mrows_s": num_rows / t / 1e6,
+            "scanned_GBps": nbytes / t / 1e9}
+
+
 def _run_axis(axis: str):
     """Run one benchmark axis in this process and print its result JSON."""
     if axis == "calibrate":
         print("AXIS_RESULT " + json.dumps(_calibrate_hbm()), flush=True)
         return
     kind, n = axis.split(":")
+    if kind == "json":
+        print("AXIS_RESULT " + json.dumps(bench_json_wildcard(int(n))),
+              flush=True)
+        return
     if kind == "fixed":
         res = bench_fixed(int(n))
     elif kind == "nostrings":
@@ -537,6 +577,9 @@ def main():
             _axis_subprocess("skewed:1000000")]
         _flush()
         results["no_strings_155col"] = [_axis_subprocess("nostrings:1000000")]
+        _flush()
+        # device trailing-[*] JSON path extraction at 1M rows
+        results["json_wildcard"] = [_axis_subprocess("json:1000000")]
         _flush()
 
     head = next((r for r in fixed if "error" not in r), None)

@@ -45,9 +45,9 @@ WILDCARD = object()   # the [*] path segment
 def _parse_path(path: str):
     """``$.a[0].b`` -> [b"a", 0, b"b"]: bytes for object keys, int for
     array subscripts (``$[1].x`` and chained ``[i][j]`` work too), the
-    ``WILDCARD`` sentinel for ``[*]`` (wildcard paths are evaluated on
-    the host — multiple matches per row defeat the single-capture device
-    automaton)."""
+    ``WILDCARD`` sentinel for ``[*]`` (a single TRAILING wildcard runs
+    on device — see ``_eval_wildcard_device``; nested/non-trailing
+    wildcards fan out mid-path and evaluate on the host)."""
     import re
     if not path.startswith("$"):
         raise ValueError(f"JSON path must start with '$': {path!r}")
@@ -101,10 +101,28 @@ def _scan_automaton(ch: jnp.ndarray, segs: Tuple,
         else:
             seg_bytes[i, :len(s)] = np.frombuffer(s, np.uint8)
             seg_lens[i] = len(s)
-    segb = jnp.asarray(seg_bytes)
-    segl = jnp.asarray(seg_lens)
-    segix = jnp.asarray(seg_isidx)
-    segtg = jnp.asarray(seg_tgt)
+    # per-level lookups happen via select-sums over the (tiny, static)
+    # tables, NEVER via [n]-element gathers: dynamic gathers run ~100x
+    # slower than vector selects on TPU and sit inside the scan body
+
+    def _lut(table_np, idx):
+        out = None
+        for l, v in enumerate(table_np):
+            term = jnp.where(idx == l, jnp.int32(int(v)), 0)
+            out = term if out is None else out + term
+        return out
+
+    def _lut_bytes(idx, kpos):
+        out = None
+        for l in range(L):
+            row = None
+            for k in range(max_key_len):
+                term = jnp.where(kpos == k,
+                                 jnp.int32(int(seg_bytes[l, k])), 0)
+                row = term if row is None else row + term
+            term = jnp.where(idx == l, row, 0)
+            out = term if out is None else out + term
+        return out
 
     i32 = jnp.int32
     z = jnp.zeros((n,), i32)
@@ -161,9 +179,9 @@ def _scan_automaton(ch: jnp.ndarray, segs: Tuple,
         # char inside a key (in_str was 1 when we entered this char)
         key_char = (in_key == 1) & (in_str == 1) & ~(eff_q & (esc == 0))
         seg_idx = jnp.clip(c["matched"], 0, L - 1)
-        expect = segb[seg_idx, jnp.clip(key_pos, 0, max_key_len - 1)] \
-            .astype(i32)
-        this_len = segl[seg_idx]
+        expect = _lut_bytes(seg_idx, jnp.clip(key_pos, 0,
+                                               max_key_len - 1))
+        this_len = _lut(seg_lens, seg_idx)
         ok_char = key_char & (key_pos < this_len) & (xs == expect) \
             & (esc == 0)
         key_ok = jnp.where(key_char,
@@ -194,7 +212,7 @@ def _scan_automaton(ch: jnp.ndarray, segs: Tuple,
         # on a LATER step, so exclude the colon step)
 
         # --- element entry at an index-segment frontier array ---
-        fr_is_idx = segix[seg_idx] == 1
+        fr_is_idx = _lut(seg_isidx, seg_idx) == 1
         elem_value_starts = (c["elem_pending"] == 1) & fr_is_idx \
             & outside & ~is_ws & ~is_comma & ~is_close \
             & (depth == c["matched"] + 1) & (c["capturing"] == 0) \
@@ -205,7 +223,7 @@ def _scan_automaton(ch: jnp.ndarray, segs: Tuple,
         is_last = matched == (L - 1)
         # intermediate segment: the value must be the container kind the
         # NEXT segment needs ('{' before a key, '[' before a subscript)
-        next_is_idx = segix[jnp.clip(matched + 1, 0, L - 1)] == 1
+        next_is_idx = _lut(seg_isidx, jnp.clip(matched + 1, 0, L - 1)) == 1
         expected_open = jnp.where(next_is_idx, i32(ord("[")),
                                   i32(ord("{")))
         descend = value_starts & ~is_last & (xs == expected_open) \
@@ -232,7 +250,7 @@ def _scan_automaton(ch: jnp.ndarray, segs: Tuple,
         elem_comma = outside & is_comma & fr_is_idx \
             & (depth == c["matched"] + 1) & (c["capturing"] == 0) \
             & (c["found"] == 0)
-        tgt = segtg[seg_idx]
+        tgt = _lut(seg_tgt, seg_idx)
         elem_count = c["elem_count"] + jnp.where(elem_comma, 1, 0)
         elem_pending = jnp.where(
             elem_comma, (elem_count == tgt).astype(i32),
@@ -242,7 +260,7 @@ def _scan_automaton(ch: jnp.ndarray, segs: Tuple,
         # opening the frontier object or ',' inside it puts us in key
         # position; anything else that is not whitespace leaves it
         new_frontier = matched + 1
-        new_fr_idx = segix[jnp.clip(matched, 0, L - 1)] == 1
+        new_fr_idx = _lut(seg_isidx, jnp.clip(matched, 0, L - 1)) == 1
         opens_frontier = outside & is_open & (xs == ord("{")) \
             & (new_depth == new_frontier) & ~new_fr_idx
         comma_frontier = outside & is_comma & (depth == new_frontier) \
@@ -260,7 +278,7 @@ def _scan_automaton(ch: jnp.ndarray, segs: Tuple,
         arr_open = outside & (xs == ord("[")) & new_fr_idx \
             & (new_depth == matched + 1) & (c["capturing"] == 0) \
             & (c["found"] == 0)
-        new_tgt = segtg[jnp.clip(matched, 0, L - 1)]
+        new_tgt = _lut(seg_tgt, jnp.clip(matched, 0, L - 1))
         elem_count = jnp.where(arr_open, 0, elem_count)
         elem_pending = jnp.where(arr_open, (new_tgt == 0).astype(i32),
                                  elem_pending)
@@ -332,27 +350,34 @@ def get_json_object(col: Column, path: str,
     if not col.dtype.is_string:
         raise ValueError("get_json_object needs a string column")
     segs = tuple(_parse_path(path))
-    if any(s is WILDCARD for s in segs):
-        # [*] can yield several matches per row; the single-capture scan
-        # cannot express that, so wildcard paths evaluate on the host
-        # (Spark semantics: 0 matches -> null, 1 -> the value, many ->
-        # a JSON array of the matches)
+    n_wc = sum(1 for s in segs if s is WILDCARD)
+    if n_wc and not (n_wc == 1 and segs[-1] is WILDCARD):
+        # nested / non-trailing wildcards fan out mid-path; the
+        # single-capture scan cannot express that, so they evaluate on
+        # the host.  (The dominant Spark usage -- ONE trailing [*] over
+        # an array -- runs on device below.)
         if any(isinstance(leaf, jax.core.Tracer)
                for leaf in jax.tree_util.tree_leaves(col)):
             raise ValueError(
-                "wildcard ([*]) JSON paths are host-evaluated: call "
-                "get_json_object eagerly, not under jit")
+                "nested wildcard ([*]) JSON paths are host-evaluated: "
+                "call get_json_object eagerly, not under jit")
         return _eval_wildcard_host(col, segs)
     if col.is_padded:
         from spark_rapids_jni_tpu.table import string_tail
-        lens_np = np.asarray(col.str_lens()) \
-            if not isinstance(col.str_lens(), jax.core.Tracer) else None
+        # max-length check: ONE device scalar reduce cached on the
+        # column (a full np.asarray(str_lens()) pull cost ~150 ms per
+        # call over the tunnel and dominated the whole op)
+        max_len = getattr(col, "_gjo_max_len", None)
+        if max_len is None \
+                and not isinstance(col.str_lens(), jax.core.Tracer):
+            max_len = int(jnp.max(col.str_lens())) if col.num_rows else 0
+            object.__setattr__(col, "_gjo_max_len", max_len)
         # the `capped` flag rides pytree aux, so this refusal also fires
         # under jit, where the host tail cannot exist
         if getattr(col, "capped", False) \
                 or string_tail(col) is not None or (
-                lens_np is not None and lens_np.size
-                and int(lens_np.max()) > col.chars2d.shape[1]):
+                max_len is not None
+                and max_len > col.chars2d.shape[1]):
             # width-capped documents are truncated on device; scanning
             # them would silently null (or mis-parse) rows whose answer
             # lives past the cap — same loud-failure contract as
@@ -364,13 +389,55 @@ def get_json_object(col: Column, path: str,
     elif max_str_len is not None:
         W = (int(max_str_len) + 3) // 4 * 4
     else:
+        if isinstance(col.str_lens(), jax.core.Tracer):
+            raise ValueError(
+                "get_json_object under jit needs a static window: pass "
+                "a dense-padded column or max_str_len=")
         lens = np.asarray(col.str_lens())
         W = ((int(lens.max()) if lens.size else 0) + 3) // 4 * 4
     ch = col.chars_window(W)
-    lens = col.str_lens()
     mkl = max((len(s) for s in segs if isinstance(s, bytes)), default=1)
-    st = _scan_automaton(ch, segs, mkl)
+    if n_wc:  # single trailing [*]: the device wildcard evaluator
+        return _eval_wildcard_device(col, ch, segs, W, mkl, path)
+    vals, out_len, valid, needs_host = _gjo_device_jit(
+        ch, col.validity, segs, W, mkl)
+    result, needs_host = _assemble_result(vals, out_len, valid,
+                                          needs_host)
+    if needs_host is None:  # under an outer jit: punts degraded to null
+        return result
+    # punted rows take the exact host path (one scalar readback gate,
+    # the cast_string punt pattern): string values containing escapes
+    # (must decode), and container values (Spark returns NORMALIZED
+    # json -- re-serialized without insignificant whitespace)
+    if bool(jnp.any(needs_host)):
+        result = _host_fixup(result, col, path, np.asarray(needs_host))
+    return result
 
+
+import functools
+
+
+def _left_justify(mat: jnp.ndarray, start: jnp.ndarray) -> jnp.ndarray:
+    """Shift each row of ``mat [n, W]`` left by its ``start`` (barrel
+    shifter: one static pad/slice per bit of W, selected per row)."""
+    n, W = mat.shape
+    out = mat
+    for b in range((max(W - 1, 1)).bit_length()):
+        sh = 1 << b
+        if sh >= W:
+            break
+        shifted = jnp.concatenate(
+            [out[:, sh:], jnp.zeros((n, sh), mat.dtype)], axis=1)
+        out = jnp.where(((start & sh) > 0)[:, None], shifted, out)
+    return out
+
+
+def _extract_value(ch: jnp.ndarray, st, W: int):
+    """Finish one automaton run: left-justified value window.
+
+    Returns (vals [n, W], out_len, ok, is_strval, first): quote-stripped
+    string contents, trailing-whitespace-trimmed scalars, raw container
+    spans."""
     start, end = st["start"], st["end"]
     # a capture still open at end-of-string means truncated JSON: null
     # (Spark's streaming parser hits EOF and returns null), so only
@@ -385,11 +452,11 @@ def get_json_object(col: Column, path: str,
     vend = jnp.where(is_strval, end - 1, end)
     out_len = jnp.clip(vend - vstart, 0, W)
 
-    # left-justify the value into its own padded matrix (the one
-    # data-dependent addressing step)
-    idx = jnp.clip(vstart[:, None]
-                   + jnp.arange(W, dtype=jnp.int32)[None, :], 0, W - 1)
-    vals = jnp.take_along_axis(ch, idx, axis=1)
+    # left-justify the value into its own padded matrix: a barrel
+    # shifter (log2(W) static pad/slice shifts selected by the start's
+    # bits) — the take_along_axis gather this replaces ran ~100x slower
+    # (measured 220 ms per 20MB window at 1M rows)
+    vals = _left_justify(ch, jnp.clip(vstart, 0, W - 1))
     mask = jnp.arange(W, dtype=jnp.int32)[None, :] < out_len[:, None]
     vals = jnp.where(mask, vals, jnp.uint8(0))
     # scalar tokens: trim trailing whitespace picked up before the
@@ -401,37 +468,43 @@ def get_json_object(col: Column, path: str,
     out_len = jnp.where(is_strval, out_len, last_nonws)
     mask = jnp.arange(W, dtype=jnp.int32)[None, :] < out_len[:, None]
     vals = jnp.where(mask, vals, jnp.uint8(0))
+    return vals, out_len, ok, is_strval, first
 
-    valid = col.valid_bools() & ok
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def _gjo_device_jit(ch, validity, segs, W: int, mkl: int):
+    """The whole non-wildcard device computation in ONE program (the
+    eager path would otherwise dispatch every vector op of the scan
+    individually -- hundreds of tunnel round-trips)."""
+    st = _scan_automaton(ch, segs, mkl)
+    vals, out_len, ok, is_strval, first = _extract_value(ch, st, W)
+    mask = jnp.arange(W, dtype=jnp.int32)[None, :] < out_len[:, None]
+    if validity is not None:
+        from spark_rapids_jni_tpu.table import unpack_bools
+        valid = unpack_bools(validity, ch.shape[0]) & ok
+    else:
+        valid = ok
+    # host-punt classes: string values containing escapes (must
+    # decode), container values (Spark returns NORMALIZED json)
+    has_bs = jnp.any(jnp.where(mask, vals == ord("\\"), False), axis=1) \
+        & is_strval & valid
+    is_container = valid & ((first == ord("{")) | (first == ord("[")))
+    return vals, out_len, valid, has_bs | is_container
+
+
+def _assemble_result(vals, out_len, valid, needs_host):
+    """Build the output Column; under an outer jit, degrade punted rows
+    to null (the host fixup cannot run) and return needs_host=None."""
+    traced = isinstance(needs_host, jax.core.Tracer)
+    if traced:
+        valid = valid & ~needs_host
     lens_out = jnp.where(valid, out_len, 0).astype(jnp.int32)
     offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
                                jnp.cumsum(lens_out).astype(jnp.int32)])
     result = Column(STRING, jnp.zeros((0,), jnp.uint8),
-                    pack_bools(valid), offsets, None, vals)
-
-    # two row classes take the exact host path (one scalar readback gate,
-    # the cast_string punt pattern): string values containing escapes
-    # (must decode), and container values (Spark returns NORMALIZED json —
-    # re-serialized without insignificant whitespace — not the raw slice)
-    has_bs = jnp.any(jnp.where(mask, vals == ord("\\"), False), axis=1) \
-        & is_strval & valid
-    is_container = valid & ((first == ord("{")) | (first == ord("[")))
-    needs_host = has_bs | is_container
-    if isinstance(needs_host, jax.core.Tracer):
-        # under an outer jit the host fixup cannot run: degrade punted
-        # rows to null (never emit raw un-normalized/un-decoded text) —
-        # the cast_string conservative-null precedent
-        valid2 = valid & ~needs_host
-        lens2 = jnp.where(valid2, out_len, 0).astype(jnp.int32)
-        offsets2 = jnp.concatenate(
-            [jnp.zeros((1,), jnp.int32),
-             jnp.cumsum(lens2).astype(jnp.int32)])
-        return Column(STRING, jnp.zeros((0,), jnp.uint8),
-                      pack_bools(valid2), offsets2, None,
-                      jnp.where(valid2[:, None], vals, jnp.uint8(0)))
-    if bool(jnp.any(needs_host)):
-        result = _host_fixup(result, col, path, np.asarray(needs_host))
-    return result
+                    pack_bools(valid), offsets, None,
+                    jnp.where(valid[:, None], vals, jnp.uint8(0)))
+    return result, (None if traced else needs_host)
 
 
 def _at(b: jnp.ndarray, pos: jnp.ndarray) -> jnp.ndarray:
@@ -475,12 +548,17 @@ def _host_fixup(result: Column, src: Column, path: str,
             matches = _walk_path(obj, segs)
             if not matches:
                 raise KeyError(path)
-            obj = matches[0]
-            if isinstance(obj, str):
-                text = obj
+            if len(matches) > 1:
+                # wildcard multi-match: a JSON array of the matches
+                # (strings quoted), Spark's collection rendering
+                text = "[" + ",".join(_render_json(m)
+                                      for m in matches) + "]"
             else:
-                text = json.dumps(obj, separators=(",", ":"),
-                                  ensure_ascii=False)
+                obj = matches[0]
+                if isinstance(obj, (str, _RawNum)):
+                    text = str(obj)
+                else:
+                    text = _render_json(obj)
             patches[r] = text.encode("utf-8")
         except Exception:
             valid[r] = False
@@ -503,10 +581,18 @@ def _host_fixup(result: Column, src: Column, path: str,
                   None, jnp.asarray(mat))
 
 
+class _RawNum(str):
+    """A number token carried as its RAW source text: Spark's Jackson
+    copy preserves '1.50'/'1e2' verbatim, json.loads+dumps would
+    normalize them — the device raw-span path and the host renderer
+    must agree on the source text."""
+
+
 def _spark_decoder() -> json.JSONDecoder:
     """Streaming-compatible decoder: FIRST occurrence wins for duplicate
-    keys, matching the device automaton (shared by the host fixup and
-    the wildcard evaluator)."""
+    keys, and number tokens keep their raw text (see ``_RawNum``),
+    matching the device automaton (shared by the host fixup and the
+    wildcard evaluator)."""
     def _first_wins(pairs):
         d = {}
         for k, v in pairs:
@@ -514,7 +600,30 @@ def _spark_decoder() -> json.JSONDecoder:
                 d[k] = v
         return d
 
-    return json.JSONDecoder(object_pairs_hook=_first_wins)
+    return json.JSONDecoder(object_pairs_hook=_first_wins,
+                            parse_float=_RawNum, parse_int=_RawNum,
+                            parse_constant=_RawNum)
+
+
+def _render_json(obj) -> str:
+    """Spark-compact rendering with raw number tokens preserved."""
+    if isinstance(obj, _RawNum):
+        return str(obj)
+    if isinstance(obj, str):
+        return json.dumps(obj, ensure_ascii=False)
+    if obj is None:
+        return "null"
+    if obj is True:
+        return "true"
+    if obj is False:
+        return "false"
+    if isinstance(obj, list):
+        return "[" + ",".join(_render_json(v) for v in obj) + "]"
+    if isinstance(obj, dict):
+        return "{" + ",".join(
+            json.dumps(k, ensure_ascii=False) + ":" + _render_json(v)
+            for k, v in obj.items()) + "}"
+    return json.dumps(obj, separators=(",", ":"), ensure_ascii=False)
 
 
 def _walk_path(obj, segs):
@@ -568,12 +677,138 @@ def _eval_wildcard_host(col: Column, segs) -> Column:
             out.append(None)
         elif len(matches) == 1:
             m = matches[0]
-            out.append(m if isinstance(m, str)
-                       else json.dumps(m, separators=(",", ":"),
-                                       ensure_ascii=False))
+            out.append(str(m) if isinstance(m, (str, _RawNum))
+                       else _render_json(m))
         else:
-            # several matches render as a JSON array (strings quoted)
-            out.append("[" + ",".join(
-                json.dumps(m, separators=(",", ":"), ensure_ascii=False)
-                for m in matches) + "]")
+            # several matches render as a JSON array (strings quoted,
+            # number tokens raw)
+            out.append("[" + ",".join(_render_json(m)
+                                      for m in matches) + "]")
     return Column.strings_padded(out)
+
+
+# ---------------------------------------------------------------------------
+# Device trailing-[*] wildcard
+# ---------------------------------------------------------------------------
+#
+# Spark's wildcard collects every match: for a single TRAILING [*] the
+# matches are exactly the parent array's elements, so
+#   0 elements -> null
+#   1 element  -> that element, processed like any single-capture value
+#   2+         -> a JSON array of the matches == the parent array's own
+#                 text with insignificant whitespace stripped
+# Two automaton passes (parent array span; parent + [0] for the single-
+# element case) plus one small element-count scan cover all three on
+# device; rows whose array text contains whitespace outside strings or
+# any escape (where raw passthrough != Spark's re-serialization) punt to
+# the exact host path, the same pattern as container normalization.
+
+
+def _elem_scan(vals: jnp.ndarray, out_len: jnp.ndarray):
+    """Over left-justified raw ARRAY spans [n, W]: (element_count,
+    has_ws_outside_strings, has_backslash, has_bad).  Elements =
+    top-level commas + 1, or 0 for empty arrays.  ``has_bad`` flags
+    bytes >= 0x80 outside strings -- the JSON grammar is pure ASCII
+    there, so such rows are malformed (Spark's parser nulls them)."""
+    n, W = vals.shape
+    i32 = jnp.int32
+    z = jnp.zeros((n,), i32)
+    carry0 = dict(in_str=z, esc=z, depth=z, commas=z, has_tok=z,
+                  has_ws=z, has_bs=z, has_bad=z)
+
+    def step(c, x):
+        pos, col = x
+        ch = col.astype(i32)
+        act = (pos < out_len).astype(i32)
+        in_str, esc, depth = c["in_str"], c["esc"], c["depth"]
+        quote = (ch == 34) & (esc == 0)
+        new_in_str = jnp.where(quote, 1 - in_str, in_str)
+        new_esc = ((in_str == 1) & (ch == 92) & (esc == 0)).astype(i32)
+        outside = in_str == 0
+        opens = outside & ((ch == 91) | (ch == 123)) & (esc == 0)
+        closes = outside & ((ch == 93) | (ch == 125)) & (esc == 0)
+        new_depth = depth + jnp.where(opens, 1, 0) \
+            - jnp.where(closes, 1, 0)
+        comma = act * (outside & (ch == 44) & (depth == 1)).astype(i32)
+        is_ws = (ch == 32) | (ch == 9) | (ch == 10) | (ch == 13)
+        # content between the outer brackets: any non-ws char past
+        # position 0 still at depth >= 1 after the update (the closing
+        # outer bracket drops to 0 and is excluded)
+        tok = act * ((pos > 0) & ~is_ws & (new_depth >= 1)).astype(i32)
+        ws = act * (outside & is_ws).astype(i32)
+        bs = act * (ch == 92).astype(i32)
+        bad = act * (outside & (ch >= 128)).astype(i32)
+        return dict(in_str=new_in_str, esc=new_esc, depth=new_depth,
+                    commas=c["commas"] + comma,
+                    has_tok=c["has_tok"] | tok,
+                    has_ws=c["has_ws"] | ws,
+                    has_bs=c["has_bs"] | bs,
+                    has_bad=c["has_bad"] | bad), None
+
+    pos = jnp.arange(W, dtype=i32)
+    final, _ = jax.lax.scan(step, carry0, (pos, vals.T))
+    count = jnp.where(final["has_tok"] == 1, final["commas"] + 1, 0)
+    return (count, final["has_ws"] == 1, final["has_bs"] == 1,
+            final["has_bad"] == 1)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5))
+def _wildcard_device_jit(ch, validity, lens, segs, W: int, mkl: int):
+    """The whole trailing-[*] device computation in ONE program (three
+    lax.scan automaton passes; eager would dispatch each vector op)."""
+    parent = tuple(segs[:-1])
+    n = ch.shape[0]
+    z = jnp.zeros((n,), jnp.int32)
+    if parent:
+        st_arr = _scan_automaton(ch, parent, mkl)
+    else:
+        # path "$[*]": the whole document is the array; synthesize a
+        # full-span capture starting at the first non-whitespace byte
+        pos = jnp.arange(W, dtype=jnp.int32)[None, :]
+        is_ws = (ch == 32) | (ch == 9) | (ch == 10) | (ch == 13)
+        first_tok = jnp.min(jnp.where(is_ws, W, pos), axis=1)
+        st_arr = dict(start=jnp.minimum(first_tok,
+                                        lens.astype(jnp.int32)),
+                      end=lens.astype(jnp.int32),
+                      found=z + 1, capturing=z, bad=z)
+    vals_a, len_a, ok_a, _, first_a = _extract_value(ch, st_arr, W)
+    count, has_ws, has_bs, has_bad = _elem_scan(vals_a, len_a)
+    arr_ok = ok_a & (first_a == ord("[")) & ~has_bad
+
+    st0 = _scan_automaton(ch, parent + (0,), mkl)
+    vals_0, len_0, ok_0, is_str_0, first_0 = _extract_value(ch, st0, W)
+
+    single = arr_ok & (count == 1) & ok_0
+    multi = arr_ok & (count >= 2)
+    vals = jnp.where(single[:, None], vals_0, vals_a)
+    out_len = jnp.where(single, len_0, len_a)
+    if validity is not None:
+        from spark_rapids_jni_tpu.table import unpack_bools
+        in_valid = unpack_bools(validity, n)
+    else:
+        in_valid = jnp.ones((n,), jnp.bool_)
+    valid = in_valid & (single | multi)
+
+    # host punts: single-element strings with escapes / container
+    # elements (normalization), and multi-rows whose raw array text is
+    # not already Spark-normalized (whitespace or escape sequences)
+    mask0 = jnp.arange(W, dtype=jnp.int32)[None, :] < len_0[:, None]
+    e0_bs = jnp.any(jnp.where(mask0, vals_0 == ord("\\"), False),
+                    axis=1)
+    e0_container = (first_0 == ord("{")) | (first_0 == ord("["))
+    needs_host = valid & ((single & ((is_str_0 & e0_bs) | e0_container))
+                          | (multi & (has_ws | has_bs)))
+    return vals, out_len, valid, needs_host
+
+
+def _eval_wildcard_device(col: Column, ch: jnp.ndarray, segs, W: int,
+                          mkl: int, path: str) -> Column:
+    vals, out_len, valid, needs_host = _wildcard_device_jit(
+        ch, col.validity, col.str_lens(), segs, W, mkl)
+    result, needs_host = _assemble_result(vals, out_len, valid,
+                                          needs_host)
+    if needs_host is None:  # under an outer jit: punts degraded to null
+        return result
+    if bool(jnp.any(needs_host)):
+        result = _host_fixup(result, col, path, np.asarray(needs_host))
+    return result

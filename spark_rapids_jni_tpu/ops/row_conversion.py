@@ -758,7 +758,7 @@ def padded_cols_from_rows(data: jnp.ndarray, layout: RowLayout,
     if mode != "xla":
         from spark_rapids_jni_tpu.ops import row_mxu
         x, vmask = row_mxu.var_fixed_planes(
-            rows2d, layout, interpret=mode == "pallas_interpret")
+            rows2d, layout, fe_pad, interpret=mode == "pallas_interpret")
         datas, masks, str_lens = _cols_from_planes(x, vmask, layout)
     else:
         f_words = bytes2d_to_words(rows2d[:, :fe_pad])    # [n, fe_pad/4]
@@ -1139,36 +1139,17 @@ def _cols_from_planes(x: jnp.ndarray, vmask: jnp.ndarray,
     ``row_mxu._from_rows_mxu_jit``'s extraction; string slots are
     (offset, length) plane pairs)."""
     from spark_rapids_jni_tpu.ops import row_mxu
-    from spark_rapids_jni_tpu.table import pair_to_dtype
     plan = row_mxu._inverse_plan(layout)[0]
     masks = [vmask[i] for i in range(layout.num_columns)]
     datas = []
     str_lens = []
     for i, dt in enumerate(layout.dtypes):
-        w0 = plan.col_word[i]
         if dt.is_string:
             datas.append(None)
             str_lens.append(jax.lax.bitcast_convert_type(
-                x[w0 + 1], jnp.int32))            # hi plane = length
+                x[plan.col_word[i] + 1], jnp.int32))  # hi plane = length
             continue
-        sz = layout.col_sizes[i]
-        if sz == 16:
-            datas.append(x[w0:w0 + 4].T)
-        elif sz == 8:
-            datas.append(pair_to_dtype(x[w0:w0 + 2], dt.np_dtype))
-        elif sz == 4:
-            datas.append(jax.lax.bitcast_convert_type(x[w0],
-                                                      dt.np_dtype))
-        else:
-            word = x[w0] >> (8 * plan.col_byte[i])
-            if sz == 2:
-                datas.append(jax.lax.bitcast_convert_type(
-                    (word & 0xFFFF).astype(jnp.uint16), dt.np_dtype))
-            else:
-                d = (word & 0xFF).astype(jnp.uint8)
-                if dt.np_dtype != np.uint8:
-                    d = jax.lax.bitcast_convert_type(d, dt.np_dtype)
-                datas.append(d)
+        datas.append(row_mxu.extract_plane_column(x, plan, layout, i))
     return datas, masks, str_lens
 
 

@@ -825,6 +825,11 @@ def _inverse_p3k_np(layout: RowLayout, row_size: int = 0) -> np.ndarray:
         p = np.concatenate(
             [p, np.zeros((row_size - p.shape[0],) + p.shape[1:],
                          np.int8)], axis=0)
+    elif row_size and row_size < p.shape[0]:
+        # trailing rows past row_size carry no entries (only data +
+        # validity positions below fixed_end do); truncating is safe
+        assert not p[row_size:].any()
+        p = p[:row_size]
     return np.ascontiguousarray(
         np.transpose(p, (2, 1, 0)).reshape(-1, p.shape[0]))
 
@@ -1066,15 +1071,20 @@ class GroupedColumns:
 
 
 def var_fixed_planes(rows2d: jnp.ndarray, layout: RowLayout,
-                     interpret: bool = False):
+                     fe_pad: int, interpret: bool = False):
     """Planes decode of padded VARIABLE-width rows' fixed section: one
     fused kernel emits the [W, n] word planes (string slots as (offset,
     length) u32 plane pairs) + [ncols, n/8] packed validity — the
     grouped-decode treatment applied to string tables (column
     extraction from plane ROWS is contiguous, where the per-row word
-    matrix forced lane-strided slices)."""
-    return _decode_planes_pallas_jit(rows2d, layout, interpret,
-                                     rows2d.shape[1])
+    matrix forced lane-strided slices).
+
+    Only the fixed section feeds the kernel (``rows2d[:, :fe_pad]``,
+    sliced under the caller's jit): contracting the char slots too
+    would scale MXU work and the permutation matrix's VMEM footprint
+    with the declared string widths for zero contribution."""
+    return _decode_planes_pallas_jit(rows2d[:, :fe_pad], layout,
+                                     interpret, fe_pad)
 
 
 def _planes_and_vmask(rows, layout: RowLayout, mode: str):

@@ -368,3 +368,53 @@ def test_wildcard_non_ascii_and_bad_utf8():
                  jnp.asarray(offsets), jnp.asarray(chars))
     got = get_json_object(raw, "$.a[*]").to_pylist()
     assert got[0] == "[1,2]" and got[1] is None
+
+
+def test_trailing_wildcard_device_matches_host_oracle(rng):
+    """The device trailing-[*] evaluator agrees with the host walker on
+    randomized documents: empty/single/multi arrays, strings, nested
+    containers, missing paths, malformed rows."""
+    from spark_rapids_jni_tpu.ops.get_json import _eval_wildcard_host, _parse_path
+    docs = []
+    for r in range(300):
+        kind = r % 10
+        if kind == 0:
+            docs.append('{"a":[]}')
+        elif kind == 1:
+            docs.append('{"a":[%d]}' % rng.integers(0, 100))
+        elif kind == 2:
+            docs.append('{"a":[%d,%d,%d]}' % tuple(rng.integers(0, 100, 3)))
+        elif kind == 3:
+            docs.append('{"a":["x","yy"],"b":1}')
+        elif kind == 4:
+            docs.append('{"a":[{"k":1},{"k":2}]}')   # container elements
+        elif kind == 5:
+            docs.append('{"b":[1,2]}')               # missing path
+        elif kind == 6:
+            docs.append('{"a": [ 1 , 2 ] }')         # whitespace (host punt)
+        elif kind == 7:
+            docs.append('{"a":["es\\\\"c",2]}')      # escapes (host punt)
+        elif kind == 8:
+            docs.append('{"a":7}')                   # not an array
+        else:
+            docs.append(None)
+    col = Column.strings(docs)
+    got = get_json_object(col, "$.a[*]").to_pylist()
+    exp = _eval_wildcard_host(col, _parse_path("$.a[*]")).to_pylist()
+    assert got == exp
+
+
+def test_trailing_wildcard_whole_doc_array():
+    col = Column.strings(['[1,2,3]', '[5]', '[]', '{"a":1}'])
+    assert get_json_object(col, "$[*]").to_pylist() == \
+        ["[1,2,3]", "5", None, None]
+
+
+def test_trailing_wildcard_under_jit_degrades_punts_to_null():
+    """Traced: clean rows answer on device; rows needing the host
+    (whitespace / escapes / container elements) degrade to null."""
+    import jax
+    col = Column.strings_padded(
+        ['{"a":[1,2]}', '{"a": [ 1 , 2 ]}', '{"a":[9]}'])
+    out = jax.jit(lambda c: get_json_object(c, "$.a[*]"))(col)
+    assert out.to_pylist() == ["[1,2]", None, "9"]
