@@ -1,0 +1,70 @@
+#!/usr/bin/env bash
+# Dependency-advance bot (the reference keeps its vendored cudf current
+# with ci/submodule-sync.sh + an auto-merge PR flow; this framework's
+# moving dependency is the jax/jaxlib/numpy pin).
+#
+# Finds the latest released jax/jaxlib/numpy, rewrites the premerge pin,
+# runs the premerge suite against the new pins, and (in CI) pushes a bot
+# branch that .github/workflows/bump-deps.yml turns into an auto-merge
+# PR on green.  Run locally with DRY_RUN=1 to only print the plan.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PIN_FILE=.github/workflows/premerge.yml
+BOT_BRANCH=bot-bump-deps
+
+latest() {  # latest non-prerelease version of a package on PyPI
+  python - "$1" "$2" <<'PY'
+import json, re, sys, urllib.request
+pkg, fallback = sys.argv[1], sys.argv[2]
+try:
+    with urllib.request.urlopen(
+            f"https://pypi.org/pypi/{pkg}/json", timeout=20) as r:
+        data = json.load(r)
+    vers = [v for v in data["releases"]
+            if re.fullmatch(r"\d+(\.\d+)*", v) and data["releases"][v]]
+    vers.sort(key=lambda v: tuple(int(x) for x in v.split(".")))
+    print(vers[-1])
+except OSError as e:
+    # offline (e.g. a zero-egress dev sandbox): keep the current pin
+    print(f"[bump-deps] {pkg}: PyPI unreachable ({e}); keeping pin",
+          file=sys.stderr)
+    print(fallback)
+PY
+}
+
+current() { grep -oP "$1==\K[0-9.]+" "$PIN_FILE" | head -1; }
+
+JAX_OLD=$(current jax); JAXLIB_OLD=$(current jaxlib); NUMPY_OLD=$(current numpy)
+JAX_NEW=$(latest jax "$JAX_OLD")
+JAXLIB_NEW=$(latest jaxlib "$JAXLIB_OLD")
+NUMPY_NEW=$(latest numpy "$NUMPY_OLD")
+
+echo "jax:    $JAX_OLD -> $JAX_NEW"
+echo "jaxlib: $JAXLIB_OLD -> $JAXLIB_NEW"
+echo "numpy:  $NUMPY_OLD -> $NUMPY_NEW"
+
+if [ "$JAX_NEW" = "$JAX_OLD" ] && [ "$JAXLIB_NEW" = "$JAXLIB_OLD" ] \
+    && [ "$NUMPY_NEW" = "$NUMPY_OLD" ]; then
+  echo "pins already current; nothing to do"
+  exit 0
+fi
+if [ "${DRY_RUN:-0}" = "1" ]; then
+  echo "[dry-run] would rewrite $PIN_FILE, run ci/premerge.sh, and"
+  echo "[dry-run] force-push branch $BOT_BRANCH for the auto-merge PR"
+  exit 0
+fi
+
+sed -i -E "s/jax==[0-9.]+/jax==$JAX_NEW/; s/jaxlib==[0-9.]+/jaxlib==$JAXLIB_NEW/; s/numpy==[0-9.]+/numpy==$NUMPY_NEW/" "$PIN_FILE"
+
+# test-build against the new pins before proposing anything (the
+# reference test-builds the advanced submodule the same way)
+pip install "jax==$JAX_NEW" "jaxlib==$JAXLIB_NEW" "numpy==$NUMPY_NEW"
+bash ci/premerge.sh
+
+git config user.name "deps-bump-bot"
+git config user.email "bot@invalid"
+git checkout -B "$BOT_BRANCH"
+git add "$PIN_FILE"
+git commit -m "Bump pins: jax $JAX_OLD->$JAX_NEW jaxlib $JAXLIB_OLD->$JAXLIB_NEW numpy $NUMPY_OLD->$NUMPY_NEW"
+git push -f origin "$BOT_BRANCH"

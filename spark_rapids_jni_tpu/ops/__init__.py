@@ -9,6 +9,12 @@ from spark_rapids_jni_tpu.ops.cast_string import (  # noqa: F401
     cast_string_to_timestamp,
     cast_timestamp_to_string,
 )
+from spark_rapids_jni_tpu.ops.float_string import (  # noqa: F401
+    cast_float_to_string,
+)
+from spark_rapids_jni_tpu.ops.double_string import (  # noqa: F401
+    cast_double_to_string,
+)
 from spark_rapids_jni_tpu.ops.row_conversion import (  # noqa: F401
     RowsColumn,
     convert_to_rows,
@@ -28,7 +34,8 @@ from spark_rapids_jni_tpu.ops.zorder import (  # noqa: F401
     interleave_bits, zorder_sort_indices,
 )
 from spark_rapids_jni_tpu.ops.decimal import (  # noqa: F401
-    add_decimal128, decimal128, decimal128_from_ints, decimal128_to_ints,
+    add_decimal128, cast_decimal128_to_string, decimal128,
+    decimal128_from_ints, decimal128_to_ints,
     decimal128_to_strings, div_decimal128, mul_decimal128,
     rescale_decimal128, sub_decimal128,
 )

@@ -18,6 +18,7 @@ columns, see ``Column.from_numpy``).
 
 from __future__ import annotations
 
+import functools
 from typing import List, Sequence, Tuple
 
 import jax
@@ -25,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_jni_tpu.table import Column, DType, pack_bools
+from spark_rapids_jni_tpu.utils.tracing import func_range
 
 MAX_PRECISION = 38
 # 10^38 - 1, the +/- bound of DECIMAL(38) magnitudes, as 4 LE uint32 limbs
@@ -397,3 +399,113 @@ def mul_decimal128(a: Column, b: Column):
     valid = a.valid_bools() & b.valid_bools() & ~overflow
     return (Column(decimal128(scale), signed, pack_bools(valid)),
             overflow & a.valid_bools() & b.valid_bools())
+
+
+# ---------------------------------------------------------------------------
+# decimal128 -> string (device kernel)
+# ---------------------------------------------------------------------------
+
+_DEC_MAX_DIGITS = 39        # 10^38 - 1 has 38 digits; +1 headroom
+
+
+@jax.jit
+def _dec128_digits_jit(data: jnp.ndarray):
+    """[n, 4] uint32 limb columns -> (digit matrix [n, 39] MSB-first,
+    ndigits, negative) via vectorized schoolbook divmod-10 over 8x16-bit
+    limbs (the 128-bit widening of ``cast_string._int_to_string_jit``'s
+    4-limb extraction)."""
+    mag, neg = _abs_limbs(data)
+    limbs = []
+    for k in range(4):
+        limbs.append(mag[:, k] & 0xFFFF)
+        limbs.append(mag[:, k] >> 16)
+    digs = []
+    for _ in range(_DEC_MAX_DIGITS):
+        rem = jnp.zeros_like(limbs[0])
+        new = []
+        for k in range(7, -1, -1):
+            cur = (rem << 16) | limbs[k]
+            q = cur // 10
+            rem = cur - q * 10
+            new.append(q)
+        limbs = new[::-1]
+        digs.append(rem)
+    digits = jnp.stack(digs[::-1], axis=1)         # [n, 39] MSB first
+    nz = digits != 0
+    first_nz = jnp.argmax(nz, axis=1).astype(jnp.int32)
+    any_nz = jnp.any(nz, axis=1)
+    ndig = jnp.where(any_nz, _DEC_MAX_DIGITS - first_nz, 1)
+    return digits, ndig.astype(jnp.int32), neg
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _dec128_format_jit(digits, ndig, neg, scale: int,
+                       trail_zeros: int = 0):
+    """Fixed-point rendering at ``scale`` (Spark ``Decimal.toString``
+    for non-negative scales: exactly ``scale`` fraction digits, at
+    least one integer digit).  ``trail_zeros`` appends zeros for
+    negative scales (value = unscaled * 10^k rendered EXACTLY as
+    digits + k zeros — a 128-bit multiply would wrap for legitimate
+    wide values).  Returns (char matrix, lengths)."""
+    i32 = jnp.int32
+    n = digits.shape[0]
+    MD = _DEC_MAX_DIGITS
+    is_zero = (ndig == 1) & (digits[:, MD - 1] == 0)
+    tz = jnp.where(is_zero, 0, trail_zeros)        # 0 * 10^k == "0"
+    ndig = ndig + tz
+    # logical digit count incl. zero-padding to scale + 1
+    eff = jnp.maximum(ndig, scale + 1)
+    int_len = eff - scale
+    W = MD + 3 + trail_zeros                       # sign + dot + zeros
+    base = neg.astype(i32)
+    pos = jnp.arange(W, dtype=i32)[None, :]
+    idx = pos - base[:, None]
+    in_int = (idx >= 0) & (idx < int_len[:, None])
+    dot_at = (idx == int_len[:, None]) & (scale > 0)
+    fidx = idx - int_len[:, None] - 1
+    in_frac = (fidx >= 0) & (fidx < scale) & (scale > 0)
+    # logical digit position p in [0, eff): matrix column MD - eff + p
+    p_int = idx
+    p_frac = int_len[:, None] + fidx
+    p = jnp.where(in_int, p_int, p_frac)
+    k = MD - eff[:, None] + p + tz[:, None]
+    dig = jnp.zeros((n, W), jnp.uint8)
+    for m in range(MD):
+        dig = dig | jnp.where(k == m,
+                              digits[:, m].astype(jnp.uint8)[:, None],
+                              jnp.uint8(0))
+    dig = dig + jnp.uint8(ord("0"))
+    mat = jnp.where(in_int | in_frac, dig,
+                    jnp.where(dot_at, jnp.uint8(ord(".")),
+                              jnp.uint8(0)))
+    mat = jnp.where((pos == 0) & neg[:, None], jnp.uint8(ord("-")), mat)
+    length = base + int_len + (1 + scale if scale > 0 else 0)
+    mat = jnp.where(pos < length[:, None], mat, jnp.uint8(0))
+    return mat, length
+
+
+@func_range()
+def cast_decimal128_to_string(col: Column) -> Column:
+    """CAST(decimal128 AS STRING) on device: Spark ``Decimal.toString``
+    fixed-point rendering at the column's scale (``1.20`` keeps its
+    trailing zero; at least one integer digit).  Negative scales
+    multiply out on device too (rare in Spark plans)."""
+    from spark_rapids_jni_tpu.table import STRING, pack_bools
+    if col.dtype.kind != "decimal128":
+        raise ValueError("cast_decimal128_to_string needs decimal128")
+    scale = col.dtype.scale
+    data = col.data
+    # negative scales render as digits + |scale| trailing zeros (a
+    # 128-bit multiply-out would silently wrap for legitimate values
+    # like 10^37 at scale -3)
+    trail = -scale if scale < 0 else 0
+    digits, ndig, neg = _dec128_digits_jit(data)
+    mat, lens = _dec128_format_jit(digits, ndig, neg, max(scale, 0),
+                                   trail)
+    valid = col.valid_bools()
+    lens = jnp.where(valid, lens, 0).astype(jnp.int32)
+    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(lens).astype(jnp.int32)])
+    return Column(STRING, jnp.zeros((0,), jnp.uint8), col.validity,
+                  offsets, None,
+                  jnp.where(valid[:, None], mat, jnp.uint8(0)))

@@ -1,0 +1,487 @@
+"""CAST(double AS STRING): Java notation over Ryu shortest digits — the
+float64 sibling of ``float_string`` (see that module's docstring).
+
+The d2s core needs 128-bit power-of-5 approximations.  The full tables
+(292 + 326 entries x 128 bits) are too large for select-sum lookups, so
+the kernel uses Ryu's two-level decomposition (``d2s_small_table.h``
+idea): ``5^i = 5^(26b) * 5^o`` with ~13-entry 128-bit base tables and a
+26-entry 64-bit offset table, plus per-``i`` corrections.  Unlike the C
+code's hardcoded offsets, the corrections are COMPUTED EXACTLY at
+import (unbounded python ints compare the two-level product against the
+exact table value); they are tiny (pow5: 0..2, inv: -1..1).
+
+All 128-bit device arithmetic rides uint32 limbs (no-x64-safe); the
+bounded digit loops unroll (<= 17 iterations)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.table import Column, STRING
+from spark_rapids_jni_tpu.ops.float_string import (
+    _mulu32v, _apply_specials, _java_notation)
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+
+_D_MANTISSA_BITS = 52
+_D_BIAS = 1023
+_D_INV_BC = 125
+_D_BC = 125
+_STEP = 26
+_MAX_POW5 = 326
+_MAX_INV = 292
+
+
+def _pow5bits_py(e: int) -> int:
+    return ((e * 1217359) >> 19) + 1
+
+
+def _exact_pow5(i: int) -> int:
+    b = _pow5bits_py(i) - _D_BC
+    return (5 ** i >> b) if b >= 0 else (5 ** i << -b)
+
+
+def _exact_inv_pow5(q: int) -> int:
+    return ((1 << (_D_INV_BC + _pow5bits_py(q) - 1)) // 5 ** q) + 1
+
+
+_POW5_BASE = tuple(_exact_pow5(b * _STEP)
+                   for b in range(_MAX_POW5 // _STEP + 2))
+_INV_BASE = tuple(_exact_inv_pow5(b * _STEP)
+                  for b in range(_MAX_INV // _STEP + 2))
+_POW5_OFF = tuple(5 ** o for o in range(_STEP))
+
+
+def _corr_pow5(i: int) -> int:
+    b, o = divmod(i, _STEP)
+    if o == 0:
+        return 0
+    delta = _pow5bits_py(i) - _pow5bits_py(b * _STEP)
+    return _exact_pow5(i) - ((_POW5_OFF[o] * _POW5_BASE[b]) >> delta)
+
+
+def _corr_inv(q: int) -> int:
+    # inv(q) ~= (inv((b+1)*26) * 5^(26-o)) >> delta, one MULTIPLY (a
+    # division route could not keep exactness cheaply)
+    b, o = divmod(q, _STEP)
+    if o == 0:
+        return 0
+    delta = _pow5bits_py((b + 1) * _STEP) - _pow5bits_py(q)
+    approx = (_INV_BASE[b + 1] * _POW5_OFF[_STEP - o]) >> delta
+    return _exact_inv_pow5(q) - approx
+
+
+_POW5_CORR = tuple(_corr_pow5(i) for i in range(_MAX_POW5))
+_INV_CORR = tuple(_corr_inv(q) for q in range(_MAX_INV))
+assert all(0 <= c <= 2 for c in _POW5_CORR)
+assert all(-1 <= c <= 1 for c in _INV_CORR)
+
+
+# ---------------------------------------------------------------------------
+# uint32-limb arithmetic (LE limb order)
+# ---------------------------------------------------------------------------
+
+def _add_limbs(a, b):
+    """Elementwise limb-vector add (equal lengths), no final carry out."""
+    out = []
+    carry = None
+    for x, y in zip(a, b):
+        s = x + y
+        if carry is not None:
+            s2 = s + carry
+            carry = ((s < x) | (s2 < s)).astype(jnp.uint32)
+            s = s2
+        else:
+            carry = (s < x).astype(jnp.uint32)
+        out.append(s)
+    return out
+
+
+def _mul_limbs(a, b):
+    """[len(a)+len(b)]-limb product of limb vectors (schoolbook with
+    deferred carries, folded in one ascending pass)."""
+    n_out = len(a) + len(b)
+    z = jnp.zeros_like(a[0])
+    acc = [z for _ in range(n_out)]
+    defer = [z for _ in range(n_out)]
+    for i, x in enumerate(a):
+        for j, y in enumerate(b):
+            hi, lo = _mulu32v(x, y)
+            k = i + j
+            s = acc[k] + lo
+            c = (s < lo).astype(jnp.uint32)
+            acc[k] = s
+            s2 = acc[k + 1] + hi
+            c2 = (s2 < hi).astype(jnp.uint32)
+            s3 = s2 + c
+            c3 = (s3 < s2).astype(jnp.uint32)
+            acc[k + 1] = s3
+            if k + 2 < n_out:
+                defer[k + 2] = defer[k + 2] + c2 + c3
+    carry = z
+    for k in range(n_out):
+        add = defer[k] + carry
+        s = acc[k] + add
+        carry = (s < acc[k]).astype(jnp.uint32) \
+            + (add < defer[k]).astype(jnp.uint32)
+        acc[k] = s
+    return acc
+
+
+def _shr_limbs(limbs, s, out_limbs: int):
+    """Limb vector >> s (per-row s in [0, 32*len)), keep out_limbs."""
+    nl = len(limbs)
+    word = (s // 32).astype(jnp.uint32)
+    bit = (s % 32).astype(jnp.uint32)
+    z = jnp.zeros_like(limbs[0])
+    out = []
+    for k in range(out_limbs):
+        lo_sel = z
+        hi_sel = z
+        for w in range(nl):
+            if w >= k:
+                lo_sel = lo_sel | jnp.where(word == (w - k), limbs[w],
+                                            jnp.uint32(0))
+            if w >= k + 1:
+                hi_sel = hi_sel | jnp.where(word == (w - k - 1),
+                                            limbs[w], jnp.uint32(0))
+        r = jnp.where(bit == 0, lo_sel,
+                      (lo_sel >> bit) | (hi_sel << ((32 - bit) & 31)))
+        out.append(r)
+    return out
+
+
+def _lut_u32s(table_words, idx):
+    """Select-OR lookup: list of python ints -> per-row uint32."""
+    out = jnp.zeros_like(idx).astype(jnp.uint32)
+    for j, v in enumerate(table_words):
+        out = out | jnp.where(idx == j, jnp.uint32(v), jnp.uint32(0))
+    return out
+
+
+def _lut_limbs(table, idx, nlimbs: int):
+    """Select-OR lookup of big-int table entries as nlimbs u32 limbs."""
+    return [_lut_u32s([(v >> (32 * k)) & 0xFFFFFFFF for v in table],
+                      idx) for k in range(nlimbs)]
+
+
+def _div10_pair(hi, lo):
+    """(hi, lo) u64 divmod 10 -> (qhi, qlo, rem)."""
+    qh = hi // 10
+    r = hi % 10
+    lo10 = lo // 10
+    lor = lo % 10
+    t = r * 6 + lor            # r*2^32 + lo = 10*(r*429496729 + lo10) + t
+    qlo = r * 429496729 + lo10 + t // 10
+    return qh, qlo, t % 10
+
+
+def _div5_pair(hi, lo):
+    qh = hi // 5
+    r = hi % 5
+    lo5 = lo // 5
+    lor = lo % 5
+    t = r * 1 + lor            # 2^32 = 5*858993459 + 1
+    qlo = r * 858993459 + lo5 + t // 5
+    return qh, qlo, t % 5
+
+
+def _pair_cmp_gt(ah, al, bh, bl):
+    return (ah > bh) | ((ah == bh) & (al > bl))
+
+
+def _pair_eq(ah, al, bh, bl):
+    return (ah == bh) & (al == bl)
+
+
+def _pow5_factor_ge_pair(vh, vl, p, iters: int):
+    def body(_, st):
+        h, l, count, alive = st
+        qh, ql, r = _div5_pair(h, l)
+        div = (r == 0) & ((h | l) != 0) & alive
+        return (jnp.where(div, qh, h), jnp.where(div, ql, l),
+                count + div.astype(jnp.uint32), div)
+
+    _, _, count, _ = jax.lax.fori_loop(
+        0, iters, body, (vh, vl, jnp.zeros_like(vh),
+                         jnp.ones(vh.shape, jnp.bool_)))
+    return count >= p
+
+
+# ---------------------------------------------------------------------------
+# table value per row: (mul0 lo64 limbs[0:2], mul1 hi64 limbs[2:4])
+# ---------------------------------------------------------------------------
+
+def _pow5_limbs(i):
+    """DOUBLE_POW5_SPLIT[i] per row as 4 u32 limbs (two-level exact)."""
+    base = i // _STEP
+    off = i % _STEP
+    mul = _lut_limbs(_POW5_BASE, base, 4)
+    m = _lut_limbs(_POW5_OFF, off, 2)
+    prod = _mul_limbs(m, mul)                      # 6 limbs
+    i_bits = ((i * 1217359) >> 19) + 1
+    b26 = base * _STEP
+    b_bits = ((b26 * 1217359) >> 19) + 1
+    delta = (i_bits - b_bits).astype(jnp.uint32)
+    shifted = _shr_limbs(prod, delta, 4)
+    corr = _lut_u32s(_POW5_CORR, i)
+    res = _add_limbs(shifted, [corr] + [jnp.zeros_like(corr)] * 3)
+    exact = off == 0
+    return [jnp.where(exact, mul[k], res[k]) for k in range(4)]
+
+
+def _inv_pow5_limbs(q):
+    """DOUBLE_POW5_INV_SPLIT[q] per row as 4 u32 limbs."""
+    base = q // _STEP
+    off = q % _STEP
+    mul = _lut_limbs(_INV_BASE, base, 4)           # exact when off == 0
+    mul1 = _lut_limbs(_INV_BASE, base + 1, 4)
+    m = _lut_limbs(_POW5_OFF, (_STEP - off) % _STEP, 2)
+    prod = _mul_limbs(m, mul1)                     # 6 limbs
+    q_bits = ((q * 1217359) >> 19) + 1
+    b26 = (base + 1) * _STEP
+    b_bits = ((b26 * 1217359) >> 19) + 1
+    delta = (b_bits - q_bits).astype(jnp.uint32)
+    shifted = _shr_limbs(prod, delta, 4)
+    corr_i = _lut_u32s([c & 0xFFFFFFFF for c in _INV_CORR], q)
+    # corrections are -1/0/1: adding the sign-extended limb vector of
+    # -1 (all-ones) implements the subtraction mod 2^128
+    ones = jnp.uint32(0xFFFFFFFF)
+    ext = jnp.where(corr_i == ones, ones, jnp.uint32(0))
+    res = _add_limbs(shifted, [corr_i, ext, ext, ext])
+    exact = off == 0
+    return [jnp.where(exact, mul[k], res[k]) for k in range(4)]
+
+
+def _mul_shift64(mh, ml, f, j):
+    """Ryu mulShift64: ((m * factor128) >> j) low 64, j in (64, 128).
+    ``f`` = 4 factor limbs; m as (mh, ml) u32 pair."""
+    b0 = _mul_limbs([ml, mh], f[0:2])              # 4 limbs
+    b2 = _mul_limbs([ml, mh], f[2:4])              # 4 limbs
+    s = _add_limbs(b2, b0[2:4] + [jnp.zeros_like(mh)] * 2)
+    out = _shr_limbs(s, j - 64, 2)
+    return out[1], out[0]                          # (hi, lo)
+
+
+# ---------------------------------------------------------------------------
+# d2s core
+# ---------------------------------------------------------------------------
+
+def _ryu_d2d(bits_hi: jnp.ndarray, bits_lo: jnp.ndarray):
+    """Vectorized Ryu d2s for finite nonzero float64 (hi, lo) bits.
+    Returns (digit matrix [n, 17], olen, exp int32)."""
+    i32 = jnp.int32
+    u32 = jnp.uint32
+    ieee_m_hi = bits_hi & ((1 << 20) - 1)
+    ieee_m_lo = bits_lo
+    ieee_e = ((bits_hi >> 20) & 0x7FF).astype(i32)
+
+    denorm = ieee_e == 0
+    e2 = jnp.where(denorm, 1 - _D_BIAS - _D_MANTISSA_BITS - 2,
+                   ieee_e - _D_BIAS - _D_MANTISSA_BITS - 2).astype(i32)
+    m2_hi = jnp.where(denorm, ieee_m_hi, ieee_m_hi | (1 << 20))
+    m2_lo = ieee_m_lo
+    accept = (m2_lo & 1) == 0
+
+    # mv = 4*m2; mp = mv + 2; mm = mv - 1 - mmShift  (u64 pairs)
+    mv_hi = (m2_hi << 2) | (m2_lo >> 30)
+    mv_lo = m2_lo << 2
+    mp_hi, mp_lo = mv_hi, mv_lo + 2                # low 2 bits are 0
+    mm_shift = (((ieee_m_hi | ieee_m_lo) != 0)
+                | (ieee_e <= 1)).astype(u32)
+    sub = 1 + mm_shift
+    mm_lo = mv_lo - sub                            # borrows at most once
+    mm_hi = mv_hi - (mv_lo < sub).astype(u32)
+
+    # ---- e2 >= 0 ----
+    e2p = jnp.maximum(e2, 0).astype(u32)
+    q_p = ((e2p * 78913) >> 18) - (e2 > 3).astype(u32)
+    e10_p = q_p.astype(i32)
+    p5b_q = ((q_p * 1217359) >> 19) + 1
+    i_p = (-e2 + q_p.astype(i32) + _D_INV_BC
+           + p5b_q.astype(i32) - 1).astype(u32)
+    f_inv = _inv_pow5_limbs(q_p)
+    vr_p = _mul_shift64(mv_hi, mv_lo, f_inv, i_p)
+    vp_p = _mul_shift64(mp_hi, mp_lo, f_inv, i_p)
+    vm_p = _mul_shift64(mm_hi, mm_lo, f_inv, i_p)
+    q_le21 = q_p <= 21
+    _, _, mv_r5 = _div5_pair(mv_hi, mv_lo)
+    mv5 = mv_r5 == 0
+    vr_tz_p = q_le21 & mv5 & _pow5_factor_ge_pair(mv_hi, mv_lo, q_p, 25)
+    vm_tz_p = q_le21 & ~mv5 & accept \
+        & _pow5_factor_ge_pair(mm_hi, mm_lo, q_p, 25)
+    vp_dec_p = q_le21 & ~mv5 & ~accept \
+        & _pow5_factor_ge_pair(mp_hi, mp_lo, q_p, 25)
+    dec = vp_dec_p.astype(u32)
+    vp_p = (vp_p[0] - ((vp_p[1] < dec) & (dec > 0)).astype(u32),
+            vp_p[1] - dec)
+
+    # ---- e2 < 0 ----
+    ne2 = jnp.maximum(-e2, 0).astype(u32)
+    q_n = ((ne2 * 732923) >> 20) - (ne2 > 1).astype(u32)
+    e10_n = q_n.astype(i32) + e2
+    i_n = (ne2 - q_n).astype(u32)
+    p5b_i = ((i_n * 1217359) >> 19) + 1
+    j_n = (q_n.astype(i32)
+           - (p5b_i.astype(i32) - _D_BC)).astype(u32)
+    f_pow = _pow5_limbs(i_n)
+    vr_n = _mul_shift64(mv_hi, mv_lo, f_pow, j_n)
+    vp_n = _mul_shift64(mp_hi, mp_lo, f_pow, j_n)
+    vm_n = _mul_shift64(mm_hi, mm_lo, f_pow, j_n)
+    q_le1 = q_n <= 1
+    # multipleOfPowerOf2(mv, q) for 1 < q < 63
+    qq = jnp.minimum(q_n, 62)
+    mask_lo = jnp.where(qq >= 32, u32(0xFFFFFFFF) + u32(0),
+                        (u32(1) << (qq & 31)) - 1)
+    mask_hi = jnp.where(qq >= 32, (u32(1) << ((qq - 32) & 31)) - 1,
+                        u32(0))
+    p2 = ((mv_lo & mask_lo) | (mv_hi & mask_hi)) == 0
+    vr_tz_n = jnp.where(q_le1, True, (q_n < 63) & p2)
+    vm_tz_n = q_le1 & accept & (mm_shift == 1)
+    vp_dec_n = (q_le1 & ~accept).astype(u32)
+    vp_n = (vp_n[0] - ((vp_n[1] < vp_dec_n)
+                       & (vp_dec_n > 0)).astype(u32),
+            vp_n[1] - vp_dec_n)
+
+    # ---- select branch ----
+    pos = e2 >= 0
+    vr_h = jnp.where(pos, vr_p[0], vr_n[0])
+    vr_l = jnp.where(pos, vr_p[1], vr_n[1])
+    vp_h = jnp.where(pos, vp_p[0], vp_n[0])
+    vp_l = jnp.where(pos, vp_p[1], vp_n[1])
+    vm_h = jnp.where(pos, vm_p[0], vm_n[0])
+    vm_l = jnp.where(pos, vm_p[1], vm_n[1])
+    e10 = jnp.where(pos, e10_p, e10_n)
+    vr_tz = jnp.where(pos, vr_tz_p, vr_tz_n)
+    vm_tz = jnp.where(pos, vm_tz_p, vm_tz_n)
+
+    # d2s computes lastRemovedDigit inside the loops only (no special
+    # pre-step like f2s): start at 0
+    lrd = jnp.zeros(vr_h.shape, u32)
+    removed = jnp.zeros(vr_h.shape, i32)
+    general = vm_tz | vr_tz
+
+    def loop1(_, st):
+        vr_h, vr_l, vp_h, vp_l, vm_h, vm_l, lrd, removed, vm_tz, vr_tz = st
+        vpq_h, vpq_l, _r = _div10_pair(vp_h, vp_l)
+        vmq_h, vmq_l, vm_r = _div10_pair(vm_h, vm_l)
+        go = _pair_cmp_gt(vpq_h, vpq_l, vmq_h, vmq_l)
+        vrq_h, vrq_l, vr_r = _div10_pair(vr_h, vr_l)
+        vm_tz = vm_tz & jnp.where(go & general, vm_r == 0, True)
+        vr_tz = vr_tz & jnp.where(go & general, lrd == 0, True)
+        lrd = jnp.where(go, vr_r, lrd)
+        return (jnp.where(go, vrq_h, vr_h), jnp.where(go, vrq_l, vr_l),
+                jnp.where(go, vpq_h, vp_h), jnp.where(go, vpq_l, vp_l),
+                jnp.where(go, vmq_h, vm_h), jnp.where(go, vmq_l, vm_l),
+                lrd, removed + go.astype(i32), vm_tz, vr_tz)
+
+    st = (vr_h, vr_l, vp_h, vp_l, vm_h, vm_l, lrd, removed, vm_tz,
+          vr_tz)
+    st = jax.lax.fori_loop(0, 17, loop1, st)
+
+    def loop2(_, st):
+        vr_h, vr_l, vp_h, vp_l, vm_h, vm_l, lrd, removed, vm_tz, vr_tz = st
+        vmq_h, vmq_l, vm_r = _div10_pair(vm_h, vm_l)
+        go = general & vm_tz & (vm_r == 0) & ((vm_h | vm_l) != 0)
+        vrq_h, vrq_l, vr_r = _div10_pair(vr_h, vr_l)
+        vpq_h, vpq_l, _r = _div10_pair(vp_h, vp_l)
+        vr_tz = vr_tz & jnp.where(go, lrd == 0, True)
+        lrd = jnp.where(go, vr_r, lrd)
+        return (jnp.where(go, vrq_h, vr_h), jnp.where(go, vrq_l, vr_l),
+                jnp.where(go, vpq_h, vp_h), jnp.where(go, vpq_l, vp_l),
+                jnp.where(go, vmq_h, vm_h), jnp.where(go, vmq_l, vm_l),
+                lrd, removed + go.astype(i32), vm_tz, vr_tz)
+
+    st = jax.lax.fori_loop(0, 17, loop2, st)
+    (vr_h, vr_l, vp_h, vp_l, vm_h, vm_l, lrd, removed, vm_tz,
+     vr_tz) = st
+    lrd = jnp.where(general & vr_tz & (lrd == 5) & ((vr_l & 1) == 0),
+                    u32(4), lrd)
+    round_up = jnp.where(
+        general,
+        (_pair_eq(vr_h, vr_l, vm_h, vm_l) & (~accept | ~vm_tz))
+        | (lrd >= 5),
+        _pair_eq(vr_h, vr_l, vm_h, vm_l) | (lrd >= 5))
+    out_l = vr_l + round_up.astype(u32)
+    out_h = vr_h + (out_l < vr_l).astype(u32)
+    exp = e10 + removed
+
+    def strip(_, st):
+        out_h, out_l, exp = st
+        qh, ql, r = _div10_pair(out_h, out_l)
+        go = (r == 0) & ((out_h != 0) | (out_l >= 10))
+        return (jnp.where(go, qh, out_h), jnp.where(go, ql, out_l),
+                exp + go.astype(i32))
+
+    out_h, out_l, exp = jax.lax.fori_loop(0, 16, strip,
+                                          (out_h, out_l, exp))
+
+    # digits MSB-first [n, 17] + olen
+    MD = 17
+    ds = []
+    h, l = out_h, out_l
+    nz_beyond = []
+    for _ in range(MD):
+        h2, l2, r = _div10_pair(h, l)
+        ds.append(r.astype(jnp.uint8))
+        h, l = h2, l2
+        nz_beyond.append((h | l) != 0)
+    dm = jnp.stack(ds[::-1], axis=1)
+    olen = jnp.ones(out_h.shape, i32)
+    for k in range(MD - 1):
+        olen = olen + nz_beyond[k].astype(i32)
+    return dm, olen, exp
+
+
+_D_W = 26   # "-2.2250738585072014E-308" is 24 chars
+
+
+@jax.jit
+def _f64_format_jit(hi: jnp.ndarray, lo: jnp.ndarray):
+    i32 = jnp.int32
+    sign = (hi >> 31) == 1
+    exp_f = (hi >> 20) & 0x7FF
+    man_nz = ((hi & ((1 << 20) - 1)) | lo) != 0
+    is_nan = (exp_f == 0x7FF) & man_nz
+    is_inf = (exp_f == 0x7FF) & ~man_nz
+    is_zero = (exp_f == 0) & ~man_nz
+
+    dm, olen, exp = _ryu_d2d(hi & 0x7FFFFFFF, lo)
+    mat, length = _java_notation(dm, olen, exp, sign, 17, _D_W)
+    mat, length = _apply_specials(mat, length, _D_W, sign, is_nan,
+                                  is_inf, is_zero)
+    return mat, length.astype(i32)
+
+
+@func_range()
+def cast_double_to_string(col: Column) -> Column:
+    """CAST(double AS STRING): Java ``Double.toString`` notation over
+    Ryu shortest digits, one device program (u32-limb arithmetic, so it
+    runs under no-x64/TPU)."""
+    if col.dtype.kind != "float64":
+        raise ValueError("cast_double_to_string needs a float64 column")
+    data = col.data
+    if data.ndim == 2:                  # [2, n] plane pairs
+        lo, hi = data[0], data[1]
+    else:
+        pair = jax.lax.bitcast_convert_type(
+            data, jnp.uint32)           # [n, 2] under x64
+        lo, hi = pair[:, 0], pair[:, 1]
+    from spark_rapids_jni_tpu.ops.float_string import _bucket
+    n = hi.shape[0]
+    nb = _bucket(n)
+    if nb != n:  # bucket the row count: ONE compile serves all sizes
+        pad = jnp.zeros((nb - n,), jnp.uint32)
+        hi = jnp.concatenate([hi, pad])
+        lo = jnp.concatenate([lo, pad])
+    mat, lens = _f64_format_jit(hi, lo)
+    mat, lens = mat[:n], lens[:n]
+    valid = col.valid_bools()
+    lens = jnp.where(valid, lens, 0)
+    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(lens).astype(jnp.int32)])
+    return Column(STRING, jnp.zeros((0,), jnp.uint8), col.validity,
+                  offsets, None,
+                  jnp.where(valid[:, None], mat, jnp.uint8(0)))

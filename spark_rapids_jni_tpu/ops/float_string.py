@@ -1,0 +1,461 @@
+"""CAST(float AS STRING) with Java shortest-representation semantics —
+a vectorized Ryu port.
+
+The reference lineage implements Java ``Float.toString`` /
+``Double.toString`` as a device kernel (``cast_float_to_string``, named
+in ``BASELINE.json``'s kernel list).  Modern Java (and therefore Spark)
+renders the SHORTEST decimal that round-trips, in Java's notation:
+plain decimal for 1e-3 <= |x| < 1e7 (always at least one fractional
+digit: ``100.0``), scientific ``d.dddE±e`` otherwise, ``-0.0`` signed,
+``NaN``/``Infinity`` literals.
+
+TPU-native design: Ryu's integer algorithm vectorizes cleanly — the
+per-row state is a handful of uint32 words, the bounded digit/factor
+loops unroll (<= 11 iterations), and the power-of-5 tables (31/47
+entries for f32) become select-sums (per-row dynamic gathers run ~100x
+slower than vector selects on TPU).  64-bit intermediates ride uint32
+(hi, lo) pairs, so everything is exact under no-x64.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.table import Column, STRING, pack_bools
+from spark_rapids_jni_tpu.utils.tracing import func_range
+
+
+# ---------------------------------------------------------------------------
+# uint32-pair helpers (no-x64-safe 64-bit arithmetic)
+# ---------------------------------------------------------------------------
+
+def _mulu32v(a: jnp.ndarray, b: jnp.ndarray):
+    """Full 32x32 -> 64 product of two uint32 vectors, as (hi, lo)."""
+    a_lo, a_hi = a & 0xFFFF, a >> 16
+    b_lo, b_hi = b & 0xFFFF, b >> 16
+    ll = a_lo * b_lo
+    lh = a_lo * b_hi
+    hl = a_hi * b_lo
+    hh = a_hi * b_hi
+    mid = (ll >> 16) + (lh & 0xFFFF) + (hl & 0xFFFF)
+    lo = (ll & 0xFFFF) | (mid << 16)
+    hi = hh + (lh >> 16) + (hl >> 16) + (mid >> 16)
+    return hi, lo
+
+
+def _pair_add(ah, al, bh, bl):
+    lo = al + bl
+    carry = (lo < bl).astype(jnp.uint32)
+    return ah + bh + carry, lo
+
+
+def _pair_shr_to32(hi, lo, s):
+    """(hi, lo) >> s -> low 32 bits, per-row s in [0, 63]."""
+    s = s.astype(jnp.uint32)
+    big = s >= 32
+    s2 = jnp.where(big, s - 32, s) & 31
+    small = jnp.where(s2 == 0, lo, (lo >> s2) | (hi << ((32 - s2) & 31)))
+    return jnp.where(big, hi >> s2, small)
+
+
+# ---------------------------------------------------------------------------
+# Ryu f2s tables (computed exactly at import; tiny)
+# ---------------------------------------------------------------------------
+
+_F_POW5_INV_BITCOUNT = 59
+_F_POW5_BITCOUNT = 61
+
+
+def _pow5bits_py(e: int) -> int:
+    return ((e * 1217359) >> 19) + 1
+
+
+_F_POW5_INV = tuple(
+    ((1 << (_F_POW5_INV_BITCOUNT + _pow5bits_py(q) - 1)) // 5 ** q) + 1
+    for q in range(31))
+_F_POW5 = tuple(
+    (5 ** i) << (_F_POW5_BITCOUNT - _pow5bits_py(i))
+    if _pow5bits_py(i) <= _F_POW5_BITCOUNT
+    else (5 ** i) >> (_pow5bits_py(i) - _F_POW5_BITCOUNT)
+    for i in range(47))
+
+
+def _lut64(table, idx):
+    """Select-OR lookup of 64-bit constants -> (hi, lo) uint32 vectors."""
+    hi = jnp.zeros_like(idx)
+    lo = jnp.zeros_like(idx)
+    for j, v in enumerate(table):
+        sel = idx == j
+        hi = hi | jnp.where(sel, jnp.uint32(v >> 32), jnp.uint32(0))
+        lo = lo | jnp.where(sel, jnp.uint32(v & 0xFFFFFFFF),
+                            jnp.uint32(0))
+    return hi, lo
+
+
+def _mul_shift32(m, f_hi, f_lo, shift):
+    """Ryu mulShift32: low32((m * factor) >> shift), 32 < shift < 64."""
+    b0h, _ = _mulu32v(m, f_lo)
+    b1h, b1l = _mulu32v(m, f_hi)
+    sh, sl = _pair_add(b1h, b1l, jnp.zeros_like(b0h), b0h)
+    return _pair_shr_to32(sh, sl, shift - 32)
+
+
+def _pow5bits(e):
+    return ((e.astype(jnp.uint32) * 1217359) >> 19) + 1
+
+
+def _pow5_factor_ge(value: jnp.ndarray, p: jnp.ndarray,
+                    iters: int) -> jnp.ndarray:
+    """True where 5^p divides value (vectorized pow5Factor >= p)."""
+    v = value
+    count = jnp.zeros(value.shape, jnp.uint32)
+    alive = jnp.ones(value.shape, jnp.bool_)
+    for _ in range(iters):
+        q = v // 5
+        div = (q * 5 == v) & (v != 0) & alive
+        count = count + div.astype(jnp.uint32)
+        v = jnp.where(div, q, v)
+        alive = div
+    return count >= p
+
+
+_F_MANTISSA_BITS = 23
+_F_BIAS = 127
+
+
+def _ryu_f2d(bits: jnp.ndarray):
+    """Vectorized Ryu f2s core for finite nonzero float32 bit patterns.
+    Returns (output digits uint32 < 10^9+1, exp int32) with
+    |value| = output * 10^exp (ryu/f2s.c, steps 2-4)."""
+    i32 = jnp.int32
+    u32 = jnp.uint32
+    ieee_m = bits & ((1 << _F_MANTISSA_BITS) - 1)
+    ieee_e = ((bits >> _F_MANTISSA_BITS) & 0xFF).astype(i32)
+
+    denorm = ieee_e == 0
+    e2 = jnp.where(denorm, 1 - _F_BIAS - _F_MANTISSA_BITS - 2,
+                   ieee_e - _F_BIAS - _F_MANTISSA_BITS - 2).astype(i32)
+    m2 = jnp.where(denorm, ieee_m,
+                   (u32(1) << _F_MANTISSA_BITS) | ieee_m)
+    accept = (m2 & 1) == 0          # acceptBounds = even
+
+    mv = u32(4) * m2
+    mp = mv + 2
+    mm_shift = ((ieee_m != 0) | (ieee_e <= 1)).astype(u32)
+    mm = mv - 1 - mm_shift
+
+    # ---- positive-exponent branch (e2 >= 0) ----
+    e2p = jnp.maximum(e2, 0).astype(u32)
+    q_p = (e2p * 78913) >> 18                      # log10Pow2
+    i_p = (-e2 + q_p.astype(i32)
+           + (_F_POW5_INV_BITCOUNT
+              + _pow5bits(q_p).astype(i32) - 1)).astype(u32)
+    fh, fl = _lut64(_F_POW5_INV, q_p)
+    vr_p = _mul_shift32(mv, fh, fl, i_p)
+    vp_p = _mul_shift32(mp, fh, fl, i_p)
+    vm_p = _mul_shift32(mm, fh, fl, i_p)
+    e10_p = q_p.astype(i32)
+    # one extra removed digit when the loop below will not run
+    need_lrd_p = (q_p != 0) & ((vp_p - 1) // 10 <= vm_p // 10)
+    qm1 = jnp.where(q_p > 0, q_p - 1, 0)
+    l_p = (-e2 + qm1.astype(i32)
+           + (_F_POW5_INV_BITCOUNT
+              + _pow5bits(qm1).astype(i32) - 1)).astype(u32)
+    fh1, fl1 = _lut64(_F_POW5_INV, qm1)
+    lrd_p = jnp.where(need_lrd_p,
+                      _mul_shift32(mv, fh1, fl1, l_p) % 10, 0)
+    q_le9 = q_p <= 9
+    mv5 = (mv % 5) == 0
+    vr_tz_p = q_le9 & mv5 & _pow5_factor_ge(mv, q_p, 11)
+    vm_tz_p = q_le9 & ~mv5 & accept & _pow5_factor_ge(mm, q_p, 11)
+    vp_dec_p = q_le9 & ~mv5 & ~accept & _pow5_factor_ge(mp, q_p, 11)
+    vp_p = vp_p - vp_dec_p.astype(u32)
+
+    # ---- negative-exponent branch (e2 < 0) ----
+    ne2 = jnp.maximum(-e2, 0).astype(u32)
+    q_n = (ne2 * 732923) >> 20                     # log10Pow5
+    e10_n = q_n.astype(i32) + e2
+    i_n = (ne2 - q_n).astype(u32)
+    j_n = (q_n.astype(i32)
+           - (_pow5bits(i_n).astype(i32) - _F_POW5_BITCOUNT)).astype(u32)
+    gh, gl = _lut64(_F_POW5, i_n)
+    vr_n = _mul_shift32(mv, gh, gl, j_n)
+    vp_n = _mul_shift32(mp, gh, gl, j_n)
+    vm_n = _mul_shift32(mm, gh, gl, j_n)
+    need_lrd_n = (q_n != 0) & ((vp_n - 1) // 10 <= vm_n // 10)
+    i_n1 = i_n + 1
+    j_n1 = (q_n.astype(i32) - 1
+            - (_pow5bits(i_n1).astype(i32)
+               - _F_POW5_BITCOUNT)).astype(u32)
+    gh1, gl1 = _lut64(_F_POW5, i_n1)
+    lrd_n = jnp.where(need_lrd_n,
+                      _mul_shift32(mv, gh1, gl1, j_n1) % 10, 0)
+    q_le1 = q_n <= 1
+    vr_tz_n = q_le1 | ((q_n < 31)
+                       & ((mv & ((u32(1) << jnp.where(q_n > 0,
+                                                      q_n - 1, 0)) - 1))
+                          == 0) & (q_n > 1))
+    vm_tz_n = q_le1 & accept & (mm_shift == 1)
+    vp_dec_n = q_le1 & ~accept
+    vp_n = vp_n - vp_dec_n.astype(u32)
+
+    # ---- select branch results ----
+    pos = e2 >= 0
+    vr = jnp.where(pos, vr_p, vr_n)
+    vp = jnp.where(pos, vp_p, vp_n)
+    vm = jnp.where(pos, vm_p, vm_n)
+    e10 = jnp.where(pos, e10_p, e10_n)
+    lrd = jnp.where(pos, lrd_p, lrd_n).astype(u32)
+    vr_tz = jnp.where(pos, vr_tz_p, vr_tz_n)
+    vm_tz = jnp.where(pos, vm_tz_p, vm_tz_n)
+
+    # ---- step 4: shortest representation in the interval ----
+    removed = jnp.zeros(bits.shape, i32)
+    general = vm_tz | vr_tz
+    # loop 1: while vp/10 > vm/10  (<= 10 iterations for f32)
+    for _ in range(10):
+        go = (vp // 10) > (vm // 10)
+        vm_tz = vm_tz & jnp.where(go & general, (vm % 10) == 0, True)
+        vr_tz = vr_tz & jnp.where(go & general, lrd == 0, True)
+        lrd = jnp.where(go, vr % 10, lrd)
+        vr = jnp.where(go, vr // 10, vr)
+        vp = jnp.where(go, vp // 10, vp)
+        vm = jnp.where(go, vm // 10, vm)
+        removed = removed + go.astype(i32)
+    # loop 2 (general case only): while vm % 10 == 0
+    for _ in range(10):
+        go = general & vm_tz & ((vm % 10) == 0) & (vm != 0)
+        vr_tz = vr_tz & jnp.where(go, lrd == 0, True)
+        lrd = jnp.where(go, vr % 10, lrd)
+        vr = jnp.where(go, vr // 10, vr)
+        vp = jnp.where(go, vp // 10, vp)
+        vm = jnp.where(go, vm // 10, vm)
+        removed = removed + go.astype(i32)
+    # round-even on exact .5
+    lrd = jnp.where(general & vr_tz & (lrd == 5) & ((vr % 2) == 0),
+                    u32(4), lrd)
+    round_up = jnp.where(
+        general,
+        ((vr == vm) & (~accept | ~vm_tz)) | (lrd >= 5),
+        (vr == vm) | (lrd >= 5))
+    output = vr + round_up.astype(u32)
+    exp = e10 + removed
+    # defensive: strip trailing zeros a round-up could introduce
+    for _ in range(9):
+        go = (output >= 10) & ((output % 10) == 0)
+        output = jnp.where(go, output // 10, output)
+        exp = exp + go.astype(i32)
+    return output, exp
+
+
+# ---------------------------------------------------------------------------
+# Java Float.toString formatting
+# ---------------------------------------------------------------------------
+
+_F_W = 16   # "-1.17549435E-38" is 15 chars
+
+
+def _digits_of(output: jnp.ndarray, max_digits: int):
+    """(digit matrix [n, max_digits] MSB-first, count) of a uint32."""
+    n = output.shape[0]
+    ds = []
+    v = output
+    for _ in range(max_digits):
+        ds.append((v % 10).astype(jnp.uint8))
+        v = v // 10
+    dm = jnp.stack(ds[::-1], axis=1)               # MSB first, padded
+    olen = jnp.ones(output.shape, jnp.int32)
+    p10 = 10
+    for k in range(1, max_digits):
+        olen = olen + (output >= p10).astype(jnp.int32)
+        p10 *= 10
+    return dm, olen
+
+
+def _sel_digit(dm: jnp.ndarray, k: jnp.ndarray, max_digits: int):
+    """dm[row, k] via select-OR (k per row, clamped)."""
+    out = jnp.zeros(k.shape, jnp.uint8)
+    for m in range(max_digits):
+        out = out | jnp.where(k == m, dm[:, m], jnp.uint8(0))
+    return out
+
+
+def _bucket(n: int) -> int:
+    """Row-count bucket (next power of two, min 256): the unrolled Ryu
+    graphs compile in minutes — shape-bucketing caps that at one
+    compile per bucket instead of one per distinct column length."""
+    b = 256
+    while b < n:
+        b *= 2
+    return b
+
+
+def _java_notation(dm, olen, exp, sign, MD: int, W: int):
+    """Java float/double notation from shortest digits: plain decimal
+    for -3 <= exp_sci < 7 (at least one fractional digit), scientific
+    ``d.dddE±e`` otherwise.  ``dm`` [n, MD] digit matrix (MSB-justified
+    right: first significant digit at column MD - olen), ``exp`` the
+    power of the LAST digit.  Returns (char matrix [n, W], lengths)."""
+    i32 = jnp.int32
+    n = dm.shape[0]
+    first_off = MD - olen
+    exp_sci = exp + olen - 1
+    sci = (exp_sci < -3) | (exp_sci >= 7)
+    base = sign.astype(i32)
+    pos = jnp.arange(W, dtype=i32)[None, :]
+    zero8 = jnp.zeros((n, W), jnp.uint8)
+
+    def dig_at(k2d):
+        out = jnp.zeros((n, W), jnp.uint8)
+        for m in range(MD):
+            out = out | jnp.where(k2d == m, dm[:, m][:, None],
+                                  jnp.uint8(0))
+        return out + jnp.uint8(ord("0"))
+
+    # ---- plain notation ----
+    int_len = jnp.maximum(exp_sci + 1, 1)
+    lead_zeros = jnp.maximum(-exp_sci - 1, 0)
+    idx = pos - base[:, None]
+    in_int = (idx >= 0) & (idx < int_len[:, None])
+    k_int = first_off[:, None] + idx
+    int_digit = jnp.where(
+        exp_sci[:, None] >= 0,
+        jnp.where(k_int < (first_off + olen)[:, None],
+                  dig_at(k_int), jnp.uint8(ord("0"))),
+        jnp.uint8(ord("0")))
+    dot_at = idx == int_len[:, None]
+    fidx = idx - int_len[:, None] - 1
+    frac_digits_avail = jnp.where(exp_sci >= 0,
+                                  jnp.maximum(olen - int_len, 0),
+                                  olen)
+    frac_len = jnp.maximum(frac_digits_avail, 1) \
+        + jnp.where(exp_sci < 0, lead_zeros, 0)
+    in_frac = (fidx >= 0) & (fidx < frac_len[:, None])
+    k_frac = jnp.where(exp_sci[:, None] >= 0,
+                       first_off[:, None] + int_len[:, None] + fidx,
+                       first_off[:, None] + fidx - lead_zeros[:, None])
+    have_digit = (k_frac >= first_off[:, None]) \
+        & (k_frac < (first_off + olen)[:, None]) \
+        & jnp.where(exp_sci[:, None] >= 0,
+                    frac_digits_avail[:, None] > 0, True)
+    frac_digit = jnp.where(have_digit, dig_at(k_frac),
+                           jnp.uint8(ord("0")))
+    plain = jnp.where(in_int, int_digit,
+                      jnp.where(dot_at, jnp.uint8(ord(".")),
+                                jnp.where(in_frac, frac_digit, zero8)))
+    plain_len = base + int_len + 1 + frac_len
+
+    # ---- scientific notation ----
+    mant_frac = jnp.maximum(olen - 1, 1)
+    e_abs = jnp.abs(exp_sci)
+    e_ndig = 1 + (e_abs >= 10).astype(i32) + (e_abs >= 100).astype(i32)
+    e_neg = (exp_sci < 0).astype(i32)
+    d0_at = idx == 0
+    sdot_at = idx == 1
+    sfidx = idx - 2
+    s_in_frac = (sfidx >= 0) & (sfidx < mant_frac[:, None])
+    k_sf = first_off[:, None] + 1 + sfidx
+    s_frac = jnp.where(k_sf < (first_off + olen)[:, None],
+                       dig_at(k_sf), jnp.uint8(ord("0")))
+    e_at = idx == (2 + mant_frac[:, None])
+    eneg_at = (idx == (3 + mant_frac[:, None])) & (e_neg[:, None] == 1)
+    ed_start = 3 + mant_frac[:, None] + e_neg[:, None]
+    ed_idx = idx - ed_start
+    h = (e_abs // 100).astype(jnp.uint8) + jnp.uint8(ord("0"))
+    t = ((e_abs // 10) % 10).astype(jnp.uint8) + jnp.uint8(ord("0"))
+    o = (e_abs % 10).astype(jnp.uint8) + jnp.uint8(ord("0"))
+    # exponent digit at position ed_idx of e_ndig digits (MSB first)
+    k_e = ed_idx + (3 - e_ndig[:, None])           # map into [h, t, o]
+    e_digit = jnp.where(k_e == 0, h[:, None],
+                        jnp.where(k_e == 1, t[:, None], o[:, None]))
+    in_ed = (ed_idx >= 0) & (ed_idx < e_ndig[:, None])
+    scis = jnp.where(
+        d0_at, dig_at(first_off[:, None] + 0 * idx),
+        jnp.where(sdot_at, jnp.uint8(ord(".")),
+                  jnp.where(s_in_frac, s_frac,
+                            jnp.where(e_at, jnp.uint8(ord("E")),
+                                      jnp.where(eneg_at,
+                                                jnp.uint8(ord("-")),
+                                                jnp.where(in_ed, e_digit,
+                                                          zero8))))))
+    sci_len = base + 3 + mant_frac + e_neg + e_ndig
+
+    mat = jnp.where(sci[:, None], scis, plain)
+    length = jnp.where(sci, sci_len, plain_len)
+    mat = jnp.where((pos == 0) & sign[:, None], jnp.uint8(ord("-")), mat)
+    return mat, length
+
+
+def _literal_row(text: str, W: int):
+    b = np.frombuffer(text.encode(), np.uint8)
+    row = np.zeros((W,), np.uint8)
+    row[:len(b)] = b
+    return jnp.asarray(row)[None, :], len(b)
+
+
+def _apply_specials(mat, length, W, sign, is_nan, is_inf, is_zero):
+    pos = jnp.arange(W, dtype=jnp.int32)[None, :]
+    for cond, text in ((is_nan, "NaN"),
+                       (is_inf & ~sign, "Infinity"),
+                       (is_inf & sign, "-Infinity"),
+                       (is_zero & ~sign, "0.0"),
+                       (is_zero & sign, "-0.0")):
+        row, ln = _literal_row(text, W)
+        mat = jnp.where(cond[:, None], row, mat)
+        length = jnp.where(cond, ln, length)
+    mat = jnp.where(pos < length[:, None], mat, jnp.uint8(0))
+    return mat, length
+
+
+@jax.jit
+def _f32_format_jit(bits: jnp.ndarray):
+    """float32 bit patterns -> (char matrix [n, 16], lengths)."""
+    i32 = jnp.int32
+    sign = (bits >> 31) == 1
+    exp_f = (bits >> 23) & 0xFF
+    man_f = bits & ((1 << 23) - 1)
+    is_nan = (exp_f == 255) & (man_f != 0)
+    is_inf = (exp_f == 255) & (man_f == 0)
+    is_zero = (exp_f == 0) & (man_f == 0)
+
+    output, exp = _ryu_f2d(bits & 0x7FFFFFFF)
+    MD = 9
+    dm, olen = _digits_of(output, MD)
+    mat, length = _java_notation(dm, olen, exp, sign, MD, _F_W)
+    mat, length = _apply_specials(mat, length, _F_W, sign, is_nan,
+                                  is_inf, is_zero)
+    return mat, length.astype(i32)
+
+
+@func_range()
+def cast_float_to_string(col: Column) -> Column:
+    """CAST(float AS STRING): Java ``Float.toString`` notation over Ryu
+    shortest-round-trip digits, as one device program (the digit
+    selection matches the reference lineage's own Ryu-based
+    ``ftos_converter``; pre-shortest JDKs rendered some boundary values
+    with more digits).  float64 columns route to the double kernel."""
+    if col.dtype.kind == "float64":
+        from spark_rapids_jni_tpu.ops.double_string import (
+            cast_double_to_string)
+        return cast_double_to_string(col)
+    if col.dtype.kind != "float32":
+        raise ValueError("cast_float_to_string needs a float column")
+    bits = jax.lax.bitcast_convert_type(col.data, jnp.uint32)
+    n = bits.shape[0]
+    nb = _bucket(n)
+    if nb != n:  # bucket the row count: ONE compile serves all sizes
+        bits = jnp.concatenate([bits, jnp.zeros((nb - n,), jnp.uint32)])
+    mat, lens = _f32_format_jit(bits)
+    mat, lens = mat[:n], lens[:n]
+    valid = col.valid_bools()
+    lens = jnp.where(valid, lens, 0)
+    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(lens).astype(jnp.int32)])
+    return Column(STRING, jnp.zeros((0,), jnp.uint8), col.validity,
+                  offsets, None,
+                  jnp.where(valid[:, None], mat, jnp.uint8(0)))

@@ -706,50 +706,116 @@ def _eval_wildcard_host(col: Column, segs) -> Column:
 
 def _elem_scan(vals: jnp.ndarray, out_len: jnp.ndarray):
     """Over left-justified raw ARRAY spans [n, W]: (element_count,
-    has_ws_outside_strings, has_backslash, has_bad).  Elements =
-    top-level commas + 1, or 0 for empty arrays.  ``has_bad`` flags
-    bytes >= 0x80 outside strings -- the JSON grammar is pure ASCII
-    there, so such rows are malformed (Spark's parser nulls them)."""
+    punt, has_bad).
+
+    Counts top-level elements AND validates that the span is a FLAT
+    JSON array of number / escape-free-string elements via a per-char
+    token automaton (states: expect-value, number sign/int/zero/frac/
+    exponent phases, in-string, after-value).  ``punt`` flags anything
+    the raw-passthrough rendering cannot guarantee Spark-exact — outer
+    whitespace, escapes, nested containers, literals, malformed
+    structure (trailing commas, leading zeros, bare tokens) — those
+    rows take the exact host path.  ``has_bad`` flags bytes >= 0x80
+    outside strings: the JSON grammar is pure ASCII there, so such rows
+    are malformed (Spark's parser nulls them)."""
     n, W = vals.shape
     i32 = jnp.int32
     z = jnp.zeros((n,), i32)
-    carry0 = dict(in_str=z, esc=z, depth=z, commas=z, has_tok=z,
-                  has_ws=z, has_bs=z, has_bad=z)
+    # states
+    EXP, NSIGN, NINT, NZERO, NDOT, NFRAC, NE, NESIGN, NEXP, AFTER, \
+        INSTR, CLOSED = range(12)
+    carry0 = dict(st=z + EXP, esc=z, commas=z, has_tok=z, punt=z,
+                  has_bad=z, closed=z)
 
     def step(c, x):
         pos, col = x
         ch = col.astype(i32)
-        act = (pos < out_len).astype(i32)
-        in_str, esc, depth = c["in_str"], c["esc"], c["depth"]
+        act = (pos > 0) & (pos < out_len)          # skip the outer '['
+        st, esc = c["st"], c["esc"]
+        in_str = st == INSTR
         quote = (ch == 34) & (esc == 0)
-        new_in_str = jnp.where(quote, 1 - in_str, in_str)
-        new_esc = ((in_str == 1) & (ch == 92) & (esc == 0)).astype(i32)
-        outside = in_str == 0
-        opens = outside & ((ch == 91) | (ch == 123)) & (esc == 0)
-        closes = outside & ((ch == 93) | (ch == 125)) & (esc == 0)
-        new_depth = depth + jnp.where(opens, 1, 0) \
-            - jnp.where(closes, 1, 0)
-        comma = act * (outside & (ch == 44) & (depth == 1)).astype(i32)
-        is_ws = (ch == 32) | (ch == 9) | (ch == 10) | (ch == 13)
-        # content between the outer brackets: any non-ws char past
-        # position 0 still at depth >= 1 after the update (the closing
-        # outer bracket drops to 0 and is excluded)
-        tok = act * ((pos > 0) & ~is_ws & (new_depth >= 1)).astype(i32)
-        ws = act * (outside & is_ws).astype(i32)
-        bs = act * (ch == 92).astype(i32)
-        bad = act * (outside & (ch >= 128)).astype(i32)
-        return dict(in_str=new_in_str, esc=new_esc, depth=new_depth,
-                    commas=c["commas"] + comma,
-                    has_tok=c["has_tok"] | tok,
-                    has_ws=c["has_ws"] | ws,
-                    has_bs=c["has_bs"] | bs,
-                    has_bad=c["has_bad"] | bad), None
+        new_esc = (in_str & (ch == 92) & (esc == 0)).astype(i32)
+        is_dig = (ch >= 48) & (ch <= 57)
+        is_nz = (ch >= 49) & (ch <= 57)
+        e_ch = (ch == 101) | (ch == 69)
+        comma = ch == 44
+        close = ch == 93
+        # closing ']' of the OUTER array: the span's last char
+        outer_close = close & (pos == out_len - 1)
+
+        def trans(cur):
+            """next state for the non-string states."""
+            bad = jnp.ones_like(st)                # sentinel: punt
+            nxt = jnp.where(cur == EXP,
+                jnp.where(ch == 34, INSTR,
+                jnp.where(ch == 45, NSIGN,
+                jnp.where(ch == 48, NZERO,
+                jnp.where(is_nz, NINT, -1)))), -1)
+            num_close = jnp.where(outer_close, CLOSED, -1)
+            from_int = jnp.where(is_dig, NINT,
+                jnp.where(ch == 46, NDOT,
+                jnp.where(e_ch, NE,
+                jnp.where(comma, EXP, num_close))))
+            from_zero = jnp.where(ch == 46, NDOT,
+                jnp.where(e_ch, NE,
+                jnp.where(comma, EXP, num_close)))
+            from_frac = jnp.where(is_dig, NFRAC,
+                jnp.where(e_ch, NE,
+                jnp.where(comma, EXP, num_close)))
+            from_exp = jnp.where(is_dig, NEXP,
+                jnp.where(comma, EXP, num_close))
+            nxt = jnp.where(cur == NSIGN,
+                            jnp.where(ch == 48, NZERO,
+                                      jnp.where(is_nz, NINT, -1)), nxt)
+            nxt = jnp.where(cur == NINT, from_int, nxt)
+            nxt = jnp.where(cur == NZERO, from_zero, nxt)
+            nxt = jnp.where(cur == NDOT,
+                            jnp.where(is_dig, NFRAC, -1), nxt)
+            nxt = jnp.where(cur == NFRAC, from_frac, nxt)
+            nxt = jnp.where(cur == NE,
+                            jnp.where((ch == 43) | (ch == 45), NESIGN,
+                                      jnp.where(is_dig, NEXP, -1)), nxt)
+            nxt = jnp.where(cur == NESIGN,
+                            jnp.where(is_dig, NEXP, -1), nxt)
+            nxt = jnp.where(cur == NEXP, from_exp, nxt)
+            nxt = jnp.where(cur == AFTER,
+                            jnp.where(comma, EXP, num_close), nxt)
+            nxt = jnp.where(cur == CLOSED, -1, nxt)
+            del bad
+            return nxt
+
+        nxt = trans(st)
+        # string state: unescaped quote closes the element
+        nxt = jnp.where(in_str,
+                        jnp.where(quote & (esc == 0), AFTER, INSTR),
+                        nxt)
+        bad_step = act & (nxt == -1)
+        # a ']' while EXPECTing a value: legal only for the empty array
+        empty_ok = (st == EXP) & outer_close & (c["has_tok"] == 0)
+        nxt = jnp.where(empty_ok, CLOSED, nxt)
+        bad_step = bad_step & ~empty_ok
+        nxt = jnp.where(act == 0, st, jnp.where(bad_step, st, nxt))
+        is_comma_top = act & ~in_str & comma & (st != INSTR) \
+            & ((st == NINT) | (st == NZERO) | (st == NFRAC)
+               | (st == NEXP) | (st == AFTER))
+        tok = act & (st == EXP) & ~close & (nxt != EXP)
+        bad_hi = act & ~in_str & (ch >= 128)
+        return dict(st=nxt, esc=jnp.where(in_str, new_esc, z),
+                    commas=c["commas"] + is_comma_top.astype(i32),
+                    has_tok=c["has_tok"] | tok.astype(i32),
+                    punt=c["punt"] | bad_step.astype(i32)
+                    | (act & (ch == 92)).astype(i32),
+                    has_bad=c["has_bad"] | bad_hi.astype(i32),
+                    closed=c["closed"]
+                    | (act & (nxt == CLOSED)).astype(i32)), None
 
     pos = jnp.arange(W, dtype=i32)
     final, _ = jax.lax.scan(step, carry0, (pos, vals.T))
     count = jnp.where(final["has_tok"] == 1, final["commas"] + 1, 0)
-    return (count, final["has_ws"] == 1, final["has_bs"] == 1,
-            final["has_bad"] == 1)
+    # spans that never reached CLOSED (escapes flipped string state,
+    # truncation, ...) punt as well
+    punt = (final["punt"] == 1) | (final["closed"] == 0)
+    return count, punt, final["has_bad"] == 1
 
 
 @functools.partial(jax.jit, static_argnums=(3, 4, 5))
@@ -772,7 +838,7 @@ def _wildcard_device_jit(ch, validity, lens, segs, W: int, mkl: int):
                       end=lens.astype(jnp.int32),
                       found=z + 1, capturing=z, bad=z)
     vals_a, len_a, ok_a, _, first_a = _extract_value(ch, st_arr, W)
-    count, has_ws, has_bs, has_bad = _elem_scan(vals_a, len_a)
+    count, elem_punt, has_bad = _elem_scan(vals_a, len_a)
     arr_ok = ok_a & (first_a == ord("[")) & ~has_bad
 
     st0 = _scan_automaton(ch, parent + (0,), mkl)
@@ -787,17 +853,25 @@ def _wildcard_device_jit(ch, validity, lens, segs, W: int, mkl: int):
         in_valid = unpack_bools(validity, n)
     else:
         in_valid = jnp.ones((n,), jnp.bool_)
-    valid = in_valid & (single | multi)
+    # uncertified spans (elem_punt) stay live so the host pass decides
+    # them; under jit they degrade to null below
+    valid = in_valid & (single | multi | (arr_ok & elem_punt))
 
     # host punts: single-element strings with escapes / container
-    # elements (normalization), and multi-rows whose raw array text is
-    # not already Spark-normalized (whitespace or escape sequences)
+    # elements (normalization), and multi-rows whose raw array text the
+    # flat-array automaton could not certify as already Spark-exact
+    # (whitespace, escapes, nested containers/objects, literals,
+    # malformed structure)
     mask0 = jnp.arange(W, dtype=jnp.int32)[None, :] < len_0[:, None]
     e0_bs = jnp.any(jnp.where(mask0, vals_0 == ord("\\"), False),
                     axis=1)
     e0_container = (first_0 == ord("{")) | (first_0 == ord("["))
-    needs_host = valid & ((single & ((is_str_0 & e0_bs) | e0_container))
-                          | (multi & (has_ws | has_bs)))
+    # an uncertified span also makes the single/multi classification
+    # itself unreliable (bare tokens, literals), so ANY punt routes to
+    # the host regardless of count
+    needs_host = valid & ((arr_ok & elem_punt)
+                          | (single & ((is_str_0 & e0_bs)
+                                       | e0_container)))
     return vals, out_len, valid, needs_host
 
 

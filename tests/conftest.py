@@ -18,6 +18,18 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+# persistent compilation cache: the biggest test graphs (the unrolled
+# Ryu double kernel, the wide row-conversion programs) compile in
+# minutes cold; repeat suite runs hit the on-disk cache instead
+try:
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "..", ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+except Exception:
+    pass
+
 CPU_DEVICES = jax.devices("cpu")
 jax.config.update("jax_default_device", CPU_DEVICES[0])
 jax.config.update("jax_enable_x64", True)

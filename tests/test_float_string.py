@@ -1,0 +1,204 @@
+"""cast_float_to_string: Ryu shortest-round-trip digits in Java
+notation.  Oracles: an exact scalar Ryu port (unbounded python ints)
+for digits, numpy round-trip for the shortest property, golden vectors
+for Java formatting."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import Column, FLOAT32
+from spark_rapids_jni_tpu.ops.float_string import cast_float_to_string
+
+
+# -- exact scalar reference (ryu/f2s.c, unbounded ints) ---------------------
+
+_F_INV_BC, _F_BC = 59, 61
+
+
+def _pow5bits(e):
+    return ((e * 1217359) >> 19) + 1
+
+
+_POW5_INV = [((1 << (_F_INV_BC + _pow5bits(q) - 1)) // 5 ** q) + 1
+             for q in range(31)]
+_POW5 = [(5 ** i << (_F_BC - _pow5bits(i)))
+         if _pow5bits(i) <= _F_BC else (5 ** i >> (_pow5bits(i) - _F_BC))
+         for i in range(47)]
+
+
+def _pow5factor(v):
+    c = 0
+    while v > 0 and v % 5 == 0:
+        v //= 5
+        c += 1
+    return c
+
+
+def _ref_f2d(bits):
+    ieee_m = bits & ((1 << 23) - 1)
+    ieee_e = (bits >> 23) & 0xFF
+    if ieee_e == 0:
+        e2, m2 = 1 - 127 - 23 - 2, ieee_m
+    else:
+        e2, m2 = ieee_e - 127 - 23 - 2, (1 << 23) | ieee_m
+    accept = (m2 & 1) == 0
+    mv, mp = 4 * m2, 4 * m2 + 2
+    mm_shift = 1 if (ieee_m != 0 or ieee_e <= 1) else 0
+    mm = 4 * m2 - 1 - mm_shift
+    vm_tz = vr_tz = False
+    lrd = 0
+    if e2 >= 0:
+        q = (e2 * 78913) >> 18
+        e10 = q
+        i = -e2 + q + _F_INV_BC + _pow5bits(q) - 1
+        vr = (mv * _POW5_INV[q]) >> i
+        vp = (mp * _POW5_INV[q]) >> i
+        vm = (mm * _POW5_INV[q]) >> i
+        if q != 0 and (vp - 1) // 10 <= vm // 10:
+            l = _F_INV_BC + _pow5bits(q - 1) - 1
+            lrd = ((mv * _POW5_INV[q - 1]) >> (-e2 + q - 1 + l)) % 10
+        if q <= 9:
+            if mv % 5 == 0:
+                vr_tz = _pow5factor(mv) >= q
+            elif accept:
+                vm_tz = _pow5factor(mm) >= q
+            else:
+                vp -= _pow5factor(mp) >= q
+    else:
+        q = (-e2 * 732923) >> 20
+        e10 = q + e2
+        i = -e2 - q
+        j = q - (_pow5bits(i) - _F_BC)
+        vr = (mv * _POW5[i]) >> j
+        vp = (mp * _POW5[i]) >> j
+        vm = (mm * _POW5[i]) >> j
+        if q != 0 and (vp - 1) // 10 <= vm // 10:
+            j2 = q - 1 - (_pow5bits(i + 1) - _F_BC)
+            lrd = ((mv * _POW5[i + 1]) >> j2) % 10
+        if q <= 1:
+            vr_tz = True
+            if accept:
+                vm_tz = mm_shift == 1
+            else:
+                vp -= 1
+        elif q < 31:
+            vr_tz = (mv & ((1 << (q - 1)) - 1)) == 0
+    removed = 0
+    if vm_tz or vr_tz:
+        while vp // 10 > vm // 10:
+            vm_tz &= vm % 10 == 0
+            vr_tz &= lrd == 0
+            lrd = vr % 10
+            vr //= 10; vp //= 10; vm //= 10; removed += 1
+        if vm_tz:
+            while vm % 10 == 0:
+                vr_tz &= lrd == 0
+                lrd = vr % 10
+                vr //= 10; vp //= 10; vm //= 10; removed += 1
+        if vr_tz and lrd == 5 and vr % 2 == 0:
+            lrd = 4
+        out = vr + (1 if ((vr == vm and (not accept or not vm_tz))
+                          or lrd >= 5) else 0)
+    else:
+        while vp // 10 > vm // 10:
+            lrd = vr % 10
+            vr //= 10; vp //= 10; vm //= 10; removed += 1
+        out = vr + (1 if (vr == vm or lrd >= 5) else 0)
+    while out >= 10 and out % 10 == 0:
+        out //= 10
+        removed += 1
+    return out, e10 + removed
+
+
+def _java_format(out, exp, neg):
+    s = str(out)
+    olen = len(s)
+    exp_sci = exp + olen - 1
+    if -3 <= exp_sci < 7:
+        if exp_sci >= 0:
+            ip = s[:exp_sci + 1] + "0" * max(0, exp_sci + 1 - olen)
+            fp = s[exp_sci + 1:] or "0"
+            t = ip + "." + fp
+        else:
+            t = "0." + "0" * (-exp_sci - 1) + s
+    else:
+        mant = s[0] + "." + (s[1:] or "0")
+        t = mant + "E" + str(exp_sci)
+    return ("-" if neg else "") + t
+
+
+def _ref_tostring(v):
+    b = int(np.float32(v).view(np.uint32))
+    neg = b >> 31 == 1
+    if (b & 0x7FFFFFFF) > 0x7F800000:
+        return "NaN"
+    if (b & 0x7FFFFFFF) == 0x7F800000:
+        return "-Infinity" if neg else "Infinity"
+    if b & 0x7FFFFFFF == 0:
+        return "-0.0" if neg else "0.0"
+    out, exp = _ref_f2d(b & 0x7FFFFFFF)
+    return _java_format(out, exp, neg)
+
+
+GOLDENS = [
+    (1.0, "1.0"), (-1.0, "-1.0"), (100.0, "100.0"), (0.001, "0.001"),
+    (1e7, "1.0E7"), (9999999.0, "9999999.0"), (1e-4, "1.0E-4"),
+    (0.5, "0.5"), (2.5, "2.5"), (0.1, "0.1"),
+    (3.14159265, "3.1415927"), (12345678.0, "1.2345678E7"),
+    (123456.789, "123456.79"),
+    (3.4028235e38, "3.4028235E38"),       # Float.MAX_VALUE
+    # Ryu shortest-digit semantics (the reference lineage's
+    # ftos_converter is a Ryu port too); pre-shortest Java rendered
+    # these with more digits
+    (1.17549435e-38, "1.1754944E-38"),    # min normal
+    (1.4e-45, "1.0E-45"),                 # min subnormal
+    (0.0, "0.0"), (-0.0, "-0.0"),
+    (float("nan"), "NaN"), (float("inf"), "Infinity"),
+    (float("-inf"), "-Infinity"),
+]
+
+
+def test_float_to_string_goldens():
+    vals = np.array([v for v, _ in GOLDENS], np.float32)
+    got = cast_float_to_string(Column.from_numpy(vals, FLOAT32)).to_pylist()
+    for (v, want), g in zip(GOLDENS, got):
+        assert g == want, (v, g, want)
+
+
+def test_float_to_string_matches_scalar_ryu(rng):
+    """Vector kernel == exact scalar Ryu on random bit patterns
+    (subnormals, extremes, every exponent)."""
+    bits = rng.integers(0, 2 ** 32, 5000, dtype=np.uint64).astype(np.uint32)
+    # force coverage of every exponent incl. 0 (subnormals) and edges
+    sweep = np.array([(e << 23) | (m & ((1 << 23) - 1))
+                      for e in range(0, 255)
+                      for m in (0, 1, 0x7FFFFF, 0x400000)], np.uint32)
+    bits = np.concatenate([bits, sweep, sweep | (1 << 31)])
+    f = bits.view(np.float32)
+    keep = np.isfinite(f)
+    f = f[keep]
+    got = cast_float_to_string(
+        Column.from_numpy(f, FLOAT32)).to_pylist()
+    for i in range(len(f)):
+        want = _ref_tostring(f[i])
+        assert got[i] == want, (f[i], got[i], want)
+
+
+def test_float_to_string_roundtrip(rng):
+    """cast_string_to_float(cast_float_to_string(x)) == x bitwise."""
+    from spark_rapids_jni_tpu.ops import cast_string_to_float
+    bits = rng.integers(0, 2 ** 32, 4000, dtype=np.uint64).astype(np.uint32)
+    f = bits.view(np.float32)
+    f = f[np.isfinite(f)]
+    s = cast_float_to_string(Column.from_numpy(f, FLOAT32))
+    back, err = cast_string_to_float(s.to_arrow(), FLOAT32)
+    assert not np.asarray(err).any()
+    got = np.array(back.to_pylist(), np.float32)
+    np.testing.assert_array_equal(got.view(np.uint32),
+                                  f.view(np.uint32))
+
+
+def test_float_to_string_null_propagation():
+    col = Column.from_numpy(np.array([1.5, 2.5], np.float32), FLOAT32,
+                            valid=np.array([1, 0], bool))
+    assert cast_float_to_string(col).to_pylist() == ["1.5", None]

@@ -418,3 +418,24 @@ def test_trailing_wildcard_under_jit_degrades_punts_to_null():
         ['{"a":[1,2]}', '{"a": [ 1 , 2 ]}', '{"a":[9]}'])
     out = jax.jit(lambda c: get_json_object(c, "$.a[*]"))(col)
     assert out.to_pylist() == ["[1,2]", None, "9"]
+
+
+def test_trailing_wildcard_adversarial_battery():
+    """Malformed/edge documents where raw passthrough must NOT diverge
+    from the host walker: trailing commas, duplicate keys, literals,
+    leading zeros, bad number tokens, nested containers, escapes."""
+    from spark_rapids_jni_tpu.ops.get_json import (
+        _eval_wildcard_host, _parse_path)
+    docs = ['{"a":[1,2,]}', '{"a":[{"k":1,"k":2},3]}', '{"a":[1,2]}',
+            '{"a":["x","y"]}', '{"a":[true,1]}', '{"a":[01,2]}',
+            '{"a":[1.5,2e3]}', '{"a":[-0.5,"z"]}', '{"a":[[1],2]}',
+            '{"a":[1,,2]}', '{"a":[]}', '{"a":[7]}',
+            '{"a":["es\\\\"c",2]}', '{"a":[1e,2]}', '{"a":[.5,1]}',
+            '{"a":[5.,1]}', '{"a":[0,0.0]}', '{"a":[1E+2,3e-4]}',
+            '{"a":["",""]}', '{"a":[null]}', '{"a":[false,null,true]}',
+            '{"b":1}', '{"a":7}', 'junk', None]
+    col = Column.strings(docs)
+    got = get_json_object(col, "$.a[*]").to_pylist()
+    exp = _eval_wildcard_host(col, _parse_path("$.a[*]")).to_pylist()
+    assert got == exp, [(d, g, e) for d, g, e
+                        in zip(docs, got, exp) if g != e]

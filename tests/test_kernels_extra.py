@@ -421,3 +421,23 @@ def test_spark_bloom_sizing_matches_spark_create():
                 b"\0" * 8):
         with pytest.raises(ValueError):
             SparkBloomFilter.deserialize(bad)
+
+
+def test_cast_decimal128_to_string_device(rng, x64_both):
+    """Device fixed-point rendering == the host helper across scales,
+    signs, and the DECIMAL(38) extremes."""
+    from spark_rapids_jni_tpu.ops.decimal import (
+        cast_decimal128_to_string, decimal128_from_ints,
+        decimal128_to_strings)
+    vals = [0, 1, -1, 5, -5, 10 ** 38 - 1, -(10 ** 38 - 1)]
+    vals += [int(x) for x in rng.integers(-10 ** 18, 10 ** 18, 50)]
+    valid = [True] * (len(vals) - 1) + [False]
+    for scale in (0, 1, 2, 7, 20, 37):
+        col = decimal128_from_ints(vals, scale, valid=valid)
+        got = cast_decimal128_to_string(col).to_pylist()
+        exp = [e if v else None
+               for e, v in zip(decimal128_to_strings(col), valid)]
+        assert got == exp, scale
+    # negative scale multiplies out
+    col = decimal128_from_ints([3, -7], -2)
+    assert cast_decimal128_to_string(col).to_pylist() == ["300", "-700"]
