@@ -1,0 +1,74 @@
+// Pooled host staging arena — the RMM analogue for the host side of the
+// boundary.
+//
+// The reference's memory story is RMM: cudf allocates every device buffer
+// through a pool/arena memory resource with statistics + logging adaptors
+// (SURVEY.md §2 C12 knob RMM_LOGGING_LEVEL; the reference compiles it in at
+// /root/reference/src/main/cpp/CMakeLists.txt:62-69).  On TPU the *device*
+// allocator is XLA's BFC pool inside PJRT (not replaceable from user code —
+// the Python layer adds the statistics/lifetime tier instead, see
+// spark_rapids_jni_tpu/memory.py).  What the native layer CAN own is the
+// host staging memory that crosses the ctypes boundary: the row-blob /
+// chars buffers of the native row engine are the exact analogue of RMM's
+// pinned-host staging pool, and reusing them across calls removes the
+// page-fault + zeroing cost of a fresh numpy allocation per batch.
+//
+// Design: size-class binned freelist (power-of-two classes from 4KB),
+// 64-byte aligned blocks, O(1) alloc/free under one mutex, statistics in
+// the RMM statistics_resource_adaptor shape (current/peak/total bytes,
+// counts), and an explicit trim() (RMM pool `release()`).  Blocks above
+// 256MB bypass the freelist on free — a single giant batch must not pin
+// its high-water block for the process lifetime (RMM pools pass
+// oversized requests to the upstream allocator the same way).
+
+#pragma once
+
+#include <cstdint>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace srj {
+namespace arena {
+
+struct Stats {
+  uint64_t current_bytes = 0;    // bytes in live (handed-out) blocks
+  uint64_t peak_bytes = 0;       // high-water mark of current_bytes
+  uint64_t allocated_bytes = 0;  // cumulative bytes ever requested
+  uint64_t alloc_count = 0;      // total alloc() calls
+  uint64_t reuse_count = 0;      // alloc() calls served from the freelist
+  uint64_t outstanding = 0;      // live blocks not yet freed
+  uint64_t pooled_bytes = 0;     // bytes parked on the freelist
+};
+
+class HostArena {
+ public:
+  HostArena() = default;
+  ~HostArena();
+  HostArena(const HostArena&) = delete;
+  HostArena& operator=(const HostArena&) = delete;
+
+  // 64-byte-aligned block of at least `size` bytes (class-rounded).
+  // size 0 is served as the 1-byte class.  Throws std::bad_alloc on OOM.
+  void* alloc(uint64_t size);
+
+  // Return a block to the freelist.  Throws std::invalid_argument for a
+  // pointer this arena does not own (double free / foreign pointer).
+  void free(void* p);
+
+  // Release every freelisted block back to the OS (live blocks stay).
+  void trim();
+
+  Stats stats() const;
+
+ private:
+  static uint64_t size_class(uint64_t size);
+
+  mutable std::mutex mu_;
+  std::unordered_map<uint64_t, std::vector<void*>> free_;  // class -> blocks
+  std::unordered_map<void*, uint64_t> live_;               // ptr -> class
+  Stats st_;
+};
+
+}  // namespace arena
+}  // namespace srj

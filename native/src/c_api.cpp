@@ -10,6 +10,7 @@
 #include <string>
 #include <vector>
 
+#include "srj/host_arena.hpp"
 #include "srj/parquet_footer.hpp"
 #include "srj/row_engine.hpp"
 
@@ -226,6 +227,52 @@ int srj_rows_decode_variable(int32_t ncols, int64_t nrows,
   } catch (const std::exception& e) {
     return set_error(e);
   }
+}
+
+// ---------------------------------------------------------------------------
+// Host staging arena (the RMM pinned-pool analogue; srj/host_arena.hpp)
+// ---------------------------------------------------------------------------
+
+struct srj_arena {
+  srj::arena::HostArena impl;
+};
+
+srj_arena* srj_arena_create() { return new srj_arena(); }
+
+void srj_arena_destroy(srj_arena* a) { delete a; }
+
+// 64-byte-aligned block of >= size bytes, or null (see srj_last_error).
+void* srj_arena_alloc(srj_arena* a, uint64_t size) {
+  try {
+    return a->impl.alloc(size);
+  } catch (const std::exception& e) {
+    set_error(e);
+    return nullptr;
+  }
+}
+
+int srj_arena_free(srj_arena* a, void* p) {
+  try {
+    a->impl.free(p);
+    return 0;
+  } catch (const std::exception& e) {
+    return set_error(e);
+  }
+}
+
+void srj_arena_trim(srj_arena* a) { a->impl.trim(); }
+
+// out holds 7 values: {current, peak, allocated, alloc_count, reuse_count,
+// outstanding, pooled} (srj::arena::Stats order).
+void srj_arena_stats(const srj_arena* a, uint64_t* out) {
+  srj::arena::Stats s = a->impl.stats();
+  out[0] = s.current_bytes;
+  out[1] = s.peak_bytes;
+  out[2] = s.allocated_bytes;
+  out[3] = s.alloc_count;
+  out[4] = s.reuse_count;
+  out[5] = s.outstanding;
+  out[6] = s.pooled_bytes;
 }
 
 }  // extern "C"

@@ -1,0 +1,91 @@
+#include "srj/host_arena.hpp"
+
+#include <cstdlib>
+#include <new>
+#include <stdexcept>
+
+namespace srj {
+namespace arena {
+
+namespace {
+constexpr uint64_t kMinClass = 4096;      // smallest pooled block
+constexpr uint64_t kAlignment = 64;       // cache-line aligned staging
+// blocks above this never park on the freelist: a single giant batch
+// must not pin its high-water block for the process lifetime (RMM pools
+// pass oversized requests through to the upstream allocator the same way)
+constexpr uint64_t kMaxPooled = uint64_t{256} << 20;  // 256 MB
+}  // namespace
+
+uint64_t HostArena::size_class(uint64_t size) {
+  if (size <= kMinClass) return kMinClass;
+  // absurd requests (incl. negative int64s wrapped to uint64 across the
+  // C boundary) fail like any other OOM instead of overflowing the
+  // doubling below into an infinite loop
+  if (size > (uint64_t{1} << 62)) throw std::bad_alloc();
+  // next power of two >= size
+  uint64_t c = kMinClass;
+  while (c < size) c <<= 1;
+  return c;
+}
+
+HostArena::~HostArena() {
+  // OS reclaims live blocks with the process; freelisted blocks are ours
+  for (auto& kv : free_)
+    for (void* p : kv.second) std::free(p);
+}
+
+void* HostArena::alloc(uint64_t size) {
+  uint64_t cls = size_class(size);
+  std::lock_guard<std::mutex> lock(mu_);
+  void* p = nullptr;
+  auto it = free_.find(cls);
+  if (it != free_.end() && !it->second.empty()) {
+    p = it->second.back();
+    it->second.pop_back();
+    st_.reuse_count += 1;
+    st_.pooled_bytes -= cls;
+  } else {
+    p = std::aligned_alloc(kAlignment, cls);
+    if (p == nullptr) throw std::bad_alloc();
+  }
+  live_[p] = cls;
+  st_.alloc_count += 1;
+  st_.allocated_bytes += size;
+  st_.current_bytes += cls;
+  if (st_.current_bytes > st_.peak_bytes) st_.peak_bytes = st_.current_bytes;
+  st_.outstanding += 1;
+  return p;
+}
+
+void HostArena::free(void* p) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = live_.find(p);
+  if (it == live_.end())
+    throw std::invalid_argument("HostArena::free: unknown pointer");
+  uint64_t cls = it->second;
+  live_.erase(it);
+  st_.current_bytes -= cls;
+  st_.outstanding -= 1;
+  if (cls > kMaxPooled) {
+    std::free(p);          // oversized: straight back to the OS
+  } else {
+    free_[cls].push_back(p);
+    st_.pooled_bytes += cls;
+  }
+}
+
+void HostArena::trim() {
+  std::lock_guard<std::mutex> lock(mu_);
+  for (auto& kv : free_)
+    for (void* p : kv.second) std::free(p);
+  free_.clear();
+  st_.pooled_bytes = 0;
+}
+
+Stats HostArena::stats() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return st_;
+}
+
+}  // namespace arena
+}  // namespace srj

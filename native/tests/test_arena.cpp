@@ -1,0 +1,133 @@
+// Assert-based self-test for the host staging arena (srj/host_arena.hpp),
+// following the suite's style (native/tests/test_rows.cpp): block reuse,
+// alignment, statistics accounting, trim, double-free rejection, and a
+// multi-threaded smoke.
+
+#include <cassert>
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <thread>
+#include <vector>
+
+#include "srj/host_arena.hpp"
+
+using srj::arena::HostArena;
+using srj::arena::Stats;
+
+static void test_reuse_and_alignment() {
+  HostArena a;
+  void* p1 = a.alloc(1000);
+  assert(reinterpret_cast<uintptr_t>(p1) % 64 == 0);
+  std::memset(p1, 0xAB, 1000);
+  a.free(p1);
+  // same size class comes back as the same block
+  void* p2 = a.alloc(2000);  // still the 4KB class
+  assert(p2 == p1);
+  a.free(p2);
+  // a bigger class is a different block
+  void* p3 = a.alloc(1 << 20);
+  assert(p3 != p1);
+  assert(reinterpret_cast<uintptr_t>(p3) % 64 == 0);
+  a.free(p3);
+}
+
+static void test_stats() {
+  HostArena a;
+  Stats s0 = a.stats();
+  assert(s0.current_bytes == 0 && s0.alloc_count == 0);
+  void* p = a.alloc(5000);  // 8KB class
+  void* q = a.alloc(100);   // 4KB class
+  Stats s1 = a.stats();
+  assert(s1.current_bytes == 8192 + 4096);
+  assert(s1.peak_bytes == 8192 + 4096);
+  assert(s1.allocated_bytes == 5100);
+  assert(s1.alloc_count == 2 && s1.reuse_count == 0);
+  assert(s1.outstanding == 2 && s1.pooled_bytes == 0);
+  a.free(p);
+  Stats s2 = a.stats();
+  assert(s2.current_bytes == 4096 && s2.peak_bytes == 8192 + 4096);
+  assert(s2.outstanding == 1 && s2.pooled_bytes == 8192);
+  void* r = a.alloc(6000);  // reuses the 8KB block
+  assert(r == p);
+  Stats s3 = a.stats();
+  assert(s3.reuse_count == 1 && s3.pooled_bytes == 0);
+  a.free(r);
+  a.free(q);
+  a.trim();
+  Stats s4 = a.stats();
+  assert(s4.pooled_bytes == 0 && s4.current_bytes == 0);
+  // after trim a fresh alloc still works
+  void* t = a.alloc(64);
+  assert(t != nullptr);
+  a.free(t);
+}
+
+static void test_double_free_rejected() {
+  HostArena a;
+  void* p = a.alloc(10);
+  a.free(p);
+  bool threw = false;
+  try {
+    a.free(p);
+  } catch (const std::invalid_argument&) {
+    threw = true;
+  }
+  assert(threw);
+  int dummy = 0;
+  threw = false;
+  try {
+    a.free(&dummy);
+  } catch (const std::invalid_argument&) {
+    threw = true;
+  }
+  assert(threw);
+}
+
+static void test_oversized_bypass_and_absurd_size() {
+  HostArena a;
+  // 300MB rounds to the 512MB class, above the 256MB pooling cap: the
+  // free must return it to the OS, not park it on the freelist
+  void* p = a.alloc(uint64_t{300} << 20);
+  assert(p != nullptr);
+  a.free(p);
+  Stats s = a.stats();
+  assert(s.pooled_bytes == 0 && s.outstanding == 0 && s.current_bytes == 0);
+  // near-UINT64_MAX (e.g. a negative int64 wrapped across the C
+  // boundary) must fail cleanly instead of hanging the class doubling
+  bool threw = false;
+  try {
+    a.alloc(~uint64_t{0} - 7);
+  } catch (const std::bad_alloc&) {
+    threw = true;
+  }
+  assert(threw);
+}
+
+static void test_threaded_smoke() {
+  HostArena a;
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 8; ++t) {
+    ts.emplace_back([&a, t]() {
+      for (int i = 0; i < 200; ++i) {
+        void* p = a.alloc(static_cast<uint64_t>(1024 * (1 + (t + i) % 7)));
+        std::memset(p, t, 16);
+        a.free(p);
+      }
+    });
+  }
+  for (auto& th : ts) th.join();
+  Stats s = a.stats();
+  assert(s.alloc_count == 8 * 200);
+  assert(s.outstanding == 0);
+  assert(s.current_bytes == 0);
+}
+
+int main() {
+  test_reuse_and_alignment();
+  test_stats();
+  test_double_free_rejected();
+  test_oversized_bypass_and_absurd_size();
+  test_threaded_smoke();
+  return 0;
+}

@@ -20,6 +20,10 @@ Subpackage map (see each module's docstring for its reference citation):
   ``src/main/cpp/benchmarks/common/generate_input.hpp``).
 - ``faultinj``: fault injection at the runtime-API boundary (reference
   ``src/main/cpp/faultinj/faultinj.cu``).
+- ``memory``: the RMM analogue — pooled host staging arena (native
+  freelist, ``native/src/host_arena.cpp``) + PJRT device-buffer
+  statistics/lifetime adaptor (reference RMM knobs,
+  ``src/main/cpp/CMakeLists.txt:62-69``).
 """
 
 from spark_rapids_jni_tpu.table import (  # noqa: F401

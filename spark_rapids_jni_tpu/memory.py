@@ -1,0 +1,291 @@
+"""Memory management — the framework's RMM analogue.
+
+The reference's memory tier is RMM: every cudf device buffer flows through
+a pool/arena ``device_memory_resource`` with statistics and logging
+adaptors, configured at build time via ``RMM_LOGGING_LEVEL``
+(``/root/reference/pom.xml:81``, ``src/main/cpp/CMakeLists.txt:62-69``) and
+surfaced to Java as ``RmmAllocationMode`` pools.  On TPU the device
+allocator itself is XLA's BFC pool inside PJRT — deliberately not
+replaceable from user code — so this module provides the tiers that sit
+*around* an allocator in RMM's stack, adapted to the PJRT buffer model:
+
+- :class:`HostStagingArena` — ctypes front-end of the native size-class
+  pooled host arena (``native/src/host_arena.cpp``), the pinned-staging
+  pool analogue.  Numpy staging buffers for the host↔device boundary come
+  from a freelist instead of fresh ``np.zeros`` pages; blocks return to
+  the pool when the array is garbage-collected (or explicitly).
+- :class:`DeviceBufferTracker` — the ``statistics_resource_adaptor`` /
+  ``tracking_resource_adaptor`` analogue for PJRT buffers: registers
+  ``jax.Array`` s, accounts live/peak bytes per device, logs events at an
+  ``RMM_LOGGING_LEVEL``-style threshold (``SRJ_MEMORY_LOG_LEVEL``), and
+  frees device memory eagerly via ``jax.Array.delete()`` (the
+  ``device_buffer.release()`` analogue — dropping the *Python* reference
+  alone leaves HBM pinned until GC runs).
+- :func:`device_memory_stats` — the PJRT allocator's own counters
+  (``bytes_in_use``, ``peak_bytes_in_use``, …) when the backend exposes
+  them (TPU does; the CPU test backend returns {}).
+
+Spill policy stays above this layer (Spark's plugin owns spilling in the
+reference); :meth:`DeviceBufferTracker.spill` gives it the mechanism.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import threading
+import weakref
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = [
+    "HostStagingArena", "DeviceBufferTracker", "default_arena",
+    "device_memory_stats", "log_level",
+]
+
+logger = logging.getLogger("spark_rapids_jni_tpu.memory")
+
+# RMM_LOGGING_LEVEL values: TRACE/DEBUG/INFO/WARN/ERROR/CRITICAL/OFF.
+_LEVELS = {
+    "TRACE": logging.DEBUG - 5, "DEBUG": logging.DEBUG,
+    "INFO": logging.INFO, "WARN": logging.WARNING,
+    "ERROR": logging.ERROR, "CRITICAL": logging.CRITICAL,
+    "OFF": logging.CRITICAL + 10,
+}
+
+
+def log_level() -> int:
+    """Configured memory-event threshold from ``SRJ_MEMORY_LOG_LEVEL``
+    (default OFF, like the reference's default RMM_LOGGING_LEVEL)."""
+    return _LEVELS.get(os.environ.get("SRJ_MEMORY_LOG_LEVEL", "OFF").upper(),
+                       _LEVELS["OFF"])
+
+
+def _log_event(msg: str, *args) -> None:
+    """Emit an allocation-trace event (DEBUG severity, like RMM's
+    per-alloc logging): fires only when the configured threshold admits
+    DEBUG records — i.e. SRJ_MEMORY_LOG_LEVEL is TRACE or DEBUG.  The
+    default OFF threshold silences everything."""
+    if log_level() <= logging.DEBUG:
+        logger.debug(msg, *args)
+
+
+_ARENA_CONFIGURED = False
+
+
+def _arena_lib():
+    """The native library with arena symbols configured, or None."""
+    global _ARENA_CONFIGURED
+    from spark_rapids_jni_tpu.parquet import native as _loader
+    lib = _loader.load()
+    if lib is None:
+        return None
+    if not _ARENA_CONFIGURED:
+        if not hasattr(lib, "srj_arena_create"):   # stale prebuilt .so
+            return None
+        lib.srj_arena_create.restype = ctypes.c_void_p
+        lib.srj_arena_create.argtypes = []
+        lib.srj_arena_destroy.argtypes = [ctypes.c_void_p]
+        lib.srj_arena_alloc.restype = ctypes.c_void_p
+        lib.srj_arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.srj_arena_free.restype = ctypes.c_int
+        lib.srj_arena_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.srj_arena_trim.argtypes = [ctypes.c_void_p]
+        lib.srj_arena_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+        _ARENA_CONFIGURED = True
+    return lib
+
+
+_STAT_FIELDS = ("current_bytes", "peak_bytes", "allocated_bytes",
+                "alloc_count", "reuse_count", "outstanding", "pooled_bytes")
+
+
+class HostStagingArena:
+    """Pooled host staging memory over the native size-class arena.
+
+    ``empty(n, dtype)`` returns a numpy array whose storage comes from the
+    pool; when the last reference to the array (or a view of it) dies, the
+    block returns to the freelist.  Falls back to plain numpy when the
+    native library is unavailable (stats then report zeros and
+    ``native`` is False).
+    """
+
+    def __init__(self):
+        lib = _arena_lib()
+        self._lib = lib
+        self._handle = lib.srj_arena_create() if lib is not None else None
+        if self._handle is not None:
+            # destroy the native arena when this wrapper dies; finalizers
+            # on handed-out arrays hold a ref to self, so every block is
+            # already back (or leaked with the process) by then
+            self._fin = weakref.finalize(
+                self, lib.srj_arena_destroy, self._handle)
+
+    @property
+    def native(self) -> bool:
+        return self._handle is not None
+
+    def empty(self, n: int, dtype) -> np.ndarray:
+        """Uninitialized [n] array of ``dtype`` backed by the pool."""
+        dt = np.dtype(dtype)
+        nbytes = int(n) * dt.itemsize
+        if self._handle is None:
+            return np.empty(int(n), dt)
+        ptr = self._lib.srj_arena_alloc(self._handle, max(nbytes, 1))
+        if not ptr:
+            raise MemoryError("host arena allocation failed")
+        # size the ctypes view to the arena's power-of-two size class:
+        # CPython interns (c_uint8 * n) types permanently per distinct n,
+        # so per-exact-size types would accumulate without bound across
+        # varying batch sizes; classes keep the set ~20 types total
+        cls = 4096
+        while cls < nbytes:
+            cls <<= 1
+        buf = (ctypes.c_uint8 * cls).from_address(ptr)
+        arr = np.frombuffer(buf, dtype=np.uint8, count=max(nbytes, 1))
+        # the finalizer fires when the LAST array referencing this block
+        # dies (views keep their base alive), returning it to the pool
+        weakref.finalize(arr, self._release, ptr)
+        arr = arr[:nbytes].view(dt)
+        _log_event("arena alloc %d bytes @0x%x", nbytes, ptr)
+        return arr
+
+    def zeros(self, n: int, dtype) -> np.ndarray:
+        a = self.empty(n, dtype)
+        a[...] = 0
+        return a
+
+    def _release(self, ptr: int) -> None:
+        rc = self._lib.srj_arena_free(self._handle, ptr)
+        if rc != 0:   # pragma: no cover - double free is a program bug
+            logger.error("arena free failed: %s",
+                         self._lib.srj_last_error().decode())
+
+    def trim(self) -> None:
+        """Release every pooled (free) block back to the OS."""
+        if self._handle is not None:
+            self._lib.srj_arena_trim(self._handle)
+
+    def stats(self) -> Dict[str, int]:
+        if self._handle is None:
+            return {k: 0 for k in _STAT_FIELDS}
+        out = (ctypes.c_uint64 * 7)()
+        self._lib.srj_arena_stats(self._handle, out)
+        return dict(zip(_STAT_FIELDS, (int(v) for v in out)))
+
+
+_default_arena: Optional[HostStagingArena] = None
+_default_lock = threading.Lock()
+
+
+def default_arena() -> HostStagingArena:
+    """Process-wide staging arena (the ``rmm::mr::get_current_device_
+    resource()`` analogue for host staging)."""
+    global _default_arena
+    with _default_lock:
+        if _default_arena is None:
+            _default_arena = HostStagingArena()
+        return _default_arena
+
+
+def device_memory_stats(device=None) -> Dict[str, int]:
+    """The PJRT allocator's own counters for ``device`` (default: first
+    addressable device): ``bytes_in_use``, ``peak_bytes_in_use``,
+    ``bytes_limit``, … as exposed by the backend.  CPU returns {}."""
+    import jax
+    if device is None:
+        device = jax.local_devices()[0]
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        stats = None
+    return dict(stats) if stats else {}
+
+
+class DeviceBufferTracker:
+    """Statistics + lifetime adaptor over PJRT device buffers.
+
+    ``track(arr, tag)`` registers a ``jax.Array``; accounting drops
+    automatically when the array is garbage-collected, or immediately —
+    with the HBM actually released — via ``release(arr)`` /
+    ``release_all()``, which call ``jax.Array.delete()``.  ``spill(arr)``
+    pulls a buffer to host memory and deletes the device copy, returning
+    the numpy image (the mechanism under a Spark-plugin-style spill
+    policy).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._live: Dict[int, tuple] = {}   # id -> (weakref, nbytes, tag)
+        self.current_bytes = 0
+        self.peak_bytes = 0
+        self.total_tracked = 0
+
+    def track(self, arr, tag: str = ""):
+        nbytes = int(arr.nbytes)
+        key = id(arr)
+
+        def _gone(_ref, self=self, key=key, nbytes=nbytes):
+            with self._lock:
+                if self._live.pop(key, None) is not None:
+                    self.current_bytes -= nbytes
+
+        ref = weakref.ref(arr, _gone)
+        with self._lock:
+            if key in self._live:      # double-track: keep one entry so
+                return arr             # bytes add and subtract once
+            self._live[key] = (ref, nbytes, tag)
+            self.current_bytes += nbytes
+            self.total_tracked += 1
+            if self.current_bytes > self.peak_bytes:
+                self.peak_bytes = self.current_bytes
+        _log_event("track %s: %d bytes (live %d)",
+                   tag or "<buffer>", nbytes, self.current_bytes)
+        return arr
+
+    def release(self, arr) -> None:
+        """Delete the device buffer NOW (``jax.Array.delete``) and drop
+        its accounting; safe on untracked or already-deleted arrays."""
+        with self._lock:
+            ent = self._live.pop(id(arr), None)
+            if ent is not None:
+                self.current_bytes -= ent[1]
+        try:
+            arr.delete()
+        except Exception:
+            pass
+
+    def release_all(self) -> int:
+        """Delete every live tracked buffer; returns bytes released."""
+        with self._lock:
+            entries = list(self._live.values())
+            self._live.clear()
+            released = self.current_bytes
+            self.current_bytes = 0
+        for ref, _nbytes, _tag in entries:
+            arr = ref()
+            if arr is not None:
+                try:
+                    arr.delete()
+                except Exception:
+                    pass
+        return released
+
+    def spill(self, arr) -> np.ndarray:
+        """Copy ``arr`` to host, delete the device buffer, return the
+        numpy image (un-spill by ``jax.device_put`` of the image)."""
+        host = np.asarray(arr)
+        self.release(arr)
+        return host
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "current_bytes": self.current_bytes,
+                "peak_bytes": self.peak_bytes,
+                "live_buffers": len(self._live),
+                "total_tracked": self.total_tracked,
+            }

@@ -831,6 +831,37 @@ def join_inner_table(build, build_key: int, build_payload: int,
             valid & bpvs[bidx], valid, total, overflow)
 
 
+def _exchange_with_validity(table: Table, key_idx: int, num_parts: int,
+                            capacity: int, axis_name: str):
+    """Hash-exchange a Table's int32 columns across the mesh with their
+    validity riding the payload as a packed flag word (one bit per
+    column).  Partition ids hash the RAW key data (the Spark int hash
+    contract; null keys land somewhere, then never join/group by their
+    flag).  Returns (received columns as a Table, their validity as bool
+    arrays, slot_valid, overflow); the bool masks — already ANDed with
+    slot liveness — are the same values packed into the Table's columns,
+    returned unpacked so callers avoid a pack/unpack roundtrip in the
+    hot step."""
+    from spark_rapids_jni_tpu.parallel.shuffle import bucket_exchange
+    from spark_rapids_jni_tpu.table import INT32, pack_bools
+    cols = table.columns
+    key = cols[key_idx]
+    pids = pmod(murmur3_hash([Column(INT32, key.data)]), num_parts)
+    flags = cols[0].valid_bools().astype(jnp.int32)
+    for j, c in enumerate(cols[1:], start=1):
+        flags = flags | (c.valid_bools().astype(jnp.int32) << j)
+    payload = jnp.stack([c.data for c in cols] + [flags], axis=1)
+    exchange = bucket_exchange(num_parts, capacity, axis_name)
+    recv, slot_valid, _, overflow = exchange(payload, pids)
+    r_flags = recv[:, len(cols)]
+    valids = [slot_valid & ((r_flags & (1 << j)) != 0)
+              for j in range(len(cols))]
+    out = Table(tuple(
+        Column(INT32, recv[:, j], pack_bools(v))
+        for j, v in enumerate(valids)))
+    return out, valids, slot_valid, overflow
+
+
 def distributed_q72_table_step(mesh, axis_name="data",
                                capacity_factor: float = 8.0,
                                join_expansion: int = 4,
@@ -850,37 +881,27 @@ def distributed_q72_table_step(mesh, axis_name="data",
     are not true) and null inventory payloads drop the same way.
     """
     from jax.sharding import PartitionSpec as P
-    from spark_rapids_jni_tpu.parallel.shuffle import bucket_exchange
     from spark_rapids_jni_tpu.table import INT32, pack_bools
     num_parts = mesh.shape[axis_name]
 
     def step(tbl, build):
-        item, week, qty = tbl.columns[0], tbl.columns[1], tbl.columns[2]
-        n_local = item.num_rows
+        n_local = tbl.num_rows
         capacity = max(8, int(capacity_factor * n_local / num_parts))
-        pids = pmod(murmur3_hash([Column(INT32, item.data)]), num_parts)
-        flags = item.valid_bools().astype(jnp.int32) \
-            | (week.valid_bools().astype(jnp.int32) << 1) \
-            | (qty.valid_bools().astype(jnp.int32) << 2)
-        payload = jnp.stack([item.data, week.data, qty.data, flags],
-                            axis=1)
-        exchange = bucket_exchange(num_parts, capacity, axis_name)
-        recv, slot_valid, _, x_overflow = exchange(payload, pids)
-        r_item, r_week, r_qty, r_flags = (recv[:, j] for j in range(4))
-        iv = slot_valid & ((r_flags & 1) != 0)
-        wv = slot_valid & ((r_flags & 2) != 0)
-        qv = slot_valid & ((r_flags & 4) != 0)
+        shuffled, valids, _slot_valid, x_overflow = _exchange_with_validity(
+            tbl, 0, num_parts, capacity, axis_name)
+        r_item, r_week, r_qty = shuffled.columns
+        iv, wv, qv = valids            # already ANDed with slot liveness
 
-        probe = Table((Column(INT32, r_item, pack_bools(iv)),))
-        join_cap = recv.shape[0] * join_expansion
+        probe = Table((r_item,))
+        join_cap = r_item.num_rows * join_expansion
         pidx, inv_q, inv_valid, jvalid, _, j_overflow = join_inner_table(
             build, 0, 1, probe, 0, join_cap)
-        live = jvalid & slot_valid[pidx] & qv[pidx] & inv_valid \
-            & (inv_q < r_qty[pidx])
+        live = jvalid & qv[pidx] & inv_valid \
+            & (inv_q < r_qty.data[pidx])
         joined = Table((
-            Column(INT32, r_item[pidx], pack_bools(iv[pidx])),
-            Column(INT32, r_week[pidx], pack_bools(wv[pidx])),
-            Column(INT32, r_qty[pidx], pack_bools(qv[pidx])),
+            Column(INT32, r_item.data[pidx], pack_bools(iv[pidx])),
+            Column(INT32, r_week.data[pidx], pack_bools(wv[pidx])),
+            Column(INT32, r_qty.data[pidx], pack_bools(qv[pidx])),
         ))
         res, have, num_groups = hash_aggregate_table(
             joined, key_idxs=[0, 1],
@@ -900,5 +921,56 @@ def distributed_q72_table_step(mesh, axis_name="data",
     in_build = Table(tuple(Column(_I32, P(), P()) for _ in range(2)))
     return shard_map(step, mesh=mesh,
                      in_specs=(in_probe, in_build),
+                     out_specs=(out_tree, spec, spec, spec),
+                     check_vma=False)
+
+
+def distributed_q95_table_step(mesh, axis_name="data",
+                               capacity_factor: float = 8.0,
+                               max_groups: int = MAX_GROUPS):
+    """The q95 shape over TABLES: web_sales-like (order, ship_date, net)
+    columns WITH validity hash-exchange by order key, left-semi against a
+    replicated returned-orders Table (null keys never match on either
+    side, :func:`join_semi_mask_table`), then group by ship_date with
+    :func:`hash_aggregate_table` measures COUNT(order) / SUM(net) /
+    MIN(net) / MAX(net) — the null-aware twin of
+    :func:`distributed_q95_step`.
+
+    Takes (probe_table, returned_table) — probe row-sharded, returned
+    replicated single-column; every column must CARRY a validity array
+    (shard_map specs are structural; pass all-ones masks for non-null
+    columns).  Returns (result_table, have, num_groups, overflow) per
+    device; ``result_table`` columns are (ship_date, count, sum, min,
+    max).  Null ship dates form a null-key group whose key column is
+    null; null nets drop from SUM/MIN/MAX but still COUNT (the order key
+    is non-null by the semi join).
+    """
+    from jax.sharding import PartitionSpec as P
+    from spark_rapids_jni_tpu.table import INT32
+    num_parts = mesh.shape[axis_name]
+
+    def step(tbl, returned):
+        n_local = tbl.num_rows
+        capacity = max(8, int(capacity_factor * n_local / num_parts))
+        shipped, _valids, _slot_valid, x_overflow = _exchange_with_validity(
+            tbl, 0, num_parts, capacity, axis_name)
+        # semi mask requires a valid order key, which already carries
+        # slot liveness from the exchange helper
+        live = join_semi_mask_table(returned, 0, shipped, 0)
+        res, have, num_groups = hash_aggregate_table(
+            shipped, key_idxs=[1],
+            measures=[(0, "count"), (2, "sum"), (2, "min"), (2, "max")],
+            max_groups=max_groups, mask=live)
+        overflow = x_overflow | (num_groups > max_groups)
+        return res, have, num_groups[None], overflow[None]
+
+    from jax import shard_map
+    spec = P(axis_name)
+    # result table: ship_date key + COUNT + SUM + MIN + MAX
+    out_tree = Table(tuple(Column(INT32, spec, spec) for _ in range(5)))
+    in_probe = Table(tuple(Column(INT32, spec, spec) for _ in range(3)))
+    in_returned = Table((Column(INT32, P(), P()),))
+    return shard_map(step, mesh=mesh,
+                     in_specs=(in_probe, in_returned),
                      out_specs=(out_tree, spec, spec, spec),
                      check_vma=False)

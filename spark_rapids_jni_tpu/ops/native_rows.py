@@ -67,6 +67,22 @@ def native_available() -> bool:
     return _lib() is not None
 
 
+def _staging_zeros(n: int, dtype) -> np.ndarray:
+    """Zeroed staging buffer from the pooled host arena (the RMM
+    pinned-staging analogue, ``memory.HostStagingArena``): blob-sized
+    allocations reuse freelisted blocks across calls instead of paying
+    fresh page faults per batch."""
+    from spark_rapids_jni_tpu.memory import default_arena
+    return default_arena().zeros(n, dtype)
+
+
+def _staging_empty(n: int, dtype) -> np.ndarray:
+    """Uninitialized pooled staging buffer — for outputs the native call
+    fully overwrites."""
+    from spark_rapids_jni_tpu.memory import default_arena
+    return default_arena().empty(n, dtype)
+
+
 def _schema_arrays(dtypes: Sequence[DType]):
     itemsizes = np.array(
         [8 if dt.is_string else dt.itemsize for dt in dtypes], np.int32)
@@ -163,7 +179,7 @@ def encode_fixed_native(columns: Sequence[np.ndarray],
             v = np.ascontiguousarray(v, dtype=np.uint8)
             keep.append(v)
             val_c[i] = _u8p(v)
-    out = np.zeros(nrows * layout.fixed_row_size, np.uint8)
+    out = _staging_zeros(nrows * layout.fixed_row_size, np.uint8)
     rc = lib.srj_rows_encode_fixed(n, nrows, _i32p(itemsizes),
                                    _u8p(is_string), cols_c, val_c, _u8p(out))
     if rc != 0:
@@ -235,7 +251,7 @@ def encode_variable_native(columns: Sequence[Optional[np.ndarray]],
         ch = np.ascontiguousarray(ch, dtype=np.uint8)
         keep.append(ch)
         chars_c[s] = _u8p(ch)
-    out = np.zeros(int(total), np.uint8)
+    out = _staging_zeros(int(total), np.uint8)
     rc = lib.srj_rows_encode_variable(n, nrows, _i32p(itemsizes),
                                       _u8p(is_string), cols_c, val_c,
                                       soff_c, chars_c, _i64p(row_offsets),
@@ -287,7 +303,9 @@ def decode_variable_native(blob: np.ndarray, row_offsets: np.ndarray,
                                       soff_c, None)
     if rc != 0:
         raise ValueError(_loader.last_error(lib))
-    chars = [np.zeros(int(o[-1]), np.uint8) for o in soffs]
+    # chars are fully overwritten by the decode pass: no zeroing needed
+    # (unlike encode blobs, whose inter-field padding must be zero)
+    chars = [_staging_empty(int(o[-1]), np.uint8) for o in soffs]
     if nstr:
         chars_c = (u8p_t * nstr)(*[_u8p(ch) for ch in chars])
         rc = lib.srj_rows_decode_variable(n, nrows, _i32p(itemsizes),

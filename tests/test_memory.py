@@ -1,0 +1,194 @@
+"""Memory subsystem (the RMM analogue, ``spark_rapids_jni_tpu/memory.py``):
+pooled host staging arena over the native freelist and the PJRT
+device-buffer statistics/lifetime adaptor."""
+
+import gc
+import logging
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import memory
+from spark_rapids_jni_tpu.ops import native_rows
+
+
+needs_native = pytest.mark.skipif(not native_rows.native_available(),
+                                  reason="native library unavailable")
+
+
+@needs_native
+def test_arena_block_reuse_and_stats():
+    a = memory.HostStagingArena()
+    assert a.native
+    x = a.zeros(1000, np.uint8)
+    assert x.shape == (1000,) and not x.any()
+    x[:] = 7
+    addr1 = x.__array_interface__["data"][0]
+    s1 = a.stats()
+    assert s1["outstanding"] == 1 and s1["alloc_count"] == 1
+    assert s1["current_bytes"] == 4096      # min size class
+    del x
+    gc.collect()
+    s2 = a.stats()
+    assert s2["outstanding"] == 0 and s2["pooled_bytes"] == 4096
+    # the same block comes back, zeroed again despite the 7s we wrote
+    y = a.zeros(500, np.uint8)
+    addr2 = y.__array_interface__["data"][0]
+    assert addr2 == addr1
+    assert not y.any()
+    s3 = a.stats()
+    assert s3["reuse_count"] == 1
+    del y
+    gc.collect()
+    a.trim()
+    assert a.stats()["pooled_bytes"] == 0
+
+
+@needs_native
+def test_arena_views_keep_block_alive():
+    a = memory.HostStagingArena()
+    x = a.empty(4096, np.int32)
+    x[:] = np.arange(4096, dtype=np.int32)
+    v = x[100:200]
+    del x
+    gc.collect()
+    # the view holds the block: nothing returned to the pool yet
+    assert a.stats()["outstanding"] == 1
+    assert (np.asarray(v) == np.arange(100, 200, dtype=np.int32)).all()
+    del v
+    gc.collect()
+    assert a.stats()["outstanding"] == 0
+
+
+@needs_native
+def test_arena_dtype_and_zero_size():
+    a = memory.HostStagingArena()
+    f = a.zeros(10, np.float64)
+    assert f.dtype == np.float64 and f.shape == (10,)
+    z = a.empty(0, np.int32)
+    assert z.size == 0
+    del f, z
+    gc.collect()
+    assert a.stats()["outstanding"] == 0
+
+
+@needs_native
+def test_default_arena_is_shared_and_feeds_native_rows():
+    from spark_rapids_jni_tpu.table import INT32, INT64
+    a = memory.default_arena()
+    assert a is memory.default_arena()
+    before = a.stats()["alloc_count"]
+    cols = [np.arange(64, dtype=np.int32), np.arange(64, dtype=np.int64)]
+    blob = native_rows.encode_fixed_native(cols, [None, None],
+                                           [INT32, INT64])
+    after = a.stats()["alloc_count"]
+    assert after > before            # the blob staging came from the pool
+    dec, _ = native_rows.decode_fixed_native(blob, [INT32, INT64])
+    assert (dec[0] == cols[0]).all() and (dec[1] == cols[1]).all()
+
+
+def test_arena_numpy_fallback(monkeypatch):
+    monkeypatch.setattr(memory, "_arena_lib", lambda: None)
+    a = memory.HostStagingArena()
+    assert not a.native
+    x = a.zeros(100, np.uint8)
+    assert x.shape == (100,) and not x.any()
+    assert a.stats() == {k: 0 for k in memory._STAT_FIELDS}
+    a.trim()                          # no-op, must not raise
+
+
+def test_tracker_accounting_and_release():
+    import jax.numpy as jnp
+    tr = memory.DeviceBufferTracker()
+    x = tr.track(jnp.zeros((256,), jnp.float32), tag="x")
+    y = tr.track(jnp.zeros((128,), jnp.int32), tag="y")
+    s = tr.stats()
+    assert s["live_buffers"] == 2
+    assert s["current_bytes"] == 256 * 4 + 128 * 4
+    assert s["peak_bytes"] == s["current_bytes"]
+    tr.release(x)
+    assert x.is_deleted()
+    s2 = tr.stats()
+    assert s2["live_buffers"] == 1 and s2["current_bytes"] == 128 * 4
+    assert s2["peak_bytes"] == 256 * 4 + 128 * 4   # peak survives
+    # GC-driven drop: no explicit release
+    del y
+    gc.collect()
+    assert tr.stats()["live_buffers"] == 0
+    assert tr.stats()["current_bytes"] == 0
+
+
+def test_tracker_release_all_and_spill():
+    import jax.numpy as jnp
+    tr = memory.DeviceBufferTracker()
+    a = tr.track(jnp.arange(64, dtype=jnp.int32))
+    host = tr.spill(a)
+    assert a.is_deleted()
+    assert (host == np.arange(64, dtype=np.int32)).all()
+    b = tr.track(jnp.zeros((32,), jnp.int32))
+    c = tr.track(jnp.zeros((32,), jnp.int32))
+    released = tr.release_all()
+    assert released == 2 * 32 * 4
+    assert b.is_deleted() and c.is_deleted()
+    assert tr.stats()["live_buffers"] == 0
+
+
+def test_tracker_double_track_not_inflated():
+    import jax.numpy as jnp
+    tr = memory.DeviceBufferTracker()
+    x = tr.track(jnp.zeros((16,), jnp.int32))
+    tr.track(x, tag="again")          # second registration is a no-op
+    assert tr.stats()["current_bytes"] == 16 * 4
+    del x
+    gc.collect()
+    assert tr.stats()["current_bytes"] == 0
+    assert tr.stats()["peak_bytes"] == 16 * 4
+
+
+def test_tracker_double_release_safe():
+    import jax.numpy as jnp
+    tr = memory.DeviceBufferTracker()
+    x = tr.track(jnp.zeros((16,), jnp.int32))
+    tr.release(x)
+    tr.release(x)                     # already deleted: must not raise
+    assert tr.stats()["current_bytes"] == 0
+
+
+def test_device_memory_stats_shape():
+    # CPU backends may expose no stats; the call must be total either way
+    stats = memory.device_memory_stats()
+    assert isinstance(stats, dict)
+    for v in stats.values():
+        assert isinstance(v, (int, float))
+
+
+@needs_native
+def test_arena_absurd_size_fails_not_hangs():
+    a = memory.HostStagingArena()
+    # a negative int64 byte count wrapped to uint64 across the C boundary
+    # must fail like OOM, not hang the size-class doubling
+    with pytest.raises(MemoryError):
+        a.empty(2 ** 63 + 8, np.uint8)
+    assert a.stats()["outstanding"] == 0
+
+
+def test_log_gating_default_off(monkeypatch, caplog):
+    import jax.numpy as jnp
+    monkeypatch.delenv("SRJ_MEMORY_LOG_LEVEL", raising=False)
+    tr = memory.DeviceBufferTracker()
+    with caplog.at_level(logging.DEBUG,
+                         logger="spark_rapids_jni_tpu.memory"):
+        tr.track(jnp.zeros((4,), jnp.int32))
+        assert not caplog.records          # OFF: silent even at DEBUG
+        monkeypatch.setenv("SRJ_MEMORY_LOG_LEVEL", "DEBUG")
+        tr.track(jnp.zeros((4,), jnp.int32))
+        assert any("track" in r.message for r in caplog.records)
+
+
+def test_log_level_env(monkeypatch):
+    monkeypatch.delenv("SRJ_MEMORY_LOG_LEVEL", raising=False)
+    assert memory.log_level() == memory._LEVELS["OFF"]
+    monkeypatch.setenv("SRJ_MEMORY_LOG_LEVEL", "debug")
+    assert memory.log_level() == memory._LEVELS["DEBUG"]
+    monkeypatch.setenv("SRJ_MEMORY_LOG_LEVEL", "bogus")
+    assert memory.log_level() == memory._LEVELS["OFF"]

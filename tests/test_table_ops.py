@@ -248,6 +248,72 @@ def test_distributed_q72_table_step_nulls(rng, cpu_devices):
     assert got == exp
 
 
+def test_distributed_q95_table_step_nulls(rng, cpu_devices):
+    """The Table-level q95 step: validity rides the exchange, the semi
+    join drops null order keys on both sides, null ship dates form a
+    null-key group, null nets drop from SUM/MIN/MAX but still COUNT;
+    totals match a numpy oracle computed from the nullable inputs."""
+    import jax
+    from spark_rapids_jni_tpu.parallel import make_mesh, shard_table
+    from spark_rapids_jni_tpu.models.pipeline import (
+        distributed_q95_table_step)
+    mesh = make_mesh(cpu_devices[:8])
+    n = 8 * 64
+    order = rng.integers(0, 60, n).astype(np.int32)
+    ov = rng.random(n) > 0.15
+    date = rng.integers(0, 4, n).astype(np.int32)
+    dv = rng.random(n) > 0.1
+    net = rng.integers(-40, 40, n).astype(np.int32)
+    nv = rng.random(n) > 0.2
+    ret = rng.integers(0, 60, 48).astype(np.int32)
+    rv = rng.random(48) > 0.1
+
+    t = shard_table(Table((
+        Column.from_numpy(order, INT32, valid=ov),
+        Column.from_numpy(date, INT32, valid=dv),
+        Column.from_numpy(net, INT32, valid=nv))), mesh)
+    returned = Table((Column.from_numpy(ret, INT32, valid=rv),))
+    step = jax.jit(distributed_q95_table_step(mesh))
+    res, have, ng, ovf = step(t, returned)
+    assert not np.asarray(ovf).any()
+
+    # numpy oracle over the nullable inputs
+    ret_set = {int(k) for k, v in zip(ret, rv) if v}
+    exp = {}
+    for r in range(n):
+        if not ov[r] or int(order[r]) not in ret_set:
+            continue
+        key = int(date[r]) if dv[r] else None
+        c, s, lo, hi = exp.get(key, (0, 0, None, None))
+        c += 1
+        if nv[r]:
+            v = int(net[r])
+            s += v
+            lo = v if lo is None else min(lo, v)
+            hi = v if hi is None else max(hi, v)
+        exp[key] = (c, s, lo, hi)
+
+    hv = np.asarray(have).reshape(-1)
+    gdate = res.columns[0].to_pylist()
+    counts = res.columns[1].to_pylist()
+    sums = res.columns[2].to_pylist()
+    mins = res.columns[3].to_pylist()
+    maxs = res.columns[4].to_pylist()
+    got = {}
+    for j in np.nonzero(hv)[0]:
+        key = gdate[j]
+        c, s, lo, hi = got.get(key, (0, 0, None, None))
+        c += counts[j]
+        s += sums[j] or 0
+        if mins[j] is not None:
+            lo = mins[j] if lo is None else min(lo, mins[j])
+        if maxs[j] is not None:
+            hi = maxs[j] if hi is None else max(hi, maxs[j])
+        got[key] = (c, s, lo, hi)
+    # a group whose every net is null merges as sum 0 with the oracle's 0
+    assert got == exp
+
+
 def test_grouped_survives_shuffle_roundtrip(rng, cpu_devices):
     """The plane-major backing crosses a mesh shuffle: per-device lazy
     extraction feeds the row encode, rows exchange, and the receive side
