@@ -709,7 +709,27 @@ def _to_rows_variable_padded(table: Table, layout: RowLayout,
         return _to_rows_padded_jit(table, layout, slot_starts, fe_pad,
                                    row_size, jnp.int32(start), size)
 
-    chunk = min(size_limit, 1 << 30)
+    # padded rows are uniform, so the only hard batch bound is the JCUDF
+    # int32-offset contract (<=2GB per blob).  Batch slicing costs a full
+    # relayout copy per column that XLA never fuses into the assembling
+    # concat (measured at 1M x 155+25str: two sliced 500k batches take
+    # 23-25 ms — static OR traced starts — where the unsliced 1M encode
+    # takes 11 ms), so take the whole table in one program whenever the
+    # blob fits the contract; SRJ_VAR_CHUNK caps it for HBM-tight runs
+    import os as _os
+    env = _os.environ.get("SRJ_VAR_CHUNK")
+    cap = MAX_BATCH_BYTES
+    if env is not None:
+        try:
+            cap = int(env)
+        except ValueError:
+            raise ValueError(
+                f"SRJ_VAR_CHUNK must be a positive integer, got {env!r}")
+        if cap <= 0:
+            raise ValueError(
+                f"SRJ_VAR_CHUNK must be a positive integer, got {env!r}")
+    # MAX_BATCH_BYTES stays the unconditional bound: int32 offsets
+    chunk = min(size_limit, cap, MAX_BATCH_BYTES)
     out = []
     if len(plan_fixed_batches(n, row_size, chunk)) == 1:
         offsets = jnp.arange(n + 1, dtype=jnp.int32) * row_size
