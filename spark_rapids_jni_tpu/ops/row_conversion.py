@@ -723,11 +723,11 @@ def _to_rows_variable_padded(table: Table, layout: RowLayout,
         try:
             cap = int(env)
         except ValueError:
-            raise ValueError(
-                f"SRJ_VAR_CHUNK must be a positive integer, got {env!r}")
+            cap = 0
         if cap <= 0:
             raise ValueError(
-                f"SRJ_VAR_CHUNK must be a positive integer, got {env!r}")
+                f"SRJ_VAR_CHUNK must be a positive integer, "
+                f"got {env!r}") from None
     # MAX_BATCH_BYTES stays the unconditional bound: int32 offsets
     chunk = min(size_limit, cap, MAX_BATCH_BYTES)
     out = []
