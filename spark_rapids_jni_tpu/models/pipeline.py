@@ -608,6 +608,12 @@ def hash_aggregate_table(source, key_idxs: Sequence[int],
     null keys; empty SUM/MIN/MAX/AVG show null).  ``have`` flags live
     group slots; ``num_groups`` is the uncapped distinct-key count (the
     overflow contract of :func:`hash_aggregate_multi`).
+
+    64-bit (int64 lo/hi pair) and decimal128 (4-limb) measure columns
+    aggregate exactly on device: SUM/MIN/MAX via the multi-word segment
+    kernels (:func:`_segment_sum_words` — sums wrap modulo the type
+    width, Spark's non-ANSI long overflow behavior), AVG (64-bit only)
+    as float32.
     """
     from spark_rapids_jni_tpu.table import pack_bools, INT32
     n = _source_num_rows(source)
@@ -653,9 +659,27 @@ def hash_aggregate_table(source, key_idxs: Sequence[int],
             continue
         c = _source_column(source, idx)
         if c.data.ndim == 2:
-            raise NotImplementedError(
-                "64-bit measure columns need the pair-sum kernel; "
-                "widen on the host after partial aggregation")
+            # multi-u32-word measures: [2, n] int64 lo/hi pairs and
+            # [n, 4] decimal128 limbs aggregate exactly on device via
+            # chunked 16-bit limb segment sums (_segment_sum_words)
+            if c.dtype.kind.startswith("float"):
+                raise NotImplementedError(
+                    "float64 measure columns under no-x64: the limb "
+                    "kernels are integer-exact and IEEE bit patterns do "
+                    "not add; cast to float32 or aggregate as decimal")
+            if c.dtype.itemsize == 8:
+                words = (c.data[0], c.data[1])
+            elif c.dtype.itemsize == 16:
+                words = tuple(c.data[:, j] for j in range(4))
+            else:
+                raise NotImplementedError(
+                    f"unsupported 2-D measure layout {c.data.shape}")
+            if op == "avg" and len(words) > 2:
+                raise NotImplementedError(
+                    "AVG over decimal128 needs decimal division; "
+                    "SUM + COUNT and divide with ops.decimal")
+            mcore.append((words, op, c.valid_bools()))
+            continue
         mcore.append((c.data, op, c.valid_bools()))
 
     gkeys, outs, metas, have, num_groups = _hash_aggregate_nulls(
@@ -694,6 +718,11 @@ def hash_aggregate_table(source, key_idxs: Sequence[int],
             src = _source_column(source, idx)
             dt = DType("float32", 4) if op == "avg" else src.dtype
             valid = have & meta              # null when no non-null input
+        if isinstance(out, tuple):
+            # multi-word result back to the column layout: [2, G] lo/hi
+            # pairs for 64-bit, [G, 4] limbs for decimal128
+            out = jnp.stack(out, axis=0) if len(out) == 2 \
+                else jnp.stack(out, axis=1)
         out_cols.append(Column(dt, out, pack_bools(valid)))
     return Table(tuple(out_cols)), have, num_groups
 
@@ -710,9 +739,13 @@ def _hash_aggregate_nulls(sort_keys, measures, live, max_groups: int):
         gkeys = [jnp.zeros((mg,), k.dtype) for k in sort_keys]
         outs, metas = [], []
         for v, op, _ in measures:
-            dt = jnp.float32 if op == "avg" else \
-                (jnp.int32 if op == "count" else v.dtype)
-            outs.append(jnp.zeros((mg,), dt))
+            if isinstance(v, tuple) and op != "avg":
+                outs.append(tuple(jnp.zeros((mg,), jnp.uint32)
+                                  for _ in v))
+            else:
+                dt = jnp.float32 if op == "avg" else \
+                    (jnp.int32 if op == "count" else v.dtype)
+                outs.append(jnp.zeros((mg,), dt))
             metas.append(None if op == "count"
                          else jnp.zeros((mg,), jnp.bool_))
         return (gkeys, outs, metas, jnp.zeros((mg,), jnp.bool_),
@@ -729,8 +762,12 @@ def _hash_aggregate_nulls(sort_keys, measures, live, max_groups: int):
             slots.append(("countcol", len(payloads)))
             payloads.append(vvalid.astype(jnp.int32))
             continue
-        slots.append(("value", len(payloads)))
-        payloads.append(v)
+        if isinstance(v, tuple):               # multi-word: each word rides
+            slots.append(("words", len(payloads)))
+            payloads.extend(v)
+        else:
+            slots.append(("value", len(payloads)))
+            payloads.append(v)
         if vvalid is not None:
             payloads.append(vvalid.astype(jnp.int32))
     if not payloads:   # all-COUNT(*) measure lists still need the arity
@@ -768,6 +805,42 @@ def _hash_aggregate_nulls(sort_keys, measures, live, max_groups: int):
                 num_segments=nseg)[:max_groups])
             metas.append(None)
             continue
+        if kind == "words":
+            nw = len(v)
+            wsort = spay[p0:p0 + nw]
+            mvalid = contrib if vvalid is None \
+                else contrib & (spay[p0 + nw] == 1)
+            nn = jax.ops.segment_sum(mvalid.astype(jnp.int32), seg_c,
+                                     num_segments=nseg)[:max_groups]
+            if op in ("sum", "avg"):
+                ws = _segment_sum_words(wsort, mvalid, seg_c, nseg,
+                                        max_groups)
+                if op == "avg":          # W == 2 guaranteed by the caller
+                    # float32(hi)*2^32 + float32(lo) catastrophically
+                    # cancels for small negative sums (e.g. -2 -> 0.0):
+                    # negate the two's-complement pair first, convert
+                    # the MAGNITUDE, then reapply the sign
+                    lo, hi = ws[0], ws[1]
+                    neg = (hi >> 31) == 1
+                    nlo = (~lo) + jnp.uint32(1)
+                    nhi = (~hi) + jnp.where(lo == 0, jnp.uint32(1),
+                                            jnp.uint32(0))
+                    mlo = jnp.where(neg, nlo, lo)
+                    mhi = jnp.where(neg, nhi, hi)
+                    f = mhi.astype(jnp.float32) * jnp.float32(2.0 ** 32) \
+                        + mlo.astype(jnp.float32)
+                    f = jnp.where(neg, -f, f)
+                    outs.append(f / jnp.maximum(nn, 1).astype(jnp.float32))
+                else:
+                    outs.append(tuple(
+                        jnp.where(nn > 0, w, jnp.uint32(0)) for w in ws))
+            else:                        # min / max: lexicographic cascade
+                ws = _segment_minmax_words(wsort, mvalid, seg_c, nseg,
+                                           max_groups, op)
+                outs.append(tuple(
+                    jnp.where(nn > 0, w, jnp.uint32(0)) for w in ws))
+            metas.append(nn > 0)
+            continue
         vo = spay[p0]
         mvalid = contrib if vvalid is None else contrib & (spay[p0 + 1] == 1)
         nn = jax.ops.segment_sum(mvalid.astype(jnp.int32), seg_c,
@@ -803,6 +876,93 @@ def _hash_aggregate_nulls(sort_keys, measures, live, max_groups: int):
                                    num_segments=n) > 0
     num_groups = jnp.sum(seg_live.astype(jnp.int32))
     return gkeys, outs, metas, have, num_groups
+
+
+def _segment_sum_words(words, mvalid, seg_c, nseg, max_groups):
+    """Exact per-segment sum of multi-u32-word little-endian integers
+    modulo ``2^(32*W)`` — 64-bit (lo, hi) pairs and decimal128 4-limb
+    values — WITHOUT x64: the values split into 16-bit limbs whose
+    int32 segment sums cannot overflow within a 32768-row chunk
+    (``32768 * 0xFFFF < 2^31``), and chunk partials combine with
+    explicit carry propagation (the reference inherits exact long/
+    decimal SUM from cudf's int64/int128 device accumulators;
+    ``jax.ops.segment_sum`` has no 64-bit accumulator under no-x64, so
+    the limbs ARE the accumulator).  Returns W uint32 arrays
+    [max_groups]."""
+    n = words[0].shape[0]
+    W = len(words)
+    CH = 1 << 15
+    nch = -(-n // CH)
+    chunk = jnp.arange(n, dtype=jnp.int32) // CH
+    ids = seg_c + chunk * nseg
+    parts = []
+    for w in words:
+        wu = w if w.dtype == jnp.uint32 \
+            else jax.lax.bitcast_convert_type(w, jnp.uint32)
+        wz = jnp.where(mvalid, wu, jnp.uint32(0))
+        for sh in (0, 16):
+            limb = ((wz >> sh) & jnp.uint32(0xFFFF)).astype(jnp.int32)
+            parts.append(jax.ops.segment_sum(
+                limb, ids, num_segments=nch * nseg).reshape(nch, nseg))
+    stacked = jnp.stack(parts, axis=1)           # [nch, 2W, nseg]
+
+    def add_chunk(acc, limbs):
+        out = []
+        carry = jnp.zeros((nseg,), jnp.uint32)
+        for j in range(W):
+            lo16 = limbs[2 * j].astype(jnp.uint32)
+            hi16 = limbs[2 * j + 1].astype(jnp.uint32)
+            add = lo16 + (hi16 << 16)            # wraps mod 2^32
+            c0 = (add < lo16).astype(jnp.uint32)  # wrap of the limb join
+            s1 = acc[j] + add
+            c1 = (s1 < add).astype(jnp.uint32)
+            s2 = s1 + carry
+            c2 = (s2 < carry).astype(jnp.uint32)
+            out.append(s2)
+            carry = (hi16 >> 16) + c0 + c1 + c2
+        return tuple(out), None
+
+    acc0 = tuple(jnp.zeros((nseg,), jnp.uint32) for _ in range(W))
+    acc, _ = jax.lax.scan(add_chunk, acc0, stacked)
+    return [a[:max_groups] for a in acc]
+
+
+def _segment_minmax_words(words, mvalid, seg_c, nseg, max_groups, op):
+    """Lexicographic per-segment min/max of multi-u32-word integers with
+    a SIGNED top word (int64 pairs, decimal128 limbs — both two's
+    complement).  Cascades from the top word down: level j reduces word
+    j among the rows still tied on every higher word; the tie mask
+    gathers each level's result back through the (small) group table.
+    Returns W uint32 arrays [max_groups] (garbage where a group has no
+    valid rows — callers mask on their non-empty flag)."""
+    W = len(words)
+    red = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+    tied = mvalid
+    outs = [None] * W
+    for j in reversed(range(W)):
+        w = words[j]
+        wu = w if w.dtype == jnp.uint32 \
+            else jax.lax.bitcast_convert_type(w, jnp.uint32)
+        if j == W - 1:
+            key = jax.lax.bitcast_convert_type(wu, jnp.int32)  # signed top
+        else:
+            # unsigned order in signed space: flip the sign bit
+            key = jax.lax.bitcast_convert_type(
+                wu ^ jnp.uint32(0x80000000), jnp.int32)
+        info = jnp.iinfo(jnp.int32)
+        ident = jnp.int32(info.max if op == "min" else info.min)
+        k = jnp.where(tied, key, ident)
+        m = red(k, seg_c, num_segments=nseg)
+        outs[j] = m
+        if j:
+            tied = tied & (k == m[seg_c])
+    result = []
+    for j in range(W):
+        m = jax.lax.bitcast_convert_type(outs[j][:max_groups], jnp.uint32)
+        if j != W - 1:
+            m = m ^ jnp.uint32(0x80000000)
+        result.append(m)
+    return result
 
 
 # -- null-aware join wrappers ------------------------------------------------

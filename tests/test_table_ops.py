@@ -248,6 +248,141 @@ def test_distributed_q72_table_step_nulls(rng, cpu_devices):
     assert got == exp
 
 
+def test_aggregate_int64_measures_exact(rng, x64_both):
+    """SUM/MIN/MAX over int64 measure columns run exactly on device via
+    the multi-word limb kernels: values crossing int32 range, negatives,
+    nulls, and more rows than one 32768-row limb chunk; sums compare
+    against Python-int arithmetic mod 2^64 (Spark's non-ANSI long
+    overflow wraps).  Both x64 modes: no-x64 (the TPU representation)
+    takes the pair path, x64 the native-int64 path."""
+    n = 70_001                      # 3 limb chunks, ragged tail
+    keys = rng.integers(0, 7, n).astype(np.int32)
+    kv = rng.random(n) > 0.1
+    vals = rng.integers(-(2 ** 62), 2 ** 62, n, dtype=np.int64)
+    vv = rng.random(n) > 0.2
+    t = Table((Column.from_numpy(keys, INT32, valid=kv),
+               Column.from_numpy(vals, INT64, valid=vv)))
+    res, have, ng = hash_aggregate_table(
+        t, key_idxs=[0],
+        measures=[(1, "sum"), (1, "min"), (1, "max"), (1, "count")],
+        max_groups=32)
+    hv = np.asarray(have)
+    got = {}
+    gk = res.columns[0].to_pylist()
+    sm = res.columns[1].to_pylist()
+    mn = res.columns[2].to_pylist()
+    mx = res.columns[3].to_pylist()
+    ct = res.columns[4].to_pylist()
+    for j in np.nonzero(hv)[0]:
+        got[gk[j]] = (sm[j], mn[j], mx[j], ct[j])
+
+    exp = {}
+    for r in range(n):
+        key = int(keys[r]) if kv[r] else None
+        s, lo, hi, c = exp.get(key, (0, None, None, 0))
+        if vv[r]:
+            v = int(vals[r])
+            s += v
+            lo = v if lo is None else min(lo, v)
+            hi = v if hi is None else max(hi, v)
+            c += 1
+        exp.setdefault(key, None)
+        exp[key] = (s, lo, hi, c)
+    for key, (s, lo, hi, c) in exp.items():
+        s_wrap = ((s + (1 << 63)) % (1 << 64)) - (1 << 63)
+        want = (s_wrap if c else None, lo, hi, c)
+        assert got[key] == want, (key, got[key], want)
+
+
+def test_aggregate_int64_avg_and_empty_groups(rng, x64_both):
+    """AVG(int64) as float32 — exact for small negative sums, where a
+    naive hi*2^32+lo float32 reconstruction cancels to 0.0; a group
+    whose every measure is null gets null SUM/MIN/MAX/AVG but still
+    COUNT(*) rows."""
+    keys = np.array([1, 1, 2, 2, 3], np.int32)
+    vals = np.array([10, 20, 7, -9, 999], np.int64)
+    vv = np.array([True, True, True, True, False])
+    t = Table((Column.from_numpy(keys, INT32),
+               Column.from_numpy(vals, INT64, valid=vv)))
+    res, have, ng = hash_aggregate_table(
+        t, key_idxs=[0],
+        measures=[(1, "avg"), (1, "sum"), (None, "count")], max_groups=8)
+    hv = np.asarray(have)
+    gk = res.columns[0].to_pylist()
+    av = res.columns[1].to_pylist()
+    sm = res.columns[2].to_pylist()
+    ct = res.columns[3].to_pylist()
+    out = {gk[j]: (av[j], sm[j], ct[j]) for j in np.nonzero(hv)[0]}
+    assert out[1] == (15.0, 30, 2)
+    assert out[2] == (-1.0, -2, 2)
+    assert out[3] == (None, None, 1)    # all-null measures, COUNT(*)=1
+
+
+def test_aggregate_float64_measure_refused(rng, x64_both):
+    """FLOAT64 pair columns must refuse the integer limb kernels
+    (IEEE bit patterns do not add), not silently return NaN."""
+    import jax
+    from spark_rapids_jni_tpu import FLOAT64
+    t = Table((Column.from_numpy(np.array([1, 1], np.int32), INT32),
+               Column.from_numpy(np.array([1.5, 2.5]), FLOAT64)))
+    if jax.config.jax_enable_x64:
+        # native [n] float64: the scalar path sums it fine
+        res, have, _ = hash_aggregate_table(
+            t, key_idxs=[0], measures=[(1, "sum")], max_groups=4)
+        j = int(np.nonzero(np.asarray(have))[0][0])
+        assert res.columns[1].to_pylist()[j] == 4.0
+    else:
+        with pytest.raises(NotImplementedError):
+            hash_aggregate_table(t, key_idxs=[0],
+                                 measures=[(1, "sum")], max_groups=4)
+
+
+def test_aggregate_decimal128_sum_minmax(rng):
+    """Decimal128 measures: 4-limb SUM (mod 2^128) and lexicographic
+    MIN/MAX with a signed top limb, vs Python-int arithmetic."""
+    from spark_rapids_jni_tpu.ops.decimal import (
+        decimal128_from_ints, decimal128_to_ints)
+    n = 40_000                      # 2 limb chunks
+    keys = rng.integers(0, 5, n).astype(np.int32)
+    mags = [int(x) for x in rng.integers(0, 1 << 62, n)]
+    shifts = rng.integers(0, 64, n)
+    signs = rng.integers(0, 2, n)
+    vals = [(m << int(sh)) * (1 if sg else -1)
+            for m, sh, sg in zip(mags, shifts, signs)]
+    vv = rng.random(n) > 0.15
+    dcol = decimal128_from_ints(vals, scale=2, valid=np.asarray(vv))
+    t = Table((Column.from_numpy(keys, INT32), dcol))
+    res, have, ng = hash_aggregate_table(
+        t, key_idxs=[0],
+        measures=[(1, "sum"), (1, "min"), (1, "max")], max_groups=16)
+    hv = np.asarray(have)
+    gk = res.columns[0].to_pylist()
+    sums = decimal128_to_ints(res.columns[1])
+    mins = decimal128_to_ints(res.columns[2])
+    maxs = decimal128_to_ints(res.columns[3])
+    sv = np.asarray(res.columns[1].valid_bools())
+
+    exp = {}
+    for r in range(n):
+        key = int(keys[r])
+        s, lo, hi = exp.get(key, (0, None, None))
+        if vv[r]:
+            v = vals[r]
+            s += v
+            lo = v if lo is None else min(lo, v)
+            hi = v if hi is None else max(hi, v)
+        exp[key] = (s, lo, hi)
+    for j in np.nonzero(hv)[0]:
+        key = gk[j]
+        s, lo, hi = exp[key]
+        if lo is None:
+            assert not sv[j]
+            continue
+        s_wrap = ((s + (1 << 127)) % (1 << 128)) - (1 << 127)
+        assert sums[j] == s_wrap, (key, sums[j], s_wrap)
+        assert mins[j] == lo and maxs[j] == hi, key
+
+
 def test_distributed_q95_table_step_nulls(rng, cpu_devices):
     """The Table-level q95 step: validity rides the exchange, the semi
     join drops null order keys on both sides, null ship dates form a
