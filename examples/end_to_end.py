@@ -145,7 +145,54 @@ def main():
     print(f"skew: capped width={capped.chars2d.shape[1]}B, "
           f"{len(string_tail(capped))} outlier in host tail, roundtrip OK")
 
-    # 9. operator metrics
+    # 9. Table-level q95 with Spark null semantics: validity rides the
+    # exchange, the semi join drops null order keys, and the aggregate
+    # sums an INT64 net column exactly on device (multi-word limb sums)
+    from spark_rapids_jni_tpu.models import distributed_q95_table_step
+    from spark_rapids_jni_tpu import INT64
+    from spark_rapids_jni_tpu.parallel import shard_table
+    ov = rng.random(n) > 0.1
+    tship = shard_table(Table((
+        Column.from_numpy(order, INT32, valid=ov),
+        Column.from_numpy(np.asarray(t.columns[0].data), INT32,
+                          valid=np.ones(n, bool)),
+        Column.from_numpy(net, INT32, valid=rng.random(n) > 0.2))), mesh)
+    tret = Table((Column.from_numpy(returned, INT32,
+                                    valid=np.ones(len(returned), bool)),))
+    t95res, t95have, _, t95ovf = jax.jit(
+        distributed_q95_table_step(mesh))(tship, tret)
+    assert not np.asarray(t95ovf).any()
+    print(f"q95 tables: {int(np.asarray(t95have).sum())} partial groups "
+          "with null-aware COUNT/SUM/MIN/MAX")
+
+    # 10. int64 measures aggregate exactly without x64 (uint32-pair
+    # columns through the chunked limb kernels)
+    from spark_rapids_jni_tpu.models import hash_aggregate_table
+    big = Table((Column.from_numpy(rng.integers(0, 4, 1000)
+                                   .astype(np.int32), INT32),
+                 Column.from_numpy(rng.integers(-2 ** 40, 2 ** 40, 1000)
+                                   .astype(np.int64), INT64)))
+    bres, bhave, _ = hash_aggregate_table(
+        big, key_idxs=[0], measures=[(1, "sum"), (1, "min"), (1, "max")],
+        max_groups=8)
+    print("int64 SUM/MIN/MAX groups:",
+          int(np.asarray(bhave).sum()))
+
+    # 11. the memory tier (RMM analogue): pooled host staging + device
+    # buffer accounting
+    from spark_rapids_jni_tpu import memory
+    arena = memory.default_arena()
+    tr = memory.DeviceBufferTracker()
+    blob = tr.track(rb[0].data, tag="jcudf-batch")
+    st = arena.stats()
+    print(f"memory: arena reuse {st['reuse_count']}/{st['alloc_count']} "
+          f"allocs, tracker live {tr.stats()['current_bytes']} bytes; "
+          f"spill+restore", end=" ")
+    host_img = tr.spill(blob)            # device buffer freed eagerly
+    restored = jax.device_put(host_img)
+    print("OK" if restored.shape == host_img.shape else "FAIL")
+
+    # 12. operator metrics
     snap = metrics.snapshot()
     print("metrics:", {k: v for k, v in sorted(snap.items())
                        if k.endswith(".calls") or k.endswith(".rows")})

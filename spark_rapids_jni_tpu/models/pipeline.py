@@ -1007,17 +1007,16 @@ def join_inner_table(build, build_key: int, build_payload: int,
     bp = bpc.data
     bpv = bpc.valid_bools()
     big = jnp.array(jnp.iinfo(bk.dtype).max, bk.dtype)
-    # null build rows park at the key sentinel; sorting by validity
-    # FIRST (valid rows leading) and then stably by key guarantees that
+    # null build rows park at the key sentinel; ONE variadic sort with
+    # key-with-sentinel major and invalidity minor guarantees that
     # within the sentinel key value every real row precedes every
-    # parked null row, so the count-bounded gather window [lo, lo+cnt)
-    # can only cover real rows even when a live key equals dtype max
-    order0 = jnp.argsort((~bv).astype(jnp.int32), stable=True)
-    k1 = jnp.where(bv, bk, big)[order0]
-    order = order0[jnp.argsort(k1, stable=True)]
-    bks = jnp.where(bv, bk, big)[order]
-    bps = bp[order]
-    bpvs = bpv[order]
+    # parked null row — so the count-bounded gather window [lo, lo+cnt)
+    # can only cover real rows even when a live key equals dtype max;
+    # payload + payload-validity ride as value operands
+    bks, _, bps, bpvs_i = jax.lax.sort(
+        (jnp.where(bv, bk, big), (~bv).astype(jnp.int32), bp,
+         bpv.astype(jnp.int32)), num_keys=2, is_stable=True)
+    bpvs = bpvs_i == 1
     n_real = jnp.sum(bv.astype(jnp.int32))
     lo = jnp.searchsorted(bks, pk, side="left")
     hi = jnp.minimum(jnp.searchsorted(bks, pk, side="right"), n_real)

@@ -45,9 +45,10 @@ WILDCARD = object()   # the [*] path segment
 def _parse_path(path: str):
     """``$.a[0].b`` -> [b"a", 0, b"b"]: bytes for object keys, int for
     array subscripts (``$[1].x`` and chained ``[i][j]`` work too), the
-    ``WILDCARD`` sentinel for ``[*]`` (a single TRAILING wildcard runs
-    on device — see ``_eval_wildcard_device``; nested/non-trailing
-    wildcards fan out mid-path and evaluate on the host)."""
+    ``WILDCARD`` sentinel for ``[*]`` (a single trailing wildcard runs
+    on device via ``_eval_wildcard_device``; a single MID-path wildcard
+    with a key-only suffix via ``_eval_wildcard_mid_device``; multiple
+    wildcards or subscripted suffixes evaluate on the host)."""
     import re
     if not path.startswith("$"):
         raise ValueError(f"JSON path must start with '$': {path!r}")
@@ -73,6 +74,33 @@ def _parse_path(path: str):
     if not segs:
         raise ValueError(f"empty JSON path: {path!r}")
     return segs
+
+
+def _select_lut(table_np, idx):
+    """A tiny static int table at per-row indices, as a select-sum —
+    NEVER an [n]-element gather: dynamic gathers run ~100x slower than
+    vector selects on TPU and these sit inside scan bodies."""
+    out = None
+    for l, v in enumerate(table_np):
+        term = jnp.where(idx == l, jnp.int32(int(v)), 0)
+        out = term if out is None else out + term
+    return out
+
+
+def _select_lut_bytes(bytes_np, idx, kpos):
+    """Static key-byte matrix [L, K] at per-row (level, key position),
+    same select-sum strategy as :func:`_select_lut`."""
+    L, K = bytes_np.shape
+    out = None
+    for l in range(L):
+        row = None
+        for k in range(K):
+            term = jnp.where(kpos == k,
+                             jnp.int32(int(bytes_np[l, k])), 0)
+            row = term if row is None else row + term
+        term = jnp.where(idx == l, row, 0)
+        out = term if out is None else out + term
+    return out
 
 
 def _scan_automaton(ch: jnp.ndarray, segs: Tuple,
@@ -101,28 +129,12 @@ def _scan_automaton(ch: jnp.ndarray, segs: Tuple,
         else:
             seg_bytes[i, :len(s)] = np.frombuffer(s, np.uint8)
             seg_lens[i] = len(s)
-    # per-level lookups happen via select-sums over the (tiny, static)
-    # tables, NEVER via [n]-element gathers: dynamic gathers run ~100x
-    # slower than vector selects on TPU and sit inside the scan body
-
+    # per-level lookups via the shared select-sum helpers (no gathers)
     def _lut(table_np, idx):
-        out = None
-        for l, v in enumerate(table_np):
-            term = jnp.where(idx == l, jnp.int32(int(v)), 0)
-            out = term if out is None else out + term
-        return out
+        return _select_lut(table_np, idx)
 
     def _lut_bytes(idx, kpos):
-        out = None
-        for l in range(L):
-            row = None
-            for k in range(max_key_len):
-                term = jnp.where(kpos == k,
-                                 jnp.int32(int(seg_bytes[l, k])), 0)
-                row = term if row is None else row + term
-            term = jnp.where(idx == l, row, 0)
-            out = term if out is None else out + term
-        return out
+        return _select_lut_bytes(seg_bytes, idx, kpos)
 
     i32 = jnp.int32
     z = jnp.zeros((n,), i32)
@@ -351,17 +363,27 @@ def get_json_object(col: Column, path: str,
         raise ValueError("get_json_object needs a string column")
     segs = tuple(_parse_path(path))
     n_wc = sum(1 for s in segs if s is WILDCARD)
-    if n_wc and not (n_wc == 1 and segs[-1] is WILDCARD):
-        # nested / non-trailing wildcards fan out mid-path; the
-        # single-capture scan cannot express that, so they evaluate on
-        # the host.  (The dominant Spark usage -- ONE trailing [*] over
-        # an array -- runs on device below.)
-        if any(isinstance(leaf, jax.core.Tracer)
-               for leaf in jax.tree_util.tree_leaves(col)):
-            raise ValueError(
-                "nested wildcard ([*]) JSON paths are host-evaluated: "
-                "call get_json_object eagerly, not under jit")
-        return _eval_wildcard_host(col, segs)
+    mid_wc = None
+    if n_wc:
+        wc_at = next(i for i, s in enumerate(segs) if s is WILDCARD)
+        trailing = n_wc == 1 and wc_at == len(segs) - 1
+        # a single mid-path wildcard with a key-only suffix projects a
+        # field from every element on device (_eval_wildcard_mid_device);
+        # multiple wildcards or subscripted suffixes fan out beyond the
+        # element-suffix scan and evaluate on the host
+        mid_ok = (n_wc == 1 and not trailing
+                  and all(isinstance(s, bytes)
+                          for s in segs[wc_at + 1:]))
+        if not trailing and not mid_ok:
+            if any(isinstance(leaf, jax.core.Tracer)
+                   for leaf in jax.tree_util.tree_leaves(col)):
+                raise ValueError(
+                    "nested wildcard ([*]) JSON paths are "
+                    "host-evaluated: call get_json_object eagerly, not "
+                    "under jit")
+            return _eval_wildcard_host(col, segs)
+        if not trailing:
+            mid_wc = wc_at
     if col.is_padded:
         from spark_rapids_jni_tpu.table import string_tail
         # max-length check: ONE device scalar reduce cached on the
@@ -397,6 +419,18 @@ def get_json_object(col: Column, path: str,
         W = ((int(lens.max()) if lens.size else 0) + 3) // 4 * 4
     ch = col.chars_window(W)
     mkl = max((len(s) for s in segs if isinstance(s, bytes)), default=1)
+    if mid_wc is not None:  # single mid-path [*] with key suffix
+        if W > (1 << 23):
+            # the compaction packs (position-if-kept | W)*256 + byte
+            # into int32; wider windows would wrap the sort keys
+            if any(isinstance(leaf, jax.core.Tracer)
+                   for leaf in jax.tree_util.tree_leaves(col)):
+                raise ValueError(
+                    "mid-path [*] on documents wider than 8MB is "
+                    "host-evaluated: call get_json_object eagerly")
+            return _eval_wildcard_host(col, segs)
+        return _eval_wildcard_mid_device(col, ch, segs, mid_wc, W, mkl,
+                                         path)
     if n_wc:  # single trailing [*]: the device wildcard evaluator
         return _eval_wildcard_device(col, ch, segs, W, mkl, path)
     vals, out_len, valid, needs_host = _gjo_device_jit(
@@ -818,6 +852,34 @@ def _elem_scan(vals: jnp.ndarray, out_len: jnp.ndarray):
     return count, punt, final["has_bad"] == 1
 
 
+def _root_array_span(ch, lens, W: int):
+    """Synthetic automaton result for a path whose array IS the whole
+    document ("$[*]", "$[*].k"): a full-span capture starting at the
+    first non-whitespace byte."""
+    n = ch.shape[0]
+    z = jnp.zeros((n,), jnp.int32)
+    pos = jnp.arange(W, dtype=jnp.int32)[None, :]
+    is_ws = (ch == 32) | (ch == 9) | (ch == 10) | (ch == 13)
+    first_tok = jnp.min(jnp.where(is_ws, W, pos), axis=1)
+    return dict(start=jnp.minimum(first_tok, lens.astype(jnp.int32)),
+                end=lens.astype(jnp.int32),
+                found=z + 1, capturing=z, bad=z)
+
+
+def _finish_device_result(col: Column, path: str, vals, out_len, valid,
+                          needs_host) -> Column:
+    """Shared epilogue of every device evaluator: assemble the Column,
+    degrade punts to null under an outer jit, otherwise run the exact
+    host fixup on the punted rows (one scalar readback gate)."""
+    result, needs_host = _assemble_result(vals, out_len, valid,
+                                          needs_host)
+    if needs_host is None:  # under an outer jit: punts degraded to null
+        return result
+    if bool(jnp.any(needs_host)):
+        result = _host_fixup(result, col, path, np.asarray(needs_host))
+    return result
+
+
 @functools.partial(jax.jit, static_argnums=(3, 4, 5))
 def _wildcard_device_jit(ch, validity, lens, segs, W: int, mkl: int):
     """The whole trailing-[*] device computation in ONE program (three
@@ -828,15 +890,7 @@ def _wildcard_device_jit(ch, validity, lens, segs, W: int, mkl: int):
     if parent:
         st_arr = _scan_automaton(ch, parent, mkl)
     else:
-        # path "$[*]": the whole document is the array; synthesize a
-        # full-span capture starting at the first non-whitespace byte
-        pos = jnp.arange(W, dtype=jnp.int32)[None, :]
-        is_ws = (ch == 32) | (ch == 9) | (ch == 10) | (ch == 13)
-        first_tok = jnp.min(jnp.where(is_ws, W, pos), axis=1)
-        st_arr = dict(start=jnp.minimum(first_tok,
-                                        lens.astype(jnp.int32)),
-                      end=lens.astype(jnp.int32),
-                      found=z + 1, capturing=z, bad=z)
+        st_arr = _root_array_span(ch, lens, W)
     vals_a, len_a, ok_a, _, first_a = _extract_value(ch, st_arr, W)
     count, elem_punt, has_bad = _elem_scan(vals_a, len_a)
     arr_ok = ok_a & (first_a == ord("[")) & ~has_bad
@@ -879,10 +933,323 @@ def _eval_wildcard_device(col: Column, ch: jnp.ndarray, segs, W: int,
                           mkl: int, path: str) -> Column:
     vals, out_len, valid, needs_host = _wildcard_device_jit(
         ch, col.validity, col.str_lens(), segs, W, mkl)
-    result, needs_host = _assemble_result(vals, out_len, valid,
-                                          needs_host)
-    if needs_host is None:  # under an outer jit: punts degraded to null
-        return result
-    if bool(jnp.any(needs_host)):
-        result = _host_fixup(result, col, path, np.asarray(needs_host))
-    return result
+    return _finish_device_result(col, path, vals, out_len, valid,
+                                 needs_host)
+
+
+# ---------------------------------------------------------------------------
+# Device mid-path [*] wildcard:  $.a[*].b(.c...)
+# ---------------------------------------------------------------------------
+#
+# A single NON-trailing wildcard whose suffix is object keys projects a
+# field from every element of the parent array.  Spark collects the
+# matches: 0 -> null, 1 -> the bare value (strings unquoted), 2+ -> a
+# JSON array of the raw match texts (strings quoted).  The device plan:
+#
+# 1. locate the parent array span with the standard automaton and
+#    left-justify it (shared with the trailing-[*] path);
+# 2. _suffix_scan: one lax.scan over the span runs the key-match
+#    machinery PER ELEMENT (the frontier state resets at every
+#    top-level comma), emitting per-char KEEP flags for capture bytes
+#    and substituted ',' separators after each capture — first-match-
+#    commit within an element, elements without the suffix skipped,
+#    exactly _walk_path's fan-out on well-formed input;
+# 3. compact the kept chars with ONE per-row lane sort of
+#    (position-if-kept | W) packed over the char byte — the static-shape
+#    answer to ragged concatenation (a gather would be ~100x slower);
+# 4. post-shape: 2+ captures turn the trailing separator into the
+#    closing ']' (the leading '[' is the source array's own bracket);
+#    a single capture drops bracket/separator/quotes with one more
+#    barrel shift.
+#
+# Rows the raw-passthrough rendering cannot certify Spark-exact punt to
+# the exact host path: escapes anywhere in a capture, container-valued
+# matches, and the certified structural anomalies — unclosed array or
+# string, bracket-kind mismatch at the array level, leading/double/
+# trailing commas and missing-comma junk BETWEEN elements (the depth-1
+# phase guard), bytes >= 0x80 outside strings, captures cut by the
+# window.  Structure INSIDE an element beyond the matched path (e.g. a
+# missing comma between two unmatched pairs of one element object) is
+# not validated: the scanner commits to the first match streaming-style
+# and may answer where a whole-document parser would null — the same
+# prefix-tolerance contract the plain-key device path documents.
+
+
+def _suffix_scan(arr: jnp.ndarray, arr_len: jnp.ndarray, suffix: Tuple,
+                 mkl: int):
+    """Scan left-justified array text ``arr [n, W]`` (``arr[:, 0] ==
+    '['``) matching the key-only ``suffix`` inside every top-level
+    element.  Returns (keep [n, W], comma_sub [n, W], captures [n],
+    first_cap_is_str [n], punt [n])."""
+    n, W = arr.shape
+    S = len(suffix)
+    seg_bytes = np.zeros((S, mkl), np.uint8)
+    seg_lens = np.zeros((S,), np.int32)
+    for i, s in enumerate(suffix):
+        seg_bytes[i, :len(s)] = np.frombuffer(s, np.uint8)
+        seg_lens[i] = len(s)
+    i32 = jnp.int32
+    z = jnp.zeros((n,), i32)
+
+    def _lut(table_np, idx):
+        return _select_lut(table_np, idx)
+
+    def _lut_bytes(idx, kpos):
+        return _select_lut_bytes(seg_bytes, idx, kpos)
+
+    carry0 = dict(
+        in_str=z, esc=z, depth=z + 1,     # pos 0 ('[') is skipped
+        rel=z,                            # suffix segments matched
+        in_key=z, key_pos=z, key_ok=z + 1, await_colon=z, pending=z,
+        expect_key=z, capturing=z, cap_is_str=z, elem_done=z,
+        count=z, first_str=z, punt=z, emit_comma=z,
+        phase=z, had_tok=z,               # top-level structure guard
+        closed=z,
+    )
+
+    def step(c, pos_and_char):
+        pos, x = pos_and_char
+        xs = x.astype(i32)
+        # once the array's own ']' has closed it, every later char is
+        # outside the value (a root-array span covers the whole string;
+        # trailing text must not fabricate matches)
+        act = (pos > 0) & (pos < arr_len) & (c["closed"] == 0)
+        is_q = xs == ord('"')
+        is_bs = xs == ord("\\")
+        is_ws = (xs == 32) | (xs == 9) | (xs == 10) | (xs == 13)
+        is_open = (xs == ord("{")) | (xs == ord("["))
+        is_close = (xs == ord("}")) | (xs == ord("]"))
+        is_colon = xs == ord(":")
+        is_comma = xs == ord(",")
+
+        in_str, esc = c["in_str"], c["esc"]
+        eff_q = is_q & (esc == 0)
+        new_in_str = jnp.where(act & eff_q, 1 - in_str, in_str)
+        new_esc = (act & (in_str == 1) & (esc == 0) & is_bs).astype(i32)
+        outside = (in_str == 0) & act
+
+        depth = c["depth"]
+        new_depth = depth + jnp.where(outside & is_open, 1, 0) \
+            - jnp.where(outside & is_close, 1, 0)
+        # only the matching ']' closes the array; a mismatched '}' that
+        # zeroes the depth leaves closed unset and the row punts
+        closed = c["closed"] | (outside & (xs == ord("]"))
+                                & (new_depth == 0)).astype(i32)
+
+        rel = c["rel"]
+        live = (c["elem_done"] == 0) & (c["punt"] == 0)
+        frontier = rel + 2                # element object keys live here
+
+        # --- key scanning (cloned from _scan_automaton, element-local)
+        key_opening = outside & eff_q & (c["expect_key"] == 1) \
+            & (c["in_key"] == 0) & (c["await_colon"] == 0) \
+            & (c["capturing"] == 0) & live & (depth == frontier)
+        in_key, key_pos, key_ok = c["in_key"], c["key_pos"], c["key_ok"]
+        key_char = act & (in_key == 1) & (in_str == 1) & ~eff_q
+        seg_idx = jnp.clip(rel, 0, S - 1)
+        expect = _lut_bytes(seg_idx, jnp.clip(key_pos, 0, mkl - 1))
+        this_len = _lut(seg_lens, seg_idx)
+        ok_char = key_char & (key_pos < this_len) & (xs == expect) \
+            & (esc == 0)
+        key_ok = jnp.where(key_char,
+                           jnp.where(ok_char, key_ok, 0), key_ok)
+        key_ok = jnp.where(key_char & (esc == 1), 0, key_ok)
+        key_pos = jnp.where(key_char, key_pos + 1, key_pos)
+        key_closing = act & (in_key == 1) & eff_q & (in_str == 1)
+        full_match = key_closing & (key_ok == 1) & (key_pos == this_len)
+        await_colon = jnp.where(key_closing,
+                                jnp.where(full_match, 1, 0),
+                                c["await_colon"])
+        in_key = jnp.where(key_opening, 1,
+                           jnp.where(key_closing, 0, in_key))
+        key_pos = jnp.where(key_opening, 0, key_pos)
+        key_ok = jnp.where(key_opening, 1, key_ok)
+
+        # --- value entry after a matched key's colon
+        saw_colon = (c["await_colon"] == 1) & outside & is_colon
+        await_colon = jnp.where(saw_colon, 0, await_colon)
+        pending = c["pending"] | jnp.where(saw_colon, 1, 0)
+        value_starts = (pending == 1) & act & ~is_ws & ~saw_colon & live
+
+        is_last = rel == (S - 1)
+        descend = value_starts & ~is_last & (xs == ord("{"))
+        deadend = value_starts & ~is_last & (xs != ord("{"))
+        start_cap = value_starts & is_last & (c["capturing"] == 0)
+        cap_container = start_cap & is_open
+        start_str = start_cap & eff_q
+        rel = rel + jnp.where(descend, 1, 0)
+        pending = jnp.where(value_starts | deadend, 0, pending)
+
+        # a committed sub-object closing without the match exhausts the
+        # element (first-match-commit; same rule as the main automaton)
+        exhausted = outside & is_close & (c["capturing"] == 0) \
+            & (c["rel"] > 0) & (new_depth <= c["rel"] + 1) & live
+
+        # --- capture progress
+        capturing = jnp.where(start_cap & ~cap_container, 1,
+                              c["capturing"])
+        cap_is_str = jnp.where(start_cap, start_str.astype(i32),
+                               c["cap_is_str"])
+        str_end = act & (c["capturing"] == 1) & (c["cap_is_str"] == 1) \
+            & eff_q & (in_str == 1)
+        scalar_end = (c["capturing"] == 1) & (c["cap_is_str"] == 0) \
+            & outside & ((is_comma & (depth == frontier)) | is_close)
+        ends = str_end | scalar_end
+        capturing = jnp.where(ends, 0, capturing)
+        count = c["count"] + jnp.where(ends, 1, 0)
+        first_str = jnp.where(ends & (c["count"] == 0),
+                              c["cap_is_str"], c["first_str"])
+
+        # --- keep flags
+        keep = (start_cap & ~cap_container) \
+            | ((c["capturing"] == 1) & act
+               & ((c["cap_is_str"] == 1) | (~is_ws & ~scalar_end)))
+        # scalar terminators double as the substituted separator; string
+        # captures request one on the following char
+        comma_sub = scalar_end | ((c["emit_comma"] == 1) & act)
+        keep = keep | comma_sub
+        emit_comma = jnp.where(str_end, 1,
+                               jnp.where((c["emit_comma"] == 1) & act, 0,
+                                         c["emit_comma"]))
+
+        elem_done = c["elem_done"] \
+            | jnp.where(deadend | exhausted | ends, 1, 0)
+
+        # --- punts: anything raw passthrough cannot certify
+        bad_hi = outside & (xs >= 128)
+        cap_bs = act & (c["capturing"] == 1) & is_bs
+        # an escape inside a frontier KEY can decode to the very key the
+        # raw bytes fail to match ('b' == 'b'): only the host's
+        # decoding walker can answer such rows
+        key_bs = act & (in_key == 1) & is_bs
+        punt = c["punt"] | jnp.where(
+            cap_container | bad_hi | cap_bs | key_bs, 1, 0)
+
+        # --- element boundary: top-level comma resets the frontier
+        elem_comma = outside & is_comma & (depth == 1) \
+            & (c["capturing"] == 0)
+        rel = jnp.where(elem_comma, 0, rel)
+        in_key = jnp.where(elem_comma, 0, in_key)
+        key_pos = jnp.where(elem_comma, 0, key_pos)
+        key_ok = jnp.where(elem_comma, 1, key_ok)
+        await_colon = jnp.where(elem_comma, 0, await_colon)
+        pending = jnp.where(elem_comma, 0, pending)
+        elem_done = jnp.where(elem_comma, 0, elem_done)
+
+        # --- top-level structure guard (phase at depth 1):
+        # 0 = expecting an element (after '[' or ','), 1 = inside a bare
+        # scalar element, 2 = after an element (expecting ',' or ']').
+        # Violations — ',' while expecting an element (leading/double
+        # comma), a token while phase 2 (missing comma / stray junk),
+        # ']' right after ',' (trailing comma) — are docs the host
+        # parser nulls; punt them rather than fabricate output.
+        phase = c["phase"]
+        at_top = act & (in_str == 0) & (depth == 1)
+        tok_first = at_top & ~is_ws & ~is_comma & ~is_close \
+            & (phase == 0)
+        punt = punt | jnp.where(
+            (at_top & is_comma & (phase == 0))
+            | (at_top & ~is_ws & ~is_comma & ~is_close & (phase == 2))
+            | (at_top & is_close & (phase == 0)
+               & (c["had_tok"] == 1)), 1, 0)
+        had_tok = c["had_tok"] | tok_first.astype(i32)
+        phase = jnp.where(elem_comma, 0,
+                          jnp.where(tok_first, 1, phase))
+        # element ends: a container close back to depth 1, a string
+        # element's closing quote, or whitespace after a bare scalar
+        phase = jnp.where(
+            (outside & is_close & (new_depth == 1))
+            | (act & eff_q & (in_str == 1) & (depth == 1))
+            | (at_top & is_ws & (c["phase"] == 1)), 2, phase)
+
+        # --- expect_key maintenance for the (possibly new) frontier
+        new_frontier = rel + 2
+        opens_frontier = outside & (xs == ord("{")) \
+            & (new_depth == new_frontier)
+        comma_frontier = outside & is_comma & (depth == new_frontier) \
+            & (c["capturing"] == 0)
+        expect_key = jnp.where(
+            opens_frontier | comma_frontier, 1,
+            jnp.where(key_opening
+                      | (act & ~is_ws & (in_str == 0) & ~eff_q
+                         & ~is_open & ~is_comma), 0, c["expect_key"]))
+
+        out = dict(in_str=new_in_str, esc=new_esc, depth=new_depth,
+                   rel=rel, in_key=in_key, key_pos=key_pos,
+                   key_ok=key_ok, await_colon=await_colon,
+                   pending=pending, expect_key=expect_key,
+                   capturing=capturing, cap_is_str=cap_is_str,
+                   elem_done=elem_done, count=count,
+                   first_str=first_str, punt=punt,
+                   emit_comma=emit_comma,
+                   phase=phase, had_tok=had_tok, closed=closed)
+        return out, (keep, comma_sub)
+
+    pos = jnp.arange(W, dtype=i32)
+    final, (keep_t, sub_t) = jax.lax.scan(step, carry0, (pos, arr.T))
+    keep = keep_t.T | (jnp.arange(W, dtype=i32)[None, :] == 0)  # the '['
+    sub = sub_t.T
+    # structural punts visible only at end-of-scan
+    punt = (final["punt"] == 1) | (final["closed"] == 0) \
+        | (final["in_str"] == 1) | (final["capturing"] == 1) \
+        | (final["emit_comma"] == 1)
+    return keep, sub, final["count"], final["first_str"] == 1, punt
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6))
+def _mid_wildcard_jit(ch, validity, lens, segs, wc_at: int, W: int,
+                      mkl: int):
+    """The whole mid-path-[*] device computation in ONE program."""
+    parent = tuple(segs[:wc_at])
+    suffix = tuple(segs[wc_at + 1:])
+    n = ch.shape[0]
+    if parent:
+        st_arr = _scan_automaton(ch, parent, mkl)
+    else:
+        st_arr = _root_array_span(ch, lens, W)
+    arr, len_a, ok_a, _, first_a = _extract_value(ch, st_arr, W)
+    arr_ok = ok_a & (first_a == ord("["))
+
+    keep, sub, count, first_str, punt = _suffix_scan(arr, len_a, suffix,
+                                                     mkl)
+    # compaction: one per-row lane sort of (pos-if-kept | W) over the
+    # char byte; dropped chars sink to the tail and mask away
+    posw = jnp.arange(W, dtype=jnp.int32)[None, :]
+    chars_eff = jnp.where(sub, jnp.uint8(ord(",")), arr)
+    packed = jnp.where(keep, posw, W) * 256 + chars_eff.astype(jnp.int32)
+    comp = (jnp.sort(packed, axis=1) & 0xFF).astype(jnp.uint8)
+    klen = jnp.sum(keep.astype(jnp.int32), axis=1)
+
+    single = arr_ok & (count == 1)
+    multi = arr_ok & (count >= 2)
+    # multi: the trailing separator becomes the closing ']'
+    comp_multi = jnp.where(posw == (klen - 1)[:, None],
+                           jnp.uint8(ord("]")), comp)
+    # single: drop the leading '[' (and quotes), drop the trailing ','
+    shift = 1 + first_str.astype(jnp.int32)
+    comp_single = _left_justify(comp, shift)
+    len_single = klen - 2 - 2 * first_str.astype(jnp.int32)
+    vals = jnp.where(single[:, None], comp_single, comp_multi)
+    out_len = jnp.clip(jnp.where(single, len_single, klen), 0, W)
+    mask = posw < out_len[:, None]
+    vals = jnp.where(mask, vals, jnp.uint8(0))
+
+    if validity is not None:
+        from spark_rapids_jni_tpu.table import unpack_bools
+        in_valid = unpack_bools(validity, n)
+    else:
+        in_valid = jnp.ones((n,), jnp.bool_)
+    # punted rows stay live so the host pass decides them; under an
+    # outer jit they degrade to null
+    valid = in_valid & arr_ok & ((count >= 1) | punt)
+    needs_host = in_valid & arr_ok & punt
+    return vals, out_len, valid, needs_host
+
+
+def _eval_wildcard_mid_device(col: Column, ch: jnp.ndarray, segs,
+                              wc_at: int, W: int, mkl: int,
+                              path: str) -> Column:
+    vals, out_len, valid, needs_host = _mid_wildcard_jit(
+        ch, col.validity, col.str_lens(), segs, wc_at, W, mkl)
+    return _finish_device_result(col, path, vals, out_len, valid,
+                                 needs_host)

@@ -439,3 +439,132 @@ def test_trailing_wildcard_adversarial_battery():
     exp = _eval_wildcard_host(col, _parse_path("$.a[*]")).to_pylist()
     assert got == exp, [(d, g, e) for d, g, e
                         in zip(docs, got, exp) if g != e]
+
+
+def test_mid_wildcard_device_matches_host():
+    """$.a[*].b on device: multi-match arrays, single bare values
+    (strings unquoted), skipped elements, literals with raw number
+    tokens, container/escape punts, structural anomalies -> the host
+    walker's answers exactly."""
+    from spark_rapids_jni_tpu.ops.get_json import (
+        _eval_wildcard_host, _parse_path)
+    docs = [
+        '{"a":[{"b":1},{"b":2},{"b":3}]}',
+        '{"a":[{"b":"x"},{"b":"y"}]}',
+        '{"a":[{"b":7}]}',
+        '{"a":[{"b":"hello"}]}',
+        '{"a":[{"c":1},{"b":5},{"c":2}]}',
+        '{"a":[{"c":1},{"c":2}]}',
+        '{"a":[]}',
+        '{"a":[{"b":true},{"b":null},{"b":1.5e2}]}',
+        '{"a":{"b":1}}',
+        '{"x":1}',
+        '{"a":[{"b":{"z":1}},{"b":2}]}',     # container match -> host
+        '{"a":[{"b":"e\\nsc"},{"b":"p"}]}',  # escape -> host
+        '{"a":[{"b":" s p "},{"b":2}]}',
+        '{"a":[ {"b": 1 } , {"b": 2 } ]}',
+        '{"a":[{"d":{"b":9}},{"b":3}]}',     # nested same-name key
+        '{"a":[{"b":1},{"b":2},]}',          # trailing comma
+        '{"a":[1,{"b":2},"s"]}',             # scalar elements skipped
+        '{"a":[{"b":-0.5},{"b":2E+1}]}',     # signed/exponent tokens
+        '{"a":[{"b":""},{"b":"q"}]}',        # empty strings
+        '{"a":[{"bb":1},{"b":2}]}',          # prefix key must not match
+        '{"a":[{"B":1},{"b":2}]}',           # case sensitive
+        '{"a":[{"b":1,"b":2}]}',             # duplicate key: first wins
+        '{"a":[{"c":{"b":8},"b":4}]}',       # deeper b ignored
+        '{"a":[[{"b":1}],{"b":2}]}',         # array element skipped
+        '{"a":[{"b":1}',                     # unclosed -> null
+        '{"a":[{"b":1},,{"b":2}]}',          # double comma -> null
+        '{"a":[{"b":1} {"b":2}]}',           # missing comma -> null
+        '{"a":[{"\\u0062":1},{"b":2}]}',     # escaped KEY decodes to b
+        '{"a":[{"x\\ny":1},{"b":2}]}',       # escaped non-match key
+        '{"a":[{"b":1}.]}',                  # junk between els -> null
+        '{"a":[,{"b":1}]}',                  # leading comma -> null
+        '{"a":[{"b":1}}',                    # bracket mismatch -> null
+        'junk', '', None,
+    ]
+    col = Column.strings(docs)
+    path = "$.a[*].b"
+    got = get_json_object(col, path).to_pylist()
+    exp = _eval_wildcard_host(col, tuple(_parse_path(path))).to_pylist()
+    assert got == exp, [(d, g, e) for d, g, e
+                        in zip(docs, got, exp) if g != e]
+
+
+def test_mid_wildcard_deep_suffix_and_root():
+    """Two-key suffixes ($.a[*].b.c) and a root-array wildcard
+    ($[*].k) take the device path and match the host walker."""
+    from spark_rapids_jni_tpu.ops.get_json import (
+        _eval_wildcard_host, _parse_path)
+    docs = [
+        '{"a":[{"b":{"c":1}},{"b":{"c":2}}]}',
+        '{"a":[{"b":{"x":1}},{"b":{"c":5}}]}',
+        '{"a":[{"b":1},{"b":{"c":3}}]}',      # non-object b skipped
+        '{"a":[{"b":{"c":{"d":1}}}]}',        # container match -> host
+        '{"a":[{"b":{"c":"v"}}]}',
+        None,
+    ]
+    col = Column.strings(docs)
+    for path in ("$.a[*].b.c",):
+        got = get_json_object(col, path).to_pylist()
+        exp = _eval_wildcard_host(
+            col, tuple(_parse_path(path))).to_pylist()
+        assert got == exp, (path,
+                            [(d, g, e) for d, g, e
+                             in zip(docs, got, exp) if g != e])
+    rdocs = ['[{"k":1},{"k":2}]', '[{"j":1},{"k":9}]', '[]', '{"k":1}',
+             # trailing text after the root array must not fabricate
+             # matches (raw_decode stops at the first complete value)
+             '[{"j":1}] [{"k":9}]', '[{"k":1},{"j":2}] [{"k":7}]',
+             None]
+    rcol = Column.strings(rdocs)
+    got = get_json_object(rcol, "$[*].k").to_pylist()
+    exp = _eval_wildcard_host(
+        rcol, tuple(_parse_path("$[*].k"))).to_pylist()
+    assert got == exp, [(d, g, e) for d, g, e
+                        in zip(rdocs, got, exp) if g != e]
+
+
+def test_mid_wildcard_randomized_vs_host(rng):
+    """Randomized well-formed documents: device == host on 300 docs
+    mixing match counts, value kinds, whitespace, and depths."""
+    from spark_rapids_jni_tpu.ops.get_json import (
+        _eval_wildcard_host, _parse_path)
+    vals = ['1', '-2.5', '"s%d"', 'true', 'null', '{"z":%d}', '[%d]']
+    docs = []
+    for r in range(300):
+        els = []
+        for e in range(int(rng.integers(0, 5))):
+            if rng.random() < 0.3:
+                els.append('{"c":%d}' % rng.integers(0, 9))
+            else:
+                v = vals[int(rng.integers(0, len(vals)))]
+                if "%d" in v:
+                    v = v % rng.integers(0, 99)
+                sp = " " if rng.random() < 0.3 else ""
+                els.append('{%s"b"%s:%s%s}' % (sp, sp, sp, v))
+        sep = " , " if rng.random() < 0.2 else ","
+        docs.append('{"a":[%s]}' % sep.join(els))
+    col = Column.strings(docs)
+    path = "$.a[*].b"
+    got = get_json_object(col, path).to_pylist()
+    exp = _eval_wildcard_host(col, tuple(_parse_path(path))).to_pylist()
+    assert got == exp, [(d, g, e) for d, g, e
+                        in zip(docs, got, exp) if g != e][:5]
+
+
+def test_mid_wildcard_under_jit_degrades_punts_to_null():
+    import jax
+    col = Column.strings_padded(
+        ['{"a":[{"b":1},{"b":2}]}',            # clean multi
+         '{"a":[{"b":{"z":1}}]}',              # container punt -> null
+         '{"a":[{"b":9}]}'])                   # clean single
+    out = jax.jit(lambda c: get_json_object(c, "$.a[*].b"))(col)
+    assert out.to_pylist() == ["[1,2]", None, "9"]
+
+
+def test_mid_wildcard_subscript_suffix_falls_back_to_host():
+    """A subscripted suffix ($.a[*].b[0]) exceeds the key-only device
+    scan and must still answer via the host walker."""
+    col = Column.strings_padded(['{"a":[{"b":[5,6]},{"b":[7]}]}'])
+    assert get_json_object(col, "$.a[*].b[0]").to_pylist() == ["[5,7]"]
