@@ -27,6 +27,7 @@ from typing import Callable, Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spark_rapids_jni_tpu.table import Column, Table
 from spark_rapids_jni_tpu.ops.hashing import murmur3_hash, pmod
@@ -506,6 +507,34 @@ def sort_order(keys: Sequence[jnp.ndarray],
     return _lexsort_live_last(list(keys), mask, descending)[0]
 
 
+def _check_merge_ops(ops: Sequence[str]) -> None:
+    for op in ops:
+        if op == "avg":
+            raise ValueError(
+                "avg partials do not merge; aggregate sum and count "
+                "partials and divide after merging")
+        if op not in _AGG_OPS:
+            raise ValueError(f"unknown aggregate op {op!r}")
+
+
+def _merge_one(acc, vals, ops: Sequence[str]) -> None:
+    """Accumulate one group's measure values into ``acc`` in place
+    (Python scalars — arbitrary precision; None skips per Spark null
+    semantics)."""
+    for i, op in enumerate(ops):
+        v = vals[i]
+        if v is None:
+            continue
+        if acc[i] is None:
+            acc[i] = v
+        elif op in ("sum", "count"):
+            acc[i] = acc[i] + v
+        elif op == "min":
+            acc[i] = min(acc[i], v)
+        else:
+            acc[i] = max(acc[i], v)
+
+
 def merge_aggregate_partials(partials, ops: Sequence[str]):
     """Combine per-device partial aggregates into final groups (the
     second phase of Spark's partial/final aggregation — q95's exchange
@@ -518,14 +547,7 @@ def merge_aggregate_partials(partials, ops: Sequence[str]):
     :func:`hash_aggregate_multi` (``avg`` partials cannot merge — carry
     sum and count and divide here instead).  Host-side: final groups are
     small.  Returns (keys_tuple -> [merged measures]) dict."""
-    import numpy as np
-    for op in ops:
-        if op == "avg":
-            raise ValueError(
-                "avg partials do not merge; aggregate sum and count "
-                "partials and divide after merging")
-        if op not in _AGG_OPS:
-            raise ValueError(f"unknown aggregate op {op!r}")
+    _check_merge_ops(ops)
     out = {}
     for gkeys, outs, have in partials:
         hv = np.asarray(have).reshape(-1)
@@ -540,15 +562,34 @@ def merge_aggregate_partials(partials, ops: Sequence[str]):
             if key not in out:
                 out[key] = list(vals)
                 continue
-            acc = out[key]
-            for i, op in enumerate(ops):
-                if op in ("sum", "count"):
-                    acc[i] = acc[i] + vals[i]
-                elif op == "min":
-                    acc[i] = min(acc[i], vals[i])
-                else:
-                    acc[i] = max(acc[i], vals[i])
+            _merge_one(out[key], vals, ops)
     return out
+
+def merge_aggregate_table_partials(results, num_keys: int,
+                                   ops: Sequence[str]):
+    """Combine per-device result TABLES from the Table-level distributed
+    steps (q72/q95) into final groups with Spark null semantics: keys
+    are tuples with ``None`` for null keys; SUM/MIN/MAX of an all-null
+    group stay ``None``; values merge as Python scalars (arbitrary
+    precision — int64 pair columns come back exact via ``to_pylist``).
+
+    ``results``: iterable of (result_table, have) pairs; the table's
+    columns are ``num_keys`` key columns followed by one column per op.
+    Returns {key_tuple: [merged measure values]}."""
+    _check_merge_ops(ops)
+    out: Dict = {}
+    for table, have in results:
+        hv = np.asarray(have).reshape(-1)
+        cols = [c.to_pylist() for c in table.columns]
+        for j in np.nonzero(hv)[0]:
+            key = tuple(col[j] for col in cols[:num_keys])
+            vals = [cols[num_keys + i][j] for i in range(len(ops))]
+            if key not in out:
+                out[key] = list(vals)
+                continue
+            _merge_one(out[key], vals, ops)
+    return out
+
 
 # ---------------------------------------------------------------------------
 # Columnar (Table / GroupedColumns) operator layer with Spark null
@@ -876,6 +917,68 @@ def _hash_aggregate_nulls(sort_keys, measures, live, max_groups: int):
                                    num_segments=n) > 0
     num_groups = jnp.sum(seg_live.astype(jnp.int32))
     return gkeys, outs, metas, have, num_groups
+
+
+def distributed_q6_table_step(mesh, axis_name="data",
+                              capacity_factor: float = 8.0,
+                              max_groups: int = MAX_GROUPS):
+    """The q6/flagship shape over TABLES (BASELINE.json configs 1-2:
+    Project + Filter + HashAggregate on store_sales): row-sharded
+    (sold_date, item, quantity, price_cents) columns WITH validity
+    hash-exchange by sold date, join the replicated items Table
+    (item -> avg_price_cents) with null-key exclusion, filter
+    price > 1.2x the item average (integral: price*10 > avg*12), project
+    revenue = price * quantity, aggregate COUNT(*) + SUM(revenue) by
+    sold date — the null-aware Table twin of
+    :func:`flagship_query_step`/:func:`distributed_query_step`.
+
+    Takes (sales_table, items_table); every column must CARRY a validity
+    array (shard_map specs are structural).  Returns (result_table,
+    have, num_groups, overflow) per device; result columns are
+    (sold_date, count, revenue_sum).  Null sale dates form a null-key
+    group; null items/prices/quantities drop at the join/filter (NULL
+    comparisons are not true)."""
+    from jax.sharding import PartitionSpec as P
+    from spark_rapids_jni_tpu.table import INT32, pack_bools
+    num_parts = mesh.shape[axis_name]
+
+    def step(tbl, items):
+        n_local = tbl.num_rows
+        capacity = max(8, int(capacity_factor * n_local / num_parts))
+        shuffled, valids, _slot_valid, x_overflow = \
+            _exchange_with_validity(tbl, 0, num_parts, capacity,
+                                    axis_name)
+        r_date, r_item, r_qty, r_price = shuffled.columns
+        dv, iv, qv, pv = valids
+
+        probe = Table((r_item,))
+        # unique item keys: one match per probe row suffices
+        join_cap = r_item.num_rows
+        pidx, avg_p, avg_valid, jvalid, _, j_overflow = join_inner_table(
+            items, 0, 1, probe, 0, join_cap)
+        live = jvalid & avg_valid & pv[pidx] & qv[pidx] \
+            & (r_price.data[pidx] * 10 > avg_p * 12)
+        revenue = r_price.data[pidx] * r_qty.data[pidx]
+        joined = Table((
+            Column(INT32, r_date.data[pidx], pack_bools(dv[pidx])),
+            Column(INT32, revenue, pack_bools(pv[pidx] & qv[pidx])),
+        ))
+        res, have, num_groups = hash_aggregate_table(
+            joined, key_idxs=[0],
+            measures=[(None, "count"), (1, "sum")],
+            max_groups=max_groups, mask=live)
+        overflow = x_overflow | j_overflow | (num_groups > max_groups)
+        return res, have, num_groups[None], overflow[None]
+
+    from jax import shard_map
+    spec = P(axis_name)
+    out_tree = Table(tuple(Column(INT32, spec, spec) for _ in range(3)))
+    in_sales = Table(tuple(Column(INT32, spec, spec) for _ in range(4)))
+    in_items = Table(tuple(Column(INT32, P(), P()) for _ in range(2)))
+    return shard_map(step, mesh=mesh,
+                     in_specs=(in_sales, in_items),
+                     out_specs=(out_tree, spec, spec, spec),
+                     check_vma=False)
 
 
 def _segment_sum_words(words, mvalid, seg_c, nseg, max_groups):

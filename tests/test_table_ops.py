@@ -449,6 +449,55 @@ def test_distributed_q95_table_step_nulls(rng, cpu_devices):
     assert got == exp
 
 
+def test_distributed_q6_table_step_nulls(rng, cpu_devices):
+    """The Table-level q6/flagship step: exchange by sold date, join
+    replicated items, integral price filter, revenue aggregate — merged
+    across devices with merge_aggregate_table_partials and checked
+    against a numpy oracle over the nullable inputs."""
+    import jax
+    from spark_rapids_jni_tpu.parallel import make_mesh, shard_table
+    from spark_rapids_jni_tpu.models.pipeline import (
+        distributed_q6_table_step, merge_aggregate_table_partials)
+    mesh = make_mesh(cpu_devices[:8])
+    n = 8 * 64
+    date = rng.integers(0, 5, n).astype(np.int32)
+    dv = rng.random(n) > 0.1
+    item = rng.integers(0, 20, n).astype(np.int32)
+    iv = rng.random(n) > 0.1
+    qty = rng.integers(1, 6, n).astype(np.int32)
+    qv = rng.random(n) > 0.15
+    price = rng.integers(50, 500, n).astype(np.int32)
+    pv = rng.random(n) > 0.15
+    bi = np.arange(20, dtype=np.int32)
+    bp = rng.integers(40, 400, 20).astype(np.int32)
+    bpv = rng.random(20) > 0.1
+
+    sales = shard_table(Table((
+        Column.from_numpy(date, INT32, valid=dv),
+        Column.from_numpy(item, INT32, valid=iv),
+        Column.from_numpy(qty, INT32, valid=qv),
+        Column.from_numpy(price, INT32, valid=pv))), mesh)
+    items = Table((Column.from_numpy(bi, INT32,
+                                     valid=np.ones(20, bool)),
+                   Column.from_numpy(bp, INT32, valid=bpv)))
+    step = jax.jit(distributed_q6_table_step(mesh))
+    res, have, ng, ovf = step(sales, items)
+    assert not np.asarray(ovf).any()
+    got = merge_aggregate_table_partials([(res, have)], num_keys=1,
+                                         ops=["count", "sum"])
+
+    exp = {}
+    for r in range(n):
+        if not (iv[r] and pv[r] and qv[r] and bpv[item[r]]):
+            continue
+        if not price[r] * 10 > bp[item[r]] * 12:
+            continue
+        key = (int(date[r]) if dv[r] else None,)
+        c, s = exp.get(key, (0, 0))
+        exp[key] = (c + 1, s + int(price[r]) * int(qty[r]))
+    assert {k: tuple(v) for k, v in got.items()} == exp
+
+
 def test_grouped_survives_shuffle_roundtrip(rng, cpu_devices):
     """The plane-major backing crosses a mesh shuffle: per-device lazy
     extraction feeds the row encode, rows exchange, and the receive side
