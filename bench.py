@@ -69,6 +69,12 @@ def _sync(out):
     (Scalar INDEXING, not ``reshape(-1)[:1]``: an eager flatten of a 2-D
     tiled array dispatches a full relayout copy — measured 50 ms on a
     [221, 1M] plane matrix — that would poison every timing.)
+
+    NO retry here: _sync runs inside _time's measured windows, where a
+    retry sleep would silently poison the published numbers.  A relay
+    failure (spurious InvalidArgument windows lasting minutes, observed
+    2026-07-31) propagates, fails the axis subprocess, and the
+    axis-level retry with backoff re-measures cleanly.
     """
     leaf = jax.tree_util.tree_leaves(out)[-1]
     np.asarray(leaf[(0,) * leaf.ndim])
@@ -352,9 +358,33 @@ def bench_json_wildcard(num_rows):
     t = _time(lambda: get_json_object(col, "$.a[*]"), iters=12,
               label=f"json_wildcard[{num_rows}]", sync_each=True)
     nbytes = col.chars2d.size
+
+    # mid-path wildcard ($.a[*].b): element-suffix scan + per-row lane
+    # sort compaction, same oracle-then-measure protocol
+    mdocs = np.where(
+        kinds == 0, '{"a":[],"k":1}',
+        np.where(kinds == 1, '{"a":[{"b":__A__}]}',
+                 np.where(kinds == 2,
+                          '{"a":[{"b":__A__},{"c":1},{"b":__B__}]}',
+                          '{"a":[{"c":__A__}]}'))).astype(object)
+    mdocs = [d.replace("__A__", str(av)).replace("__B__", str(bv))
+             for d, av, bv in zip(mdocs, a, b)]
+    msample = Column.strings(mdocs[:2000])
+    got = get_json_object(msample, "$.a[*].b").to_pylist()
+    exp = _eval_wildcard_host(msample,
+                              _parse_path("$.a[*].b")).to_pylist()
+    assert got == exp, "mid-path wildcard diverges from the host oracle"
+    _log(f"json {num_rows}: mid-path oracle check OK")
+    mcol = Column.strings_padded(mdocs)
+    jax.block_until_ready(mcol.chars2d)
+    tm = _time(lambda: get_json_object(mcol, "$.a[*].b"), iters=12,
+               label=f"json_mid_wildcard[{num_rows}]", sync_each=True)
     return {"num_rows": num_rows, "path": "$.a[*]",
             "wildcard_s": t, "wildcard_Mrows_s": num_rows / t / 1e6,
-            "scanned_GBps": nbytes / t / 1e9}
+            "scanned_GBps": nbytes / t / 1e9,
+            "mid_path": "$.a[*].b", "mid_wildcard_s": tm,
+            "mid_Mrows_s": num_rows / tm / 1e6,
+            "mid_scanned_GBps": mcol.chars2d.size / tm / 1e9}
 
 
 def _run_axis(axis: str):
@@ -500,24 +530,48 @@ def _verify_variable(num_rows, num_cols=155, native_rows=50_000):
           flush=True)
 
 
-def _axis_subprocess(axis: str, timeout_s: int = 540):
+def _axis_subprocess(axis: str, timeout_s: int = 540, attempts: int = 3):
     """Each axis gets a fresh process (and TPU client): an OOM on one axis
-    cannot poison the allocator state of the next."""
+    cannot poison the allocator state of the next.  Failed axes retry in
+    a fresh process (with a settling pause): the shared axon relay
+    intermittently rejects transfers with spurious InvalidArgument
+    errors that clear within a minute — observed 2026-07-31 with the
+    same binary passing/failing across minutes."""
     import subprocess
     cmd = [sys.executable, os.path.abspath(__file__), "--one", axis]
-    try:
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=timeout_s, cwd=os.path.dirname(
-                                  os.path.abspath(__file__)))
-    except subprocess.TimeoutExpired:
-        return {"axis": axis, "error": f"timeout after {timeout_s}s"}
-    sys.stderr.write(proc.stderr[-4000:])
-    for line in proc.stdout.splitlines():
-        if line.startswith("AXIS_RESULT "):
-            return json.loads(line[len("AXIS_RESULT "):])
-    tail = (proc.stderr or "").strip().splitlines()[-3:]
-    return {"axis": axis, "error": f"exit {proc.returncode}: "
-            + " | ".join(tail)}
+    last = None
+    backoff = [30, 180]        # bad relay windows last minutes: spread
+    for attempt in range(attempts):
+        if attempt:
+            err = last.get("error", "")
+            # only the documented transients re-run; deterministic
+            # failures (asserts, OOM, import errors) surface immediately
+            if "InvalidArgument" not in err and "timeout" not in err:
+                return last
+            wait = backoff[min(attempt - 1, len(backoff) - 1)]
+            _log(f"{axis}: attempt {attempt} failed "
+                 f"({err[:80]}); retrying in {wait}s")
+            time.sleep(wait)
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=timeout_s, cwd=os.path.dirname(
+                                      os.path.abspath(__file__)))
+        except subprocess.TimeoutExpired:
+            last = {"axis": axis, "error": f"timeout after {timeout_s}s"}
+            continue
+        sys.stderr.write(proc.stderr[-4000:])
+        result = None
+        for line in proc.stdout.splitlines():
+            if line.startswith("AXIS_RESULT "):
+                result = json.loads(line[len("AXIS_RESULT "):])
+        if result is not None:
+            if attempt:
+                result["retries"] = attempt
+            return result
+        tail = (proc.stderr or "").strip().splitlines()[-3:]
+        last = {"axis": axis, "error": f"exit {proc.returncode}: "
+                + " | ".join(tail)}
+    return last
 
 
 def main():

@@ -1149,7 +1149,12 @@ def _exchange_with_validity(table: Table, key_idx: int, num_parts: int,
     arrays, slot_valid, overflow); the bool masks — already ANDed with
     slot liveness — are the same values packed into the Table's columns,
     returned unpacked so callers avoid a pack/unpack roundtrip in the
-    hot step."""
+    hot step.
+
+    Columns must be int32-representable [n] arrays (the payload stacks
+    them with the flag word), and at most 31 of them (one validity bit
+    each in the int32 flag word — exceeding it fails loudly at trace
+    time via the int32 shift overflow)."""
     from spark_rapids_jni_tpu.parallel.shuffle import bucket_exchange
     from spark_rapids_jni_tpu.table import INT32, pack_bools
     cols = table.columns

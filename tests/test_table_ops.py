@@ -153,6 +153,52 @@ def test_aggregate_from_grouped_backing(rng):
         assert cg.to_pylist() == ct.to_pylist()
 
 
+def test_aggregate_int64_measure_from_grouped_backing(rng, x64_both):
+    """An int64 measure aggregates identically from the plane-major
+    GroupedColumns backing and the Table — the pair column comes out of
+    the planes as the same [2, n] representation the words kernels eat
+    (or native int64 under x64)."""
+    from spark_rapids_jni_tpu.ops.row_mxu import table_to_grouped
+    n = 512
+    keys = rng.integers(0, 6, n).astype(np.int32)
+    vals = rng.integers(-(2 ** 50), 2 ** 50, n, dtype=np.int64)
+    vv = rng.random(n) > 0.2
+    t = Table((Column.from_numpy(keys, INT32),
+               Column.from_numpy(vals, INT64, valid=vv)))
+    gc = table_to_grouped(t)
+    m = [(1, "sum"), (1, "min"), (1, "max")]
+    res_g, have_g, _ = hash_aggregate_table(gc, key_idxs=[0],
+                                            measures=m, max_groups=16)
+    res_t, have_t, _ = hash_aggregate_table(t, key_idxs=[0],
+                                            measures=m, max_groups=16)
+    for cg, ct in zip(res_g.columns, res_t.columns):
+        assert cg.to_pylist() == ct.to_pylist()
+    # and against Python ints
+    exp = {}
+    for r in range(n):
+        if not vv[r]:
+            continue
+        k, v = int(keys[r]), int(vals[r])
+        s, lo, hi = exp.get(k, (0, None, None))
+        exp[k] = (s + v, v if lo is None else min(lo, v),
+                  v if hi is None else max(hi, v))
+    hv = np.asarray(have_t)
+    gk = res_t.columns[0].to_pylist()
+    sm = res_t.columns[1].to_pylist()
+    mn = res_t.columns[2].to_pylist()
+    mx = res_t.columns[3].to_pylist()
+    live = list(np.nonzero(hv)[0])
+    # every key with live rows must come back, and no others (keys
+    # whose every measure is null still group — count them too)
+    all_keys = {int(k) for k in keys}
+    assert {gk[j] for j in live} == all_keys
+    for j in live:
+        if gk[j] in exp:
+            assert (sm[j], mn[j], mx[j]) == exp[gk[j]]
+        else:                      # all-null-measure group: null outputs
+            assert (sm[j], mn[j], mx[j]) == (None, None, None)
+
+
 def test_join_null_keys_never_match(rng):
     bkeys = np.array([1, 2, 2, 3, 0], np.int32)
     bvalid = np.array([1, 1, 0, 1, 0], bool)     # one null dup of key 2
