@@ -340,51 +340,45 @@ def bench_json_wildcard(num_rows):
     kinds = rng.integers(0, 4, num_rows)
     a = rng.integers(0, 100, num_rows)
     b = rng.integers(0, 100, num_rows)
-    docs = np.where(
-        kinds == 0, '{"a":[],"k":1}',
-        np.where(kinds == 1, '{"a":[__A__]}',
-                 np.where(kinds == 2, '{"a":[__A__,__B__],"x":2}',
-                          '{"b":[__A__]}'))).astype(object)
-    docs = [d.replace("__A__", str(av)).replace("__B__", str(bv))
-            for d, av, bv in zip(docs, a, b)]
-    _log(f"json {num_rows}: docs built")
-    sample = Column.strings(docs[:2000])
-    got = get_json_object(sample, "$.a[*]").to_pylist()
-    exp = _eval_wildcard_host(sample, _parse_path("$.a[*]")).to_pylist()
-    assert got == exp, "device wildcard diverges from the host oracle"
-    _log(f"json {num_rows}: oracle check OK")
-    col = Column.strings_padded(docs)
-    jax.block_until_ready(col.chars2d)
-    t = _time(lambda: get_json_object(col, "$.a[*]"), iters=12,
-              label=f"json_wildcard[{num_rows}]", sync_each=True)
-    nbytes = col.chars2d.size
 
+    def _measure(templates, path, label):
+        """Build docs from the 4 kind-templates, oracle-check a sample
+        against the host walker, then time the device evaluator."""
+        docs = np.where(
+            kinds == 0, templates[0],
+            np.where(kinds == 1, templates[1],
+                     np.where(kinds == 2, templates[2],
+                              templates[3]))).astype(object)
+        docs = [d.replace("__A__", str(av)).replace("__B__", str(bv))
+                for d, av, bv in zip(docs, a, b)]
+        sample = Column.strings(docs[:2000])
+        got = get_json_object(sample, path).to_pylist()
+        exp = _eval_wildcard_host(sample, _parse_path(path)).to_pylist()
+        assert got == exp, f"{path} diverges from the host oracle"
+        _log(f"json {num_rows}: {label} oracle check OK")
+        col = Column.strings_padded(docs)
+        jax.block_until_ready(col.chars2d)
+        t = _time(lambda: get_json_object(col, path), iters=12,
+                  label=f"{label}[{num_rows}]", sync_each=True)
+        return t, col.chars2d.size
+
+    t, nbytes = _measure(
+        ('{"a":[],"k":1}', '{"a":[__A__]}',
+         '{"a":[__A__,__B__],"x":2}', '{"b":[__A__]}'),
+        "$.a[*]", "json_wildcard")
     # mid-path wildcard ($.a[*].b): element-suffix scan + per-row lane
     # sort compaction, same oracle-then-measure protocol
-    mdocs = np.where(
-        kinds == 0, '{"a":[],"k":1}',
-        np.where(kinds == 1, '{"a":[{"b":__A__}]}',
-                 np.where(kinds == 2,
-                          '{"a":[{"b":__A__},{"c":1},{"b":__B__}]}',
-                          '{"a":[{"c":__A__}]}'))).astype(object)
-    mdocs = [d.replace("__A__", str(av)).replace("__B__", str(bv))
-             for d, av, bv in zip(mdocs, a, b)]
-    msample = Column.strings(mdocs[:2000])
-    got = get_json_object(msample, "$.a[*].b").to_pylist()
-    exp = _eval_wildcard_host(msample,
-                              _parse_path("$.a[*].b")).to_pylist()
-    assert got == exp, "mid-path wildcard diverges from the host oracle"
-    _log(f"json {num_rows}: mid-path oracle check OK")
-    mcol = Column.strings_padded(mdocs)
-    jax.block_until_ready(mcol.chars2d)
-    tm = _time(lambda: get_json_object(mcol, "$.a[*].b"), iters=12,
-               label=f"json_mid_wildcard[{num_rows}]", sync_each=True)
+    tm, mbytes = _measure(
+        ('{"a":[],"k":1}', '{"a":[{"b":__A__}]}',
+         '{"a":[{"b":__A__},{"c":1},{"b":__B__}]}',
+         '{"a":[{"c":__A__}]}'),
+        "$.a[*].b", "json_mid_wildcard")
     return {"num_rows": num_rows, "path": "$.a[*]",
             "wildcard_s": t, "wildcard_Mrows_s": num_rows / t / 1e6,
             "scanned_GBps": nbytes / t / 1e9,
             "mid_path": "$.a[*].b", "mid_wildcard_s": tm,
             "mid_Mrows_s": num_rows / tm / 1e6,
-            "mid_scanned_GBps": mcol.chars2d.size / tm / 1e9}
+            "mid_scanned_GBps": mbytes / tm / 1e9}
 
 
 def _run_axis(axis: str):

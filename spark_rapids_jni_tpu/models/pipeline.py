@@ -655,6 +655,12 @@ def hash_aggregate_table(source, key_idxs: Sequence[int],
     kernels (:func:`_segment_sum_words` — sums wrap modulo the type
     width, Spark's non-ANSI long overflow behavior), AVG (64-bit only)
     as float32.
+
+    Dense-padded STRING key columns group lexicographically (big-endian
+    word subkeys through the same variadic sort, true length as the
+    tiebreak); the result's key column is rebuilt from the sorted
+    subkeys — no gather.  Width-capped string keys refuse loudly
+    (truncated bytes would merge distinct values).
     """
     from spark_rapids_jni_tpu.table import pack_bools, INT32
     n = _source_num_rows(source)
@@ -662,10 +668,46 @@ def hash_aggregate_table(source, key_idxs: Sequence[int],
 
     key_cols = [_source_column(source, i) for i in key_idxs]
     sort_keys = []     # expanded arrays driving grouping equality
-    per_key = []       # (packed_bits_or_0, n_subarrays) bookkeeping
+    per_key = []       # ("packed", bits) | ("plain", nsub) |
+    #                    ("str", nsub, W) bookkeeping
     for c in key_cols:
         kv = c.valid_bools()
         null_flag = (~kv).astype(jnp.int32)
+        if c.dtype.is_string:
+            # string keys group lexicographically: the padded chars as
+            # BIG-endian u32 words (byte order == unsigned word order),
+            # flipped into signed sort space, with the true length as
+            # the final tiebreak (zero padding would otherwise merge
+            # "a" with "a\\x00")
+            from spark_rapids_jni_tpu.table import string_tail
+            if c.chars2d is None:
+                raise ValueError(
+                    "string group-by keys need dense-padded columns "
+                    "(Column.strings_padded)")
+            if getattr(c, "capped", False) \
+                    or string_tail(c) is not None:
+                raise ValueError(
+                    "width-capped string keys would merge distinct "
+                    "values truncated at the cap; to_arrow() the "
+                    "column first")
+            b = c.chars2d
+            if b.shape[1] % 4:
+                b = jnp.concatenate(
+                    [b, jnp.zeros((n, 4 - b.shape[1] % 4), jnp.uint8)],
+                    axis=1)
+            be = (b[:, 0::4].astype(jnp.uint32) << 24
+                  | b[:, 1::4].astype(jnp.uint32) << 16
+                  | b[:, 2::4].astype(jnp.uint32) << 8
+                  | b[:, 3::4].astype(jnp.uint32))
+            subs = [jax.lax.bitcast_convert_type(
+                        be[:, j] ^ jnp.uint32(0x80000000), jnp.int32)
+                    for j in range(be.shape[1])]
+            subs.append(c.str_lens().astype(jnp.int32))
+            sort_keys.append(null_flag)
+            sort_keys.extend(
+                jnp.where(kv, s, jnp.zeros_like(s)) for s in subs)
+            per_key.append(("str", len(subs), int(b.shape[1])))
+            continue
         subs = _key_subarrays(c)
         bits = 8 * c.dtype.itemsize
         if len(subs) == 1 and bits <= 16:
@@ -681,7 +723,7 @@ def hash_aggregate_table(source, key_idxs: Sequence[int],
             packed = (null_flag << bits) \
                 | jnp.where(kv, u.astype(jnp.int32), 0)
             sort_keys.append(packed)
-            per_key.append((bits, 1))
+            per_key.append(("packed", bits))
             continue
         # the null flag leads its key's subarrays: null-safe equality
         # (two rows group together iff both null or both equal), with
@@ -689,7 +731,7 @@ def hash_aggregate_table(source, key_idxs: Sequence[int],
         sort_keys.append(null_flag)
         sort_keys.extend(
             jnp.where(kv, s, jnp.zeros_like(s)) for s in subs)
-        per_key.append((0, len(subs)))
+        per_key.append(("plain", len(subs)))
 
     mcore = []
     for idx, op in measures:
@@ -728,8 +770,9 @@ def hash_aggregate_table(source, key_idxs: Sequence[int],
 
     out_cols = []
     ki = 0
-    for c, (packed_bits, nsub) in zip(key_cols, per_key):
-        if packed_bits:
+    for c, spec in zip(key_cols, per_key):
+        if spec[0] == "packed":
+            packed_bits = spec[1]
             pk = gkeys[ki]; ki += 1
             gnull = pk >> packed_bits
             raw = (pk & ((1 << packed_bits) - 1)).astype(
@@ -738,7 +781,35 @@ def hash_aggregate_table(source, key_idxs: Sequence[int],
                 (raw.astype(jnp.uint8).astype(jnp.bool_)
                  if c.data.dtype == jnp.bool_
                  else jax.lax.bitcast_convert_type(raw, c.data.dtype))
+        elif spec[0] == "str":
+            nsub, W = spec[1], spec[2]
+            gnull = gkeys[ki]; ki += 1
+            subs = gkeys[ki:ki + nsub]; ki += nsub
+            valid = have & (gnull == 0)
+            # the sorted word subkeys ARE the group's key bytes:
+            # un-flip, back to big-endian bytes (tiny [G, W] output)
+            lens_g = jnp.where(valid, subs[-1], 0)
+            words = [jax.lax.bitcast_convert_type(s, jnp.uint32)
+                     ^ jnp.uint32(0x80000000) for s in subs[:-1]]
+            if words:
+                wmat = jnp.stack(words, axis=1)      # [G, W/4]
+                bmat = jnp.stack(
+                    [(wmat >> 24).astype(jnp.uint8),
+                     ((wmat >> 16) & 0xFF).astype(jnp.uint8),
+                     ((wmat >> 8) & 0xFF).astype(jnp.uint8),
+                     (wmat & 0xFF).astype(jnp.uint8)],
+                    axis=2).reshape(wmat.shape[0], -1)[:, :W]
+                bmat = jnp.where(valid[:, None], bmat, jnp.uint8(0))
+            else:   # zero-width column (all rows empty or null)
+                bmat = jnp.zeros((lens_g.shape[0], 0), jnp.uint8)
+            offs = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32),
+                 jnp.cumsum(lens_g).astype(jnp.int32)])
+            out_cols.append(Column(c.dtype, jnp.zeros((0,), jnp.uint8),
+                                   pack_bools(valid), offs, None, bmat))
+            continue
         else:
+            nsub = spec[1]
             gnull = gkeys[ki]; ki += 1
             subs = gkeys[ki:ki + nsub]; ki += nsub
             if nsub == 2:  # 64-bit plane pair: (hi signed, lo)
