@@ -8,4 +8,5 @@ from spark_rapids_jni_tpu.models.pipeline import (  # noqa: F401
     hash_aggregate_table, join_inner_table, join_semi_mask_table,
     distributed_q72_table_step, distributed_q95_table_step,
     distributed_q6_table_step, merge_aggregate_table_partials,
+    join_semi_mask_strings, sort_merge_join_strings,
 )

@@ -614,6 +614,42 @@ def merge_aggregate_table_partials(results, num_keys: int,
 # decode->aggregate pipeline never materializes per-column arrays.
 
 
+def _string_key_words(c: Column, what: str, width: int = None):
+    """A dense-padded string column as lexicographic int32 sort
+    subkeys: the padded chars as BIG-endian u32 words (byte order ==
+    unsigned word order) flipped into signed sort space, with the true
+    length as the final tiebreak (zero padding would otherwise merge
+    "a" with "a\\x00").  ``width`` pads the char matrix wider first (so
+    two columns can share subkey arity for a combined sort).  Returns
+    (subkey list, padded width).  Shared by the aggregate's string
+    GROUP BY keys and the string joins."""
+    from spark_rapids_jni_tpu.table import string_tail
+    if c.chars2d is None:
+        raise ValueError(
+            f"string {what} keys need dense-padded columns "
+            "(Column.strings_padded)")
+    if getattr(c, "capped", False) or string_tail(c) is not None:
+        raise ValueError(
+            f"width-capped string {what} keys would merge distinct "
+            "values truncated at the cap; to_arrow() the column first")
+    b = c.chars2d
+    n = b.shape[0]
+    target = max(width or 0, b.shape[1])
+    target = -(-target // 4) * 4
+    if b.shape[1] < target:
+        b = jnp.concatenate(
+            [b, jnp.zeros((n, target - b.shape[1]), jnp.uint8)], axis=1)
+    be = (b[:, 0::4].astype(jnp.uint32) << 24
+          | b[:, 1::4].astype(jnp.uint32) << 16
+          | b[:, 2::4].astype(jnp.uint32) << 8
+          | b[:, 3::4].astype(jnp.uint32))
+    subs = [jax.lax.bitcast_convert_type(
+                be[:, j] ^ jnp.uint32(0x80000000), jnp.int32)
+            for j in range(be.shape[1])]
+    subs.append(c.str_lens().astype(jnp.int32))
+    return subs, int(b.shape[1])
+
+
 def _key_subarrays(col: Column):
     """A key column as sortable integer word arrays (major first).
 
@@ -674,39 +710,11 @@ def hash_aggregate_table(source, key_idxs: Sequence[int],
         kv = c.valid_bools()
         null_flag = (~kv).astype(jnp.int32)
         if c.dtype.is_string:
-            # string keys group lexicographically: the padded chars as
-            # BIG-endian u32 words (byte order == unsigned word order),
-            # flipped into signed sort space, with the true length as
-            # the final tiebreak (zero padding would otherwise merge
-            # "a" with "a\\x00")
-            from spark_rapids_jni_tpu.table import string_tail
-            if c.chars2d is None:
-                raise ValueError(
-                    "string group-by keys need dense-padded columns "
-                    "(Column.strings_padded)")
-            if getattr(c, "capped", False) \
-                    or string_tail(c) is not None:
-                raise ValueError(
-                    "width-capped string keys would merge distinct "
-                    "values truncated at the cap; to_arrow() the "
-                    "column first")
-            b = c.chars2d
-            if b.shape[1] % 4:
-                b = jnp.concatenate(
-                    [b, jnp.zeros((n, 4 - b.shape[1] % 4), jnp.uint8)],
-                    axis=1)
-            be = (b[:, 0::4].astype(jnp.uint32) << 24
-                  | b[:, 1::4].astype(jnp.uint32) << 16
-                  | b[:, 2::4].astype(jnp.uint32) << 8
-                  | b[:, 3::4].astype(jnp.uint32))
-            subs = [jax.lax.bitcast_convert_type(
-                        be[:, j] ^ jnp.uint32(0x80000000), jnp.int32)
-                    for j in range(be.shape[1])]
-            subs.append(c.str_lens().astype(jnp.int32))
+            subs, W_str = _string_key_words(c, "group-by")
             sort_keys.append(null_flag)
             sort_keys.extend(
                 jnp.where(kv, s, jnp.zeros_like(s)) for s in subs)
-            per_key.append(("str", len(subs), int(b.shape[1])))
+            per_key.append(("str", len(subs), W_str))
             continue
         subs = _key_subarrays(c)
         bits = 8 * c.dtype.itemsize
@@ -1137,6 +1145,119 @@ def _segment_minmax_words(words, mvalid, seg_c, nseg, max_groups, op):
             m = m ^ jnp.uint32(0x80000000)
         result.append(m)
     return result
+
+
+# -- string-key joins --------------------------------------------------------
+#
+# String equi-joins cannot ride searchsorted (multi-word keys).  The
+# gather-free plan: ONE variadic sort of build+probe rows together on
+# the lexicographic word subkeys (side flag minor, so build rows lead
+# each key run), a segmented forward-fill of the build payload through
+# each run (log-depth associative_scan — no [n]-gathers anywhere), and
+# a second small sort on (side, original index) to un-permute the probe
+# results.  Null keys never match on either side (validity rides the
+# fill).  Build keys must be UNIQUE per value (dimension joins);
+# duplicate valid build keys raise the ``ambiguous`` flag.
+
+
+def _fill_forward_segmented(reset, has, vals):
+    """Segmented forward-fill: at each position, the latest (has=1)
+    values at or before it within its segment (``reset`` marks segment
+    starts).  Returns (filled_has, filled_vals).  The operator is the
+    standard segmented-scan combine — associative, so lax's log-depth
+    scan applies."""
+    def op(a, b):
+        ar, af, av = a
+        br, bf, bv = b
+        f = jnp.where(br == 1, bf, jnp.where(bf == 1, bf, af))
+        v = [jnp.where((br == 1) | (bf == 1), y, x)
+             for x, y in zip(av, bv)]
+        return (ar | br, f, v)
+
+    r, f, v = jax.lax.associative_scan(
+        op, (reset.astype(jnp.int32), has.astype(jnp.int32),
+             list(vals)))
+    return f == 1, v
+
+
+def _string_join_fill(build: Column, probe: Column, build_payloads):
+    """Shared core of the string joins: returns per-probe-row (in
+    original order) (matched, filled payloads, ambiguous) where
+    ``matched`` marks probe rows whose valid key equals a valid build
+    key, ``filled payloads`` carry that build row's payload values, and
+    ``ambiguous`` flags any duplicate valid build key (fan-out joins
+    are not expressible by a forward-fill)."""
+    nb, npr = build.num_rows, probe.num_rows
+    if nb == 0 or npr == 0:
+        z = jnp.zeros((npr,), jnp.bool_)
+        return (z, [jnp.zeros((npr,), p.dtype) for p in build_payloads],
+                jnp.bool_(False))
+    W = max(build.chars2d.shape[1] if build.chars2d is not None else 0,
+            probe.chars2d.shape[1] if probe.chars2d is not None else 0)
+    bsubs, _ = _string_key_words(build, "join", width=W)
+    psubs, _ = _string_key_words(probe, "join", width=W)
+    side = jnp.concatenate([jnp.zeros((nb,), jnp.int32),
+                            jnp.ones((npr,), jnp.int32)])
+    keys = [jnp.concatenate([b, p]) for b, p in zip(bsubs, psubs)]
+    # invalidity is a sort key too: valid build rows lead each (key,
+    # side) block contiguously, so the adjacent-pair duplicate check
+    # below is sound even with invalid rows carrying equal bytes
+    inval = jnp.concatenate(
+        [(~build.valid_bools()).astype(jnp.int32),
+         (~probe.valid_bools()).astype(jnp.int32)])
+    idx = jnp.concatenate([jnp.arange(nb, dtype=jnp.int32),
+                           jnp.arange(npr, dtype=jnp.int32)])
+    pay = [jnp.concatenate([p, jnp.zeros((npr,), p.dtype)])
+           for p in build_payloads]
+    m = len(keys)
+    out = jax.lax.sort((*keys, side, inval, idx, *pay),
+                       num_keys=m + 2, is_stable=True)
+    s_side, s_valid, s_idx = out[m], out[m + 1] == 0, out[m + 2]
+    s_pay = list(out[m + 3:])
+    s_keys = out[:m]
+    N = nb + npr
+    changed = jnp.zeros((N - 1,), jnp.bool_)
+    for k in s_keys:
+        changed = changed | (k[1:] != k[:-1])
+    reset = jnp.concatenate([jnp.ones((1,), jnp.bool_), changed])
+    is_build = (s_side == 0) & s_valid
+    # a valid build row directly after another valid build row in the
+    # same run = duplicate key value
+    prev_build = jnp.concatenate([jnp.zeros((1,), jnp.bool_),
+                                  is_build[:-1]])
+    ambiguous = jnp.any(is_build & prev_build & ~reset)
+    filled_has, filled_pay = _fill_forward_segmented(
+        reset, is_build, s_pay)
+    probe_matched = (s_side == 1) & s_valid & filled_has
+    # un-permute: sort (side, original idx) carrying the results; the
+    # probe block lands at [nb:] in original row order
+    out2 = jax.lax.sort(
+        (s_side, s_idx, probe_matched.astype(jnp.int32), *filled_pay),
+        num_keys=2, is_stable=True)
+    matched = out2[2][nb:] == 1
+    res_pay = [p[nb:] for p in out2[3:]]
+    return matched, res_pay, ambiguous
+
+
+def join_semi_mask_strings(build: Column, probe: Column) -> jnp.ndarray:
+    """Left-semi existence mask for STRING keys with Spark null
+    semantics (null keys never match).  Duplicate build keys are fine
+    for a semi join, so the ambiguity flag is ignored."""
+    matched, _, _ = _string_join_fill(build, probe, [])
+    return matched
+
+
+def sort_merge_join_strings(build: Column, build_payloads,
+                            probe: Column):
+    """Equi-join probe rows against a unique-valid-key STRING build
+    side: returns (payloads_for_probe list, matched, ambiguous).
+    Unmatched/null rows carry zero payloads with ``matched`` False;
+    ``ambiguous`` (a traced bool) is True when a valid build key value
+    repeats — the caller must host-check it like the overflow flags."""
+    matched, pay, ambiguous = _string_join_fill(
+        build, probe, list(build_payloads))
+    pay = [jnp.where(matched, p, jnp.zeros_like(p)) for p in pay]
+    return pay, matched, ambiguous
 
 
 # -- null-aware join wrappers ------------------------------------------------

@@ -253,6 +253,57 @@ def test_aggregate_string_key_capped_refused():
                              measures=[(None, "count")], max_groups=4)
 
 
+def test_string_join_vs_oracle(rng):
+    """String equi-join (two-sort forward-fill, no gathers): payload
+    lookup, unmatched/null probes, null build keys, prefix/NUL
+    near-collisions, and different padded widths on the two sides."""
+    from spark_rapids_jni_tpu.models.pipeline import (
+        sort_merge_join_strings, join_semi_mask_strings)
+    build_keys = ["alpha", "beta", "b", "b\x00", "", "zzz-long-key",
+                  None]
+    build_pay = np.array([10, 20, 30, 40, 50, 60, 70], np.int32)
+    bcol = Column.strings_padded(build_keys)
+    pool = build_keys[:-1] + ["missing", "alph", "alphaa", None, "bet"]
+    probe_keys = [pool[i] for i in rng.integers(0, len(pool), 200)]
+    pcol = Column.strings_padded(probe_keys)
+
+    pays, matched, ambiguous = sort_merge_join_strings(
+        bcol, [build_pay], pcol)
+    assert not bool(ambiguous)
+    got_m = np.asarray(matched)
+    got_p = np.asarray(pays[0])
+    lut = {k: int(v) for k, v in zip(build_keys, build_pay)
+           if k is not None}
+    for r, k in enumerate(probe_keys):
+        want = lut.get(k) if k is not None else None
+        if want is None:
+            assert not got_m[r], (r, k)
+        else:
+            assert got_m[r] and got_p[r] == want, (r, k, got_p[r])
+
+    semi = np.asarray(join_semi_mask_strings(bcol, pcol))
+    assert (semi == got_m).all()
+
+
+def test_string_join_duplicate_build_flags_ambiguous():
+    from spark_rapids_jni_tpu.models.pipeline import (
+        sort_merge_join_strings, join_semi_mask_strings)
+    bcol = Column.strings_padded(["x", "y", "x"])
+    pcol = Column.strings_padded(["x", "z"])
+    pays, matched, ambiguous = sort_merge_join_strings(
+        bcol, [np.array([1, 2, 3], np.int32)], pcol)
+    assert bool(ambiguous)
+    # a DUPLICATE NULL build key is not ambiguous (nulls never match)
+    bcol2 = Column.strings_padded(["x", None, None])
+    _, m2, amb2 = sort_merge_join_strings(
+        bcol2, [np.array([1, 2, 3], np.int32)], pcol)
+    assert not bool(amb2)
+    assert list(np.asarray(m2)) == [True, False]
+    # semi joins tolerate duplicates
+    semi = np.asarray(join_semi_mask_strings(bcol, pcol))
+    assert list(semi) == [True, False]
+
+
 def test_join_null_keys_never_match(rng):
     bkeys = np.array([1, 2, 2, 3, 0], np.int32)
     bvalid = np.array([1, 1, 0, 1, 0], bool)     # one null dup of key 2
