@@ -11,9 +11,10 @@ slices, chosen by XLA from the mesh layout.
 Static-shape design (XLA needs fixed buffer sizes where NCCL send/recv can
 be ragged): each device packs its rows into ``[P, capacity, row_size]``
 send buckets by partition id, all-to-alls the buckets, and carries per-bucket
-counts so receivers know the valid prefix of each bucket.  ``capacity`` is a
-static slack factor over the expected ``n_local / P``; an overflow flag is
-returned (checked on host) so callers can retry with more slack — the
+counts so receivers know the valid prefix of each bucket.  ``capacity`` is
+sized by an exact count pre-pass by default (overflow impossible, even under
+heavy key skew); an explicit ``capacity_factor`` estimate instead retries
+internally with doubled capacity when its overflow flag trips — the
 static-shape analogue of the reference's data-dependent batch re-planning
 (``build_batches`` host sync, ``row_conversion.cu:1521``).
 """
@@ -44,7 +45,10 @@ class ShuffleResult:
     ``row_valid``: bool mask over those slots,
     ``num_valid``: int32 scalar per device,
     ``overflow``: bool scalar — True anywhere means capacity was exceeded
-    and the shuffle must be retried with a larger ``capacity_factor``.
+    and rows were dropped.  :func:`shuffle_table_sharded` handles this
+    itself (exact pre-pass sizing by default; internal capacity-doubling
+    retry on the estimated path): callers only see a True flag when they
+    opted out with ``max_retries=0``.
     """
 
     rows: jnp.ndarray
@@ -62,6 +66,22 @@ class ShuffleResult:
     @classmethod
     def tree_unflatten(cls, aux, children):
         return cls(*children, aux)
+
+
+def _col_sig(c):
+    """Hashable structural signature of a column — everything
+    ``table_partition_specs`` and the exchange trace depend on besides
+    the input avals (which ``jax.jit`` keys on itself)."""
+    return (c.dtype, getattr(c.data, "ndim", None),
+            c.validity is not None, c.offsets is not None,
+            c.chars is not None, c.chars2d is not None,
+            c.lens is not None, c.capped,
+            tuple(_col_sig(ch) for ch in c.children) if c.children else ())
+
+
+# jitted exchange programs keyed on their static parameters (see attempt()
+# in shuffle_table_sharded); bounded in practice by the pow2 capacity grid
+_exchange_cache: dict = {}
 
 
 def _pack_buckets(rows2d, pids, num_parts: int, capacity: int):
@@ -178,11 +198,53 @@ def _string_layout_of(table: Table, layout):
     return slot_starts, fe_pad, row_size, widths
 
 
+def max_bucket_count(table: Table, key_cols: Sequence[int], mesh: Mesh,
+                     axis_name: str = "data", seed: int = 42) -> int:
+    """Exact-capacity pre-pass: the largest (source device, destination
+    partition) bucket the exchange will produce.  One cheap jit (hash +
+    bincount + pmax) before the row encode — the static-shape analogue of
+    the reference's data-dependent host sync (``build_batches``,
+    ``row_conversion.cu:1521``): spend one tiny device round-trip to size
+    the buffers exactly instead of guessing and overflowing."""
+    num_parts = mesh.shape[axis_name]
+    from spark_rapids_jni_tpu.parallel.mesh import table_partition_specs
+
+    cache_key = ("count", tuple(_col_sig(c) for c in table.columns),
+                 tuple(key_cols), num_parts, axis_name, mesh, seed,
+                 bool(jax.config.jax_enable_x64))
+    fn = _exchange_cache.get(cache_key)
+    if fn is None:
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(table_partition_specs(table, axis_name),),
+            out_specs=P(), check_vma=False)
+        def count(tbl):
+            pids = hash_partition_ids(
+                [tbl.columns[i] for i in key_cols], num_parts, seed)
+            counts = jnp.bincount(pids, length=num_parts).astype(jnp.int32)
+            return jax.lax.pmax(jnp.max(counts), axis_name)
+
+        fn = _exchange_cache[cache_key] = jax.jit(count)
+    return int(fn(table))
+
+
+def _align_capacity(capacity: int, num_parts: int) -> int:
+    # per-device slot count (num_parts * capacity) must land on a byte
+    # boundary: decode packs validity bitmasks per device and concatenates
+    # them across the mesh, so a non-multiple-of-8 count would misalign
+    # every later device's bits
+    capacity = max(8, capacity)
+    while (capacity * num_parts) % 8:
+        capacity += 1
+    return capacity
+
+
 def shuffle_table_sharded(table: Table, key_cols: Sequence[int],
                           mesh: Mesh, axis_name: str = "data",
-                          capacity_factor: float = 2.0,
+                          capacity_factor: Optional[float] = None,
                           seed: int = 42,
-                          method: str = "all_to_all") -> ShuffleResult:
+                          method: str = "all_to_all",
+                          max_retries: int = 4) -> ShuffleResult:
     """Hash-partition a row-sharded table across the mesh axis.
 
     Fixed-width tables exchange fixed-size JCUDF rows; string tables
@@ -190,21 +252,36 @@ def shuffle_table_sharded(table: Table, key_cols: Sequence[int],
     fixed section + one padded slot per string column) — the static-shape
     wire format the all-to-all needs, self-describing via each row's
     (offset, length) pairs.  Decode with :func:`decode_shuffle_result`.
+
+    Capacity sizing: with ``capacity_factor=None`` (the default) a cheap
+    count pre-pass (:func:`max_bucket_count`) sizes the buckets exactly,
+    so skewed key distributions — the normal case for group-by exchanges —
+    cannot overflow.  Passing an explicit factor skips the pre-pass and
+    estimates ``capacity = n_local / P * factor``; if that estimate
+    overflows, the exchange is retried with doubled capacity (host-checked,
+    at most ``max_retries`` times) before raising — the retry the
+    ``ShuffleResult.overflow`` contract promises, implemented here so no
+    caller has to.  ``max_retries=0`` opts out of the retry and returns
+    the flagged result for callers that inspect the flag themselves.
     """
+    if method not in ("all_to_all", "ring"):
+        raise ValueError(f"unknown shuffle method {method!r}")
     layout = compute_row_layout(table.dtypes)
     slot_starts, fe_pad, row_size, widths = _string_layout_of(table, layout)
     num_parts = mesh.shape[axis_name]
     n_local = table.num_rows // num_parts
-    # per-device slot count (num_parts * capacity) must land on a byte
-    # boundary: decode packs validity bitmasks per device and concatenates
-    # them across the mesh, so a non-multiple-of-8 count would misalign
-    # every later device's bits
-    capacity = max(8, int(n_local / num_parts * capacity_factor))
-    while (capacity * num_parts) % 8:
-        capacity += 1
+    exact = capacity_factor is None
+    # capacity quantizes up to a power of two on both paths: it is a
+    # static shape, so every distinct value is a full XLA recompile of
+    # the exchange program (and a permanent _exchange_cache entry) —
+    # pow2 rounding bounds the compiled variants to log2(n)
+    if exact:
+        need = max(8, max_bucket_count(table, key_cols, mesh, axis_name,
+                                       seed))
+    else:
+        need = max(8, int(n_local / num_parts * capacity_factor))
+    capacity = _align_capacity(1 << (need - 1).bit_length(), num_parts)
 
-    if method not in ("all_to_all", "ring"):
-        raise ValueError(f"unknown shuffle method {method!r}")
     make_body = (ring_bucket_exchange if method == "ring"
                  else bucket_exchange)
 
@@ -212,24 +289,56 @@ def shuffle_table_sharded(table: Table, key_cols: Sequence[int],
     rep = P()
     from spark_rapids_jni_tpu.parallel.mesh import table_partition_specs
 
-    @functools.partial(
-        shard_map, mesh=mesh,
-        in_specs=(table_partition_specs(table, axis_name),),
-        out_specs=(spec, spec, spec, rep),
-        check_vma=False)
-    def run(tbl):
-        if widths is not None:
-            rows2d = rc.padded_rows2d(tbl, layout, slot_starts, fe_pad,
-                                      row_size)
-        else:
-            rows2d = rc._assemble_fixed_rows(tbl, layout)
-        pids = hash_partition_ids(
-            [tbl.columns[i] for i in key_cols], num_parts, seed)
-        body = make_body(num_parts, capacity, axis_name)
-        rows, valid, num_valid, overflow = body(rows2d, pids)
-        return rows, valid, num_valid[None], overflow[None]
+    def attempt(capacity: int):
+        # the jitted exchange is cached on its true statics so repeated
+        # shuffles of same-shaped batches reuse one compiled program
+        # (jit retraces on aval changes by itself; the key pins what the
+        # trace closes over)
+        cache_key = (tuple(_col_sig(c) for c in table.columns),
+                     tuple(key_cols), num_parts, capacity, method,
+                     axis_name, mesh, seed, widths,
+                     bool(jax.config.jax_enable_x64))
+        fn = _exchange_cache.get(cache_key)
+        if fn is None:
+            @functools.partial(
+                shard_map, mesh=mesh,
+                in_specs=(table_partition_specs(table, axis_name),),
+                out_specs=(spec, spec, spec, rep),
+                check_vma=False)
+            def run(tbl):
+                if widths is not None:
+                    rows2d = rc.padded_rows2d(tbl, layout, slot_starts,
+                                              fe_pad, row_size)
+                else:
+                    rows2d = rc._assemble_fixed_rows(tbl, layout)
+                pids = hash_partition_ids(
+                    [tbl.columns[i] for i in key_cols], num_parts, seed)
+                body = make_body(num_parts, capacity, axis_name)
+                rows, valid, num_valid, overflow = body(rows2d, pids)
+                return rows, valid, num_valid[None], overflow[None]
 
-    rows, valid, num_valid, overflow = jax.jit(run)(table)
+            fn = _exchange_cache[cache_key] = jax.jit(run)
+        return fn(table)
+
+    rows, valid, num_valid, overflow = attempt(capacity)
+    if not exact and max_retries > 0:
+        # host-checked doubling retry.  The blocking flag sync only
+        # happens here: exact sizing cannot overflow and the
+        # max_retries=0 opt-out returns the un-synced flagged result,
+        # so both stay fully async
+        for _ in range(max_retries):
+            if not bool(jax.device_get(overflow).any()):
+                break
+            capacity = _align_capacity(capacity * 2, num_parts)
+            rows, valid, num_valid, overflow = attempt(capacity)
+        else:
+            if bool(jax.device_get(overflow).any()):
+                raise RuntimeError(
+                    f"shuffle bucket overflow persists after "
+                    f"{max_retries} capacity doublings (final "
+                    f"capacity={capacity}); the key distribution "
+                    "concentrates more rows on one (device, partition) "
+                    "bucket than the exchange can grow to hold")
     from spark_rapids_jni_tpu.utils import metrics
     metrics.op("shuffle_table_sharded", rows=table.num_rows,
                bytes_=table.num_rows * row_size)

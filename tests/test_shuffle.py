@@ -83,14 +83,17 @@ def test_overflow_flag(rng, mesh):
     t = Table((Column.from_numpy(key, INT64),))
     ts = shard_table(t, mesh)
     res = shuffle_table_sharded(ts, key_cols=[0], mesh=mesh,
-                                capacity_factor=1.0)
+                                capacity_factor=1.0, max_retries=0)
     assert bool(np.asarray(res.overflow)[0])
-    # retry with enough slack: every row targets one partition, so capacity
-    # must cover all of a device's local rows
+    # the built-in retry doubles capacity until the exchange fits
     res2 = shuffle_table_sharded(ts, key_cols=[0], mesh=mesh,
-                                 capacity_factor=8.0 * 8)
+                                 capacity_factor=1.0)
     assert not bool(np.asarray(res2.overflow)[0])
     assert int(np.asarray(res2.num_valid).sum()) == n
+    # and the exact pre-pass sizes it right on the first attempt
+    res3 = shuffle_table_sharded(ts, key_cols=[0], mesh=mesh)
+    assert not bool(np.asarray(res3.overflow)[0])
+    assert int(np.asarray(res3.num_valid).sum()) == n
 
 
 def test_ring_exchange_matches_all_to_all(rng, mesh, x64_both):
@@ -114,8 +117,32 @@ def test_ring_exchange_overflow_flag(rng, mesh):
     t = Table((Column.from_numpy(key, INT64),))
     ts = shard_table(t, mesh)
     res = shuffle_table_sharded(ts, key_cols=[0], mesh=mesh,
-                                capacity_factor=1.0, method="ring")
+                                capacity_factor=1.0, method="ring",
+                                max_retries=0)
     assert bool(np.asarray(res.overflow)[0])
+
+
+def test_hot_key_skew_exact_capacity(rng, mesh, x64_both):
+    """One key owns >60% of the rows — the normal shape of a group-by
+    exchange.  Default capacity sizing (the count pre-pass) must absorb
+    the skew without any manual factor tuning, and every row must still
+    arrive exactly once."""
+    n = 8 * 64
+    hot = rng.random(n) < 0.62
+    key = np.where(hot, 7, rng.integers(0, 1 << 30, n)).astype(np.int64)
+    payload = rng.integers(-2**31, 2**31, n, dtype=np.int32)
+    t = Table((Column.from_numpy(key, INT64),
+               Column.from_numpy(payload, INT32)))
+    ts = shard_table(t, mesh)
+    res = shuffle_table_sharded(ts, key_cols=[0], mesh=mesh)
+    assert not bool(np.asarray(res.overflow)[0])
+    assert int(np.asarray(res.num_valid).sum()) == n
+    out = decode_shuffle_result(res, t.dtypes, mesh)
+    mask = np.asarray(res.row_valid)
+    got = sorted(zip(_rows(out.columns[0])[mask].tolist(),
+                     _rows(out.columns[1])[mask].tolist()))
+    exp = sorted(zip(key.tolist(), payload.tolist()))
+    assert got == exp
 
 
 # ---------------------------------------------------------------------------

@@ -679,15 +679,16 @@ def test_distributed_string_groupby_via_shuffle(rng, cpu_devices):
     import jax.numpy as jnp
     parts = []
     num_parts = 8
+    dev_mesh = make_mesh(cpu_devices[:1])
     rows = np.asarray(res.rows)
-    valid = np.asarray(res.valid).reshape(num_parts, -1)
+    valid = np.asarray(res.row_valid).reshape(num_parts, -1)
     per = rows.shape[0] // num_parts
     for d in range(num_parts):
         sub_res = type(res)(jnp.asarray(rows[d * per:(d + 1) * per]),
                             jnp.asarray(valid[d].reshape(-1)),
                             res.num_valid, res.overflow,
                             res.str_widths)
-        sub = decode_shuffle_result(sub_res, t.dtypes)
+        sub = decode_shuffle_result(sub_res, t.dtypes, dev_mesh)
         r, have, ng = hash_aggregate_table(
             sub, key_idxs=[0], measures=[(None, "count"), (1, "sum")],
             max_groups=32, mask=jnp.asarray(valid[d].reshape(-1)))
@@ -697,8 +698,9 @@ def test_distributed_string_groupby_via_shuffle(rng, cpu_devices):
 
     exp = {}
     for k, v, mv in zip(keys, vals, vv):
-        c, s = exp.get(k, (0, None))
-        exp[k] = (c + 1, ((0 if s is None else s) + int(v)) if mv else s)
+        c, s = exp.get((k,), (0, None))
+        exp[(k,)] = (c + 1,
+                     ((0 if s is None else s) + int(v)) if mv else s)
     assert {k: tuple(v) for k, v in got.items()} == exp
 
 
