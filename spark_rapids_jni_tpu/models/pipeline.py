@@ -773,8 +773,25 @@ def hash_aggregate_table(source, key_idxs: Sequence[int],
             continue
         mcore.append((c.data, op, c.valid_bools()))
 
-    gkeys, outs, metas, have, num_groups = _hash_aggregate_nulls(
-        sort_keys, mcore, live, max_groups)
+    # narrow/packed keys + single-word measures: aggregate by DIRECT
+    # domain index (one slot per possible key) instead of the O(n log n)
+    # variadic sort — the north-star HashAggregate path.  Multi-word
+    # (int64/decimal128) measures keep the sort: their limb kernels
+    # would need nch * domain scatter segments
+    direct = (n > 0 and per_key
+              and all(s[0] == "packed" for s in per_key)
+              and all(not isinstance(v, tuple) for v, _, _ in mcore))
+    if direct:
+        domain = 1
+        for s in per_key:
+            domain *= (1 << s[1]) + 1
+        direct = domain <= _DOMAIN_DIRECT_MAX
+    if direct:
+        gkeys, outs, metas, have, num_groups = _hash_aggregate_domain(
+            sort_keys, [s[1] for s in per_key], mcore, live, max_groups)
+    else:
+        gkeys, outs, metas, have, num_groups = _hash_aggregate_nulls(
+            sort_keys, mcore, live, max_groups)
 
     out_cols = []
     ki = 0
@@ -845,6 +862,142 @@ def hash_aggregate_table(source, key_idxs: Sequence[int],
                 else jnp.stack(out, axis=1)
         out_cols.append(Column(dt, out, pack_bools(valid)))
     return Table(tuple(out_cols)), have, num_groups
+
+
+# widest packed-key domain the direct aggregate will allocate slots for
+# (int32 accumulators: 2^21 slots = 8MB per measure array — well inside
+# HBM, far above the 2^16+1 an int16 key needs)
+_DOMAIN_DIRECT_MAX = 1 << 21
+
+
+def _minmax_identity(op: str, dtype):
+    """The op's identity element: rows masked out of a MIN/MAX carry it
+    so they cannot win the reduction (shared by the sort and
+    domain-direct aggregate paths)."""
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf if op == "min" else -jnp.inf, dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.max if op == "min" else info.min, dtype)
+
+
+def _hash_aggregate_domain(packed, bits_list, measures, live,
+                           max_groups: int):
+    """Domain-direct group-by for narrow packed keys: scatter-add each
+    row straight into the slot its key addresses (``2^(bits+1)`` slots
+    per key — the +1 is the null flag riding above the value bits), then
+    compact live slots into the ``max_groups`` output in ascending
+    packed-key order — the same order and overflow semantics as the
+    variadic-sort path (:func:`_hash_aggregate_nulls`), without the
+    O(n log n) sort that dominates the aggregate at row scale.
+
+    ``packed``: one int32 array per key, each ``(null << bits) | value``;
+    ``measures``: (values, op, valid_or_None) with single-word values.
+    Returns (gkeys, outs, metas, have, num_groups) exactly like
+    :func:`_hash_aggregate_nulls`."""
+    # packed values span [0, 2^bits] — valid data in [0, 2^bits), the
+    # null row exactly at 1<<bits (data is zeroed under null) — so each
+    # key's radix is (1<<bits)+1; mixed-radix math needs no pow2 dims
+    dims = [(1 << b) + 1 for b in bits_list]
+    idx = packed[0]
+    for p, dim in zip(packed[1:], dims[1:]):
+        idx = idx * dim + p
+    D = 1
+    for d in dims:
+        D *= d
+    # TPU scatters pay per PASS, not per lane: batch every sum-typed
+    # contribution of a dtype into one [n, K] stacked segment_sum, and
+    # min/max likewise per (op, dtype) — three-ish scatter passes total
+    # instead of one per measure.  Integer sums accumulate in the
+    # promoted dtype and truncate back at the end, which preserves the
+    # sort path's wrap-at-width semantics (two's-complement truncation
+    # commutes with modular addition).
+    sum_cols = {}      # accum dtype -> list of [n] contribution arrays
+    mm_cols = {}       # (op, dtype) -> list of [n] identity-filled arrays
+    plan = []          # per measure: how to read the batched results
+
+    def _sum_slot(arr):
+        cols = sum_cols.setdefault(arr.dtype, [])
+        cols.append(arr)
+        return (arr.dtype, len(cols) - 1)
+
+    star_slot = _sum_slot(live.astype(jnp.int32))
+    for v, op, vvalid in measures:
+        mv = live if vvalid is None else live & vvalid
+        if op == "count":
+            plan.append(("count",
+                         star_slot if vvalid is None
+                         else _sum_slot(mv.astype(jnp.int32)), None))
+            continue
+        nn_slot = _sum_slot(mv.astype(jnp.int32))
+        if op in ("sum", "avg"):
+            acc = jnp.promote_types(v.dtype, jnp.int32) \
+                if jnp.issubdtype(v.dtype, jnp.integer) else v.dtype
+            vs = jnp.where(mv, v, 0).astype(acc)
+            plan.append((op, _sum_slot(vs), nn_slot, v.dtype))
+        else:
+            ident = _minmax_identity(op, v.dtype)
+            cols = mm_cols.setdefault((op, v.dtype), [])
+            cols.append(jnp.where(mv, v, ident))
+            plan.append((op, (v.dtype, len(cols) - 1), nn_slot))
+
+    sums_d = {dt: jax.ops.segment_sum(jnp.stack(cols, axis=1), idx,
+                                      num_segments=D)
+              for dt, cols in sum_cols.items()}
+    mm_d = {}
+    for (op, dt), cols in mm_cols.items():
+        red = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+        mm_d[(op, dt)] = red(jnp.stack(cols, axis=1), idx, num_segments=D)
+
+    counts_d = sums_d[jnp.dtype(jnp.int32)][:, star_slot[1]]
+    live_d = counts_d > 0
+    # ascending-slot rank of each live slot; slots past max_groups (and
+    # dead slots) route to the dump slot that is sliced away
+    pos = jnp.cumsum(live_d.astype(jnp.int32)) - 1
+    num_groups = jnp.sum(live_d.astype(jnp.int32))
+    out_idx = jnp.where(live_d & (pos < max_groups), pos, max_groups)
+
+    def compact(a_d):
+        shape = (max_groups + 1,) + a_d.shape[1:]
+        return jnp.zeros(shape, a_d.dtype).at[out_idx].set(a_d) \
+            [:max_groups]
+
+    sums_g = {dt: compact(m) for dt, m in sums_d.items()}
+    mm_g = {k: compact(m) for k, m in mm_d.items()}
+
+    star = sums_g[jnp.dtype(jnp.int32)][:, star_slot[1]]
+    have = star > 0
+    # each kept slot's id decomposes back into its packed key values
+    slot = compact(jnp.arange(D, dtype=jnp.int32))
+    gkeys = []
+    rem = slot
+    for dim in reversed(dims):
+        gkeys.append(jnp.where(have, rem % dim, 0))
+        rem = rem // dim
+    gkeys.reverse()
+
+    outs, metas = [], []
+    for entry in plan:
+        op = entry[0]
+        if op == "count":
+            outs.append(sums_g[entry[1][0]][:, entry[1][1]])
+            metas.append(None)
+            continue
+        nn = sums_g[entry[2][0]][:, entry[2][1]]
+        if op in ("sum", "avg"):
+            _, vslot, _, vdt = entry
+            s = sums_g[vslot[0]][:, vslot[1]]
+            if s.dtype != vdt:
+                s = s.astype(vdt)    # wrap back to the measure's width
+            if op == "avg":
+                s = s.astype(jnp.float32) / jnp.maximum(nn, 1) \
+                    .astype(jnp.float32)
+            outs.append(s)
+        else:
+            _, mslot, _ = entry
+            r = mm_g[(op, mslot[0])][:, mslot[1]]
+            outs.append(jnp.where(nn > 0, r, 0))
+        metas.append(nn > 0)
+    return gkeys, outs, metas, have, num_groups
 
 
 def _hash_aggregate_nulls(sort_keys, measures, live, max_groups: int):
@@ -973,13 +1126,7 @@ def _hash_aggregate_nulls(sort_keys, measures, live, max_groups: int):
                     .astype(jnp.float32)
             outs.append(s)
         else:
-            if jnp.issubdtype(vo.dtype, jnp.floating):
-                ident = jnp.array(jnp.inf if op == "min" else -jnp.inf,
-                                  vo.dtype)
-            else:
-                info = jnp.iinfo(vo.dtype)
-                ident = jnp.array(info.max if op == "min" else info.min,
-                                  vo.dtype)
+            ident = _minmax_identity(op, vo.dtype)
             red = jax.ops.segment_min if op == "min" \
                 else jax.ops.segment_max
             r = red(jnp.where(mvalid, vo, ident), seg_c,

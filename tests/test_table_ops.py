@@ -794,6 +794,60 @@ def test_aggregate_narrow_key_packed_path(rng):
         assert got == ok, dt
 
 
+def test_aggregate_domain_direct_matches_sort_path(rng, monkeypatch):
+    """The domain-direct aggregate (narrow packed keys scatter straight
+    into per-key slots) must produce slot-for-slot identical results to
+    the variadic-sort path across every op, with masks, null keys and
+    null measures, single and composite narrow keys."""
+    from spark_rapids_jni_tpu import INT8, INT16, BOOL8
+    from spark_rapids_jni_tpu.models import pipeline as pl
+    n = 600
+    k16 = rng.integers(-3000, 3000, n).astype(np.int16)
+    k8 = rng.integers(-128, 128, n).astype(np.int8)
+    kb = (rng.random(n) > 0.5)
+    kv16 = rng.random(n) > 0.15
+    kv8 = rng.random(n) > 0.15
+    vals = rng.integers(-50, 50, n).astype(np.int32)
+    fvals = rng.random(n).astype(np.float32)
+    vvalid = rng.random(n) > 0.2
+    import jax.numpy as jnp
+    mask = jnp.asarray(rng.random(n) > 0.3)
+    measures = [(2, "sum"), (2, "min"), (2, "max"), (2, "avg"),
+                (2, "count"), (None, "count"), (3, "sum")]
+    real_domain = pl._hash_aggregate_domain
+    for key_idxs in ([0], [1], [0, 1], [0, 4], [1, 4]):
+        t = Table((Column.from_numpy(k16, INT16, valid=kv16),
+                   Column.from_numpy(k8, INT8, valid=kv8),
+                   Column.from_numpy(vals, INT32, valid=vvalid),
+                   Column.from_numpy(fvals, FLOAT32),
+                   Column.from_numpy(kb.astype(np.uint8), BOOL8)))
+        # widen the domain cap so even the 2^25 int16+int8 composite
+        # rides the direct path, and assert it actually did
+        took = []
+        monkeypatch.setattr(pl, "_DOMAIN_DIRECT_MAX", 1 << 26)
+        monkeypatch.setattr(
+            pl, "_hash_aggregate_domain",
+            lambda *a, **k: took.append(1) or real_domain(*a, **k))
+        fast = hash_aggregate_table(t, key_idxs=key_idxs,
+                                    measures=measures, max_groups=1024,
+                                    mask=mask)
+        assert took, key_idxs
+        monkeypatch.setattr(pl, "_DOMAIN_DIRECT_MAX", 0)
+        slow = hash_aggregate_table(t, key_idxs=key_idxs,
+                                    measures=measures, max_groups=1024,
+                                    mask=mask)
+        monkeypatch.undo()
+        assert int(np.asarray(fast[2])) == int(np.asarray(slow[2]))
+        np.testing.assert_array_equal(np.asarray(fast[1]),
+                                      np.asarray(slow[1]))
+        for cf, cs in zip(fast[0].columns, slow[0].columns):
+            np.testing.assert_array_equal(np.asarray(cf.valid_bools()),
+                                          np.asarray(cs.valid_bools()))
+            hv = np.asarray(fast[1])
+            np.testing.assert_array_equal(np.asarray(cf.data)[hv],
+                                          np.asarray(cs.data)[hv])
+
+
 def test_join_sentinel_interleave_with_duplicates():
     """Null build rows parked at the sentinel must order strictly AFTER
     real rows whose key IS dtype max — the gather window may only cover
