@@ -780,7 +780,10 @@ def hash_aggregate_table(source, key_idxs: Sequence[int],
     # would need nch * domain scatter segments
     direct = (n > 0 and per_key
               and all(s[0] == "packed" for s in per_key)
-              and all(not isinstance(v, tuple) for v, _, _ in mcore))
+              # COUNT only reads validity, so multi-word values do not
+              # disqualify it from the direct path
+              and all(op == "count" or not isinstance(v, tuple)
+                      for v, op, _ in mcore))
     if direct:
         domain = 1
         for s in per_key:
@@ -1409,20 +1412,63 @@ def sort_merge_join_strings(build: Column, build_payloads,
 
 # -- null-aware join wrappers ------------------------------------------------
 
-def _join_key_and_valid(source, idx: int):
-    c = _source_column(source, idx)
-    if c.data.ndim == 2:
-        raise NotImplementedError(
-            "64-bit join keys: probe via two int32 word joins or cast")
-    return c.data, c.valid_bools()
+def _dense_join_ids(build_c: Column, probe_c: Column):
+    """Equality- and order-preserving int32 ids for multi-word (64-bit
+    plane-pair) join keys: concatenate both sides' word arrays
+    (hi signed, lo — :func:`_key_subarrays`), ONE variadic sort with the
+    row index riding, run-id the equality runs, and un-permute.  The ids
+    feed the int32 searchsorted join bodies unchanged — the two-word
+    composite probe the TPC-DS SF3000 surrogate keys (>2^31) need,
+    without a 64-bit searchsorted."""
+    bw = _key_subarrays(build_c)
+    pw = _key_subarrays(probe_c)
+    nb = bw[0].shape[0]
+    n = nb + pw[0].shape[0]
+    if n == 0:
+        z = jnp.zeros((0,), jnp.int32)
+        return z, z
+    words = [jnp.concatenate([b, p]) for b, p in zip(bw, pw)]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    out = jax.lax.sort((*words, idx), num_keys=len(words), is_stable=True)
+    sw, sidx = out[:len(words)], out[-1]
+    changed = jnp.zeros((n - 1,), jnp.bool_)
+    for w in sw:
+        changed = changed | (w[1:] != w[:-1])
+    ids_sorted = jnp.cumsum(jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), changed.astype(jnp.int32)]))
+    ids = jnp.zeros((n,), jnp.int32).at[sidx].set(ids_sorted)
+    return ids[:nb], ids[nb:]
+
+
+def _join_keys_pair(build, build_key: int, probe, probe_key: int):
+    """(bk, bv, pk, pv) sortable key arrays + validity for a join's two
+    key columns; 64-bit plane-pair keys densify to int32 ids jointly
+    (:func:`_dense_join_ids`)."""
+    bc = _source_column(build, build_key)
+    pc = _source_column(probe, probe_key)
+    for c in (bc, pc):
+        if c.data.ndim == 2 and c.dtype.itemsize != 8:
+            raise NotImplementedError(
+                f"{c.dtype.kind} join keys: only 64-bit plane-pair "
+                "keys densify; cast wider keys upstream")
+    b2, p2 = bc.data.ndim == 2, pc.data.ndim == 2
+    if b2 != p2:
+        raise ValueError(
+            "join key representation mismatch: one side is a 64-bit "
+            "plane pair and the other is not — cast keys to a common "
+            "type upstream as Spark's planner does")
+    if b2:
+        bk, pk = _dense_join_ids(bc, pc)
+    else:
+        bk, pk = bc.data, pc.data
+    return bk, bc.valid_bools(), pk, pc.valid_bools()
 
 
 def join_semi_mask_table(build, build_key: int, probe,
                          probe_key: int) -> jnp.ndarray:
     """Left-semi existence mask with Spark null semantics: null probe
     keys never match; null build keys match nothing."""
-    bk, bv = _join_key_and_valid(build, build_key)
-    pk, pv = _join_key_and_valid(probe, probe_key)
+    bk, bv, pk, pv = _join_keys_pair(build, build_key, probe, probe_key)
     # exclude null build rows: move them to a sentinel AND bound-check
     # probe matches against the count of real rows (a live probe equal
     # to the sentinel cannot false-match: its hits are range-checked
@@ -1443,8 +1489,7 @@ def join_inner_table(build, build_key: int, build_payload: int,
     the gathered payload's own validity (a matched row whose payload is
     null stays in the join output with ``payload_valid`` False, exactly
     Spark's inner-join-then-project semantics)."""
-    bk, bv = _join_key_and_valid(build, build_key)
-    pk, pv = _join_key_and_valid(probe, probe_key)
+    bk, bv, pk, pv = _join_keys_pair(build, build_key, probe, probe_key)
     bpc = _source_column(build, build_payload)
     bp = bpc.data
     bpv = bpc.valid_bools()
@@ -1490,34 +1535,56 @@ def _exchange_with_validity(table: Table, key_idx: int, num_parts: int,
     returned unpacked so callers avoid a pack/unpack roundtrip in the
     hot step.
 
-    Columns must be int32-representable [n] arrays (the payload stacks
-    them with the flag word), and at most 31 of them (one validity bit
-    each in the int32 flag word — exceeding it fails loudly at trace
-    time via the int32 shift overflow)."""
+    Columns are int32-representable [n] arrays or 64-bit [2, n] plane
+    pairs (each pair rides as two payload words and is rebuilt on the
+    receive side), and at most 31 of them (one validity bit each in the
+    int32 flag word)."""
     from spark_rapids_jni_tpu.parallel.shuffle import bucket_exchange
-    from spark_rapids_jni_tpu.table import INT32, pack_bools
+    from spark_rapids_jni_tpu.table import pack_bools
     cols = table.columns
+    if len(cols) > 31:
+        raise ValueError(
+            f"{len(cols)} columns exceed the 31 validity bits of the "
+            "exchange's int32 flag word; split the exchange")
     key = cols[key_idx]
-    pids = pmod(murmur3_hash([Column(INT32, key.data)]), num_parts)
+    pids = pmod(murmur3_hash([Column(key.dtype, key.data)]), num_parts)
     flags = cols[0].valid_bools().astype(jnp.int32)
     for j, c in enumerate(cols[1:], start=1):
         flags = flags | (c.valid_bools().astype(jnp.int32) << j)
-    payload = jnp.stack([c.data for c in cols] + [flags], axis=1)
+    words, spans = [], []          # spans: (first word, word count)
+    for c in cols:
+        if c.data.ndim == 2:
+            spans.append((len(words), 2))
+            words.extend(
+                jax.lax.bitcast_convert_type(c.data[p], jnp.int32)
+                for p in range(2))
+        else:
+            spans.append((len(words), 1))
+            words.append(c.data)
+    payload = jnp.stack(words + [flags], axis=1)
     exchange = bucket_exchange(num_parts, capacity, axis_name)
     recv, slot_valid, _, overflow = exchange(payload, pids)
-    r_flags = recv[:, len(cols)]
+    r_flags = recv[:, len(words)]
     valids = [slot_valid & ((r_flags & (1 << j)) != 0)
               for j in range(len(cols))]
-    out = Table(tuple(
-        Column(INT32, recv[:, j], pack_bools(v))
-        for j, v in enumerate(valids)))
-    return out, valids, slot_valid, overflow
+    out_cols = []
+    for (start, nw), c, v in zip(spans, cols, valids):
+        if nw == 2:
+            data = jnp.stack(
+                [jax.lax.bitcast_convert_type(recv[:, start + p],
+                                              jnp.uint32)
+                 for p in range(2)], axis=0)
+        else:
+            data = recv[:, start]
+        out_cols.append(Column(c.dtype, data, pack_bools(v)))
+    return Table(tuple(out_cols)), valids, slot_valid, overflow
 
 
 def distributed_q72_table_step(mesh, axis_name="data",
                                capacity_factor: float = 8.0,
                                join_expansion: int = 4,
-                               max_groups: int = MAX_GROUPS):
+                               max_groups: int = MAX_GROUPS,
+                               key_dtype=None):
     """The q72 shape over TABLES: row-sharded (item, week, quantity)
     columns WITH validity hash-exchange across the mesh (null flags ride
     the payload), join a replicated build Table with null-key exclusion,
@@ -1531,10 +1598,18 @@ def distributed_q72_table_step(mesh, axis_name="data",
     null-key groups cross devices (the host partial merge stays
     key-numeric); null quantities drop at the filter (NULL comparisons
     are not true) and null inventory payloads drop the same way.
+
+    ``key_dtype``: the item key's dtype — INT32 (default) or INT64 for
+    SF3000-scale surrogate keys (>2^31): the [2, n] plane pair rides the
+    exchange as two payload words and joins via the dense-id composite
+    probe (:func:`_dense_join_ids`); the build table's key column must
+    match.
     """
     from jax.sharding import PartitionSpec as P
     from spark_rapids_jni_tpu.table import INT32, pack_bools
     num_parts = mesh.shape[axis_name]
+    kdt = INT32 if key_dtype is None else key_dtype
+    wide_key = kdt.itemsize == 8 and not jax.config.jax_enable_x64
 
     def step(tbl, build):
         n_local = tbl.num_rows
@@ -1550,8 +1625,10 @@ def distributed_q72_table_step(mesh, axis_name="data",
             build, 0, 1, probe, 0, join_cap)
         live = jvalid & qv[pidx] & inv_valid \
             & (inv_q < r_qty.data[pidx])
+        item_data = r_item.data[:, pidx] if r_item.data.ndim == 2 \
+            else r_item.data[pidx]
         joined = Table((
-            Column(INT32, r_item.data[pidx], pack_bools(iv[pidx])),
+            Column(kdt, item_data, pack_bools(iv[pidx])),
             Column(INT32, r_week.data[pidx], pack_bools(wv[pidx])),
             Column(INT32, r_qty.data[pidx], pack_bools(qv[pidx])),
         ))
@@ -1565,12 +1642,17 @@ def distributed_q72_table_step(mesh, axis_name="data",
     from jax import shard_map
     from spark_rapids_jni_tpu.table import INT32 as _I32
     spec = P(axis_name)
-    # result table: 2 key columns + COUNT + SUM, each (data, validity)
-    out_tree = Table(tuple(Column(_I32, spec, spec) for _ in range(4)))
+    kspec = P(None, axis_name) if wide_key else spec
+    krep = P(None, None) if wide_key else P()
+    # result table: 2 key columns + COUNT + SUM, each (data, validity);
+    # the item key keeps its dtype (64-bit pairs concat on axis 1)
+    out_tree = Table((Column(kdt, kspec, spec),)
+                     + tuple(Column(_I32, spec, spec) for _ in range(3)))
     # input columns must CARRY validity arrays (all-valid columns pass
     # np.ones masks): shard_map specs are structural
-    in_probe = Table(tuple(Column(_I32, spec, spec) for _ in range(3)))
-    in_build = Table(tuple(Column(_I32, P(), P()) for _ in range(2)))
+    in_probe = Table((Column(kdt, kspec, spec),)
+                     + tuple(Column(_I32, spec, spec) for _ in range(2)))
+    in_build = Table((Column(kdt, krep, P()), Column(_I32, P(), P())))
     return shard_map(step, mesh=mesh,
                      in_specs=(in_probe, in_build),
                      out_specs=(out_tree, spec, spec, spec),
@@ -1579,7 +1661,8 @@ def distributed_q72_table_step(mesh, axis_name="data",
 
 def distributed_q95_table_step(mesh, axis_name="data",
                                capacity_factor: float = 8.0,
-                               max_groups: int = MAX_GROUPS):
+                               max_groups: int = MAX_GROUPS,
+                               key_dtype=None):
     """The q95 shape over TABLES: web_sales-like (order, ship_date, net)
     columns WITH validity hash-exchange by order key, left-semi against a
     replicated returned-orders Table (null keys never match on either
@@ -1596,10 +1679,17 @@ def distributed_q95_table_step(mesh, axis_name="data",
     max).  Null ship dates form a null-key group whose key column is
     null; null nets drop from SUM/MIN/MAX but still COUNT (the order key
     is non-null by the semi join).
+
+    ``key_dtype``: the order key's dtype — INT32 (default) or INT64 for
+    ticket numbers past 2^31; the semi join then probes via the
+    dense-id composite (:func:`_dense_join_ids`) and the returned
+    table's key column must match.
     """
     from jax.sharding import PartitionSpec as P
     from spark_rapids_jni_tpu.table import INT32
     num_parts = mesh.shape[axis_name]
+    kdt = INT32 if key_dtype is None else key_dtype
+    wide_key = kdt.itemsize == 8 and not jax.config.jax_enable_x64
 
     def step(tbl, returned):
         n_local = tbl.num_rows
@@ -1618,10 +1708,13 @@ def distributed_q95_table_step(mesh, axis_name="data",
 
     from jax import shard_map
     spec = P(axis_name)
+    kspec = P(None, axis_name) if wide_key else spec
+    krep = P(None, None) if wide_key else P()
     # result table: ship_date key + COUNT + SUM + MIN + MAX
     out_tree = Table(tuple(Column(INT32, spec, spec) for _ in range(5)))
-    in_probe = Table(tuple(Column(INT32, spec, spec) for _ in range(3)))
-    in_returned = Table((Column(INT32, P(), P()),))
+    in_probe = Table((Column(kdt, kspec, spec),)
+                     + tuple(Column(INT32, spec, spec) for _ in range(2)))
+    in_returned = Table((Column(kdt, krep, P()),))
     return shard_map(step, mesh=mesh,
                      in_specs=(in_probe, in_returned),
                      out_specs=(out_tree, spec, spec, spec),

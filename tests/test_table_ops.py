@@ -343,6 +343,46 @@ def test_join_sentinel_key_with_null_build(rng):
     assert got == [(1, 5)]
 
 
+def test_join_int64_keys_beyond_int32(rng, x64_both):
+    """int64 join keys spanning >2^31 (TPC-DS SF3000 surrogate/ticket
+    keys): the dense-id composite probe must join exactly, with
+    duplicate build keys, null keys on both sides, and key values whose
+    low AND high words collide across distinct keys."""
+    base = 3 << 32
+    bkeys = np.array([base + 1, base + 2, base + 2, -(base + 2),
+                      (7 << 32) + 2, 5], np.int64)
+    bvalid = np.array([1, 1, 1, 1, 1, 0], bool)
+    bpay = np.array([10, 20, 21, 30, 40, 99], np.int32)
+    pkeys = np.array([base + 2, -(base + 2), (7 << 32) + 2, base + 1,
+                      5, base + 9], np.int64)
+    pvalid = np.array([1, 1, 1, 1, 0, 1], bool)
+    build = Table((Column.from_numpy(bkeys, INT64, valid=bvalid),
+                   Column.from_numpy(bpay, INT32)))
+    probe = Table((Column.from_numpy(pkeys, INT64, valid=pvalid),))
+    pidx, pay, pay_valid, valid, total, overflow = join_inner_table(
+        build, 0, 1, probe, 0, capacity=16)
+    assert not bool(np.asarray(overflow))
+    got = sorted(zip(np.asarray(pidx)[np.asarray(valid)].tolist(),
+                     np.asarray(pay)[np.asarray(valid)].tolist()))
+    # probe 0 (base+2) hits both non-null dups; 1 hits the negative twin;
+    # 2 hits the hi-word-differing key; 3 hits base+1; null probe 4 and
+    # unmatched 5 emit nothing
+    assert got == [(0, 20), (0, 21), (1, 30), (2, 40), (3, 10)]
+    sm = np.asarray(join_semi_mask_table(build, 0, probe, 0))
+    assert sm.tolist() == [True, True, True, True, False, False]
+
+
+def test_join_int64_key_representation_mismatch():
+    from spark_rapids_jni_tpu.models.pipeline import _join_keys_pair
+    build = Table((Column.from_numpy(
+        np.array([1], np.int64), INT64),))
+    probe = Table((Column.from_numpy(np.array([1], np.int32), INT32),))
+    if build.columns[0].data.ndim != 2:
+        pytest.skip("x64 on: both sides 1-D, no mismatch to detect")
+    with pytest.raises(ValueError, match="mismatch"):
+        _join_keys_pair(build, 0, probe, 0)
+
+
 def test_distributed_q72_table_step_nulls(rng, cpu_devices):
     """The Table-level q72 step: validity rides the exchange, null keys
     never join, null quantities/inventories drop at the filter; totals
