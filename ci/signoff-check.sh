@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# DCO signoff gate: every commit in the PR range must carry a
+# `Signed-off-by: Name <email>` trailer (the Developer Certificate of
+# Origin contract the reference enforces via its signoff-check action,
+# /root/reference/.github/workflows/signoff-check.yml).
+#
+# Usage: signoff-check.sh <base_ref> <head_ref>
+# Exits non-zero listing every commit missing the trailer.
+set -euo pipefail
+
+base="${1:?base ref}"
+head="${2:?head ref}"
+
+missing=0
+while read -r sha; do
+    if ! git show -s --format=%B "$sha" \
+            | grep -Eq '^Signed-off-by: .+ <.+@.+>$'; then
+        echo "missing Signed-off-by: $(git show -s --format='%h %s' "$sha")"
+        missing=1
+    fi
+done < <(git rev-list --no-merges "$base".."$head")
+
+if [ "$missing" -ne 0 ]; then
+    echo
+    echo "All commits need a DCO signoff trailer; amend with:"
+    echo "  git commit --amend --signoff   (or git rebase --signoff $base)"
+    exit 1
+fi
+echo "signoff-check: all commits carry Signed-off-by"

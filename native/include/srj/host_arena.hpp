@@ -61,9 +61,13 @@ class HostArena {
 
   Stats stats() const;
 
- private:
+  // The pooled block size a request of `size` bytes receives — public
+  // so the C API can report it to callers sizing views over alloc'd
+  // blocks (they must not re-derive the rounding rule).  Throws
+  // std::bad_alloc for absurd (> 2^62) requests.
   static uint64_t size_class(uint64_t size);
 
+ private:
   mutable std::mutex mu_;
   std::unordered_map<uint64_t, std::vector<void*>> free_;  // class -> blocks
   std::unordered_map<void*, uint64_t> live_;               // ptr -> class

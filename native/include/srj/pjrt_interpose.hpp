@@ -1,0 +1,76 @@
+// PJRT C-API fault-injection interposer (skeleton).
+//
+// The reference's fault injector is loaded by the CUDA driver itself and
+// sees every runtime/driver call from any language
+// (/root/reference/src/main/cpp/src/faultinj/faultinj.cu:477-498, matching
+// sites by name or numeric callback id :142-152).  The TPU-native analogue
+// must sit below Python at the PJRT boundary: every PJRT C-API entry has
+// the uniform shape
+//
+//     PJRT_Error* PJRT_Something(PJRT_Something_Args* args);
+//
+// i.e. one args-struct pointer in, one error pointer out — which makes a
+// GENERIC vtable interposer possible: copy the plugin's api struct (a
+// struct_size header followed by function-pointer slots), and replace
+// selected slots with trampolines that either call through, fail with a
+// synthesized error, or call through after a delay.
+//
+// This environment exposes no dlopen-able PJRT plugin (the TPU tunnels
+// through a relay), so the interposer is built and tested against a MOCK
+// vtable with the same ABI shape (native/tests/test_pjrt_interpose.cpp).
+// Dropping it onto a real plugin is: read PJRT_Api's struct_size, treat
+// the tail as slots, wrap with srj::pjrt::interpose(), and hand the copy
+// to the loader — slot indices then come from pjrt_c_api.h.
+#pragma once
+
+#include <cstdint>
+#include <cstddef>
+
+namespace srj {
+namespace pjrt {
+
+// Every PJRT entry: PJRT_Error* fn(SomeArgs*).  Opaque pointers here.
+using Slot = void* (*)(void*);
+
+enum class Mode : uint8_t {
+  kPassthrough = 0,   // call the plugin's original entry
+  kFail = 1,          // return the configured synthesized error
+  kFailOnce = 2,      // fail the next call, then passthrough
+};
+
+struct SlotConfig {
+  Mode mode = Mode::kPassthrough;
+  // returned verbatim as the PJRT_Error*; the harness owns its shape
+  // (tests use a tagged sentinel; a real deployment builds a
+  // PJRT_Error via the plugin's error-create entry)
+  void* error = nullptr;
+};
+
+// A plugin api struct viewed as: size header + function-pointer slots.
+// (PJRT_Api literally starts with `size_t struct_size` and
+// `PJRT_Extension_Base* extension_start`, then the entries.)
+struct ApiView {
+  size_t struct_size = 0;
+  void* extension_start = nullptr;
+  Slot slots[1];      // flexible tail: (struct_size - header) / sizeof(Slot)
+};
+
+constexpr int kMaxSlots = 256;   // PJRT_Api has < 200 entries today
+
+// Wrap `api` (an ApiView-shaped struct): returns a heap-allocated copy
+// whose slots route through the interposer.  One interposed api per
+// process (static trampoline table — C ABI function pointers cannot
+// carry closures); calling again resets counters and re-wraps.
+ApiView* interpose(const ApiView* api);
+
+// Configure one slot by index (idempotent; passthrough by default).
+void configure_slot(int slot, SlotConfig cfg);
+
+// Calls observed per slot since interpose() — the counter faultinj's
+// CI canary asserts on.
+uint64_t call_count(int slot);
+
+void reset();
+
+}  // namespace pjrt
+}  // namespace srj

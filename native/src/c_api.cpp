@@ -262,6 +262,18 @@ int srj_arena_free(srj_arena* a, void* p) {
 
 void srj_arena_trim(srj_arena* a) { a->impl.trim(); }
 
+// The pooled block size a request of `size` bytes actually receives —
+// callers sizing views over srj_arena_alloc blocks must use this instead
+// of re-deriving the rounding rule (which could drift and overrun).
+uint64_t srj_arena_size_class(uint64_t size) {
+  try {
+    return srj::arena::HostArena::size_class(size);
+  } catch (const std::exception& e) {
+    set_error(e);
+    return 0;
+  }
+}
+
 // out holds 7 values: {current, peak, allocated, alloc_count, reuse_count,
 // outstanding, pooled} (srj::arena::Stats order).
 void srj_arena_stats(const srj_arena* a, uint64_t* out) {

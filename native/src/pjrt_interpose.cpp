@@ -1,0 +1,117 @@
+#include "srj/pjrt_interpose.hpp"
+
+#include <atomic>
+#include <cstring>
+#include <mutex>
+#include <new>
+
+namespace srj {
+namespace pjrt {
+namespace {
+
+struct SlotState {
+  Slot original = nullptr;
+  // dispatch() runs on live plugin threads while a harness thread
+  // reconfigures: error is written BEFORE mode (release) and read
+  // AFTER it (acquire), so a dispatch that observes a failing mode
+  // always sees that configuration's error pointer — never a torn
+  // (new mode, old error) pair returning null (PJRT success) for a
+  // call that never reached the plugin
+  std::atomic<void*> error{nullptr};
+  std::atomic<uint8_t> mode{0};
+  std::atomic<uint64_t> calls{0};
+  std::atomic<bool> fired{false};   // kFailOnce latch
+};
+
+SlotState g_state[kMaxSlots];
+std::mutex g_mu;
+ApiView* g_wrapped = nullptr;
+
+void* dispatch(int slot, void* args) {
+  SlotState& st = g_state[slot];
+  st.calls.fetch_add(1, std::memory_order_relaxed);
+  Mode mode = static_cast<Mode>(st.mode.load(std::memory_order_acquire));
+  if (mode == Mode::kFailOnce &&
+      !st.fired.exchange(true, std::memory_order_acq_rel)) {
+    return st.error.load(std::memory_order_acquire);
+  }
+  if (mode == Mode::kFail)
+    return st.error.load(std::memory_order_acquire);
+  return st.original ? st.original(args) : nullptr;
+}
+
+// C ABI function pointers cannot carry a closure, so each slot gets its
+// own trampoline instantiation; the table is filled at compile time.
+template <int I>
+void* tramp(void* args) {
+  return dispatch(I, args);
+}
+
+template <int... Is>
+constexpr void fill(Slot* out, std::integer_sequence<int, Is...>) {
+  ((out[Is] = &tramp<Is>), ...);
+}
+
+Slot* trampolines() {
+  static Slot table[kMaxSlots];
+  static bool init = [] {
+    fill(table, std::make_integer_sequence<int, kMaxSlots>{});
+    return true;
+  }();
+  (void)init;
+  return table;
+}
+
+}  // namespace
+
+ApiView* interpose(const ApiView* api) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  size_t nslots =
+      (api->struct_size - offsetof(ApiView, slots)) / sizeof(Slot);
+  if (nslots > static_cast<size_t>(kMaxSlots)) return nullptr;
+  char* mem = static_cast<char*>(::operator new(api->struct_size));
+  std::memcpy(mem, api, api->struct_size);
+  ApiView* copy = reinterpret_cast<ApiView*>(mem);
+  Slot* tr = trampolines();
+  for (size_t i = 0; i < nslots; ++i) {
+    g_state[i].original = api->slots[i];
+    g_state[i].error.store(nullptr, std::memory_order_release);
+    g_state[i].mode.store(0, std::memory_order_release);
+    g_state[i].calls.store(0, std::memory_order_relaxed);
+    g_state[i].fired.store(false, std::memory_order_relaxed);
+    copy->slots[i] = tr[i];
+  }
+  ::operator delete(g_wrapped);
+  g_wrapped = copy;
+  return copy;
+}
+
+void configure_slot(int slot, SlotConfig cfg) {
+  if (slot < 0 || slot >= kMaxSlots) return;
+  std::lock_guard<std::mutex> lock(g_mu);
+  SlotState& st = g_state[slot];
+  st.fired.store(false, std::memory_order_relaxed);
+  // error first, mode last (see SlotState): a reader that sees the new
+  // mode is guaranteed to see this error
+  st.error.store(cfg.error, std::memory_order_release);
+  st.mode.store(static_cast<uint8_t>(cfg.mode),
+                std::memory_order_release);
+}
+
+uint64_t call_count(int slot) {
+  if (slot < 0 || slot >= kMaxSlots) return 0;
+  return g_state[slot].calls.load(std::memory_order_relaxed);
+}
+
+void reset() {
+  std::lock_guard<std::mutex> lock(g_mu);
+  for (auto& st : g_state) {
+    st.error.store(nullptr, std::memory_order_release);
+    st.mode.store(0, std::memory_order_release);
+    st.calls.store(0, std::memory_order_relaxed);
+    st.fired.store(false, std::memory_order_relaxed);
+  }
+}
+
+}  // namespace pjrt
+}  // namespace srj

@@ -1,0 +1,71 @@
+// Mock-vtable test for the PJRT C-API interposer skeleton: a fake api
+// struct with the real ABI shape (size header + uniform
+// `void* fn(void*)` slots) is wrapped, selected slots are failed, and
+// passthrough slots must reach the mock plugin untouched.
+#include "srj/pjrt_interpose.hpp"
+
+#include <cassert>
+#include <cstdio>
+#include <cstdlib>
+
+namespace {
+
+int g_plugin_calls[3];
+
+void* plugin_fn0(void* args) { g_plugin_calls[0]++; return args; }
+void* plugin_fn1(void* args) { g_plugin_calls[1]++; return args; }
+void* plugin_fn2(void* args) { g_plugin_calls[2]++; return args; }
+
+struct MockApi {
+  size_t struct_size;
+  void* extension_start;
+  srj::pjrt::Slot slots[3];
+};
+
+}  // namespace
+
+int main() {
+  using namespace srj::pjrt;
+  MockApi mock{sizeof(MockApi), nullptr,
+               {&plugin_fn0, &plugin_fn1, &plugin_fn2}};
+  auto* api = interpose(reinterpret_cast<const ApiView*>(&mock));
+  assert(api != nullptr);
+  assert(api->struct_size == sizeof(MockApi));
+  auto* slots = reinterpret_cast<MockApi*>(api)->slots;
+
+  // passthrough: the wrapped slot reaches the plugin and returns its
+  // value (PJRT success = null error; the mock echoes args to prove
+  // the args pointer travels intact)
+  int token = 42;
+  assert(slots[0](&token) == &token);
+  assert(g_plugin_calls[0] == 1);
+  assert(call_count(0) == 1);
+
+  // kFail: the synthesized error comes back and the plugin is NOT hit
+  int err_obj = 7;
+  configure_slot(1, SlotConfig{Mode::kFail, &err_obj});
+  assert(slots[1](&token) == &err_obj);
+  assert(slots[1](&token) == &err_obj);
+  assert(g_plugin_calls[1] == 0);
+  assert(call_count(1) == 2);
+
+  // kFailOnce: first call fails, later calls pass through
+  configure_slot(2, SlotConfig{Mode::kFailOnce, &err_obj});
+  assert(slots[2](&token) == &err_obj);
+  assert(slots[2](&token) == &token);
+  assert(g_plugin_calls[2] == 1);
+
+  // reconfigure back to passthrough restores the original
+  configure_slot(1, SlotConfig{});
+  assert(slots[1](&token) == &token);
+  assert(g_plugin_calls[1] == 1);
+
+  // re-interpose resets counters and latches
+  api = interpose(reinterpret_cast<const ApiView*>(&mock));
+  assert(call_count(1) == 0);
+  slots = reinterpret_cast<MockApi*>(api)->slots;
+  assert(slots[2](&token) == &token);   // latch cleared -> passthrough
+
+  std::printf("pjrt interpose mock-vtable tests passed\n");
+  return 0;
+}

@@ -95,6 +95,9 @@ def _arena_lib():
         lib.srj_arena_trim.argtypes = [ctypes.c_void_p]
         lib.srj_arena_stats.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+        if hasattr(lib, "srj_arena_size_class"):
+            lib.srj_arena_size_class.restype = ctypes.c_uint64
+            lib.srj_arena_size_class.argtypes = [ctypes.c_uint64]
         _ARENA_CONFIGURED = True
     return lib
 
@@ -137,13 +140,17 @@ class HostStagingArena:
         ptr = self._lib.srj_arena_alloc(self._handle, max(nbytes, 1))
         if not ptr:
             raise MemoryError("host arena allocation failed")
-        # size the ctypes view to the arena's power-of-two size class:
-        # CPython interns (c_uint8 * n) types permanently per distinct n,
-        # so per-exact-size types would accumulate without bound across
-        # varying batch sizes; classes keep the set ~20 types total
-        cls = 4096
-        while cls < nbytes:
-            cls <<= 1
+        # size the ctypes view to the arena's size class, as reported by
+        # the arena itself (re-deriving the rounding rule here could
+        # drift from native and overrun the block).  Class-sized views
+        # also keep the set of interned (c_uint8 * n) CPython types ~20
+        # total across varying batch sizes.
+        cls = self._lib.srj_arena_size_class(max(nbytes, 1)) \
+            if hasattr(self._lib, "srj_arena_size_class") else None
+        if not cls:                       # stale .so or absurd request
+            cls = 4096
+            while cls < nbytes:
+                cls <<= 1
         buf = (ctypes.c_uint8 * cls).from_address(ptr)
         arr = np.frombuffer(buf, dtype=np.uint8, count=max(nbytes, 1))
         # the finalizer fires when the LAST array referencing this block

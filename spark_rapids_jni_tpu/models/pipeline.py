@@ -742,6 +742,8 @@ def hash_aggregate_table(source, key_idxs: Sequence[int],
         per_key.append(("plain", len(subs)))
 
     mcore = []
+    davg = set()       # mcore positions where a decimal128 AVG expanded
+    #                    into (sum, count) core measures
     for idx, op in measures:
         if op not in _AGG_OPS:
             raise ValueError(f"unknown aggregate op {op!r}")
@@ -766,9 +768,14 @@ def hash_aggregate_table(source, key_idxs: Sequence[int],
                 raise NotImplementedError(
                     f"unsupported 2-D measure layout {c.data.shape}")
             if op == "avg" and len(words) > 2:
-                raise NotImplementedError(
-                    "AVG over decimal128 needs decimal division; "
-                    "SUM + COUNT and divide with ops.decimal")
+                # decimal128 AVG = exact limb SUM + COUNT core measures,
+                # divided after the core with Spark's HALF_UP decimal
+                # division (ops.decimal.div_decimal128)
+                davg.add(len(mcore))
+                mcore.append((words, "sum", c.valid_bools()))
+                mcore.append((jnp.zeros((n,), jnp.int32), "count",
+                              c.valid_bools()))
+                continue
             mcore.append((words, op, c.valid_bools()))
             continue
         mcore.append((c.data, op, c.valid_bools()))
@@ -850,8 +857,31 @@ def hash_aggregate_table(source, key_idxs: Sequence[int],
                     if subs[0].dtype != c.data.dtype else subs[0]
         valid = have & (gnull == 0)
         out_cols.append(Column(c.dtype, data, pack_bools(valid)))
-    for (idx, op), out, meta in zip(measures, outs, metas):
+    oi = 0
+    for idx, op in measures:
         from spark_rapids_jni_tpu.table import DType
+        out, meta = outs[oi], metas[oi]
+        if oi in davg:
+            # decimal128 AVG: SUM limbs / COUNT with HALF_UP at Spark's
+            # avg scale (input scale + 4, capped at the 38-digit bound)
+            from spark_rapids_jni_tpu.ops.decimal import (
+                decimal128, div_decimal128)
+            cnt = outs[oi + 1]
+            oi += 2
+            src = _source_column(source, idx)
+            s = src.dtype.scale
+            sum_col = Column(decimal128(s), jnp.stack(out, axis=1),
+                             pack_bools(have & meta))
+            g = cnt.shape[0]
+            cnt_limbs = jnp.concatenate(
+                [jax.lax.bitcast_convert_type(cnt, jnp.uint32)[:, None],
+                 jnp.zeros((g, 3), jnp.uint32)], axis=1)
+            cnt_col = Column(decimal128(0), cnt_limbs, pack_bools(have))
+            q, _ovf = div_decimal128(sum_col, cnt_col,
+                                     result_scale=min(s + 4, 38))
+            out_cols.append(q)
+            continue
+        oi += 1
         if op == "count":
             dt, valid = INT32, have          # COUNT is never null
         else:

@@ -574,6 +574,52 @@ def test_aggregate_decimal128_sum_minmax(rng):
         assert mins[j] == lo and maxs[j] == hi, key
 
 
+def test_aggregate_decimal128_avg(rng):
+    """AVG over decimal128: exact limb SUM / COUNT with HALF_UP at
+    scale+4 (Spark's avg widening), vs Python Fraction arithmetic;
+    all-null groups stay null."""
+    from fractions import Fraction
+    from spark_rapids_jni_tpu.ops.decimal import (
+        decimal128_from_ints, decimal128_to_ints)
+    n = 5_000
+    keys = rng.integers(0, 6, n).astype(np.int32)
+    vals = [int(x) for x in rng.integers(-(1 << 40), 1 << 40, n)]
+    vv = rng.random(n) > 0.15
+    vv[keys == 5] = False                 # one all-null group
+    dcol = decimal128_from_ints(vals, scale=2, valid=np.asarray(vv))
+    t = Table((Column.from_numpy(keys, INT32), dcol))
+    res, have, ng = hash_aggregate_table(
+        t, key_idxs=[0], measures=[(1, "avg"), (None, "count")],
+        max_groups=16)
+    hv = np.asarray(have)
+    gk = res.columns[0].to_pylist()
+    assert res.columns[1].dtype.kind == "decimal128"
+    assert res.columns[1].dtype.scale == 6
+    avgs = decimal128_to_ints(res.columns[1])
+    av = np.asarray(res.columns[1].valid_bools())
+    exp = {}
+    for r in range(n):
+        if not vv[r]:
+            continue
+        s, c = exp.get(int(keys[r]), (0, 0))
+        exp[int(keys[r])] = (s + vals[r], c + 1)
+    for j in np.nonzero(hv)[0]:
+        if gk[j] not in exp:
+            assert not av[j], gk[j]       # all-null group: null AVG
+            continue
+        s, c = exp[gk[j]]
+        # HALF_UP on the magnitude at result scale 6 (input scale 2)
+        q = Fraction(abs(s) * 10_000, c)
+        r_int = q.numerator // q.denominator
+        if Fraction(q.numerator % q.denominator, q.denominator) \
+                >= Fraction(1, 2):
+            r_int += 1
+        if s < 0:
+            r_int = -r_int
+        assert avgs[j] == r_int, (gk[j], avgs[j], r_int)
+        assert av[j]
+
+
 def test_distributed_q95_table_step_nulls(rng, cpu_devices):
     """The Table-level q95 step: validity rides the exchange, the semi
     join drops null order keys on both sides, null ship dates form a
