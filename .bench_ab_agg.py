@@ -1,0 +1,58 @@
+"""A/B the sort vs domain-direct aggregate on the real chip, with
+axis-level retries around relay InvalidArgument windows (same policy as
+bench.py's axis subprocess retry).  Writes results to .bench_ab_agg.json."""
+import json
+import subprocess
+import sys
+import time
+
+BODY = r'''
+import time, numpy as np, jax
+import bench as B
+from spark_rapids_jni_tpu.utils.datagen import create_random_table, DataProfile
+from spark_rapids_jni_tpu.ops import convert_to_rows, row_mxu
+from spark_rapids_jni_tpu.ops.row_layout import compute_row_layout
+from spark_rapids_jni_tpu.ops.hashing import murmur3_hash, pmod
+from spark_rapids_jni_tpu.models import pipeline as pl
+n = int({n}); tag = "{tag}"; cap = int({cap})
+dtypes = B.cycle_dtypes(B.FIXED_DTYPES, 212)
+t = create_random_table(dtypes, n, DataProfile(), seed=42)
+layout = compute_row_layout(t.dtypes)
+batches = convert_to_rows(t)
+del t
+blob = batches[0].data
+pl._DOMAIN_DIRECT_MAX = cap
+import jax
+@jax.jit
+def step(blob2d):
+    gc = row_mxu.from_rows_fixed_grouped(blob2d, layout)
+    pids = pmod(murmur3_hash([gc.column(2), gc.column(4)]), 200)
+    res, have, ng = pl.hash_aggregate_table(
+        gc, key_idxs=[4], measures=[(None, "count"), (2, "sum")],
+        max_groups=256, mask=pids < 100)
+    return res, have, ng
+dt = B._time(lambda: step(blob), label=f"query[{{tag}}]",
+             sync_each=(n > 2_000_000))
+print("RESULT", tag, n, dt)
+'''
+
+results = {}
+for n in (1_000_000, 4_000_000):
+    for tag, cap in (("sort", 0), ("domain", 1 << 21)):
+        for attempt in range(6):
+            p = subprocess.run(
+                [sys.executable, "-c", BODY.format(n=n, tag=tag, cap=cap)],
+                capture_output=True, text=True, timeout=900)
+            hit = [l for l in p.stdout.splitlines() if l.startswith("RESULT")]
+            if hit:
+                _, tg, nn, dt = hit[0].split()
+                results[f"{tg}_{nn}"] = float(dt)
+                print(hit[0], flush=True)
+                break
+            print(f"attempt {attempt} {tag}@{n} failed "
+                  f"({p.stderr.strip().splitlines()[-1][:90] if p.stderr.strip() else 'no stderr'})",
+                  flush=True)
+            time.sleep(60 + 60 * attempt)
+        with open(".bench_ab_agg.json", "w") as f:
+            json.dump(results, f)
+print("DONE", json.dumps(results))

@@ -10,7 +10,9 @@ namespace pjrt {
 namespace {
 
 struct SlotState {
-  Slot original = nullptr;
+  // atomic: interpose() rewrites originals while plugin threads may be
+  // mid-dispatch through a previously wrapped vtable
+  std::atomic<Slot> original{nullptr};
   // dispatch() runs on live plugin threads while a harness thread
   // reconfigures: error is written BEFORE mode (release) and read
   // AFTER it (acquire), so a dispatch that observes a failing mode
@@ -37,7 +39,8 @@ void* dispatch(int slot, void* args) {
   }
   if (mode == Mode::kFail)
     return st.error.load(std::memory_order_acquire);
-  return st.original ? st.original(args) : nullptr;
+  Slot orig = st.original.load(std::memory_order_acquire);
+  return orig ? orig(args) : nullptr;
 }
 
 // C ABI function pointers cannot carry a closure, so each slot gets its
@@ -74,14 +77,18 @@ ApiView* interpose(const ApiView* api) {
   ApiView* copy = reinterpret_cast<ApiView*>(mem);
   Slot* tr = trampolines();
   for (size_t i = 0; i < nslots; ++i) {
-    g_state[i].original = api->slots[i];
+    g_state[i].original.store(api->slots[i],
+                              std::memory_order_release);
     g_state[i].error.store(nullptr, std::memory_order_release);
     g_state[i].mode.store(0, std::memory_order_release);
     g_state[i].calls.store(0, std::memory_order_relaxed);
     g_state[i].fired.store(false, std::memory_order_relaxed);
     copy->slots[i] = tr[i];
   }
-  ::operator delete(g_wrapped);
+  // earlier wrapped copies are intentionally NOT freed: a loader that
+  // received one may still dispatch through it (its trampolines stay
+  // valid and route to the new originals); freeing would be a
+  // use-after-free.  Re-wraps are rare — the leak is bounded and safe.
   g_wrapped = copy;
   return copy;
 }

@@ -41,6 +41,12 @@ from spark_rapids_jni_tpu.utils.tracing import func_range
 
 WILDCARD = object()   # the [*] path segment
 
+# chars consumed per fused scan iteration in the automaton passes: the
+# transition body is ~60 tiny [n] elementwise ops, so per-iteration loop
+# overhead dominates; unrolling amortizes it (SRJ_JSON_UNROLL overrides)
+import os as _os
+_UNROLL = max(1, int(_os.environ.get("SRJ_JSON_UNROLL", "8")))
+
 
 def _parse_path(path: str):
     """``$.a[0].b`` -> [b"a", 0, b"b"]: bytes for object keys, int for
@@ -76,18 +82,33 @@ def _parse_path(path: str):
     return segs
 
 
-def _select_lut(table_np, idx):
+def _select_lut(table_np, idx, dtype=jnp.int32):
     """A tiny static int table at per-row indices, as a select-sum —
     NEVER an [n]-element gather: dynamic gathers run ~100x slower than
-    vector selects on TPU and these sit inside scan bodies."""
+    vector selects on TPU and these sit inside scan bodies.  ``dtype``
+    narrows the select lanes (uint8 tables run 4x wider on the VPU)."""
     out = None
     for l, v in enumerate(table_np):
-        term = jnp.where(idx == l, jnp.int32(int(v)), 0)
+        term = jnp.where(idx == dtype(l), dtype(int(v)), dtype(0))
         out = term if out is None else out + term
     return out
 
 
-def _select_lut_bytes(bytes_np, idx, kpos):
+def _select_lut_bool(table_np, idx):
+    """Boolean variant of :func:`_select_lut`: OR of the levels whose
+    table entry is truthy."""
+    out = None
+    for l, v in enumerate(table_np):
+        if not int(v):
+            continue
+        term = idx == l
+        out = term if out is None else out | term
+    if out is None:
+        return jnp.zeros(idx.shape, jnp.bool_)
+    return out
+
+
+def _select_lut_bytes(bytes_np, idx, kpos, dtype=jnp.int32):
     """Static key-byte matrix [L, K] at per-row (level, key position),
     same select-sum strategy as :func:`_select_lut`."""
     L, K = bytes_np.shape
@@ -95,10 +116,10 @@ def _select_lut_bytes(bytes_np, idx, kpos):
     for l in range(L):
         row = None
         for k in range(K):
-            term = jnp.where(kpos == k,
-                             jnp.int32(int(bytes_np[l, k])), 0)
+            term = jnp.where(kpos == dtype(k),
+                             dtype(int(bytes_np[l, k])), dtype(0))
             row = term if row is None else row + term
-        term = jnp.where(idx == l, row, 0)
+        term = jnp.where(idx == dtype(l), row, dtype(0))
         out = term if out is None else out + term
     return out
 
@@ -129,208 +150,219 @@ def _scan_automaton(ch: jnp.ndarray, segs: Tuple,
         else:
             seg_bytes[i, :len(s)] = np.frombuffer(s, np.uint8)
             seg_lens[i] = len(s)
-    # per-level lookups via the shared select-sum helpers (no gathers)
+    # per-level lookups via the shared select-sum helpers (no gathers);
+    # byte/length tables select in uint8 lanes, flags in bool
     def _lut(table_np, idx):
         return _select_lut(table_np, idx)
 
-    def _lut_bytes(idx, kpos):
-        return _select_lut_bytes(seg_bytes, idx, kpos)
+    def _lut8(table_np, idx):
+        return _select_lut(table_np, idx, dtype=jnp.uint8)
 
+    def _lutb(table_np, idx):
+        return _select_lut_bool(table_np, idx)
+
+    def _lut_bytes(idx, kpos):
+        return _select_lut_bytes(seg_bytes, idx, kpos, dtype=jnp.uint8)
+
+    # Carry dtypes are the throughput lever: a [1M] int32 carry costs
+    # 4 bytes/lane/step of HBM traffic and one 32-bit VPU lane; bool and
+    # uint8 carries run 4x wider and cut the scan's memory traffic ~3x
+    # (measured ~20x end-to-end on the med-model microbenchmark — the
+    # original all-int32 carry was the entire bottleneck).  Bounds that
+    # make uint8 sound: `matched`/`depth` only feed ==/> comparisons
+    # against values < L+2 (wrapped malformed-JSON depths land at 255
+    # and compare unequal); `key_pos` needs max_key_len < 255, enforced
+    # below; `elem_count` stays int32 (array subscripts are unbounded).
+    if max_key_len >= 255:
+        raise ValueError(
+            "JSON path keys longer than 254 bytes are not supported by "
+            "the device automaton")
     i32 = jnp.int32
-    z = jnp.zeros((n,), i32)
+    u8 = jnp.uint8
+    z8 = jnp.zeros((n,), u8)
+    zb = jnp.zeros((n,), jnp.bool_)
+    zi = jnp.zeros((n,), i32)
     carry0 = dict(
-        in_str=z, esc=z, depth=z,
-        matched=z,            # path segments fully matched on the stack
-        in_key=z,             # currently scanning an object key at the
+        in_str=zb, esc=zb, depth=z8,
+        matched=z8,           # path segments fully matched on the stack
+        in_key=zb,            # currently scanning an object key at the
                               # match frontier (depth == matched + 1)
-        key_pos=z,            # bytes of the key consumed
-        key_ok=z + 1,         # key still equals the target segment
-        await_colon=z,        # key closed, expecting ':'
-        capturing=z,          # inside the target value
-        cap_depth=z,          # depth at capture start
-        elem_count=z,         # elements passed in the frontier array
-        elem_pending=z,       # target element's value starts next
-        start=z - 1, end=z - 1,
-        found=z, bad=z,
+        key_pos=z8,           # bytes of the key consumed
+        key_ok=~zb,           # key still equals the target segment
+        await_colon=zb,       # key closed, expecting ':'
+        capturing=zb,         # inside the target value
+        cap_depth=z8,         # depth at capture start
+        elem_count=zi,        # elements passed in the frontier array
+        elem_pending=zb,      # target element's value starts next
+        start=zi - 1, end=zi - 1,
+        found=zb, bad=zb,
+        pending=zb, cap_is_str=zb, expect_key=zb,
+        deep=zb,              # nesting exceeded the uint8 depth budget
     )
+    seg_lens_u8 = seg_lens.astype(np.uint8)
 
     def step(c, pos_and_char):
         pos, x = pos_and_char          # x: [n] uint8 at position pos
-        xs = x.astype(i32)
-        is_q = xs == ord('"')
-        is_bs = xs == ord("\\")
-        is_ws = (xs == 32) | (xs == 9) | (xs == 10) | (xs == 13)
-        is_open = (xs == ord("{")) | (xs == ord("["))
-        is_close = (xs == ord("}")) | (xs == ord("]"))
-        is_colon = xs == ord(":")
-        is_comma = xs == ord(",")
+        is_q = x == u8(ord('"'))
+        is_bs = x == u8(ord("\\"))
+        is_ws = (x == u8(32)) | (x == u8(9)) | (x == u8(10)) \
+            | (x == u8(13))
+        is_open = (x == u8(ord("{"))) | (x == u8(ord("[")))
+        is_close = (x == u8(ord("}"))) | (x == u8(ord("]")))
+        is_colon = x == u8(ord(":"))
+        is_comma = x == u8(ord(","))
 
         in_str, esc = c["in_str"], c["esc"]
-        eff_q = is_q & (esc == 0)
-        new_in_str = jnp.where(eff_q, 1 - in_str, in_str)
-        new_esc = ((in_str == 1) & (esc == 0) & is_bs).astype(i32)
+        eff_q = is_q & ~esc
+        new_in_str = in_str ^ eff_q
+        new_esc = in_str & ~esc & is_bs
 
         depth = c["depth"]
-        outside = in_str == 0
-        new_depth = depth + jnp.where(outside & is_open, 1, 0) \
-            - jnp.where(outside & is_close, 1, 0)
+        outside = ~in_str
+        # uint8 depth budget: opens past 250 saturate and flag `deep` —
+        # those rows route to the exact host path (a wrapped depth would
+        # collide with the match frontier and fabricate answers).  The
+        # unguarded decrement is benign: a close at depth 0 wraps to
+        # 255, which never equals the tiny frontier values — the same
+        # inertness the old int32 carry's negative depths had.
+        opens = outside & is_open
+        deep = c["deep"] | (opens & (depth >= u8(250)))
+        new_depth = depth \
+            + jnp.where(opens & (depth < u8(250)), u8(1), u8(0)) \
+            - jnp.where(outside & is_close, u8(1), u8(0))
 
-        frontier = c["matched"] + 1
+        frontier = c["matched"] + u8(1)
         at_frontier = depth == frontier
 
         # --- key scanning at the frontier ---
         # a quote opens a KEY only in key position (right after '{' or ','
         # of the frontier object) — without this, string VALUES equal to
         # the path segment would be scanned as keys
-        key_opening = outside & eff_q & (c["expect_key"] == 1) \
-            & (c["in_key"] == 0) & (c["await_colon"] == 0) \
-            & (c["capturing"] == 0) & (c["found"] == 0) & at_frontier
+        key_opening = outside & eff_q & c["expect_key"] \
+            & ~c["in_key"] & ~c["await_colon"] \
+            & ~c["capturing"] & ~c["found"] & at_frontier
         in_key = c["in_key"]
         key_pos = c["key_pos"]
         key_ok = c["key_ok"]
         # char inside a key (in_str was 1 when we entered this char)
-        key_char = (in_key == 1) & (in_str == 1) & ~(eff_q & (esc == 0))
-        seg_idx = jnp.clip(c["matched"], 0, L - 1)
-        expect = _lut_bytes(seg_idx, jnp.clip(key_pos, 0,
-                                               max_key_len - 1))
-        this_len = _lut(seg_lens, seg_idx)
-        ok_char = key_char & (key_pos < this_len) & (xs == expect) \
-            & (esc == 0)
-        key_ok = jnp.where(key_char,
-                           jnp.where(ok_char, key_ok, 0), key_ok)
-        # escapes in keys: conservatively no-match (Spark keys rarely
-        # escape; an escaped key can only fail to match our literal path)
-        key_ok = jnp.where(key_char & (esc == 1), 0, key_ok)
-        key_pos = jnp.where(key_char, key_pos + 1, key_pos)
+        key_char = in_key & in_str & ~eff_q
+        seg_idx = jnp.minimum(c["matched"], u8(L - 1))
+        expect = _lut_bytes(seg_idx,
+                            jnp.minimum(key_pos, u8(max_key_len - 1)))
+        this_len = _lut8(seg_lens_u8, seg_idx)
+        ok_char = key_char & (key_pos < this_len) & (x == expect) & ~esc
+        # a mismatching or escaped key char kills the match (escapes in
+        # keys conservatively no-match: an escaped key can only fail to
+        # equal our literal path)
+        key_ok = key_ok & (~key_char | ok_char)
+        key_pos = jnp.where(key_char, key_pos + u8(1), key_pos)
         # key closes on its terminating quote
-        key_closing = (in_key == 1) & eff_q & (in_str == 1)
-        full_match = key_closing & (key_ok == 1) & (key_pos == this_len)
-        await_colon = jnp.where(key_closing,
-                                jnp.where(full_match, 1, 0),
+        key_closing = in_key & eff_q & in_str
+        full_match = key_closing & key_ok & (key_pos == this_len)
+        await_colon = jnp.where(key_closing, full_match,
                                 c["await_colon"])
-        in_key = jnp.where(key_opening, 1,
-                           jnp.where(key_closing, 0, in_key))
-        key_pos = jnp.where(key_opening, 0, key_pos)
-        key_ok = jnp.where(key_opening, 1, key_ok)
+        in_key = (in_key | key_opening) & ~key_closing
+        key_pos = jnp.where(key_opening, u8(0), key_pos)
+        key_ok = key_ok | key_opening
 
         # --- value entry after a matched key's colon ---
-        saw_colon = (c["await_colon"] == 1) & outside & is_colon
-        await_colon = jnp.where(saw_colon, 0, await_colon)
-        pending = c.get("pending", z) | jnp.where(saw_colon, 1, 0)
-        # first non-ws char after the colon starts the value
-        key_value_starts = (pending == 1) & ~is_ws \
-            & ~(jnp.where(saw_colon, 1, 0) == 1)
-        # (the colon char itself is consumed this step; value chars begin
-        # on a LATER step, so exclude the colon step)
+        saw_colon = c["await_colon"] & outside & is_colon
+        await_colon = await_colon & ~saw_colon
+        pending = c["pending"] | saw_colon
+        # first non-ws char after the colon starts the value (the colon
+        # char itself is consumed this step; value chars begin later)
+        key_value_starts = pending & ~is_ws & ~saw_colon
 
         # --- element entry at an index-segment frontier array ---
-        fr_is_idx = _lut(seg_isidx, seg_idx) == 1
-        elem_value_starts = (c["elem_pending"] == 1) & fr_is_idx \
+        fr_is_idx = _lutb(seg_isidx, seg_idx)
+        elem_value_starts = c["elem_pending"] & fr_is_idx \
             & outside & ~is_ws & ~is_comma & ~is_close \
-            & (depth == c["matched"] + 1) & (c["capturing"] == 0) \
-            & (c["found"] == 0)
+            & at_frontier & ~c["capturing"] & ~c["found"]
         value_starts = key_value_starts | elem_value_starts
 
         matched = c["matched"]
-        is_last = matched == (L - 1)
+        is_last = matched == u8(L - 1)
         # intermediate segment: the value must be the container kind the
         # NEXT segment needs ('{' before a key, '[' before a subscript)
-        next_is_idx = _lut(seg_isidx, jnp.clip(matched + 1, 0, L - 1)) == 1
-        expected_open = jnp.where(next_is_idx, i32(ord("[")),
-                                  i32(ord("{")))
-        descend = value_starts & ~is_last & (xs == expected_open) \
-            & (c["capturing"] == 0) & (c["found"] == 0)
-        deadend = value_starts & ~is_last & (xs != expected_open) \
-            & (c["capturing"] == 0) & (c["found"] == 0)
-        start_cap = value_starts & is_last & (c["capturing"] == 0) \
-            & (c["found"] == 0)
-        matched = matched + jnp.where(descend, 1, 0)
+        next_is_idx = _lutb(seg_isidx,
+                            jnp.minimum(matched + u8(1), u8(L - 1)))
+        expected_open = jnp.where(next_is_idx, u8(ord("[")),
+                                  u8(ord("{")))
+        live = ~c["capturing"] & ~c["found"]
+        descend = value_starts & ~is_last & (x == expected_open) & live
+        deadend = value_starts & ~is_last & (x != expected_open) & live
+        start_cap = value_starts & is_last & live
+        matched = matched + jnp.where(descend, u8(1), u8(0))
         # a descended-into container closing without a find exhausts the
         # committed search space: this framework's documented duplicate-
         # key semantics bind to the FIRST matching key with no
         # backtracking (the r2 review's direction — device automaton and
         # host fixup must agree; Spark itself emits degenerate output for
         # duplicate keys, which are invalid JSON in practice)
-        exhausted = outside & is_close & (c["capturing"] == 0) \
-            & (c["matched"] > 0) & (new_depth == c["matched"]) \
-            & (c["found"] == 0)
-        pending2 = jnp.where(value_starts | deadend, 0, pending)
-        bad = c["bad"] | jnp.where(deadend | exhausted, 1, 0)
+        exhausted = outside & is_close & ~c["capturing"] \
+            & (c["matched"] > u8(0)) & (new_depth == c["matched"]) \
+            & ~c["found"]
+        pending2 = pending & ~(value_starts | deadend)
+        bad = c["bad"] | deadend | exhausted
 
         # element counter: commas at the frontier array's depth advance
         # it; the value after comma #k is element k
         elem_comma = outside & is_comma & fr_is_idx \
-            & (depth == c["matched"] + 1) & (c["capturing"] == 0) \
-            & (c["found"] == 0)
+            & at_frontier & ~c["capturing"] & ~c["found"]
         tgt = _lut(seg_tgt, seg_idx)
         elem_count = c["elem_count"] + jnp.where(elem_comma, 1, 0)
         elem_pending = jnp.where(
-            elem_comma, (elem_count == tgt).astype(i32),
-            jnp.where(elem_value_starts, 0, c["elem_pending"]))
+            elem_comma, elem_count == tgt,
+            c["elem_pending"] & ~elem_value_starts)
 
         # key-position tracking for the (possibly updated) frontier: '{'
         # opening the frontier object or ',' inside it puts us in key
         # position; anything else that is not whitespace leaves it
-        new_frontier = matched + 1
-        new_fr_idx = _lut(seg_isidx, jnp.clip(matched, 0, L - 1)) == 1
-        opens_frontier = outside & is_open & (xs == ord("{")) \
+        new_frontier = matched + u8(1)
+        new_fr_idx = _lutb(seg_isidx, jnp.minimum(matched, u8(L - 1)))
+        opens_frontier = outside & (x == u8(ord("{"))) \
             & (new_depth == new_frontier) & ~new_fr_idx
         comma_frontier = outside & is_comma & (depth == new_frontier) \
-            & (c["capturing"] == 0) & ~new_fr_idx
-        expect_key = c["expect_key"]
-        expect_key = jnp.where(opens_frontier | comma_frontier, 1,
-                               jnp.where(key_opening
-                                         | (~is_ws & (in_str == 0)
-                                            & ~eff_q & ~is_open
-                                            & ~is_comma),
-                                         0, expect_key))
+            & ~c["capturing"] & ~new_fr_idx
+        clears_key_pos = ~is_ws & outside & ~eff_q & ~is_open & ~is_comma
+        expect_key = jnp.where(
+            opens_frontier | comma_frontier, True,
+            c["expect_key"] & ~(key_opening | clears_key_pos))
 
         # entering the frontier array (a descend's '[', or the root '['
         # when the path starts with a subscript) arms the counter
-        arr_open = outside & (xs == ord("[")) & new_fr_idx \
-            & (new_depth == matched + 1) & (c["capturing"] == 0) \
-            & (c["found"] == 0)
-        new_tgt = _lut(seg_tgt, jnp.clip(matched, 0, L - 1))
+        arr_open = outside & (x == u8(ord("["))) & new_fr_idx \
+            & (new_depth == matched + u8(1)) & ~c["capturing"] \
+            & ~c["found"]
+        new_tgt = _lut(seg_tgt, jnp.minimum(matched, u8(L - 1)))
         elem_count = jnp.where(arr_open, 0, elem_count)
-        elem_pending = jnp.where(arr_open, (new_tgt == 0).astype(i32),
-                                 elem_pending)
+        elem_pending = jnp.where(arr_open, new_tgt == 0, elem_pending)
 
         capturing = c["capturing"]
         start = jnp.where(start_cap, pos, c["start"])
         cap_depth = jnp.where(start_cap, depth, c["cap_depth"])
-        cap_is_str = jnp.where(start_cap,
-                               (xs == ord('"')).astype(i32),
+        cap_is_str = jnp.where(start_cap, x == u8(ord('"')),
                                c["cap_is_str"])
-        capturing = jnp.where(start_cap, 1, capturing)
+        capturing = capturing | start_cap
 
-        # --- capture end: value ends when, at the capture depth and
-        # outside strings, a comma/close appears (for scalars), or when
-        # the bracket that opened the value closes (for containers).
-        # Track: scalar value -> ends at first outside comma/close at
-        # cap_depth; container -> new_depth < cap_depth + ... simpler:
-        # value text ends when outside & depth returns to cap_depth after
-        # having consumed at least one char AND the current char is a
-        # terminator (comma or close at cap_depth), or for containers when
-        # new_depth == cap_depth - 0 after the matching close.
-        started = (capturing == 1) & (start >= 0) & (c["found"] == 0)
-        # container case: the char that brings depth back to cap_depth
-        # FROM above, i.e. is_close with depth == cap_depth + 1 ... but the
-        # opening char itself raised depth AFTER start; detect end when
-        # outside & is_close & (new_depth == cap_depth - 0) & pos > start
+        # --- capture end: scalars end at the first outside comma/close
+        # at cap_depth (terminator excluded); containers when the
+        # bracket that opened the value closes (inclusive); strings at
+        # their terminating quote (inclusive)
+        started = capturing & (start >= 0) & ~c["found"]
         cont_end = started & outside & is_close \
             & (new_depth == cap_depth) & (pos > start)
-        scalar_term = started & (cap_is_str == 0) & outside \
-            & (is_comma | is_close) & (depth == cap_depth) & (pos > start)
-        str_end = started & (cap_is_str == 1) & eff_q & (in_str == 1) \
+        scalar_term = started & ~cap_is_str & outside \
+            & (is_comma | is_close) & (depth == cap_depth) \
             & (pos > start)
-        # (string values: their terminating quote, inclusive)
+        str_end = started & cap_is_str & eff_q & in_str & (pos > start)
         ends_now = cont_end | scalar_term | str_end
         # scalar_term ends BEFORE the terminator char; others include it
         end_pos = jnp.where(scalar_term & ~cont_end & ~str_end, pos,
                             pos + 1)
         end = jnp.where(ends_now, end_pos, c["end"])
-        found = c["found"] | jnp.where(ends_now, 1, 0)
-        capturing = jnp.where(ends_now, 0, capturing)
+        found = c["found"] | ends_now
+        capturing = capturing & ~ends_now
 
         out = dict(in_str=new_in_str, esc=new_esc, depth=new_depth,
                    matched=matched, in_key=in_key, key_pos=key_pos,
@@ -339,14 +371,11 @@ def _scan_automaton(ch: jnp.ndarray, segs: Tuple,
                    cap_is_str=cap_is_str, expect_key=expect_key,
                    elem_count=elem_count, elem_pending=elem_pending,
                    start=start, end=end, found=found, bad=bad,
-                   pending=pending2)
+                   pending=pending2, deep=deep)
         return out, None
 
-    carry0["pending"] = z
-    carry0["cap_is_str"] = z
-    carry0["expect_key"] = z
     pos = jnp.arange(W, dtype=i32)
-    final, _ = jax.lax.scan(step, carry0, (pos, ch.T))
+    final, _ = jax.lax.scan(step, carry0, (pos, ch.T), unroll=_UNROLL)
     # unterminated scalar at end-of-string: value runs to the char length
     return final
 
@@ -420,9 +449,11 @@ def get_json_object(col: Column, path: str,
     ch = col.chars_window(W)
     mkl = max((len(s) for s in segs if isinstance(s, bytes)), default=1)
     if mid_wc is not None:  # single mid-path [*] with key suffix
-        if W > (1 << 23):
+        if W >= (1 << 23):
             # the compaction packs (position-if-kept | W)*256 + byte
-            # into int32; wider windows would wrap the sort keys
+            # into int32; at W = 2^23 exactly, dropped lanes pack to
+            # W*256 = 2^31 which wraps NEGATIVE and sorts to the front,
+            # silently corrupting the row — hence >=, not >
             if any(isinstance(leaf, jax.core.Tracer)
                    for leaf in jax.tree_util.tree_leaves(col)):
                 raise ValueError(
@@ -433,19 +464,12 @@ def get_json_object(col: Column, path: str,
                                          path)
     if n_wc:  # single trailing [*]: the device wildcard evaluator
         return _eval_wildcard_device(col, ch, segs, W, mkl, path)
-    vals, out_len, valid, needs_host = _gjo_device_jit(
-        ch, col.validity, segs, W, mkl)
-    result, needs_host = _assemble_result(vals, out_len, valid,
-                                          needs_host)
-    if needs_host is None:  # under an outer jit: punts degraded to null
-        return result
-    # punted rows take the exact host path (one scalar readback gate,
-    # the cast_string punt pattern): string values containing escapes
+    # punted rows take the exact host path (one readback gate, the
+    # cast_string punt pattern): string values containing escapes
     # (must decode), and container values (Spark returns NORMALIZED
     # json -- re-serialized without insignificant whitespace)
-    if bool(jnp.any(needs_host)):
-        result = _host_fixup(result, col, path, np.asarray(needs_host))
-    return result
+    outs = _gjo_device_jit(ch, col.validity, segs, W, mkl)
+    return _finish_device_result(col, path, outs)
 
 
 import functools
@@ -505,6 +529,25 @@ def _extract_value(ch: jnp.ndarray, st, W: int):
     return vals, out_len, ok, is_strval, first
 
 
+def _assemble_in_jit(vals, out_len, valid, needs_host):
+    """In-trace tail of every device evaluator: punted rows are NULLED
+    here (the host fixup rebuilds them from source text and
+    re-validates on success), so the assembled column is correct both
+    under an outer jit (punts degrade to null) and on the eager path
+    (punts get patched).  Runs INSIDE the evaluator jits — the eager
+    formulation dispatched ~10 individual ops through the tunnel at
+    ~25 ms per round-trip, dwarfing the 7 ms device compute."""
+    strict = valid & ~needs_host
+    lens_out = jnp.where(strict, out_len, 0).astype(jnp.int32)
+    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(lens_out).astype(jnp.int32)])
+    chars = jnp.where(strict[:, None], vals, jnp.uint8(0))
+    # the host gate reads ONE scalar; the [n] punt vector only crosses
+    # the (slow) tunnel when something actually punted
+    return chars, offsets, pack_bools(strict), needs_host, \
+        jnp.any(needs_host)
+
+
 @functools.partial(jax.jit, static_argnums=(2, 3, 4))
 def _gjo_device_jit(ch, validity, segs, W: int, mkl: int):
     """The whole non-wildcard device computation in ONE program (the
@@ -515,30 +558,18 @@ def _gjo_device_jit(ch, validity, segs, W: int, mkl: int):
     mask = jnp.arange(W, dtype=jnp.int32)[None, :] < out_len[:, None]
     if validity is not None:
         from spark_rapids_jni_tpu.table import unpack_bools
-        valid = unpack_bools(validity, ch.shape[0]) & ok
+        in_valid = unpack_bools(validity, ch.shape[0])
     else:
-        valid = ok
+        in_valid = jnp.ones((ch.shape[0],), jnp.bool_)
+    valid = in_valid & ok
     # host-punt classes: string values containing escapes (must
-    # decode), container values (Spark returns NORMALIZED json)
+    # decode), container values (Spark returns NORMALIZED json), and
+    # documents past the automaton's uint8 nesting budget
     has_bs = jnp.any(jnp.where(mask, vals == ord("\\"), False), axis=1) \
         & is_strval & valid
     is_container = valid & ((first == ord("{")) | (first == ord("[")))
-    return vals, out_len, valid, has_bs | is_container
-
-
-def _assemble_result(vals, out_len, valid, needs_host):
-    """Build the output Column; under an outer jit, degrade punted rows
-    to null (the host fixup cannot run) and return needs_host=None."""
-    traced = isinstance(needs_host, jax.core.Tracer)
-    if traced:
-        valid = valid & ~needs_host
-    lens_out = jnp.where(valid, out_len, 0).astype(jnp.int32)
-    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
-                               jnp.cumsum(lens_out).astype(jnp.int32)])
-    result = Column(STRING, jnp.zeros((0,), jnp.uint8),
-                    pack_bools(valid), offsets, None,
-                    jnp.where(valid[:, None], vals, jnp.uint8(0)))
-    return result, (None if traced else needs_host)
+    punts = has_bs | is_container | (st["deep"] & in_valid)
+    return _assemble_in_jit(vals, out_len, valid, punts)
 
 
 def _at(b: jnp.ndarray, pos: jnp.ndarray) -> jnp.ndarray:
@@ -608,6 +639,9 @@ def _host_fixup(result: Column, src: Column, path: str,
             mat[r] = 0
             mat[r, :len(b)] = np.frombuffer(b, np.uint8)
             lens[r] = len(b)
+            # punted rows arrive NULLED from the in-jit assembly; a
+            # successful host re-parse re-validates them
+            valid[r] = True
     offsets = np.zeros(len(lens) + 1, np.int32)
     np.cumsum(lens, out=offsets[1:])
     return Column(STRING, jnp.zeros((0,), jnp.uint8),
@@ -754,43 +788,47 @@ def _elem_scan(vals: jnp.ndarray, out_len: jnp.ndarray):
     are malformed (Spark's parser nulls them)."""
     n, W = vals.shape
     i32 = jnp.int32
-    z = jnp.zeros((n,), i32)
-    # states
-    EXP, NSIGN, NINT, NZERO, NDOT, NFRAC, NE, NESIGN, NEXP, AFTER, \
-        INSTR, CLOSED = range(12)
-    carry0 = dict(st=z + EXP, esc=z, commas=z, has_tok=z, punt=z,
-                  has_bad=z, closed=z)
+    i8 = jnp.int8
+    u8 = jnp.uint8
+    zb = jnp.zeros((n,), jnp.bool_)
+    # states as int8 scalars: the state carry is the scan's dominant
+    # traffic, and 8-bit lanes run 4x wider on the VPU (same reasoning
+    # as _scan_automaton's carry dtypes); BAD = -1 sentinel
+    (EXP, NSIGN, NINT, NZERO, NDOT, NFRAC, NE, NESIGN, NEXP, AFTER,
+     INSTR, CLOSED) = (i8(v) for v in range(12))
+    BAD = i8(-1)
+    carry0 = dict(st=jnp.full((n,), EXP), esc=zb,
+                  commas=jnp.zeros((n,), i32), has_tok=zb, punt=zb,
+                  has_bad=zb, closed=zb)
 
     def step(c, x):
-        pos, col = x
-        ch = col.astype(i32)
-        act = (pos > 0) & (pos < out_len)          # skip the outer '['
+        pos, ch = x                               # ch: [n] uint8
+        act = (pos > 0) & (pos < out_len)         # skip the outer '['
         st, esc = c["st"], c["esc"]
         in_str = st == INSTR
-        quote = (ch == 34) & (esc == 0)
-        new_esc = (in_str & (ch == 92) & (esc == 0)).astype(i32)
-        is_dig = (ch >= 48) & (ch <= 57)
-        is_nz = (ch >= 49) & (ch <= 57)
-        e_ch = (ch == 101) | (ch == 69)
-        comma = ch == 44
-        close = ch == 93
+        quote = (ch == u8(34)) & ~esc
+        new_esc = in_str & (ch == u8(92)) & ~esc
+        is_dig = (ch >= u8(48)) & (ch <= u8(57))
+        is_nz = (ch >= u8(49)) & (ch <= u8(57))
+        e_ch = (ch == u8(101)) | (ch == u8(69))
+        comma = ch == u8(44)
+        close = ch == u8(93)
         # closing ']' of the OUTER array: the span's last char
         outer_close = close & (pos == out_len - 1)
 
         def trans(cur):
             """next state for the non-string states."""
-            bad = jnp.ones_like(st)                # sentinel: punt
             nxt = jnp.where(cur == EXP,
-                jnp.where(ch == 34, INSTR,
-                jnp.where(ch == 45, NSIGN,
-                jnp.where(ch == 48, NZERO,
-                jnp.where(is_nz, NINT, -1)))), -1)
-            num_close = jnp.where(outer_close, CLOSED, -1)
+                jnp.where(ch == u8(34), INSTR,
+                jnp.where(ch == u8(45), NSIGN,
+                jnp.where(ch == u8(48), NZERO,
+                jnp.where(is_nz, NINT, BAD)))), BAD)
+            num_close = jnp.where(outer_close, CLOSED, BAD)
             from_int = jnp.where(is_dig, NINT,
-                jnp.where(ch == 46, NDOT,
+                jnp.where(ch == u8(46), NDOT,
                 jnp.where(e_ch, NE,
                 jnp.where(comma, EXP, num_close))))
-            from_zero = jnp.where(ch == 46, NDOT,
+            from_zero = jnp.where(ch == u8(46), NDOT,
                 jnp.where(e_ch, NE,
                 jnp.where(comma, EXP, num_close)))
             from_frac = jnp.where(is_dig, NFRAC,
@@ -799,57 +837,55 @@ def _elem_scan(vals: jnp.ndarray, out_len: jnp.ndarray):
             from_exp = jnp.where(is_dig, NEXP,
                 jnp.where(comma, EXP, num_close))
             nxt = jnp.where(cur == NSIGN,
-                            jnp.where(ch == 48, NZERO,
-                                      jnp.where(is_nz, NINT, -1)), nxt)
+                            jnp.where(ch == u8(48), NZERO,
+                                      jnp.where(is_nz, NINT, BAD)), nxt)
             nxt = jnp.where(cur == NINT, from_int, nxt)
             nxt = jnp.where(cur == NZERO, from_zero, nxt)
             nxt = jnp.where(cur == NDOT,
-                            jnp.where(is_dig, NFRAC, -1), nxt)
+                            jnp.where(is_dig, NFRAC, BAD), nxt)
             nxt = jnp.where(cur == NFRAC, from_frac, nxt)
             nxt = jnp.where(cur == NE,
-                            jnp.where((ch == 43) | (ch == 45), NESIGN,
-                                      jnp.where(is_dig, NEXP, -1)), nxt)
+                            jnp.where((ch == u8(43)) | (ch == u8(45)),
+                                      NESIGN,
+                                      jnp.where(is_dig, NEXP, BAD)), nxt)
             nxt = jnp.where(cur == NESIGN,
-                            jnp.where(is_dig, NEXP, -1), nxt)
+                            jnp.where(is_dig, NEXP, BAD), nxt)
             nxt = jnp.where(cur == NEXP, from_exp, nxt)
             nxt = jnp.where(cur == AFTER,
                             jnp.where(comma, EXP, num_close), nxt)
-            nxt = jnp.where(cur == CLOSED, -1, nxt)
-            del bad
+            nxt = jnp.where(cur == CLOSED, BAD, nxt)
             return nxt
 
         nxt = trans(st)
         # string state: unescaped quote closes the element
-        nxt = jnp.where(in_str,
-                        jnp.where(quote & (esc == 0), AFTER, INSTR),
-                        nxt)
-        bad_step = act & (nxt == -1)
+        nxt = jnp.where(in_str, jnp.where(quote, AFTER, INSTR), nxt)
+        bad_step = act & (nxt == BAD)
         # a ']' while EXPECTing a value: legal only for the empty array
-        empty_ok = (st == EXP) & outer_close & (c["has_tok"] == 0)
+        empty_ok = (st == EXP) & outer_close & ~c["has_tok"]
         nxt = jnp.where(empty_ok, CLOSED, nxt)
         bad_step = bad_step & ~empty_ok
-        nxt = jnp.where(act == 0, st, jnp.where(bad_step, st, nxt))
-        is_comma_top = act & ~in_str & comma & (st != INSTR) \
+        nxt = jnp.where(~act | bad_step, st, nxt)
+        is_comma_top = act & ~in_str & comma \
             & ((st == NINT) | (st == NZERO) | (st == NFRAC)
                | (st == NEXP) | (st == AFTER))
         tok = act & (st == EXP) & ~close & (nxt != EXP)
-        bad_hi = act & ~in_str & (ch >= 128)
-        return dict(st=nxt, esc=jnp.where(in_str, new_esc, z),
-                    commas=c["commas"] + is_comma_top.astype(i32),
-                    has_tok=c["has_tok"] | tok.astype(i32),
-                    punt=c["punt"] | bad_step.astype(i32)
-                    | (act & (ch == 92)).astype(i32),
-                    has_bad=c["has_bad"] | bad_hi.astype(i32),
-                    closed=c["closed"]
-                    | (act & (nxt == CLOSED)).astype(i32)), None
+        bad_hi = act & ~in_str & (ch >= u8(128))
+        return dict(st=nxt, esc=in_str & new_esc,
+                    commas=c["commas"]
+                    + jnp.where(is_comma_top, 1, 0),
+                    has_tok=c["has_tok"] | tok,
+                    punt=c["punt"] | bad_step
+                    | (act & (ch == u8(92))),
+                    has_bad=c["has_bad"] | bad_hi,
+                    closed=c["closed"] | (act & (nxt == CLOSED))), None
 
     pos = jnp.arange(W, dtype=i32)
-    final, _ = jax.lax.scan(step, carry0, (pos, vals.T))
-    count = jnp.where(final["has_tok"] == 1, final["commas"] + 1, 0)
+    final, _ = jax.lax.scan(step, carry0, (pos, vals.T), unroll=_UNROLL)
+    count = jnp.where(final["has_tok"], final["commas"] + 1, 0)
     # spans that never reached CLOSED (escapes flipped string state,
     # truncation, ...) punt as well
-    punt = (final["punt"] == 1) | (final["closed"] == 0)
-    return count, punt, final["has_bad"] == 1
+    punt = final["punt"] | ~final["closed"]
+    return count, punt, final["has_bad"]
 
 
 def _root_array_span(ch, lens, W: int):
@@ -863,21 +899,47 @@ def _root_array_span(ch, lens, W: int):
     first_tok = jnp.min(jnp.where(is_ws, W, pos), axis=1)
     return dict(start=jnp.minimum(first_tok, lens.astype(jnp.int32)),
                 end=lens.astype(jnp.int32),
-                found=z + 1, capturing=z, bad=z)
+                found=z + 1, capturing=z, bad=z,
+                deep=jnp.zeros((n,), jnp.bool_))
 
 
-def _finish_device_result(col: Column, path: str, vals, out_len, valid,
-                          needs_host) -> Column:
-    """Shared epilogue of every device evaluator: assemble the Column,
-    degrade punts to null under an outer jit, otherwise run the exact
-    host fixup on the punted rows (one scalar readback gate)."""
-    result, needs_host = _assemble_result(vals, out_len, valid,
-                                          needs_host)
-    if needs_host is None:  # under an outer jit: punts degraded to null
-        return result
-    if bool(jnp.any(needs_host)):
-        result = _host_fixup(result, col, path, np.asarray(needs_host))
+def _finish_device_result(col: Column, path: str, outs) -> Column:
+    """Shared epilogue of every device evaluator: wrap the in-jit
+    assembled arrays as a Column; punted rows arrive nulled, and on the
+    eager path ONE host readback of the punt flags gates the exact host
+    fixup (which rebuilds those rows from source and re-validates)."""
+    chars, offsets, vpacked, needs_host, any_punt = outs
+    result = Column(STRING, _empty_u8(), vpacked, offsets, None, chars)
+    if isinstance(any_punt, jax.core.Tracer):
+        return result   # under an outer jit: punts stay null
+    # the punt decision is a pure function of the (immutable) column
+    # and path: memoize it on the column like _gjo_max_len, so repeated
+    # evaluation of the same expression pays the tunnel round-trip once
+    cache = getattr(col, "_gjo_punts", None)
+    if cache is None:
+        cache = {}
+        object.__setattr__(col, "_gjo_punts", cache)
+    hit = cache.get(path)
+    if hit is None:
+        any_p = bool(np.asarray(any_punt))  # the one blocking readback
+        hit = (any_p, np.asarray(needs_host) if any_p else None)
+        cache[path] = hit
+    any_p, nh = hit
+    if any_p:
+        result = _host_fixup(result, col, path, nh)
     return result
+
+
+_EMPTY_U8 = None
+
+
+def _empty_u8():
+    """Cached zero-length uint8 device array (a fresh jnp.zeros per
+    call is one more eager tunnel dispatch)."""
+    global _EMPTY_U8
+    if _EMPTY_U8 is None:
+        _EMPTY_U8 = jnp.zeros((0,), jnp.uint8)
+    return _EMPTY_U8
 
 
 @functools.partial(jax.jit, static_argnums=(3, 4, 5))
@@ -922,19 +984,21 @@ def _wildcard_device_jit(ch, validity, lens, segs, W: int, mkl: int):
     e0_container = (first_0 == ord("{")) | (first_0 == ord("["))
     # an uncertified span also makes the single/multi classification
     # itself unreliable (bare tokens, literals), so ANY punt routes to
-    # the host regardless of count
+    # the host regardless of count; so do documents past the automaton's
+    # uint8 nesting budget
     needs_host = valid & ((arr_ok & elem_punt)
                           | (single & ((is_str_0 & e0_bs)
                                        | e0_container)))
-    return vals, out_len, valid, needs_host
+    needs_host = needs_host \
+        | ((st_arr["deep"] | st0["deep"]) & in_valid)
+    return _assemble_in_jit(vals, out_len, valid, needs_host)
 
 
 def _eval_wildcard_device(col: Column, ch: jnp.ndarray, segs, W: int,
                           mkl: int, path: str) -> Column:
-    vals, out_len, valid, needs_host = _wildcard_device_jit(
-        ch, col.validity, col.str_lens(), segs, W, mkl)
-    return _finish_device_result(col, path, vals, out_len, valid,
-                                 needs_host)
+    outs = _wildcard_device_jit(ch, col.validity, col.str_lens(), segs,
+                                W, mkl)
+    return _finish_device_result(col, path, outs)
 
 
 # ---------------------------------------------------------------------------
@@ -989,152 +1053,157 @@ def _suffix_scan(arr: jnp.ndarray, arr_len: jnp.ndarray, suffix: Tuple,
         seg_bytes[i, :len(s)] = np.frombuffer(s, np.uint8)
         seg_lens[i] = len(s)
     i32 = jnp.int32
-    z = jnp.zeros((n,), i32)
+    u8 = jnp.uint8
+    zb = jnp.zeros((n,), jnp.bool_)
+    z8 = jnp.zeros((n,), u8)
+    zi = jnp.zeros((n,), i32)
+    seg_lens_u8 = seg_lens.astype(np.uint8)
+    if mkl >= 255:
+        raise ValueError(
+            "JSON path keys longer than 254 bytes are not supported by "
+            "the device automaton")
 
-    def _lut(table_np, idx):
-        return _select_lut(table_np, idx)
+    def _lut8(table_np, idx):
+        return _select_lut(table_np, idx, dtype=u8)
 
     def _lut_bytes(idx, kpos):
-        return _select_lut_bytes(seg_bytes, idx, kpos)
+        return _select_lut_bytes(seg_bytes, idx, kpos, dtype=u8)
 
+    # carry dtypes mirror _scan_automaton: flags as bool, small counters
+    # as uint8 (rel/depth/key_pos/phase), only `count` needs int32
     carry0 = dict(
-        in_str=z, esc=z, depth=z + 1,     # pos 0 ('[') is skipped
-        rel=z,                            # suffix segments matched
-        in_key=z, key_pos=z, key_ok=z + 1, await_colon=z, pending=z,
-        expect_key=z, capturing=z, cap_is_str=z, elem_done=z,
-        count=z, first_str=z, punt=z, emit_comma=z,
-        phase=z, had_tok=z,               # top-level structure guard
-        closed=z,
+        in_str=zb, esc=zb, depth=z8 + u8(1),  # pos 0 ('[') is skipped
+        rel=z8,                           # suffix segments matched
+        in_key=zb, key_pos=z8, key_ok=~zb, await_colon=zb, pending=zb,
+        expect_key=zb, capturing=zb, cap_is_str=zb, elem_done=zb,
+        count=zi, first_str=zb, punt=zb, emit_comma=zb,
+        phase=z8, had_tok=zb,             # top-level structure guard
+        closed=zb,
     )
 
     def step(c, pos_and_char):
-        pos, x = pos_and_char
-        xs = x.astype(i32)
+        pos, x = pos_and_char             # x: [n] uint8
         # once the array's own ']' has closed it, every later char is
         # outside the value (a root-array span covers the whole string;
         # trailing text must not fabricate matches)
-        act = (pos > 0) & (pos < arr_len) & (c["closed"] == 0)
-        is_q = xs == ord('"')
-        is_bs = xs == ord("\\")
-        is_ws = (xs == 32) | (xs == 9) | (xs == 10) | (xs == 13)
-        is_open = (xs == ord("{")) | (xs == ord("["))
-        is_close = (xs == ord("}")) | (xs == ord("]"))
-        is_colon = xs == ord(":")
-        is_comma = xs == ord(",")
+        act = (pos > 0) & (pos < arr_len) & ~c["closed"]
+        is_q = x == u8(ord('"'))
+        is_bs = x == u8(ord("\\"))
+        is_ws = (x == u8(32)) | (x == u8(9)) | (x == u8(10)) \
+            | (x == u8(13))
+        is_open = (x == u8(ord("{"))) | (x == u8(ord("[")))
+        is_close = (x == u8(ord("}"))) | (x == u8(ord("]")))
+        is_colon = x == u8(ord(":"))
+        is_comma = x == u8(ord(","))
 
         in_str, esc = c["in_str"], c["esc"]
-        eff_q = is_q & (esc == 0)
-        new_in_str = jnp.where(act & eff_q, 1 - in_str, in_str)
-        new_esc = (act & (in_str == 1) & (esc == 0) & is_bs).astype(i32)
-        outside = (in_str == 0) & act
+        eff_q = is_q & ~esc
+        new_in_str = in_str ^ (act & eff_q)
+        new_esc = act & in_str & ~esc & is_bs
+        outside = ~in_str & act
 
         depth = c["depth"]
-        new_depth = depth + jnp.where(outside & is_open, 1, 0) \
-            - jnp.where(outside & is_close, 1, 0)
+        # same uint8 depth budget as _scan_automaton: opens past 250
+        # saturate and punt to the host walker
+        opens = outside & is_open
+        deep_now = opens & (depth >= u8(250))
+        new_depth = depth \
+            + jnp.where(opens & (depth < u8(250)), u8(1), u8(0)) \
+            - jnp.where(outside & is_close, u8(1), u8(0))
         # only the matching ']' closes the array; a mismatched '}' that
         # zeroes the depth leaves closed unset and the row punts
-        closed = c["closed"] | (outside & (xs == ord("]"))
-                                & (new_depth == 0)).astype(i32)
+        closed = c["closed"] | (outside & (x == u8(ord("]")))
+                                & (new_depth == u8(0)))
 
         rel = c["rel"]
-        live = (c["elem_done"] == 0) & (c["punt"] == 0)
-        frontier = rel + 2                # element object keys live here
+        live = ~c["elem_done"] & ~c["punt"]
+        frontier = rel + u8(2)            # element object keys live here
 
         # --- key scanning (cloned from _scan_automaton, element-local)
-        key_opening = outside & eff_q & (c["expect_key"] == 1) \
-            & (c["in_key"] == 0) & (c["await_colon"] == 0) \
-            & (c["capturing"] == 0) & live & (depth == frontier)
+        key_opening = outside & eff_q & c["expect_key"] \
+            & ~c["in_key"] & ~c["await_colon"] \
+            & ~c["capturing"] & live & (depth == frontier)
         in_key, key_pos, key_ok = c["in_key"], c["key_pos"], c["key_ok"]
-        key_char = act & (in_key == 1) & (in_str == 1) & ~eff_q
-        seg_idx = jnp.clip(rel, 0, S - 1)
-        expect = _lut_bytes(seg_idx, jnp.clip(key_pos, 0, mkl - 1))
-        this_len = _lut(seg_lens, seg_idx)
-        ok_char = key_char & (key_pos < this_len) & (xs == expect) \
-            & (esc == 0)
-        key_ok = jnp.where(key_char,
-                           jnp.where(ok_char, key_ok, 0), key_ok)
-        key_ok = jnp.where(key_char & (esc == 1), 0, key_ok)
-        key_pos = jnp.where(key_char, key_pos + 1, key_pos)
-        key_closing = act & (in_key == 1) & eff_q & (in_str == 1)
-        full_match = key_closing & (key_ok == 1) & (key_pos == this_len)
-        await_colon = jnp.where(key_closing,
-                                jnp.where(full_match, 1, 0),
+        key_char = act & in_key & in_str & ~eff_q
+        seg_idx = jnp.minimum(rel, u8(S - 1))
+        expect = _lut_bytes(seg_idx, jnp.minimum(key_pos, u8(mkl - 1)))
+        this_len = _lut8(seg_lens_u8, seg_idx)
+        ok_char = key_char & (key_pos < this_len) & (x == expect) & ~esc
+        key_ok = key_ok & (~key_char | ok_char)
+        key_pos = jnp.where(key_char, key_pos + u8(1), key_pos)
+        key_closing = act & in_key & eff_q & in_str
+        full_match = key_closing & key_ok & (key_pos == this_len)
+        await_colon = jnp.where(key_closing, full_match,
                                 c["await_colon"])
-        in_key = jnp.where(key_opening, 1,
-                           jnp.where(key_closing, 0, in_key))
-        key_pos = jnp.where(key_opening, 0, key_pos)
-        key_ok = jnp.where(key_opening, 1, key_ok)
+        in_key = (in_key | key_opening) & ~key_closing
+        key_pos = jnp.where(key_opening, u8(0), key_pos)
+        key_ok = key_ok | key_opening
 
         # --- value entry after a matched key's colon
-        saw_colon = (c["await_colon"] == 1) & outside & is_colon
-        await_colon = jnp.where(saw_colon, 0, await_colon)
-        pending = c["pending"] | jnp.where(saw_colon, 1, 0)
-        value_starts = (pending == 1) & act & ~is_ws & ~saw_colon & live
+        saw_colon = c["await_colon"] & outside & is_colon
+        await_colon = await_colon & ~saw_colon
+        pending = c["pending"] | saw_colon
+        value_starts = pending & act & ~is_ws & ~saw_colon & live
 
-        is_last = rel == (S - 1)
-        descend = value_starts & ~is_last & (xs == ord("{"))
-        deadend = value_starts & ~is_last & (xs != ord("{"))
-        start_cap = value_starts & is_last & (c["capturing"] == 0)
+        is_last = rel == u8(S - 1)
+        descend = value_starts & ~is_last & (x == u8(ord("{")))
+        deadend = value_starts & ~is_last & (x != u8(ord("{")))
+        start_cap = value_starts & is_last & ~c["capturing"]
         cap_container = start_cap & is_open
         start_str = start_cap & eff_q
-        rel = rel + jnp.where(descend, 1, 0)
-        pending = jnp.where(value_starts | deadend, 0, pending)
+        rel = rel + jnp.where(descend, u8(1), u8(0))
+        pending = pending & ~(value_starts | deadend)
 
         # a committed sub-object closing without the match exhausts the
         # element (first-match-commit; same rule as the main automaton)
-        exhausted = outside & is_close & (c["capturing"] == 0) \
-            & (c["rel"] > 0) & (new_depth <= c["rel"] + 1) & live
+        exhausted = outside & is_close & ~c["capturing"] \
+            & (c["rel"] > u8(0)) & (new_depth <= c["rel"] + u8(1)) & live
 
         # --- capture progress
-        capturing = jnp.where(start_cap & ~cap_container, 1,
-                              c["capturing"])
-        cap_is_str = jnp.where(start_cap, start_str.astype(i32),
-                               c["cap_is_str"])
-        str_end = act & (c["capturing"] == 1) & (c["cap_is_str"] == 1) \
-            & eff_q & (in_str == 1)
-        scalar_end = (c["capturing"] == 1) & (c["cap_is_str"] == 0) \
+        capturing = c["capturing"] | (start_cap & ~cap_container)
+        cap_is_str = jnp.where(start_cap, start_str, c["cap_is_str"])
+        str_end = act & c["capturing"] & c["cap_is_str"] \
+            & eff_q & in_str
+        scalar_end = c["capturing"] & ~c["cap_is_str"] \
             & outside & ((is_comma & (depth == frontier)) | is_close)
         ends = str_end | scalar_end
-        capturing = jnp.where(ends, 0, capturing)
+        capturing = capturing & ~ends
         count = c["count"] + jnp.where(ends, 1, 0)
         first_str = jnp.where(ends & (c["count"] == 0),
                               c["cap_is_str"], c["first_str"])
 
         # --- keep flags
         keep = (start_cap & ~cap_container) \
-            | ((c["capturing"] == 1) & act
-               & ((c["cap_is_str"] == 1) | (~is_ws & ~scalar_end)))
+            | (c["capturing"] & act
+               & (c["cap_is_str"] | (~is_ws & ~scalar_end)))
         # scalar terminators double as the substituted separator; string
         # captures request one on the following char
-        comma_sub = scalar_end | ((c["emit_comma"] == 1) & act)
+        comma_sub = scalar_end | (c["emit_comma"] & act)
         keep = keep | comma_sub
-        emit_comma = jnp.where(str_end, 1,
-                               jnp.where((c["emit_comma"] == 1) & act, 0,
-                                         c["emit_comma"]))
+        emit_comma = str_end | (c["emit_comma"] & ~act)
 
-        elem_done = c["elem_done"] \
-            | jnp.where(deadend | exhausted | ends, 1, 0)
+        elem_done = c["elem_done"] | deadend | exhausted | ends
 
         # --- punts: anything raw passthrough cannot certify
-        bad_hi = outside & (xs >= 128)
-        cap_bs = act & (c["capturing"] == 1) & is_bs
+        bad_hi = outside & (x >= u8(128))
+        cap_bs = act & c["capturing"] & is_bs
         # an escape inside a frontier KEY can decode to the very key the
         # raw bytes fail to match ('b' == 'b'): only the host's
         # decoding walker can answer such rows
-        key_bs = act & (in_key == 1) & is_bs
-        punt = c["punt"] | jnp.where(
-            cap_container | bad_hi | cap_bs | key_bs, 1, 0)
+        key_bs = act & in_key & is_bs
+        punt = c["punt"] | cap_container | bad_hi | cap_bs | key_bs \
+            | deep_now
 
         # --- element boundary: top-level comma resets the frontier
-        elem_comma = outside & is_comma & (depth == 1) \
-            & (c["capturing"] == 0)
-        rel = jnp.where(elem_comma, 0, rel)
-        in_key = jnp.where(elem_comma, 0, in_key)
-        key_pos = jnp.where(elem_comma, 0, key_pos)
-        key_ok = jnp.where(elem_comma, 1, key_ok)
-        await_colon = jnp.where(elem_comma, 0, await_colon)
-        pending = jnp.where(elem_comma, 0, pending)
-        elem_done = jnp.where(elem_comma, 0, elem_done)
+        elem_comma = outside & is_comma & (depth == u8(1)) \
+            & ~c["capturing"]
+        rel = jnp.where(elem_comma, u8(0), rel)
+        in_key = in_key & ~elem_comma
+        key_pos = jnp.where(elem_comma, u8(0), key_pos)
+        key_ok = key_ok | elem_comma
+        await_colon = await_colon & ~elem_comma
+        pending = pending & ~elem_comma
+        elem_done = elem_done & ~elem_comma
 
         # --- top-level structure guard (phase at depth 1):
         # 0 = expecting an element (after '[' or ','), 1 = inside a bare
@@ -1144,35 +1213,34 @@ def _suffix_scan(arr: jnp.ndarray, arr_len: jnp.ndarray, suffix: Tuple,
         # ']' right after ',' (trailing comma) — are docs the host
         # parser nulls; punt them rather than fabricate output.
         phase = c["phase"]
-        at_top = act & (in_str == 0) & (depth == 1)
+        at_top = act & ~in_str & (depth == u8(1))
         tok_first = at_top & ~is_ws & ~is_comma & ~is_close \
-            & (phase == 0)
-        punt = punt | jnp.where(
-            (at_top & is_comma & (phase == 0))
-            | (at_top & ~is_ws & ~is_comma & ~is_close & (phase == 2))
-            | (at_top & is_close & (phase == 0)
-               & (c["had_tok"] == 1)), 1, 0)
-        had_tok = c["had_tok"] | tok_first.astype(i32)
-        phase = jnp.where(elem_comma, 0,
-                          jnp.where(tok_first, 1, phase))
+            & (phase == u8(0))
+        punt = punt \
+            | (at_top & is_comma & (phase == u8(0))) \
+            | (at_top & ~is_ws & ~is_comma & ~is_close
+               & (phase == u8(2))) \
+            | (at_top & is_close & (phase == u8(0)) & c["had_tok"])
+        had_tok = c["had_tok"] | tok_first
+        phase = jnp.where(elem_comma, u8(0),
+                          jnp.where(tok_first, u8(1), phase))
         # element ends: a container close back to depth 1, a string
         # element's closing quote, or whitespace after a bare scalar
         phase = jnp.where(
-            (outside & is_close & (new_depth == 1))
-            | (act & eff_q & (in_str == 1) & (depth == 1))
-            | (at_top & is_ws & (c["phase"] == 1)), 2, phase)
+            (outside & is_close & (new_depth == u8(1)))
+            | (act & eff_q & in_str & (depth == u8(1)))
+            | (at_top & is_ws & (c["phase"] == u8(1))), u8(2), phase)
 
         # --- expect_key maintenance for the (possibly new) frontier
-        new_frontier = rel + 2
-        opens_frontier = outside & (xs == ord("{")) \
+        new_frontier = rel + u8(2)
+        opens_frontier = outside & (x == u8(ord("{"))) \
             & (new_depth == new_frontier)
         comma_frontier = outside & is_comma & (depth == new_frontier) \
-            & (c["capturing"] == 0)
+            & ~c["capturing"]
+        clears = act & ~is_ws & ~in_str & ~eff_q & ~is_open & ~is_comma
         expect_key = jnp.where(
-            opens_frontier | comma_frontier, 1,
-            jnp.where(key_opening
-                      | (act & ~is_ws & (in_str == 0) & ~eff_q
-                         & ~is_open & ~is_comma), 0, c["expect_key"]))
+            opens_frontier | comma_frontier, True,
+            c["expect_key"] & ~(key_opening | clears))
 
         out = dict(in_str=new_in_str, esc=new_esc, depth=new_depth,
                    rel=rel, in_key=in_key, key_pos=key_pos,
@@ -1183,17 +1251,22 @@ def _suffix_scan(arr: jnp.ndarray, arr_len: jnp.ndarray, suffix: Tuple,
                    first_str=first_str, punt=punt,
                    emit_comma=emit_comma,
                    phase=phase, had_tok=had_tok, closed=closed)
-        return out, (keep, comma_sub)
+        # one packed u8 per-position output instead of two bool streams:
+        # halves the scan's ys traffic and drops one [W, n] transpose
+        flags = keep.astype(u8) | (comma_sub.astype(u8) << 1)
+        return out, flags
 
     pos = jnp.arange(W, dtype=i32)
-    final, (keep_t, sub_t) = jax.lax.scan(step, carry0, (pos, arr.T))
-    keep = keep_t.T | (jnp.arange(W, dtype=i32)[None, :] == 0)  # the '['
-    sub = sub_t.T
+    final, flags_t = jax.lax.scan(step, carry0, (pos, arr.T),
+                                  unroll=_UNROLL)
+    flags = flags_t.T
+    keep = ((flags & u8(1)) != 0) \
+        | (jnp.arange(W, dtype=i32)[None, :] == 0)  # the '['
+    sub = (flags & u8(2)) != 0
     # structural punts visible only at end-of-scan
-    punt = (final["punt"] == 1) | (final["closed"] == 0) \
-        | (final["in_str"] == 1) | (final["capturing"] == 1) \
-        | (final["emit_comma"] == 1)
-    return keep, sub, final["count"], final["first_str"] == 1, punt
+    punt = final["punt"] | ~final["closed"] \
+        | final["in_str"] | final["capturing"] | final["emit_comma"]
+    return keep, sub, final["count"], final["first_str"], punt
 
 
 @functools.partial(jax.jit, static_argnums=(3, 4, 5, 6))
@@ -1213,11 +1286,20 @@ def _mid_wildcard_jit(ch, validity, lens, segs, wc_at: int, W: int,
     keep, sub, count, first_str, punt = _suffix_scan(arr, len_a, suffix,
                                                      mkl)
     # compaction: one per-row lane sort of (pos-if-kept | W) over the
-    # char byte; dropped chars sink to the tail and mask away
+    # char byte; dropped chars sink to the tail and mask away.  The
+    # sort key narrows to uint16 when (W | dropped-sentinel) * 256 +
+    # byte fits — half the sort traffic of the int32 formulation
     posw = jnp.arange(W, dtype=jnp.int32)[None, :]
     chars_eff = jnp.where(sub, jnp.uint8(ord(",")), arr)
-    packed = jnp.where(keep, posw, W) * 256 + chars_eff.astype(jnp.int32)
-    comp = (jnp.sort(packed, axis=1) & 0xFF).astype(jnp.uint8)
+    if W < 256:
+        packed = (jnp.where(keep, posw, W).astype(jnp.uint16)
+                  * jnp.uint16(256)) + chars_eff.astype(jnp.uint16)
+        comp = (jnp.sort(packed, axis=1)
+                & jnp.uint16(0xFF)).astype(jnp.uint8)
+    else:
+        packed = jnp.where(keep, posw, W) * 256 \
+            + chars_eff.astype(jnp.int32)
+        comp = (jnp.sort(packed, axis=1) & 0xFF).astype(jnp.uint8)
     klen = jnp.sum(keep.astype(jnp.int32), axis=1)
 
     single = arr_ok & (count == 1)
@@ -1242,14 +1324,14 @@ def _mid_wildcard_jit(ch, validity, lens, segs, wc_at: int, W: int,
     # punted rows stay live so the host pass decides them; under an
     # outer jit they degrade to null
     valid = in_valid & arr_ok & ((count >= 1) | punt)
-    needs_host = in_valid & arr_ok & punt
-    return vals, out_len, valid, needs_host
+    needs_host = (in_valid & arr_ok & punt) \
+        | (st_arr["deep"] & in_valid)
+    return _assemble_in_jit(vals, out_len, valid, needs_host)
 
 
 def _eval_wildcard_mid_device(col: Column, ch: jnp.ndarray, segs,
                               wc_at: int, W: int, mkl: int,
                               path: str) -> Column:
-    vals, out_len, valid, needs_host = _mid_wildcard_jit(
-        ch, col.validity, col.str_lens(), segs, wc_at, W, mkl)
-    return _finish_device_result(col, path, vals, out_len, valid,
-                                 needs_host)
+    outs = _mid_wildcard_jit(ch, col.validity, col.str_lens(), segs,
+                             wc_at, W, mkl)
+    return _finish_device_result(col, path, outs)

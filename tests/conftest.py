@@ -16,6 +16,11 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# the JSON automaton's scan unrolling (default 8) multiplies CPU compile
+# time per distinct (path, window) combo ~4x with no test-value; keep
+# tests at 1 (test_get_json has an explicit unrolled-parity test)
+os.environ.setdefault("SRJ_JSON_UNROLL", "1")
+
 import jax  # noqa: E402
 
 # persistent compilation cache: the biggest test graphs (the unrolled

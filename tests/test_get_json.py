@@ -568,3 +568,48 @@ def test_mid_wildcard_subscript_suffix_falls_back_to_host():
     scan and must still answer via the host walker."""
     col = Column.strings_padded(['{"a":[{"b":[5,6]},{"b":[7]}]}'])
     assert get_json_object(col, "$.a[*].b[0]").to_pylist() == ["[5,7]"]
+
+
+def test_unrolled_scan_parity(rng, monkeypatch):
+    """The scan unroll factor must not change any answer: evaluate a
+    mixed batch at unroll 1 and 8 (distinct windows defeat the jit
+    cache) and compare against the host walker both times."""
+    import spark_rapids_jni_tpu.ops.get_json as gj
+    from spark_rapids_jni_tpu.ops.get_json import (
+        _eval_wildcard_host, _parse_path)
+    docs = ['{"k":{"x":%d},"a":[%d,%d]}' % (i, i, i + 1)
+            for i in range(50)] + \
+           ['{"a":[{"b":%d},{"c":0},{"b":%d}]}' % (i, -i)
+            for i in range(50)] + \
+           ['{"a":[]}', '{"k":{"x":"s"}}', "broken{", '{"a":[1 , 2]}']
+    for path in ("$.k.x", "$.a[*]", "$.a[*].b", "$.a[1]"):
+        exp = None
+        for unroll in (1, 8):
+            monkeypatch.setattr(gj, "_UNROLL", unroll)
+            # pad to a distinct width per factor so each traces fresh
+            col = Column.strings(docs).to_padded(pad_to=64 + 4 * unroll)
+            got = get_json_object(col, path).to_pylist()
+            if exp is None:
+                exp = got
+                if "[*]" in path:
+                    host = _eval_wildcard_host(
+                        col, tuple(_parse_path(path))).to_pylist()
+                    assert got == host, path
+            else:
+                assert got == exp, (path, unroll)
+
+
+def test_deep_nesting_routes_to_host():
+    """Valid JSON nested past the automaton's uint8 depth budget must
+    still answer exactly (via the host punt), not fabricate a match
+    from a wrapped depth counter."""
+    deep_decoy = '{"x":' + '{"d":' * 255 + '{"a":9}' + '}' * 255 \
+        + ',"a":7}'
+    shallow = '{"a":1}'
+    col = Column.strings_padded([deep_decoy, shallow])
+    assert get_json_object(col, "$.a").to_pylist() == ["7", "1"]
+    # deep array nesting through the wildcard paths as well
+    deep_arr = '{"a":[' + '[' * 254 + '1' + ']' * 254 + ']}'
+    col2 = Column.strings_padded([deep_arr, '{"a":[5]}'])
+    out = get_json_object(col2, "$.a[*]").to_pylist()
+    assert out[1] == "5"
