@@ -602,39 +602,80 @@ def main():
         with open("BENCH_DETAILS.json", "w") as f:
             json.dump(results, f, indent=2)
 
+    def _annotate(d):
+        """Calibration-normalized twins of every throughput field: raw
+        GB/s varies with tunnel health session to session, so each axis
+        also records its percentage of the same-session HBM-copy anchor
+        — the number that IS comparable across rounds."""
+        cal = results.get("calibration", {}).get("calibration_GBps")
+        if not cal or not isinstance(d, dict):
+            return d
+        for k in [k for k in d if k.endswith("_GBps")]:
+            d[k[:-5] + "_pct_of_calibration"] = round(100 * d[k] / cal, 2)
+        return d
+
     # session anchor first: a fixed HBM-copy slope every run records so
     # cross-round numbers can be normalized for tunnel variance
     results["calibration"] = _axis_subprocess("calibrate", timeout_s=240)
     _flush()
 
-    fixed = []
-    results["fixed_width"] = fixed
-    for n in row_axes:
-        out = _axis_subprocess(f"fixed:{n}")
-        out.setdefault("num_rows", n)
-        fixed.append(out)
+    # (container key, index, axis spec) of every failed axis: re-queued
+    # at END of sweep — relay bad windows last minutes, longer than the
+    # in-axis 30-180s backoff can outlast, but usually shorter than the
+    # rest of the sweep
+    requeue = []
+    if "error" in results["calibration"]:
+        requeue.append(("calibration", None, "calibrate"))
+
+    def _run(key, axis, post=None):
+        out = _axis_subprocess(axis)
+        if post:
+            post(out)
+        _annotate(out)
+        results.setdefault(key, []).append(out)
+        if "error" in out:
+            requeue.append((key, len(results[key]) - 1, axis))
         _flush()  # partial results survive a driver timeout
+
+    for n in row_axes:
+        _run("fixed_width", f"fixed:{n}",
+             post=lambda out, n=n: out.setdefault("num_rows", n))
 
     if not args.quick:
         # the reference's mixed axes: 155 cols with strings at 1M rows
         # (it skips strings >1M for memory, benchmarks/row_conversion.cpp:105)
         # and the no-strings variant; strings run on the dense-padded engine
-        results["variable_width"] = [_axis_subprocess("variable:1000000")]
-        _flush()
-        results["variable_width_skewed"] = [
-            _axis_subprocess("skewed:1000000")]
-        _flush()
-        results["no_strings_155col"] = [_axis_subprocess("nostrings:1000000")]
-        _flush()
+        _run("variable_width", "variable:1000000")
+        _run("variable_width_skewed", "skewed:1000000")
+        _run("no_strings_155col", "nostrings:1000000")
         # device trailing-[*] JSON path extraction at 1M rows
-        results["json_wildcard"] = [_axis_subprocess("json:1000000")]
+        _run("json_wildcard", "json:1000000")
+
+    for key, idx, axis in requeue:
+        _log(f"requeue {axis}: re-running failed axis at end of sweep")
+        out = _axis_subprocess(axis)
+        if "error" in out:
+            continue                      # keep the original error record
+        out["requeued"] = True
+        if key == "calibration":
+            results["calibration"] = out
+            # the anchor arrived late: (re-)annotate every axis with it
+            for k, v in results.items():
+                if isinstance(v, list):
+                    for d in v:
+                        _annotate(d)
+        else:
+            if idx < len(results[key]):
+                results[key][idx] = _annotate(out)
         _flush()
 
+    fixed = results.get("fixed_width", [])
     head = next((r for r in fixed if "error" not in r), None)
     if head is None:
         print(json.dumps({"metric": "to_rows_212col_throughput",
                           "value": 0.0, "unit": "GB/s", "vs_baseline": 0.0,
-                          "error": fixed[0].get("error", "unknown")}))
+                          "error": (fixed[0].get("error", "unknown")
+                                    if fixed else "no axes ran")}))
         sys.exit(1)
     # headline: largest successful fixed-width axis, to-rows direction;
     # vs_baseline from the largest axis that ran the oracle comparison
@@ -650,6 +691,8 @@ def main():
     cal = results.get("calibration", {})
     if "calibration_GBps" in cal:
         out["calibration_GBps"] = round(cal["calibration_GBps"], 1)
+        out["pct_of_calibration"] = round(
+            100 * head["to_rows_GBps"] / cal["calibration_GBps"], 2)
     print(json.dumps(out))
 
 

@@ -728,6 +728,111 @@ def to_rows_fixed_grouped(gc, start: int = 0, size: int = None,
 
 
 # ---------------------------------------------------------------------------
+# Transpose-engine encode (the MXU-floor falsification spike): most of a
+# JCUDF row is contiguous field bytes, so instead of the permutation
+# matmul, copy each maximal run of plane bytes that lands contiguously in
+# the row via block transposes, and compute only the validity section
+# arithmetically.  No MXU at all: the op becomes pure memory movement.
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=64)
+def _copy_runs_np(layout: RowLayout):
+    """Maximal (plane byte index, row offset, length) runs where
+    consecutive plane-stream bytes map to consecutive row bytes, in
+    ascending row order — or None when the schema's mapping is not
+    run-decomposable (the dot engine then stays)."""
+    _, p = _forward_plan(layout)                 # [W, 4, rs] int8
+    Wd = _data_words(layout)
+    sub = p.view(np.uint8)[:Wd]
+    pos = np.full((Wd * 4,), -1, np.int64)
+    for w in range(Wd):
+        for k in range(4):
+            nz = np.nonzero(sub[w, k])[0]
+            if len(nz) > 1:
+                return None
+            if len(nz):
+                pos[4 * w + k] = nz[0]
+    runs = []
+    b, B = 0, Wd * 4
+    while b < B:
+        if pos[b] < 0:
+            b += 1
+            continue
+        start_b, start_pos = b, int(pos[b])
+        L = 1
+        while b + L < B and pos[b + L] == start_pos + L:
+            L += 1
+        runs.append((start_b, start_pos, L))
+        b += L
+    # slices read the plane stream at arbitrary positions, so order the
+    # concat by ROW position; refuse overlaps (can't happen for a sane
+    # forward plan, but the dot engine is always correct)
+    runs.sort(key=lambda r: r[1])
+    cur = 0
+    for _, p0, L in runs:
+        if p0 < cur:
+            return None
+        cur = p0 + L
+    return tuple(runs)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _to_rows_transpose_jit(planes, vmask, layout: RowLayout,
+                           size: int) -> jnp.ndarray:
+    """[size, row_size] u8 rows from the plane-major backing with ZERO
+    matmuls: one [Wd, n, 4]->[n, Wd*4] byte-stream transpose, per-run
+    slices concatenated in row order, and the validity bytes from a
+    bit unpack/repack (disjoint bits sum exactly in uint8)."""
+    runs = _copy_runs_np(layout)
+    if runs is None:
+        raise ValueError("schema is not run-decomposable; use the dot "
+                         "engine")
+    Wd = _data_words(layout)
+    n = size
+    rs = layout.fixed_row_size
+    ncols = layout.num_columns
+    b8 = jax.lax.bitcast_convert_type(planes[:Wd, :n], jnp.uint8)
+    stream = jnp.transpose(b8, (1, 0, 2)).reshape(n, Wd * 4)
+    pieces = []
+    cursor = 0
+    for b, p0, L in runs:
+        if p0 > cursor:
+            pieces.append(jnp.zeros((n, p0 - cursor), jnp.uint8))
+        pieces.append(jax.lax.slice(stream, (0, b), (n, b + L)))
+        cursor = p0 + L
+    if layout.validity_offset > cursor:
+        pieces.append(jnp.zeros((n, layout.validity_offset - cursor),
+                                jnp.uint8))
+    # validity: [ncols, ceil(n/8)] packed-over-rows masks -> per-row
+    # bytes (slice to n after unpacking: n need not be 8-aligned)
+    iota8 = jnp.arange(8, dtype=jnp.uint8)
+    nbytes = (n + 7) // 8
+    bits = ((vmask[:, :nbytes, None] >> iota8[None, None, :])
+            & jnp.uint8(1)).reshape(ncols, nbytes * 8)[:, :n]
+    vb = layout.validity_bytes
+    pad = vb * 8 - ncols
+    bitsT = bits.T
+    if pad:
+        bitsT = jnp.concatenate(
+            [bitsT, jnp.zeros((n, pad), jnp.uint8)], axis=1)
+    vsec = jnp.sum(bitsT.reshape(n, vb, 8) << iota8[None, None, :],
+                   axis=2, dtype=jnp.uint8)
+    pieces.append(vsec)
+    tail = rs - layout.validity_offset - vb
+    if tail:
+        pieces.append(jnp.zeros((n, tail), jnp.uint8))
+    return jnp.concatenate(pieces, axis=1)
+
+
+def to_rows_fixed_grouped_transpose(gc, size: int = None) -> jnp.ndarray:
+    """Transpose-engine twin of :func:`to_rows_fixed_grouped` (full
+    batch only): same [n, row_size] u8 output, no MXU."""
+    layout = gc.layout
+    n = gc.num_rows if size is None else size
+    return _to_rows_transpose_jit(gc.planes, gc.vmask, layout, n)
+
+
+# ---------------------------------------------------------------------------
 # Decode: [n, fixed_row_size] uint8 -> columns
 # ---------------------------------------------------------------------------
 

@@ -240,3 +240,23 @@ def test_fused_decode_planes_matches_xla(rng, x64_both):
                                           mode="pallas_interpret")
     for a, b in zip(g_x.tree_flatten()[0], g_p.tree_flatten()[0]):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_transpose_engine_matches_dot_engine(rng):
+    """The no-MXU transpose encoder (contiguous-run block copies +
+    arithmetic validity bytes) must produce byte-identical JCUDF blobs
+    to the permutation-dot kernel across schema shapes."""
+    from bench import FIXED_DTYPES, cycle_dtypes
+    from spark_rapids_jni_tpu.ops import row_mxu
+    from spark_rapids_jni_tpu.ops.row_layout import compute_row_layout
+    from spark_rapids_jni_tpu.table import (INT8, INT16, INT32, FLOAT64,
+                                            BOOL8)
+    for dtypes, n in ((cycle_dtypes(FIXED_DTYPES, 212), 2048),
+                      ([INT32, INT8, INT16, BOOL8, FLOAT64], 1001),
+                      ([INT8] * 3 + [INT16] * 5 + [INT32], 2048)):
+        t = _random_table(rng, dtypes, n)
+        layout = compute_row_layout(t.dtypes)
+        gc = row_mxu.table_to_grouped(t, layout)
+        a = np.asarray(row_mxu.to_rows_fixed_grouped(gc, interpret=True))
+        b = np.asarray(row_mxu.to_rows_fixed_grouped_transpose(gc))
+        np.testing.assert_array_equal(a, b)
