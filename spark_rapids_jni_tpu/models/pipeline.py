@@ -23,6 +23,7 @@ Everything is jit-compatible and shape-static; masks carry row liveness.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Callable, Dict, Optional, Sequence
 
 import jax
@@ -796,9 +797,28 @@ def hash_aggregate_table(source, key_idxs: Sequence[int],
         for s in per_key:
             domain *= (1 << s[1]) + 1
         direct = domain <= _DOMAIN_DIRECT_MAX
+    # wider single-word integer keys (int32 dates/ids) can still be
+    # dense BY VALUE at runtime: the adaptive path range-checks in-trace
+    # and lax.cond picks dense slots or the sort per batch
+    adaptive = (not direct and n > 0 and per_key
+                and all(not isinstance(v, tuple) or op == "count"
+                        for v, op, _ in mcore)
+                and _ADAPTIVE_AGG_ON and _DOMAIN_DIRECT_MAX > 1)
+    if adaptive:
+        for c, spec in zip(key_cols, per_key):
+            if spec[0] == "packed":
+                continue
+            if (spec != ("plain", 1) or c.data.ndim != 1
+                    or not jnp.issubdtype(c.data.dtype, jnp.integer)
+                    or c.dtype.itemsize > 4):
+                adaptive = False
+                break
     if direct:
         gkeys, outs, metas, have, num_groups = _hash_aggregate_domain(
             sort_keys, [s[1] for s in per_key], mcore, live, max_groups)
+    elif adaptive:
+        gkeys, outs, metas, have, num_groups = _hash_aggregate_adaptive(
+            per_key, sort_keys, mcore, live, max_groups)
     else:
         gkeys, outs, metas, have, num_groups = _hash_aggregate_nulls(
             sort_keys, mcore, live, max_groups)
@@ -897,10 +917,16 @@ def hash_aggregate_table(source, key_idxs: Sequence[int],
     return Table(tuple(out_cols)), have, num_groups
 
 
-# widest packed-key domain the direct aggregate will allocate slots for
-# (int32 accumulators: 2^21 slots = 8MB per measure array — well inside
-# HBM, far above the 2^16+1 an int16 key needs)
-_DOMAIN_DIRECT_MAX = 1 << 21
+# widest key domain the direct aggregates will allocate slots for.
+# 2^18 is NOT a memory bound — it is XLA's TPU scatter-lowering cliff,
+# measured: a [1M, 3] int32 segment_sum costs ~15 ms up to 2^18 output
+# slots and ~85 ms from 2^19 up (the accumulator stops fitting the
+# fast lowering); past the cliff the dense path loses to the sort
+_DOMAIN_DIRECT_MAX = 1 << 18
+
+# runtime-adaptive range dispatch for wider integer keys (SRJ_ADAPTIVE_AGG=0
+# disables; compiles both cond branches)
+_ADAPTIVE_AGG_ON = os.environ.get("SRJ_ADAPTIVE_AGG", "1") != "0"
 
 
 def _minmax_identity(op: str, dtype):
@@ -937,6 +963,27 @@ def _hash_aggregate_domain(packed, bits_list, measures, live,
     D = 1
     for d in dims:
         D *= d
+
+    def decode_keys(slot, have):
+        gkeys = []
+        rem = slot
+        for dim in reversed(dims):
+            gkeys.append(jnp.where(have, rem % dim, 0))
+            rem = rem // dim
+        gkeys.reverse()
+        return gkeys
+
+    return _domain_aggregate_core(idx, D, measures, live, max_groups,
+                                  decode_keys)
+
+
+def _domain_aggregate_core(idx, D: int, measures, live, max_groups: int,
+                           decode_keys):
+    """Shared tail of the domain-direct aggregates: batched scatter-adds
+    into ``D`` static slots addressed by ``idx``, live-slot compaction
+    into ``max_groups`` outputs in ascending slot order, and group-key
+    reconstruction via ``decode_keys(compacted_slot_ids, have)`` (static
+    or traced radix arithmetic — the core doesn't care)."""
     # TPU scatters pay per PASS, not per lane: batch every sum-typed
     # contribution of a dtype into one [n, K] stacked segment_sum, and
     # min/max likewise per (op, dtype) — three-ish scatter passes total
@@ -988,25 +1035,25 @@ def _hash_aggregate_domain(packed, bits_list, measures, live,
     pos = jnp.cumsum(live_d.astype(jnp.int32)) - 1
     num_groups = jnp.sum(live_d.astype(jnp.int32))
     out_idx = jnp.where(live_d & (pos < max_groups), pos, max_groups)
+    # compaction as ONE [D] id scatter + per-matrix [G] row GATHERS:
+    # scattering every accumulator matrix costs O(D) writes per matrix,
+    # which dominates once D >> max_groups (the adaptive 2^21 budget
+    # measured 2.5x slower than the sort before this)
+    slot_g = jnp.zeros((max_groups + 1,), jnp.int32) \
+        .at[out_idx].set(jnp.arange(D, dtype=jnp.int32))[:max_groups]
+    have = jnp.arange(max_groups, dtype=jnp.int32) \
+        < jnp.minimum(num_groups, max_groups)
 
     def compact(a_d):
-        shape = (max_groups + 1,) + a_d.shape[1:]
-        return jnp.zeros(shape, a_d.dtype).at[out_idx].set(a_d) \
-            [:max_groups]
+        out = a_d[slot_g]
+        mask = have if out.ndim == 1 else have[:, None]
+        return jnp.where(mask, out, jnp.zeros((), out.dtype))
 
     sums_g = {dt: compact(m) for dt, m in sums_d.items()}
     mm_g = {k: compact(m) for k, m in mm_d.items()}
-
-    star = sums_g[jnp.dtype(jnp.int32)][:, star_slot[1]]
-    have = star > 0
-    # each kept slot's id decomposes back into its packed key values
-    slot = compact(jnp.arange(D, dtype=jnp.int32))
-    gkeys = []
-    rem = slot
-    for dim in reversed(dims):
-        gkeys.append(jnp.where(have, rem % dim, 0))
-        rem = rem // dim
-    gkeys.reverse()
+    # dead output slots gathered slot 0's garbage and were zeroed by
+    # compact(); `have` is rank-based so it needs no gathered counts
+    gkeys = decode_keys(slot_g, have)
 
     outs, metas = [], []
     for entry in plan:
@@ -1031,6 +1078,116 @@ def _hash_aggregate_domain(packed, bits_list, measures, live,
             outs.append(jnp.where(nn > 0, r, 0))
         metas.append(nn > 0)
     return gkeys, outs, metas, have, num_groups
+
+
+def _hash_aggregate_adaptive(per_key, sort_keys, measures, live,
+                             max_groups: int):
+    """Runtime-adaptive domain aggregate for single-word keys whose
+    VALUES may span int32 (dates, surrogate ids): the key ranges are
+    computed in-trace (min/max over live rows) and ``lax.cond``
+    dispatches between dense-slot aggregation over a STATIC
+    ``_DOMAIN_DIRECT_MAX``-slot budget with dynamic mixed-radix strides
+    (TPC-DS date keys span ~73k values — dense by value, huge by bit
+    width) and the variadic-sort path when the combined range doesn't
+    fit.  Output structure, ordering (ascending per key, nulls last)
+    and overflow semantics match :func:`_hash_aggregate_nulls` exactly,
+    so the caller can't tell which branch ran."""
+    D = _DOMAIN_DIRECT_MAX
+    # per key: (data, kv_or_None) — packed keys carry their null inside
+    # the value (sort_keys holds the packed array); plain keys carry a
+    # leading null-flag array in sort_keys
+    descs = []
+    ki = 0
+    for spec in per_key:
+        if spec[0] == "packed":
+            descs.append((sort_keys[ki], None))
+            ki += 1
+        else:                        # ("plain", 1)
+            nf = sort_keys[ki]
+            descs.append((sort_keys[ki + 1], nf == 0))
+            ki += 2
+
+    # dynamic ranges + the integer-safe fits chain: ok &= diff in
+    # [0, rem-2]; rem //= radix — guarantees prod(radix) <= D without
+    # ever forming the (overflowable) product
+    kmins, radii = [], []
+    rem = jnp.int32(D)
+    ok = live.any()                  # an all-dead batch takes the sort
+    #                                  path (its n==0-like degenerate
+    #                                  ranges would be meaningless)
+    for data, kv in descs:
+        sel = live if kv is None else live & kv
+        d32 = data.astype(jnp.int32)
+        kmin = jnp.min(jnp.where(sel, d32, jnp.int32(2**31 - 1)))
+        kmax = jnp.max(jnp.where(sel, d32, jnp.int32(-2**31)))
+        kmax = jnp.maximum(kmax, kmin)
+        diff = kmax - kmin
+        extra = 1 if kv is None else 2      # +1 value span, +1 null slot
+        ok = ok & (diff >= 0) & (diff <= rem - extra)
+        radix = diff + extra
+        rem = rem // jnp.maximum(radix, 1)
+        kmins.append(kmin)
+        radii.append(radix)
+
+    def domain_branch():
+        idx = jnp.zeros(live.shape, jnp.int32)
+        for (data, kv), kmin, radix in zip(descs, kmins, radii):
+            comp = jnp.clip(data.astype(jnp.int32) - kmin, 0,
+                            radix - 1)
+            if kv is not None:       # nulls own the top slot
+                comp = jnp.where(kv, comp, radix - 1)
+            idx = idx * radix + comp
+
+        def decode_keys(slot, have):
+            comps = []
+            rem_s = slot
+            for radix in reversed(radii):
+                comps.append(rem_s % radix)
+                rem_s = rem_s // radix
+            comps.reverse()
+            gkeys = []
+            for (data, kv), kmin, radix, comp in zip(descs, kmins,
+                                                     radii, comps):
+                if kv is None:       # packed: one array, null encoded
+                    gkeys.append(jnp.where(have, comp + kmin, 0)
+                                 .astype(data.dtype))
+                else:                # plain: (null_flag, value) pair
+                    gnull = comp == radix - 1
+                    gkeys.append(jnp.where(have & gnull, 1, 0)
+                                 .astype(jnp.int32))
+                    gkeys.append(jnp.where(have & ~gnull, comp + kmin,
+                                           0).astype(data.dtype))
+            return gkeys
+
+        return _strip_metas(_domain_aggregate_core(
+            idx, D, measures, live, max_groups, decode_keys))
+
+    def sort_branch():
+        return _strip_metas(_hash_aggregate_nulls(
+            list(sort_keys), measures, live, max_groups))
+
+    out = jax.lax.cond(ok, domain_branch, sort_branch)
+    return _unstrip_metas(out, measures)
+
+
+def _strip_metas(res):
+    """cond branches cannot carry Nones: drop the COUNT measures' None
+    metas (their positions are static per the ops list)."""
+    gkeys, outs, metas, have, ng = res
+    return (tuple(gkeys), tuple(outs),
+            tuple(m for m in metas if m is not None), have, ng)
+
+
+def _unstrip_metas(res, measures):
+    gkeys, outs, metas_t, have, ng = res
+    metas, mi = [], 0
+    for _, op, _ in measures:
+        if op == "count":
+            metas.append(None)
+        else:
+            metas.append(metas_t[mi])
+            mi += 1
+    return list(gkeys), list(outs), metas, have, ng
 
 
 def _hash_aggregate_nulls(sort_keys, measures, live, max_groups: int):

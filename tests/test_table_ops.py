@@ -919,6 +919,7 @@ def test_aggregate_domain_direct_matches_sort_path(rng, monkeypatch):
                                     mask=mask)
         assert took, key_idxs
         monkeypatch.setattr(pl, "_DOMAIN_DIRECT_MAX", 0)
+        monkeypatch.setattr(pl, "_ADAPTIVE_AGG_ON", False)
         slow = hash_aggregate_table(t, key_idxs=key_idxs,
                                     measures=measures, max_groups=1024,
                                     mask=mask)
@@ -932,6 +933,86 @@ def test_aggregate_domain_direct_matches_sort_path(rng, monkeypatch):
             hv = np.asarray(fast[1])
             np.testing.assert_array_equal(np.asarray(cf.data)[hv],
                                           np.asarray(cs.data)[hv])
+
+
+def test_aggregate_adaptive_int32_keys(rng, monkeypatch):
+    """int32 keys that are dense BY VALUE (date-key shape) ride the
+    runtime range dispatch; huge-range keys fall back to the sort at
+    RUNTIME through the same cond — results identical to the
+    sort-only path either way, nulls and masks included."""
+    import jax.numpy as jnp
+    from spark_rapids_jni_tpu.models import pipeline as pl
+    n = 4000
+    measures = [(1, "sum"), (1, "min"), (1, "avg"), (None, "count")]
+    mask = np.asarray(rng.random(n) > 0.3)
+    for tag, lo, hi in (("dense", 2_415_022, 2_488_070),
+                        ("huge", -(1 << 30), 1 << 30)):
+        keys = rng.integers(lo, hi, n).astype(np.int32)
+        kv = rng.random(n) > 0.15
+        vals = rng.integers(-50, 50, n).astype(np.int32)
+        t = Table((Column.from_numpy(keys, INT32, valid=kv),
+                   Column.from_numpy(vals, INT32)))
+        took = []
+        real = pl._hash_aggregate_adaptive
+        monkeypatch.setattr(
+            pl, "_hash_aggregate_adaptive",
+            lambda *a, **k: took.append(1) or real(*a, **k))
+        fast = hash_aggregate_table(t, key_idxs=[0], measures=measures,
+                                    max_groups=8192,
+                                    mask=jnp.asarray(mask))
+        assert took, tag             # the adaptive dispatch engaged
+        monkeypatch.setattr(pl, "_ADAPTIVE_AGG_ON", False)
+        slow = hash_aggregate_table(t, key_idxs=[0], measures=measures,
+                                    max_groups=8192,
+                                    mask=jnp.asarray(mask))
+        monkeypatch.undo()
+        assert int(np.asarray(fast[2])) == int(np.asarray(slow[2])), tag
+        np.testing.assert_array_equal(np.asarray(fast[1]),
+                                      np.asarray(slow[1]))
+        hv = np.asarray(fast[1])
+        for cf, cs in zip(fast[0].columns, slow[0].columns):
+            np.testing.assert_array_equal(
+                np.asarray(cf.valid_bools())[hv],
+                np.asarray(cs.valid_bools())[hv])
+            np.testing.assert_array_equal(np.asarray(cf.data)[hv],
+                                          np.asarray(cs.data)[hv])
+
+
+def test_aggregate_adaptive_composite_packed_plus_plain(rng, monkeypatch):
+    """Multi-key adaptive coverage: a packed int16 key (null-free,
+    small values — packed range 51) combined with a nullable int32 key
+    (value range ~102, +2 slots) gives radix product ~5.3k < 2^18, so
+    the runtime dispatch takes the DOMAIN branch with the multi-key
+    mixed-radix chain and the packed decode — results must equal the
+    sort-only path slot-for-slot."""
+    import jax.numpy as jnp
+    from spark_rapids_jni_tpu import INT16
+    from spark_rapids_jni_tpu.models import pipeline as pl
+    n = 3000
+    k16 = rng.integers(0, 51, n).astype(np.int16)        # packed, no nulls
+    k32 = rng.integers(1000, 1102, n).astype(np.int32)
+    kv32 = rng.random(n) > 0.2
+    vals = rng.integers(-9, 9, n).astype(np.int32)
+    mask = jnp.asarray(rng.random(n) > 0.25)
+    t = Table((Column.from_numpy(k16, INT16),
+               Column.from_numpy(k32, INT32, valid=kv32),
+               Column.from_numpy(vals, INT32)))
+    measures = [(2, "sum"), (2, "max"), (None, "count")]
+    fast = hash_aggregate_table(t, key_idxs=[0, 1], measures=measures,
+                                max_groups=8192, mask=mask)
+    monkeypatch.setattr(pl, "_ADAPTIVE_AGG_ON", False)
+    slow = hash_aggregate_table(t, key_idxs=[0, 1], measures=measures,
+                                max_groups=8192, mask=mask)
+    monkeypatch.undo()
+    assert int(np.asarray(fast[2])) == int(np.asarray(slow[2]))
+    np.testing.assert_array_equal(np.asarray(fast[1]),
+                                  np.asarray(slow[1]))
+    hv = np.asarray(fast[1])
+    for cf, cs in zip(fast[0].columns, slow[0].columns):
+        np.testing.assert_array_equal(np.asarray(cf.valid_bools())[hv],
+                                      np.asarray(cs.valid_bools())[hv])
+        np.testing.assert_array_equal(np.asarray(cf.data)[hv],
+                                      np.asarray(cs.data)[hv])
 
 
 def test_join_sentinel_interleave_with_duplicates():
