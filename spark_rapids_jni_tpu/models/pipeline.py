@@ -809,8 +809,11 @@ def hash_aggregate_table(source, key_idxs: Sequence[int],
             if spec[0] == "packed":
                 continue
             if (spec != ("plain", 1) or c.data.ndim != 1
-                    or not jnp.issubdtype(c.data.dtype, jnp.integer)
+                    or not jnp.issubdtype(c.data.dtype, jnp.signedinteger)
                     or c.dtype.itemsize > 4):
+                # unsigned keys stay on the sort: the range math runs
+                # signed, and near the wrap boundary it would order
+                # groups differently than the unsigned sort
                 adaptive = False
                 break
     if direct:
@@ -1159,35 +1162,20 @@ def _hash_aggregate_adaptive(per_key, sort_keys, measures, live,
                                            0).astype(data.dtype))
             return gkeys
 
-        return _strip_metas(_domain_aggregate_core(
-            idx, D, measures, live, max_groups, decode_keys))
+        gkeys, outs, metas, have, ng = _domain_aggregate_core(
+            idx, D, measures, live, max_groups, decode_keys)
+        return tuple(gkeys), tuple(outs), tuple(metas), have, ng
 
     def sort_branch():
-        return _strip_metas(_hash_aggregate_nulls(
-            list(sort_keys), measures, live, max_groups))
+        gkeys, outs, metas, have, ng = _hash_aggregate_nulls(
+            list(sort_keys), measures, live, max_groups)
+        return tuple(gkeys), tuple(outs), tuple(metas), have, ng
 
-    out = jax.lax.cond(ok, domain_branch, sort_branch)
-    return _unstrip_metas(out, measures)
-
-
-def _strip_metas(res):
-    """cond branches cannot carry Nones: drop the COUNT measures' None
-    metas (their positions are static per the ops list)."""
-    gkeys, outs, metas, have, ng = res
-    return (tuple(gkeys), tuple(outs),
-            tuple(m for m in metas if m is not None), have, ng)
-
-
-def _unstrip_metas(res, measures):
-    gkeys, outs, metas_t, have, ng = res
-    metas, mi = [], 0
-    for _, op, _ in measures:
-        if op == "count":
-            metas.append(None)
-        else:
-            metas.append(metas_t[mi])
-            mi += 1
-    return list(gkeys), list(outs), metas, have, ng
+    # None metas (COUNT measures) sit at the same static positions in
+    # both branches, and None is an empty pytree node — cond is fine
+    gkeys, outs, metas, have, ng = jax.lax.cond(
+        ok, domain_branch, sort_branch)
+    return list(gkeys), list(outs), list(metas), have, ng
 
 
 def _hash_aggregate_nulls(sort_keys, measures, live, max_groups: int):
