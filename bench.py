@@ -194,18 +194,32 @@ def bench_fixed(num_rows, num_cols=212, use_pallas=None):
     # decode phases only need the blobs: free the source table so the 4M
     # axis (table + batches + decode transients) stays inside HBM
     del table
-    t_from = _time(lambda: [convert_from_rows(b, dtypes,
-                                              use_pallas=use_pallas)
-                            for b in batches],
-                   label=f"from_rows[{num_rows}]", sync_each=big)
+    leg_errors = {}
+
+    def _leg(name, fn, **kw):
+        """One timing leg; a relay failure records the leg's error
+        instead of killing the whole axis (the 1M from-rows leg has
+        died through whole bad windows while every other leg passed —
+        a partial axis record beats none)."""
+        try:
+            return _time(fn, label=f"{name}[{num_rows}]", **kw)
+        except Exception as e:
+            leg_errors[name] = f"{type(e).__name__}: {str(e)[:90]}"
+            _log(f"{name}[{num_rows}]: LEG FAILED {leg_errors[name]}")
+            return None
+
+    t_from = _leg("from_rows",
+                  lambda: [convert_from_rows(b, dtypes,
+                                             use_pallas=use_pallas)
+                           for b in batches], sync_each=big)
     # grouped (dtype-major) decode: the wide-output fast path consumers
     # use when they touch a handful of columns, reported alongside the
     # per-column-materializing standard decode
     from spark_rapids_jni_tpu.ops import row_mxu
-    t_from_g = _time(
+    t_from_g = _leg(
+        "from_rows_grouped",
         lambda: [row_mxu.from_rows_fixed_grouped(b.data, layout)
-                 for b in batches],
-        label=f"from_rows_grouped[{num_rows}]", sync_each=big)
+                 for b in batches], sync_each=big)
     # end-to-end grouped consumer leg: decode -> hash two key columns ->
     # null-aware group-by aggregate, all from the plane-major backing in
     # ONE jit per batch (column extraction is plane slices that fuse
@@ -225,21 +239,27 @@ def bench_fixed(num_rows, num_cols=212, use_pallas=None):
             max_groups=256, mask=pids < 100)
         return res, have, ng
 
-    t_query = _time(lambda: [_query_step(b.data) for b in batches],
-                    label=f"query_grouped[{num_rows}]", sync_each=big)
+    t_query = _leg("query_grouped",
+                   lambda: [_query_step(b.data) for b in batches],
+                   sync_each=big)
     res = {
         "num_rows": num_rows,
         "num_cols": num_cols,
         "row_size": layout.fixed_row_size,
-        "query_grouped_s": t_query,
-        "query_grouped_GBps": out_bytes / t_query / 1e9,
         "to_rows_s": t_to,
         "to_rows_GBps": moved / t_to / 1e9,
-        "from_rows_s": t_from,
-        "from_rows_GBps": moved / t_from / 1e9,
-        "from_rows_grouped_s": t_from_g,
-        "from_rows_grouped_GBps": moved / t_from_g / 1e9,
     }
+    if t_query is not None:
+        res["query_grouped_s"] = t_query
+        res["query_grouped_GBps"] = out_bytes / t_query / 1e9
+    if t_from is not None:
+        res["from_rows_s"] = t_from
+        res["from_rows_GBps"] = moved / t_from / 1e9
+    if t_from_g is not None:
+        res["from_rows_grouped_s"] = t_from_g
+        res["from_rows_grouped_GBps"] = moved / t_from_g / 1e9
+    if leg_errors:
+        res["leg_errors"] = leg_errors
     if t_oracle is not None:
         res["oracle_to_rows_s"] = t_oracle
         res["speedup_vs_oracle"] = t_oracle / t_to
@@ -287,6 +307,10 @@ def bench_variable(num_rows, num_cols=155, with_strings=True,
                 assert got == want, "skewed roundtrip lost tail bytes"
                 break
             start += nb
+        # free the verification transients BEFORE timing: the skewed
+        # legs must not run under extra HBM residency the uniform
+        # anchor doesn't share
+        del batches, back
         _log(f"variable skewed: outlier roundtrip verified (row {r})")
     _log(f"variable {num_rows} rows: table ready")
     t_to = _time(lambda: convert_to_rows(table), iters=12,
@@ -313,8 +337,10 @@ def bench_variable(num_rows, num_cols=155, with_strings=True,
         # re-measure: sequential axis subprocesses minutes apart fall
         # into the relay's ±60% window noise (the r4 record's spurious
         # 1.7x "skew gap" was exactly that), so the skewed axis carries
-        # its own interleaved uniform anchor and the ratio
-        del batches
+        # its own interleaved uniform anchor and the ratio.  The skewed
+        # table and blobs are freed first so both profiles time under
+        # the same HBM residency
+        del batches, table
         uprof = DataProfile(string_len_min=0, string_len_max=32)
         utable = create_random_table(dtypes, num_rows, uprof, seed=42)
         jax.block_until_ready(utable)
@@ -667,9 +693,15 @@ def main():
             post(out)
         _annotate(out)
         results.setdefault(key, []).append(out)
-        if "error" in out:
+        if "error" in out or "leg_errors" in out:
             requeue.append((key, len(results[key]) - 1, axis))
         _flush()  # partial results survive a driver timeout
+
+    def _badness(out):
+        """full-axis error = infinitely bad; else count of failed legs"""
+        if "error" in out:
+            return 1 << 30
+        return len(out.get("leg_errors", {}))
 
     for n in row_axes:
         _run("fixed_width", f"fixed:{n}",
@@ -688,8 +720,11 @@ def main():
     for key, idx, axis in requeue:
         _log(f"requeue {axis}: re-running failed axis at end of sweep")
         out = _axis_subprocess(axis)
+        if key != "calibration" and idx < len(results[key]) \
+                and _badness(out) >= _badness(results[key][idx]):
+            continue                # keep the (no worse) original record
         if "error" in out:
-            continue                      # keep the original error record
+            continue
         out["requeued"] = True
         if key == "calibration":
             results["calibration"] = out

@@ -192,7 +192,48 @@ def main():
     restored = jax.device_put(host_img)
     print("OK" if restored.shape == host_img.shape else "FAIL")
 
-    # 12. operator metrics
+    # 12. round-5 query surface: int64 join keys past 2^31 (dense-id
+    # composite probe), decimal128 AVG (exact limb SUM / COUNT with
+    # HALF_UP), and adaptive dense aggregation for int32 date keys
+    from spark_rapids_jni_tpu.models.pipeline import join_inner_table
+    from spark_rapids_jni_tpu.ops.decimal import (decimal128_from_ints,
+                                                  decimal128_to_ints)
+    base = np.int64(3 << 32)
+    build = Table((Column.from_numpy(
+        np.array([base + 1, base + 2, base + 2], np.int64), INT64),
+        Column.from_numpy(np.array([10, 20, 21], np.int32), INT32)))
+    probe = Table((Column.from_numpy(
+        np.array([base + 2, base + 9], np.int64), INT64),))
+    _, pay, _, jvalid, _, _ = join_inner_table(build, 0, 1, probe, 0, 8)
+    print("int64-key join payloads:",
+          sorted(np.asarray(pay)[np.asarray(jvalid)].tolist()))
+
+    davg = Table((Column.from_numpy(np.array([1, 1, 2], np.int32),
+                                    INT32),
+                  decimal128_from_ints([250, 251, -100], scale=2)))
+    dres, dhave, _ = hash_aggregate_table(
+        davg, key_idxs=[0], measures=[(1, "avg")], max_groups=4)
+    print("decimal128 AVG (scale 6):",
+          [decimal128_to_ints(dres.columns[1])[j]
+           for j in np.nonzero(np.asarray(dhave))[0]])
+
+    dates = Table((Column.from_numpy(
+        rng.integers(2_415_022, 2_488_070, 4096).astype(np.int32),
+        INT32),
+        Column.from_numpy(rng.integers(0, 9, 4096).astype(np.int32),
+                          INT32)))
+    _, ahave, ang = hash_aggregate_table(
+        dates, key_idxs=[0], measures=[(None, "count"), (1, "sum")],
+        max_groups=8192)
+    print(f"adaptive date-key group-by: {int(np.asarray(ang))} groups "
+          "(dense-slot branch at runtime)")
+
+    # 13. JSON path extraction on device (trailing + mid-path wildcards)
+    jcol = Column.strings_padded(
+        ['{"a":[{"b":1},{"c":9},{"b":2}]}', '{"a":[]}'])
+    print("$.a[*].b ->", get_json_object(jcol, "$.a[*].b").to_pylist())
+
+    # 14. operator metrics
     snap = metrics.snapshot()
     print("metrics:", {k: v for k, v in sorted(snap.items())
                        if k.endswith(".calls") or k.endswith(".rows")})
