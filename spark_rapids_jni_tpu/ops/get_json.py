@@ -396,12 +396,12 @@ def get_json_object(col: Column, path: str,
     if n_wc:
         wc_at = next(i for i, s in enumerate(segs) if s is WILDCARD)
         trailing = n_wc == 1 and wc_at == len(segs) - 1
-        # a single mid-path wildcard with a key-only suffix projects a
-        # field from every element on device (_eval_wildcard_mid_device);
-        # multiple wildcards or subscripted suffixes fan out beyond the
-        # element-suffix scan and evaluate on the host
+        # a single mid-path wildcard with a key/subscript suffix
+        # projects from every element on device
+        # (_eval_wildcard_mid_device); multiple wildcards fan out beyond
+        # the element-suffix scan and evaluate on the host
         mid_ok = (n_wc == 1 and not trailing
-                  and all(isinstance(s, bytes)
+                  and all(isinstance(s, (bytes, int))
                           for s in segs[wc_at + 1:]))
         if not trailing and not mid_ok:
             if any(isinstance(leaf, jax.core.Tracer)
@@ -1049,9 +1049,15 @@ def _suffix_scan(arr: jnp.ndarray, arr_len: jnp.ndarray, suffix: Tuple,
     S = len(suffix)
     seg_bytes = np.zeros((S, mkl), np.uint8)
     seg_lens = np.zeros((S,), np.int32)
+    seg_isidx = np.zeros((S,), np.int32)
+    seg_tgt = np.zeros((S,), np.int32)
     for i, s in enumerate(suffix):
-        seg_bytes[i, :len(s)] = np.frombuffer(s, np.uint8)
-        seg_lens[i] = len(s)
+        if isinstance(s, int):
+            seg_isidx[i] = 1
+            seg_tgt[i] = s
+        else:
+            seg_bytes[i, :len(s)] = np.frombuffer(s, np.uint8)
+            seg_lens[i] = len(s)
     i32 = jnp.int32
     u8 = jnp.uint8
     zb = jnp.zeros((n,), jnp.bool_)
@@ -1070,7 +1076,7 @@ def _suffix_scan(arr: jnp.ndarray, arr_len: jnp.ndarray, suffix: Tuple,
         return _select_lut_bytes(seg_bytes, idx, kpos, dtype=u8)
 
     # carry dtypes mirror _scan_automaton: flags as bool, small counters
-    # as uint8 (rel/depth/key_pos/phase), only `count` needs int32
+    # as uint8 (rel/depth/key_pos/phase), only counters need int32
     carry0 = dict(
         in_str=zb, esc=zb, depth=z8 + u8(1),  # pos 0 ('[') is skipped
         rel=z8,                           # suffix segments matched
@@ -1079,6 +1085,11 @@ def _suffix_scan(arr: jnp.ndarray, arr_len: jnp.ndarray, suffix: Tuple,
         count=zi, first_str=zb, punt=zb, emit_comma=zb,
         phase=z8, had_tok=zb,             # top-level structure guard
         closed=zb,
+        e_count=zi, e_pending=zb,         # element-local [k] subscripts
+        e_armed=zb,                       # the target ARRAY actually
+                                          # opened (commas in an OBJECT
+                                          # at the same depth must not
+                                          # count as element separators)
     )
 
     def step(c, pos_and_char):
@@ -1118,9 +1129,12 @@ def _suffix_scan(arr: jnp.ndarray, arr_len: jnp.ndarray, suffix: Tuple,
         rel = c["rel"]
         live = ~c["elem_done"] & ~c["punt"]
         frontier = rel + u8(2)            # element object keys live here
+        fr_is_idx = _select_lut_bool(seg_isidx,
+                                     jnp.minimum(rel, u8(S - 1)))
 
-        # --- key scanning (cloned from _scan_automaton, element-local)
-        key_opening = outside & eff_q & c["expect_key"] \
+        # --- key scanning (cloned from _scan_automaton, element-local;
+        # index frontiers count elements instead of matching keys)
+        key_opening = outside & eff_q & c["expect_key"] & ~fr_is_idx \
             & ~c["in_key"] & ~c["await_colon"] \
             & ~c["capturing"] & live & (depth == frontier)
         in_key, key_pos, key_ok = c["in_key"], c["key_pos"], c["key_ok"]
@@ -1139,20 +1153,51 @@ def _suffix_scan(arr: jnp.ndarray, arr_len: jnp.ndarray, suffix: Tuple,
         key_pos = jnp.where(key_opening, u8(0), key_pos)
         key_ok = key_ok | key_opening
 
-        # --- value entry after a matched key's colon
+        # --- value entry after a matched key's colon, or at an index
+        # frontier when the armed element's value starts
         saw_colon = c["await_colon"] & outside & is_colon
         await_colon = await_colon & ~saw_colon
         pending = c["pending"] | saw_colon
-        value_starts = pending & act & ~is_ws & ~saw_colon & live
+        idx_value_starts = c["e_pending"] & c["e_armed"] & fr_is_idx \
+            & outside & ~is_ws & ~is_comma & ~is_close \
+            & (depth == frontier) & ~c["capturing"] & live
+        value_starts = (pending & act & ~is_ws & ~saw_colon & live) \
+            | idx_value_starts
 
         is_last = rel == u8(S - 1)
-        descend = value_starts & ~is_last & (x == u8(ord("{")))
-        deadend = value_starts & ~is_last & (x != u8(ord("{")))
+        # intermediate segments need the container kind the NEXT
+        # segment expects: '[' before a subscript, '{' before a key
+        next_is_idx = _select_lut_bool(
+            seg_isidx, jnp.minimum(rel + u8(1), u8(S - 1)))
+        expected_open = jnp.where(next_is_idx, u8(ord("[")),
+                                  u8(ord("{")))
+        descend = value_starts & ~is_last & (x == expected_open)
+        deadend = value_starts & ~is_last & (x != expected_open)
         start_cap = value_starts & is_last & ~c["capturing"]
         cap_container = start_cap & is_open
         start_str = start_cap & eff_q
         rel = rel + jnp.where(descend, u8(1), u8(0))
         pending = pending & ~(value_starts | deadend)
+
+        # element counting inside a descended-into (or element-root)
+        # array at an index frontier: commas at its depth advance the
+        # counter; the value after comma #k is element k
+        tgt = _select_lut(seg_tgt, jnp.minimum(rel, u8(S - 1)))
+        new_fr_idx = _select_lut_bool(seg_isidx,
+                                      jnp.minimum(rel, u8(S - 1)))
+        arr_open = outside & (x == u8(ord("["))) & new_fr_idx \
+            & (new_depth == rel + u8(2)) & ~c["capturing"] & live
+        e_count = jnp.where(arr_open, 0, c["e_count"])
+        e_pending = jnp.where(arr_open, tgt == 0, c["e_pending"])
+        e_armed = c["e_armed"] | arr_open
+        # only commas inside a genuinely-opened target array count: an
+        # OBJECT element's key-value commas sit at the same depth for
+        # idx-first suffixes and must not advance the element counter
+        idx_comma = outside & is_comma & fr_is_idx & c["e_armed"] \
+            & (depth == frontier) & ~c["capturing"] & live
+        e_count = e_count + jnp.where(idx_comma, 1, 0)
+        e_pending = jnp.where(idx_comma, e_count == tgt,
+                              e_pending & ~idx_value_starts)
 
         # a committed sub-object closing without the match exhausts the
         # element (first-match-commit; same rule as the main automaton)
@@ -1204,6 +1249,11 @@ def _suffix_scan(arr: jnp.ndarray, arr_len: jnp.ndarray, suffix: Tuple,
         await_colon = await_colon & ~elem_comma
         pending = pending & ~elem_comma
         elem_done = elem_done & ~elem_comma
+        e_count = jnp.where(elem_comma, 0, e_count)
+        # an idx-FIRST suffix ($.a[*][0]) re-arms at the next element's
+        # own '[' via arr_open; pending/armed must not leak across
+        e_pending = e_pending & ~elem_comma
+        e_armed = e_armed & ~elem_comma
 
         # --- top-level structure guard (phase at depth 1):
         # 0 = expecting an element (after '[' or ','), 1 = inside a bare
@@ -1232,11 +1282,12 @@ def _suffix_scan(arr: jnp.ndarray, arr_len: jnp.ndarray, suffix: Tuple,
             | (at_top & is_ws & (c["phase"] == u8(1))), u8(2), phase)
 
         # --- expect_key maintenance for the (possibly new) frontier
+        # (index frontiers count elements, not keys: never arm there)
         new_frontier = rel + u8(2)
         opens_frontier = outside & (x == u8(ord("{"))) \
-            & (new_depth == new_frontier)
+            & (new_depth == new_frontier) & ~new_fr_idx
         comma_frontier = outside & is_comma & (depth == new_frontier) \
-            & ~c["capturing"]
+            & ~c["capturing"] & ~new_fr_idx
         clears = act & ~is_ws & ~in_str & ~eff_q & ~is_open & ~is_comma
         expect_key = jnp.where(
             opens_frontier | comma_frontier, True,
@@ -1250,7 +1301,9 @@ def _suffix_scan(arr: jnp.ndarray, arr_len: jnp.ndarray, suffix: Tuple,
                    elem_done=elem_done, count=count,
                    first_str=first_str, punt=punt,
                    emit_comma=emit_comma,
-                   phase=phase, had_tok=had_tok, closed=closed)
+                   phase=phase, had_tok=had_tok, closed=closed,
+                   e_count=e_count, e_pending=e_pending,
+                   e_armed=e_armed)
         # one packed u8 per-position output instead of two bool streams:
         # halves the scan's ys traffic and drops one [W, n] transpose
         flags = keep.astype(u8) | (comma_sub.astype(u8) << 1)

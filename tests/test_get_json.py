@@ -563,11 +563,57 @@ def test_mid_wildcard_under_jit_degrades_punts_to_null():
     assert out.to_pylist() == ["[1,2]", None, "9"]
 
 
-def test_mid_wildcard_subscript_suffix_falls_back_to_host():
-    """A subscripted suffix ($.a[*].b[0]) exceeds the key-only device
-    scan and must still answer via the host walker."""
-    col = Column.strings_padded(['{"a":[{"b":[5,6]},{"b":[7]}]}'])
-    assert get_json_object(col, "$.a[*].b[0]").to_pylist() == ["[5,7]"]
+def test_mid_wildcard_subscript_suffix_on_device(rng):
+    """Subscripted suffixes ($.a[*].b[0], $.a[*][0], deeper chains) run
+    on the device element-suffix scan — randomized docs vs the host
+    walker, including missing indices, empty arrays and ragged
+    elements."""
+    from spark_rapids_jni_tpu.ops.get_json import (_eval_wildcard_host,
+                                                   _parse_path)
+    col0 = Column.strings_padded(['{"a":[{"b":[5,6]},{"b":[7]}]}'])
+    assert get_json_object(col0, "$.a[*].b[0]").to_pylist() == ["[5,7]"]
+
+    r = rng
+    docs = []
+    for _ in range(200):
+        els = []
+        for _ in range(int(r.integers(0, 4))):
+            kind = int(r.integers(0, 5))
+            if kind == 0:
+                arr = ",".join(str(int(v))
+                               for v in r.integers(-9, 99,
+                                                   int(r.integers(0, 4))))
+                els.append('{"b":[%s]}' % arr)
+            elif kind == 1:
+                els.append('{"c":%d}' % int(r.integers(0, 9)))
+            elif kind == 2:
+                arr = ",".join('"s%d"' % int(v)
+                               for v in r.integers(0, 9,
+                                                   int(r.integers(0, 3))))
+                els.append('[%s]' % arr)
+            elif kind == 3:
+                els.append('{"b":[{"c":%d},{"c":%d}]}'
+                           % (int(r.integers(0, 9)),
+                              int(r.integers(0, 9))))
+            else:
+                # multi-pair OBJECT element: its top-level commas sit at
+                # the idx-first frontier depth and must NOT count as
+                # array separators (review regression: '$.a[*][1]'
+                # returned the key name 'y')
+                els.append('{"x":%d,"y":%d,"b":[%d]}'
+                           % (int(r.integers(0, 9)),
+                              int(r.integers(0, 9)),
+                              int(r.integers(0, 9))))
+        docs.append('{"a":[%s]}' % ",".join(els))
+    col = Column.strings_padded(docs)
+    for path in ("$.a[*].b[0]", "$.a[*].b[1]", "$.a[*][0]",
+                 "$.a[*].b[0].c", "$.a[*].b[1].c"):
+        got = get_json_object(col, path).to_pylist()
+        exp = _eval_wildcard_host(col,
+                                  tuple(_parse_path(path))).to_pylist()
+        assert got == exp, (path,
+                            [(d, g, e) for d, g, e
+                             in zip(docs, got, exp) if g != e][:4])
 
 
 def test_unrolled_scan_parity(rng, monkeypatch):
@@ -613,3 +659,12 @@ def test_deep_nesting_routes_to_host():
     col2 = Column.strings_padded([deep_arr, '{"a":[5]}'])
     out = get_json_object(col2, "$.a[*]").to_pylist()
     assert out[1] == "5"
+
+
+def test_mid_wildcard_idx_over_object_no_match():
+    """An OBJECT element is not a list: '$.a[*][1]' must not fabricate
+    a match from the object's key-value commas (review regression:
+    returned the key name)."""
+    col = Column.strings_padded(['{"a":[{"x":1,"y":2}]}',
+                                 '{"a":[[7,8],{"x":1,"y":2}]}'])
+    assert get_json_object(col, "$.a[*][1]").to_pylist() == [None, "8"]
