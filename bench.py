@@ -60,6 +60,49 @@ def _log(msg):
 _T0 = time.perf_counter()
 
 
+class BenchError(RuntimeError):
+    """Base for structured bench failures."""
+
+
+class BenchLegError(BenchError):
+    """A required timing leg died; carries which op failed so the axis
+    error is attributable without parsing the traceback."""
+
+    def __init__(self, op, cause):
+        super().__init__(f"bench leg {op!r} failed: "
+                         f"{type(cause).__name__}: {cause}")
+        self.op = op
+        self.cause = cause
+
+
+class CalibrationError(BenchError):
+    """The HBM-copy calibration anchor failed — distinguishable from op
+    legs: a dead anchor means the session numbers are unnormalizable,
+    not that any kernel is slow."""
+
+
+def _leg(name, fn, leg_errors=None, *, label=None, required=False, **kw):
+    """One timing leg under an obs span: wall/device time, compile count,
+    and (on death) the structured exception all land in the event log —
+    a failed leg is a record, not a hole.  With ``leg_errors`` a dict the
+    failure is recorded as ``{op, type, error}`` and the leg returns
+    ``None`` (a partial axis record beats none — the 1M from-rows leg
+    has died through whole bad relay windows while every other leg
+    passed); ``required`` legs re-raise as :class:`BenchLegError` so the
+    axis error names the op."""
+    from spark_rapids_jni_tpu import obs
+    try:
+        with obs.span(f"leg.{name}"):
+            return _time(fn, label=label or name, **kw)
+    except Exception as e:
+        if required or leg_errors is None:
+            raise BenchLegError(name, e) from e
+        leg_errors[name] = {"op": name, "type": type(e).__name__,
+                            "error": str(e)[:90]}
+        _log(f"{name}: LEG FAILED {type(e).__name__}: {str(e)[:90]}")
+        return None
+
+
 def _sync(out):
     """Force completion of everything queued before ``out``.
 
@@ -167,13 +210,16 @@ def bench_fixed(num_rows, num_cols=212, use_pallas=None):
     # 1M fixed record to exactly this
     big = out_bytes > (1 << 29)
 
-    t_to = _time(lambda: convert_to_rows(table, use_pallas=use_pallas),
-                 label=f"to_rows[{num_rows}]", sync_each=big)
+    t_to = _leg("to_rows",
+                lambda: convert_to_rows(table, use_pallas=use_pallas),
+                label=f"to_rows[{num_rows}]", sync_each=big,
+                required=True)
     t_oracle = None
     if not big:
-        t_oracle = _time(
+        t_oracle = _leg(
+            "oracle_to_rows",
             lambda: convert_to_rows_fixed_width_optimized(table),
-            label=f"oracle_to_rows[{num_rows}]")
+            label=f"oracle_to_rows[{num_rows}]", required=True)
     else:
         # large axes run the oracle per equal-sized batch with a traced
         # start (single-shot would exceed HBM), so the dual-path
@@ -186,9 +232,9 @@ def bench_fixed(num_rows, num_cols=212, use_pallas=None):
             return [_oracle_to_rows_batch_jit(table, layout, s,
                                               min(per, num_rows - s))
                     for s in range(0, num_rows, per)]
-        t_oracle = _time(oracle_batched,
-                         label=f"oracle_to_rows[{num_rows}]",
-                         sync_each=True)
+        t_oracle = _leg("oracle_to_rows", oracle_batched,
+                        label=f"oracle_to_rows[{num_rows}]",
+                        sync_each=True, required=True)
     batches = convert_to_rows(table, use_pallas=use_pallas)
     moved = _table_bytes(table) + out_bytes  # read + write per direction
     # decode phases only need the blobs: free the source table so the 4M
@@ -196,22 +242,11 @@ def bench_fixed(num_rows, num_cols=212, use_pallas=None):
     del table
     leg_errors = {}
 
-    def _leg(name, fn, **kw):
-        """One timing leg; a relay failure records the leg's error
-        instead of killing the whole axis (the 1M from-rows leg has
-        died through whole bad windows while every other leg passed —
-        a partial axis record beats none)."""
-        try:
-            return _time(fn, label=f"{name}[{num_rows}]", **kw)
-        except Exception as e:
-            leg_errors[name] = f"{type(e).__name__}: {str(e)[:90]}"
-            _log(f"{name}[{num_rows}]: LEG FAILED {leg_errors[name]}")
-            return None
-
     t_from = _leg("from_rows",
                   lambda: [convert_from_rows(b, dtypes,
                                              use_pallas=use_pallas)
-                           for b in batches], sync_each=big)
+                           for b in batches], leg_errors,
+                  label=f"from_rows[{num_rows}]", sync_each=big)
     # grouped (dtype-major) decode: the wide-output fast path consumers
     # use when they touch a handful of columns, reported alongside the
     # per-column-materializing standard decode
@@ -219,7 +254,8 @@ def bench_fixed(num_rows, num_cols=212, use_pallas=None):
     t_from_g = _leg(
         "from_rows_grouped",
         lambda: [row_mxu.from_rows_fixed_grouped(b.data, layout)
-                 for b in batches], sync_each=big)
+                 for b in batches], leg_errors,
+        label=f"from_rows_grouped[{num_rows}]", sync_each=big)
     # end-to-end grouped consumer leg: decode -> hash two key columns ->
     # null-aware group-by aggregate, all from the plane-major backing in
     # ONE jit per batch (column extraction is plane slices that fuse
@@ -241,6 +277,7 @@ def bench_fixed(num_rows, num_cols=212, use_pallas=None):
 
     t_query = _leg("query_grouped",
                    lambda: [_query_step(b.data) for b in batches],
+                   leg_errors, label=f"query_grouped[{num_rows}]",
                    sync_each=big)
     res = {
         "num_rows": num_rows,
@@ -313,13 +350,16 @@ def bench_variable(num_rows, num_cols=155, with_strings=True,
         del batches, back
         _log(f"variable skewed: outlier roundtrip verified (row {r})")
     _log(f"variable {num_rows} rows: table ready")
-    t_to = _time(lambda: convert_to_rows(table), iters=12,
-                 label=f"var_to_rows[{num_rows}]", sync_each=True)
+    leg_errors = {}
+    t_to = _leg("var_to_rows", lambda: convert_to_rows(table), iters=12,
+                label=f"var_to_rows[{num_rows}]", sync_each=True,
+                required=True)
     batches = convert_to_rows(table)
     out_bytes = sum(int(np.asarray(b.offsets)[-1]) for b in batches)
-    t_from = _time(lambda: [convert_from_rows(b, dtypes) for b in batches],
-                   iters=12, label=f"var_from_rows[{num_rows}]",
-                   sync_each=True)
+    t_from = _leg("var_from_rows",
+                  lambda: [convert_from_rows(b, dtypes) for b in batches],
+                  leg_errors, iters=12,
+                  label=f"var_from_rows[{num_rows}]", sync_each=True)
     moved = _table_bytes(table) + out_bytes
     res = {
         "num_rows": num_rows,
@@ -329,9 +369,10 @@ def bench_variable(num_rows, num_cols=155, with_strings=True,
         "padded_rows": bool(batches[0].is_padded),
         "to_rows_s": t_to,
         "to_rows_GBps": moved / t_to / 1e9,
-        "from_rows_s": t_from,
-        "from_rows_GBps": moved / t_from / 1e9,
     }
+    if t_from is not None:
+        res["from_rows_s"] = t_from
+        res["from_rows_GBps"] = moved / t_from / 1e9
     if skewed:
         # skew parity must be judged against a SAME-PROCESS uniform
         # re-measure: sequential axis subprocesses minutes apart fall
@@ -344,18 +385,25 @@ def bench_variable(num_rows, num_cols=155, with_strings=True,
         uprof = DataProfile(string_len_min=0, string_len_max=32)
         utable = create_random_table(dtypes, num_rows, uprof, seed=42)
         jax.block_until_ready(utable)
-        tu = _time(lambda: convert_to_rows(utable), iters=12,
-                   label=f"var_to_rows_uniform_anchor[{num_rows}]",
-                   sync_each=True)
+        tu = _leg("var_to_rows_uniform_anchor",
+                  lambda: convert_to_rows(utable), leg_errors, iters=12,
+                  label=f"var_to_rows_uniform_anchor[{num_rows}]",
+                  sync_each=True)
         ub = convert_to_rows(utable)
-        tuf = _time(lambda: [convert_from_rows(b, dtypes) for b in ub],
-                    iters=12,
-                    label=f"var_from_rows_uniform_anchor[{num_rows}]",
-                    sync_each=True)
-        res["uniform_anchor_to_s"] = tu
-        res["uniform_anchor_from_s"] = tuf
-        res["skew_to_ratio"] = t_to / tu
-        res["skew_from_ratio"] = t_from / tuf
+        tuf = _leg("var_from_rows_uniform_anchor",
+                   lambda: [convert_from_rows(b, dtypes) for b in ub],
+                   leg_errors, iters=12,
+                   label=f"var_from_rows_uniform_anchor[{num_rows}]",
+                   sync_each=True)
+        if tu is not None:
+            res["uniform_anchor_to_s"] = tu
+            res["skew_to_ratio"] = t_to / tu
+        if tuf is not None:
+            res["uniform_anchor_from_s"] = tuf
+            if t_from is not None:
+                res["skew_from_ratio"] = t_from / tuf
+    if leg_errors:
+        res["leg_errors"] = leg_errors
     return res
 
 
@@ -377,11 +425,20 @@ def _calibrate_hbm():
     # hazard _time documents); 16 x 256MB stays well inside HBM while
     # remaining far above the tunnel round-trip in cost
     n = 64 * 1024 * 1024
-    x = jax.jit(lambda: jnp.ones((n,), jnp.uint32))()
-    _sync(x)
-    cp = jax.jit(lambda a: a + jnp.uint32(1))
-    t = _time(lambda: cp(x), iters=16, label="hbm_calibration")
-    del x
+    from spark_rapids_jni_tpu import obs
+    try:
+        with obs.span("leg.hbm_calibration"):
+            x = jax.jit(lambda: jnp.ones((n,), jnp.uint32))()
+            _sync(x)
+            cp = jax.jit(lambda a: a + jnp.uint32(1))
+            t = _time(lambda: cp(x), iters=16, label="hbm_calibration")
+        del x
+    except Exception as e:
+        # a dead anchor is a session problem, not an op problem — raise
+        # a type the axis error string names so the distinction survives
+        # the subprocess boundary
+        raise CalibrationError(
+            f"hbm calibration failed: {type(e).__name__}: {e}") from e
     moved = 2 * 4 * n  # read + write
     return {"copy_s": t, "calibration_GBps": moved / t / 1e9,
             "pct_hbm": round(100 * moved / t / 1e9 / _HBM_GBPS, 2)}
@@ -418,8 +475,9 @@ def bench_json_wildcard(num_rows):
         _log(f"json {num_rows}: {label} oracle check OK")
         col = Column.strings_padded(docs)
         jax.block_until_ready(col.chars2d)
-        t = _time(lambda: get_json_object(col, path), iters=12,
-                  label=f"{label}[{num_rows}]", sync_each=True)
+        t = _leg(label, lambda: get_json_object(col, path), iters=12,
+                 label=f"{label}[{num_rows}]", sync_each=True,
+                 required=True)
         return t, col.chars2d.size
 
     t, nbytes = _measure(
@@ -441,28 +499,49 @@ def bench_json_wildcard(num_rows):
             "mid_scanned_GBps": mbytes / tm / 1e9}
 
 
+def _obs_axis_summary():
+    """Compact per-op obs digest of this axis process — every leg span
+    (including failed ones, which carry ``error_types``) plus the XLA
+    compile totals — attached to the AXIS_RESULT so BENCH_DETAILS.json
+    records timing/compiles/errors even for legs that died."""
+    from spark_rapids_jni_tpu import obs
+    from spark_rapids_jni_tpu.obs import report
+    obs.flush()
+    summ = report.summarize(obs.events())
+    ops = {}
+    for name, rec in summ["ops"].items():
+        d = {"calls": rec["calls"], "failures": rec["failures"],
+             "wall_p50_s": rec["wall_p50_s"], "device_s": rec["device_s"],
+             "compiles": rec["compiles"], "compile_s": rec["compile_s"]}
+        if rec["error_types"]:
+            d["error_types"] = rec["error_types"]
+        ops[name] = d
+    return {"ops": ops, "compiles": summ["compiles"]}
+
+
 def _run_axis(axis: str):
     """Run one benchmark axis in this process and print its result JSON."""
+    from spark_rapids_jni_tpu import obs
+    obs.enable()   # ring buffer (+ the SRJ_TPU_EVENTS sink if configured)
     if axis == "calibrate":
-        print("AXIS_RESULT " + json.dumps(_calibrate_hbm()), flush=True)
-        return
-    kind, n = axis.split(":")
-    if kind == "json":
-        print("AXIS_RESULT " + json.dumps(bench_json_wildcard(int(n))),
-              flush=True)
-        return
-    if kind == "fixed":
-        res = bench_fixed(int(n))
-    elif kind == "nostrings":
-        res = bench_variable(int(n), with_strings=False)
-    elif kind == "skewed":
-        res = bench_variable(int(n), skewed=True)
+        res = _calibrate_hbm()
     else:
-        res = bench_variable(int(n))
-    for d in ("to_rows", "from_rows"):
-        if f"{d}_GBps" in res:
-            res[f"{d}_pct_hbm"] = round(
-                100 * res[f"{d}_GBps"] / _HBM_GBPS, 2)
+        kind, n = axis.split(":")
+        if kind == "json":
+            res = bench_json_wildcard(int(n))
+        elif kind == "fixed":
+            res = bench_fixed(int(n))
+        elif kind == "nostrings":
+            res = bench_variable(int(n), with_strings=False)
+        elif kind == "skewed":
+            res = bench_variable(int(n), skewed=True)
+        else:
+            res = bench_variable(int(n))
+        for d in ("to_rows", "from_rows"):
+            if f"{d}_GBps" in res:
+                res[f"{d}_pct_hbm"] = round(
+                    100 * res[f"{d}_GBps"] / _HBM_GBPS, 2)
+    res["obs"] = _obs_axis_summary()
     print("AXIS_RESULT " + json.dumps(res), flush=True)
 
 
@@ -628,6 +707,24 @@ def _axis_subprocess(axis: str, timeout_s: int = 540, attempts: int = 3):
     return last
 
 
+def _collect_leg_failures(results):
+    """``[{axis, op, type}]`` for every failed leg anywhere in the sweep,
+    read from the structured ``leg_errors`` records each axis carries."""
+    fails = []
+    for key, v in results.items():
+        for d in (v if isinstance(v, list) else [v]):
+            if not isinstance(d, dict):
+                continue
+            for le in (d.get("leg_errors") or {}).values():
+                if isinstance(le, dict):
+                    fails.append({"axis": key, "op": le.get("op"),
+                                  "type": le.get("type")})
+                else:       # pre-structured string form, kept readable
+                    fails.append({"axis": key, "op": None,
+                                  "type": str(le).split(":")[0]})
+    return fails
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -738,13 +835,17 @@ def main():
                 results[key][idx] = _annotate(out)
         _flush()
 
+    leg_failures = _collect_leg_failures(results)
     fixed = results.get("fixed_width", [])
     head = next((r for r in fixed if "error" not in r), None)
     if head is None:
-        print(json.dumps({"metric": "to_rows_212col_throughput",
-                          "value": 0.0, "unit": "GB/s", "vs_baseline": 0.0,
-                          "error": (fixed[0].get("error", "unknown")
-                                    if fixed else "no axes ran")}))
+        out = {"metric": "to_rows_212col_throughput",
+               "value": 0.0, "unit": "GB/s", "vs_baseline": 0.0,
+               "error": (fixed[0].get("error", "unknown")
+                         if fixed else "no axes ran")}
+        if leg_failures:
+            out["leg_failures"] = leg_failures
+        print(json.dumps(out))
         sys.exit(1)
     # headline: largest successful fixed-width axis, to-rows direction;
     # vs_baseline from the largest axis that ran the oracle comparison
@@ -762,6 +863,10 @@ def main():
         out["calibration_GBps"] = round(cal["calibration_GBps"], 1)
         out["pct_of_calibration"] = round(
             100 * head["to_rows_GBps"] / cal["calibration_GBps"], 2)
+    if leg_failures:
+        # name WHAT failed in the headline, not just that something did:
+        # each entry is {axis, op, type} from a structured leg record
+        out["leg_failures"] = leg_failures
     print(json.dumps(out))
 
 

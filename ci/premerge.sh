@@ -9,3 +9,21 @@ make native-test
 # full python suite on the 8-device virtual CPU mesh (conftest sets it up);
 # bypass the axon TPU relay so CI is hermetic
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q
+
+# observability smoke: run a tiny op under the JSONL event sink and make
+# the report CLI digest it — proves spans flow end to end (the CLI exits
+# non-zero on an empty log, and set -e turns that into a gate failure)
+OBS_EVENTS=$(mktemp /tmp/srj_obs_smoke.XXXXXX.jsonl)
+OBS_REPORT=$(mktemp /tmp/srj_obs_smoke.XXXXXX.txt)
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu SRJ_TPU_EVENTS="$OBS_EVENTS" \
+  python -c "
+import jax.numpy as jnp
+from spark_rapids_jni_tpu import Column, INT32, Table
+from spark_rapids_jni_tpu.ops import convert_from_rows, convert_to_rows
+t = Table((Column(INT32, jnp.arange(64, dtype=jnp.int32)),))
+convert_from_rows(convert_to_rows(t)[0], [INT32])
+"
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python -m spark_rapids_jni_tpu.obs "$OBS_EVENTS" > "$OBS_REPORT"
+grep -q convert_to_rows "$OBS_REPORT"
+rm -f "$OBS_EVENTS" "$OBS_REPORT"

@@ -20,6 +20,11 @@ Subpackage map (see each module's docstring for its reference citation):
   ``src/main/cpp/benchmarks/common/generate_input.hpp``).
 - ``faultinj``: fault injection at the runtime-API boundary (reference
   ``src/main/cpp/faultinj/faultinj.cu``).
+- ``obs``: structured observability — timed spans over the operator entry
+  points (wall + fenced device time, rows/bytes, per-span XLA compile
+  counts, failure capture), a JSONL event sink (``SRJ_TPU_EVENTS=<path>``),
+  and the ``python -m spark_rapids_jni_tpu.obs`` report CLI; the NVTX-range
+  layer it subsumes lives in ``utils.tracing``/``utils.metrics``.
 - ``memory``: the RMM analogue — pooled host staging arena (native
   freelist, ``native/src/host_arena.cpp``) + PJRT device-buffer
   statistics/lifetime adaptor (reference RMM knobs,

@@ -85,6 +85,25 @@ DOMAIN_TRANSFER = "pjrtTransferFaults"
 _DOMAINS = (DOMAIN_COMPILE, DOMAIN_EXECUTE, DOMAIN_TRANSFER)
 
 
+def _emit_fault(domain: str, name: str, itype: Optional[int] = None,
+                rejected: bool = False) -> None:
+    """Mirror an injection (or a device-dead rejection) into the obs event
+    log, so fault assertions can be made against the same JSONL/report
+    stream as spans.  Lazy import: obs imports nothing from faultinj at
+    module level, but the reverse edge must also stay import-time-free."""
+    try:
+        from spark_rapids_jni_tpu import obs
+        if not obs.enabled():
+            return
+        ev = {"kind": "fault", "domain": domain, "name": name,
+              "rejected": rejected}
+        if itype is not None:
+            ev["injection_type"] = itype
+        obs.emit(ev)
+    except Exception:
+        pass
+
+
 class FaultInjectionError(RuntimeError):
     """Base class for every injected failure."""
 
@@ -245,6 +264,7 @@ class FaultInjectorState:
         with self.lock:
             self.calls[domain] = self.calls.get(domain, 0) + 1
             if self.device_dead:
+                _emit_fault(domain, name, rejected=True)
                 raise FatalDeviceError(
                     f"faultinj: device unusable (prior fatal fault); "
                     f"rejected {domain}:{name}")
@@ -260,6 +280,7 @@ class FaultInjectorState:
             itype = rule.injection_type
         logger.error("faultinj: injecting type=%d into %s:%s",
                      itype, domain, name)
+        _emit_fault(domain, name, itype=itype)
         if itype == FI_TRAP:
             with self.lock:
                 self.device_dead = True

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_jni_tpu.table import Column, Table
+from spark_rapids_jni_tpu.obs import span_fn
 from spark_rapids_jni_tpu.ops.hashing import murmur3_hash, pmod
 
 
@@ -566,6 +567,7 @@ def merge_aggregate_partials(partials, ops: Sequence[str]):
             _merge_one(out[key], vals, ops)
     return out
 
+@span_fn(fence=False)
 def merge_aggregate_table_partials(results, num_keys: int,
                                    ops: Sequence[str]):
     """Combine per-device result TABLES from the Table-level distributed
@@ -673,6 +675,7 @@ def _source_num_rows(source) -> int:
     return source.num_rows
 
 
+@span_fn(attrs=lambda source, *a, **k: {"rows": source.num_rows})
 def hash_aggregate_table(source, key_idxs: Sequence[int],
                          measures: Sequence, max_groups: int,
                          mask: Optional[jnp.ndarray] = None):
@@ -1639,6 +1642,7 @@ def _join_keys_pair(build, build_key: int, probe, probe_key: int):
     return bk, bc.valid_bools(), pk, pc.valid_bools()
 
 
+@span_fn(attrs=lambda build, bk, probe, *a, **k: {"rows": probe.num_rows})
 def join_semi_mask_table(build, build_key: int, probe,
                          probe_key: int) -> jnp.ndarray:
     """Left-semi existence mask with Spark null semantics: null probe
@@ -1656,6 +1660,7 @@ def join_semi_mask_table(build, build_key: int, probe,
     return pv & (jnp.minimum(hi, n_real) > lo)
 
 
+@span_fn(attrs=lambda build, bk, bp, probe, *a, **k: {"rows": probe.num_rows})
 def join_inner_table(build, build_key: int, build_payload: int,
                      probe, probe_key: int, capacity: int):
     """Inner join (duplicate build keys allowed) with null-key

@@ -1,0 +1,38 @@
+"""Structured span/event observability — the NVTX + nvml telemetry tier.
+
+The reference instruments every footer API and kernel hot spot with NVTX
+ranges (``CUDF_FUNC_RANGE()``, ``NativeParquetJni.cpp:136,392``) and ships
+a fault-observation tool (``faultinj.cu``); profile-guided rounds hang off
+that substrate.  This package is the TPU-native equivalent, subsuming and
+extending ``utils/tracing.py`` (named scopes) and ``utils/metrics.py``
+(counters):
+
+- :func:`span` / :func:`span_fn` — timed spans on every hot entry point:
+  host wall-clock, device-completion time (``block_until_ready`` fence),
+  nesting, thread identity, rows/bytes attributes, exception capture.
+- :mod:`~spark_rapids_jni_tpu.obs.compilemon` — ``jax.monitoring``
+  subscription counting XLA backend compiles (and compile-seconds) per
+  span, so shape-churn recompiles are a visible counter, not a mystery
+  slowdown.
+- Device-memory snapshots at span boundaries from the PJRT allocator
+  counters (``memory.device_memory_stats``).
+- A bounded in-process ring buffer (:func:`events`) plus an optional JSONL
+  sink: ``SRJ_TPU_EVENTS=<path>`` writes one event per line.
+- ``python -m spark_rapids_jni_tpu.obs <events.jsonl>`` — per-op summary
+  table (calls, p50/p95 wall, device ms, volume, compiles, failures) and a
+  ``--prom`` Prometheus text exposition.
+
+Enable with ``SRJ_TPU_EVENTS=<path>``, ``SRJ_TPU_OBS=1``, or
+:func:`enable`; off by default and free when off (no fences, no locks).
+"""
+
+from spark_rapids_jni_tpu.obs.spans import (  # noqa: F401
+    Span, clear, configure_sink, current_span, disable, emit, enable,
+    enabled, events, flush, recording, sink_path, span, span_fn,
+)
+from spark_rapids_jni_tpu.obs import compilemon as _compilemon
+from spark_rapids_jni_tpu.obs import report  # noqa: F401
+
+compile_totals = _compilemon.totals
+
+_compilemon.install()

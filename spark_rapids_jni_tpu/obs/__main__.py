@@ -1,0 +1,7 @@
+"""CLI entry: ``python -m spark_rapids_jni_tpu.obs <events.jsonl>``."""
+
+import sys
+
+from spark_rapids_jni_tpu.obs.report import main
+
+sys.exit(main())

@@ -1,0 +1,84 @@
+"""Compile telemetry: make silent XLA recompiles visible.
+
+The framework's cold compiles run tens of seconds on the wide benchmark
+schemas (see ``bench.py``'s persistent-cache workaround), and a shape- or
+dtype-churned call site recompiles *silently* — the invocation just takes
+500x longer.  This module subscribes to ``jax.monitoring``'s duration
+events and turns every backend compile into:
+
+- a process-global counter (:func:`totals`),
+- per-span attribution — every span active on the compiling thread gets
+  the compile added to its ``compiles``/``compile_s``, so an operator that
+  recompiles per call shows ``compiles == calls`` in the report instead of
+  a mysteriously slow p95, and
+- a ``kind="compile"`` event in the obs stream (ring + JSONL sink) naming
+  the innermost span it happened under.
+
+Registration is process-wide and idempotent; the listener is a dict lookup
+and an early return for non-compile events, and jax invokes it only around
+compiles — there is no per-dispatch cost.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+# the actual backend compile (cache misses only — in-process and
+# persistent cache hits skip it), the signal that distinguishes "XLA
+# built a program" from "the trace was replayed"
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+_totals = {"compiles": 0, "compile_s": 0.0}
+_installed = False
+
+
+def _listener(name: str, secs: float, **kwargs) -> None:
+    if name != _COMPILE_EVENT:
+        return
+    with _lock:
+        _totals["compiles"] += 1
+        _totals["compile_s"] += secs
+    # attribute to every span active on this thread (compiles run
+    # synchronously on the dispatching thread): nested spans each see the
+    # compiles that happened within them
+    from spark_rapids_jni_tpu.obs import spans
+    stack = getattr(spans._tls, "stack", None) or ()
+    for sp in stack:
+        sp.compiles += 1
+        sp.compile_s += secs
+    spans.emit({"kind": "compile", "duration_s": secs,
+                "span": stack[-1].name if stack else None})
+
+
+def install() -> bool:
+    """Register the listener with ``jax.monitoring`` (idempotent).  Returns
+    False when the monitoring API is unavailable (compile counts then stay
+    zero; spans still work)."""
+    global _installed
+    if _installed:
+        return True
+    try:
+        from jax import monitoring
+    except Exception:
+        try:
+            from jax._src import monitoring  # type: ignore
+        except Exception:
+            return False
+    try:
+        monitoring.register_event_duration_secs_listener(_listener)
+    except Exception:
+        return False
+    _installed = True
+    return True
+
+
+def installed() -> bool:
+    return _installed
+
+
+def totals() -> Dict[str, float]:
+    """Process-wide compile counters since import."""
+    with _lock:
+        return dict(_totals)

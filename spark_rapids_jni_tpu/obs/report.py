@@ -1,0 +1,228 @@
+"""Event-log reporting: JSONL -> per-op summary table / Prometheus text.
+
+``python -m spark_rapids_jni_tpu.obs <events.jsonl>`` prints, per span
+name: calls, failures, wall p50/p95, total device time, rows/bytes volume,
+compile count and compile-seconds — the at-a-glance answer to "which op is
+slow, which op recompiles, which op fails".  ``--prom`` emits the same
+aggregates as a Prometheus text exposition (one scrape away from a real
+dashboard); ``--json`` dumps the raw summary dict.
+
+Pure stdlib on purpose: the report must load a log from a process that
+died (the whole point of failure capture), so it depends on nothing that
+the failing run could have broken.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, Iterable, List, Optional
+
+
+def load_events(path: str) -> Iterable[Dict]:
+    """Yield events from a JSONL file, skipping blank/corrupt lines (a
+    crashed writer can leave a torn final line — that must not make the
+    log unreadable)."""
+    with open(path, "r") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(ev, dict):
+                yield ev
+
+
+def _pct(sorted_vals: List[float], q: float) -> Optional[float]:
+    """Linear-interpolated percentile of an ascending list."""
+    if not sorted_vals:
+        return None
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = (len(sorted_vals) - 1) * q / 100.0
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac
+
+
+def summarize(events: Iterable[Dict]) -> Dict:
+    """Aggregate an event stream into per-op stats plus fault/compile
+    totals.  Per op: calls, failures, wall_p50_s/wall_p95_s/wall_sum_s,
+    device_s, rows, bytes, compiles, compile_s, error_types."""
+    ops: Dict[str, Dict] = {}
+    faults = {"total": 0, "rejected": 0, "by_domain": {}}
+    compiles = {"count": 0, "seconds": 0.0}
+    for ev in events:
+        kind = ev.get("kind")
+        if kind == "span":
+            s = ops.setdefault(ev.get("name", "?"), {
+                "calls": 0, "failures": 0, "wall": [], "device_s": 0.0,
+                "rows": 0, "bytes": 0, "compiles": 0, "compile_s": 0.0,
+                "error_types": {}})
+            s["calls"] += 1
+            if ev.get("status") == "error":
+                s["failures"] += 1
+                et = ev.get("error_type", "?")
+                s["error_types"][et] = s["error_types"].get(et, 0) + 1
+            if isinstance(ev.get("wall_s"), (int, float)):
+                s["wall"].append(float(ev["wall_s"]))
+            if isinstance(ev.get("device_s"), (int, float)):
+                s["device_s"] += float(ev["device_s"])
+            for key in ("rows", "bytes"):
+                if isinstance(ev.get(key), (int, float)):
+                    s[key] += int(ev[key])
+            if isinstance(ev.get("compiles"), int):
+                s["compiles"] += ev["compiles"]
+            if isinstance(ev.get("compile_s"), (int, float)):
+                s["compile_s"] += float(ev["compile_s"])
+        elif kind == "fault":
+            faults["total"] += 1
+            dom = ev.get("domain", "?")
+            faults["by_domain"][dom] = faults["by_domain"].get(dom, 0) + 1
+            if ev.get("rejected"):
+                faults["rejected"] += 1
+        elif kind == "compile":
+            compiles["count"] += 1
+            if isinstance(ev.get("duration_s"), (int, float)):
+                compiles["seconds"] += float(ev["duration_s"])
+    for s in ops.values():
+        wall = sorted(s.pop("wall"))
+        s["wall_p50_s"] = _pct(wall, 50)
+        s["wall_p95_s"] = _pct(wall, 95)
+        s["wall_sum_s"] = sum(wall)
+    return {"ops": ops, "faults": faults, "compiles": compiles}
+
+
+def _ms(v: Optional[float]) -> str:
+    return f"{v * 1e3:.2f}" if isinstance(v, (int, float)) else "-"
+
+
+def format_table(summary: Dict) -> str:
+    """Fixed-width per-op table plus fault/compile footer lines."""
+    lines = [f"{'op':<36} {'calls':>6} {'fail':>5} {'p50_ms':>10} "
+             f"{'p95_ms':>10} {'device_ms':>10} {'rows':>12} "
+             f"{'bytes':>14} {'compiles':>8} {'compile_s':>9}"]
+    lines.append("-" * len(lines[0]))
+    for name in sorted(summary["ops"]):
+        s = summary["ops"][name]
+        lines.append(
+            f"{name:<36} {s['calls']:>6} {s['failures']:>5} "
+            f"{_ms(s['wall_p50_s']):>10} {_ms(s['wall_p95_s']):>10} "
+            f"{_ms(s['device_s'] or None):>10} {s['rows']:>12} "
+            f"{s['bytes']:>14} {s['compiles']:>8} {s['compile_s']:>9.2f}")
+    errs = {name: s["error_types"] for name, s in summary["ops"].items()
+            if s["error_types"]}
+    if errs:
+        lines.append("")
+        lines.append("failures:")
+        for name in sorted(errs):
+            kinds = ", ".join(f"{t} x{c}" for t, c
+                              in sorted(errs[name].items()))
+            lines.append(f"  {name}: {kinds}")
+    comp = summary["compiles"]
+    faults = summary["faults"]
+    lines.append("")
+    lines.append(f"xla compiles: {comp['count']} "
+                 f"({comp['seconds']:.2f}s total)")
+    if faults["total"]:
+        doms = ", ".join(f"{d}={c}" for d, c
+                         in sorted(faults["by_domain"].items()))
+        lines.append(f"injected faults: {faults['total']} ({doms}; "
+                     f"{faults['rejected']} device-dead rejections)")
+    return "\n".join(lines)
+
+
+def _label(v: str) -> str:
+    """Escape a Prometheus label value."""
+    return v.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def format_prometheus(summary: Dict) -> str:
+    """Prometheus text exposition of the same aggregates (counter
+    semantics: totals over the life of the event log)."""
+    out = []
+
+    def metric(name, help_, rows):
+        out.append(f"# HELP {name} {help_}")
+        out.append(f"# TYPE {name} counter")
+        out.extend(rows)
+
+    ops = summary["ops"]
+
+    def per_op(fmt):
+        return [fmt(name, s) for name, s in sorted(ops.items())]
+
+    metric("srj_tpu_span_calls_total", "Span invocations per op.",
+           per_op(lambda n, s:
+                  f'srj_tpu_span_calls_total{{op="{_label(n)}"}} '
+                  f'{s["calls"]}'))
+    metric("srj_tpu_span_failures_total", "Failed span invocations per op.",
+           per_op(lambda n, s:
+                  f'srj_tpu_span_failures_total{{op="{_label(n)}"}} '
+                  f'{s["failures"]}'))
+    metric("srj_tpu_span_wall_seconds_total", "Host wall seconds per op.",
+           per_op(lambda n, s:
+                  f'srj_tpu_span_wall_seconds_total{{op="{_label(n)}"}} '
+                  f'{s["wall_sum_s"]:.6f}'))
+    metric("srj_tpu_span_device_seconds_total",
+           "Device-completion seconds per op (fenced spans only).",
+           per_op(lambda n, s:
+                  f'srj_tpu_span_device_seconds_total{{op="{_label(n)}"}} '
+                  f'{s["device_s"]:.6f}'))
+    metric("srj_tpu_span_rows_total", "Rows processed per op.",
+           per_op(lambda n, s:
+                  f'srj_tpu_span_rows_total{{op="{_label(n)}"}} '
+                  f'{s["rows"]}'))
+    metric("srj_tpu_span_bytes_total", "Bytes processed per op.",
+           per_op(lambda n, s:
+                  f'srj_tpu_span_bytes_total{{op="{_label(n)}"}} '
+                  f'{s["bytes"]}'))
+    metric("srj_tpu_span_xla_compiles_total",
+           "XLA backend compiles attributed per op.",
+           per_op(lambda n, s:
+                  f'srj_tpu_span_xla_compiles_total{{op="{_label(n)}"}} '
+                  f'{s["compiles"]}'))
+    comp = summary["compiles"]
+    metric("srj_tpu_xla_compiles_total", "XLA backend compiles observed.",
+           [f"srj_tpu_xla_compiles_total {comp['count']}"])
+    metric("srj_tpu_xla_compile_seconds_total",
+           "Seconds spent in XLA backend compiles.",
+           [f"srj_tpu_xla_compile_seconds_total {comp['seconds']:.6f}"])
+    metric("srj_tpu_fault_injections_total",
+           "Injected faults fired, by domain.",
+           [f'srj_tpu_fault_injections_total{{domain="{_label(d)}"}} {c}'
+            for d, c in sorted(summary["faults"]["by_domain"].items())])
+    return "\n".join(out) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m spark_rapids_jni_tpu.obs",
+        description="Summarize a span/event JSONL log "
+                    "(written under SRJ_TPU_EVENTS=<path>).")
+    ap.add_argument("path", help="events JSONL file")
+    ap.add_argument("--prom", action="store_true",
+                    help="Prometheus text exposition instead of the table")
+    ap.add_argument("--json", action="store_true",
+                    help="raw summary dict as JSON")
+    args = ap.parse_args(argv)
+    try:
+        events = list(load_events(args.path))
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    summary = summarize(events)
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    elif args.prom:
+        sys.stdout.write(format_prometheus(summary))
+    else:
+        print(format_table(summary))
+    # empty logs exit non-zero so CI smoke checks can assert "events
+    # actually flowed" with the exit code alone
+    return 0 if events else 1

@@ -1,0 +1,360 @@
+"""Timed spans: the structured core of the observability layer.
+
+A span measures one operator invocation end to end: host wall-clock,
+device-completion time (an explicit ``block_until_ready`` fence, the
+``cudaEventSynchronize``-bracketing every CUDA profiler leans on), the XLA
+compiles that happened inside it (attributed by
+:mod:`~spark_rapids_jni_tpu.obs.compilemon`), device-memory deltas from the
+PJRT allocator counters, and — when the body raises — the exception type,
+message, and device health instead of letting the failure vanish into a log
+tail.
+
+Spans nest (thread-local stack; events carry ``depth`` and ``parent``) and
+are thread-safe.  Finished spans land in a bounded in-process ring buffer
+(:func:`events`) and, when a sink is configured, as one JSON object per
+line in a JSONL file — the format :mod:`~spark_rapids_jni_tpu.obs.report`
+consumes.
+
+Off by default and **free when off**: the disabled path is one attribute
+read, inserts no device fences, and takes no locks — the same contract as
+``metrics``/``tracing`` (and the acceptance guard in
+``tests/test_obs.py::test_disabled_spans_insert_no_fences``).  Enable with
+``SRJ_TPU_EVENTS=<path>`` (JSONL sink), ``SRJ_TPU_OBS=1`` (ring only), or
+:func:`enable`.  Spans also stand down inside a jit trace (recording there
+would fire once per compile, not per call, and a tracer cannot be fenced) —
+the same eager-only rule ``metrics._recording`` enforces.
+
+Note on remote-tunnel backends (axon): ``jax.block_until_ready`` does not
+actually wait there (see ``bench.py::_sync``), so ``device_s`` is a lower
+bound on such backends; on local PJRT clients (CPU tests, real TPU) it is
+the true device-completion time.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import functools
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+from spark_rapids_jni_tpu.utils import metrics as _metrics
+
+_RING_CAP = int(os.environ.get("SRJ_TPU_OBS_RING", "4096"))
+
+
+class _State:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.enabled = False
+        self.sink_path: Optional[str] = None
+        self.sink = None
+        self.ring = collections.deque(maxlen=_RING_CAP)
+
+
+_STATE = _State()
+_tls = threading.local()
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+# ---------------------------------------------------------------------------
+# Enablement + sink
+# ---------------------------------------------------------------------------
+
+def enable(sink: Optional[str] = None) -> None:
+    """Turn span recording on.  ``sink``: optional JSONL path (append, one
+    event per line); omitted, the current sink configuration (typically
+    from ``SRJ_TPU_EVENTS``) is kept."""
+    with _STATE.lock:
+        _STATE.enabled = True
+        if sink is not None:
+            _set_sink_locked(sink)
+
+
+def disable() -> None:
+    """Turn span recording off and flush/close the sink.  The sink *path*
+    stays configured; :func:`enable` re-opens it on the next event."""
+    with _STATE.lock:
+        _STATE.enabled = False
+        _close_sink_locked()
+
+
+def enabled() -> bool:
+    return _STATE.enabled
+
+
+def recording() -> bool:
+    """True when spans should record here and now: enabled AND executing
+    eagerly (inside a jit trace a span body runs once per compile, not per
+    invocation, and tracers cannot be fenced)."""
+    return _STATE.enabled and _metrics.eager()
+
+
+def configure_sink(path: Optional[str]) -> None:
+    """Point the JSONL sink at ``path`` (``None`` detaches it)."""
+    with _STATE.lock:
+        if path is None:
+            _close_sink_locked()
+            _STATE.sink_path = None
+        else:
+            _set_sink_locked(path)
+
+
+def sink_path() -> Optional[str]:
+    return _STATE.sink_path
+
+
+def _set_sink_locked(path: str) -> None:
+    if path != _STATE.sink_path:
+        _close_sink_locked()
+    _STATE.sink_path = path
+
+
+def _close_sink_locked() -> None:
+    if _STATE.sink is not None:
+        try:
+            _STATE.sink.close()
+        except Exception:
+            pass
+        _STATE.sink = None
+
+
+def flush() -> None:
+    with _STATE.lock:
+        if _STATE.sink is not None:
+            try:
+                _STATE.sink.flush()
+            except Exception:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Event stream
+# ---------------------------------------------------------------------------
+
+def emit(event: Dict) -> None:
+    """Record one event (no-op unless enabled): append to the ring buffer
+    and, when a sink is configured, write one JSON line.  Never raises —
+    observability must not take down the operation it observes."""
+    if not _STATE.enabled:
+        return
+    ev = dict(event)
+    ev.setdefault("ts", time.time())
+    try:
+        with _STATE.lock:
+            _STATE.ring.append(ev)
+            if _STATE.sink is None and _STATE.sink_path:
+                try:
+                    _STATE.sink = open(_STATE.sink_path, "a")
+                except OSError:
+                    _STATE.sink_path = None  # bad path: drop, keep the ring
+            if _STATE.sink is not None:
+                try:
+                    _STATE.sink.write(json.dumps(ev, default=str) + "\n")
+                    _STATE.sink.flush()
+                except Exception:
+                    _close_sink_locked()
+    except Exception:
+        pass
+
+
+def events(kind: Optional[str] = None) -> List[Dict]:
+    """Snapshot of the in-process ring buffer, optionally filtered."""
+    with _STATE.lock:
+        evs = list(_STATE.ring)
+    if kind is not None:
+        evs = [e for e in evs if e.get("kind") == kind]
+    return evs
+
+
+def clear() -> None:
+    with _STATE.lock:
+        _STATE.ring.clear()
+
+
+# ---------------------------------------------------------------------------
+# Spans
+# ---------------------------------------------------------------------------
+
+def _mem_snapshot() -> Dict[str, int]:
+    try:
+        from spark_rapids_jni_tpu.memory import device_memory_stats
+        return device_memory_stats()
+    except Exception:
+        return {}
+
+
+def _device_dead() -> bool:
+    try:
+        from spark_rapids_jni_tpu import faultinj
+        return bool(faultinj.state().device_dead)
+    except Exception:
+        return False
+
+
+class Span:
+    """An active span.  ``set(**attrs)`` attaches attributes (``rows``,
+    ``bytes``, …); ``fence(value)`` blocks until ``value``'s arrays are
+    device-complete and stamps the device time."""
+
+    __slots__ = ("name", "attrs", "depth", "parent", "t0", "_fence_t",
+                 "compiles", "compile_s", "_mem0")
+
+    def __init__(self, name: str, attrs: Dict, depth: int,
+                 parent: Optional[str]):
+        self.name = name
+        self.attrs = dict(attrs)
+        self.depth = depth
+        self.parent = parent
+        self.t0 = 0.0
+        self._fence_t = None
+        self.compiles = 0
+        self.compile_s = 0.0
+        self._mem0 = None
+
+    def set(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+    def fence(self, value):
+        """Block until every array in ``value`` is device-complete and
+        record the span's device-completion time; returns ``value``."""
+        # looked up via the module attribute so tests (and users) can
+        # interpose jax.block_until_ready and see exactly our fences
+        jax.block_until_ready(value)
+        self._fence_t = time.perf_counter()
+        return value
+
+
+class _NullSpan:
+    """The disabled stand-in: every method is a no-op (``fence`` does NOT
+    block — disabled instrumentation must insert no device fences)."""
+
+    __slots__ = ()
+
+    def set(self, **attrs) -> None:
+        pass
+
+    def fence(self, value):
+        return value
+
+
+_NULL_SPAN = _NullSpan()
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """Context manager timing a block as one span event.
+
+    Yields the active :class:`Span` (or a no-op stand-in when not
+    recording).  On exception the event records ``status="error"`` with
+    the exception type/message and device health, then re-raises."""
+    if not recording():
+        yield _NULL_SPAN
+        return
+    stack = _stack()
+    sp = Span(name, attrs, depth=len(stack),
+              parent=stack[-1].name if stack else None)
+    sp._mem0 = _mem_snapshot()
+    stack.append(sp)
+    sp.t0 = time.perf_counter()
+    try:
+        yield sp
+    except Exception as e:
+        _finish(sp, "error", err=e)
+        raise
+    else:
+        _finish(sp, "ok")
+    finally:
+        stack.pop()
+
+
+def _finish(sp: Span, status: str, err: Optional[BaseException] = None
+            ) -> None:
+    wall = time.perf_counter() - sp.t0
+    ev: Dict = {"kind": "span", "name": sp.name, "status": status,
+                "wall_s": wall, "depth": sp.depth,
+                "thread": threading.current_thread().name}
+    if sp.parent is not None:
+        ev["parent"] = sp.parent
+    if sp._fence_t is not None:
+        ev["device_s"] = sp._fence_t - sp.t0
+    if sp.compiles:
+        ev["compiles"] = sp.compiles
+        ev["compile_s"] = sp.compile_s
+    ev.update(sp.attrs)
+    mem1 = _mem_snapshot()
+    if mem1:
+        mem = {"bytes_in_use": mem1.get("bytes_in_use"),
+               "peak_bytes_in_use": mem1.get("peak_bytes_in_use")}
+        if sp._mem0:
+            mem["delta_bytes"] = (mem1.get("bytes_in_use", 0)
+                                  - sp._mem0.get("bytes_in_use", 0))
+        ev["mem"] = mem
+    if err is not None:
+        ev["error_type"] = type(err).__name__
+        ev["error"] = str(err)[:300]
+        ev["device_dead"] = _device_dead()
+    emit(ev)
+
+
+def span_fn(name: Optional[str] = None, attrs=None, fence: bool = True):
+    """Decorator form of :func:`span` for operator entry points.
+
+    ``attrs``: optional ``(*args, **kwargs) -> dict`` extracting event
+    attributes (``rows``, ``bytes``, …) from the call; extraction errors
+    are swallowed — attributes are best-effort, timing is not.
+    ``fence=False`` for host-only functions (no arrays to wait on).
+
+    When not recording (disabled, or inside a jit trace) the wrapper is a
+    single predicate check and a tail call — no fence, no lock."""
+
+    def deco(fn):
+        sname = name or fn.__name__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not recording():
+                return fn(*args, **kwargs)
+            a = {}
+            if attrs is not None:
+                try:
+                    a = attrs(*args, **kwargs) or {}
+                except Exception:
+                    a = {}
+            with span(sname, **a) as sp:
+                out = fn(*args, **kwargs)
+                if fence:
+                    sp.fence(out)
+                return out
+
+        return wrapper
+
+    return deco
+
+
+def current_span() -> Optional[Span]:
+    """The innermost active span on this thread, if any."""
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+# env-driven bring-up (the SRJ_METRICS / SRJ_TPU_TRACE pattern):
+# SRJ_TPU_EVENTS=<path> enables recording with a JSONL sink;
+# SRJ_TPU_OBS=1 enables the ring buffer alone.
+_env_sink = os.environ.get("SRJ_TPU_EVENTS")
+if _env_sink:
+    enable(_env_sink)
+elif os.environ.get("SRJ_TPU_OBS", "0") == "1":
+    enable()
+del _env_sink

@@ -36,6 +36,9 @@ from spark_rapids_jni_tpu.table import (
     Column, DType, pack_bools,
 )
 from spark_rapids_jni_tpu.utils.tracing import func_range
+from spark_rapids_jni_tpu.obs import span_fn
+
+_col_rows = lambda col, *a, **k: {"rows": col.num_rows}  # noqa: E731
 
 # static window sizes: whitespace trim looks at the first/last TRIM_WIDTH
 # bytes, the numeric body at PARSE_WIDTH bytes after the leading trim.
@@ -249,6 +252,7 @@ def _host_parse_punted(raw: bytes, itemsize: int):
     return -mag if neg else mag
 
 
+@span_fn(attrs=_col_rows)
 @func_range()
 def cast_string_to_int(col: Column, dtype: DType, *, ansi: bool = False
                        ) -> Tuple[Column, jnp.ndarray]:
@@ -451,6 +455,7 @@ def _cast_string_to_float_jit(offsets, chars, width: int):
     return ch, tlen, valid, special_cls, has_suffix, punted
 
 
+@span_fn(attrs=_col_rows)
 @func_range()
 def cast_string_to_float(col: Column, dtype: DType, *,
                          ansi: bool = False) -> Tuple[Column, jnp.ndarray]:
@@ -786,6 +791,7 @@ def _cast_string_to_decimal_jit(offsets, chars, scale: int, width: int):
     ovf = ovf | _gt_limbs_const(result, _BOUND_LIMBS)
     return result, negative, valid, ovf, punted
 
+@span_fn(attrs=_col_rows)
 @func_range()
 def cast_string_to_decimal128(col: Column, scale: int, *,
                               ansi: bool = False
@@ -936,6 +942,7 @@ def _int_to_string_jit(data, mode: str):
     return digits, ndigits.astype(jnp.int32), negative
 
 
+@span_fn(attrs=_col_rows)
 @func_range()
 def cast_int_to_string(col: Column) -> Column:
     """CAST(<int> AS STRING): decimal formatting, '-' for negatives."""
@@ -1194,6 +1201,7 @@ def _parse_temporal_jit(offsets, chars, width: int, want_time: bool):
     return out
 
 
+@span_fn(attrs=_col_rows)
 @func_range()
 def cast_string_to_date(col: Column, *, ansi: bool = False
                         ) -> Tuple[Column, jnp.ndarray]:
@@ -1225,6 +1233,7 @@ def cast_string_to_date(col: Column, *, ansi: bool = False
                    pack_bools(in_valid & ok)), error)
 
 
+@span_fn(attrs=_col_rows)
 @func_range()
 def cast_string_to_timestamp(col: Column, *, ansi: bool = False
                              ) -> Tuple[Column, jnp.ndarray]:
@@ -1465,6 +1474,7 @@ def _date_to_string_jit(days):
     return out, (y >= 1) & (y <= 9999)
 
 
+@span_fn(attrs=_col_rows)
 @func_range()
 def cast_date_to_string(col: Column) -> Column:
     """CAST(date AS STRING): 'yyyy-MM-dd' (years outside 1..9999 render
@@ -1483,6 +1493,7 @@ def cast_date_to_string(col: Column) -> Column:
                   offsets, None, jnp.where(valid[:, None], mat, 0))
 
 
+@span_fn(attrs=_col_rows)
 @func_range()
 def cast_timestamp_to_string(col: Column) -> Column:
     """CAST(timestamp AS STRING), UTC: 'yyyy-MM-dd HH:mm:ss[.ffffff]'

@@ -37,6 +37,7 @@ import numpy as np
 
 from spark_rapids_jni_tpu.table import Column, STRING, pack_bools
 from spark_rapids_jni_tpu.utils.tracing import func_range
+from spark_rapids_jni_tpu.obs import span_fn
 
 
 WILDCARD = object()   # the [*] path segment
@@ -380,6 +381,8 @@ def _scan_automaton(ch: jnp.ndarray, segs: Tuple,
     return final
 
 
+@span_fn(attrs=lambda col, path, *a, **k: {"rows": col.num_rows,
+                                           "path": path})
 @func_range()
 def get_json_object(col: Column, path: str,
                     max_str_len: Optional[int] = None) -> Column:

@@ -36,6 +36,13 @@ import numpy as np
 from spark_rapids_jni_tpu.table import (
     Column, Table, bytes2d_to_words as _bytes_to_u32_lanes,
 )
+from spark_rapids_jni_tpu.obs import span_fn
+
+
+def _hash_attrs(table_or_cols, *args, **kwargs):
+    cols = (table_or_cols.columns if isinstance(table_or_cols, Table)
+            else tuple(table_or_cols))
+    return {"rows": cols[0].num_rows} if cols else {}
 
 # np (not jnp) scalars: module import must never create a device array —
 # an eager jnp constant here dispatches to the default backend at import
@@ -276,6 +283,7 @@ def _patch_capped_rows(col: Column, hc, h_entry, kernel_fn, scatter_fn):
     return scatter_fn(hc, rows, vals)
 
 
+@span_fn(attrs=_hash_attrs)
 def murmur3_hash(table_or_cols, seed: int = DEFAULT_SEED,
                  max_str_len: Optional[int] = None) -> jnp.ndarray:
     """Spark ``Murmur3Hash(cols)``: returns int32 [n].
@@ -516,6 +524,7 @@ def _xx64_string_col(col: Column, h, W: int):
     return _xx_fmix(hash_)
 
 
+@span_fn(attrs=_hash_attrs)
 def xxhash64(table_or_cols, seed: int = DEFAULT_SEED,
              max_str_len: Optional[int] = None) -> jnp.ndarray:
     """Spark ``XxHash64(cols)``: returns the hash as uint32 (hi, lo) pair

@@ -47,6 +47,7 @@ from spark_rapids_jni_tpu.ops.row_layout import (
 )
 from spark_rapids_jni_tpu.utils.tracing import func_range
 from spark_rapids_jni_tpu.utils import metrics
+from spark_rapids_jni_tpu.obs import span_fn
 
 
 # ---------------------------------------------------------------------------
@@ -367,6 +368,7 @@ def _batch_rows2d(rows2d: jnp.ndarray, layout: RowLayout,
     return out
 
 
+@span_fn(attrs=lambda table, **k: {"rows": table.num_rows})
 @func_range()
 def convert_to_rows_fixed_width_optimized(
         table: Table, *, size_limit: int = MAX_BATCH_BYTES) -> List[RowsColumn]:
@@ -379,6 +381,8 @@ def convert_to_rows_fixed_width_optimized(
     return _batch_rows2d(rows2d, layout, size_limit)
 
 
+@span_fn(attrs=lambda rows, dtypes: {"rows": rows.num_rows,
+                                     "bytes": int(rows.data.size)})
 @func_range()
 def convert_from_rows_fixed_width_optimized(
         rows: RowsColumn, dtypes: Sequence[DType]) -> Table:
@@ -410,6 +414,7 @@ def _resolve_impl(impl: Optional[str], use_pallas: Optional[bool],
     return "mxu" if platform == "tpu" else "xla"
 
 
+@span_fn(attrs=lambda table, **k: {"rows": table.num_rows})
 @func_range()
 def convert_to_rows(table: Table, *, size_limit: int = MAX_BATCH_BYTES,
                     use_pallas: Optional[bool] = None,
@@ -498,6 +503,8 @@ def convert_to_rows(table: Table, *, size_limit: int = MAX_BATCH_BYTES,
     return out
 
 
+@span_fn(attrs=lambda rows, dtypes, **k: {"rows": rows.num_rows,
+                                          "bytes": int(rows.data.size)})
 @func_range()
 def convert_from_rows(rows: RowsColumn, dtypes: Sequence[DType],
                       *, use_pallas: Optional[bool] = None,
@@ -533,6 +540,7 @@ def convert_from_rows(rows: RowsColumn, dtypes: Sequence[DType],
     return Table(tuple(cols))
 
 
+@span_fn(attrs=lambda gc, **k: {"rows": gc.num_rows})
 @func_range()
 def convert_to_rows_grouped(gc, *, size_limit: int = MAX_BATCH_BYTES
                             ) -> List[RowsColumn]:
@@ -569,6 +577,8 @@ def convert_to_rows_grouped(gc, *, size_limit: int = MAX_BATCH_BYTES
     return out
 
 
+@span_fn(attrs=lambda rows, dtypes: {"rows": rows.num_rows,
+                                     "bytes": int(rows.data.size)})
 @func_range()
 def convert_from_rows_grouped(rows: RowsColumn, dtypes: Sequence[DType]):
     """Decode one batch of fixed-width JCUDF rows to the dtype-major

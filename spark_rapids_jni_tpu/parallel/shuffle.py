@@ -31,6 +31,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from spark_rapids_jni_tpu.table import Column, Table
+from spark_rapids_jni_tpu.obs import span_fn
 from spark_rapids_jni_tpu.ops.row_layout import compute_row_layout
 from spark_rapids_jni_tpu.ops import row_conversion as rc
 from spark_rapids_jni_tpu.ops.hashing import hash_partition_ids
@@ -239,6 +240,7 @@ def _align_capacity(capacity: int, num_parts: int) -> int:
     return capacity
 
 
+@span_fn(attrs=lambda table, *a, **k: {"rows": table.num_rows})
 def shuffle_table_sharded(table: Table, key_cols: Sequence[int],
                           mesh: Mesh, axis_name: str = "data",
                           capacity_factor: Optional[float] = None,

@@ -27,6 +27,7 @@ import struct as _struct
 from typing import List, Optional, Sequence, Tuple
 
 from spark_rapids_jni_tpu.parquet import native as _native
+from spark_rapids_jni_tpu.obs import span_fn
 from spark_rapids_jni_tpu.utils.tracing import func_range
 from spark_rapids_jni_tpu.parquet.pyfooter import (
     PyFooter, TAG_LIST, TAG_MAP, TAG_STRUCT, TAG_VALUE,
@@ -228,6 +229,7 @@ def _strip_framing(buffer: bytes) -> bytes:
     return buffer
 
 
+@span_fn(attrs=lambda buffer, *a, **k: {"bytes": len(buffer)}, fence=False)
 @func_range()
 def read_and_filter(buffer: bytes, part_offset: int, part_length: int,
                     schema: StructElement, ignore_case: bool = False,

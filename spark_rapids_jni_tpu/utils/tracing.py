@@ -7,8 +7,11 @@ exposes a Java-side toggle (``pom.xml:86,488-491``).  The TPU equivalents
 in ``jax.profiler`` traces, plus a trace context manager writing a
 TensorBoard-loadable profile.
 
-Toggle: set ``SRJ_TPU_TRACE=0`` to make :func:`func_range` a no-op (the
-``ai.rapids.cudf.nvtx.enabled`` analogue).
+Toggle: ``SRJ_TPU_TRACE=0`` (the ``ai.rapids.cudf.nvtx.enabled`` analogue)
+or :func:`disable` / :func:`enable` — the decision is read per call, so a
+process can turn scoping on around one suspect region and back off, same
+as :mod:`~spark_rapids_jni_tpu.utils.metrics`.  Structured timing/failure
+telemetry lives one layer up in :mod:`spark_rapids_jni_tpu.obs`.
 """
 
 from __future__ import annotations
@@ -19,21 +22,37 @@ import os
 
 import jax
 
-_ENABLED = os.environ.get("SRJ_TPU_TRACE", "1") != "0"
+_enabled = os.environ.get("SRJ_TPU_TRACE", "1") != "0"
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
 
 
 def func_range(name: str | None = None):
     """Decorator: wrap a function body in a named scope (the
     ``CUDF_FUNC_RANGE`` analogue).  Scope names appear in HLO op metadata
-    and profiler timelines."""
+    and profiler timelines.  The enable check happens per call — decorated
+    functions honor :func:`enable`/:func:`disable` at runtime instead of
+    baking in the import-time setting."""
 
     def deco(fn):
-        if not _ENABLED:
-            return fn
         scope = name or f"srj::{fn.__name__}"
 
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
+            if not _enabled:
+                return fn(*args, **kwargs)
             with jax.named_scope(scope):
                 return fn(*args, **kwargs)
 

@@ -1,0 +1,313 @@
+"""Observability-layer tests: span semantics (nesting, threading, failure
+capture), the JSONL sink -> report CLI round trip, compile telemetry,
+faultinj event integration, and the free-when-off fence guard the layer's
+acceptance contract names (disabled instrumentation must not change device
+synchronization)."""
+
+import json
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import Column, INT32, Table, faultinj, obs
+from spark_rapids_jni_tpu.obs import report
+from spark_rapids_jni_tpu.ops import convert_from_rows, convert_to_rows
+from spark_rapids_jni_tpu.ops.hashing import murmur3_hash
+from spark_rapids_jni_tpu.utils import metrics
+
+
+@pytest.fixture
+def obs_on():
+    """Enabled obs with a clean ring and no sink; everything off after."""
+    obs.configure_sink(None)
+    obs.clear()
+    obs.enable()
+    yield
+    obs.disable()
+    obs.configure_sink(None)
+    obs.clear()
+
+
+def _int_table(n=16):
+    return Table((Column(INT32, jnp.arange(n, dtype=jnp.int32)),))
+
+
+# ---------------------------------------------------------------------------
+# Span semantics
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_depth_and_parent(obs_on):
+    with obs.span("outer"):
+        with obs.span("inner") as sp:
+            sp.set(rows=7)
+    evs = obs.events(kind="span")
+    by_name = {e["name"]: e for e in evs}
+    assert by_name["inner"]["depth"] == 1
+    assert by_name["inner"]["parent"] == "outer"
+    assert by_name["inner"]["rows"] == 7
+    assert by_name["outer"]["depth"] == 0
+    assert "parent" not in by_name["outer"]
+    # inner finishes (and is emitted) before outer
+    assert evs.index(by_name["inner"]) < evs.index(by_name["outer"])
+
+
+def test_span_records_wall_and_fenced_device_time(obs_on):
+    t = _int_table()
+    out = convert_to_rows(t)
+    jax.block_until_ready([b.data for b in out])
+    evs = obs.events(kind="span")
+    ev = next(e for e in evs if e["name"] == "convert_to_rows")
+    assert ev["status"] == "ok"
+    assert ev["wall_s"] > 0
+    assert 0 < ev["device_s"] <= ev["wall_s"] * 1.001
+    assert ev["rows"] == t.num_rows
+
+
+def test_span_failure_capture(obs_on):
+    with pytest.raises(ValueError, match="boom"):
+        with obs.span("doomed"):
+            raise ValueError("boom")
+    ev = next(e for e in obs.events(kind="span") if e["name"] == "doomed")
+    assert ev["status"] == "error"
+    assert ev["error_type"] == "ValueError"
+    assert "boom" in ev["error"]
+    assert ev["device_dead"] is False
+
+
+def test_spans_thread_safe(obs_on):
+    def work(i):
+        for j in range(50):
+            with obs.span(f"t{i}"):
+                with obs.span(f"t{i}.child"):
+                    pass
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    evs = obs.events(kind="span")
+    assert len(evs) == 8 * 50 * 2
+    for i in range(8):
+        children = [e for e in evs if e["name"] == f"t{i}.child"]
+        assert len(children) == 50
+        # the thread-local stack keeps parentage per thread, not global
+        assert all(e["parent"] == f"t{i}" and e["depth"] == 1
+                   for e in children)
+
+
+def test_span_inside_jit_trace_not_recorded(obs_on):
+    @jax.jit
+    def f(x):
+        with obs.span("traced"):
+            return x + 1
+
+    f(jnp.int32(1))
+    f(jnp.int32(2))  # cached call: span body doesn't even run
+    assert not [e for e in obs.events(kind="span")
+                if e["name"] == "traced"]
+
+
+# ---------------------------------------------------------------------------
+# Compile telemetry
+# ---------------------------------------------------------------------------
+
+def test_compile_telemetry_attributed_to_span(obs_on):
+    before = obs.compile_totals()["compiles"]
+    with obs.span("compiling"):
+        # a fresh lambda gets a fresh jit cache entry, and conftest's
+        # persistent-cache threshold (2s) keeps tiny compiles uncached —
+        # so the backend compile really runs, inside the span
+        jax.block_until_ready(jax.jit(lambda x: x * 3 + 1)(jnp.arange(8)))
+    ev = next(e for e in obs.events(kind="span")
+              if e["name"] == "compiling")
+    assert ev["compiles"] >= 1
+    assert ev["compile_s"] > 0
+    assert obs.compile_totals()["compiles"] > before
+    comp = [e for e in obs.events(kind="compile")
+            if e.get("span") == "compiling"]
+    assert len(comp) >= 1
+
+
+# ---------------------------------------------------------------------------
+# JSONL sink -> report CLI round trip
+# ---------------------------------------------------------------------------
+
+def test_jsonl_roundtrip_through_report_cli(obs_on, tmp_path, capsys):
+    path = str(tmp_path / "events.jsonl")
+    obs.configure_sink(path)
+    t = _int_table()
+    convert_from_rows(convert_to_rows(t)[0], [INT32])
+    murmur3_hash(t)
+    with pytest.raises(RuntimeError):
+        with obs.span("exploding_leg"):
+            raise RuntimeError("relay window")
+    obs.flush()
+
+    evs = list(report.load_events(path))
+    assert evs and all(isinstance(e, dict) for e in evs)
+    summ = report.summarize(evs)
+    assert summ["ops"]["convert_from_rows"]["calls"] == 1
+    assert summ["ops"]["convert_from_rows"]["rows"] == t.num_rows
+    assert summ["ops"]["exploding_leg"]["failures"] == 1
+    assert summ["ops"]["exploding_leg"]["error_types"] == {
+        "RuntimeError": 1}
+
+    assert report.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "convert_from_rows" in out and "exploding_leg" in out
+    assert "RuntimeError" in out
+
+    assert report.main([path, "--prom"]) == 0
+    prom = capsys.readouterr().out
+    assert 'srj_tpu_span_calls_total{op="murmur3_hash"} 1' in prom
+    assert 'srj_tpu_span_failures_total{op="exploding_leg"} 1' in prom
+
+    assert report.main([path, "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["ops"]
+
+
+def test_report_cli_corrupt_lines_and_missing_file(tmp_path, capsys):
+    p = tmp_path / "partial.jsonl"
+    p.write_text('{"kind": "span", "name": "op", "status": "ok", '
+                 '"wall_s": 0.5}\nnot json at all\n\n')
+    assert report.main([str(p)]) == 0
+    assert "op" in capsys.readouterr().out
+    assert report.main([str(tmp_path / "absent.jsonl")]) == 2
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert report.main([str(empty)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# faultinj -> event integration
+# ---------------------------------------------------------------------------
+
+def test_faultinj_trap_produces_fault_and_span_events(obs_on):
+    faultinj.install(config={})
+    try:
+        x = jax.block_until_ready(jnp.arange(8))
+        faultinj.state().apply_config({"pjrtExecuteFaults": {
+            "*": {"percent": 100, "injectionType": 0,
+                  "interceptionCount": 1}}})
+        with pytest.raises(faultinj.FatalDeviceError):
+            with obs.span("dying_op"):
+                jax.block_until_ready(jax.jit(lambda a: a * 2)(x))
+        fault = [e for e in obs.events(kind="fault")
+                 if not e.get("rejected")]
+        assert fault and fault[-1]["domain"] == "pjrtExecuteFaults"
+        assert fault[-1]["injection_type"] == 0
+        sp = next(e for e in obs.events(kind="span")
+                  if e["name"] == "dying_op")
+        assert sp["status"] == "error"
+        assert sp["error_type"] == "FatalDeviceError"
+        assert sp["device_dead"] is True
+        # the dead device rejects the NEXT call too, as a rejected event
+        with pytest.raises(faultinj.FatalDeviceError):
+            faultinj.state().maybe_inject("pjrtExecuteFaults", "next")
+        assert any(e.get("rejected") for e in obs.events(kind="fault"))
+    finally:
+        faultinj.reset_device()
+        faultinj.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# The free-when-off contract
+# ---------------------------------------------------------------------------
+
+def test_disabled_spans_insert_no_fences(monkeypatch):
+    """With obs off (and metrics off), instrumented operators must add
+    ZERO ``jax.block_until_ready`` fences — disabled observability cannot
+    change dispatch/synchronization behavior (acceptance criterion)."""
+    obs.disable()
+    metrics.disable()
+    t = _int_table()
+    # warm everything first so the instrumented calls below do no lazy
+    # init that might legitimately fence
+    convert_from_rows(convert_to_rows(t)[0], [INT32])
+    murmur3_hash(t)
+
+    calls = []
+    real = jax.block_until_ready
+    monkeypatch.setattr(jax, "block_until_ready",
+                        lambda v: (calls.append(1), real(v))[1])
+    convert_from_rows(convert_to_rows(t)[0], [INT32])
+    murmur3_hash(t)
+    assert calls == []
+
+    # and the SAME call sites do fence once recording is on
+    obs.enable()
+    try:
+        convert_to_rows(t)
+        assert len(calls) >= 1
+    finally:
+        obs.disable()
+        obs.clear()
+
+
+# ---------------------------------------------------------------------------
+# metrics hardening (satellite: version-robust probe, fail-closed)
+# ---------------------------------------------------------------------------
+
+def test_metrics_probe_failure_fails_toward_not_recording(monkeypatch):
+    def broken_probe():
+        raise RuntimeError("probe exploded")
+
+    monkeypatch.setattr(metrics, "_trace_probe", broken_probe)
+    metrics.reset()
+    metrics.enable()
+    try:
+        assert metrics.eager() is False
+        metrics.count("should_not_record")
+        assert metrics.snapshot() == {}
+    finally:
+        metrics.disable()
+        metrics.reset()
+
+
+def test_metrics_probe_missing_fails_toward_not_recording(monkeypatch):
+    monkeypatch.setattr(metrics, "_trace_probe", False)
+    metrics.reset()
+    metrics.enable()
+    try:
+        assert metrics.eager() is False
+        metrics.op("ghost", rows=10)
+        assert metrics.snapshot() == {}
+    finally:
+        metrics.disable()
+        metrics.reset()
+
+
+def test_metrics_enable_disable_race():
+    """Counter writers racing an enable/disable toggler must neither
+    raise nor corrupt the registry (the lock covers the counters; the
+    flag is a benign boolean read)."""
+    metrics.reset()
+    stop = threading.Event()
+
+    def toggler():
+        while not stop.is_set():
+            metrics.enable()
+            metrics.disable()
+
+    def writer():
+        for _ in range(2000):
+            metrics.count("raced")
+
+    tg = threading.Thread(target=toggler)
+    ws = [threading.Thread(target=writer) for _ in range(4)]
+    tg.start()
+    for w in ws:
+        w.start()
+    for w in ws:
+        w.join()
+    stop.set()
+    tg.join()
+    snap = metrics.snapshot()
+    assert set(snap) <= {"raced"}
+    assert snap.get("raced", 0) <= 4 * 2000
+    metrics.disable()
+    metrics.reset()

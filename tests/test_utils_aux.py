@@ -6,6 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_jni_tpu.utils import build_info, func_range
+from spark_rapids_jni_tpu.utils import tracing
 from spark_rapids_jni_tpu.utils.tracing import annotate
 
 
@@ -17,7 +18,50 @@ def test_func_range_preserves_behavior():
     assert int(f(jnp.int32(1))) == 2
     # and inside jit: the scope must appear in the lowered HLO metadata
     lowered = jax.jit(f).lower(jnp.int32(1))
-    assert "test_scope" in lowered.as_text(debug_info=True)
+    try:
+        txt = lowered.as_text(debug_info=True)
+    except TypeError:
+        # jax 0.4.x: as_text has no debug_info kwarg, and plain as_text
+        # drops location metadata — ask the MLIR module for it directly
+        txt = lowered.compiler_ir(dialect="stablehlo") \
+            .operation.get_asm(enable_debug_info=True)
+    assert "test_scope" in txt
+
+
+def test_func_range_toggle_is_dynamic(monkeypatch):
+    """The enable check happens per CALL, not at decoration/import time:
+    a function decorated while tracing is on must stop opening scopes
+    after disable() and start again after enable()."""
+    opened = []
+
+    class _FakeScope:
+        def __init__(self, name):
+            opened.append(name)
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    monkeypatch.setattr(jax, "named_scope", _FakeScope)
+
+    @func_range("srj::dynamic_scope")
+    def f(x):
+        return x + 1
+
+    assert tracing.enabled()
+    try:
+        assert f(1) == 2
+        assert opened == ["srj::dynamic_scope"]
+        tracing.disable()
+        assert f(2) == 3
+        assert opened == ["srj::dynamic_scope"]  # no new scope while off
+        tracing.enable()
+        assert f(3) == 4
+        assert opened == ["srj::dynamic_scope"] * 2
+    finally:
+        tracing.enable()
 
 
 def test_annotate_context():
