@@ -499,6 +499,72 @@ def bench_json_wildcard(num_rows):
             "mid_scanned_GBps": mbytes / tm / 1e9}
 
 
+def bench_ragged(num_batches):
+    """Ragged-batch stream: the same mixed non-pow-2 batch sizes stream
+    through to_rows / murmur3 / cast_string_to_int twice — exact-shape
+    (``bucket=None``) versus the shape-bucket policy
+    (``runtime/shapes.py``) — and the record is the compile count and
+    compile-seconds delta: N distinct sizes cost N programs per op
+    unbucketed but only O(log N) bucketed.  Wall time includes compile
+    (this axis measures the shape-churn pathology itself, not
+    steady-state throughput)."""
+    from spark_rapids_jni_tpu import Column, INT32, Table, obs
+    from spark_rapids_jni_tpu.ops import (
+        cast_string_to_int, convert_to_rows, murmur3_hash)
+    from spark_rapids_jni_tpu.runtime import shapes
+
+    rng = np.random.default_rng(11)
+    sizes = []
+    while len(sizes) < num_batches:
+        n = int(rng.integers(60, 5000))
+        if n != shapes.bucket_rows(n):   # keep sizes off the bucket grid
+            sizes.append(n)
+    batches = []
+    for n in sizes:
+        ints = Column.from_numpy(
+            rng.integers(-99, 99, n).astype(np.int32), INT32,
+            valid=rng.random(n) > 0.1)
+        strs = Column.strings_padded(
+            ["%05d" % v for v in rng.integers(0, 99999, n)])
+        jax.block_until_ready((ints.data, strs.chars2d))
+        batches.append((Table((ints,)), strs))
+    buckets = sorted({shapes.bucket_rows(n) for n in sizes})
+    _log(f"ragged: {num_batches} batches, sizes "
+         f"{min(sizes)}..{max(sizes)} -> {len(buckets)} buckets")
+
+    def _stream(bucket, label):
+        c0 = obs.compile_totals()
+        t0 = time.perf_counter()
+        with obs.span(f"leg.ragged_{label}"):
+            for t, s in batches:
+                rows = convert_to_rows(t, bucket=bucket)
+                _sync(rows[0].data)
+                h = murmur3_hash([t.columns[0], s], bucket=bucket)
+                _sync(h)
+                c, _ = cast_string_to_int(s, INT32, bucket=bucket)
+                _sync(c.data)
+        wall = time.perf_counter() - t0
+        c1 = obs.compile_totals()
+        rec = {"wall_s": round(wall, 4),
+               "compiles": int(c1["compiles"] - c0["compiles"]),
+               "compile_s": round(c1["compile_s"] - c0["compile_s"], 4)}
+        _log(f"ragged {label}: {rec['compiles']} compiles "
+             f"({rec['compile_s']:.2f}s) in {rec['wall_s']:.2f}s wall")
+        return rec
+
+    # exact-shape first: the two passes share no program shapes (sizes
+    # avoid the bucket grid), so order does not cross-seed the jit cache
+    unbucketed = _stream(None, "unbucketed")
+    bucketed = _stream("auto", "bucketed")
+    res = {"num_batches": num_batches, "sizes_min": min(sizes),
+           "sizes_max": max(sizes), "buckets": buckets,
+           "unbucketed": unbucketed, "bucketed": bucketed}
+    if bucketed["compile_s"] > 0:
+        res["compile_s_ratio"] = round(
+            unbucketed["compile_s"] / bucketed["compile_s"], 2)
+    return res
+
+
 def _obs_axis_summary():
     """Compact per-op obs digest of this axis process — every leg span
     (including failed ones, which carry ``error_types``) plus the XLA
@@ -529,6 +595,8 @@ def _run_axis(axis: str):
         kind, n = axis.split(":")
         if kind == "json":
             res = bench_json_wildcard(int(n))
+        elif kind == "ragged":
+            res = bench_ragged(int(n))
         elif kind == "fixed":
             res = bench_fixed(int(n))
         elif kind == "nostrings":
@@ -813,6 +881,9 @@ def main():
         _run("no_strings_155col", "nostrings:1000000")
         # device trailing-[*] JSON path extraction at 1M rows
         _run("json_wildcard", "json:1000000")
+        # shape-churn axis: N ragged batch sizes, compile cost with and
+        # without the bucket policy
+        _run("ragged_stream", "ragged:28")
 
     for key, idx, axis in requeue:
         _log(f"requeue {axis}: re-running failed axis at end of sweep")

@@ -27,3 +27,31 @@ PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   python -m spark_rapids_jni_tpu.obs "$OBS_EVENTS" > "$OBS_REPORT"
 grep -q convert_to_rows "$OBS_REPORT"
 rm -f "$OBS_EVENTS" "$OBS_REPORT"
+
+# shape-bucket smoke: stream mixed batch sizes through a bucket-wired op
+# under the JSONL sink, then fail if the programs compiled under the
+# op's span exceed the bucket bound — the cheap end-to-end version of
+# tests/test_shapes.py's guard, against the real event sink
+SHAPE_EVENTS=$(mktemp /tmp/srj_shape_smoke.XXXXXX.jsonl)
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu SRJ_TPU_EVENTS="$SHAPE_EVENTS" \
+  python -c "
+import numpy as np
+from spark_rapids_jni_tpu import Column, INT32
+from spark_rapids_jni_tpu.ops import murmur3_hash
+for n in (5, 11, 19, 27, 42, 53, 61):
+    murmur3_hash([Column.from_numpy(np.arange(n, dtype=np.int32), INT32)])
+"
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python - "$SHAPE_EVENTS" <<'PY'
+import json, sys
+from spark_rapids_jni_tpu.runtime import shapes
+sizes = (5, 11, 19, 27, 42, 53, 61)
+bound = len({shapes.bucket_rows(n) for n in sizes})
+compiles = sum(1 for line in open(sys.argv[1])
+               for e in [json.loads(line)]
+               if e.get("kind") == "compile" and e.get("span") == "murmur3_hash")
+print(f"shape smoke: {compiles} op-span compiles for {len(sizes)} sizes "
+      f"(bucket bound {bound})")
+sys.exit(0 if 0 < compiles <= bound else 1)
+PY
+rm -f "$SHAPE_EVENTS"

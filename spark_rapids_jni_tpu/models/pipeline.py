@@ -33,6 +33,7 @@ import numpy as np
 from spark_rapids_jni_tpu.table import Column, Table
 from spark_rapids_jni_tpu.obs import span_fn
 from spark_rapids_jni_tpu.ops.hashing import murmur3_hash, pmod
+from spark_rapids_jni_tpu.runtime import shapes
 
 
 # ---------------------------------------------------------------------------
@@ -678,7 +679,8 @@ def _source_num_rows(source) -> int:
 @span_fn(attrs=lambda source, *a, **k: {"rows": source.num_rows})
 def hash_aggregate_table(source, key_idxs: Sequence[int],
                          measures: Sequence, max_groups: int,
-                         mask: Optional[jnp.ndarray] = None):
+                         mask: Optional[jnp.ndarray] = None,
+                         bucket="auto"):
     """Group-by over a Table or GroupedColumns with Spark null
     semantics.
 
@@ -704,6 +706,33 @@ def hash_aggregate_table(source, key_idxs: Sequence[int],
     """
     from spark_rapids_jni_tpu.table import pack_bools, INT32
     n = _source_num_rows(source)
+    # shape-bucket the source rows (runtime/shapes.py): results are
+    # [max_groups]-shaped already, so only the input pads — the padded
+    # tail is masked dead (a padded row has invalid keys, which would
+    # otherwise join the legitimate null-key group)
+    f = shapes.resolve(bucket)
+    if (f is not None and isinstance(source, Table) and n > 0
+            and shapes.bucketable(source)
+            and not any(getattr(c, "capped", False)
+                        for c in source.columns)):
+        b = shapes.bucket_rows(n, f)
+        shapes.note(n, b)
+        with shapes.pad_span():
+            source = shapes.pad_table(source, b)
+            mask = shapes.pad_mask(mask, n, b)
+        # the whole (eager, jit-compatible) body runs as ONE program per
+        # bucket — without this, each eager primitive would count one
+        # compile per bucket and the O(buckets) program guarantee would
+        # hold only up to a constant.  The dispatch-relevant module
+        # state rides along as a static cache key: the traced program
+        # bakes in _ADAPTIVE_AGG_ON and the adaptive callee, so flipping
+        # or patching either (tests do both) must force a retrace, not
+        # replay a stale trace
+        return _hash_aggregate_jit(source, mask, tuple(key_idxs),
+                                   tuple((i, op) for i, op in measures),
+                                   max_groups,
+                                   (_ADAPTIVE_AGG_ON,
+                                    _hash_aggregate_adaptive))
     live = jnp.ones((n,), jnp.bool_) if mask is None else mask
 
     key_cols = [_source_column(source, i) for i in key_idxs]
@@ -903,8 +932,16 @@ def hash_aggregate_table(source, key_idxs: Sequence[int],
                 [jax.lax.bitcast_convert_type(cnt, jnp.uint32)[:, None],
                  jnp.zeros((g, 3), jnp.uint32)], axis=1)
             cnt_col = Column(decimal128(0), cnt_limbs, pack_bools(have))
-            q, _ovf = div_decimal128(sum_col, cnt_col,
-                                     result_scale=min(s + 4, 38))
+            # overflow handling is DELIBERATELY non-ANSI: div_decimal128
+            # already folds ``~overflow`` into the quotient's validity
+            # (ops/decimal.py), so a group whose rescaled sum cannot fit
+            # 38 digits comes back as NULL — Spark's
+            # spark.sql.ansi.enabled=false AVG behavior.  The returned
+            # mask is the hook for a future ANSI mode (raise instead of
+            # null); until then it is intentionally unused, not dropped
+            # by accident.
+            q, _overflow_is_null = div_decimal128(
+                sum_col, cnt_col, result_scale=min(s + 4, 38))
             out_cols.append(q)
             continue
         oi += 1
@@ -921,6 +958,19 @@ def hash_aggregate_table(source, key_idxs: Sequence[int],
                 else jnp.stack(out, axis=1)
         out_cols.append(Column(dt, out, pack_bools(valid)))
     return Table(tuple(out_cols)), have, num_groups
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
+def _hash_aggregate_jit(source, mask, key_idxs, measures, max_groups,
+                        _dispatch_state):
+    # bucket=None: bucketing already happened (this jit exists only for
+    # the bucketed path), and resolve() would refuse inside a trace
+    # anyway.  _dispatch_state is unused in the body: it is the static
+    # cache key carrying (_ADAPTIVE_AGG_ON, _hash_aggregate_adaptive) so
+    # monkeypatched dispatch state retraces instead of replaying a trace
+    # that baked in the old values
+    return hash_aggregate_table(source, key_idxs, measures, max_groups,
+                                mask=mask, bucket=None)
 
 
 # widest key domain the direct aggregates will allocate slots for.
@@ -1642,11 +1692,40 @@ def _join_keys_pair(build, build_key: int, probe, probe_key: int):
     return bk, bc.valid_bools(), pk, pc.valid_bools()
 
 
+def _join_tables_bucketable(build, probe) -> bool:
+    return (isinstance(build, Table) and isinstance(probe, Table)
+            and shapes.bucketable(build) and shapes.bucketable(probe)
+            and not any(getattr(c, "capped", False)
+                        for c in build.columns + probe.columns))
+
+
 @span_fn(attrs=lambda build, bk, probe, *a, **k: {"rows": probe.num_rows})
 def join_semi_mask_table(build, build_key: int, probe,
-                         probe_key: int) -> jnp.ndarray:
+                         probe_key: int, bucket="auto") -> jnp.ndarray:
     """Left-semi existence mask with Spark null semantics: null probe
-    keys never match; null build keys match nothing."""
+    keys never match; null build keys match nothing.
+
+    ``bucket``: shape-bucket both sides (padded build rows park at the
+    null sentinel, padded probe rows are invalid so their mask bit is
+    False) and run one jitted program per bucket pair; the mask slices
+    back to the probe's true row count."""
+    f = shapes.resolve(bucket)
+    if (f is not None and _join_tables_bucketable(build, probe)
+            and build.num_rows > 0 and probe.num_rows > 0):
+        n = probe.num_rows
+        bb = shapes.bucket_rows(build.num_rows, f)
+        pb = shapes.bucket_rows(n, f)
+        shapes.note(n, pb)
+        with shapes.pad_span():
+            build = shapes.pad_table(build, bb)
+            probe = shapes.pad_table(probe, pb)
+        mask = _join_semi_mask_jit(build, build_key, probe, probe_key)
+        with shapes.unpad_span():
+            return shapes.unpad_array(mask, n)
+    return _join_semi_mask_core(build, build_key, probe, probe_key)
+
+
+def _join_semi_mask_core(build, build_key, probe, probe_key):
     bk, bv, pk, pv = _join_keys_pair(build, build_key, probe, probe_key)
     # exclude null build rows: move them to a sentinel AND bound-check
     # probe matches against the count of real rows (a live probe equal
@@ -1660,15 +1739,47 @@ def join_semi_mask_table(build, build_key: int, probe,
     return pv & (jnp.minimum(hi, n_real) > lo)
 
 
+_join_semi_mask_jit = jax.jit(_join_semi_mask_core, static_argnums=(1, 3))
+
+
 @span_fn(attrs=lambda build, bk, bp, probe, *a, **k: {"rows": probe.num_rows})
 def join_inner_table(build, build_key: int, build_payload: int,
-                     probe, probe_key: int, capacity: int):
+                     probe, probe_key: int, capacity: int, bucket="auto"):
     """Inner join (duplicate build keys allowed) with null-key
     exclusion on both sides.  Returns (probe_idx, payload, payload_valid,
     slot_valid, total, overflow) — like :func:`sort_merge_join_dup` plus
     the gathered payload's own validity (a matched row whose payload is
     null stays in the join output with ``payload_valid`` False, exactly
-    Spark's inner-join-then-project semantics)."""
+    Spark's inner-join-then-project semantics).
+
+    ``bucket``: shape-bucket both sides; outputs are ``capacity``-shaped
+    already, so nothing slices back — padded rows are invalid on both
+    sides and emit no matches.  ``probe_idx`` is re-clamped to the true
+    probe row count so dead-slot indices stay gatherable against the
+    caller's unpadded probe columns."""
+    f = shapes.resolve(bucket)
+    if (f is not None and _join_tables_bucketable(build, probe)
+            and build.num_rows > 0 and probe.num_rows > 0):
+        n = probe.num_rows
+        bb = shapes.bucket_rows(build.num_rows, f)
+        pb = shapes.bucket_rows(n, f)
+        shapes.note(n, pb)
+        with shapes.pad_span():
+            build = shapes.pad_table(build, bb)
+            probe = shapes.pad_table(probe, pb)
+        out = _join_inner_jit(build, build_key, build_payload,
+                              probe, probe_key, capacity)
+        with shapes.unpad_span():
+            probe_idx, payload, payload_valid, slot_valid, total, ovf = out
+            probe_idx = jnp.minimum(probe_idx, n - 1)
+            return (probe_idx, payload, payload_valid, slot_valid,
+                    total, ovf)
+    return _join_inner_core(build, build_key, build_payload,
+                            probe, probe_key, capacity)
+
+
+def _join_inner_core(build, build_key, build_payload,
+                     probe, probe_key, capacity):
     bk, bv, pk, pv = _join_keys_pair(build, build_key, probe, probe_key)
     bpc = _source_column(build, build_payload)
     bp = bpc.data
@@ -1701,6 +1812,9 @@ def join_inner_table(build, build_key: int, build_payload: int,
     bidx = jnp.clip(lo[probe_idx] + within, 0, bks.shape[0] - 1)
     return (probe_idx, jnp.where(valid, bps[bidx], 0),
             valid & bpvs[bidx], valid, total, overflow)
+
+
+_join_inner_jit = jax.jit(_join_inner_core, static_argnums=(1, 2, 4, 5))
 
 
 def _exchange_with_validity(table: Table, key_idx: int, num_parts: int,

@@ -33,12 +33,47 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_jni_tpu.table import (
-    Column, DType, pack_bools,
+    Column, DType, pack_bools, unpack_bools,
 )
 from spark_rapids_jni_tpu.utils.tracing import func_range
 from spark_rapids_jni_tpu.obs import span_fn
+from spark_rapids_jni_tpu.runtime import shapes
 
 _col_rows = lambda col, *a, **k: {"rows": col.num_rows}  # noqa: E731
+
+
+def _shape_bucketed(fn):
+    """Run a cast entry at the shape-bucket size (``runtime/shapes.py``):
+    the input column pads to the row bucket (tail rows invalid, so they
+    parse to null and never punt to the host loop) and results slice
+    back — N distinct batch sizes share O(log N) compiled programs.
+
+    Sits INSIDE ``span_fn`` so the pad/slice glue nests under the op's
+    span (its compiles land in ``shapes.pad``/``shapes.unpad``) and the
+    ``bucket``/``padded_rows`` attributes stamp the op span itself."""
+
+    @functools.wraps(fn)
+    def wrapper(col, *args, bucket="auto", **kwargs):
+        f = shapes.resolve(bucket)
+        if f is None or not shapes.bucketable(col):
+            return fn(col, *args, **kwargs)
+        n = col.num_rows
+        b = shapes.bucket_rows(n, f)
+        shapes.note(n, b)
+        with shapes.pad_span():
+            if col.dtype.is_string and col.is_padded:
+                # the parse impls index the ragged Arrow layout, so a
+                # dense-padded input crosses that host boundary inside
+                # the impl anyway (see cast_string_to_int); convert
+                # BEFORE padding so the chars buffer gets bucketed too
+                # instead of staying content-sized under the jit
+                col = col.to_arrow()
+            padded = shapes.pad_column(col, b)
+        out = fn(padded, *args, **kwargs)
+        with shapes.unpad_span():
+            return shapes.unpad_result(out, n)
+
+    return wrapper
 
 # static window sizes: whitespace trim looks at the first/last TRIM_WIDTH
 # bytes, the numeric body at PARSE_WIDTH bytes after the leading trim.
@@ -219,6 +254,40 @@ def _cast_string_to_int_jit(offsets, chars, itemsize: int, width: int):
     return out_lo, out_hi, ok, punted
 
 
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _cast_int_fused_jit(offsets, chars, validity, itemsize: int,
+                        width: int):
+    """Grammar pass + result assembly as ONE compiled program.
+
+    The shape-bucket guard (tests/test_shapes.py) bounds compiled
+    programs per op span by the bucket count; assembling data/validity
+    eagerly would add a handful of tiny per-bucket programs on top, so
+    everything up to the (rare) host-punt patch fuses here.  ``validity``
+    may be None (static in the pytree: at most one extra program)."""
+    out_lo, out_hi, ok, punted = _cast_string_to_int_jit(
+        offsets, chars, itemsize, width)
+    n = out_lo.shape[0]
+    in_valid = jnp.ones((n,), jnp.bool_) if validity is None \
+        else unpack_bools(validity, n)
+    error = in_valid & ~ok
+    if itemsize == 8:
+        if jax.config.jax_enable_x64:
+            val64 = (out_lo.astype(jnp.uint64)
+                     | (out_hi.astype(jnp.uint64) << jnp.uint64(32)))
+            data = val64.astype(jnp.int64)
+        else:
+            data = jnp.stack([out_lo, out_hi], axis=0)  # [2, n] plane pair
+    else:
+        bits = 8 * itemsize
+        val = out_lo.astype(jnp.int32)
+        # sign-extend the low limbs for narrow types
+        val = (val << (32 - bits)) >> (32 - bits)
+        data = val.astype(jnp.dtype(f"int{bits}"))
+    punted_live = punted & in_valid
+    return (data, ok, error, pack_bools(in_valid & ok), punted_live,
+            jnp.any(punted_live))
+
+
 def _host_parse_punted(raw: bytes, itemsize: int):
     """Exact Spark CAST semantics for the rare rows the static device
     windows punt on (same grammar as :func:`_parse_int_magnitude`, with
@@ -253,6 +322,7 @@ def _host_parse_punted(raw: bytes, itemsize: int):
 
 
 @span_fn(attrs=_col_rows)
+@_shape_bucketed
 @func_range()
 def cast_string_to_int(col: Column, dtype: DType, *, ansi: bool = False
                        ) -> Tuple[Column, jnp.ndarray]:
@@ -277,28 +347,11 @@ def cast_string_to_int(col: Column, dtype: DType, *, ansi: bool = False
                 "host-boundary conversion: call it eagerly (or "
                 "to_arrow() the column before entering jit)")
         col = col.to_arrow()
-    out_lo, out_hi, ok, punted = _cast_string_to_int_jit(
-        col.offsets, col.chars, dtype.itemsize, PARSE_WIDTH)
-
-    in_valid = col.valid_bools()
-    error = in_valid & ~ok
-
-    if dtype.itemsize == 8:
-        if jax.config.jax_enable_x64:
-            val64 = (out_lo.astype(jnp.uint64)
-                     | (out_hi.astype(jnp.uint64) << jnp.uint64(32)))
-            data = val64.astype(jnp.int64)
-        else:
-            data = jnp.stack([out_lo, out_hi], axis=0)  # [2, n] plane pair
-    else:
-        bits = 8 * dtype.itemsize
-        val = out_lo.astype(jnp.int32)
-        # sign-extend the low limbs for narrow types
-        val = (val << (32 - bits)) >> (32 - bits)
-        data = val.astype(dtype.np_dtype)
+    data, ok, error, valid_packed, punted_live, any_punted = \
+        _cast_int_fused_jit(col.offsets, col.chars, col.validity,
+                            dtype.itemsize, PARSE_WIDTH)
 
     import numpy as np
-    punted_live = punted & in_valid
     if isinstance(punted_live, jax.core.Tracer):
         # under an outer jit the host fallback cannot run: punted rows
         # stay conservatively null (eager calls — the normal operator
@@ -307,10 +360,13 @@ def cast_string_to_int(col: Column, dtype: DType, *, ansi: bool = False
     else:
         # ONE scalar readback gates the rare path; the non-punting common
         # case stays a single small sync, never a full-array transfer
-        has_punts = bool(jnp.any(punted_live))
+        # (the any-reduce ran inside the fused jit)
+        has_punts = bool(any_punted)
     if has_punts:
         punted_np = np.asarray(punted_live)
-        # exact host parse for the unbounded tail, patched back in
+        # exact host parse for the unbounded tail, patched back in (rare
+        # path: the eager recombine below is fine off the hot path)
+        in_valid = np.asarray(col.valid_bools())
         offs = np.asarray(col.offsets)
         chars_np = np.asarray(col.chars)
         data_np = np.array(np.asarray(data))
@@ -329,8 +385,8 @@ def cast_string_to_int(col: Column, dtype: DType, *, ansi: bool = False
             else:
                 data_np[r] = val
         data = jnp.asarray(data_np)
-        ok = jnp.asarray(ok_np)
-        error = in_valid & ~ok
+        error = jnp.asarray(in_valid & ~ok_np)
+        valid_packed = pack_bools(jnp.asarray(in_valid & ok_np))
 
     if ansi:
         bad = np.asarray(error)
@@ -338,8 +394,7 @@ def cast_string_to_int(col: Column, dtype: DType, *, ansi: bool = False
             raise ValueError(
                 f"ANSI cast failure: {int(bad.sum())} invalid value(s), "
                 f"first at row {int(bad.argmax())}")
-    result_valid = in_valid & ok
-    return Column(dtype, data, pack_bools(result_valid)), error
+    return Column(dtype, data, valid_packed), error
 
 
 # ---------------------------------------------------------------------------
@@ -456,6 +511,7 @@ def _cast_string_to_float_jit(offsets, chars, width: int):
 
 
 @span_fn(attrs=_col_rows)
+@_shape_bucketed
 @func_range()
 def cast_string_to_float(col: Column, dtype: DType, *,
                          ansi: bool = False) -> Tuple[Column, jnp.ndarray]:
@@ -792,6 +848,7 @@ def _cast_string_to_decimal_jit(offsets, chars, scale: int, width: int):
     return result, negative, valid, ovf, punted
 
 @span_fn(attrs=_col_rows)
+@_shape_bucketed
 @func_range()
 def cast_string_to_decimal128(col: Column, scale: int, *,
                               ansi: bool = False
@@ -943,6 +1000,7 @@ def _int_to_string_jit(data, mode: str):
 
 
 @span_fn(attrs=_col_rows)
+@_shape_bucketed
 @func_range()
 def cast_int_to_string(col: Column) -> Column:
     """CAST(<int> AS STRING): decimal formatting, '-' for negatives."""
@@ -1202,6 +1260,7 @@ def _parse_temporal_jit(offsets, chars, width: int, want_time: bool):
 
 
 @span_fn(attrs=_col_rows)
+@_shape_bucketed
 @func_range()
 def cast_string_to_date(col: Column, *, ansi: bool = False
                         ) -> Tuple[Column, jnp.ndarray]:
@@ -1234,6 +1293,7 @@ def cast_string_to_date(col: Column, *, ansi: bool = False
 
 
 @span_fn(attrs=_col_rows)
+@_shape_bucketed
 @func_range()
 def cast_string_to_timestamp(col: Column, *, ansi: bool = False
                              ) -> Tuple[Column, jnp.ndarray]:
@@ -1475,6 +1535,7 @@ def _date_to_string_jit(days):
 
 
 @span_fn(attrs=_col_rows)
+@_shape_bucketed
 @func_range()
 def cast_date_to_string(col: Column) -> Column:
     """CAST(date AS STRING): 'yyyy-MM-dd' (years outside 1..9999 render
@@ -1494,6 +1555,7 @@ def cast_date_to_string(col: Column) -> Column:
 
 
 @span_fn(attrs=_col_rows)
+@_shape_bucketed
 @func_range()
 def cast_timestamp_to_string(col: Column) -> Column:
     """CAST(timestamp AS STRING), UTC: 'yyyy-MM-dd HH:mm:ss[.ffffff]'

@@ -38,6 +38,7 @@ import numpy as np
 from spark_rapids_jni_tpu.table import Column, STRING, pack_bools
 from spark_rapids_jni_tpu.utils.tracing import func_range
 from spark_rapids_jni_tpu.obs import span_fn
+from spark_rapids_jni_tpu.runtime import shapes
 
 
 WILDCARD = object()   # the [*] path segment
@@ -381,16 +382,95 @@ def _scan_automaton(ch: jnp.ndarray, segs: Tuple,
     return final
 
 
-@span_fn(attrs=lambda col, path, *a, **k: {"rows": col.num_rows,
+@span_fn(name="get_json_object",
+         attrs=lambda col, path, *a, **k: {"rows": col.num_rows,
                                            "path": path})
 @func_range()
 def get_json_object(col: Column, path: str,
-                    max_str_len: Optional[int] = None) -> Column:
+                    max_str_len: Optional[int] = None, *,
+                    bucket="auto") -> Column:
     """Spark ``get_json_object(json, path)`` for object-key and
     ``[i]`` array-subscript paths.
 
     Returns a dense-padded string column; null where the path is missing
-    or the JSON is malformed along the scanned prefix."""
+    or the JSON is malformed along the scanned prefix.
+
+    ``bucket``: shape-bucket policy (``runtime/shapes.py``) — ``"auto"``
+    pads rows (and the char window) up to the geometric bucket so ragged
+    batch traffic reuses compiled programs; ``None`` runs at the exact
+    shape."""
+    f = shapes.resolve(bucket)
+    if (f is None or not shapes.bucketable(col)
+            or getattr(col, "capped", False)):
+        return _get_json_object_impl(col, path, max_str_len)
+    n = col.num_rows
+    b = shapes.bucket_rows(n, f)
+    width = None
+    mslen = max_str_len
+    if col.is_padded:
+        from spark_rapids_jni_tpu.table import string_tail
+        if string_tail(col) is not None:
+            return _get_json_object_impl(col, path, max_str_len)
+        max_len = getattr(col, "_gjo_max_len", None)
+        if max_len is None:
+            max_len = _host_max_len(col)
+            if max_len is None:  # traced lengths: impl refuses cleanly
+                return _get_json_object_impl(col, path, max_str_len)
+            object.__setattr__(col, "_gjo_max_len", max_len)
+        if max_len > col.chars2d.shape[1]:
+            # width-capped content: let the impl's loud refusal fire on
+            # the original column
+            return _get_json_object_impl(col, path, max_str_len)
+        width = shapes.bucket_width(col.chars2d.shape[1], f)
+    elif mslen is not None:
+        mslen = shapes.bucket_width(int(mslen), f)
+    else:
+        max_len = _host_max_len(col)
+        if max_len is None:
+            return _get_json_object_impl(col, path, max_str_len)
+        mslen = shapes.bucket_width(max_len, f)
+    shapes.note(n, b)
+    with shapes.pad_span():
+        padded = shapes.pad_column(col, b, width=width)
+        # the padded column is rebuilt per call; carry the original's
+        # memos across so the max-len reduce and the punt readback stay
+        # once-per-(column, path), not once-per-call
+        if col.is_padded:
+            object.__setattr__(padded, "_gjo_max_len",
+                               getattr(col, "_gjo_max_len"))
+        cache = getattr(col, "_gjo_punts", None)
+        if cache is None:
+            cache = {}
+            object.__setattr__(col, "_gjo_punts", cache)
+        object.__setattr__(padded, "_gjo_punts", cache)
+        object.__setattr__(padded, "_gjo_token", _content_token(col))
+    out = _get_json_object_impl(padded, path, mslen)
+    with shapes.unpad_span():
+        return shapes.unpad_column(out, n)
+
+
+def _host_max_len(col: Column) -> Optional[int]:
+    """Max string byte length via a HOST transfer + numpy reduce: a
+    device ``str_lens()`` diff would compile one tiny program per raw
+    batch shape, which the shape-bucket wrapper exists to avoid.  None
+    when lengths are traced (caller falls back to the unbucketed impl)."""
+    src = col.lens if col.lens is not None else col.offsets
+    if src is None or isinstance(src, jax.core.Tracer):
+        return None
+    arr = np.asarray(src)
+    lens = arr if col.lens is not None else arr[1:] - arr[:-1]
+    return int(lens.max()) if lens.size else 0
+
+
+def _content_token(col: Column) -> int:
+    """Identity token of the column's char content buffer — the part of
+    a string column a (path,) memo is actually a function of."""
+    buf = col.chars2d if col.chars2d is not None else col.chars
+    return id(buf)
+
+
+def _get_json_object_impl(col: Column, path: str,
+                          max_str_len: Optional[int] = None) -> Column:
     if not col.dtype.is_string:
         raise ValueError("get_json_object needs a string column")
     segs = tuple(_parse_path(path))
@@ -915,18 +995,26 @@ def _finish_device_result(col: Column, path: str, outs) -> Column:
     result = Column(STRING, _empty_u8(), vpacked, offsets, None, chars)
     if isinstance(any_punt, jax.core.Tracer):
         return result   # under an outer jit: punts stay null
-    # the punt decision is a pure function of the (immutable) column
-    # and path: memoize it on the column like _gjo_max_len, so repeated
-    # evaluation of the same expression pays the tunnel round-trip once
+    # the punt decision is a pure function of the column's char CONTENT
+    # and the path: memoize it on the column like _gjo_max_len, so
+    # repeated evaluation of the same expression pays the tunnel
+    # round-trip once.  The key carries a content token (the char
+    # buffer's identity) alongside the path — a cache dict that outlives
+    # the buffer it described (shared across shape-bucketed re-pads, or
+    # surviving an in-place buffer swap) can then never serve stale punt
+    # flags for fresh content
     cache = getattr(col, "_gjo_punts", None)
     if cache is None:
         cache = {}
         object.__setattr__(col, "_gjo_punts", cache)
-    hit = cache.get(path)
+    token = getattr(col, "_gjo_token", None)
+    if token is None:
+        token = _content_token(col)
+    hit = cache.get((token, path))
     if hit is None:
         any_p = bool(np.asarray(any_punt))  # the one blocking readback
         hit = (any_p, np.asarray(needs_host) if any_p else None)
-        cache[path] = hit
+        cache[(token, path)] = hit
     any_p, nh = hit
     if any_p:
         result = _host_fixup(result, col, path, nh)

@@ -37,6 +37,7 @@ from spark_rapids_jni_tpu.table import (
     Column, Table, bytes2d_to_words as _bytes_to_u32_lanes,
 )
 from spark_rapids_jni_tpu.obs import span_fn
+from spark_rapids_jni_tpu.runtime import shapes
 
 
 def _hash_attrs(table_or_cols, *args, **kwargs):
@@ -192,7 +193,16 @@ def _resolve_str_window(cols, max_str_len: Optional[int]) -> int:
                     # longer rows are host-patched by the hash functions
                     actual_max = max(actual_max, col.chars2d.shape[1])
                     continue
-                lens = np.asarray(col.str_lens())
+                # host-side: str_lens() is an eager device op that would
+                # compile one tiny program per raw shape, defeating the
+                # bucket policy's compile bound
+                if col.lens is not None:
+                    lens = np.asarray(col.lens)
+                elif col.offsets is not None:
+                    arr = np.asarray(col.offsets)
+                    lens = arr[1:] - arr[:-1]
+                else:
+                    lens = np.asarray(col.str_lens())
                 col_max = int(lens.max())
                 actual_max = max(actual_max, col_max)
                 if col.is_padded and col_max > col.chars2d.shape[1]:
@@ -283,24 +293,10 @@ def _patch_capped_rows(col: Column, hc, h_entry, kernel_fn, scatter_fn):
     return scatter_fn(hc, rows, vals)
 
 
-@span_fn(attrs=_hash_attrs)
-def murmur3_hash(table_or_cols, seed: int = DEFAULT_SEED,
-                 max_str_len: Optional[int] = None) -> jnp.ndarray:
-    """Spark ``Murmur3Hash(cols)``: returns int32 [n].
-
-    Null rows of a column leave the running hash unchanged (Spark skips
-    null fields).  String columns hash their UTF-8 bytes; pass
-    ``max_str_len`` when calling under jit (otherwise it is derived from
-    the offsets with a host sync).  Width-capped padded columns hash
-    their device window and host-patch the tail rows (eager only).
-    """
-    cols = (table_or_cols.columns if isinstance(table_or_cols, Table)
-            else tuple(table_or_cols))
+def _murmur3_chain(cols, seed: int, W: int) -> jnp.ndarray:
+    """The per-column murmur3 chain (no capped-tail patching — callers
+    route capped columns through the eager entry)."""
     n = cols[0].num_rows
-    from spark_rapids_jni_tpu.utils import metrics
-    metrics.op("murmur3_hash", rows=n)
-    W = _resolve_str_window(cols, max_str_len) \
-        if any(c.dtype.is_string for c in cols) else 0
     h = jnp.full((n,), seed, dtype=jnp.uint32)
 
     def _mm3_kernel(sub, rows):
@@ -327,6 +323,64 @@ def murmur3_hash(table_or_cols, seed: int = DEFAULT_SEED,
     return jax.lax.bitcast_convert_type(h, jnp.int32)
 
 
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _murmur3_jit(cols, seed: int, W: int) -> jnp.ndarray:
+    """The whole chain as ONE program.  Eagerly the chain dispatches
+    hundreds of tiny per-shape vector ops; under the shape-bucket policy
+    each bucket then compiles exactly one program, which is what lets
+    the guard test count compiles-per-op against the bucket count."""
+    return _murmur3_chain(cols, seed, W)
+
+
+def _hash_bucketed(cols, bucket, W: int):
+    """Resolve the bucket plan for a hash entry: ``(b, Wb)`` row/width
+    buckets, or None to take the eager unbucketed path (opt-out, inside
+    a trace, nested columns, or capped columns whose host tail patch
+    requires eager per-shape execution)."""
+    f = shapes.resolve(bucket)
+    if f is None or any(c.children or getattr(c, "capped", False)
+                        for c in cols):
+        return None
+    n = cols[0].num_rows
+    return shapes.bucket_rows(n, f), shapes.bucket_width(W, f)
+
+
+@span_fn(attrs=_hash_attrs)
+def murmur3_hash(table_or_cols, seed: int = DEFAULT_SEED,
+                 max_str_len: Optional[int] = None, *,
+                 bucket="auto") -> jnp.ndarray:
+    """Spark ``Murmur3Hash(cols)``: returns int32 [n].
+
+    Null rows of a column leave the running hash unchanged (Spark skips
+    null fields).  String columns hash their UTF-8 bytes; pass
+    ``max_str_len`` when calling under jit (otherwise it is derived from
+    the offsets with a host sync).  Width-capped padded columns hash
+    their device window and host-patch the tail rows (eager only).
+
+    ``bucket``: shape-bucket policy (``runtime/shapes.py``).  ``"auto"``
+    pads rows/window to the geometric bucket and runs the whole chain as
+    one jitted program per bucket; ``None`` keeps the exact-shape eager
+    chain."""
+    cols = (table_or_cols.columns if isinstance(table_or_cols, Table)
+            else tuple(table_or_cols))
+    n = cols[0].num_rows
+    from spark_rapids_jni_tpu.utils import metrics
+    metrics.op("murmur3_hash", rows=n)
+    W = _resolve_str_window(cols, max_str_len) \
+        if any(c.dtype.is_string for c in cols) else 0
+    plan = _hash_bucketed(cols, bucket, W)
+    if plan is None:
+        return _murmur3_chain(cols, seed, W)
+    b, Wb = plan
+    shapes.note(n, b)
+    with shapes.pad_span():
+        pcols = tuple(shapes.pad_column(c, b, width=Wb or None)
+                      for c in cols)
+    out = _murmur3_jit(pcols, int(seed), Wb)
+    with shapes.unpad_span():
+        return shapes.unpad_array(out, n)
+
+
 def pmod(hashes: jnp.ndarray, divisor: int) -> jnp.ndarray:
     """Spark's positive-mod used by HashPartitioning."""
     m = hashes % jnp.int32(divisor)
@@ -335,9 +389,11 @@ def pmod(hashes: jnp.ndarray, divisor: int) -> jnp.ndarray:
 
 def hash_partition_ids(table_or_cols, num_partitions: int,
                        seed: int = DEFAULT_SEED,
-                       max_str_len: Optional[int] = None) -> jnp.ndarray:
+                       max_str_len: Optional[int] = None,
+                       bucket="auto") -> jnp.ndarray:
     """Row -> partition id, exactly as Spark HashPartitioning does."""
-    return pmod(murmur3_hash(table_or_cols, seed, max_str_len),
+    return pmod(murmur3_hash(table_or_cols, seed, max_str_len,
+                             bucket=bucket),
                 num_partitions)
 
 
@@ -524,18 +580,9 @@ def _xx64_string_col(col: Column, h, W: int):
     return _xx_fmix(hash_)
 
 
-@span_fn(attrs=_hash_attrs)
-def xxhash64(table_or_cols, seed: int = DEFAULT_SEED,
-             max_str_len: Optional[int] = None) -> jnp.ndarray:
-    """Spark ``XxHash64(cols)``: returns the hash as uint32 (hi, lo) pair
-    stacked into an [n, 2] array (lo word first), chaining per column with
-    null fields skipped.  String columns hash their UTF-8 byte stream; pass
-    ``max_str_len`` when calling under jit."""
-    cols = (table_or_cols.columns if isinstance(table_or_cols, Table)
-            else tuple(table_or_cols))
+def _xx64_chain(cols, seed: int, W: int) -> jnp.ndarray:
+    """The per-column xxhash64 chain (see :func:`_murmur3_chain`)."""
     n = cols[0].num_rows
-    W = _resolve_str_window(cols, max_str_len) \
-        if any(c.dtype.is_string for c in cols) else 0
     zeros = jnp.zeros((n,), jnp.uint32)
     h = (zeros, zeros + jnp.uint32(seed))  # seed < 2^32 in practice
 
@@ -565,3 +612,35 @@ def xxhash64(table_or_cols, seed: int = DEFAULT_SEED,
             hc = (jnp.where(v, hc[0], h[0]), jnp.where(v, hc[1], h[1]))
         h = hc
     return jnp.stack([h[1], h[0]], axis=1)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _xx64_jit(cols, seed: int, W: int) -> jnp.ndarray:
+    return _xx64_chain(cols, seed, W)
+
+
+@span_fn(attrs=_hash_attrs)
+def xxhash64(table_or_cols, seed: int = DEFAULT_SEED,
+             max_str_len: Optional[int] = None, *,
+             bucket="auto") -> jnp.ndarray:
+    """Spark ``XxHash64(cols)``: returns the hash as uint32 (hi, lo) pair
+    stacked into an [n, 2] array (lo word first), chaining per column with
+    null fields skipped.  String columns hash their UTF-8 byte stream; pass
+    ``max_str_len`` when calling under jit.  ``bucket``: shape-bucket
+    policy, as in :func:`murmur3_hash`."""
+    cols = (table_or_cols.columns if isinstance(table_or_cols, Table)
+            else tuple(table_or_cols))
+    n = cols[0].num_rows
+    W = _resolve_str_window(cols, max_str_len) \
+        if any(c.dtype.is_string for c in cols) else 0
+    plan = _hash_bucketed(cols, bucket, W)
+    if plan is None:
+        return _xx64_chain(cols, seed, W)
+    b, Wb = plan
+    shapes.note(n, b)
+    with shapes.pad_span():
+        pcols = tuple(shapes.pad_column(c, b, width=Wb or None)
+                      for c in cols)
+    out = _xx64_jit(pcols, int(seed), Wb)
+    with shapes.unpad_span():
+        return shapes.unpad_array(out, n)

@@ -48,6 +48,7 @@ from spark_rapids_jni_tpu.ops.row_layout import (
 from spark_rapids_jni_tpu.utils.tracing import func_range
 from spark_rapids_jni_tpu.utils import metrics
 from spark_rapids_jni_tpu.obs import span_fn
+from spark_rapids_jni_tpu.runtime import shapes
 
 
 # ---------------------------------------------------------------------------
@@ -414,18 +415,87 @@ def _resolve_impl(impl: Optional[str], use_pallas: Optional[bool],
     return "mxu" if platform == "tpu" else "xla"
 
 
+def _trim_row_batches(batches: List[RowsColumn], n: int
+                      ) -> List[RowsColumn]:
+    """Slice a padded-table encode back to ``n`` total rows: drop whole
+    padding batches, row-slice the batch straddling ``n`` (offsets are
+    uniform per batch, so ``offsets[:keep+1]`` stays consistent)."""
+    out, done = [], 0
+    for bc in batches:
+        k = bc.num_rows
+        keep = min(k, n - done)
+        if keep == k:
+            out.append(bc)
+        else:
+            rs = (bc.data.shape[1] if bc.data.ndim == 2
+                  else bc.data.size // max(k, 1))
+            data = (bc.data[:keep] if bc.data.ndim == 2
+                    else bc.data[:keep * rs])
+            out.append(RowsColumn(data, bc.offsets[:keep + 1],
+                                  bc.row_size, bc.str_widths))
+        done += keep
+        if done >= n:
+            break
+    return out
+
+
+def _pad_rows_blob(bc: RowsColumn, b: int, rs: int) -> RowsColumn:
+    """Pad a row blob to ``b`` rows of zeros (zero validity bytes decode
+    as all-null rows, which the post-decode slice then drops)."""
+    n = bc.num_rows
+    if bc.data.ndim == 2:
+        data = jnp.pad(bc.data, ((0, b - n), (0, 0)))
+    else:
+        data = jnp.pad(bc.data, (0, (b - n) * rs))
+    offsets = jnp.asarray(np.arange(b + 1, dtype=np.int32) * rs)
+    return RowsColumn(data, offsets, bc.row_size, bc.str_widths)
+
+
 @span_fn(attrs=lambda table, **k: {"rows": table.num_rows})
 @func_range()
 def convert_to_rows(table: Table, *, size_limit: int = MAX_BATCH_BYTES,
                     use_pallas: Optional[bool] = None,
-                    impl: Optional[str] = None) -> List[RowsColumn]:
+                    impl: Optional[str] = None,
+                    bucket="auto") -> List[RowsColumn]:
     """Convert a table to JCUDF row batches (reference ``convert_to_rows``,
     ``row_conversion.cu:1902-1960``).
 
     Variable-width dispatch: tables whose string columns are dense-padded
     (``chars2d``) encode to padded uniform-size rows — the TPU hot path
     (static shapes end to end).  Arrow-layout string columns take the
-    compact wire-exact path (per-row scatter; slow on TPU, fine on CPU)."""
+    compact wire-exact path (per-row scatter; slow on TPU, fine on CPU).
+
+    ``bucket``: shape-bucket the row axis (``runtime/shapes.py``) so a
+    stream of varying batch sizes reuses O(log N) compiled programs; the
+    encode runs on the padded table (tail rows invalid → all-null rows)
+    and the resulting batches are sliced back.  Arrow-layout string
+    tables skip bucketing (their char buffers are content-sized, so the
+    jit is content-keyed regardless)."""
+    f = shapes.resolve(bucket)
+    if (f is not None and shapes.bucketable(table)
+            and not any(getattr(c, "capped", False) for c in table.columns)
+            and all(c.is_padded for c in _string_cols(table))):
+        n = table.num_rows
+        b = shapes.bucket_rows(n, f)
+        shapes.note(n, b)
+        with shapes.pad_span():
+            padded = shapes.pad_table(table, b)
+        try:
+            out = _convert_to_rows_impl(padded, size_limit, use_pallas, impl)
+        except ValueError:
+            # a tight size_limit can hold the exact-shape table but not
+            # its bucket-padded twin (plan_fixed_batches' sub-32-row
+            # fallback is byte-exact) — padding must never turn a
+            # convertible table into an error, so take the exact path
+            return _convert_to_rows_impl(table, size_limit, use_pallas, impl)
+        with shapes.unpad_span():
+            return _trim_row_batches(out, n)
+    return _convert_to_rows_impl(table, size_limit, use_pallas, impl)
+
+
+def _convert_to_rows_impl(table: Table, size_limit: int,
+                          use_pallas: Optional[bool],
+                          impl: Optional[str]) -> List[RowsColumn]:
     layout = compute_row_layout(table.dtypes)
     metrics.op("convert_to_rows", rows=table.num_rows)
     if layout.has_strings:
@@ -472,8 +542,10 @@ def convert_to_rows(table: Table, *, size_limit: int = MAX_BATCH_BYTES,
         if impl == "pallas":
             from spark_rapids_jni_tpu.ops import row_kernels
             if size is None:
+                # bucketing (if any) already happened at the convert_to_rows
+                # wrapper; never re-bucket inside the impl
                 return row_kernels.to_rows_fixed(
-                    table, layout, interpret=platform != "tpu")
+                    table, layout, interpret=platform != "tpu", bucket=None)
             return row_kernels.to_rows_fixed_batch(
                 table, layout, jnp.int32(start), size,
                 interpret=platform != "tpu")
@@ -483,7 +555,11 @@ def convert_to_rows(table: Table, *, size_limit: int = MAX_BATCH_BYTES,
         return _to_rows_fixed_jit(table, layout, jnp.int32(start), size)
 
     if len(plan_fixed_batches(n, layout.fixed_row_size, chunk)) == 1:
-        offsets = jnp.arange(n + 1, dtype=jnp.int32) * layout.fixed_row_size
+        # host-built (jnp.asarray of numpy emits no XLA compile): batch
+        # offsets are pure bookkeeping and must not count against the
+        # operator's compiled-program budget (see runtime/shapes.py)
+        offsets = jnp.asarray(
+            np.arange(n + 1, dtype=np.int32) * layout.fixed_row_size)
         return [RowsColumn(encode(), offsets)]
     # multi-batch: encode per batch (sliced inside the jit with a traced
     # start) so peak memory stays ~one batch of transients, the way the
@@ -497,8 +573,8 @@ def convert_to_rows(table: Table, *, size_limit: int = MAX_BATCH_BYTES,
     out = []
     for start in range(0, n, per):
         size = min(per, n - start)
-        offsets = jnp.arange(size + 1,
-                             dtype=jnp.int32) * layout.fixed_row_size
+        offsets = jnp.asarray(
+            np.arange(size + 1, dtype=np.int32) * layout.fixed_row_size)
         out.append(RowsColumn(encode(start, size), offsets))
     return out
 
@@ -508,10 +584,39 @@ def convert_to_rows(table: Table, *, size_limit: int = MAX_BATCH_BYTES,
 @func_range()
 def convert_from_rows(rows: RowsColumn, dtypes: Sequence[DType],
                       *, use_pallas: Optional[bool] = None,
-                      impl: Optional[str] = None) -> Table:
+                      impl: Optional[str] = None, bucket="auto") -> Table:
     """Convert one batch of JCUDF rows back to a table (reference
-    ``convert_from_rows``, ``row_conversion.cu:2032-2250``)."""
+    ``convert_from_rows``, ``row_conversion.cu:2032-2250``).
+
+    ``bucket``: shape-bucket the row axis — the blob pads with zero rows
+    (zero validity bytes decode as all-null rows) and the decoded table
+    is sliced back to the true row count.  Compact wire-form string
+    blobs skip bucketing (content-sized, so content-keyed anyway), as do
+    blobs carrying width-cap overflow tails (the host-side tail dict
+    hangs off the exact RowsColumn object; a padded twin would lose it
+    and the decode refuses to silently truncate)."""
     layout = compute_row_layout(dtypes)
+    f = shapes.resolve(bucket)
+    if (f is not None and (rows.is_padded or not layout.has_strings)
+            and getattr(rows, "_string_tails", None) is None):
+        n = rows.num_rows
+        rs = rows.row_size if rows.row_size is not None \
+            else layout.fixed_row_size
+        b = shapes.bucket_rows(n, f)
+        shapes.note(n, b)
+        with shapes.pad_span():
+            padded = _pad_rows_blob(rows, b, rs)
+        out = _convert_from_rows_impl(padded, dtypes, layout,
+                                      use_pallas, impl)
+        with shapes.unpad_span():
+            return slice_table(out, 0, n)
+    return _convert_from_rows_impl(rows, dtypes, layout, use_pallas, impl)
+
+
+def _convert_from_rows_impl(rows: RowsColumn, dtypes: Sequence[DType],
+                            layout: RowLayout,
+                            use_pallas: Optional[bool],
+                            impl: Optional[str]) -> Table:
     metrics.op("convert_from_rows", rows=rows.num_rows,
                bytes_=rows.data.size)
     if layout.has_strings:
@@ -525,7 +630,8 @@ def convert_from_rows(rows: RowsColumn, dtypes: Sequence[DType],
         from spark_rapids_jni_tpu.ops import row_kernels
         rows2d = rows.rows2d(layout.fixed_row_size)
         cols = row_kernels.from_rows_fixed(rows2d, layout,
-                                           interpret=platform != "tpu")
+                                           interpret=platform != "tpu",
+                                           bucket=None)
     elif impl == "mxu":
         from spark_rapids_jni_tpu.ops import row_mxu
         if rows.data.size != n * layout.fixed_row_size:

@@ -27,6 +27,7 @@ from jax.experimental.pallas import tpu as pltpu
 from spark_rapids_jni_tpu.table import Column, Table, pack_bools
 from spark_rapids_jni_tpu.ops.row_layout import RowLayout
 from spark_rapids_jni_tpu.ops import row_conversion as rc
+from spark_rapids_jni_tpu.runtime import shapes
 
 # Rows per grid step.  Mosaic lane-pads every per-column [tile, size]
 # uint8 block to 128 lanes, so VMEM cost is ~(ncols + 2) * tile * 128
@@ -108,12 +109,24 @@ def _to_rows_pallas(table: Table, layout: RowLayout,
 
 def to_rows_fixed(table: Table, layout: RowLayout,
                   tile_rows: int = 0,
-                  interpret: bool = False) -> jnp.ndarray:
+                  interpret: bool = False, bucket="auto") -> jnp.ndarray:
     """Flat uint8 JCUDF rows (n * fixed_row_size) via the Pallas tiled
     kernel.  ``tile_rows=0`` sizes the tile to the schema's VMEM
-    footprint."""
+    footprint.  ``bucket`` shape-buckets the row axis (the padded tail is
+    invalid rows, encoded as all-null, sliced off the blob) so direct
+    callers with ragged batch sizes reuse one program per bucket."""
     if tile_rows <= 0:
         tile_rows = _tile_rows_for(layout.num_columns)
+    f = shapes.resolve(bucket)
+    if f is not None and shapes.bucketable(table):
+        n = table.num_rows
+        b = shapes.bucket_rows(n, f)
+        shapes.note(n, b)
+        with shapes.pad_span():
+            padded = shapes.pad_table(table, b)
+        rows = _to_rows_pallas(padded, layout, tile_rows, interpret)
+        with shapes.unpad_span():
+            return shapes.unpad_array(rows, n)
     return _to_rows_pallas(table, layout, tile_rows, interpret)
 
 
@@ -180,7 +193,20 @@ def _from_rows_pallas(rows2d: jnp.ndarray, layout: RowLayout,
 
 def from_rows_fixed(rows2d: jnp.ndarray, layout: RowLayout,
                     tile_rows: int = 0,
-                    interpret: bool = False) -> List[Column]:
+                    interpret: bool = False, bucket="auto") -> List[Column]:
+    """Decode fixed-width JCUDF rows.  ``bucket`` shape-buckets the row
+    axis: the blob pads with zero rows (decoding to all-null) and the
+    decoded columns slice back to the true count."""
     if tile_rows <= 0:
         tile_rows = _tile_rows_for(layout.num_columns)
+    f = shapes.resolve(bucket)
+    if f is not None:
+        n = rows2d.shape[0]
+        b = shapes.bucket_rows(n, f)
+        shapes.note(n, b)
+        with shapes.pad_span():
+            padded = _pad_rows(rows2d, b)
+        cols = _from_rows_pallas(padded, layout, tile_rows, interpret)
+        with shapes.unpad_span():
+            return [shapes.unpad_column(c, n) for c in cols]
     return _from_rows_pallas(rows2d, layout, tile_rows, interpret)

@@ -21,8 +21,10 @@ static-shape analogue of the reference's data-dependent batch re-planning
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
+import weakref
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -32,6 +34,7 @@ from jax import shard_map
 
 from spark_rapids_jni_tpu.table import Column, Table
 from spark_rapids_jni_tpu.obs import span_fn
+from spark_rapids_jni_tpu.runtime import shapes
 from spark_rapids_jni_tpu.ops.row_layout import compute_row_layout
 from spark_rapids_jni_tpu.ops import row_conversion as rc
 from spark_rapids_jni_tpu.ops.hashing import hash_partition_ids
@@ -80,9 +83,41 @@ def _col_sig(c):
             tuple(_col_sig(ch) for ch in c.children) if c.children else ())
 
 
-# jitted exchange programs keyed on their static parameters (see attempt()
-# in shuffle_table_sharded); bounded in practice by the pow2 capacity grid
-_exchange_cache: dict = {}
+class _ExchangeCache:
+    """Compiled exchange programs, bounded and collectable.
+
+    Entries hang off the Mesh object through a ``WeakKeyDictionary``, so
+    retiring a mesh releases every exchange program traced against it
+    (the old module-global dict pinned them forever).  Within a mesh a
+    small LRU bounds the (schema × capacity-bucket × method) variants —
+    the capacity grid (``runtime/shapes.py``) already bounds them in
+    practice; the LRU turns that into a hard cap."""
+
+    PER_MESH = 16
+
+    def __init__(self):
+        self._by_mesh = weakref.WeakKeyDictionary()
+
+    def get(self, mesh: Mesh, key):
+        lru = self._by_mesh.get(mesh)
+        if lru is None:
+            return None
+        fn = lru.get(key)
+        if fn is not None:
+            lru.move_to_end(key)
+        return fn
+
+    def put(self, mesh: Mesh, key, fn):
+        lru = self._by_mesh.get(mesh)
+        if lru is None:
+            lru = self._by_mesh[mesh] = collections.OrderedDict()
+        lru[key] = fn
+        lru.move_to_end(key)
+        while len(lru) > self.PER_MESH:
+            lru.popitem(last=False)
+
+
+_exchange_cache = _ExchangeCache()
 
 
 def _pack_buckets(rows2d, pids, num_parts: int, capacity: int):
@@ -211,9 +246,9 @@ def max_bucket_count(table: Table, key_cols: Sequence[int], mesh: Mesh,
     from spark_rapids_jni_tpu.parallel.mesh import table_partition_specs
 
     cache_key = ("count", tuple(_col_sig(c) for c in table.columns),
-                 tuple(key_cols), num_parts, axis_name, mesh, seed,
+                 tuple(key_cols), num_parts, axis_name, seed,
                  bool(jax.config.jax_enable_x64))
-    fn = _exchange_cache.get(cache_key)
+    fn = _exchange_cache.get(mesh, cache_key)
     if fn is None:
         @functools.partial(
             shard_map, mesh=mesh,
@@ -225,7 +260,8 @@ def max_bucket_count(table: Table, key_cols: Sequence[int], mesh: Mesh,
             counts = jnp.bincount(pids, length=num_parts).astype(jnp.int32)
             return jax.lax.pmax(jnp.max(counts), axis_name)
 
-        fn = _exchange_cache[cache_key] = jax.jit(count)
+        fn = jax.jit(count)
+        _exchange_cache.put(mesh, cache_key, fn)
     return int(fn(table))
 
 
@@ -273,16 +309,16 @@ def shuffle_table_sharded(table: Table, key_cols: Sequence[int],
     num_parts = mesh.shape[axis_name]
     n_local = table.num_rows // num_parts
     exact = capacity_factor is None
-    # capacity quantizes up to a power of two on both paths: it is a
-    # static shape, so every distinct value is a full XLA recompile of
-    # the exchange program (and a permanent _exchange_cache entry) —
-    # pow2 rounding bounds the compiled variants to log2(n)
+    # capacity quantizes up to the repo-wide shape-bucket grid on both
+    # paths: it is a static shape, so every distinct value is a full XLA
+    # recompile of the exchange program (and an _exchange_cache entry) —
+    # the geometric grid bounds the compiled variants to O(log n)
     if exact:
         need = max(8, max_bucket_count(table, key_cols, mesh, axis_name,
                                        seed))
     else:
         need = max(8, int(n_local / num_parts * capacity_factor))
-    capacity = _align_capacity(1 << (need - 1).bit_length(), num_parts)
+    capacity = _align_capacity(shapes.bucket_rows(need), num_parts)
 
     make_body = (ring_bucket_exchange if method == "ring"
                  else bucket_exchange)
@@ -298,9 +334,9 @@ def shuffle_table_sharded(table: Table, key_cols: Sequence[int],
         # trace closes over)
         cache_key = (tuple(_col_sig(c) for c in table.columns),
                      tuple(key_cols), num_parts, capacity, method,
-                     axis_name, mesh, seed, widths,
+                     axis_name, seed, widths,
                      bool(jax.config.jax_enable_x64))
-        fn = _exchange_cache.get(cache_key)
+        fn = _exchange_cache.get(mesh, cache_key)
         if fn is None:
             @functools.partial(
                 shard_map, mesh=mesh,
@@ -319,7 +355,8 @@ def shuffle_table_sharded(table: Table, key_cols: Sequence[int],
                 rows, valid, num_valid, overflow = body(rows2d, pids)
                 return rows, valid, num_valid[None], overflow[None]
 
-            fn = _exchange_cache[cache_key] = jax.jit(run)
+            fn = jax.jit(run)
+            _exchange_cache.put(mesh, cache_key, fn)
         return fn(table)
 
     rows, valid, num_valid, overflow = attempt(capacity)

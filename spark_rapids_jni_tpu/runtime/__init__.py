@@ -1,0 +1,5 @@
+"""Runtime policies that sit between operator entry points and their
+jitted kernels — currently the shape-bucketing policy
+(:mod:`~spark_rapids_jni_tpu.runtime.shapes`)."""
+
+from spark_rapids_jni_tpu.runtime import shapes  # noqa: F401

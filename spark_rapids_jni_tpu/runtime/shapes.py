@@ -1,0 +1,268 @@
+"""Shape-bucketed execution: padding buckets so varying-shape traffic
+reuses compiled programs.
+
+Every hot entry point (``to_rows``/``from_rows``, ``cast_string_*``,
+``get_json_object``, hashing, shuffle, joins/aggregates) is a ``jax.jit``
+keyed on the exact row count (and, for strings, on char-buffer sizes), so
+a production stream of varying batch sizes recompiles per shape — the
+silent-recompile pathology ``obs/compilemon.py`` exists to expose.  This
+module is the repo-wide fix, generalizing the pow-2 capacity grid
+``parallel/shuffle.py`` already proved locally:
+
+- :func:`bucket_rows` / :func:`bucket_width` quantize a size up to a
+  geometric grid (pow-2 by default; ``SRJ_TPU_SHAPE_BUCKETS`` sets the
+  factor), so N distinct sizes map to O(log N) buckets.
+- :func:`pad_column` / :func:`pad_table` pad the leading row axis up to
+  the bucket with rows that are **invalid** (the padded validity mask is
+  the correctness contract: every kernel in this repo already implements
+  Spark null semantics, so invalid tail rows produce no hashes, no parse
+  errors, no join matches, and no groups).
+- :func:`unpad_column` / :func:`unpad_array` slice results back to the
+  true row count.
+
+Wired ops take a ``bucket`` keyword: the default ``"auto"`` buckets when
+executing eagerly (a jit trace already has a fixed shape — padding there
+would be pure overhead), ``None`` opts out for fixed-shape callers, and a
+number is an explicit geometric factor.  ``SRJ_TPU_SHAPE_BUCKETS=1`` (or
+``0`` / ``off``) disables bucketing process-wide.
+
+Observability: the pad/slice glue runs inside dedicated ``shapes.pad`` /
+``shapes.unpad`` spans so its (tiny, per-raw-shape) eager compiles are
+attributed there, not to the operator; the operator's own span gets
+``bucket`` / ``padded_rows`` attributes so the report CLI can show
+padding overhead next to compile counts.  The guard test
+(``tests/test_shapes.py``) pushes ~20 distinct batch sizes through each
+wired op and asserts, via the compile-event stream, that programs
+compiled **under the op's span** stay ≤ the bucket count.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.obs import spans
+from spark_rapids_jni_tpu.table import (
+    Column, Table, slice_table, string_tail, attach_string_tail,
+)
+from spark_rapids_jni_tpu.utils import metrics as _metrics
+
+# smallest row bucket: matches shuffle's historical minimum capacity and
+# keeps packed-validity byte counts whole for every bucket
+MIN_ROWS = 8
+# smallest non-zero width bucket; widths stay multiples of 4 so char
+# slots keep the uint32-word alignment ``table._padded_width`` promises
+MIN_WIDTH = 4
+
+_ENV = "SRJ_TPU_SHAPE_BUCKETS"
+
+
+def factor() -> Optional[float]:
+    """The process-wide geometric bucket factor from ``SRJ_TPU_SHAPE_BUCKETS``
+    (default 2.0 = pow-2 grid), or ``None`` when the env disables
+    bucketing (``0``, ``1``, ``off``, ``none``, or any factor ≤ 1)."""
+    raw = os.environ.get(_ENV, "").strip().lower()
+    if raw in ("", "auto"):
+        return 2.0
+    if raw in ("off", "none", "no", "false"):
+        return None
+    try:
+        f = float(raw)
+    except ValueError:
+        return 2.0
+    return f if f > 1.0 else None
+
+
+def resolve(bucket) -> Optional[float]:
+    """Resolve an op's ``bucket`` argument to a geometric factor or None.
+
+    ``None`` → bucketing off.  ``"auto"`` → the env factor, but only when
+    executing eagerly (inside a jit trace shapes are already static and
+    host-side mask construction is impossible).  A number → that factor
+    (≤ 1 disables)."""
+    if bucket is None:
+        return None
+    if bucket == "auto":
+        return factor() if _metrics.eager() else None
+    f = float(bucket)
+    return f if f > 1.0 else None
+
+
+def bucket_rows(n: int, f: Optional[float] = None) -> int:
+    """Smallest grid bucket ≥ ``n``.  The grid is fixed (walked up from
+    :data:`MIN_ROWS` by the geometric factor) so every caller lands on
+    the same boundaries regardless of its own n."""
+    if f is None:
+        f = factor() or 2.0
+    b = MIN_ROWS
+    while b < n:
+        b = max(b + 1, int(math.ceil(b * f)))
+    return b
+
+
+def bucket_width(w: int, f: Optional[float] = None) -> int:
+    """Width bucket for char windows: like :func:`bucket_rows` but on a
+    multiple-of-4 grid from :data:`MIN_WIDTH`; 0 stays 0 (a zero-width
+    column has nothing to pad)."""
+    if w <= 0:
+        return 0
+    if f is None:
+        f = factor() or 2.0
+    b = MIN_WIDTH
+    while b < w:
+        nxt = (int(math.ceil(b * f)) + 3) // 4 * 4
+        b = max(b + 4, nxt)
+    return b
+
+
+def prefix_mask(n: int, b: int) -> jnp.ndarray:
+    """Packed validity (uint8, LSB-first — the ``pack_bools`` layout) with
+    rows [0, n) valid and [n, b) invalid.  Built host-side with numpy:
+    ``jnp.asarray`` of a host buffer emits no XLA compile, so an op whose
+    input had ``validity=None`` gains a padded mask for free."""
+    nb = (b + 7) // 8
+    buf = np.zeros((nb,), np.uint8)
+    buf[: n // 8] = 0xFF
+    if n % 8:
+        buf[n // 8] = (1 << (n % 8)) - 1
+    return jnp.asarray(buf)
+
+
+def _pad_validity(validity, n: int, b: int) -> jnp.ndarray:
+    if validity is None:
+        return prefix_mask(n, b)
+    pad = (b + 7) // 8 - validity.shape[0]
+    if pad <= 0:
+        return validity
+    # bits past n in the last byte are already 0 (pack_bools zero-pads),
+    # so appending zero bytes marks every tail row invalid
+    return jnp.concatenate([validity, jnp.zeros((pad,), jnp.uint8)])
+
+
+def _pad_axis0(arr, b: int):
+    n = arr.shape[0]
+    if n == b:
+        return arr
+    return jnp.pad(arr, ((0, b - n),) + ((0, 0),) * (arr.ndim - 1))
+
+
+def pad_mask(mask, n: int, b: int) -> jnp.ndarray:
+    """Row-liveness mask padded to ``b`` rows with a False tail (padded
+    rows must not form groups / match joins).  ``None`` → a host-built
+    prefix mask (no XLA compile), so callers that never passed a mask
+    don't pay one."""
+    if mask is None:
+        return jnp.asarray(np.arange(b) < n)
+    if b == n:
+        return mask
+    return jnp.concatenate([mask, jnp.zeros((b - n,), jnp.bool_)])
+
+
+def bucketable(obj) -> bool:
+    """True when every column has a paddable representation (nested
+    list/struct columns carry children with their own row counts and are
+    left to the unbucketed path)."""
+    cols = obj.columns if isinstance(obj, Table) else [obj]
+    return not any(c.children for c in cols)
+
+
+def pad_column(col: Column, b: int, *, width: Optional[int] = None
+               ) -> Column:
+    """Pad ``col`` to ``b`` rows; tail rows are invalid.  ``width``:
+    optionally also pad ``chars2d`` out to this many columns (zero fill —
+    kernels never read past each row's length).  String content buffers:
+    Arrow ``chars`` pads to its own length bucket (its size is a jit key
+    too), padded-layout ``offsets`` repeat the last offset so tail rows
+    are zero-length strings.  A width-capped column's host tail is
+    re-attached (tail row indices all precede the original n)."""
+    n = col.num_rows
+    if col.children:
+        raise ValueError("nested (list/struct) columns are not bucketable")
+    # always materialized (even when b == n, or the input had
+    # validity=None): a None-vs-array validity would split the jit cache
+    # into two programs per bucket
+    validity = _pad_validity(col.validity, n, b)
+    if col.dtype.is_string:
+        offsets = col.offsets
+        if offsets is not None and b > n:
+            offsets = jnp.concatenate(
+                [offsets, jnp.broadcast_to(offsets[-1:], (b - n,))])
+        chars = col.chars
+        if chars is not None and chars.shape[0]:
+            cb = bucket_rows(chars.shape[0])
+            if cb > chars.shape[0]:
+                chars = jnp.pad(chars, (0, cb - chars.shape[0]))
+        chars2d = col.chars2d
+        if chars2d is not None:
+            w = chars2d.shape[1] if width is None \
+                else max(width, chars2d.shape[1])
+            if b > n or w > chars2d.shape[1]:
+                chars2d = jnp.pad(
+                    chars2d, ((0, b - n), (0, w - chars2d.shape[1])))
+        lens = col.lens
+        if lens is not None and b > n:
+            lens = jnp.pad(lens, (0, b - n))
+        out = Column(col.dtype, col.data, validity, offsets, chars,
+                     chars2d, lens, capped=col.capped)
+        tail = string_tail(col)
+        if tail is not None:
+            attach_string_tail(out, tail)
+        return out
+    if col.data.ndim == 2 and col.dtype.itemsize == 8:
+        data = jnp.pad(col.data, ((0, 0), (0, b - n)))  # [2, n] planes
+    else:
+        data = _pad_axis0(col.data, b)  # [n] or [n, 4] limbs
+    return Column(col.dtype, data, validity)
+
+
+def pad_table(table: Table, b: int) -> Table:
+    return Table(tuple(pad_column(c, b) for c in table.columns))
+
+
+def unpad_column(col: Column, n: int) -> Column:
+    """Slice a padded result back to ``n`` rows (validity bits are
+    repacked, so stale tail bits cannot leak)."""
+    if col.num_rows == n:
+        return col
+    return slice_table(Table((col,)), 0, n).columns[0]
+
+
+def unpad_array(arr, n: int):
+    """Row-slice a padded result array back to ``n`` leading rows."""
+    return arr[:n] if arr.shape[0] != n else arr
+
+
+def unpad_result(out, n: int):
+    """Slice an op result back to ``n`` rows: Columns row-slice, arrays
+    slice their leading axis, tuples recurse (the ``(column, error_mask)``
+    contract of the cast family); anything else passes through."""
+    if isinstance(out, tuple):
+        return tuple(unpad_result(o, n) for o in out)
+    if isinstance(out, Column):
+        return unpad_column(out, n)
+    if hasattr(out, "shape") and out.ndim >= 1:
+        return unpad_array(out, n)
+    return out
+
+
+def note(n: int, b: int) -> None:
+    """Stamp ``bucket`` / ``padded_rows`` on the innermost active span
+    (the operator's own span when called from an op body) so the report
+    CLI shows padding overhead next to compile counts."""
+    sp = spans.current_span()
+    if sp is not None:
+        sp.set(bucket=b, padded_rows=b - n)
+
+
+def pad_span():
+    """Span wrapping the pad glue: its per-raw-shape eager compiles are
+    attributed to ``shapes.pad``, not to the operator."""
+    return spans.span("shapes.pad")
+
+
+def unpad_span():
+    return spans.span("shapes.unpad")

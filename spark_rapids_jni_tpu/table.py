@@ -277,6 +277,17 @@ class Column:
     zero past each length) — see the module docstring for when each is used.
     ``validity`` is a packed uint8 bitmask ``[ceil(num_rows / 8)]`` or None
     (all rows valid).
+
+    **Columns are immutable after construction.**  Every transformation
+    (slice, pad, cast, repartition) builds a NEW Column; nothing may
+    rebind ``data``/``chars``/``chars2d`` in place.  Consumers rely on
+    this: ``ops/get_json.py`` memoizes per-column device readbacks keyed
+    on ``id()`` of the content buffer (a content token that is only
+    stable because buffers never change under a live Column), and
+    ``runtime/shapes.py`` shares those memo dicts between a column and
+    its padded twin.  The only sanctioned ``object.__setattr__`` uses are
+    *append-only caches* (``_gjo_*`` memos, ``_string_tail``) that attach
+    derived state without altering column content.
     """
 
     dtype: DType

@@ -1,0 +1,341 @@
+"""Shape-bucket policy tests (``runtime/shapes.py``).
+
+Three layers:
+
+1. Grid/unit tests — bucket boundaries, env knob, mask builders, and the
+   pad/unpad round trip in isolation.
+2. The compile-count guard: ~20 distinct batch sizes stream through every
+   bucket-wired op while compile telemetry records which programs were
+   built under the op's own span.  The assertion is the PR's acceptance
+   contract: op-span compiles ≤ the bucket count (pad/slice glue compiles
+   land in the dedicated ``shapes.pad``/``shapes.unpad`` spans and are
+   bounded separately).  A second pass over *fresh* sizes that map to the
+   same buckets must add zero op-span compiles.
+3. Bucket-boundary equivalence: k-1/k/k+1 at pow-2 edges, single-row and
+   empty inputs produce element-wise identical results with and without
+   bucketing.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu import obs
+from spark_rapids_jni_tpu.models.pipeline import (
+    hash_aggregate_table, join_inner_table, join_semi_mask_table)
+from spark_rapids_jni_tpu.ops.cast_string import cast_string_to_int
+from spark_rapids_jni_tpu.ops.get_json import get_json_object
+from spark_rapids_jni_tpu.ops.hashing import murmur3_hash, xxhash64
+from spark_rapids_jni_tpu.ops.row_conversion import (
+    convert_from_rows, convert_to_rows)
+from spark_rapids_jni_tpu.runtime import shapes
+from spark_rapids_jni_tpu.table import Column, INT32, Table
+
+
+@pytest.fixture
+def obs_on():
+    obs.configure_sink(None)
+    obs.clear()
+    obs.enable()
+    yield
+    obs.disable()
+    obs.configure_sink(None)
+    obs.clear()
+
+
+# ---------------------------------------------------------------------------
+# Grid / unit layer
+# ---------------------------------------------------------------------------
+
+def test_bucket_rows_pow2_grid():
+    assert shapes.bucket_rows(0) == 8
+    assert shapes.bucket_rows(1) == 8
+    assert shapes.bucket_rows(8) == 8
+    assert shapes.bucket_rows(9) == 16
+    assert shapes.bucket_rows(33) == 64
+    assert shapes.bucket_rows(64) == 64
+    # every size lands on a bucket >= itself, and the map is monotone
+    prev = 0
+    for n in range(1, 200):
+        b = shapes.bucket_rows(n)
+        assert b >= n and b >= prev
+        prev = b
+
+
+def test_bucket_rows_geometric_factor():
+    # walk from 8 by ceil(b * 1.5): 8, 12, 18, 27, ...
+    assert shapes.bucket_rows(9, 1.5) == 12
+    assert shapes.bucket_rows(13, 1.5) == 18
+    assert shapes.bucket_rows(19, 1.5) == 27
+    # a denser factor yields a finer grid: more distinct buckets (less
+    # padding waste) over the same size range
+    fine = {shapes.bucket_rows(n, 1.5) for n in range(1, 100)}
+    coarse = {shapes.bucket_rows(n, 2.0) for n in range(1, 100)}
+    assert len(fine) > len(coarse)
+
+
+def test_bucket_width_grid():
+    assert shapes.bucket_width(0) == 0
+    assert shapes.bucket_width(1) == 4
+    assert shapes.bucket_width(5) == 8
+    for w in range(1, 200):
+        b = shapes.bucket_width(w)
+        assert b >= w and b % 4 == 0
+
+
+def test_factor_env_knob(monkeypatch):
+    cases = {"": 2.0, "auto": 2.0, "off": None, "none": None, "0": None,
+             "1": None, "1.5": 1.5, "3": 3.0, "garbage": 2.0}
+    for raw, want in cases.items():
+        monkeypatch.setenv("SRJ_TPU_SHAPE_BUCKETS", raw)
+        assert shapes.factor() == want, raw
+
+
+def test_resolve_contract(monkeypatch):
+    monkeypatch.delenv("SRJ_TPU_SHAPE_BUCKETS", raising=False)
+    assert shapes.resolve(None) is None
+    assert shapes.resolve("auto") == 2.0  # eager here
+    assert shapes.resolve(1.5) == 1.5
+    assert shapes.resolve(1.0) is None
+    monkeypatch.setenv("SRJ_TPU_SHAPE_BUCKETS", "off")
+    assert shapes.resolve("auto") is None  # process-wide opt-out
+
+
+def test_prefix_mask_packing():
+    m = np.asarray(shapes.prefix_mask(5, 16))
+    assert m.dtype == np.uint8 and m.tolist() == [0x1F, 0x00]
+    assert np.asarray(shapes.prefix_mask(8, 8)).tolist() == [0xFF]
+
+
+def test_pad_mask():
+    m = np.asarray(shapes.pad_mask(None, 3, 8))
+    assert m.tolist() == [True] * 3 + [False] * 5
+    src = jnp.asarray(np.array([True, False, True]))
+    m = np.asarray(shapes.pad_mask(src, 3, 8))
+    assert m.tolist() == [True, False, True] + [False] * 5
+
+
+def test_pad_unpad_round_trip_int():
+    vals = np.arange(11, dtype=np.int32)
+    col = Column.from_numpy(vals, INT32, valid=vals % 3 != 0)
+    b = shapes.bucket_rows(11)
+    padded = shapes.pad_column(col, b)
+    assert padded.num_rows == b
+    # tail rows are invalid -- the correctness contract
+    assert not np.asarray(padded.valid_bools())[11:].any()
+    back = shapes.unpad_column(padded, 11)
+    assert back.to_pylist() == col.to_pylist()
+
+
+def test_pad_unpad_round_trip_strings():
+    vals = ["spark", None, "", "rapids", "tpu"]
+    col = Column.strings_padded(vals)
+    padded = shapes.pad_column(col, 8)
+    assert padded.num_rows == 8
+    assert shapes.unpad_column(padded, 5).to_pylist() == vals
+
+
+def test_pad_table_bucketable():
+    t = Table((Column.from_numpy(np.arange(4, dtype=np.int32), INT32),))
+    assert shapes.bucketable(t)
+    assert shapes.pad_table(t, 8).num_rows == 8
+
+
+# ---------------------------------------------------------------------------
+# Compile-count guard
+# ---------------------------------------------------------------------------
+
+# ~20 distinct sizes spanning buckets {8, 16, 32, 64}
+SIZES = sorted({1, 7} | set(range(3, 57, 3)))
+ROW_BUCKETS = sorted({shapes.bucket_rows(n) for n in SIZES})
+
+
+def _int_table(n, seed=0):
+    r = np.random.default_rng(seed)
+    return Table((
+        Column.from_numpy(r.integers(0, 12, n).astype(np.int32), INT32,
+                          valid=r.random(n) > 0.2),
+        Column.from_numpy(r.integers(-99, 99, n).astype(np.int32), INT32,
+                          valid=r.random(n) > 0.3)))
+
+
+def _num_strings(n):
+    # fixed 3-char content so the Arrow chars buffer is 3n bytes and the
+    # cast guard's (row bucket, chars bucket) program bound is exact
+    return Column.strings_padded(["%03d" % (i % 500) for i in range(n)])
+
+
+def _json_strings(n):
+    return Column.strings_padded(['{"a":%d}' % (i % 9) for i in range(n)])
+
+
+def _op_compiles(name):
+    return [e for e in obs.events("compile") if e.get("span") == name]
+
+
+RUNNERS = {
+    "murmur3_hash": lambda n: murmur3_hash(
+        [_int_table(n, n).columns[0], _num_strings(n)]),
+    "xxhash64": lambda n: xxhash64(
+        [_int_table(n, n).columns[0], _num_strings(n)]),
+    "convert_to_rows": lambda n: convert_to_rows(_int_table(n, n)),
+    "convert_from_rows": lambda n: convert_from_rows(
+        convert_to_rows(_int_table(n, n), bucket=None)[0],
+        _int_table(2, 0).dtypes),
+    "cast_string_to_int": lambda n: cast_string_to_int(
+        _num_strings(n), INT32),
+    "get_json_object": lambda n: get_json_object(_json_strings(n), "$.a"),
+    "hash_aggregate_table": lambda n: hash_aggregate_table(
+        _int_table(n, n), [0], [(None, "count"), (1, "sum"), (1, "avg")], 32),
+    "join_semi_mask_table": lambda n: join_semi_mask_table(
+        _int_table(17, 1), 0, _int_table(n, n), 0),
+    "join_inner_table": lambda n: join_inner_table(
+        _int_table(17, 1), 0, 1, _int_table(n, n), 0, capacity=256),
+}
+
+
+def _bound(name):
+    """Max programs an op may compile over SIZES: one per bucket combo."""
+    if name == "cast_string_to_int":
+        # parses the ragged Arrow layout, so the chars-length bucket is a
+        # second program key (content here is 3 bytes/row, so chars = 3n)
+        return len({(shapes.bucket_rows(n), shapes.bucket_rows(3 * n))
+                    for n in SIZES})
+    return len(ROW_BUCKETS)
+
+
+def test_guard_compiles_bounded_by_buckets(obs_on):
+    """The tentpole acceptance test: N batch sizes -> O(log N) programs."""
+    assert len(SIZES) >= 20
+    for name, run in RUNNERS.items():
+        obs.clear()
+        for n in SIZES:
+            run(n)
+        got = len(_op_compiles(name))
+        assert got <= _bound(name), (
+            f"{name}: {got} op-span compiles for {len(SIZES)} sizes "
+            f"(bound {_bound(name)}, buckets {ROW_BUCKETS})")
+
+
+def test_guard_fresh_sizes_add_zero_compiles(obs_on):
+    """Sizes never seen before, mapping to already-compiled buckets, must
+    hit the jit cache: zero new op-span programs."""
+    for name, run in RUNNERS.items():
+        for n in SIZES:  # warm every bucket (cached if guard test ran)
+            run(n)
+        obs.clear()
+        fresh = sorted({n + 1 for n in SIZES
+                        if shapes.bucket_rows(n + 1) == shapes.bucket_rows(n)})
+        for n in fresh:
+            run(n)
+        got = len(_op_compiles(name))
+        if name == "cast_string_to_int":
+            # a fresh size can land in a new chars-length bucket (3(n+1)
+            # crosses a boundary 3n did not) -- bounded, not zero
+            new_chars = {(shapes.bucket_rows(n), shapes.bucket_rows(3 * n))
+                         for n in fresh} - \
+                        {(shapes.bucket_rows(n), shapes.bucket_rows(3 * n))
+                         for n in SIZES}
+            assert got <= len(new_chars), (name, got)
+        else:
+            assert got == 0, (name, got, [e for e in _op_compiles(name)])
+
+
+def test_span_carries_bucket_attrs(obs_on):
+    murmur3_hash([Column.from_numpy(np.arange(10, dtype=np.int32), INT32)])
+    evs = [e for e in obs.events(kind="span") if e["name"] == "murmur3_hash"]
+    assert evs and evs[-1]["bucket"] == 16
+    assert evs[-1]["padded_rows"] == 6
+
+
+def test_opt_out_no_padding(obs_on):
+    out = murmur3_hash(
+        [Column.from_numpy(np.arange(10, dtype=np.int32), INT32)],
+        bucket=None)
+    assert out.shape[0] == 10
+    evs = [e for e in obs.events(kind="span") if e["name"] == "murmur3_hash"]
+    assert evs and "bucket" not in evs[-1]
+
+
+# ---------------------------------------------------------------------------
+# Bucket-boundary equivalence (k-1 / k / k+1 at pow-2 edges, 1 row, empty)
+# ---------------------------------------------------------------------------
+
+EDGES = [1, 7, 8, 9, 31, 32, 33, 63, 64, 65]
+
+
+@pytest.mark.parametrize("n", EDGES)
+def test_edge_rows_round_trip(n):
+    t = _int_table(n, n)
+    rows = convert_to_rows(t)            # bucketed
+    ref = convert_to_rows(t, bucket=None)
+    assert sum(b.num_rows for b in rows) == n
+    back = convert_from_rows(rows[0], t.dtypes)
+    back_ref = convert_from_rows(ref[0], t.dtypes, bucket=None)
+    for c, cr, orig in zip(back.columns, back_ref.columns, t.columns):
+        assert c.to_pylist() == cr.to_pylist() == orig.to_pylist()
+
+
+@pytest.mark.parametrize("n", EDGES)
+def test_edge_cast_and_hash(n):
+    col = _num_strings(n)
+    a, ea = cast_string_to_int(col, INT32)
+    b, eb = cast_string_to_int(col, INT32, bucket=None)
+    assert a.to_pylist() == b.to_pylist()
+    assert np.array_equal(np.asarray(ea), np.asarray(eb))
+    ints = _int_table(n, n).columns[0]
+    assert np.array_equal(np.asarray(murmur3_hash([ints, col])),
+                          np.asarray(murmur3_hash([ints, col], bucket=None)))
+
+
+@pytest.mark.parametrize("n", [1, 7, 8, 9, 33])
+def test_edge_get_json(n):
+    col = _json_strings(n)
+    a = get_json_object(col, "$.a")
+    b = get_json_object(col, "$.a", bucket=None)
+    assert a.to_pylist() == b.to_pylist()
+
+
+@pytest.mark.parametrize("n", [1, 7, 8, 9, 33])
+def test_edge_aggregate_and_join(n):
+    t = _int_table(n, n)
+    ga, ha, nga = hash_aggregate_table(
+        t, [0], [(None, "count"), (1, "sum")], 32)
+    gb, hb, ngb = hash_aggregate_table(
+        t, [0], [(None, "count"), (1, "sum")], 32, bucket=None)
+    assert int(nga) == int(ngb)
+    for ca, cb in zip(ga.columns, gb.columns):
+        assert ca.to_pylist() == cb.to_pylist()
+    assert np.array_equal(np.asarray(ha), np.asarray(hb))
+
+    build = _int_table(17, 1)
+    ma = join_semi_mask_table(build, 0, t, 0)
+    mb = join_semi_mask_table(build, 0, t, 0, bucket=None)
+    assert np.array_equal(np.asarray(ma), np.asarray(mb))
+
+    # (probe_idx, payload, payload_valid, slot_valid, total, overflow)
+    ja = join_inner_table(build, 0, 1, t, 0, capacity=256)
+    jb = join_inner_table(build, 0, 1, t, 0, capacity=256, bucket=None)
+    va, vb = np.asarray(ja[3]), np.asarray(jb[3])
+    assert np.array_equal(va, vb)
+    # slot content only matters where the slot is live
+    assert np.array_equal(np.asarray(ja[0])[va], np.asarray(jb[0])[vb])
+    assert np.array_equal(np.asarray(ja[1])[va], np.asarray(jb[1])[vb])
+    assert np.array_equal(np.asarray(ja[2]), np.asarray(jb[2]))
+    assert int(ja[4]) == int(jb[4]) and bool(ja[5]) == bool(jb[5])
+
+
+def test_empty_inputs_match_unbucketed():
+    empty = Table((Column.from_numpy(np.zeros(0, np.int32), INT32),))
+    estr = Column.strings_padded([])
+    assert np.asarray(murmur3_hash([empty.columns[0]])).shape == (0,)
+    assert np.array_equal(
+        np.asarray(murmur3_hash([empty.columns[0]])),
+        np.asarray(murmur3_hash([empty.columns[0]], bucket=None)))
+    a, _ = cast_string_to_int(estr, INT32)
+    b, _ = cast_string_to_int(estr, INT32, bucket=None)
+    assert a.to_pylist() == b.to_pylist() == []
+    rows = convert_to_rows(empty)
+    ref = convert_to_rows(empty, bucket=None)
+    assert sum(b.num_rows for b in rows) == sum(b.num_rows for b in ref) == 0
