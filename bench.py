@@ -565,6 +565,140 @@ def bench_ragged(num_batches):
     return res
 
 
+def _count_boundary_dispatches(fn):
+    """Run ``fn`` once counting host->device boundary crossings: explicit
+    ``jax.device_put`` calls plus ``jnp.asarray`` calls handed a numpy
+    array (the dispatch the per-column ingest pays per buffer).  The
+    staged path late-binds ``jax.device_put`` exactly so interposers
+    like this observe its single transfer."""
+    counts = {"n": 0}
+    real_put, real_asarray = jax.device_put, jnp.asarray
+
+    def put(*a, **kw):
+        counts["n"] += 1
+        return real_put(*a, **kw)
+
+    def asarray(x, *a, **kw):
+        if isinstance(x, np.ndarray):
+            counts["n"] += 1
+        return real_asarray(x, *a, **kw)
+
+    jax.device_put, jnp.asarray = put, asarray
+    try:
+        out = fn()
+    finally:
+        jax.device_put, jnp.asarray = real_put, real_asarray
+    return counts["n"], out
+
+
+def _with_staging(value, fn):
+    """Call ``fn`` with SRJ_TPU_STAGING pinned to ``value``."""
+    old = os.environ.get("SRJ_TPU_STAGING")
+    os.environ["SRJ_TPU_STAGING"] = value
+    try:
+        return fn()
+    finally:
+        if old is None:
+            os.environ.pop("SRJ_TPU_STAGING", None)
+        else:
+            os.environ["SRJ_TPU_STAGING"] = old
+
+
+def bench_transfer(num_rows):
+    """Staged vs per-column ingest on the bench's two schema widths:
+    H2D wall time and boundary transfer count for the 212-column fixed
+    table and the 155-column (25 string) mixed table, staging on vs
+    ``SRJ_TPU_STAGING=0``.
+
+    Rows are capped well below the conversion axes (the leg measures
+    dispatch overhead and transfer coalescing, which are per-BUFFER
+    costs, not bulk bandwidth — the to_rows/from_rows legs own that),
+    so the per-column fallback's 400+ dispatches per iteration stay
+    comfortably inside the axis timeout."""
+    from spark_rapids_jni_tpu import Table
+
+    rng = np.random.default_rng(42)
+
+    def _host_values(n, dt):
+        npdt = dt.np_dtype
+        if npdt.kind == "f":
+            return rng.random(n).astype(npdt)
+        if dt.kind == "bool8":
+            return rng.integers(0, 2, n).astype(npdt)
+        return rng.integers(0, 1000, n).astype(npdt)
+
+    res = {}
+    leg_errors = {}
+
+    # -- fixed 212-col axis (numpy ingest) --------------------------------
+    n = min(num_rows, 65536)
+    dtypes = cycle_dtypes(FIXED_DTYPES, 212)
+    arrays = [_host_values(n, dt) for dt in dtypes]
+    valids = [rng.random(n) < 0.9 if i % 4 == 0 else None
+              for i in range(len(dtypes))]
+
+    def _fixed():
+        return Table.from_numpy(arrays, dtypes, valids)
+
+    staged_xfers, t = _count_boundary_dispatches(_fixed)
+    percol_xfers, _ = _with_staging(
+        "0", lambda: _count_boundary_dispatches(_fixed))
+    h2d = _table_bytes(t)
+    t_staged = _leg("ingest_staged_212col", _fixed, leg_errors, iters=8,
+                    label=f"ingest_staged_212col[{n}]")
+    t_percol = _leg(
+        "ingest_per_column_212col",
+        lambda: _with_staging("0", _fixed), leg_errors, iters=8,
+        label=f"ingest_per_column_212col[{n}]")
+    res["fixed"] = {
+        "num_rows": n, "num_cols": 212, "h2d_bytes": h2d,
+        "staged_transfers": staged_xfers,
+        "per_column_transfers": percol_xfers,
+    }
+    if t_staged is not None:
+        res["fixed"]["staged_s"] = t_staged
+        res["fixed"]["staged_GBps"] = h2d / t_staged / 1e9
+    if t_staged is not None and t_percol is not None:
+        res["fixed"]["per_column_s"] = t_percol
+        res["fixed"]["staged_speedup"] = t_percol / t_staged
+
+    # -- variable 155-col axis (25 string columns, pylist ingest) ---------
+    nv = min(num_rows, 8192)
+    var_dtypes = cycle_dtypes(FIXED_DTYPES, 130) + [STRING] * 25
+    sval = np.array(["", "spark", "tpu-rapids", "x" * 31], dtype=object)
+    cols = [(sval[rng.integers(0, len(sval), nv)].tolist()
+             if dt is STRING else _host_values(nv, dt).tolist())
+            for dt in var_dtypes]
+
+    def _variable():
+        return Table.from_pylist(cols, var_dtypes)
+
+    vstaged_xfers, vt = _count_boundary_dispatches(_variable)
+    vpercol_xfers, _ = _with_staging(
+        "0", lambda: _count_boundary_dispatches(_variable))
+    vh2d = _table_bytes(vt)
+    vt_staged = _leg("ingest_staged_155col", _variable, leg_errors,
+                     iters=6, label=f"ingest_staged_155col[{nv}]")
+    vt_percol = _leg(
+        "ingest_per_column_155col",
+        lambda: _with_staging("0", _variable), leg_errors, iters=6,
+        label=f"ingest_per_column_155col[{nv}]")
+    res["variable"] = {
+        "num_rows": nv, "num_cols": 155, "h2d_bytes": vh2d,
+        "staged_transfers": vstaged_xfers,
+        "per_column_transfers": vpercol_xfers,
+    }
+    if vt_staged is not None:
+        res["variable"]["staged_s"] = vt_staged
+        res["variable"]["staged_GBps"] = vh2d / vt_staged / 1e9
+    if vt_staged is not None and vt_percol is not None:
+        res["variable"]["per_column_s"] = vt_percol
+        res["variable"]["staged_speedup"] = vt_percol / vt_staged
+    if leg_errors:
+        res["leg_errors"] = leg_errors
+    return res
+
+
 def _obs_axis_summary():
     """Compact per-op obs digest of this axis process — every leg span
     (including failed ones, which carry ``error_types``) plus the XLA
@@ -599,6 +733,8 @@ def _run_axis(axis: str):
             res = bench_ragged(int(n))
         elif kind == "fixed":
             res = bench_fixed(int(n))
+        elif kind == "transfer":
+            res = bench_transfer(int(n))
         elif kind == "nostrings":
             res = bench_variable(int(n), with_strings=False)
         elif kind == "skewed":
@@ -871,6 +1007,12 @@ def main():
     for n in row_axes:
         _run("fixed_width", f"fixed:{n}",
              post=lambda out, n=n: out.setdefault("num_rows", n))
+
+    # staged vs per-column ingest (one coalesced transfer per table vs
+    # one dispatch per buffer) on the 212/155-col schemas; rows capped
+    # inside the axis.  Runs under --quick too — the transfer-leg
+    # numbers guard the staging path's perf claim directly
+    _run("transfer_staging", f"transfer:{row_axes[0]}")
 
     if not args.quick:
         # the reference's mixed axes: 155 cols with strings at 1M rows

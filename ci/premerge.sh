@@ -55,3 +55,28 @@ print(f"shape smoke: {compiles} op-span compiles for {len(sizes)} sizes "
 sys.exit(0 if 0 < compiles <= bound else 1)
 PY
 rm -f "$SHAPE_EVENTS"
+
+# staging smoke: ingest a WIDE table (212 int32 columns, the bench's
+# widest axis) under the JSONL sink and fail unless the whole table
+# crossed the host->device boundary as exactly ONE staged transfer —
+# the end-to-end version of tests/test_staging.py's transfer-count guard
+STAGING_EVENTS=$(mktemp /tmp/srj_staging_smoke.XXXXXX.jsonl)
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu SRJ_TPU_EVENTS="$STAGING_EVENTS" \
+  python -c "
+import numpy as np
+from spark_rapids_jni_tpu import INT32, Table
+cols = 212
+t = Table.from_numpy([np.arange(64, dtype=np.int32)] * cols, [INT32] * cols)
+assert t.num_columns == cols
+"
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python - "$STAGING_EVENTS" <<'PY'
+import json, sys
+h2d = [e for line in open(sys.argv[1]) for e in [json.loads(line)]
+       if e.get("kind") == "span" and e.get("name") == "staging.h2d"]
+transfers = sum(e.get("transfer_count", 0) for e in h2d)
+print(f"staging smoke: {transfers} H2D transfer(s) for a 212-column "
+      f"ingest ({sum(e.get('h2d_bytes', 0) for e in h2d)} bytes)")
+sys.exit(0 if transfers == 1 else 1)
+PY
+rm -f "$STAGING_EVENTS"

@@ -34,6 +34,7 @@ from spark_rapids_jni_tpu.table import Column, Table
 from spark_rapids_jni_tpu.obs import span_fn
 from spark_rapids_jni_tpu.ops.hashing import murmur3_hash, pmod
 from spark_rapids_jni_tpu.runtime import shapes
+from spark_rapids_jni_tpu.runtime import staging
 
 
 # ---------------------------------------------------------------------------
@@ -705,6 +706,10 @@ def hash_aggregate_table(source, key_idxs: Sequence[int],
     (truncated bytes would merge distinct values).
     """
     from spark_rapids_jni_tpu.table import pack_bools, INT32
+    if isinstance(source, Table):
+        # numpy-backed sources promote to device in ONE staged transfer
+        # instead of one implicit asarray per leaf at first use
+        source = staging.ensure_staged(source)
     n = _source_num_rows(source)
     # shape-bucket the source rows (runtime/shapes.py): results are
     # [max_groups]-shaped already, so only the input pads — the padded
@@ -1709,6 +1714,10 @@ def join_semi_mask_table(build, build_key: int, probe,
     null sentinel, padded probe rows are invalid so their mask bit is
     False) and run one jitted program per bucket pair; the mask slices
     back to the probe's true row count."""
+    if isinstance(build, Table):
+        build = staging.ensure_staged(build)
+    if isinstance(probe, Table):
+        probe = staging.ensure_staged(probe)
     f = shapes.resolve(bucket)
     if (f is not None and _join_tables_bucketable(build, probe)
             and build.num_rows > 0 and probe.num_rows > 0):
@@ -1757,6 +1766,10 @@ def join_inner_table(build, build_key: int, build_payload: int,
     sides and emit no matches.  ``probe_idx`` is re-clamped to the true
     probe row count so dead-slot indices stay gatherable against the
     caller's unpadded probe columns."""
+    if isinstance(build, Table):
+        build = staging.ensure_staged(build)
+    if isinstance(probe, Table):
+        probe = staging.ensure_staged(probe)
     f = shapes.resolve(bucket)
     if (f is not None and _join_tables_bucketable(build, probe)
             and build.num_rows > 0 and probe.num_rows > 0):

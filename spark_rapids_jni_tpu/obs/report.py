@@ -62,7 +62,8 @@ def summarize(events: Iterable[Dict]) -> Dict:
         if kind == "span":
             s = ops.setdefault(ev.get("name", "?"), {
                 "calls": 0, "failures": 0, "wall": [], "device_s": 0.0,
-                "rows": 0, "bytes": 0, "compiles": 0, "compile_s": 0.0,
+                "rows": 0, "bytes": 0, "h2d_bytes": 0, "d2h_bytes": 0,
+                "transfer_count": 0, "compiles": 0, "compile_s": 0.0,
                 "error_types": {}})
             s["calls"] += 1
             if ev.get("status") == "error":
@@ -73,7 +74,8 @@ def summarize(events: Iterable[Dict]) -> Dict:
                 s["wall"].append(float(ev["wall_s"]))
             if isinstance(ev.get("device_s"), (int, float)):
                 s["device_s"] += float(ev["device_s"])
-            for key in ("rows", "bytes"):
+            for key in ("rows", "bytes", "h2d_bytes", "d2h_bytes",
+                        "transfer_count"):
                 if isinstance(ev.get(key), (int, float)):
                     s[key] += int(ev[key])
             if isinstance(ev.get("compiles"), int):
@@ -106,7 +108,8 @@ def format_table(summary: Dict) -> str:
     """Fixed-width per-op table plus fault/compile footer lines."""
     lines = [f"{'op':<36} {'calls':>6} {'fail':>5} {'p50_ms':>10} "
              f"{'p95_ms':>10} {'device_ms':>10} {'rows':>12} "
-             f"{'bytes':>14} {'compiles':>8} {'compile_s':>9}"]
+             f"{'bytes':>14} {'h2d_bytes':>12} {'d2h_bytes':>12} "
+             f"{'xfers':>6} {'compiles':>8} {'compile_s':>9}"]
     lines.append("-" * len(lines[0]))
     for name in sorted(summary["ops"]):
         s = summary["ops"][name]
@@ -114,7 +117,9 @@ def format_table(summary: Dict) -> str:
             f"{name:<36} {s['calls']:>6} {s['failures']:>5} "
             f"{_ms(s['wall_p50_s']):>10} {_ms(s['wall_p95_s']):>10} "
             f"{_ms(s['device_s'] or None):>10} {s['rows']:>12} "
-            f"{s['bytes']:>14} {s['compiles']:>8} {s['compile_s']:>9.2f}")
+            f"{s['bytes']:>14} {s.get('h2d_bytes', 0):>12} "
+            f"{s.get('d2h_bytes', 0):>12} {s.get('transfer_count', 0):>6} "
+            f"{s['compiles']:>8} {s['compile_s']:>9.2f}")
     errs = {name: s["error_types"] for name, s in summary["ops"].items()
             if s["error_types"]}
     if errs:
@@ -182,6 +187,21 @@ def format_prometheus(summary: Dict) -> str:
            per_op(lambda n, s:
                   f'srj_tpu_span_bytes_total{{op="{_label(n)}"}} '
                   f'{s["bytes"]}'))
+    metric("srj_tpu_span_h2d_bytes_total",
+           "Host-to-device bytes staged per op.",
+           per_op(lambda n, s:
+                  f'srj_tpu_span_h2d_bytes_total{{op="{_label(n)}"}} '
+                  f'{s.get("h2d_bytes", 0)}'))
+    metric("srj_tpu_span_d2h_bytes_total",
+           "Device-to-host bytes fetched per op.",
+           per_op(lambda n, s:
+                  f'srj_tpu_span_d2h_bytes_total{{op="{_label(n)}"}} '
+                  f'{s.get("d2h_bytes", 0)}'))
+    metric("srj_tpu_span_transfers_total",
+           "Host/device boundary transfers per op.",
+           per_op(lambda n, s:
+                  f'srj_tpu_span_transfers_total{{op="{_label(n)}"}} '
+                  f'{s.get("transfer_count", 0)}'))
     metric("srj_tpu_span_xla_compiles_total",
            "XLA backend compiles attributed per op.",
            per_op(lambda n, s:

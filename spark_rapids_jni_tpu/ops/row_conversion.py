@@ -49,6 +49,7 @@ from spark_rapids_jni_tpu.utils.tracing import func_range
 from spark_rapids_jni_tpu.utils import metrics
 from spark_rapids_jni_tpu.obs import span_fn
 from spark_rapids_jni_tpu.runtime import shapes
+from spark_rapids_jni_tpu.runtime import staging
 
 
 # ---------------------------------------------------------------------------
@@ -441,12 +442,15 @@ def _trim_row_batches(batches: List[RowsColumn], n: int
 
 def _pad_rows_blob(bc: RowsColumn, b: int, rs: int) -> RowsColumn:
     """Pad a row blob to ``b`` rows of zeros (zero validity bytes decode
-    as all-null rows, which the post-decode slice then drops)."""
+    as all-null rows, which the post-decode slice then drops).  The pad
+    runs through the donated fill (``shapes.pad_to``): the bucketed blob
+    is written into a donated scratch, so padding never holds two copies
+    of the row bytes."""
     n = bc.num_rows
     if bc.data.ndim == 2:
-        data = jnp.pad(bc.data, ((0, b - n), (0, 0)))
+        data = shapes.pad_to(bc.data, (b, bc.data.shape[1]))
     else:
-        data = jnp.pad(bc.data, (0, (b - n) * rs))
+        data = shapes.pad_to(bc.data, (b * rs,))
     offsets = jnp.asarray(np.arange(b + 1, dtype=np.int32) * rs)
     return RowsColumn(data, offsets, bc.row_size, bc.str_widths)
 
@@ -1021,11 +1025,15 @@ def _to_rows_variable(table: Table, layout: RowLayout,
         # path (host boundary conversion; all-padded tables never get here)
         table = Table(tuple(c.to_arrow() if c.dtype.is_string else c
                             for c in table.columns))
-    row_sizes = np.asarray(_row_sizes_jit(table, layout))  # host sync (as ref)
+    scol = _string_cols(table)
+    # host sync for batch planning (as ref): row sizes + every string
+    # column's offsets come back in ONE staged D2H instead of 1 + nscol
+    # separate fetches
+    fetched = staging.fetch_arrays(
+        [_row_sizes_jit(table, layout)] + [c.offsets for c in scol])
+    row_sizes, scol_offsets_np = fetched[0], fetched[1:]
     batches = plan_variable_batches(row_sizes, size_limit)
     out = []
-    scol = _string_cols(table)
-    scol_offsets_np = [np.asarray(c.offsets) for c in scol]
     for start, end in batches:
         sizes = row_sizes[start:end]
         offsets = np.zeros(end - start + 1, dtype=np.int32)

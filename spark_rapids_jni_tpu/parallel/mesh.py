@@ -14,6 +14,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from spark_rapids_jni_tpu.runtime import staging
 from spark_rapids_jni_tpu.table import Column, Table
 
 
@@ -62,6 +63,16 @@ def shard_table(table: Table, mesh: Mesh, axis_name: str = "data") -> Table:
         raise ValueError(
             f"num_rows ({table.num_rows}) must be a multiple of 8x axis size "
             f"({naxis}) so packed validity bitmasks shard on byte boundaries")
+    for c in table.columns:
+        if c.dtype.is_string and not c.is_padded:
+            raise ValueError(
+                "shard_table requires dense-padded string columns "
+                "(Column.to_padded / strings_padded)")
+    if staging.enabled() and len(mesh.shape) == 1 \
+            and not any(c.children for c in table.columns):
+        # coalesced placement: one contiguous sub-blob transfer per mesh
+        # device for the WHOLE table (vs one device_put per column here)
+        return staging.shard_table_staged(table, mesh, axis_name)
     spec = NamedSharding(mesh, P(axis_name))
     vspec = NamedSharding(mesh, P(axis_name))
     cols = []

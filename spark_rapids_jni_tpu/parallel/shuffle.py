@@ -451,3 +451,17 @@ def decode_shuffle_result(result: ShuffleResult, dtypes,
             cols.append(Column(dt, fixed_datas[fi], masks[i]))
             fi += 1
     return Table(tuple(cols))
+
+
+def fetch_shuffle_result(result: ShuffleResult):
+    """Host images of a shuffle result's device leaves — rows blob, slot
+    mask, per-device valid counts, overflow flag — in ONE staged D2H
+    (``runtime.staging.fetch_arrays``) instead of four separate
+    ``np.asarray`` round trips.  This is the decode-side host boundary
+    for wire emission / debugging; device-side consumers should keep
+    using :func:`decode_shuffle_result`."""
+    from spark_rapids_jni_tpu.runtime import staging
+    rows, row_valid, num_valid, overflow = staging.fetch_arrays(
+        [result.rows, result.row_valid, result.num_valid,
+         result.overflow])
+    return rows, row_valid, num_valid, overflow

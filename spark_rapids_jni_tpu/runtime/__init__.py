@@ -1,5 +1,8 @@
 """Runtime policies that sit between operator entry points and their
-jitted kernels — currently the shape-bucketing policy
-(:mod:`~spark_rapids_jni_tpu.runtime.shapes`)."""
+jitted kernels — the shape-bucketing policy
+(:mod:`~spark_rapids_jni_tpu.runtime.shapes`) and the coalesced
+host↔device transfer layer
+(:mod:`~spark_rapids_jni_tpu.runtime.staging`)."""
 
 from spark_rapids_jni_tpu.runtime import shapes  # noqa: F401
+from spark_rapids_jni_tpu.runtime import staging  # noqa: F401

@@ -42,6 +42,7 @@ import math
 import os
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -132,6 +133,32 @@ def prefix_mask(n: int, b: int) -> jnp.ndarray:
     return jnp.asarray(buf)
 
 
+# donated zero-pad: write ``src`` into a fresh zeros scratch through a
+# program that DONATES the scratch, so the output aliases it (XLA
+# input-output aliasing needs an exactly matching aval, which the
+# scratch/output pair has).  Bucketed padding then allocates exactly one
+# padded buffer — no concat/pad temp doubling device residency while
+# both live.  The staging donation test pins the contract down by
+# asserting the scratch is consumed (``.is_deleted()``).
+_donated_fill = jax.jit(
+    lambda dst, src: jax.lax.dynamic_update_slice(
+        dst, src, (0,) * dst.ndim),
+    donate_argnums=(0,))
+
+
+def pad_to(arr, shape) -> jnp.ndarray:
+    """Zero-pad ``arr`` up to ``shape`` (elementwise ≥) via the donated
+    fill.  Under a trace (no real buffers to donate) falls back to
+    ``jnp.pad``; returns ``arr`` unchanged when already at ``shape``."""
+    shape = tuple(shape)
+    if tuple(arr.shape) == shape:
+        return arr
+    if isinstance(arr, jax.core.Tracer):
+        return jnp.pad(arr, [(0, b - s) for s, b in zip(arr.shape, shape)])
+    dst = jnp.zeros(shape, arr.dtype)
+    return _donated_fill(dst, arr)
+
+
 def _pad_validity(validity, n: int, b: int) -> jnp.ndarray:
     if validity is None:
         return prefix_mask(n, b)
@@ -139,15 +166,15 @@ def _pad_validity(validity, n: int, b: int) -> jnp.ndarray:
     if pad <= 0:
         return validity
     # bits past n in the last byte are already 0 (pack_bools zero-pads),
-    # so appending zero bytes marks every tail row invalid
-    return jnp.concatenate([validity, jnp.zeros((pad,), jnp.uint8)])
+    # so a zero-byte tail marks every padded row invalid
+    return pad_to(validity, ((b + 7) // 8,))
 
 
 def _pad_axis0(arr, b: int):
     n = arr.shape[0]
     if n == b:
         return arr
-    return jnp.pad(arr, ((0, b - n),) + ((0, 0),) * (arr.ndim - 1))
+    return pad_to(arr, (b,) + arr.shape[1:])
 
 
 def pad_mask(mask, n: int, b: int) -> jnp.ndarray:
@@ -159,7 +186,7 @@ def pad_mask(mask, n: int, b: int) -> jnp.ndarray:
         return jnp.asarray(np.arange(b) < n)
     if b == n:
         return mask
-    return jnp.concatenate([mask, jnp.zeros((b - n,), jnp.bool_)])
+    return pad_to(mask, (b,))
 
 
 def bucketable(obj) -> bool:
@@ -193,19 +220,15 @@ def pad_column(col: Column, b: int, *, width: Optional[int] = None
                 [offsets, jnp.broadcast_to(offsets[-1:], (b - n,))])
         chars = col.chars
         if chars is not None and chars.shape[0]:
-            cb = bucket_rows(chars.shape[0])
-            if cb > chars.shape[0]:
-                chars = jnp.pad(chars, (0, cb - chars.shape[0]))
+            chars = pad_to(chars, (bucket_rows(chars.shape[0]),))
         chars2d = col.chars2d
         if chars2d is not None:
             w = chars2d.shape[1] if width is None \
                 else max(width, chars2d.shape[1])
-            if b > n or w > chars2d.shape[1]:
-                chars2d = jnp.pad(
-                    chars2d, ((0, b - n), (0, w - chars2d.shape[1])))
+            chars2d = pad_to(chars2d, (b, w))
         lens = col.lens
         if lens is not None and b > n:
-            lens = jnp.pad(lens, (0, b - n))
+            lens = pad_to(lens, (b,))
         out = Column(col.dtype, col.data, validity, offsets, chars,
                      chars2d, lens, capped=col.capped)
         tail = string_tail(col)
@@ -213,7 +236,7 @@ def pad_column(col: Column, b: int, *, width: Optional[int] = None
             attach_string_tail(out, tail)
         return out
     if col.data.ndim == 2 and col.dtype.itemsize == 8:
-        data = jnp.pad(col.data, ((0, 0), (0, b - n)))  # [2, n] planes
+        data = pad_to(col.data, (2, b))  # [2, n] planes
     else:
         data = _pad_axis0(col.data, b)  # [n] or [n, 4] limbs
     return Column(col.dtype, data, validity)

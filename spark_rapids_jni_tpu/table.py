@@ -334,25 +334,38 @@ class Column:
 
     @staticmethod
     def _encode_strings(values: Sequence[Optional[str]]):
-        enc = [(s.encode("utf-8") if s is not None else b"") for s in values]
-        lens = np.fromiter((len(b) for b in enc), dtype=np.int32,
-                           count=len(enc))
-        offsets = np.zeros(len(enc) + 1, dtype=np.int32)
+        """Host-side bulk encode: flat utf-8 chars, int32 lens/offsets
+        and a packed validity mask, all numpy.  One joined encode (for
+        ASCII data, len(str) == byte length, so no per-row bytes object
+        is ever created) instead of one ``encode()`` call per row."""
+        vals = ["" if s is None else s for s in values]
+        joined = "".join(vals)
+        if joined.isascii():
+            chars = np.frombuffer(joined.encode("ascii"), dtype=np.uint8)
+            lens = np.fromiter(map(len, vals), dtype=np.int32,
+                               count=len(vals))
+        else:
+            enc = [s.encode("utf-8") for s in vals]
+            chars = np.frombuffer(b"".join(enc), dtype=np.uint8)
+            lens = np.fromiter(map(len, enc), dtype=np.int32,
+                               count=len(enc))
+        offsets = np.zeros(len(vals) + 1, dtype=np.int32)
         np.cumsum(lens, out=offsets[1:])
         validity = None
         if any(s is None for s in values):
             valid = np.fromiter((s is not None for s in values), dtype=bool,
                                 count=len(values))
-            validity = pack_bools(jnp.asarray(valid))
-        return enc, lens, offsets, validity
+            validity = np.packbits(valid, bitorder="little")
+        return chars, lens, offsets, validity
 
     @staticmethod
     def strings(values: Sequence[Optional[str]]) -> "Column":
         """Build an Arrow-layout string column from Python strings
         (None => null)."""
-        enc, lens, offsets, validity = Column._encode_strings(values)
-        chars = np.frombuffer(b"".join(enc), dtype=np.uint8).copy()
-        return Column(STRING, jnp.zeros((0,), jnp.uint8), validity,
+        chars, lens, offsets, validity = Column._encode_strings(values)
+        return Column(STRING, jnp.zeros((0,), jnp.uint8),
+                      jnp.asarray(validity) if validity is not None
+                      else None,
                       jnp.asarray(offsets), jnp.asarray(chars))
 
     @staticmethod
@@ -405,18 +418,23 @@ class Column:
         the full bytes live in a host-side tail (see
         :func:`string_tail`) that boundary consumers (``to_arrow``,
         ``to_pylist``, ``compact_rows_host``, hashing) patch from."""
-        enc, lens, offsets, validity = Column._encode_strings(values)
+        chars, lens, offsets, validity = Column._encode_strings(values)
         W = _padded_width(int(lens.max()) if len(lens) else 0, pad_to)
         W, tail_rows = _apply_width_cap(lens, W, width_cap)
-        mat = np.zeros((len(enc), W), np.uint8)
-        tail = {}
-        for i, b in enumerate(enc):
-            mat[i, :min(len(b), W)] = np.frombuffer(b, np.uint8)[:W]
-            if len(b) > W:
-                tail[i] = b
-        col = Column(STRING, jnp.zeros((0,), jnp.uint8), validity,
+        offs64 = offsets.astype(np.int64)
+        mat = np.zeros((len(lens), W), np.uint8)
+        if chars.size and W:
+            # vectorized ragged->padded scatter (see ``to_padded``): the
+            # first W bytes of each row land at row*W + intra
+            rows, intra = ragged_positions(np.minimum(lens, W))
+            mat.reshape(-1)[rows * W + intra] = chars[offs64[rows] + intra]
+        col = Column(STRING, jnp.zeros((0,), jnp.uint8),
+                     jnp.asarray(validity) if validity is not None
+                     else None,
                      jnp.asarray(offsets), None, jnp.asarray(mat))
-        if tail:
+        if len(tail_rows):
+            tail = {int(r): bytes(chars[offs64[r]:offs64[r + 1]])
+                    for r in tail_rows}
             attach_string_tail(col, tail)
         return col
 
@@ -497,7 +515,7 @@ class Column:
         if not self.dtype.is_string or not self.is_padded:
             return self
         mat = np.asarray(self.chars2d)
-        lens = np.asarray(self.str_lens())
+        lens = _host_str_lens(self)
         W = mat.shape[1]
         tail = _require_string_tail(self, lens, W)
         capped = np.minimum(lens, W)
@@ -547,7 +565,7 @@ class Column:
 
     def to_pylist(self):
         n = self.num_rows
-        valid = np.asarray(self.valid_bools())
+        valid = _host_valid_bools(self)
         if self.dtype.is_list:
             offs = np.asarray(self.offsets)
             child = self.children[0].to_pylist()
@@ -560,7 +578,7 @@ class Column:
         if self.dtype.is_string:
             if self.is_padded:
                 mat = np.asarray(self.chars2d)
-                lens = np.asarray(self.str_lens())
+                lens = _host_str_lens(self)
                 tail = _require_string_tail(self, lens, mat.shape[1]) \
                     or {}
                 return [(tail[i].decode("utf-8") if i in tail
@@ -592,6 +610,38 @@ class Column:
         else:  # pre-capped-flag pytrees
             dtype, capped = aux, False
         return cls(dtype, *children, capped=capped)
+
+
+def _host_valid_bools(col: "Column") -> np.ndarray:
+    """Host bool[n] validity without touching the device: numpy unpack of
+    the packed mask (works when ``validity`` is numpy — e.g. a table
+    fetched by ``runtime.staging`` — at the cost of one D2H when not)."""
+    if col.validity is None:
+        return np.ones((col.num_rows,), bool)
+    mask = np.asarray(col.validity)
+    return np.unpackbits(mask, bitorder="little")[:col.num_rows] \
+        .astype(bool)
+
+
+def _host_str_lens(col: "Column") -> np.ndarray:
+    """Host int32[n] string lengths (numpy twin of ``str_lens``)."""
+    if col.lens is not None:
+        return np.asarray(col.lens).astype(np.int32)
+    offs = np.asarray(col.offsets).astype(np.int32)
+    return offs[1:] - offs[:-1]
+
+
+def _host_fixed_data(values, dtype: DType) -> np.ndarray:
+    """Host image of a fixed-width column's ``data`` leaf: native numpy,
+    except [2, n] uint32 plane pairs for 64-bit types without x64 and
+    [n, 4] uint32 limbs passed through for decimal128 (which has no
+    native numpy dtype)."""
+    if dtype.kind == "decimal128":
+        return np.ascontiguousarray(np.asarray(values, np.uint32))
+    vals = np.ascontiguousarray(np.asarray(values, dtype=dtype.np_dtype))
+    if dtype.itemsize == 8 and not jax.config.jax_enable_x64:
+        return pair_from_np64(vals)
+    return vals
 
 
 def _column_from_python(values, dtype: DType) -> "Column":
@@ -789,8 +839,97 @@ class Table:
     def column(self, i: int) -> Column:
         return self.columns[i]
 
+    @staticmethod
+    def from_numpy(arrays: Sequence[np.ndarray], dtypes: Sequence[DType],
+                   valids: Optional[Sequence] = None) -> "Table":
+        """Build a device table from host numpy columns.
+
+        With staging enabled (the default) every column's buffers pack
+        into one contiguous blob and the whole table ships with a SINGLE
+        ``jax.device_put`` — the coalesced-ingest entry point the
+        transfer-count guard pins down.  ``SRJ_TPU_STAGING=0`` falls
+        back to one transfer per column (``Column.from_numpy``).
+        ``valids``: optional per-column bool arrays (None = all valid).
+        """
+        from spark_rapids_jni_tpu.runtime import staging
+        arrays = list(arrays)
+        dtypes = list(dtypes)
+        valids = list(valids) if valids is not None \
+            else [None] * len(arrays)
+        if not staging.enabled():
+            cols = []
+            for a, dt, v in zip(arrays, dtypes, valids):
+                if dt.kind == "decimal128":
+                    # no native numpy dtype: [n, 4] uint32 limbs pass
+                    # through (Column.from_numpy would KeyError)
+                    validity = None
+                    if v is not None:
+                        validity = jnp.asarray(np.packbits(
+                            np.asarray(v, bool), bitorder="little"))
+                    cols.append(Column(dt, jnp.asarray(
+                        _host_fixed_data(a, dt)), validity))
+                else:
+                    cols.append(Column.from_numpy(a, dt, v))
+            return Table(tuple(cols))
+        host = []
+        for a, dt, v in zip(arrays, dtypes, valids):
+            validity = None
+            if v is not None:
+                validity = np.packbits(np.asarray(v, bool),
+                                       bitorder="little")
+            host.append(staging.HostColumn(
+                dt, data=_host_fixed_data(a, dt), validity=validity))
+        return staging.ingest_table(host)
+
+    @staticmethod
+    def from_pylist(columns: Sequence[Sequence],
+                    dtypes: Sequence[DType]) -> "Table":
+        """Build a device table from per-column Python value lists
+        (None => null).
+
+        With staging enabled all flat (fixed-width / string) columns
+        encode on the host and ship as ONE transfer; nested columns use
+        the recursive per-column builder.  ``SRJ_TPU_STAGING=0`` reverts
+        entirely to the per-column path."""
+        from spark_rapids_jni_tpu.runtime import staging
+        if not staging.enabled():
+            return Table(tuple(_column_from_python(v, dt)
+                               for v, dt in zip(columns, dtypes)))
+        out = [None] * len(dtypes)
+        host, flat_idx = [], []
+        for i, (v, dt) in enumerate(zip(columns, dtypes)):
+            if dt.is_nested:
+                out[i] = _column_from_python(v, dt)
+                continue
+            if dt.is_string:
+                chars, _, offsets, validity = Column._encode_strings(v)
+                host.append(staging.HostColumn(
+                    dt, validity=validity, offsets=offsets, chars=chars))
+            else:
+                validity = None
+                if any(x is None for x in v):
+                    valid = np.fromiter((x is not None for x in v), bool,
+                                        count=len(v))
+                    validity = np.packbits(valid, bitorder="little")
+                vals = np.asarray([0 if x is None else x for x in v],
+                                  dtype=dt.np_dtype)
+                host.append(staging.HostColumn(
+                    dt, data=_host_fixed_data(vals, dt),
+                    validity=validity))
+            flat_idx.append(i)
+        staged = staging.ingest_table(host)
+        for i, c in zip(flat_idx, staged.columns):
+            out[i] = c
+        return Table(tuple(out))
+
     def to_pydict(self):
-        return {i: c.to_pylist() for i, c in enumerate(self.columns)}
+        from spark_rapids_jni_tpu.runtime import staging
+        t = self
+        if staging.enabled() and self.columns:
+            # one staged D2H for the whole table; decode runs on the
+            # host image with zero further device chatter
+            t = staging.fetch_table(self)
+        return {i: c.to_pylist() for i, c in enumerate(t.columns)}
 
     def tree_flatten(self):
         return tuple(self.columns), None

@@ -1,0 +1,439 @@
+"""Coalesced staging tests: the transfer-count guard (ONE device_put per
+staged table, however many columns), byte-identical round-trips against
+the per-column path, the donated-scratch pad contract, sharded staged
+placement, the double-buffered prefetcher, and the ``staging.h2d`` /
+``staging.d2h`` span attributes the report CLI aggregates."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu import (
+    BOOL8, Column, FLOAT64, INT32, INT64, STRING, Table, obs,
+)
+from spark_rapids_jni_tpu.ops.decimal import decimal128
+from spark_rapids_jni_tpu.runtime import shapes, staging
+from spark_rapids_jni_tpu.table import string_tail
+
+
+@pytest.fixture
+def staging_on(monkeypatch):
+    monkeypatch.delenv("SRJ_TPU_STAGING", raising=False)
+    assert staging.enabled()
+
+
+@pytest.fixture
+def staging_off(monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_STAGING", "0")
+    assert not staging.enabled()
+
+
+class _PutSpy:
+    """Counts ``jax.device_put`` calls (staging late-binds the module
+    attribute precisely so interposers like this see every transfer)."""
+
+    def __init__(self, real):
+        self.real = real
+        self.calls = 0
+
+    def __call__(self, *args, **kwargs):
+        self.calls += 1
+        return self.real(*args, **kwargs)
+
+
+@pytest.fixture
+def put_spy(monkeypatch):
+    spy = _PutSpy(jax.device_put)
+    monkeypatch.setattr(jax, "device_put", spy)
+    return spy
+
+
+def _wide_inputs(ncols=212, nrows=64):
+    rng = np.random.default_rng(7)
+    arrays = [rng.integers(0, 1000, nrows).astype(np.int32)
+              for _ in range(ncols)]
+    valids = [None if i % 3 else rng.random(nrows) < 0.8
+              for i in range(ncols)]
+    return arrays, [INT32] * ncols, valids
+
+
+# ---------------------------------------------------------------------------
+# Transfer-count guard
+# ---------------------------------------------------------------------------
+
+def test_staged_wide_ingest_is_one_device_put(staging_on, put_spy):
+    """The acceptance criterion: 212 columns (the bench's widest axis),
+    exactly ONE H2D ``device_put`` for the whole table."""
+    arrays, dtypes, valids = _wide_inputs()
+    t = Table.from_numpy(arrays, dtypes, valids)
+    assert put_spy.calls == 1
+    assert t.num_columns == 212 and t.num_rows == 64
+
+
+def test_per_column_ingest_pays_per_column_dispatch(staging_off,
+                                                    monkeypatch):
+    """The fallback path really is per-column: >= one host->device
+    ``jnp.asarray`` dispatch per column (what staging coalesces away)."""
+    calls = {"n": 0}
+    real = jnp.asarray
+
+    def spy(a, *args, **kwargs):
+        if isinstance(a, np.ndarray):
+            calls["n"] += 1
+        return real(a, *args, **kwargs)
+
+    monkeypatch.setattr(jnp, "asarray", spy)
+    arrays, dtypes, valids = _wide_inputs()
+    Table.from_numpy(arrays, dtypes, valids)
+    assert calls["n"] >= 212
+
+
+def test_stage_arrays_single_put_many_buffers(staging_on, put_spy):
+    bufs = [np.arange(n, dtype=np.int32) for n in (3, 17, 64, 0, 5)]
+    outs = staging.stage_arrays(bufs)
+    assert put_spy.calls == 1
+    for b, o in zip(bufs, outs):
+        assert not isinstance(o, np.ndarray)
+        np.testing.assert_array_equal(np.asarray(o), b)
+
+
+# ---------------------------------------------------------------------------
+# Byte-identical round trips vs the per-column path
+# ---------------------------------------------------------------------------
+
+def _leaf_images(table):
+    """Per-column dict of host images of every present leaf."""
+    out = []
+    for c in table.columns:
+        d = {}
+        for name in ("data", "validity", "offsets", "chars", "chars2d",
+                     "lens"):
+            v = getattr(c, name)
+            if v is not None:
+                d[name] = np.asarray(v)
+        out.append(d)
+    return out
+
+
+def _assert_tables_match(a, b):
+    assert a.dtypes == b.dtypes
+    for ca, cb in zip(_leaf_images(a), _leaf_images(b)):
+        assert set(ca) == set(cb)
+        for name in ca:
+            np.testing.assert_array_equal(ca[name], cb[name],
+                                          err_msg=name)
+
+
+def test_fixed_width_ingest_matches_per_column(monkeypatch):
+    arrays = [np.arange(10, dtype=np.int64) * 3,
+              np.linspace(0.0, 1.0, 10),
+              np.arange(10, dtype=np.int32),
+              (np.arange(10) % 2).astype(np.uint8)]
+    dtypes = [INT64, FLOAT64, INT32, BOOL8]
+    valids = [None, np.arange(10) % 3 != 0, None, None]
+    monkeypatch.setenv("SRJ_TPU_STAGING", "0")
+    ref = Table.from_numpy(arrays, dtypes, valids)
+    monkeypatch.delenv("SRJ_TPU_STAGING")
+    staged = Table.from_numpy(arrays, dtypes, valids)
+    _assert_tables_match(staged, ref)
+    assert staged.to_pydict() == ref.to_pydict()
+
+
+def test_string_and_null_pylist_matches_per_column(monkeypatch):
+    cols = [["hi", None, "", "wide row éé", "x" * 40],
+            [1, None, 3, None, 5]]
+    dtypes = [STRING, INT32]
+    monkeypatch.setenv("SRJ_TPU_STAGING", "0")
+    ref = Table.from_pylist(cols, dtypes)
+    monkeypatch.delenv("SRJ_TPU_STAGING")
+    staged = Table.from_pylist(cols, dtypes)
+    _assert_tables_match(staged, ref)
+    assert staged.to_pydict() == ref.to_pydict()
+    assert staged.to_pydict()[0] == cols[0]
+
+
+def test_decimal128_ingest_matches_per_column(monkeypatch):
+    limbs = np.arange(4 * 6, dtype=np.uint32).reshape(6, 4)
+    dt = decimal128(scale=2)
+    monkeypatch.setenv("SRJ_TPU_STAGING", "0")
+    ref = Table.from_numpy([limbs], [dt])
+    monkeypatch.delenv("SRJ_TPU_STAGING")
+    staged = Table.from_numpy([limbs], [dt])
+    _assert_tables_match(staged, ref)
+    np.testing.assert_array_equal(np.asarray(staged.columns[0].data),
+                                  limbs)
+
+
+def test_empty_and_zero_row_tables(staging_on):
+    assert Table.from_numpy([], []).num_columns == 0
+    t = Table.from_numpy([np.zeros(0, np.int32)], [INT32])
+    assert t.num_rows == 0
+    assert t.to_pydict() == {0: []}
+
+
+def test_fetch_table_round_trip_with_width_cap_tail(staging_on):
+    vals = ["short", "x" * 50, None, "mid"]
+    col = Column.strings_padded(vals, width_cap=8)
+    assert col.capped and string_tail(col) is not None
+    t = Table((col, Column.from_numpy(np.arange(4, dtype=np.int32),
+                                      INT32)))
+    host = staging.fetch_table(t)
+    for c in host.columns:
+        for leaf in (c.data, c.validity, c.offsets, c.chars2d, c.lens):
+            assert leaf is None or isinstance(leaf, np.ndarray)
+    # the host-side overflow tail rides across the fetch
+    assert string_tail(host.columns[0]) == string_tail(col)
+    assert host.columns[0].to_pylist() == vals
+
+
+def test_fetch_arrays_mixed_passthrough(staging_on):
+    host = np.arange(4, dtype=np.float64)
+    dev2d = jnp.arange(12, dtype=jnp.int32).reshape(3, 4)
+    devb = jnp.asarray(np.array([True, False, True]))
+    empty = jnp.zeros((0,), jnp.int64)
+    outs = staging.fetch_arrays([host, dev2d, devb, empty])
+    assert outs[0] is host
+    np.testing.assert_array_equal(outs[1], np.asarray(dev2d))
+    np.testing.assert_array_equal(outs[2],
+                                  np.array([1, 0, 1], np.uint8))
+    assert outs[3].shape == (0,)
+    assert all(isinstance(o, np.ndarray) for o in outs)
+
+
+def test_kill_switch_values(monkeypatch):
+    for off in ("0", "off", "NO", "False"):
+        monkeypatch.setenv("SRJ_TPU_STAGING", off)
+        assert not staging.enabled()
+    for on in ("1", "on", "yes", ""):
+        monkeypatch.setenv("SRJ_TPU_STAGING", on)
+        assert staging.enabled()
+
+
+# ---------------------------------------------------------------------------
+# Donation: the padded scratch really is consumed
+# ---------------------------------------------------------------------------
+
+def test_donated_fill_consumes_scratch():
+    """``shapes.pad_to`` rides ``_donated_fill``: the zero scratch is
+    DONATED and the output aliases it — the input buffer must be
+    invalidated (the whole point: no second materialized copy of padded
+    pad buffers)."""
+    src = jnp.arange(5, dtype=jnp.int32)
+    dst = jnp.zeros((8,), jnp.int32)
+    out = shapes._donated_fill(dst, src)
+    assert dst.is_deleted()
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.array([0, 1, 2, 3, 4, 0, 0, 0]))
+
+
+def test_pad_to_values_and_passthrough():
+    a = jnp.arange(6, dtype=jnp.float32)
+    out = shapes.pad_to(a, (16,))
+    np.testing.assert_array_equal(
+        np.asarray(out), np.pad(np.arange(6, dtype=np.float32), (0, 10)))
+    # 2-D (the rows-blob / chars2d case): rows pad, width fixed
+    m = jnp.ones((3, 4), jnp.uint8)
+    out2 = shapes.pad_to(m, (8, 4))
+    assert out2.shape == (8, 4)
+    np.testing.assert_array_equal(np.asarray(out2[:3]), np.ones((3, 4)))
+    np.testing.assert_array_equal(np.asarray(out2[3:]),
+                                  np.zeros((5, 4)))
+    # already at shape: identity, nothing donated or copied
+    same = shapes.pad_to(a, (6,))
+    assert same is a and not a.is_deleted()
+
+
+def test_bucketed_pad_column_still_correct():
+    """pad_column on the donated path: values and bucket shapes hold."""
+    col = Column.from_numpy(np.arange(10, dtype=np.int32), INT32,
+                            np.arange(10) % 2 == 0)
+    padded = shapes.pad_column(col, shapes.bucket_rows(10))
+    b = shapes.bucket_rows(10)
+    assert padded.data.shape == (b,)
+    np.testing.assert_array_equal(np.asarray(padded.data[:10]),
+                                  np.arange(10))
+    np.testing.assert_array_equal(np.asarray(padded.data[10:]),
+                                  np.zeros(b - 10))
+
+
+# ---------------------------------------------------------------------------
+# Sharded staged placement
+# ---------------------------------------------------------------------------
+
+def test_shard_table_staged_matches_per_column(cpu_devices, monkeypatch,
+                                               put_spy):
+    # the parallel package import chain needs jax.shard_map; skip (not
+    # fail) on jax builds that lack it — staging itself does not
+    try:
+        from spark_rapids_jni_tpu.parallel import mesh as mesh_mod
+    except ImportError as e:
+        pytest.skip(f"parallel layer unavailable: {e}")
+    mesh = mesh_mod.make_mesh(cpu_devices[:8])
+    n = 128
+    t = Table((
+        Column.from_numpy(np.arange(n, dtype=np.int32), INT32,
+                          np.arange(n) % 5 != 0),
+        Column.from_numpy(np.linspace(0., 1., n), FLOAT64),
+        Column.strings_padded([f"s{i}" for i in range(n)]),
+    ))
+    monkeypatch.setenv("SRJ_TPU_STAGING", "0")
+    ref = mesh_mod.shard_table(t, mesh)
+    monkeypatch.delenv("SRJ_TPU_STAGING")
+    put_spy.calls = 0
+    out = mesh_mod.shard_table(t, mesh)
+    # one committed put per mesh device for the WHOLE table (3 columns,
+    # 6 leaves -> would be 6 puts/device on the per-column path)
+    assert put_spy.calls == len(cpu_devices[:8])
+    for cr, co in zip(ref.columns, out.columns):
+        for name in ("data", "validity", "chars2d", "lens"):
+            vr, vo = getattr(cr, name), getattr(co, name)
+            assert (vr is None) == (vo is None)
+            if vr is None or (name == "data" and cr.dtype.is_string):
+                continue
+            np.testing.assert_array_equal(np.asarray(vr),
+                                          np.asarray(vo), err_msg=name)
+            assert vo.sharding.is_equivalent_to(vr.sharding, vo.ndim)
+
+
+def test_shard_table_staged_direct(cpu_devices, staging_on, put_spy):
+    """shard_table_staged without the parallel package (whose import
+    chain is jax-version-sensitive): values, shardings and the
+    one-put-per-device contract, straight off a raw Mesh."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(np.array(cpu_devices[:8]), ("data",))
+    n = 128
+    t = Table((
+        Column.from_numpy(np.arange(n, dtype=np.int32), INT32,
+                          np.arange(n) % 5 != 0),
+        Column.from_numpy(np.linspace(0., 1., n), FLOAT64),
+        Column.strings_padded([f"s{i}" for i in range(n)]),
+    ))
+    put_spy.calls = 0
+    out = staging.shard_table_staged(t, mesh)
+    assert put_spy.calls == 8
+    c0, c1, cs = out.columns
+    np.testing.assert_array_equal(np.asarray(c0.data), np.arange(n))
+    np.testing.assert_array_equal(np.asarray(c0.validity),
+                                  np.asarray(t.columns[0].validity))
+    np.testing.assert_array_equal(np.asarray(c1.data),
+                                  np.linspace(0., 1., n))
+    np.testing.assert_array_equal(np.asarray(cs.chars2d),
+                                  np.asarray(t.columns[2].chars2d))
+    np.testing.assert_array_equal(np.asarray(cs.lens),
+                                  np.asarray(t.columns[2].str_lens()))
+    row = NamedSharding(mesh, P("data"))
+    for arr in (c0.data, c0.validity, c1.data, cs.chars2d, cs.lens):
+        assert arr.sharding.is_equivalent_to(row, 1)
+
+
+def test_ensure_staged_promotes_host_leaves(staging_on, put_spy):
+    t = Table((Column(INT32, np.arange(8, dtype=np.int32)),
+               Column(FLOAT64, np.linspace(0., 1., 8),
+                      np.full(1, 0xFF, np.uint8))))
+    out = staging.ensure_staged(t)
+    assert put_spy.calls == 1
+    for c in out.columns:
+        assert not isinstance(c.data, np.ndarray)
+    np.testing.assert_array_equal(np.asarray(out.columns[0].data),
+                                  np.arange(8))
+    # already-staged tables pass through without another transfer
+    again = staging.ensure_staged(out)
+    assert again is out and put_spy.calls == 1
+
+
+# ---------------------------------------------------------------------------
+# Prefetcher
+# ---------------------------------------------------------------------------
+
+def test_prefetch_orders_and_runs_ahead():
+    staged = []
+    pulled = []
+
+    def stage(i):
+        staged.append(i)
+        return i * 10
+
+    def items():
+        for i in range(6):
+            pulled.append(i)
+            yield i
+
+    gen = staging.prefetch(items(), stage, depth=2)
+    first = next(gen)
+    assert first == 0
+    # double buffering: the producer ran AHEAD of the consumer (depth+1
+    # items pulled and submitted before the first yield) but not
+    # unboundedly
+    assert len(pulled) == 3
+    assert list(gen) == [10, 20, 30, 40, 50]
+    assert staged == list(range(6))
+
+
+def test_prefetch_propagates_errors_in_order():
+    def stage(i):
+        if i == 2:
+            raise RuntimeError("boom")
+        return i
+
+    gen = staging.prefetch(range(4), stage, depth=1)
+    assert next(gen) == 0
+    assert next(gen) == 1
+    with pytest.raises(RuntimeError, match="boom"):
+        next(gen)
+
+
+def test_prefetch_rejects_bad_depth():
+    with pytest.raises(ValueError):
+        list(staging.prefetch([1], lambda x: x, depth=0))
+
+
+def test_prefetcher_close_stops_early():
+    pf = staging.Prefetcher(range(100), lambda i: i, depth=2)
+    assert next(pf) == 0
+    pf.close()  # must not hang or raise
+
+
+# ---------------------------------------------------------------------------
+# Observability attributes
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def obs_on():
+    obs.configure_sink(None)
+    obs.clear()
+    obs.enable()
+    yield
+    obs.disable()
+    obs.configure_sink(None)
+    obs.clear()
+
+
+def test_staging_spans_carry_transfer_attrs(staging_on, obs_on):
+    t = Table.from_numpy([np.arange(32, dtype=np.int64),
+                          np.arange(32, dtype=np.int32)],
+                         [INT64, INT32])
+    t.to_pydict()
+    evs = obs.events(kind="span")
+    h2d = [e for e in evs if e["name"] == "staging.h2d"]
+    d2h = [e for e in evs if e["name"] == "staging.d2h"]
+    assert len(h2d) == 1 and len(d2h) == 1
+    assert h2d[0]["transfer_count"] == 1
+    assert h2d[0]["h2d_bytes"] == 32 * 8 + 32 * 4
+    assert h2d[0]["buffers"] == 2
+    assert d2h[0]["transfer_count"] == 1
+    assert d2h[0]["d2h_bytes"] >= 32 * 8 + 32 * 4
+
+
+def test_report_aggregates_transfer_columns(staging_on, obs_on):
+    from spark_rapids_jni_tpu.obs import report
+    Table.from_numpy([np.arange(16, dtype=np.int32)], [INT32])
+    summary = report.summarize(obs.events())
+    s = summary["ops"]["staging.h2d"]
+    assert s["transfer_count"] == 1 and s["h2d_bytes"] == 64
+    table = report.format_table(summary)
+    assert "h2d_bytes" in table and "xfers" in table
+    prom = report.format_prometheus(summary)
+    assert 'srj_tpu_span_h2d_bytes_total{op="staging.h2d"} 64' in prom
+    assert 'srj_tpu_span_transfers_total{op="staging.h2d"} 1' in prom
