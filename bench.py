@@ -716,13 +716,25 @@ def _obs_axis_summary():
         if rec["error_types"]:
             d["error_types"] = rec["error_types"]
         ops[name] = d
-    return {"ops": ops, "compiles": summ["compiles"]}
+    out = {"ops": ops, "compiles": summ["compiles"]}
+    dropped = obs.dropped()
+    if dropped.get("events_dropped") or dropped.get("sink_errors"):
+        # the digest above came from a truncated ring — record that, so a
+        # surprising per-op count in BENCH_DETAILS.json is explainable
+        out["dropped"] = dropped
+    return out
 
 
 def _run_axis(axis: str):
     """Run one benchmark axis in this process and print its result JSON."""
     from spark_rapids_jni_tpu import obs
     obs.enable()   # ring buffer (+ the SRJ_TPU_EVENTS sink if configured)
+    # importing obs honors SRJ_TPU_METRICS_PORT: axis legs run one at a
+    # time, so the live /metrics endpoint follows the active leg
+    from spark_rapids_jni_tpu.obs import exporter
+    if exporter.running():
+        print(f"[bench] live /metrics on 127.0.0.1:{exporter.port()}",
+              flush=True)
     if axis == "calibrate":
         res = _calibrate_hbm()
     else:

@@ -80,3 +80,76 @@ print(f"staging smoke: {transfers} H2D transfer(s) for a 212-column "
 sys.exit(0 if transfers == 1 else 1)
 PY
 rm -f "$STAGING_EVENTS"
+
+# live-telemetry smoke: run a workload with the HTTP exporter on, scrape
+# /metrics over a real socket mid-process, and assert the span counters
+# the workload must have produced are nonzero — proves the registry is
+# fed from span completion and the exporter serves it while work runs
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python - <<'PY'
+import json, urllib.request
+import jax.numpy as jnp
+from spark_rapids_jni_tpu import Column, INT32, Table, obs
+from spark_rapids_jni_tpu.obs import exporter
+from spark_rapids_jni_tpu.ops import convert_from_rows, convert_to_rows
+
+obs.enable()
+port = exporter.start(0)  # ephemeral: no collision with a parallel CI job
+assert port, "exporter failed to bind"
+t = Table((Column(INT32, jnp.arange(64, dtype=jnp.int32)),))
+convert_from_rows(convert_to_rows(t)[0], [INT32])
+body = urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+assert 'srj_tpu_span_calls_total{op="convert_to_rows"}' in body, body[:800]
+assert 'srj_tpu_span_rows_total' in body
+hz = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+assert hz["status"] == "ok" and hz["obs_enabled"], hz
+print(f"live-telemetry smoke: scraped {len(body)} bytes from "
+      f"127.0.0.1:{port}, ring={hz['ring_events']} events")
+exporter.stop()
+PY
+
+# trace-export smoke: the report CLI converts the staged event log to a
+# Chrome/Perfetto trace and the result parses with balanced nesting
+TRACE_EVENTS=$(mktemp /tmp/srj_trace_smoke.XXXXXX.jsonl)
+TRACE_OUT=$(mktemp /tmp/srj_trace_smoke.XXXXXX.trace.json)
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu SRJ_TPU_EVENTS="$TRACE_EVENTS" \
+  python -c "
+import numpy as np
+from spark_rapids_jni_tpu import Column, INT32
+from spark_rapids_jni_tpu.ops import murmur3_hash
+murmur3_hash([Column.from_numpy(np.arange(64, dtype=np.int32), INT32)])
+"
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python -m spark_rapids_jni_tpu.obs "$TRACE_EVENTS" --trace "$TRACE_OUT"
+python - "$TRACE_OUT" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+evs = doc["traceEvents"]
+assert any(e["ph"] in ("X", "B") for e in evs), "no span events in trace"
+opens = sum(1 for e in evs if e["ph"] == "B")
+closes = sum(1 for e in evs if e["ph"] == "E")
+assert opens == closes, f"unbalanced B/E: {opens} vs {closes}"
+print(f"trace smoke: {len(evs)} trace events, balanced nesting")
+PY
+rm -f "$TRACE_EVENTS" "$TRACE_OUT"
+
+# perf-regression gate, advisory for now: reports deltas of the newest
+# checked-in bench round vs the prior one (flip --mode enforce once the
+# round cadence stabilizes); the synthetic self-test proves the gate
+# actually fires on a doctored 2x regression before we trust its pass
+python - <<'PY'
+import glob, json
+latest = sorted(glob.glob("BENCH_r*.json"))[-1]
+d = json.load(open(latest))
+d["parsed"]["value"] = d["parsed"]["value"] / 2.0
+json.dump(d, open("/tmp/srj_gate_selftest.json", "w"))
+PY
+PREV=$(ls BENCH_r*.json | sort | tail -2 | head -1)
+if python ci/regress_gate.py --current /tmp/srj_gate_selftest.json \
+     --previous "$PREV" --mode enforce > /dev/null 2>&1; then
+  echo "regress_gate self-test FAILED: synthetic 2x regression passed" >&2
+  exit 1
+fi
+rm -f /tmp/srj_gate_selftest.json
+python ci/regress_gate.py --history . --mode advisory

@@ -1,0 +1,220 @@
+#!/usr/bin/env python3
+"""Perf-regression gate over the checked-in bench history.
+
+Compares the newest ``BENCH_r*.json`` round against the previous round
+(and against ``BASELINE.json``'s ``published`` figures when present),
+per parsed metric, with a configurable tolerance.  Prints a pass/fail
+table; the exit code is what CI consumes:
+
+- ``0`` — no regression beyond tolerance (or advisory mode, which always
+  reports but never fails the build).
+- ``2`` — usage / missing-history error.
+- ``3`` — enforced mode and at least one metric regressed.
+
+Direction is inferred from the metric's unit: time-like units (``s``,
+``ms``, ``us``, ``ns``) regress when they go *up*; rate-like units
+(``GB/s``, ``rows/s``, ...) regress when they go *down*.  Unknown units
+default to higher-is-better (every current bench metric is a
+throughput).
+
+Usage::
+
+    python ci/regress_gate.py [--history DIR] [--tolerance 0.25]
+                              [--mode advisory|enforce]
+                              [--current FILE] [--previous FILE]
+
+``--current``/``--previous`` override round auto-discovery, which is how
+the synthetic-regression self-test in CI feeds a doctored round through
+the same code path the real gate runs.
+
+Pure stdlib, no repo imports: the gate must run in a CI step even when
+the package itself is broken — that is half the point of a gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+LOWER_IS_BETTER_UNITS = {"s", "sec", "secs", "seconds", "ms", "us", "ns"}
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def load_round(path: str) -> Dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: expected a JSON object")
+    return doc
+
+
+def round_metrics(doc: Dict) -> Dict[str, Dict]:
+    """``{metric_name: {"value": float, "unit": str}}`` from a bench
+    round.  ``parsed`` is a single metric dict today; tolerate a future
+    list-of-dicts shape."""
+    parsed = doc.get("parsed")
+    if parsed is None:
+        return {}
+    entries = parsed if isinstance(parsed, list) else [parsed]
+    out = {}
+    for e in entries:
+        if not isinstance(e, dict):
+            continue
+        name = e.get("metric")
+        value = e.get("value")
+        if isinstance(name, str) and isinstance(value, (int, float)):
+            out[name] = {"value": float(value),
+                         "unit": str(e.get("unit", ""))}
+    return out
+
+
+def discover_rounds(history_dir: str) -> List[Tuple[int, str]]:
+    rounds = []
+    for path in glob.glob(os.path.join(history_dir, "BENCH_r*.json")):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if m:
+            rounds.append((int(m.group(1)), path))
+    return sorted(rounds)
+
+
+def lower_is_better(unit: str) -> bool:
+    return unit.strip().lower() in LOWER_IS_BETTER_UNITS
+
+
+def compare(cur: Dict[str, Dict], ref: Dict[str, Dict], ref_name: str,
+            tolerance: float) -> List[Dict]:
+    """One comparison row per metric present in both sides.  ``delta`` is
+    signed relative change in the *improvement* direction: positive =
+    better, negative = worse; ``regressed`` when worse by > tolerance."""
+    rows = []
+    for name in sorted(cur):
+        if name not in ref:
+            continue
+        c, r = cur[name]["value"], ref[name]["value"]
+        if r == 0:
+            continue
+        change = (c - r) / abs(r)
+        if lower_is_better(cur[name]["unit"]):
+            change = -change
+        rows.append({
+            "metric": name, "ref": ref_name,
+            "current": c, "reference": r, "unit": cur[name]["unit"],
+            "delta": change, "regressed": change < -tolerance,
+        })
+    return rows
+
+
+def baseline_metrics(path: str) -> Dict[str, Dict]:
+    """Published reference figures from BASELINE.json, if any were ever
+    filled in (the seed ships ``"published": {}``)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    pub = doc.get("published")
+    if not isinstance(pub, dict):
+        return {}
+    out = {}
+    for name, entry in pub.items():
+        if isinstance(entry, (int, float)):
+            out[name] = {"value": float(entry), "unit": ""}
+        elif isinstance(entry, dict) and isinstance(
+                entry.get("value"), (int, float)):
+            out[name] = {"value": float(entry["value"]),
+                         "unit": str(entry.get("unit", ""))}
+    return out
+
+
+def format_rows(rows: List[Dict], tolerance: float) -> str:
+    lines = [f"{'metric':<44} {'vs':<10} {'reference':>12} {'current':>12} "
+             f"{'delta':>8}  verdict"]
+    lines.append("-" * len(lines[0]))
+    for r in rows:
+        verdict = "REGRESSED" if r["regressed"] else (
+            "ok" if r["delta"] >= 0 else "ok (within tolerance)")
+        lines.append(
+            f"{r['metric']:<44} {r['ref']:<10} "
+            f"{r['reference']:>12.3f} {r['current']:>12.3f} "
+            f"{r['delta']:>+7.1%}  {verdict}")
+    lines.append(f"(tolerance: worse-by more than {tolerance:.0%} fails)")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python ci/regress_gate.py", description=__doc__.split("\n")[0])
+    ap.add_argument("--history", default=".",
+                    help="directory holding BENCH_r*.json (default: .)")
+    ap.add_argument("--tolerance", type=float, default=0.25,
+                    help="allowed relative worsening before failure "
+                         "(default 0.25 = 25%%)")
+    ap.add_argument("--mode", choices=("advisory", "enforce"),
+                    default="advisory",
+                    help="advisory reports only; enforce exits 3 on "
+                         "regression (default advisory)")
+    ap.add_argument("--current", help="explicit current-round JSON "
+                    "(default: highest BENCH_r*.json)")
+    ap.add_argument("--previous", help="explicit previous-round JSON "
+                    "(default: second-highest BENCH_r*.json)")
+    ap.add_argument("--baseline", default=None,
+                    help="BASELINE.json path (default: "
+                         "<history>/BASELINE.json)")
+    args = ap.parse_args(argv)
+
+    try:
+        if args.current and args.previous:
+            cur_path, prev_path = args.current, args.previous
+            cur_label = os.path.basename(cur_path)
+            prev_label = os.path.basename(prev_path)
+        else:
+            rounds = discover_rounds(args.history)
+            if len(rounds) < 2:
+                print(f"regress_gate: need >= 2 rounds in {args.history}, "
+                      f"found {len(rounds)} — nothing to gate",
+                      file=sys.stderr)
+                return 2
+            (_, prev_path), (_, cur_path) = rounds[-2], rounds[-1]
+            cur_label = os.path.basename(cur_path)
+            prev_label = os.path.basename(prev_path)
+        cur = round_metrics(load_round(cur_path))
+        prev = round_metrics(load_round(prev_path))
+    except (OSError, ValueError) as e:
+        print(f"regress_gate: {e}", file=sys.stderr)
+        return 2
+    if not cur:
+        print(f"regress_gate: no parsed metrics in {cur_label}",
+              file=sys.stderr)
+        return 2
+
+    rows = compare(cur, prev, prev_label, args.tolerance)
+    base = baseline_metrics(
+        args.baseline or os.path.join(args.history, "BASELINE.json"))
+    rows += compare(cur, base, "published", args.tolerance)
+
+    if not rows:
+        print("regress_gate: no overlapping metrics to compare",
+              file=sys.stderr)
+        return 2
+    print(f"perf regression gate: {cur_label} vs {prev_label}"
+          + (" + published baseline" if base else ""))
+    print(format_rows(rows, args.tolerance))
+    regressed = [r for r in rows if r["regressed"]]
+    if regressed:
+        names = ", ".join(r["metric"] for r in regressed)
+        if args.mode == "enforce":
+            print(f"FAIL: regression in {names}", file=sys.stderr)
+            return 3
+        print(f"ADVISORY: regression in {names} "
+              f"(mode=advisory, not failing the build)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -85,12 +85,30 @@ DOMAIN_TRANSFER = "pjrtTransferFaults"
 _DOMAINS = (DOMAIN_COMPILE, DOMAIN_EXECUTE, DOMAIN_TRANSFER)
 
 
+_ITYPE_NAMES = {FI_TRAP: "trap", FI_ASSERT: "assert",
+                FI_RETURN_VALUE: "return_value"}
+
+
 def _emit_fault(domain: str, name: str, itype: Optional[int] = None,
                 rejected: bool = False) -> None:
     """Mirror an injection (or a device-dead rejection) into the obs event
     log, so fault assertions can be made against the same JSONL/report
     stream as spans.  Lazy import: obs imports nothing from faultinj at
     module level, but the reverse edge must also stay import-time-free."""
+    # live registry counter first: it records injections even when span
+    # recording is off, so a /metrics scrape can assert "the chaos run
+    # actually injected" without turning full tracing on
+    try:
+        from spark_rapids_jni_tpu.obs import metrics as _metrics
+        _metrics.counter(
+            "srj_tpu_faults_injected_total",
+            "Faults fired by the injector, by kind and op.",
+            ("kind", "op"),
+        ).inc(kind="rejected" if rejected
+              else _ITYPE_NAMES.get(itype, "unknown"),
+              op=name)
+    except Exception:
+        pass
     try:
         from spark_rapids_jni_tpu import obs
         if not obs.enabled():

@@ -385,7 +385,7 @@ def distributed_query_step(mesh, axis_name="data",
             recv[:, 0], recv[:, 1], valid, MAX_GROUPS)
         return gkeys, sums, have, num_groups[None], overflow[None]
 
-    from jax import shard_map
+    from spark_rapids_jni_tpu.utils.compat import shard_map
     spec = P(axis_name)
     return shard_map(step, mesh=mesh, in_specs=(spec, spec),
                      out_specs=spec, check_vma=False)
@@ -438,7 +438,7 @@ def distributed_q72_step(mesh, axis_name="data",
         return (gkeys[0], gkeys[1], sums[0], sums[1], have,
                 num_groups[None], overflow[None])
 
-    from jax import shard_map
+    from spark_rapids_jni_tpu.utils.compat import shard_map
     spec = P(axis_name)
     rep = P()
     return shard_map(step, mesh=mesh,
@@ -485,7 +485,7 @@ def distributed_q95_step(mesh, axis_name="data",
         return (gkeys[0], outs[0], outs[1], outs[2], outs[3], have,
                 num_groups[None], overflow[None])
 
-    from jax import shard_map
+    from spark_rapids_jni_tpu.utils.compat import shard_map
     spec = P(axis_name)
     rep = P()
     return shard_map(step, mesh=mesh,
@@ -1432,7 +1432,7 @@ def distributed_q6_table_step(mesh, axis_name="data",
         overflow = x_overflow | j_overflow | (num_groups > max_groups)
         return res, have, num_groups[None], overflow[None]
 
-    from jax import shard_map
+    from spark_rapids_jni_tpu.utils.compat import shard_map
     spec = P(axis_name)
     out_tree = Table(tuple(Column(INT32, spec, spec) for _ in range(3)))
     in_sales = Table(tuple(Column(INT32, spec, spec) for _ in range(4)))
@@ -1946,7 +1946,7 @@ def distributed_q72_table_step(mesh, axis_name="data",
         overflow = x_overflow | j_overflow | (num_groups > max_groups)
         return res, have, num_groups[None], overflow[None]
 
-    from jax import shard_map
+    from spark_rapids_jni_tpu.utils.compat import shard_map
     from spark_rapids_jni_tpu.table import INT32 as _I32
     spec = P(axis_name)
     kspec = P(None, axis_name) if wide_key else spec
@@ -2013,7 +2013,7 @@ def distributed_q95_table_step(mesh, axis_name="data",
         overflow = x_overflow | (num_groups > max_groups)
         return res, have, num_groups[None], overflow[None]
 
-    from jax import shard_map
+    from spark_rapids_jni_tpu.utils.compat import shard_map
     spec = P(axis_name)
     kspec = P(None, axis_name) if wide_key else spec
     krep = P(None, None) if wide_key else P()

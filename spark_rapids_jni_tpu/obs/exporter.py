@@ -1,0 +1,137 @@
+"""Opt-in HTTP exporter: live Prometheus ``/metrics`` + ``/healthz``.
+
+A stdlib-only (:mod:`http.server`) daemon thread that serves the live
+:mod:`spark_rapids_jni_tpu.obs.metrics` registry while a workload runs —
+no prometheus_client dependency, no blocking of the workload (requests
+are handled on the ThreadingHTTPServer's own per-request threads, and
+reads only take the registry lock long enough to snapshot).
+
+Off by default.  Nothing binds a socket unless either
+``SRJ_TPU_METRICS_PORT`` is set when :mod:`spark_rapids_jni_tpu.obs` is
+imported, or :func:`start` is called explicitly.  ``start(port=0)`` binds
+an ephemeral port (tests use this to scrape over a real socket without
+colliding).
+
+Endpoints:
+
+``GET /metrics``
+    Prometheus text exposition (``text/plain; version=0.0.4``) of the
+    live registry — the same family names ``report --prom`` emits from a
+    JSONL log, so a mid-flight scrape matches the post-run report within
+    one flush interval.
+
+``GET /healthz``
+    JSON liveness snapshot: uptime, obs enablement, ring occupancy,
+    dropped-event and sink-error counts, XLA compile totals.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from spark_rapids_jni_tpu.obs import metrics as _metrics
+
+__all__ = ["start", "stop", "running", "port"]
+
+_LOCK = threading.Lock()
+_SERVER: Optional[ThreadingHTTPServer] = None
+_THREAD: Optional[threading.Thread] = None
+_STARTED_AT: float = 0.0
+
+
+def _healthz() -> dict:
+    from spark_rapids_jni_tpu.obs import spans as _spans
+
+    snap = _metrics.registry().snapshot()
+
+    def total(family: str) -> float:
+        vals = snap.get(family, {}).get("values", {})
+        return sum(v for v in vals.values() if isinstance(v, (int, float)))
+
+    doc = {
+        "status": "ok",
+        "uptime_s": round(time.time() - _STARTED_AT, 3),
+        "obs_enabled": _spans.enabled(),
+        "ring_events": len(_spans.events()),
+        "xla_compiles": int(total("srj_tpu_xla_compiles_total")),
+        "xla_compile_seconds": round(
+            total("srj_tpu_xla_compile_seconds_total"), 6),
+    }
+    doc.update(_spans.dropped())
+    return doc
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "srj-tpu-metrics/1.0"
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = _metrics.format_prometheus().encode("utf-8")
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif path == "/healthz":
+            body = (json.dumps(_healthz()) + "\n").encode("utf-8")
+            ctype = "application/json"
+        else:
+            self.send_error(404, "try /metrics or /healthz")
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # silence per-request stderr spam
+        pass
+
+
+def start(port: int = 9464, host: str = "127.0.0.1") -> Optional[int]:
+    """Start the exporter daemon thread; returns the bound port, or the
+    already-running exporter's port if one is live (idempotent), or
+    ``None`` if the bind failed (port taken — logged, never raised, so
+    env-driven bring-up can't take down a workload)."""
+    global _SERVER, _THREAD, _STARTED_AT
+    with _LOCK:
+        if _SERVER is not None:
+            return _SERVER.server_address[1]
+        try:
+            srv = ThreadingHTTPServer((host, port), _Handler)
+        except OSError as e:
+            import sys
+            print(f"[obs.exporter] bind {host}:{port} failed: {e}",
+                  file=sys.stderr)
+            return None
+        srv.daemon_threads = True
+        t = threading.Thread(target=srv.serve_forever,
+                             name="srj-obs-exporter", daemon=True)
+        _SERVER, _THREAD, _STARTED_AT = srv, t, time.time()
+        t.start()
+        return srv.server_address[1]
+
+
+def stop() -> None:
+    """Shut the exporter down and release the port; no-op if not running."""
+    global _SERVER, _THREAD
+    with _LOCK:
+        srv, t = _SERVER, _THREAD
+        _SERVER = _THREAD = None
+    if srv is not None:
+        srv.shutdown()
+        srv.server_close()
+    if t is not None:
+        t.join(timeout=5.0)
+
+
+def running() -> bool:
+    with _LOCK:
+        return _SERVER is not None
+
+
+def port() -> Optional[int]:
+    """Bound port of the live exporter, or ``None``."""
+    with _LOCK:
+        return _SERVER.server_address[1] if _SERVER is not None else None

@@ -1,0 +1,360 @@
+"""Live metrics registry: counters, gauges, fixed-bucket histograms.
+
+The report CLI answers "what happened" after a run from its JSONL log;
+this module answers "what is happening" *while* it runs.  A process-wide,
+thread-safe registry holds Prometheus-shaped metric families — counters,
+gauges, and fixed-bucket histograms — and is fed automatically from span
+completion (:func:`observe_event`, called by ``spans.emit`` for every
+event), so every already-instrumented entry point (row conversion,
+hashing, get_json, cast_string, shuffle, parquet, pipeline, staging)
+reports here with zero new call-site code.  Families whose aggregates the
+offline report also computes use the SAME metric names as ``report
+--prom``, so a dashboard built against one works against the other:
+
+- ``srj_tpu_span_calls_total`` / ``srj_tpu_span_failures_total`` /
+  ``srj_tpu_span_wall_seconds_total`` / ``srj_tpu_span_device_seconds_total``
+  / ``srj_tpu_span_rows_total`` / ``srj_tpu_span_bytes_total`` /
+  ``srj_tpu_span_h2d_bytes_total`` / ``srj_tpu_span_d2h_bytes_total`` /
+  ``srj_tpu_span_transfers_total`` / ``srj_tpu_span_xla_compiles_total``
+  — per-op counters, ``{op="..."}``.
+- ``srj_tpu_span_wall_seconds`` / ``srj_tpu_span_device_seconds`` — per-op
+  fixed-bucket latency histograms (live-only; percentiles come from the
+  scraper).
+- ``srj_tpu_xla_compiles_total`` / ``srj_tpu_xla_compile_seconds_total`` —
+  process compile telemetry.
+- ``srj_tpu_pad_rows_total{op}`` — shape-bucket pad waste (padded tail
+  rows) per op.
+- ``srj_tpu_fault_injections_total{domain}`` and
+  ``srj_tpu_faults_injected_total{kind,op}`` — fault-injection hits (the
+  latter fed directly by the injector, live even when spans are off).
+- ``srj_tpu_obs_events_dropped_total{reason}`` — ring evictions and sink
+  write failures, so a scrape can tell truncated telemetry from quiet.
+- ``srj_tpu_prefetch_queue_depth`` — staging prefetcher backlog gauge.
+
+Everything here is pure stdlib (the exposition must be servable from a
+process whose accelerator runtime is wedged), and recording never raises
+— the registry exists to observe operations, not to take them down.  The
+text exposition formatter (:func:`format_exposition`) is shared with
+``report --prom``: one serializer, two data sources.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Registry", "registry", "counter", "gauge", "histogram",
+    "format_exposition", "format_prometheus", "observe_event",
+    "escape_label_value", "DEFAULT_LATENCY_BUCKETS",
+]
+
+# fixed latency buckets (seconds): sub-ms kernel dispatches up through
+# the tens-of-seconds cold XLA compiles the bench schemas hit
+DEFAULT_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+def escape_label_value(v: str) -> str:
+    """Escape a Prometheus label value (backslash, quote, newline)."""
+    return (str(v).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def _fmt_value(v) -> str:
+    if isinstance(v, str):
+        return v
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return format(f, ".10g")
+
+
+def format_exposition(families: Iterable[Tuple]) -> str:
+    """Render ``(name, kind, help, samples)`` families as Prometheus text
+    exposition; each sample is ``(sample_name, labels_dict, value)``
+    (values may be pre-formatted strings).  Shared serializer for the
+    live registry (:meth:`Registry.collect`) and the offline report's
+    ``--prom`` aggregates."""
+    out: List[str] = []
+    for name, kind, help_, samples in families:
+        out.append(f"# HELP {name} {help_}")
+        out.append(f"# TYPE {name} {kind}")
+        for sname, labels, value in samples:
+            if labels:
+                inner = ",".join(
+                    f'{k}="{escape_label_value(v)}"'
+                    for k, v in labels.items())
+                sname = f"{sname}{{{inner}}}"
+            out.append(f"{sname} {_fmt_value(value)}")
+    return "\n".join(out) + "\n"
+
+
+class _Family:
+    """One metric family: a name/kind/help plus children keyed by label
+    values.  All mutation happens under the owning registry's lock; the
+    recording methods swallow label mistakes instead of raising (a typo
+    in telemetry must not fail the operation being observed)."""
+
+    __slots__ = ("name", "kind", "help", "labelnames", "buckets",
+                 "_children", "_lock")
+
+    def __init__(self, name: str, kind: str, help_: str,
+                 labelnames: Sequence[str], lock: threading.Lock,
+                 buckets: Optional[Sequence[float]] = None):
+        self.name = name
+        self.kind = kind
+        self.help = help_
+        self.labelnames = tuple(labelnames)
+        self.buckets = tuple(buckets) if buckets is not None else None
+        self._children: Dict[Tuple[str, ...], object] = {}
+        self._lock = lock
+
+    def _key(self, labels: Dict[str, object]) -> Tuple[str, ...]:
+        return tuple(str(labels.get(k, "")) for k in self.labelnames)
+
+    def _labels_of(self, key: Tuple[str, ...]) -> Dict[str, str]:
+        return dict(zip(self.labelnames, key))
+
+    # -- recording ---------------------------------------------------------
+    def inc(self, amount=1, **labels) -> None:
+        with self._lock:
+            k = self._key(labels)
+            self._children[k] = self._children.get(k, 0) + amount
+
+    def set(self, value, **labels) -> None:
+        with self._lock:
+            self._children[self._key(labels)] = value
+
+    def observe(self, value, **labels) -> None:
+        with self._lock:
+            k = self._key(labels)
+            st = self._children.get(k)
+            if st is None:
+                st = self._children[k] = {
+                    "counts": [0] * (len(self.buckets) + 1),
+                    "sum": 0.0, "count": 0}
+            i = 0
+            while i < len(self.buckets) and value > self.buckets[i]:
+                i += 1
+            st["counts"][i] += 1
+            st["sum"] += float(value)
+            st["count"] += 1
+
+    # -- exposition --------------------------------------------------------
+    def _collect_locked(self) -> Tuple:
+        samples = []
+        for key in sorted(self._children):
+            labels = self._labels_of(key)
+            st = self._children[key]
+            if self.kind == "histogram":
+                cum = 0
+                for i, ub in enumerate(self.buckets):
+                    cum += st["counts"][i]
+                    lb = dict(labels)
+                    lb["le"] = _fmt_value(ub)
+                    samples.append((f"{self.name}_bucket", lb, cum))
+                lb = dict(labels)
+                lb["le"] = "+Inf"
+                samples.append((f"{self.name}_bucket", lb, st["count"]))
+                samples.append((f"{self.name}_sum", labels, st["sum"]))
+                samples.append((f"{self.name}_count", labels, st["count"]))
+            else:
+                samples.append((self.name, labels, st))
+        return (self.name, self.kind, self.help, samples)
+
+    def _snapshot_locked(self) -> Dict:
+        vals = {}
+        for key, st in self._children.items():
+            label = ",".join(f"{k}={v}"
+                             for k, v in self._labels_of(key).items())
+            if self.kind == "histogram":
+                vals[label] = {"sum": st["sum"], "count": st["count"],
+                               "buckets": dict(zip(
+                                   [_fmt_value(b) for b in self.buckets]
+                                   + ["+Inf"], st["counts"]))}
+            else:
+                vals[label] = st
+        return {"kind": self.kind, "values": vals}
+
+
+class Registry:
+    """Thread-safe collection of metric families.  ``counter`` / ``gauge``
+    / ``histogram`` get-or-create a family (idempotent; re-declaring with
+    a different kind raises — that is a programming error, not a runtime
+    condition)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+
+    def _family(self, name: str, kind: str, help_: str,
+                labelnames: Sequence[str],
+                buckets: Optional[Sequence[float]] = None) -> _Family:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = _Family(name, kind, help_, labelnames, self._lock,
+                              buckets)
+                self._families[name] = fam
+            elif fam.kind != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {fam.kind}, "
+                    f"not {kind}")
+            return fam
+
+    def counter(self, name: str, help_: str = "",
+                labelnames: Sequence[str] = ()) -> _Family:
+        return self._family(name, "counter", help_, labelnames)
+
+    def gauge(self, name: str, help_: str = "",
+              labelnames: Sequence[str] = ()) -> _Family:
+        return self._family(name, "gauge", help_, labelnames)
+
+    def histogram(self, name: str, help_: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS
+                  ) -> _Family:
+        return self._family(name, "histogram", help_, labelnames, buckets)
+
+    def collect(self) -> List[Tuple]:
+        """``(name, kind, help, samples)`` tuples for every family, in
+        name order — the input :func:`format_exposition` takes."""
+        with self._lock:
+            return [self._families[n]._collect_locked()
+                    for n in sorted(self._families)]
+
+    def snapshot(self) -> Dict[str, Dict]:
+        """Plain-dict image of every family (the ``/healthz`` payload and
+        the test-friendly view)."""
+        with self._lock:
+            return {n: f._snapshot_locked()
+                    for n, f in sorted(self._families.items())}
+
+    def reset(self) -> None:
+        """Zero every family's children (families stay registered)."""
+        with self._lock:
+            for f in self._families.values():
+                f._children.clear()
+
+
+_REGISTRY = Registry()
+
+
+def registry() -> Registry:
+    """The process-default registry (what span completion feeds and the
+    HTTP exporter serves)."""
+    return _REGISTRY
+
+
+def counter(name: str, help_: str = "",
+            labelnames: Sequence[str] = ()) -> _Family:
+    return _REGISTRY.counter(name, help_, labelnames)
+
+
+def gauge(name: str, help_: str = "",
+          labelnames: Sequence[str] = ()) -> _Family:
+    return _REGISTRY.gauge(name, help_, labelnames)
+
+
+def histogram(name: str, help_: str = "",
+              labelnames: Sequence[str] = (),
+              buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS
+              ) -> _Family:
+    return _REGISTRY.histogram(name, help_, labelnames, buckets)
+
+
+def format_prometheus(reg: Optional[Registry] = None) -> str:
+    """Text exposition of ``reg`` (default registry when omitted) — what
+    the HTTP exporter serves at ``/metrics``."""
+    return format_exposition((reg or _REGISTRY).collect())
+
+
+# ---------------------------------------------------------------------------
+# The span -> registry bridge
+# ---------------------------------------------------------------------------
+
+_SPAN_SUM_COUNTERS = (
+    # (event field, family name, help)
+    ("rows", "srj_tpu_span_rows_total", "Rows processed per op."),
+    ("bytes", "srj_tpu_span_bytes_total", "Bytes processed per op."),
+    ("h2d_bytes", "srj_tpu_span_h2d_bytes_total",
+     "Host-to-device bytes staged per op."),
+    ("d2h_bytes", "srj_tpu_span_d2h_bytes_total",
+     "Device-to-host bytes fetched per op."),
+    ("transfer_count", "srj_tpu_span_transfers_total",
+     "Host/device boundary transfers per op."),
+    ("padded_rows", "srj_tpu_pad_rows_total",
+     "Shape-bucket pad waste (invalid tail rows) per op."),
+)
+
+
+def _observe_span(ev: Dict) -> None:
+    op = str(ev.get("name", "?"))
+    _REGISTRY.counter("srj_tpu_span_calls_total",
+                      "Span invocations per op.", ("op",)).inc(op=op)
+    if ev.get("status") == "error":
+        _REGISTRY.counter("srj_tpu_span_failures_total",
+                          "Failed span invocations per op.",
+                          ("op",)).inc(op=op)
+    wall = ev.get("wall_s")
+    if isinstance(wall, (int, float)):
+        _REGISTRY.histogram("srj_tpu_span_wall_seconds",
+                            "Host wall-clock per span.",
+                            ("op",)).observe(float(wall), op=op)
+        _REGISTRY.counter("srj_tpu_span_wall_seconds_total",
+                          "Host wall seconds per op.",
+                          ("op",)).inc(float(wall), op=op)
+    dev = ev.get("device_s")
+    if isinstance(dev, (int, float)):
+        _REGISTRY.histogram("srj_tpu_span_device_seconds",
+                            "Fenced device-completion time per span.",
+                            ("op",)).observe(float(dev), op=op)
+        _REGISTRY.counter("srj_tpu_span_device_seconds_total",
+                          "Device-completion seconds per op "
+                          "(fenced spans only).",
+                          ("op",)).inc(float(dev), op=op)
+    for field, fam, help_ in _SPAN_SUM_COUNTERS:
+        v = ev.get(field)
+        if isinstance(v, (int, float)) and v:
+            _REGISTRY.counter(fam, help_, ("op",)).inc(int(v), op=op)
+    if isinstance(ev.get("compiles"), int) and ev["compiles"]:
+        _REGISTRY.counter("srj_tpu_span_xla_compiles_total",
+                          "XLA backend compiles attributed per op.",
+                          ("op",)).inc(ev["compiles"], op=op)
+    if isinstance(ev.get("compile_s"), (int, float)) and ev["compile_s"]:
+        _REGISTRY.counter("srj_tpu_span_xla_compile_seconds_total",
+                          "XLA compile seconds attributed per op.",
+                          ("op",)).inc(float(ev["compile_s"]), op=op)
+
+
+def observe_event(ev: Dict) -> None:
+    """Fold one obs event into the default registry.  ``spans.emit``
+    calls this for every recorded event, which is what makes the live
+    ``/metrics`` exposition match the JSONL report with no extra
+    call-site code.  Never raises."""
+    try:
+        kind = ev.get("kind")
+        if kind == "span":
+            _observe_span(ev)
+        elif kind == "compile":
+            _REGISTRY.counter("srj_tpu_xla_compiles_total",
+                              "XLA backend compiles observed.").inc()
+            d = ev.get("duration_s")
+            if isinstance(d, (int, float)):
+                _REGISTRY.counter("srj_tpu_xla_compile_seconds_total",
+                                  "Seconds spent in XLA backend compiles."
+                                  ).inc(float(d))
+        elif kind == "fault":
+            _REGISTRY.counter("srj_tpu_fault_injections_total",
+                              "Injected faults fired, by domain.",
+                              ("domain",)).inc(
+                                  domain=str(ev.get("domain", "?")))
+    except Exception:
+        pass

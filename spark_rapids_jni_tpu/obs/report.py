@@ -19,6 +19,11 @@ import json
 import sys
 from typing import Dict, Iterable, List, Optional
 
+from spark_rapids_jni_tpu.obs.metrics import (
+    escape_label_value as _label,
+    format_exposition as _format_exposition,
+)
+
 
 def load_events(path: str) -> Iterable[Dict]:
     """Yield events from a JSONL file, skipping blank/corrupt lines (a
@@ -57,6 +62,7 @@ def summarize(events: Iterable[Dict]) -> Dict:
     ops: Dict[str, Dict] = {}
     faults = {"total": 0, "rejected": 0, "by_domain": {}}
     compiles = {"count": 0, "seconds": 0.0}
+    dropped = {"events_dropped": 0, "sink_errors": 0}
     for ev in events:
         kind = ev.get("kind")
         if kind == "span":
@@ -92,12 +98,19 @@ def summarize(events: Iterable[Dict]) -> Dict:
             compiles["count"] += 1
             if isinstance(ev.get("duration_s"), (int, float)):
                 compiles["seconds"] += float(ev["duration_s"])
+        elif kind == "obs_meta":
+            # cumulative truncation counters flushed by the writer; later
+            # records supersede earlier ones
+            for key in dropped:
+                if isinstance(ev.get(key), int):
+                    dropped[key] = max(dropped[key], ev[key])
     for s in ops.values():
         wall = sorted(s.pop("wall"))
         s["wall_p50_s"] = _pct(wall, 50)
         s["wall_p95_s"] = _pct(wall, 95)
         s["wall_sum_s"] = sum(wall)
-    return {"ops": ops, "faults": faults, "compiles": compiles}
+    return {"ops": ops, "faults": faults, "compiles": compiles,
+            "dropped": dropped}
 
 
 def _ms(v: Optional[float]) -> str:
@@ -139,85 +152,82 @@ def format_table(summary: Dict) -> str:
                          in sorted(faults["by_domain"].items()))
         lines.append(f"injected faults: {faults['total']} ({doms}; "
                      f"{faults['rejected']} device-dead rejections)")
+    dropped = summary.get("dropped") or {}
+    if dropped.get("events_dropped") or dropped.get("sink_errors"):
+        lines.append(
+            f"WARNING: telemetry truncated — "
+            f"{dropped.get('events_dropped', 0)} events dropped from ring, "
+            f"{dropped.get('sink_errors', 0)} sink write errors "
+            f"(raise SRJ_TPU_OBS_RING or fix SRJ_TPU_EVENTS path)")
     return "\n".join(lines)
 
 
-def _label(v: str) -> str:
-    """Escape a Prometheus label value."""
-    return v.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+# per-op counter families: (family name, help, value-from-stats); the
+# names match what the live registry exposes, so a /metrics scrape and a
+# post-run report feed the same dashboard
+_PER_OP_FAMILIES = (
+    ("srj_tpu_span_calls_total", "Span invocations per op.",
+     lambda s: s["calls"]),
+    ("srj_tpu_span_failures_total", "Failed span invocations per op.",
+     lambda s: s["failures"]),
+    ("srj_tpu_span_wall_seconds_total", "Host wall seconds per op.",
+     lambda s: f"{s['wall_sum_s']:.6f}"),
+    ("srj_tpu_span_device_seconds_total",
+     "Device-completion seconds per op (fenced spans only).",
+     lambda s: f"{s['device_s']:.6f}"),
+    ("srj_tpu_span_rows_total", "Rows processed per op.",
+     lambda s: s["rows"]),
+    ("srj_tpu_span_bytes_total", "Bytes processed per op.",
+     lambda s: s["bytes"]),
+    ("srj_tpu_span_h2d_bytes_total", "Host-to-device bytes staged per op.",
+     lambda s: s.get("h2d_bytes", 0)),
+    ("srj_tpu_span_d2h_bytes_total", "Device-to-host bytes fetched per op.",
+     lambda s: s.get("d2h_bytes", 0)),
+    ("srj_tpu_span_transfers_total",
+     "Host/device boundary transfers per op.",
+     lambda s: s.get("transfer_count", 0)),
+    ("srj_tpu_span_xla_compiles_total",
+     "XLA backend compiles attributed per op.",
+     lambda s: s["compiles"]),
+)
 
 
 def format_prometheus(summary: Dict) -> str:
     """Prometheus text exposition of the same aggregates (counter
-    semantics: totals over the life of the event log)."""
-    out = []
-
-    def metric(name, help_, rows):
-        out.append(f"# HELP {name} {help_}")
-        out.append(f"# TYPE {name} counter")
-        out.extend(rows)
-
+    semantics: totals over the life of the event log).  Rendered through
+    the serializer the live registry uses, so the two sources are
+    byte-format compatible."""
     ops = summary["ops"]
-
-    def per_op(fmt):
-        return [fmt(name, s) for name, s in sorted(ops.items())]
-
-    metric("srj_tpu_span_calls_total", "Span invocations per op.",
-           per_op(lambda n, s:
-                  f'srj_tpu_span_calls_total{{op="{_label(n)}"}} '
-                  f'{s["calls"]}'))
-    metric("srj_tpu_span_failures_total", "Failed span invocations per op.",
-           per_op(lambda n, s:
-                  f'srj_tpu_span_failures_total{{op="{_label(n)}"}} '
-                  f'{s["failures"]}'))
-    metric("srj_tpu_span_wall_seconds_total", "Host wall seconds per op.",
-           per_op(lambda n, s:
-                  f'srj_tpu_span_wall_seconds_total{{op="{_label(n)}"}} '
-                  f'{s["wall_sum_s"]:.6f}'))
-    metric("srj_tpu_span_device_seconds_total",
-           "Device-completion seconds per op (fenced spans only).",
-           per_op(lambda n, s:
-                  f'srj_tpu_span_device_seconds_total{{op="{_label(n)}"}} '
-                  f'{s["device_s"]:.6f}'))
-    metric("srj_tpu_span_rows_total", "Rows processed per op.",
-           per_op(lambda n, s:
-                  f'srj_tpu_span_rows_total{{op="{_label(n)}"}} '
-                  f'{s["rows"]}'))
-    metric("srj_tpu_span_bytes_total", "Bytes processed per op.",
-           per_op(lambda n, s:
-                  f'srj_tpu_span_bytes_total{{op="{_label(n)}"}} '
-                  f'{s["bytes"]}'))
-    metric("srj_tpu_span_h2d_bytes_total",
-           "Host-to-device bytes staged per op.",
-           per_op(lambda n, s:
-                  f'srj_tpu_span_h2d_bytes_total{{op="{_label(n)}"}} '
-                  f'{s.get("h2d_bytes", 0)}'))
-    metric("srj_tpu_span_d2h_bytes_total",
-           "Device-to-host bytes fetched per op.",
-           per_op(lambda n, s:
-                  f'srj_tpu_span_d2h_bytes_total{{op="{_label(n)}"}} '
-                  f'{s.get("d2h_bytes", 0)}'))
-    metric("srj_tpu_span_transfers_total",
-           "Host/device boundary transfers per op.",
-           per_op(lambda n, s:
-                  f'srj_tpu_span_transfers_total{{op="{_label(n)}"}} '
-                  f'{s.get("transfer_count", 0)}'))
-    metric("srj_tpu_span_xla_compiles_total",
-           "XLA backend compiles attributed per op.",
-           per_op(lambda n, s:
-                  f'srj_tpu_span_xla_compiles_total{{op="{_label(n)}"}} '
-                  f'{s["compiles"]}'))
+    families = []
+    for name, help_, value_of in _PER_OP_FAMILIES:
+        families.append((name, "counter", help_,
+                         [(name, {"op": op}, value_of(s))
+                          for op, s in sorted(ops.items())]))
     comp = summary["compiles"]
-    metric("srj_tpu_xla_compiles_total", "XLA backend compiles observed.",
-           [f"srj_tpu_xla_compiles_total {comp['count']}"])
-    metric("srj_tpu_xla_compile_seconds_total",
-           "Seconds spent in XLA backend compiles.",
-           [f"srj_tpu_xla_compile_seconds_total {comp['seconds']:.6f}"])
-    metric("srj_tpu_fault_injections_total",
-           "Injected faults fired, by domain.",
-           [f'srj_tpu_fault_injections_total{{domain="{_label(d)}"}} {c}'
-            for d, c in sorted(summary["faults"]["by_domain"].items())])
-    return "\n".join(out) + "\n"
+    families.append(
+        ("srj_tpu_xla_compiles_total", "counter",
+         "XLA backend compiles observed.",
+         [("srj_tpu_xla_compiles_total", {}, comp["count"])]))
+    families.append(
+        ("srj_tpu_xla_compile_seconds_total", "counter",
+         "Seconds spent in XLA backend compiles.",
+         [("srj_tpu_xla_compile_seconds_total", {},
+           f"{comp['seconds']:.6f}")]))
+    families.append(
+        ("srj_tpu_fault_injections_total", "counter",
+         "Injected faults fired, by domain.",
+         [("srj_tpu_fault_injections_total", {"domain": d}, c)
+          for d, c in sorted(summary["faults"]["by_domain"].items())]))
+    dropped = summary.get("dropped") or {}
+    if dropped.get("events_dropped") or dropped.get("sink_errors"):
+        families.append(
+            ("srj_tpu_obs_events_dropped_total", "counter",
+             "Obs events lost to ring eviction or sink failure.",
+             [("srj_tpu_obs_events_dropped_total", {"reason": "ring"},
+               dropped.get("events_dropped", 0)),
+              ("srj_tpu_obs_events_dropped_total", {"reason": "sink"},
+               dropped.get("sink_errors", 0))]))
+    return _format_exposition(families)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -230,12 +240,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="Prometheus text exposition instead of the table")
     ap.add_argument("--json", action="store_true",
                     help="raw summary dict as JSON")
+    ap.add_argument("--trace", metavar="OUT",
+                    help="write a Chrome/Perfetto trace_event JSON to OUT "
+                         "(open at https://ui.perfetto.dev)")
     args = ap.parse_args(argv)
     try:
         events = list(load_events(args.path))
     except OSError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if args.trace:
+        from spark_rapids_jni_tpu.obs.trace import write_trace
+        n = write_trace(events, args.trace)
+        print(f"wrote {n} trace events to {args.trace}", file=sys.stderr)
+        return 0 if events else 1
     summary = summarize(events)
     if args.json:
         print(json.dumps(summary, indent=2))

@@ -44,6 +44,7 @@ from typing import Dict, List, Optional
 import jax
 
 from spark_rapids_jni_tpu.utils import metrics as _metrics
+from spark_rapids_jni_tpu.obs.metrics import observe_event as _observe_event
 
 _RING_CAP = int(os.environ.get("SRJ_TPU_OBS_RING", "4096"))
 
@@ -55,6 +56,12 @@ class _State:
         self.sink_path: Optional[str] = None
         self.sink = None
         self.ring = collections.deque(maxlen=_RING_CAP)
+        # truncation accounting: ring evictions and sink write failures.
+        # Silently-partial telemetry reads as complete telemetry, so every
+        # drop is counted, scrapeable, and stamped into the JSONL log
+        # (kind="obs_meta") at flush/disable time.
+        self.events_dropped = 0
+        self.sink_errors = 0
 
 
 _STATE = _State()
@@ -87,6 +94,7 @@ def disable() -> None:
     stays configured; :func:`enable` re-opens it on the next event."""
     with _STATE.lock:
         _STATE.enabled = False
+        _write_meta_locked()
         _close_sink_locked()
 
 
@@ -134,9 +142,38 @@ def flush() -> None:
     with _STATE.lock:
         if _STATE.sink is not None:
             try:
+                _write_meta_locked()
                 _STATE.sink.flush()
             except Exception:
                 pass
+
+
+def dropped() -> Dict[str, int]:
+    """Truncation counters: ``events_dropped`` (ring evictions — the
+    in-process :func:`events` snapshot is missing at least that many) and
+    ``sink_errors`` (JSONL write/open failures — the log on disk is
+    missing events)."""
+    with _STATE.lock:
+        return {"events_dropped": _STATE.events_dropped,
+                "sink_errors": _STATE.sink_errors}
+
+
+def _write_meta_locked() -> None:
+    """Stamp a ``kind="obs_meta"`` truncation record into the sink (only
+    when something was actually dropped), so the offline report can warn
+    that the log is incomplete."""
+    if _STATE.sink is None:
+        return
+    if not (_STATE.events_dropped or _STATE.sink_errors):
+        return
+    meta = {"kind": "obs_meta", "ts": time.time(),
+            "events_dropped": _STATE.events_dropped,
+            "sink_errors": _STATE.sink_errors,
+            "ring_cap": _RING_CAP}
+    try:
+        _STATE.sink.write(json.dumps(meta) + "\n")
+    except Exception:
+        pass
 
 
 # ---------------------------------------------------------------------------
@@ -145,26 +182,50 @@ def flush() -> None:
 
 def emit(event: Dict) -> None:
     """Record one event (no-op unless enabled): append to the ring buffer
-    and, when a sink is configured, write one JSON line.  Never raises —
-    observability must not take down the operation it observes."""
+    (counting the eviction when the ring is full), write one JSON line
+    when a sink is configured (counting write/open failures), and fold
+    the event into the live metrics registry
+    (:func:`~spark_rapids_jni_tpu.obs.metrics.observe_event`).  Never
+    raises — observability must not take down the operation it
+    observes."""
     if not _STATE.enabled:
         return
     ev = dict(event)
     ev.setdefault("ts", time.time())
     try:
         with _STATE.lock:
+            if len(_STATE.ring) == _STATE.ring.maxlen:
+                # the deque evicts silently; the count is what tells a
+                # ring consumer its snapshot is partial
+                _STATE.events_dropped += 1
+                _count_drop("ring")
             _STATE.ring.append(ev)
             if _STATE.sink is None and _STATE.sink_path:
                 try:
                     _STATE.sink = open(_STATE.sink_path, "a")
                 except OSError:
                     _STATE.sink_path = None  # bad path: drop, keep the ring
+                    _STATE.sink_errors += 1
+                    _count_drop("sink")
             if _STATE.sink is not None:
                 try:
                     _STATE.sink.write(json.dumps(ev, default=str) + "\n")
                     _STATE.sink.flush()
                 except Exception:
                     _close_sink_locked()
+                    _STATE.sink_errors += 1
+                    _count_drop("sink")
+        _observe_event(ev)
+    except Exception:
+        pass
+
+
+def _count_drop(reason: str) -> None:
+    try:
+        from spark_rapids_jni_tpu.obs import metrics as _m
+        _m.counter("srj_tpu_obs_events_dropped_total",
+                   "Obs events lost to ring eviction or sink failure.",
+                   ("reason",)).inc(reason=reason)
     except Exception:
         pass
 

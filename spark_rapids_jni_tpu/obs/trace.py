@@ -1,0 +1,201 @@
+"""Span events -> Chrome/Perfetto ``trace_event`` JSON.
+
+The report CLI turns an event log into a table; this module turns it into
+a *timeline* a human can open in Perfetto (https://ui.perfetto.dev) or
+``chrome://tracing`` — one lane per thread (the main thread, the staging
+``srj-staging-prefetch`` worker, anything else that ran spans), nested
+duration events reconstructed from span completion records, and counter
+tracks for XLA compiles and host<->device transfer bytes.
+
+``python -m spark_rapids_jni_tpu.obs events.jsonl --trace out.json``
+converts a JSONL log; :func:`trace_events` converts any in-memory event
+list (e.g. the live ring, ``obs.events()``).
+
+Reconstruction notes.  Spans are recorded at *completion* (``ts`` is the
+end wall-clock, ``wall_s`` the duration measured on ``perf_counter``), so
+a span's start is ``ts - wall_s`` — two different clocks, which can skew
+child intervals a few microseconds outside their parent.  Because events
+arrive in completion order and carry ``depth``/``thread``, the converter
+rebuilds the exact nesting tree per thread and clamps every child subtree
+into its parent's interval: the emitted stream is guaranteed
+well-nested.  Spans with children emit ``B``/``E`` duration pairs, leaf
+spans emit single ``X`` complete events, counters emit ``C`` samples, and
+thread/process names ride ``M`` metadata records — the four phases a
+trace viewer needs, all well-formed by construction.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List
+
+__all__ = ["trace_events", "write_trace"]
+
+
+class _Node:
+    __slots__ = ("name", "start", "end", "args", "children")
+
+    def __init__(self, name: str, start: float, end: float, args: Dict):
+        self.name = name
+        self.start = start
+        self.end = end
+        self.args = args
+        self.children: List["_Node"] = []
+
+    def clamp(self, lo: float, hi: float) -> None:
+        """Clamp this subtree into ``[lo, hi]`` (clock-skew repair: spans
+        mix a wall-clock end with a perf_counter duration, so a child can
+        compute to start microseconds before its parent)."""
+        self.start = min(max(self.start, lo), hi)
+        self.end = min(max(self.end, self.start), hi)
+        for c in self.children:
+            c.clamp(self.start, self.end)
+
+
+# span attributes that are either structural (reconstructed) or huge;
+# everything else (rows, bytes, bucket, error, ...) rides into args
+_SKIP_ATTRS = {"kind", "name", "status", "wall_s", "ts", "depth", "parent",
+               "thread"}
+
+
+def _span_args(ev: Dict) -> Dict:
+    args = {}
+    for k, v in ev.items():
+        if k in _SKIP_ATTRS:
+            continue
+        if isinstance(v, (str, int, float, bool)) or v is None:
+            args[k] = v
+        else:
+            args[k] = str(v)
+    if ev.get("status") == "error":
+        args["status"] = "error"
+    return args
+
+
+def _build_thread_trees(events: Iterable[Dict]) -> Dict[str, List[_Node]]:
+    """Per-thread root span trees, nesting reconstructed from completion
+    order + ``depth`` (children complete before their parent, so when a
+    span at depth ``d`` completes, every pending node at ``d+1`` on its
+    thread is one of its children)."""
+    pending: Dict[str, Dict[int, List[_Node]]] = {}
+    roots: Dict[str, List[_Node]] = {}
+    for ev in events:
+        if ev.get("kind") != "span":
+            continue
+        wall = ev.get("wall_s")
+        end = ev.get("ts")
+        if not isinstance(wall, (int, float)) \
+                or not isinstance(end, (int, float)):
+            continue
+        thread = str(ev.get("thread", "MainThread"))
+        depth = ev.get("depth")
+        depth = int(depth) if isinstance(depth, int) else 0
+        node = _Node(str(ev.get("name", "?")), float(end) - float(wall),
+                     float(end), _span_args(ev))
+        by_depth = pending.setdefault(thread, {})
+        kids = by_depth.pop(depth + 1, [])
+        for k in kids:
+            k.clamp(node.start, node.end)
+        node.children = kids
+        if depth == 0:
+            roots.setdefault(thread, []).append(node)
+        else:
+            by_depth.setdefault(depth, []).append(node)
+    # spans whose parent never completed (ring truncation, crash mid-op):
+    # surface them as roots rather than dropping them
+    for thread, by_depth in pending.items():
+        for d in sorted(by_depth):
+            roots.setdefault(thread, []).extend(by_depth[d])
+    return roots
+
+
+def _emit_span(node: _Node, out: List[Dict], pid: int, tid: int,
+               scale: float, t0: float) -> None:
+    ts = (node.start - t0) * scale
+    dur = (node.end - node.start) * scale
+    if node.children:
+        out.append({"ph": "B", "name": node.name, "pid": pid, "tid": tid,
+                    "ts": ts, "args": node.args})
+        for c in node.children:
+            _emit_span(c, out, pid, tid, scale, t0)
+        out.append({"ph": "E", "name": node.name, "pid": pid, "tid": tid,
+                    "ts": ts + dur})
+    else:
+        out.append({"ph": "X", "name": node.name, "pid": pid, "tid": tid,
+                    "ts": ts, "dur": dur, "args": node.args})
+
+
+def trace_events(events: Iterable[Dict], pid: int = 0) -> Dict:
+    """Convert an obs event stream (JSONL records or the live ring) to a
+    Chrome ``trace_event`` document: ``{"traceEvents": [...],
+    "displayTimeUnit": "ms"}``, timestamps in microseconds relative to
+    the earliest span/counter sample."""
+    events = [e for e in events if isinstance(e, dict)]
+    roots = _build_thread_trees(events)
+
+    # time origin: earliest span start or counter sample, so ts stays
+    # small and positive for the viewer
+    starts = [n.start for nodes in roots.values() for n in nodes]
+    starts += [e["ts"] for e in events
+               if e.get("kind") in ("compile", "fault")
+               and isinstance(e.get("ts"), (int, float))]
+    t0 = min(starts) if starts else 0.0
+    scale = 1e6  # seconds -> microseconds
+
+    out: List[Dict] = [{
+        "ph": "M", "name": "process_name", "pid": pid,
+        "args": {"name": "spark_rapids_jni_tpu"}}]
+
+    # stable lanes: MainThread first, then first-appearance order (the
+    # staging prefetch worker lands in its own lane by thread name)
+    names = sorted(roots, key=lambda n: (n != "MainThread",))
+    tids = {}
+    for name in names:
+        tid = tids[name] = len(tids)
+        out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                    "tid": tid, "args": {"name": name}})
+    for name in names:
+        for node in roots[name]:
+            _emit_span(node, out, pid, tids[name], scale, t0)
+
+    # counter tracks: cumulative XLA compiles/compile-seconds and
+    # host<->device transfer bytes over time
+    compiles = 0
+    compile_s = 0.0
+    h2d = d2h = 0
+    for ev in events:
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)):
+            continue
+        if ev.get("kind") == "compile":
+            compiles += 1
+            if isinstance(ev.get("duration_s"), (int, float)):
+                compile_s += float(ev["duration_s"])
+            out.append({"ph": "C", "name": "xla_compiles", "pid": pid,
+                        "ts": (ts - t0) * scale,
+                        "args": {"count": compiles,
+                                 "seconds": round(compile_s, 6)}})
+        elif ev.get("kind") == "span" and (
+                isinstance(ev.get("h2d_bytes"), (int, float))
+                or isinstance(ev.get("d2h_bytes"), (int, float))):
+            h2d += int(ev.get("h2d_bytes") or 0)
+            d2h += int(ev.get("d2h_bytes") or 0)
+            out.append({"ph": "C", "name": "transfer_bytes", "pid": pid,
+                        "ts": (ts - t0) * scale,
+                        "args": {"h2d": h2d, "d2h": d2h}})
+
+    # non-metadata events sorted by time; python's stable sort keeps the
+    # tree-walk order (B before children before E) across equal stamps
+    meta = [e for e in out if e["ph"] == "M"]
+    rest = sorted((e for e in out if e["ph"] != "M"),
+                  key=lambda e: e["ts"])
+    return {"traceEvents": meta + rest, "displayTimeUnit": "ms"}
+
+
+def write_trace(events: Iterable[Dict], path: str, pid: int = 0) -> int:
+    """Write :func:`trace_events` output as JSON; returns the number of
+    trace records written."""
+    doc = trace_events(events, pid=pid)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return len(doc["traceEvents"])

@@ -30,7 +30,8 @@ from typing import Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from spark_rapids_jni_tpu.utils.compat import shard_map
 
 from spark_rapids_jni_tpu.table import Column, Table
 from spark_rapids_jni_tpu.obs import span_fn

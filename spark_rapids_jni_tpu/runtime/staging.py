@@ -68,6 +68,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_jni_tpu import memory
+from spark_rapids_jni_tpu.obs import metrics as _obs_metrics
 from spark_rapids_jni_tpu.obs import spans
 from spark_rapids_jni_tpu.runtime import shapes
 from spark_rapids_jni_tpu.table import (
@@ -408,17 +409,26 @@ def prefetch(items, stage_fn, depth: int = 2):
     prefetches implicitly."""
     if depth < 1:
         raise ValueError("prefetch depth must be >= 1")
+    qdepth = _obs_metrics.gauge(
+        "srj_tpu_prefetch_queue_depth",
+        "Batches staged ahead of the consumer by the prefetch worker.")
     ex = concurrent.futures.ThreadPoolExecutor(
         max_workers=1, thread_name_prefix="srj-staging-prefetch")
     try:
         pending = collections.deque()
         for item in items:
             pending.append(ex.submit(stage_fn, item))
+            qdepth.set(len(pending))
             while len(pending) > depth:
-                yield pending.popleft().result()
+                fut = pending.popleft()
+                qdepth.set(len(pending))
+                yield fut.result()
         while pending:
-            yield pending.popleft().result()
+            fut = pending.popleft()
+            qdepth.set(len(pending))
+            yield fut.result()
     finally:
+        qdepth.set(0)
         ex.shutdown(wait=False)
 
 

@@ -1,0 +1,53 @@
+"""Version-robust wrappers over jax API churn.
+
+``shard_map`` has moved twice across the jax releases this repo meets in
+the wild: it started life at ``jax.experimental.shard_map.shard_map``,
+was promoted to ``jax.shard_map``, and its replication-check kwarg was
+renamed ``check_rep`` -> ``check_vma`` in the same window.  Importing the
+new spelling on an old jax raises ImportError at module-import time and
+takes every test that transitively touches ``parallel/`` down with it
+(collection errors, not failures), so the resolution here happens once,
+lazily, and tolerates both homes and both kwarg spellings.
+
+Call sites use the modern spelling (``check_vma=``); :func:`shard_map`
+translates to ``check_rep=`` when that is what the installed jax takes.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+_IMPL = None
+_CHECK_KWARG = None     # "check_vma" | "check_rep" | None (neither known)
+
+
+def _resolve():
+    global _IMPL, _CHECK_KWARG
+    if _IMPL is not None:
+        return _IMPL
+    import jax
+    impl = getattr(jax, "shard_map", None)
+    if impl is None or not callable(impl):
+        from jax.experimental.shard_map import shard_map as impl
+    try:
+        params = set(inspect.signature(impl).parameters)
+    except (TypeError, ValueError):
+        params = set()
+    if "check_vma" in params:
+        _CHECK_KWARG = "check_vma"
+    elif "check_rep" in params:
+        _CHECK_KWARG = "check_rep"
+    _IMPL = impl
+    return impl
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kwargs):
+    """``jax.shard_map`` resolved against the installed jax: falls back to
+    ``jax.experimental.shard_map.shard_map`` and maps ``check_vma`` onto
+    ``check_rep`` for versions that predate the rename (dropping it when
+    the installed signature takes neither)."""
+    impl = _resolve()
+    if check_vma is not None and _CHECK_KWARG is not None:
+        kwargs[_CHECK_KWARG] = check_vma
+    return impl(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                **kwargs)

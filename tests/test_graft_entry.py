@@ -98,8 +98,14 @@ def _run_bare_subprocess(code):
                           capture_output=True, text=True, timeout=600)
 
 
+@pytest.mark.slow
 def test_dryrun_bare_env_subprocess():
     """dryrun_multichip(8) must pass in a BARE process.
+
+    Marked slow: the bare interpreter has neither the conftest's
+    persistent compile cache nor its XLA_FLAGS pre-provisioning, so the
+    multichip pipeline cold-compiles for minutes.  The in-process
+    ``test_dryrun_multichip_8`` keeps the dryrun contract in tier-1.
 
     The round-1 and round-2 gate failures were invisible in-process: this
     conftest pre-provisions 8 CPU devices via XLA_FLAGS, so any test here
@@ -115,8 +121,12 @@ def test_dryrun_bare_env_subprocess():
     assert "DRYRUN_OK" in proc.stdout
 
 
+@pytest.mark.slow
 def test_dryrun_bare_env_subprocess_broken_default_backend():
     """The dryrun must pass even when every non-CPU backend CANNOT init.
+
+    Marked slow for the same cold-compile reason as
+    ``test_dryrun_bare_env_subprocess``.
 
     A healthy local default backend masks accidental default-backend
     dispatch (e.g. a module-level eager ``jnp.uint32`` constant executed

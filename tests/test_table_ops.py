@@ -796,7 +796,7 @@ def test_grouped_survives_shuffle_roundtrip(rng, cpu_devices):
     decodes straight back to planes — content preserved."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax import shard_map
+    from spark_rapids_jni_tpu.utils.compat import shard_map
     from spark_rapids_jni_tpu.parallel import make_mesh
     from spark_rapids_jni_tpu.parallel.shuffle import bucket_exchange
     from spark_rapids_jni_tpu.ops.row_mxu import (

@@ -1,0 +1,363 @@
+"""Live-telemetry tests: metrics registry semantics and thread safety,
+span -> registry feed, HTTP exporter (/metrics scrape over a real socket,
+/healthz shape, off-by-default), Perfetto trace export well-formedness,
+ring-drop accounting, the faultinj direct counter, and the CI
+perf-regression gate (synthetic regression must fail, real history must
+pass)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.request
+
+import pytest
+
+from spark_rapids_jni_tpu import faultinj, obs
+from spark_rapids_jni_tpu.obs import exporter, metrics, report, spans, trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def obs_on():
+    """Enabled obs with a clean ring, no sink, and a zeroed registry."""
+    obs.configure_sink(None)
+    obs.clear()
+    metrics.registry().reset()
+    obs.enable()
+    yield
+    obs.disable()
+    obs.configure_sink(None)
+    obs.clear()
+    metrics.registry().reset()
+
+
+@pytest.fixture
+def live_exporter(obs_on):
+    """Exporter on an ephemeral port, torn down after the test."""
+    port = exporter.start(0)
+    assert port is not None
+    yield port
+    exporter.stop()
+
+
+def _scrape(port, path="/metrics"):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+        return resp.headers, resp.read().decode("utf-8")
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_roundtrip(obs_on):
+    reg = metrics.registry()
+    reg.counter("t_requests_total", "x", ("op",)).inc(3, op="a")
+    reg.counter("t_requests_total", "x", ("op",)).inc(op="a")
+    reg.gauge("t_depth").set(7)
+    reg.histogram("t_lat_seconds", "x", buckets=(0.1, 1.0)).observe(0.05)
+    reg.histogram("t_lat_seconds", "x", buckets=(0.1, 1.0)).observe(0.5)
+    text = metrics.format_prometheus()
+    assert 't_requests_total{op="a"} 4' in text
+    assert "t_depth 7" in text
+    assert '# TYPE t_depth gauge' in text
+    assert 't_lat_seconds_bucket{le="0.1"} 1' in text
+    assert 't_lat_seconds_bucket{le="1"} 2' in text
+    assert 't_lat_seconds_bucket{le="+Inf"} 2' in text
+    assert 't_lat_seconds_count 2' in text
+
+
+def test_kind_mismatch_is_a_programming_error(obs_on):
+    metrics.counter("t_mismatch_total")
+    with pytest.raises(ValueError):
+        metrics.gauge("t_mismatch_total")
+
+
+def test_label_escaping():
+    assert metrics.escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+
+
+def test_registry_thread_safety_under_concurrent_spans(obs_on):
+    """N threads x M spans each: every completion lands exactly once in
+    the per-op call counter (the registry is fed from emit, which runs
+    concurrently on every spanning thread)."""
+    n_threads, n_spans = 8, 50
+
+    def worker(i):
+        for _ in range(n_spans):
+            with obs.span(f"conc_op_{i % 2}", rows=1):
+                pass
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = metrics.registry().snapshot()
+    calls = snap["srj_tpu_span_calls_total"]["values"]
+    assert sum(calls.values()) == n_threads * n_spans
+    rows = snap["srj_tpu_span_rows_total"]["values"]
+    assert sum(rows.values()) == n_threads * n_spans
+
+
+def test_span_completion_feeds_registry_families(obs_on):
+    with obs.span("fed_op", rows=11, h2d_bytes=256, transfer_count=2,
+                  padded_rows=5):
+        pass
+    snap = metrics.registry().snapshot()
+    assert snap["srj_tpu_span_calls_total"]["values"]["op=fed_op"] == 1
+    assert snap["srj_tpu_span_rows_total"]["values"]["op=fed_op"] == 11
+    assert snap["srj_tpu_span_h2d_bytes_total"]["values"]["op=fed_op"] == 256
+    assert snap["srj_tpu_span_transfers_total"]["values"]["op=fed_op"] == 2
+    assert snap["srj_tpu_pad_rows_total"]["values"]["op=fed_op"] == 5
+    hist = snap["srj_tpu_span_wall_seconds"]["values"]["op=fed_op"]
+    assert hist["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# HTTP exporter
+# ---------------------------------------------------------------------------
+
+def test_exporter_off_by_default():
+    """No env var, no explicit start: no exporter thread, no socket."""
+    assert not exporter.running()
+    assert exporter.port() is None
+    assert not any(t.name == "srj-obs-exporter"
+                   for t in threading.enumerate())
+
+
+def test_metrics_scrape_over_real_socket(live_exporter):
+    with obs.span("scraped_op", rows=5, bytes=40):
+        with obs.span("scraped_child"):
+            pass
+    headers, body = _scrape(live_exporter)
+    assert headers["Content-Type"].startswith("text/plain; version=0.0.4")
+    assert 'srj_tpu_span_calls_total{op="scraped_op"} 1' in body
+    assert 'srj_tpu_span_calls_total{op="scraped_child"} 1' in body
+    assert 'srj_tpu_span_rows_total{op="scraped_op"} 5' in body
+    assert 'srj_tpu_span_bytes_total{op="scraped_op"} 40' in body
+    assert '# TYPE srj_tpu_span_wall_seconds histogram' in body
+
+
+def test_scrape_matches_report_prom_families(live_exporter, tmp_path):
+    """The acceptance contract: a mid-flight scrape exposes the same
+    per-op families, with the same values, as the post-run JSONL report."""
+    log = tmp_path / "ev.jsonl"
+    obs.configure_sink(str(log))
+    with obs.span("parity_op", rows=9):
+        pass
+    obs.flush()
+    _, live = _scrape(live_exporter)
+    offline = report.format_prometheus(
+        report.summarize(report.load_events(str(log))))
+    for needle in ('srj_tpu_span_calls_total{op="parity_op"} 1',
+                   'srj_tpu_span_rows_total{op="parity_op"} 9'):
+        assert needle in live
+        assert needle in offline
+
+
+def test_healthz_shape(live_exporter):
+    with obs.span("hz_op"):
+        pass
+    headers, body = _scrape(live_exporter, "/healthz")
+    assert headers["Content-Type"] == "application/json"
+    hz = json.loads(body)
+    assert hz["status"] == "ok"
+    assert hz["obs_enabled"] is True
+    assert hz["ring_events"] >= 1
+    assert {"uptime_s", "xla_compiles", "xla_compile_seconds",
+            "events_dropped", "sink_errors"} <= set(hz)
+
+
+def test_exporter_404_and_idempotent_start(live_exporter):
+    with pytest.raises(urllib.error.HTTPError):
+        _scrape(live_exporter, "/nope")
+    # second start returns the live port instead of double-binding
+    assert exporter.start(0) == live_exporter
+
+
+# ---------------------------------------------------------------------------
+# Perfetto trace export
+# ---------------------------------------------------------------------------
+
+def _run_trace_workload():
+    with obs.span("outer", rows=4):
+        with obs.span("mid"):
+            with obs.span("leaf", h2d_bytes=64):
+                pass
+        with obs.span("leaf2"):
+            pass
+    def bg():
+        with obs.span("bg"):
+            pass
+
+    t = threading.Thread(target=bg, name="worker-1")
+    t.start()
+    t.join()
+
+
+def test_trace_phases_well_formed(obs_on):
+    _run_trace_workload()
+    doc = trace.trace_events(obs.events())
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    evs = doc["traceEvents"]
+    for e in evs:
+        assert e["ph"] in ("M", "B", "E", "X", "C")
+        if e["ph"] in ("B", "E", "X", "C"):
+            assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+    # spans with children became B/E, leaves became X, transfers became C
+    assert any(e["ph"] == "B" and e["name"] == "outer" for e in evs)
+    assert any(e["ph"] == "X" and e["name"] == "leaf" for e in evs)
+    assert any(e["ph"] == "C" and e["name"] == "transfer_bytes"
+               for e in evs)
+
+
+def test_trace_per_thread_nesting_balanced(obs_on):
+    _run_trace_workload()
+    evs = trace.trace_events(obs.events())["traceEvents"]
+    depth = {}
+    for e in evs:
+        if e["ph"] == "B":
+            depth[e["tid"]] = depth.get(e["tid"], 0) + 1
+        elif e["ph"] == "E":
+            depth[e["tid"]] = depth.get(e["tid"], 0) - 1
+            assert depth[e["tid"]] >= 0, "E without matching B"
+    assert all(v == 0 for v in depth.values())
+    lanes = {e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert "MainThread" in lanes and "worker-1" in lanes
+
+
+def test_trace_children_clamped_into_parent(obs_on):
+    _run_trace_workload()
+    evs = trace.trace_events(obs.events())["traceEvents"]
+    stack = []
+    for e in evs:
+        if e["ph"] == "B":
+            if stack:
+                assert e["ts"] >= stack[-1]["ts"]
+            stack.append(e)
+        elif e["ph"] == "X" and stack:
+            assert e["ts"] >= stack[-1]["ts"]
+        elif e["ph"] == "E":
+            stack.pop()
+
+
+def test_trace_cli_writes_loadable_json(obs_on, tmp_path):
+    log = tmp_path / "ev.jsonl"
+    obs.configure_sink(str(log))
+    _run_trace_workload()
+    obs.flush()
+    out = tmp_path / "trace.json"
+    rc = report.main([str(log), "--trace", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["traceEvents"]
+
+
+# ---------------------------------------------------------------------------
+# Ring-drop accounting
+# ---------------------------------------------------------------------------
+
+def test_ring_eviction_counted_and_reported(obs_on, tmp_path, monkeypatch):
+    cap = spans._RING_CAP
+    base = obs.dropped()["events_dropped"]
+    for i in range(cap + 25):
+        obs.emit({"kind": "probe", "i": i})
+    d = obs.dropped()
+    assert d["events_dropped"] - base == 25
+    snap = metrics.registry().snapshot()
+    drops = snap["srj_tpu_obs_events_dropped_total"]["values"]
+    assert drops.get("reason=ring", 0) >= 25
+    # the meta record reaches the JSONL log on flush and the report
+    # surfaces it as a truncation warning
+    log = tmp_path / "ev.jsonl"
+    obs.configure_sink(str(log))
+    obs.emit({"kind": "probe", "i": -1})
+    obs.flush()
+    summary = report.summarize(report.load_events(str(log)))
+    assert summary["dropped"]["events_dropped"] >= 25
+    table = report.format_table(summary)
+    assert "telemetry truncated" in table
+    prom = report.format_prometheus(summary)
+    assert 'srj_tpu_obs_events_dropped_total{reason="ring"}' in prom
+
+
+# ---------------------------------------------------------------------------
+# faultinj direct counter
+# ---------------------------------------------------------------------------
+
+def test_faultinj_increments_live_counter_without_obs():
+    """The injector feeds the registry even with span recording off."""
+    assert not obs.enabled()
+    metrics.registry().reset()
+    from spark_rapids_jni_tpu.faultinj import injector
+    injector._emit_fault("pjrtExecuteFaults", "opX",
+                         itype=injector.FI_TRAP)
+    injector._emit_fault("pjrtExecuteFaults", "opX", rejected=True)
+    snap = metrics.registry().snapshot()
+    vals = snap["srj_tpu_faults_injected_total"]["values"]
+    assert vals["kind=trap,op=opX"] == 1
+    assert vals["kind=rejected,op=opX"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Perf-regression gate
+# ---------------------------------------------------------------------------
+
+def _gate(*argv):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "ci", "regress_gate.py"),
+         *argv],
+        capture_output=True, text=True, cwd=REPO)
+
+
+def test_gate_passes_on_real_history():
+    res = _gate("--history", REPO, "--mode", "enforce")
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_gate_flags_synthetic_2x_regression(tmp_path):
+    cur = json.load(open(os.path.join(REPO, "BENCH_r05.json")))
+    cur["parsed"]["value"] /= 2.0
+    bad = tmp_path / "BENCH_bad.json"
+    bad.write_text(json.dumps(cur))
+    res = _gate("--current", str(bad),
+                "--previous", os.path.join(REPO, "BENCH_r04.json"),
+                "--mode", "enforce")
+    assert res.returncode == 3, res.stdout + res.stderr
+    assert "REGRESSED" in res.stdout
+    # advisory mode reports the same regression but does not fail
+    res = _gate("--current", str(bad),
+                "--previous", os.path.join(REPO, "BENCH_r04.json"))
+    assert res.returncode == 0
+    assert "ADVISORY" in res.stderr
+
+
+def test_gate_direction_inference(tmp_path):
+    """Time-like units regress upward; a latency that doubled must fail
+    even though its value went up."""
+    prev = tmp_path / "BENCH_r01.json"
+    cur = tmp_path / "BENCH_r02.json"
+    prev.write_text(json.dumps(
+        {"n": 1, "parsed": {"metric": "op_latency", "value": 10.0,
+                            "unit": "ms"}}))
+    cur.write_text(json.dumps(
+        {"n": 2, "parsed": {"metric": "op_latency", "value": 20.0,
+                            "unit": "ms"}}))
+    res = _gate("--history", str(tmp_path), "--mode", "enforce")
+    assert res.returncode == 3, res.stdout + res.stderr
+
+
+def test_gate_needs_two_rounds(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps({"n": 1, "parsed": {"metric": "m", "value": 1.0,
+                                       "unit": "GB/s"}}))
+    res = _gate("--history", str(tmp_path))
+    assert res.returncode == 2
