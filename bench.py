@@ -565,6 +565,23 @@ def bench_ragged(num_batches):
     return res
 
 
+def bench_serve(num_requests, tenants=4, miss_rate=0.3):
+    """Serving axis: sustained multi-tenant QPS plus submit-to-result
+    latency percentiles through the continuous-batching scheduler
+    (``serve/``), at a fixed bucket-miss rate (30% of requests land off
+    the warm shape bucket, so the axis pays steady-state coalescing, not
+    a single-bucket best case).  Reuses the ``python -m
+    spark_rapids_jni_tpu.serve`` driver so the bench and the demo
+    measure the same loop."""
+    from spark_rapids_jni_tpu.serve.__main__ import run
+    res = run(num_requests, tenants, port=0, miss_rate=miss_rate)
+    res["miss_rate"] = miss_rate
+    # requests per dispatched mega-batch: the coalescing win itself
+    res["coalesce_ratio"] = round(
+        res["coalesced"] / max(1, res["batches"]), 2)
+    return res
+
+
 def _count_boundary_dispatches(fn):
     """Run ``fn`` once counting host->device boundary crossings: explicit
     ``jax.device_put`` calls plus ``jnp.asarray`` calls handed a numpy
@@ -747,6 +764,8 @@ def _run_axis(axis: str):
             res = bench_fixed(int(n))
         elif kind == "transfer":
             res = bench_transfer(int(n))
+        elif kind == "serve":
+            res = bench_serve(int(n))
         elif kind == "nostrings":
             res = bench_variable(int(n), with_strings=False)
         elif kind == "skewed":
@@ -1026,6 +1045,11 @@ def main():
     # numbers guard the staging path's perf claim directly
     _run("transfer_staging", f"transfer:{row_axes[0]}")
 
+    # continuous-batching serving axis: sustained QPS + p99 latency at a
+    # fixed 30% bucket-miss rate; runs under --quick too so the regress
+    # gate sees the serving numbers every round
+    _run("serving", "serve:2000")
+
     if not args.quick:
         # the reference's mixed axes: 155 cols with strings at 1M rows
         # (it skips strings >1M for memory, benchmarks/row_conversion.cpp:105)
@@ -1092,6 +1116,18 @@ def main():
         # name WHAT failed in the headline, not just that something did:
         # each entry is {axis, op, type} from a structured leg record
         out["leg_failures"] = leg_failures
+    # secondary tracked metrics: extra {metric, value, unit} entries the
+    # regress gate ingests alongside the headline (ci/regress_gate.py
+    # round_metrics reads parsed["secondary"])
+    sv = next((r for r in results.get("serving", [])
+               if isinstance(r, dict) and r.get("qps")), None)
+    if sv is not None:
+        out["secondary"] = [
+            {"metric": "serve_sustained_qps",
+             "value": sv["qps"], "unit": "req/s"},
+            {"metric": "serve_p99_ms",
+             "value": sv["p99_ms"], "unit": "ms"},
+        ]
     print(json.dumps(out))
 
 

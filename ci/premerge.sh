@@ -109,6 +109,82 @@ print(f"live-telemetry smoke: scraped {len(body)} bytes from "
 exporter.stop()
 PY
 
+# serving smoke: scheduler + exporter on an ephemeral port, concurrent
+# mixed-tenant queries through the continuous-batching loop; assert the
+# requests coalesced into far fewer dispatches, /healthz flips its
+# backpressure bit on a tiny-depth scheduler, and shutdown drains every
+# in-flight future — the end-to-end version of tests/test_serve.py
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python - <<'PY'
+import json, threading, urllib.request
+import numpy as np
+from spark_rapids_jni_tpu import obs, serve
+from spark_rapids_jni_tpu.obs import exporter, metrics
+
+obs.enable()
+port = exporter.start(0)
+assert port, "exporter failed to bind"
+rng = np.random.default_rng(0)
+futs, lock = [], threading.Lock()
+with serve.Scheduler() as sched:
+    clients = [serve.Client(sched, f"tenant-{i}") for i in range(3)]
+
+    def feed(c):
+        for _ in range(12):
+            k = rng.integers(0, 8, 33).astype(np.int32)
+            v = rng.integers(-4, 4, 33).astype(np.int32)
+            while True:
+                try:
+                    f = c.aggregate(k, v)
+                    break
+                except serve.QueueFull:
+                    pass
+            with lock:
+                futs.append(f)
+
+    threads = [threading.Thread(target=feed, args=(c,)) for c in clients]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    hz = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+    assert "serve" in hz, hz
+# context exit = graceful shutdown: every future must be resolved
+assert len(futs) == 36
+for f in futs:
+    assert f.result(timeout=30)["num_groups"] > 0
+
+snap = metrics.registry().snapshot()
+def total(name):
+    vals = snap.get(name, {}).get("values", {})
+    return sum(v for v in vals.values() if isinstance(v, (int, float)))
+batches = total("srj_tpu_serve_batches_total")
+assert 0 < batches < 36, f"no coalescing: {batches} batches for 36 requests"
+body = urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+assert "srj_tpu_serve_requests_total" in body
+assert 'tenant="tenant-0"' in body
+
+# backpressure: a tiny-depth scheduler must report shedding on /healthz
+s2 = serve.Scheduler(serve.Config(max_depth=8, high_water=2))
+c = serve.Client(s2, "bp")
+held = [c.aggregate(np.ones(9, np.int32), np.ones(9, np.int32))
+        for _ in range(2)]
+hz = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+assert hz["serve"]["shedding"] is True, hz
+s2.tick()
+hz = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+assert hz["serve"]["shedding"] is False, hz
+s2.close()
+for f in held:
+    f.result(timeout=30)
+exporter.stop()
+print(f"serving smoke: 36 requests over 3 tenants -> {int(batches)} "
+      f"coalesced dispatches; healthz backpressure flip OK; clean drain")
+PY
+
 # trace-export smoke: the report CLI converts the staged event log to a
 # Chrome/Perfetto trace and the result parses with balanced nesting
 TRACE_EVENTS=$(mktemp /tmp/srj_trace_smoke.XXXXXX.jsonl)

@@ -56,12 +56,17 @@ def load_round(path: str) -> Dict:
 
 def round_metrics(doc: Dict) -> Dict[str, Dict]:
     """``{metric_name: {"value": float, "unit": str}}`` from a bench
-    round.  ``parsed`` is a single metric dict today; tolerate a future
-    list-of-dicts shape."""
+    round.  ``parsed`` is a single metric dict today (tolerate a future
+    list-of-dicts shape); a headline may also carry a ``secondary`` list
+    of extra ``{metric, value, unit}`` entries (the serving axis reports
+    QPS and p99 latency this way), gated under the same tolerance."""
     parsed = doc.get("parsed")
     if parsed is None:
         return {}
-    entries = parsed if isinstance(parsed, list) else [parsed]
+    entries = list(parsed) if isinstance(parsed, list) else [parsed]
+    for e in list(entries):
+        if isinstance(e, dict) and isinstance(e.get("secondary"), list):
+            entries.extend(e["secondary"])
     out = {}
     for e in entries:
         if not isinstance(e, dict):

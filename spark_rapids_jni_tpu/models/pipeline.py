@@ -199,6 +199,35 @@ def sort_merge_join(build_keys: jnp.ndarray, build_payload: jnp.ndarray,
     return bp[pos], matched
 
 
+def sort_merge_join_live(build_keys: jnp.ndarray,
+                         build_payload: jnp.ndarray,
+                         build_live: jnp.ndarray,
+                         probe_keys: jnp.ndarray):
+    """:func:`sort_merge_join` with build-side liveness — the serving
+    layer's coalescing pads build sides up the shape grid, so dead pad
+    rows must not match any probe key.
+
+    Dead build rows are pushed to the end under a max-key sentinel (the
+    same trick the aggregate uses); liveness rides the one variadic sort
+    and gates ``matched``.  Stable sort puts live rows before dead ones
+    within a sentinel-valued tie, and ``searchsorted`` side='left' lands
+    on the first occurrence, so even a LIVE key equal to the sentinel
+    value still matches.  Unmatched payload slots are zeroed (unlike
+    :func:`sort_merge_join`, which leaves gather garbage there), making
+    the output a pure function of (live rows, probe keys) — the property
+    the serve result-identity test asserts.  ``vmap``-compatible.
+    """
+    big = jnp.iinfo(build_keys.dtype).max
+    k = jnp.where(build_live, build_keys, big)
+    bk, bp, bl = jax.lax.sort(
+        (k, build_payload, build_live.astype(jnp.int32)), num_keys=1,
+        is_stable=True)
+    pos = jnp.searchsorted(bk, probe_keys, side="left")
+    pos = jnp.minimum(pos, bk.shape[0] - 1)
+    matched = (bk[pos] == probe_keys) & (bl[pos] == 1)
+    return jnp.where(matched, bp[pos], 0), matched
+
+
 def sort_merge_join_dup(build_keys: jnp.ndarray,
                         build_payload: jnp.ndarray,
                         probe_keys: jnp.ndarray,

@@ -22,7 +22,13 @@ Endpoints:
 
 ``GET /healthz``
     JSON liveness snapshot: uptime, obs enablement, ring occupancy,
-    dropped-event and sink-error counts, XLA compile totals.
+    dropped-event and sink-error counts, XLA compile totals — plus one
+    sub-document per registered *health provider*
+    (:func:`register_health_provider`): subsystems with liveness state
+    of their own (the serve scheduler reports queue depth and shed
+    state here, which is how load balancers see backpressure).  A
+    provider that raises contributes ``{"error": ...}`` instead of
+    taking down the endpoint.
 """
 
 from __future__ import annotations
@@ -35,12 +41,30 @@ from typing import Optional
 
 from spark_rapids_jni_tpu.obs import metrics as _metrics
 
-__all__ = ["start", "stop", "running", "port"]
+__all__ = ["start", "stop", "running", "port",
+           "register_health_provider", "unregister_health_provider"]
 
 _LOCK = threading.Lock()
 _SERVER: Optional[ThreadingHTTPServer] = None
 _THREAD: Optional[threading.Thread] = None
 _STARTED_AT: float = 0.0
+_PROVIDERS: dict = {}
+_PROVIDERS_LOCK = threading.Lock()
+
+
+def register_health_provider(name: str, fn) -> None:
+    """Add a named callable contributing a sub-document to ``/healthz``
+    (``fn() -> dict``).  Re-registering a name replaces it — subsystems
+    that restart (tests churn schedulers) just win the slot."""
+    with _PROVIDERS_LOCK:
+        _PROVIDERS[name] = fn
+
+
+def unregister_health_provider(name: str) -> None:
+    """Remove a provider; unknown names are a no-op (idempotent
+    teardown)."""
+    with _PROVIDERS_LOCK:
+        _PROVIDERS.pop(name, None)
 
 
 def _healthz() -> dict:
@@ -62,6 +86,13 @@ def _healthz() -> dict:
             total("srj_tpu_xla_compile_seconds_total"), 6),
     }
     doc.update(_spans.dropped())
+    with _PROVIDERS_LOCK:
+        providers = list(_PROVIDERS.items())
+    for name, fn in providers:
+        try:
+            doc[name] = fn()
+        except Exception as e:  # a sick provider must not kill /healthz
+            doc[name] = {"error": f"{type(e).__name__}: {e}"}
     return doc
 
 

@@ -30,6 +30,17 @@ offline report also computes use the SAME metric names as ``report
 - ``srj_tpu_obs_events_dropped_total{reason}`` — ring evictions and sink
   write failures, so a scrape can tell truncated telemetry from quiet.
 - ``srj_tpu_prefetch_queue_depth`` — staging prefetcher backlog gauge.
+- ``srj_tpu_serve_*`` — the serving runtime (:mod:`serve.scheduler`):
+  ``requests_total`` / ``request_failures_total`` (``{tenant,op}``),
+  ``rows_total`` / ``bytes_total`` (``{tenant}``), ``rejected_total``
+  (``{reason}`` = full|shedding|closed), ``batches_total`` /
+  ``coalesced_requests_total`` / ``fallback_requests_total`` (``{op}``),
+  ``queue_seconds`` / ``exec_seconds`` histograms (``{op}``), and the
+  ``queue_depth`` / ``shedding`` / ``tenants`` gauges.  **Tenant-label
+  cardinality cap**: only the first ``SRJ_TPU_SERVE_MAX_TENANTS``
+  (default 64) distinct tenants get their own label value; later ones
+  fold into ``tenant="_overflow"`` so a tenant-id flood cannot blow up
+  the registry or the scrape size.
 
 Everything here is pure stdlib (the exposition must be servable from a
 process whose accelerator runtime is wedged), and recording never raises

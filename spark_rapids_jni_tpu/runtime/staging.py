@@ -139,6 +139,10 @@ def _unpack_program(sig):
             piece = jax.lax.slice(blob, (off,), (off + nb,))
             if dt == np.uint8:
                 arr = piece
+            elif dt == np.bool_:
+                # bitcast_convert_type rejects bool; the host packed
+                # 0/1 bytes, so a compare reconstructs it exactly.
+                arr = piece != 0
             elif dt.itemsize == 1:
                 arr = jax.lax.bitcast_convert_type(piece, dt)
             else:
@@ -400,20 +404,13 @@ def shard_table_staged(table: Table, mesh, axis_name: str = "data") -> Table:
 # Double-buffered prefetch
 # ---------------------------------------------------------------------------
 
-def prefetch(items, stage_fn, depth: int = 2):
-    """Generator staging ``stage_fn(item)`` for up to ``depth`` items
-    ahead of the consumer on one worker thread: batch ``i+1``'s host
-    pack + H2D overlaps batch ``i``'s device execution (classic double
-    buffering at ``depth=2``).  Exceptions from ``stage_fn`` surface at
-    the corresponding ``yield``, in order.  Opt-in: nothing in the repo
-    prefetches implicitly."""
-    if depth < 1:
-        raise ValueError("prefetch depth must be >= 1")
+def _prefetch_iter(items, stage_fn, depth: int, ex):
+    """The prefetch pump over a caller-owned executor (see
+    :func:`prefetch` / :class:`Prefetcher` for the two ownership
+    models)."""
     qdepth = _obs_metrics.gauge(
         "srj_tpu_prefetch_queue_depth",
         "Batches staged ahead of the consumer by the prefetch worker.")
-    ex = concurrent.futures.ThreadPoolExecutor(
-        max_workers=1, thread_name_prefix="srj-staging-prefetch")
     try:
         pending = collections.deque()
         for item in items:
@@ -429,21 +426,65 @@ def prefetch(items, stage_fn, depth: int = 2):
             yield fut.result()
     finally:
         qdepth.set(0)
+
+
+def prefetch(items, stage_fn, depth: int = 2):
+    """Generator staging ``stage_fn(item)`` for up to ``depth`` items
+    ahead of the consumer on one worker thread: batch ``i+1``'s host
+    pack + H2D overlaps batch ``i``'s device execution (classic double
+    buffering at ``depth=2``).  Exceptions from ``stage_fn`` surface at
+    the corresponding ``yield``, in order.  Opt-in: nothing in the repo
+    prefetches implicitly.
+
+    The generator form cannot join its worker on early exit (a ``close``
+    runs in the consumer's ``finally``, where blocking on an in-flight
+    ``stage_fn`` could deadlock under the arena lock) — the worker is
+    released async and drains on its own.  Consumers that create and
+    destroy many prefetch streams (the serving loop) should use
+    :class:`Prefetcher`, whose explicit ``close()`` DOES join."""
+    if depth < 1:
+        raise ValueError("prefetch depth must be >= 1")
+    ex = concurrent.futures.ThreadPoolExecutor(
+        max_workers=1, thread_name_prefix="srj-staging-prefetch")
+    try:
+        yield from _prefetch_iter(items, stage_fn, depth, ex)
+    finally:
         ex.shutdown(wait=False)
 
 
 class Prefetcher:
-    """Iterable wrapper over :func:`prefetch` with explicit ``close()``
-    (for consumers that stop early and want the worker gone)."""
+    """Iterable twin of :func:`prefetch` that OWNS its worker thread:
+    ``close()`` (or leaving the ``with`` block) cancels queued work and
+    joins the worker, so a consumer that stops early leaks no thread —
+    the contract a serving loop creating/destroying many of these needs.
+    Idempotent; iteration after close raises ``StopIteration``."""
 
     def __init__(self, items, stage_fn, depth: int = 2):
-        self._gen = prefetch(items, stage_fn, depth)
+        if depth < 1:
+            raise ValueError("prefetch depth must be >= 1")
+        self._ex = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="srj-staging-prefetch")
+        self._gen = _prefetch_iter(items, stage_fn, depth, self._ex)
+        self._closed = False
 
     def __iter__(self):
-        return self._gen
+        return self
 
     def __next__(self):
         return next(self._gen)
 
+    def __enter__(self) -> "Prefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
     def close(self) -> None:
+        """Stop the stream and JOIN the worker thread: queued stages are
+        cancelled, the in-flight one (if any) runs out, and the thread
+        is gone when this returns."""
+        if self._closed:
+            return
+        self._closed = True
         self._gen.close()
+        self._ex.shutdown(wait=True, cancel_futures=True)

@@ -1,0 +1,35 @@
+"""Multi-tenant serving runtime: continuous batching over the
+shape-bucket grid.
+
+The millions-of-users layer (ROADMAP): per-tenant query submissions
+(group-by aggregate, equi-join, JCUDF row conversion) flow through a
+bounded async queue; a scheduler tick coalesces every same-shape-bucket
+group into ONE padded mega-batch — staged host→device as one blob
+(:mod:`runtime.staging`), executed as one jitted vmapped program
+(:mod:`runtime.shapes` bounds the program count), fetched back in one
+transfer — and scatters per-tenant result slices to futures.
+
+Quick start::
+
+    from spark_rapids_jni_tpu import serve
+
+    with serve.Scheduler() as sched:
+        c = serve.Client(sched, tenant="analytics")
+        fut = c.aggregate(keys, values)          # returns a Future
+        out = fut.result(timeout=5)              # {'group_keys': ...}
+
+Admission control raises :class:`QueueFull` instead of blocking;
+``/healthz`` (via :mod:`obs.exporter`) reports queue depth + shed state;
+``srj_tpu_serve_*`` metric families cover per-tenant rows/bytes/latency
+(tenant label capped at ``SRJ_TPU_SERVE_MAX_TENANTS`` distinct values).
+``python -m spark_rapids_jni_tpu.serve`` runs a self-contained demo.
+"""
+
+from spark_rapids_jni_tpu.serve.client import Client  # noqa: F401
+from spark_rapids_jni_tpu.serve.queue import QueueFull  # noqa: F401
+from spark_rapids_jni_tpu.serve.scheduler import (  # noqa: F401
+    Config, Scheduler,
+)
+from spark_rapids_jni_tpu.serve import ops  # noqa: F401
+
+__all__ = ["Client", "Config", "QueueFull", "Scheduler", "ops"]

@@ -1,0 +1,127 @@
+"""Demo entry point: ``python -m spark_rapids_jni_tpu.serve``.
+
+Spins up the scheduler plus the live exporter, drives concurrent
+mixed-tenant traffic at a fixed bucket-miss rate, then prints one JSON
+summary (QPS, latency percentiles, coalescing ratio, final /healthz).
+Useful as a smoke test and as the serving bench's standalone twin::
+
+    JAX_PLATFORMS=cpu python -m spark_rapids_jni_tpu.serve \
+        --requests 200 --tenants 4 --port 0
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+
+def run(requests: int, tenants: int, port: int, miss_rate: float,
+        seed: int = 7) -> dict:
+    from spark_rapids_jni_tpu import obs, serve
+    from spark_rapids_jni_tpu.obs import exporter, metrics
+
+    obs.enable()
+    bound = exporter.start(port)
+    rng = np.random.default_rng(seed)
+    lat: list = []
+    rejected = [0]
+
+    with serve.Scheduler() as sched:
+        clients = [serve.Client(sched, f"tenant-{i}")
+                   for i in range(tenants)]
+
+        def one(c, n):
+            keys = rng.integers(0, 32, n).astype(np.int32)
+            vals = rng.integers(-9, 9, n).astype(np.int32)
+            t0 = time.perf_counter()
+            while True:
+                try:
+                    f = c.aggregate(keys, vals)
+                    break
+                except serve.QueueFull:
+                    rejected[0] += 1
+                    time.sleep(0.0005)
+            f.add_done_callback(
+                lambda _f, t0=t0: lat.append(time.perf_counter() - t0))
+            return f
+
+        # warm the two buckets once so compile time doesn't skew latency
+        warm, miss = 1000, 100
+        one(clients[0], warm).result(timeout=120)
+        one(clients[0], miss).result(timeout=120)
+
+        sizes = np.where(rng.random(requests) < miss_rate, miss, warm)
+        futs: list = []
+        t0 = time.perf_counter()
+
+        def feed(ci):
+            for i in range(ci, requests, tenants):
+                futs.append(one(clients[ci], int(sizes[i])))
+
+        threads = [threading.Thread(target=feed, args=(ci,))
+                   for ci in range(tenants)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for f in futs:
+            f.result(timeout=120)
+        wall = time.perf_counter() - t0
+
+        hz = {}
+        if bound:
+            hz = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{bound}/healthz", timeout=10).read())
+
+        snap = metrics.registry().snapshot()
+
+        def total(name):
+            vals = snap.get(name, {}).get("values", {})
+            return sum(v for v in vals.values()
+                       if isinstance(v, (int, float)))
+
+        ls = sorted(lat)
+        res = {
+            "requests": requests,
+            "tenants": tenants,
+            "wall_s": round(wall, 4),
+            "qps": round(requests / wall, 1),
+            "p50_ms": round(1e3 * ls[len(ls) // 2], 3) if ls else None,
+            "p99_ms": round(1e3 * ls[int(0.99 * (len(ls) - 1))], 3)
+            if ls else None,
+            "batches": int(total("srj_tpu_serve_batches_total")),
+            "coalesced": int(
+                total("srj_tpu_serve_coalesced_requests_total")),
+            "rejected_retries": rejected[0],
+            "ticks": sched.ticks,
+            "healthz": {k: hz[k] for k in ("status", "serve") if k in hz},
+        }
+    exporter.stop()
+    return res
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m spark_rapids_jni_tpu.serve",
+        description=__doc__.split("\n")[0])
+    ap.add_argument("--requests", type=int, default=200)
+    ap.add_argument("--tenants", type=int, default=4)
+    ap.add_argument("--port", type=int, default=0,
+                    help="exporter port (0 = ephemeral)")
+    ap.add_argument("--miss-rate", type=float, default=0.3,
+                    help="fraction of requests landing off the warm "
+                         "bucket")
+    args = ap.parse_args(argv)
+    res = run(args.requests, args.tenants, args.port, args.miss_rate)
+    print(json.dumps(res))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

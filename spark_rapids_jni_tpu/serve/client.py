@@ -1,0 +1,39 @@
+"""Per-tenant client facade over :class:`serve.Scheduler`.
+
+A thin, typed submission surface: each method validates via the op
+registry and returns a ``concurrent.futures.Future`` resolving to the
+op's result dict (call ``.result(timeout)`` to block).  One client per
+tenant; clients are cheap and thread-safe (all state lives in the
+scheduler)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+__all__ = ["Client"]
+
+
+class Client:
+    def __init__(self, scheduler, tenant: str):
+        self._sched = scheduler
+        self.tenant = str(tenant)
+
+    def aggregate(self, keys, values,
+                  max_groups: Optional[int] = None):
+        """Group-by-sum; resolves to ``{group_keys, sums, have,
+        num_groups}`` (arrays sized ``max_groups``)."""
+        kw = {} if max_groups is None else {"max_groups": max_groups}
+        return self._sched.submit(self.tenant, "agg", keys=keys,
+                                  values=values, **kw)
+
+    def join(self, build_keys, build_payload, probe_keys):
+        """Unique-key equi-join; resolves to ``{payload, matched}``
+        aligned with ``probe_keys`` (unmatched payload slots are 0)."""
+        return self._sched.submit(
+            self.tenant, "join", build_keys=build_keys,
+            build_payload=build_payload, probe_keys=probe_keys)
+
+    def to_rows(self, columns: Sequence):
+        """JCUDF fixed-width row conversion of all-valid int32 columns;
+        resolves to ``{rows, row_size, num_rows}`` (flat uint8)."""
+        return self._sched.submit(self.tenant, "rows", columns=columns)

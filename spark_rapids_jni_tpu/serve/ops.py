@@ -1,0 +1,288 @@
+"""Coalescable query ops: validate → group → mega-batch → scatter.
+
+Each :class:`ServeOp` adapts one query entry point (the agg/join kernels
+from :mod:`models.pipeline`, the JCUDF row conversion from :mod:`ops`)
+to the serving loop's continuous-batching contract:
+
+- ``validate(kwargs)`` — canonicalize a submission into host numpy
+  arrays, returning ``(payload, sig, rows, nbytes)``.  ``sig`` is the
+  STATIC coalescing signature: every dynamic row count is bucketed up
+  the :mod:`runtime.shapes` pow-2 grid, so the set of distinct
+  signatures — and therefore of compiled programs — is bounded by the
+  bucket grid, not by the request stream.
+- ``batch(payloads, kb)`` — stack K same-signature payloads into padded
+  ``[kb, ...]`` mega-arrays (``kb`` = K bucketed up the same grid; the
+  pad requests are dead: all-False masks / zero liveness).  The arrays
+  ship device-side as ONE blob via :func:`runtime.staging.stage_arrays`.
+- ``kernel(sig, kb)`` — the jitted ``vmap`` of the underlying pipeline
+  kernel, cached per ``(sig, kb)``; exactly one dispatch serves the
+  whole group per tick.
+- ``unbatch(host_outs, slot, payload)`` — cut request ``slot``'s result
+  out of the fetched mega-outputs (unpadded back to its true rows).
+
+Results are plain dicts of numpy arrays, byte-identical to what the
+direct per-request pipeline call produces (``tests/test_serve.py``
+asserts this; the agg/join kernels are integer-exact so padding cannot
+perturb values).  Values are int32 end-to-end for exactly that reason —
+float coalescing would change reduction shapes and forfeit bit-identity.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.models import pipeline
+from spark_rapids_jni_tpu.ops.row_layout import compute_row_layout
+from spark_rapids_jni_tpu.runtime import shapes
+from spark_rapids_jni_tpu.table import INT32
+
+__all__ = ["ServeOp", "get", "names", "DEFAULT_MAX_GROUPS"]
+
+DEFAULT_MAX_GROUPS = pipeline.MAX_GROUPS
+
+
+def _as_i32(name: str, v) -> np.ndarray:
+    a = np.asarray(v)
+    if a.ndim != 1 or a.size == 0:
+        raise ValueError(f"{name} must be a non-empty 1-D array")
+    if a.dtype != np.int32:
+        if not np.issubdtype(a.dtype, np.integer):
+            raise ValueError(f"{name} must be integer, got {a.dtype}")
+        a = a.astype(np.int32)
+    return np.ascontiguousarray(a)
+
+
+def _stack_pad(arrs: Sequence[np.ndarray], kb: int, width: int,
+               dtype) -> np.ndarray:
+    """[kb, width] matrix: row i is ``arrs[i]`` zero-padded; rows past
+    ``len(arrs)`` are all-zero pad requests."""
+    out = np.zeros((kb, width), dtype)
+    for i, a in enumerate(arrs):
+        out[i, :a.shape[0]] = a
+    return out
+
+
+class ServeOp:
+    """Interface of one coalescable op (see module docstring)."""
+
+    name: str = "?"
+
+    def validate(self, kwargs: Dict[str, Any]
+                 ) -> Tuple[Dict[str, Any], Tuple, int, int]:
+        raise NotImplementedError
+
+    def batch(self, payloads: Sequence[Dict[str, Any]], sig: Tuple,
+              kb: int) -> List[np.ndarray]:
+        raise NotImplementedError
+
+    def kernel(self, sig: Tuple, kb: int):
+        raise NotImplementedError
+
+    def unbatch(self, host_outs: Sequence[np.ndarray], slot: int,
+                payload: Dict[str, Any]) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# agg: group-by-sum (models.pipeline.hash_aggregate_sum)
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=256)
+def _agg_kernel(b: int, max_groups: int, kb: int):
+    def _serve_agg(keys, values, mask):
+        return jax.vmap(
+            lambda k, v, m: pipeline.hash_aggregate_sum(
+                k, v, m, max_groups))(keys, values, mask)
+    return jax.jit(_serve_agg)
+
+
+class _AggOp(ServeOp):
+    name = "agg"
+
+    def validate(self, kwargs):
+        keys = _as_i32("keys", kwargs.pop("keys"))
+        values = _as_i32("values", kwargs.pop("values"))
+        max_groups = int(kwargs.pop("max_groups", DEFAULT_MAX_GROUPS))
+        if kwargs:
+            raise ValueError(f"unknown agg arguments: {sorted(kwargs)}")
+        if values.shape != keys.shape:
+            raise ValueError("keys/values length mismatch")
+        n = keys.shape[0]
+        payload = {"keys": keys, "values": values, "n": n,
+                   "max_groups": max_groups}
+        sig = (shapes.bucket_rows(n), max_groups)
+        return payload, sig, n, keys.nbytes + values.nbytes
+
+    def batch(self, payloads, sig, kb):
+        b, _ = sig
+        mask = np.zeros((kb, b), np.bool_)
+        for i, p in enumerate(payloads):
+            mask[i, :p["n"]] = True
+        return [
+            _stack_pad([p["keys"] for p in payloads], kb, b, np.int32),
+            _stack_pad([p["values"] for p in payloads], kb, b, np.int32),
+            mask,
+        ]
+
+    def kernel(self, sig, kb):
+        return _agg_kernel(sig[0], sig[1], kb)
+
+    def unbatch(self, host_outs, slot, payload):
+        gkeys, sums, have, num_groups = host_outs
+        return {"group_keys": np.asarray(gkeys[slot]),
+                "sums": np.asarray(sums[slot]),
+                "have": np.asarray(have[slot]),
+                "num_groups": int(num_groups[slot])}
+
+
+# ---------------------------------------------------------------------------
+# join: unique-key equi-join (models.pipeline.sort_merge_join_live)
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=256)
+def _join_kernel(bm: int, bn: int, kb: int):
+    def _serve_join(bk, bp, bl, pk):
+        return jax.vmap(pipeline.sort_merge_join_live)(bk, bp, bl, pk)
+    return jax.jit(_serve_join)
+
+
+class _JoinOp(ServeOp):
+    name = "join"
+
+    def validate(self, kwargs):
+        bk = _as_i32("build_keys", kwargs.pop("build_keys"))
+        bp = _as_i32("build_payload", kwargs.pop("build_payload"))
+        pk = _as_i32("probe_keys", kwargs.pop("probe_keys"))
+        if kwargs:
+            raise ValueError(f"unknown join arguments: {sorted(kwargs)}")
+        if bp.shape != bk.shape:
+            raise ValueError("build_keys/build_payload length mismatch")
+        m, n = bk.shape[0], pk.shape[0]
+        payload = {"build_keys": bk, "build_payload": bp,
+                   "probe_keys": pk, "m": m, "n": n}
+        sig = (shapes.bucket_rows(m), shapes.bucket_rows(n))
+        return payload, sig, n, bk.nbytes + bp.nbytes + pk.nbytes
+
+    def batch(self, payloads, sig, kb):
+        bm, bn = sig
+        live = np.zeros((kb, bm), np.bool_)
+        for i, p in enumerate(payloads):
+            live[i, :p["m"]] = True
+        return [
+            _stack_pad([p["build_keys"] for p in payloads],
+                       kb, bm, np.int32),
+            _stack_pad([p["build_payload"] for p in payloads],
+                       kb, bm, np.int32),
+            live,
+            _stack_pad([p["probe_keys"] for p in payloads],
+                       kb, bn, np.int32),
+        ]
+
+    def kernel(self, sig, kb):
+        return _join_kernel(sig[0], sig[1], kb)
+
+    def unbatch(self, host_outs, slot, payload):
+        pay, matched = host_outs
+        n = payload["n"]
+        return {"payload": np.asarray(pay[slot][:n]),
+                "matched": np.asarray(matched[slot][:n])}
+
+
+# ---------------------------------------------------------------------------
+# rows: JCUDF fixed-width row conversion (all-valid int32 columns)
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=64)
+def _rows_layout(ncols: int):
+    layout = compute_row_layout([INT32] * ncols)
+    expect = tuple(4 * i for i in range(ncols))
+    if layout.col_starts != expect:
+        raise AssertionError(
+            f"all-int32 layout reordered columns: {layout.col_starts}")
+    # all-valid validity bytes: bit c%8 of byte c//8 set for every column
+    vb = np.zeros((layout.validity_bytes,), np.uint8)
+    for j in range(layout.validity_bytes):
+        vb[j] = (1 << min(8, ncols - 8 * j)) - 1
+    return layout, vb
+
+
+@functools.lru_cache(maxsize=256)
+def _rows_kernel(ncols: int, b: int, kb: int):
+    layout, vb = _rows_layout(ncols)
+    rs = layout.fixed_row_size
+    data_bytes = 4 * ncols
+    pad = rs - data_bytes - layout.validity_bytes
+    vconst = jnp.asarray(vb)
+
+    def _serve_rows(cols):                      # [kb, ncols, b] int32
+        by = jax.lax.bitcast_convert_type(cols, jnp.uint8)
+        data = jnp.transpose(by, (0, 2, 1, 3)).reshape(kb, b, data_bytes)
+        v = jnp.broadcast_to(vconst, (kb, b, layout.validity_bytes))
+        tail = jnp.zeros((kb, b, pad), jnp.uint8)
+        return (jnp.concatenate([data, v, tail], axis=-1),)
+    return jax.jit(_serve_rows)
+
+
+class _RowsOp(ServeOp):
+    """JCUDF row pack for all-valid int32 columns — the fixed-width
+    serving slice of ``ops.convert_to_rows`` (whose full surface carries
+    nulls, strings and batch planning the latency path doesn't need).
+    Output bytes match ``convert_to_rows`` exactly; the identity test
+    compares against it directly."""
+
+    name = "rows"
+
+    def validate(self, kwargs):
+        columns = kwargs.pop("columns")
+        if kwargs:
+            raise ValueError(f"unknown rows arguments: {sorted(kwargs)}")
+        cols = [_as_i32(f"columns[{i}]", c) for i, c in enumerate(columns)]
+        if not cols:
+            raise ValueError("rows needs at least one column")
+        n = cols[0].shape[0]
+        if any(c.shape[0] != n for c in cols):
+            raise ValueError("ragged columns")
+        _rows_layout(len(cols))                 # layout sanity up front
+        payload = {"columns": cols, "n": n, "ncols": len(cols)}
+        sig = (len(cols), shapes.bucket_rows(n))
+        return payload, sig, n, sum(c.nbytes for c in cols)
+
+    def batch(self, payloads, sig, kb):
+        ncols, b = sig
+        out = np.zeros((kb, ncols, b), np.int32)
+        for i, p in enumerate(payloads):
+            for ci, c in enumerate(p["columns"]):
+                out[i, ci, :p["n"]] = c
+        return [out]
+
+    def kernel(self, sig, kb):
+        return _rows_kernel(sig[0], sig[1], kb)
+
+    def unbatch(self, host_outs, slot, payload):
+        (rows,) = host_outs
+        n = payload["n"]
+        rs = rows.shape[-1]
+        return {"rows": np.ascontiguousarray(
+                    rows[slot][:n]).reshape(-1),
+                "row_size": rs, "num_rows": n}
+
+
+_OPS: Dict[str, ServeOp] = {
+    op.name: op for op in (_AggOp(), _JoinOp(), _RowsOp())}
+
+
+def get(name: str) -> ServeOp:
+    try:
+        return _OPS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown serve op {name!r}; available: {sorted(_OPS)}")
+
+
+def names() -> List[str]:
+    return sorted(_OPS)

@@ -1,0 +1,312 @@
+"""Continuous-batching scheduler: ticks, mega-dispatch, tenant accounting.
+
+One background thread runs the tick loop: each tick drains the request
+queue into coalescing groups (``(op, shape-bucket signature)``), and for
+every group stages ONE mega-batch blob host→device
+(:func:`runtime.staging.stage_arrays`), runs ONE jitted vmapped kernel,
+fetches every output in ONE transfer (:func:`staging.fetch_arrays`), and
+scatters per-request result slices back to their futures.  K concurrent
+same-bucket requests therefore cost one dispatch per tick, and the
+compiled-program count is bounded by the bucket grid — the Awkward-array
+compile-storm pathology (PAPERS.md) cannot re-enter through the serving
+door.
+
+Tenant isolation under faults: a failed group dispatch falls back to
+per-request execution (each request its own single-slot batch), so one
+tenant's poisoned batch costs the *other* tenants in the group at most a
+retry — they still get correct results; only the faulty request's future
+carries the error.  ``tests/test_serve.py`` drives this with the
+:mod:`faultinj` injector.
+
+Metrics (``srj_tpu_serve_*`` families, see :mod:`obs.metrics`): requests
+/ rows / bytes / failures are per-tenant with the label value capped at
+``max_tenants`` distinct tenants (later tenants fold into
+``_overflow`` — the documented cardinality cap); queue/exec latency
+histograms and batch/coalescing counters are per-op; depth, shed state
+and tenant count are gauges.  The scheduler also registers an
+``obs.exporter`` health provider, so ``/healthz`` reports queue depth
+and shed state for load-balancer backpressure.
+
+Env knobs (all overridable via :class:`Config`):
+
+- ``SRJ_TPU_SERVE_DEPTH`` — queue depth cap (default 256)
+- ``SRJ_TPU_SERVE_TICK`` — tick interval seconds (default 0.002)
+- ``SRJ_TPU_SERVE_MAX_TENANTS`` — tenant-label cardinality cap (64)
+- ``SRJ_TPU_SERVE_HIWATER`` — shed high-water mark (default 3/4 depth)
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from spark_rapids_jni_tpu.obs import metrics as _metrics
+from spark_rapids_jni_tpu.obs import spans as _spans
+from spark_rapids_jni_tpu.runtime import shapes, staging
+from spark_rapids_jni_tpu.serve import ops as serve_ops
+from spark_rapids_jni_tpu.serve.queue import QueueFull, Request, RequestQueue
+
+__all__ = ["Config", "Scheduler", "QueueFull"]
+
+OVERFLOW_TENANT = "_overflow"
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass
+class Config:
+    """Scheduler tuning; defaults come from ``SRJ_TPU_SERVE_*`` env."""
+
+    max_depth: int = dataclasses.field(
+        default_factory=lambda: _env_int("SRJ_TPU_SERVE_DEPTH", 256))
+    tick_s: float = dataclasses.field(
+        default_factory=lambda: _env_float("SRJ_TPU_SERVE_TICK", 0.002))
+    max_tenants: int = dataclasses.field(
+        default_factory=lambda: _env_int("SRJ_TPU_SERVE_MAX_TENANTS", 64))
+    high_water: Optional[int] = dataclasses.field(
+        default_factory=lambda: (
+            _env_int("SRJ_TPU_SERVE_HIWATER", 0) or None))
+
+
+# -- metric families (created lazily so registry resets don't strand us) ----
+
+def _fam():
+    m = _metrics
+    return {
+        "requests": m.counter(
+            "srj_tpu_serve_requests_total",
+            "Requests admitted, by tenant (capped) and op.",
+            ("tenant", "op")),
+        "rejected": m.counter(
+            "srj_tpu_serve_rejected_total",
+            "Admission rejections (QueueFull), by reason.", ("reason",)),
+        "failures": m.counter(
+            "srj_tpu_serve_request_failures_total",
+            "Requests whose future carries an error, by tenant and op.",
+            ("tenant", "op")),
+        "rows": m.counter(
+            "srj_tpu_serve_rows_total",
+            "Input rows admitted, by tenant (capped).", ("tenant",)),
+        "bytes": m.counter(
+            "srj_tpu_serve_bytes_total",
+            "Input payload bytes admitted, by tenant (capped).",
+            ("tenant",)),
+        "batches": m.counter(
+            "srj_tpu_serve_batches_total",
+            "Coalesced mega-batch dispatches, by op.", ("op",)),
+        "coalesced": m.counter(
+            "srj_tpu_serve_coalesced_requests_total",
+            "Requests served via a coalesced dispatch, by op.", ("op",)),
+        "fallbacks": m.counter(
+            "srj_tpu_serve_fallback_requests_total",
+            "Requests retried per-request after a failed group dispatch.",
+            ("op",)),
+        "queue_s": m.histogram(
+            "srj_tpu_serve_queue_seconds",
+            "Submit-to-dispatch latency, by op.", ("op",)),
+        "exec_s": m.histogram(
+            "srj_tpu_serve_exec_seconds",
+            "Group stage+dispatch+fetch+scatter latency, by op.", ("op",)),
+        "depth": m.gauge(
+            "srj_tpu_serve_queue_depth", "Pending requests in the queue."),
+        "shedding": m.gauge(
+            "srj_tpu_serve_shedding",
+            "1 while backpressure shedding is active."),
+        "tenants": m.gauge(
+            "srj_tpu_serve_tenants",
+            "Distinct tenants seen (label cap: later ones fold into "
+            "_overflow)."),
+    }
+
+
+class Scheduler:
+    """Multi-tenant serving scheduler over the shape-bucket grid.
+
+    Use as a context manager or call :meth:`start` / :meth:`close`
+    explicitly; :meth:`submit` returns a ``concurrent.futures.Future``
+    resolving to the op's result dict.  :meth:`tick` is public so tests
+    and single-threaded embeddings can pump the loop deterministically
+    without the background thread."""
+
+    def __init__(self, config: Optional[Config] = None):
+        self.config = config or Config()
+        self.queue = RequestQueue(self.config.max_depth,
+                                  self.config.high_water)
+        self._m = _fam()
+        self._tenant_labels: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._closed = False
+        self.ticks = 0
+        self.served = 0
+        from spark_rapids_jni_tpu.obs import exporter as _exporter
+        _exporter.register_health_provider("serve", self._health)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Scheduler":
+        if self._thread is None and not self._closed:
+            self._thread = threading.Thread(
+                target=self._loop, name="srj-serve-scheduler", daemon=True)
+            self._thread.start()
+        return self
+
+    def __enter__(self) -> "Scheduler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def close(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop admitting, optionally drain in-flight work, join the
+        loop thread, unregister the health provider."""
+        if self._closed:
+            return
+        self._closed = True
+        self.queue.close()
+        if not drain:
+            for reqs in self.queue.drain().values():
+                for r in reqs:
+                    r.future.set_exception(
+                        QueueFull("closed", 0, self.config.max_depth))
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+        if drain:
+            self.tick()          # anything the loop didn't get to
+        from spark_rapids_jni_tpu.obs import exporter as _exporter
+        _exporter.unregister_health_provider("serve")
+
+    # -- submission --------------------------------------------------------
+
+    def _tenant_label(self, tenant: str) -> str:
+        with self._lock:
+            lbl = self._tenant_labels.get(tenant)
+            if lbl is None:
+                lbl = tenant if (len(self._tenant_labels)
+                                 < self.config.max_tenants) \
+                    else OVERFLOW_TENANT
+                self._tenant_labels[tenant] = lbl
+                self._m["tenants"].set(len(self._tenant_labels))
+            return lbl
+
+    def submit(self, tenant: str, op: str, **kwargs
+               ) -> "concurrent.futures.Future":
+        """Validate and enqueue one query; raises :class:`QueueFull` on
+        admission rejection, ``ValueError`` on a malformed payload."""
+        opdef = serve_ops.get(op)
+        payload, sig, rows, nbytes = opdef.validate(dict(kwargs))
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        req = Request(tenant=str(tenant), op=op, sig=sig, payload=payload,
+                      future=fut, rows=rows, nbytes=nbytes)
+        try:
+            self.queue.submit(req)
+        except QueueFull as e:
+            self._m["rejected"].inc(reason=e.reason)
+            self._m["shedding"].set(1 if self.queue.shedding else 0)
+            raise
+        lbl = self._tenant_label(req.tenant)
+        self._m["requests"].inc(tenant=lbl, op=op)
+        self._m["rows"].inc(rows, tenant=lbl)
+        self._m["bytes"].inc(nbytes, tenant=lbl)
+        self._m["depth"].set(self.queue.depth)
+        self._m["shedding"].set(1 if self.queue.shedding else 0)
+        return fut
+
+    # -- the loop ----------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.queue.wait(self.config.tick_s)
+            self.tick()
+        self.tick()              # drain whatever raced the stop flag
+
+    def tick(self) -> int:
+        """Process every pending group now; returns requests served."""
+        groups = self.queue.drain()
+        self._m["depth"].set(self.queue.depth)
+        self._m["shedding"].set(1 if self.queue.shedding else 0)
+        n = 0
+        for (op, sig), reqs in groups.items():
+            n += self._execute_group(op, sig, reqs)
+        if groups:
+            self.ticks += 1
+            self.served += n
+        return n
+
+    def _execute_group(self, op: str, sig, reqs: List[Request]) -> int:
+        opdef = serve_ops.get(op)
+        t0 = time.perf_counter()
+        for r in reqs:
+            self._m["queue_s"].observe(t0 - r.t_submit, op=op)
+        try:
+            outs = self._dispatch(opdef, sig, [r.payload for r in reqs])
+            for slot, r in enumerate(reqs):
+                r.future.set_result(
+                    opdef.unbatch(outs, slot, r.payload))
+            self._m["batches"].inc(op=op)
+            self._m["coalesced"].inc(len(reqs), op=op)
+        except Exception:
+            # group poisoned: isolate tenants by retrying each request
+            # as its own single-slot batch; only the request whose
+            # retry ALSO fails carries an error
+            for r in reqs:
+                self._m["fallbacks"].inc(op=op)
+                try:
+                    outs = self._dispatch(opdef, r.sig, [r.payload])
+                    r.future.set_result(opdef.unbatch(outs, 0, r.payload))
+                except Exception as e:   # noqa: BLE001 — future carries it
+                    r.future.set_exception(e)
+                    self._m["failures"].inc(
+                        tenant=self._tenant_label(r.tenant), op=op)
+        self._m["exec_s"].observe(time.perf_counter() - t0, op=op)
+        return len(reqs)
+
+    def _dispatch(self, opdef, sig, payloads) -> List:
+        """ONE staged transfer, ONE jitted dispatch, ONE fetch for the
+        whole group (the continuous-batching hot path)."""
+        kb = shapes.bucket_rows(len(payloads))
+        with _spans.span(f"serve.{opdef.name}", requests=len(payloads),
+                         slots=kb) as sp:
+            bufs = opdef.batch(payloads, sig, kb)
+            staged = staging.stage_arrays(bufs)
+            outs = opdef.kernel(sig, kb)(*staged)
+            host = staging.fetch_arrays(list(outs))
+            sp.set(rows=sum(p.get("n", 0) for p in payloads))
+        return host
+
+    # -- health ------------------------------------------------------------
+
+    def _health(self) -> dict:
+        return {
+            "queue_depth": self.queue.depth,
+            "shedding": self.queue.shedding,
+            "closed": self.queue.closed,
+            "max_depth": self.config.max_depth,
+            "high_water": self.queue.high_water,
+            "tenants": len(self._tenant_labels),
+            "ticks": self.ticks,
+            "served": self.served,
+        }
+
+    def healthz(self) -> dict:
+        """The provider payload, for callers without an exporter."""
+        return self._health()

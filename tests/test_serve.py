@@ -1,0 +1,402 @@
+"""Serving-runtime tests: coalescing bounded by the bucket grid (via
+compile telemetry), result identity vs direct pipeline calls, QueueFull
+admission control, /healthz backpressure flip over a real socket,
+per-tenant ``srj_tpu_serve_*`` families in a real /metrics scrape,
+graceful shutdown, and tenant isolation under injected faults."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu import faultinj, obs, serve
+from spark_rapids_jni_tpu.models import pipeline
+from spark_rapids_jni_tpu.obs import exporter, metrics
+from spark_rapids_jni_tpu.ops.row_conversion import convert_to_rows
+from spark_rapids_jni_tpu.runtime import shapes
+from spark_rapids_jni_tpu.serve.scheduler import OVERFLOW_TENANT
+from spark_rapids_jni_tpu.table import INT32, Table
+
+
+@pytest.fixture
+def obs_on():
+    obs.configure_sink(None)
+    obs.clear()
+    metrics.registry().reset()
+    obs.enable()
+    yield
+    obs.disable()
+    obs.configure_sink(None)
+    obs.clear()
+    metrics.registry().reset()
+
+
+@pytest.fixture
+def live_exporter(obs_on):
+    port = exporter.start(0)
+    assert port is not None
+    yield port
+    exporter.stop()
+
+
+def _scrape(port, path="/metrics"):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+        return resp.read().decode("utf-8")
+
+
+@pytest.fixture
+def sched():
+    """An un-started scheduler: tests pump :meth:`tick` deterministically."""
+    s = serve.Scheduler()
+    yield s
+    s.close()
+
+
+def _snap_total(name):
+    vals = metrics.registry().snapshot().get(name, {}).get("values", {})
+    return sum(v for v in vals.values() if isinstance(v, (int, float)))
+
+
+def _direct_agg(keys, vals, max_groups=pipeline.MAX_GROUPS):
+    """Reference result: one padded hash_aggregate_sum call."""
+    b = shapes.bucket_rows(len(keys))
+    kp = np.zeros(b, np.int32); kp[:len(keys)] = keys
+    vp = np.zeros(b, np.int32); vp[:len(vals)] = vals
+    m = np.zeros(b, bool); m[:len(keys)] = True
+    gk, s, h, n = pipeline.hash_aggregate_sum(
+        jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(m), max_groups)
+    return np.asarray(gk), np.asarray(s), np.asarray(h), int(n)
+
+
+# ---------------------------------------------------------------------------
+# Result identity vs direct pipeline calls
+# ---------------------------------------------------------------------------
+
+def test_agg_identity_vs_direct(sched):
+    rng = np.random.default_rng(1)
+    c1 = serve.Client(sched, "alice")
+    c2 = serve.Client(sched, "bob")
+    k1 = rng.integers(0, 16, 37).astype(np.int32)
+    v1 = rng.integers(-5, 5, 37).astype(np.int32)
+    k2 = rng.integers(0, 16, 33).astype(np.int32)
+    v2 = rng.integers(-5, 5, 33).astype(np.int32)
+    f1, f2 = c1.aggregate(k1, v1), c2.aggregate(k2, v2)
+    assert sched.tick() == 2
+    for f, (k, v) in [(f1, (k1, v1)), (f2, (k2, v2))]:
+        r = f.result(timeout=30)
+        gk, s, h, n = _direct_agg(k, v)
+        assert np.array_equal(r["group_keys"], gk)
+        assert np.array_equal(r["sums"], s)
+        assert np.array_equal(r["have"], h)
+        assert r["num_groups"] == n
+
+
+def test_join_identity_vs_direct(sched):
+    rng = np.random.default_rng(2)
+    c = serve.Client(sched, "alice")
+    m, n = 21, 45
+    bk = rng.permutation(100)[:m].astype(np.int32)
+    bp = rng.integers(1, 1000, m).astype(np.int32)
+    pk = rng.integers(0, 100, n).astype(np.int32)
+    f = c.join(bk, bp, pk)
+    sched.tick()
+    r = f.result(timeout=30)
+    bm, bn = shapes.bucket_rows(m), shapes.bucket_rows(n)
+    bkp = np.zeros(bm, np.int32); bkp[:m] = bk
+    bpp = np.zeros(bm, np.int32); bpp[:m] = bp
+    lv = np.zeros(bm, bool); lv[:m] = True
+    pkp = np.zeros(bn, np.int32); pkp[:n] = pk
+    pay, mt = pipeline.sort_merge_join_live(
+        jnp.asarray(bkp), jnp.asarray(bpp), jnp.asarray(lv),
+        jnp.asarray(pkp))
+    assert np.array_equal(r["payload"], np.asarray(pay)[:n])
+    assert np.array_equal(r["matched"], np.asarray(mt)[:n])
+    # and against a pure-python hash map, so both impls are pinned
+    ref = {int(kk): int(pp) for kk, pp in zip(bk, bp)}
+    for i in range(n):
+        exp = ref.get(int(pk[i]), 0)
+        got = int(r["payload"][i]) if r["matched"][i] else 0
+        assert got == exp
+
+
+def test_rows_identity_vs_convert_to_rows(sched):
+    rng = np.random.default_rng(3)
+    c = serve.Client(sched, "alice")
+    for ncols, n in [(5, 13), (3, 100), (1, 1)]:
+        cols = [rng.integers(-2**31, 2**31 - 1, n).astype(np.int32)
+                for _ in range(ncols)]
+        f = c.to_rows(cols)
+        sched.tick()
+        r = f.result(timeout=30)
+        direct = convert_to_rows(
+            Table.from_numpy(cols, [INT32] * ncols), bucket=None)
+        assert len(direct) == 1
+        db = np.asarray(direct[0].data).reshape(-1)
+        offs = np.asarray(direct[0].offsets)
+        assert r["row_size"] == int(offs[1] - offs[0])
+        assert r["num_rows"] == n
+        assert np.array_equal(np.asarray(r["rows"]).reshape(-1), db)
+
+
+# ---------------------------------------------------------------------------
+# Coalescing: K same-bucket requests -> ONE dispatch, programs bounded by
+# the bucket grid (the compile-telemetry acceptance guard)
+# ---------------------------------------------------------------------------
+
+def _serve_compiles(op):
+    return [e for e in obs.events("compile")
+            if e.get("span") == f"serve.{op}"]
+
+
+def test_coalescing_one_dispatch_bounded_compiles(obs_on, sched):
+    rng = np.random.default_rng(4)
+    clients = [serve.Client(sched, f"t{i}") for i in range(8)]
+    # distinct sizes, ONE row bucket; max_groups=64 keys the kernel away
+    # from every other test so the compile event is guaranteed fresh
+    sizes = [100 + 3 * i for i in range(8)]
+    assert len({shapes.bucket_rows(n) for n in sizes}) == 1
+
+    def burst():
+        futs = []
+        for c, n in zip(clients, sizes):
+            futs.append(c.aggregate(
+                rng.integers(0, 16, n).astype(np.int32),
+                rng.integers(-5, 5, n).astype(np.int32), max_groups=64))
+        return futs
+
+    futs = burst()
+    assert sched.tick() == 8
+    for f in futs:
+        assert f.result(timeout=30)["num_groups"] > 0
+    # 8 concurrent requests -> ONE mega-batch dispatch, at most ONE
+    # compiled program (one (row bucket, K bucket) combo)
+    assert _snap_total("srj_tpu_serve_batches_total") == 1
+    assert _snap_total("srj_tpu_serve_coalesced_requests_total") == 8
+    assert len(_serve_compiles("agg")) <= 1
+
+    # a second same-shaped burst must hit the jit cache: zero new programs
+    obs.clear()
+    futs = burst()
+    assert sched.tick() == 8
+    for f in futs:
+        f.result(timeout=30)
+    assert _snap_total("srj_tpu_serve_batches_total") == 2
+    assert len(_serve_compiles("agg")) == 0
+
+
+# ---------------------------------------------------------------------------
+# Admission control
+# ---------------------------------------------------------------------------
+
+def _tiny(rng, n=9):
+    return (rng.integers(0, 4, n).astype(np.int32),
+            rng.integers(-3, 3, n).astype(np.int32))
+
+
+def test_queue_full_rejection(obs_on):
+    rng = np.random.default_rng(5)
+    s = serve.Scheduler(serve.Config(max_depth=4, high_water=4))
+    try:
+        c = serve.Client(s, "alice")
+        futs = [c.aggregate(*_tiny(rng)) for _ in range(4)]
+        with pytest.raises(serve.QueueFull) as ei:
+            c.aggregate(*_tiny(rng))
+        assert ei.value.reason == "full"
+        assert ei.value.depth == 4 and ei.value.limit == 4
+        s.tick()
+        for f in futs:
+            f.result(timeout=30)
+        vals = metrics.registry().snapshot()[
+            "srj_tpu_serve_rejected_total"]["values"]
+        assert vals["reason=full"] == 1
+    finally:
+        s.close()
+
+
+def test_shedding_rejection_clears_after_drain(obs_on):
+    rng = np.random.default_rng(6)
+    s = serve.Scheduler(serve.Config(max_depth=8, high_water=2))
+    try:
+        c = serve.Client(s, "alice")
+        futs = [c.aggregate(*_tiny(rng)) for _ in range(2)]
+        assert s.queue.shedding    # high-water hit
+        with pytest.raises(serve.QueueFull) as ei:
+            c.aggregate(*_tiny(rng))
+        assert ei.value.reason == "shedding"
+        s.tick()                   # drain -> shed state clears
+        assert not s.queue.shedding
+        futs.append(c.aggregate(*_tiny(rng)))
+        s.tick()
+        for f in futs:
+            f.result(timeout=30)
+    finally:
+        s.close()
+
+
+def test_submit_after_close_raises_closed(sched):
+    rng = np.random.default_rng(7)
+    c = serve.Client(sched, "alice")
+    sched.close()
+    with pytest.raises(serve.QueueFull) as ei:
+        c.aggregate(*_tiny(rng))
+    assert ei.value.reason == "closed"
+
+
+# ---------------------------------------------------------------------------
+# /healthz backpressure + /metrics families over a real socket
+# ---------------------------------------------------------------------------
+
+def test_healthz_backpressure_flip(live_exporter):
+    rng = np.random.default_rng(8)
+    s = serve.Scheduler(serve.Config(max_depth=8, high_water=2))
+    try:
+        c = serve.Client(s, "alice")
+        futs = [c.aggregate(*_tiny(rng)) for _ in range(2)]
+        doc = json.loads(_scrape(live_exporter, "/healthz"))
+        assert doc["serve"]["shedding"] is True
+        assert doc["serve"]["queue_depth"] == 2
+        assert doc["serve"]["high_water"] == 2
+        s.tick()
+        for f in futs:
+            f.result(timeout=30)
+        doc = json.loads(_scrape(live_exporter, "/healthz"))
+        assert doc["serve"]["shedding"] is False
+        assert doc["serve"]["queue_depth"] == 0
+        assert doc["serve"]["served"] == 2
+    finally:
+        s.close()
+    # provider unregisters on close: /healthz drops the sub-document
+    doc = json.loads(_scrape(live_exporter, "/healthz"))
+    assert "serve" not in doc
+
+
+def test_metrics_families_per_tenant_in_scrape(live_exporter, sched):
+    rng = np.random.default_rng(9)
+    for tenant in ("alice", "bob"):
+        serve.Client(sched, tenant).aggregate(*_tiny(rng))
+    sched.tick()
+    body = _scrape(live_exporter, "/metrics")
+    for fam in ("srj_tpu_serve_requests_total",
+                "srj_tpu_serve_rows_total",
+                "srj_tpu_serve_bytes_total",
+                "srj_tpu_serve_batches_total",
+                "srj_tpu_serve_coalesced_requests_total",
+                "srj_tpu_serve_queue_seconds",
+                "srj_tpu_serve_exec_seconds",
+                "srj_tpu_serve_queue_depth",
+                "srj_tpu_serve_shedding"):
+        assert fam in body, fam
+    assert 'tenant="alice"' in body
+    assert 'tenant="bob"' in body
+
+
+def test_tenant_label_cardinality_cap(obs_on):
+    rng = np.random.default_rng(10)
+    s = serve.Scheduler(serve.Config(max_tenants=2))
+    try:
+        for i in range(4):
+            serve.Client(s, f"tenant-{i}").aggregate(*_tiny(rng))
+        s.tick()
+        vals = metrics.registry().snapshot()[
+            "srj_tpu_serve_requests_total"]["values"]
+        labels = {k: dict(p.split("=", 1) for p in k.split(","))
+                  for k in vals}
+        tenants = {d["tenant"] for d in labels.values()}
+        assert tenants == {"tenant-0", "tenant-1", OVERFLOW_TENANT}
+        overflow = sum(v for k, v in vals.items()
+                       if labels[k]["tenant"] == OVERFLOW_TENANT)
+        assert overflow == 2
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# Shutdown
+# ---------------------------------------------------------------------------
+
+def test_graceful_shutdown_drains_in_flight():
+    rng = np.random.default_rng(11)
+    s = serve.Scheduler().start()
+    c = serve.Client(s, "alice")
+    futs = [c.aggregate(*_tiny(rng, 9 + i)) for i in range(6)]
+    s.close(drain=True)
+    for f in futs:
+        r = f.result(timeout=30)   # resolved, not abandoned
+        assert r["num_groups"] > 0
+    s.close()                      # idempotent
+
+
+def test_abrupt_shutdown_fails_pending():
+    rng = np.random.default_rng(12)
+    s = serve.Scheduler()          # never started, nothing drains
+    c = serve.Client(s, "alice")
+    futs = [c.aggregate(*_tiny(rng)) for _ in range(3)]
+    s.close(drain=False)
+    for f in futs:
+        with pytest.raises(serve.QueueFull):
+            f.result(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# Tenant isolation under injected faults (chaos)
+# ---------------------------------------------------------------------------
+
+def test_fault_in_batch_isolates_to_one_tenant(obs_on, sched):
+    """One tenant's request dies mid-coalesced-batch; the other tenants
+    in the SAME mega-batch still get byte-correct results via the
+    per-request fallback, and only the poisoned future errors."""
+    rng = np.random.default_rng(13)
+    cs = [serve.Client(sched, f"t{i}") for i in range(3)]
+    data = [(rng.integers(0, 16, 40 + i).astype(np.int32),
+             rng.integers(-5, 5, 40 + i).astype(np.int32))
+            for i in range(3)]
+    # install UNARMED before warming: the execute hook only sees
+    # programs compiled while it is in place, and max_groups=32 keys
+    # this test's kernel away from every cached one
+    st = faultinj.install(config={})
+    try:
+        warm = [c.aggregate(k, v, max_groups=32)
+                for c, (k, v) in zip(cs, data)]
+        sched.tick()
+        for f in warm:
+            f.result(timeout=30)
+        st.apply_config({"pjrtExecuteFaults": {
+            "*": {"percent": 100, "injectionType": 1,   # FI_ASSERT
+                  "interceptionCount": 2}}})
+        futs = [c.aggregate(k, v, max_groups=32)
+                for c, (k, v) in zip(cs, data)]
+        sched.tick()
+    finally:
+        faultinj.uninstall()
+    # budget 2: the group dispatch eats one fault, the first fallback
+    # request eats the second -> exactly one tenant errors
+    errs = [f for f in futs if f.exception(timeout=30) is not None]
+    assert len(errs) == 1
+    assert errs[0] is futs[0]
+    assert isinstance(futs[0].exception(), faultinj.DeviceAssertError)
+    for f, (k, v) in list(zip(futs, data))[1:]:
+        r = f.result(timeout=30)
+        gk, s, h, n = _direct_agg(k, v, max_groups=32)
+        assert np.array_equal(r["sums"], s)
+        assert np.array_equal(r["group_keys"], gk)
+        assert r["num_groups"] == n
+    assert _snap_total("srj_tpu_serve_fallback_requests_total") == 3
+    assert _snap_total("srj_tpu_serve_request_failures_total") == 1
+
+
+def test_ops_validate_rejects_malformed():
+    s = serve.Scheduler()
+    try:
+        c = serve.Client(s, "alice")
+        with pytest.raises(ValueError):
+            c.aggregate(np.zeros((2, 2), np.int32), np.zeros(4, np.int32))
+        with pytest.raises(ValueError):
+            c.aggregate(np.zeros(0, np.int32), np.zeros(0, np.int32))
+        with pytest.raises(ValueError):
+            s.submit("alice", "no_such_op", x=1)
+    finally:
+        s.close()

@@ -4,6 +4,8 @@ the per-column path, the donated-scratch pad contract, sharded staged
 placement, the double-buffered prefetcher, and the ``staging.h2d`` /
 ``staging.d2h`` span attributes the report CLI aggregates."""
 
+import threading
+
 import numpy as np
 import pytest
 
@@ -393,6 +395,38 @@ def test_prefetcher_close_stops_early():
     pf = staging.Prefetcher(range(100), lambda i: i, depth=2)
     assert next(pf) == 0
     pf.close()  # must not hang or raise
+
+
+def _prefetch_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("srj-staging-prefetch") and t.is_alive()]
+
+
+def test_prefetcher_close_joins_worker():
+    # close() must JOIN the worker, not just abandon it: a serving loop
+    # creating one Prefetcher per query would otherwise accumulate
+    # threads until the process dies.
+    before = len(_prefetch_threads())
+    pf = staging.Prefetcher(range(100), lambda i: i * 2, depth=3)
+    assert next(pf) == 0
+    assert next(pf) == 2
+    pf.close()
+    assert len(_prefetch_threads()) == before
+    pf.close()  # idempotent
+    with pytest.raises(StopIteration):
+        next(pf)
+
+
+def test_prefetcher_context_manager_full_iteration():
+    before = len(_prefetch_threads())
+    with staging.Prefetcher(range(5), lambda i: i + 1) as pf:
+        assert list(pf) == [1, 2, 3, 4, 5]
+    assert len(_prefetch_threads()) == before
+
+
+def test_prefetcher_rejects_bad_depth():
+    with pytest.raises(ValueError):
+        staging.Prefetcher([1], lambda x: x, depth=0)
 
 
 # ---------------------------------------------------------------------------
