@@ -34,7 +34,8 @@ offline report also computes use the SAME metric names as ``report
   ``requests_total`` / ``request_failures_total`` (``{tenant,op}``),
   ``rows_total`` / ``bytes_total`` (``{tenant}``), ``rejected_total``
   (``{reason}`` = full|shedding|closed), ``batches_total`` /
-  ``coalesced_requests_total`` / ``fallback_requests_total`` (``{op}``),
+  ``coalesced_requests_total`` / ``fallback_requests_total`` /
+  ``cancelled_total`` (``{op}``), ``tick_errors_total``,
   ``queue_seconds`` / ``exec_seconds`` histograms (``{op}``), and the
   ``queue_depth`` / ``shedding`` / ``tenants`` gauges.  **Tenant-label
   cardinality cap**: only the first ``SRJ_TPU_SERVE_MAX_TENANTS``

@@ -14,7 +14,10 @@ raises :class:`QueueFull` when the queue is at capacity (``reason
 or after close (``reason="closed"``).  Shedding has hysteresis: it trips
 when depth reaches the high-water mark and clears only when a drain
 takes depth back to the low-water mark — a queue hovering at the
-boundary flaps once, not per request.  ``/healthz`` surfaces both depth
+boundary flaps once, not per request.  An unbounded drain empties the
+queue and so always clears shedding; the low-water gate bites when the
+scheduler drains boundedly (``Config.max_batch`` /
+``SRJ_TPU_SERVE_MAX_BATCH``).  ``/healthz`` surfaces both depth
 and the shed flag (see :mod:`obs.exporter`'s provider hook), so external
 load balancers see backpressure the same instant submitters do.
 """
@@ -108,13 +111,21 @@ class RequestQueue:
 
     # -- scheduler side ----------------------------------------------------
 
-    def drain(self) -> Dict[Tuple[str, Tuple], List[Request]]:
-        """Take every pending request, grouped by coalescing key.
+    def drain(self, limit: Optional[int] = None
+              ) -> Dict[Tuple[str, Tuple], List[Request]]:
+        """Take up to ``limit`` pending requests (all of them when
+        ``limit`` is None or <= 0), FIFO, grouped by coalescing key.
 
-        Clears shedding when the post-drain depth (always 0 here) is at
-        or under the low-water mark — the hysteresis release edge."""
+        Clears shedding when the post-drain depth is at or under the
+        low-water mark — the hysteresis release edge.  A full drain
+        therefore always clears shedding (depth falls to 0); low-water
+        only gates bounded drains (scheduler ``max_batch``)."""
         with self._cond:
-            taken, self._pending = self._pending, []
+            if limit is not None and 0 < limit < len(self._pending):
+                taken = self._pending[:limit]
+                self._pending = self._pending[limit:]
+            else:
+                taken, self._pending = self._pending, []
             if self._shedding and len(self._pending) <= self.low_water:
                 self._shedding = False
         groups: Dict[Tuple[str, Tuple], List[Request]] = {}

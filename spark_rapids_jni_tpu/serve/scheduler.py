@@ -21,11 +21,21 @@ carries the error.  ``tests/test_serve.py`` drives this with the
 Metrics (``srj_tpu_serve_*`` families, see :mod:`obs.metrics`): requests
 / rows / bytes / failures are per-tenant with the label value capped at
 ``max_tenants`` distinct tenants (later tenants fold into
-``_overflow`` — the documented cardinality cap); queue/exec latency
-histograms and batch/coalescing counters are per-op; depth, shed state
-and tenant count are gauges.  The scheduler also registers an
-``obs.exporter`` health provider, so ``/healthz`` reports queue depth
-and shed state for load-balancer backpressure.
+``_overflow`` — the documented cardinality cap; the scheduler tracks at
+most ``max_tenants`` ids, so a tenant-id flood cannot grow its memory);
+queue/exec latency histograms and batch/coalescing counters are per-op;
+depth, shed state and tenant count are gauges.  The scheduler also
+registers an ``obs.exporter`` health provider, so ``/healthz`` reports
+queue depth and shed state for load-balancer backpressure.
+
+Futures follow the executor protocol: the tick claims each request via
+``Future.set_running_or_notify_cancel()`` before dispatch, so a client
+that cancels a still-queued future just drops it from the batch
+(``srj_tpu_serve_cancelled_total``), and every resolution goes through a
+guard that tolerates already-resolved futures — a bad future can fail
+only itself, never the scheduler loop.  The loop itself survives any
+unexpected tick error (``srj_tpu_serve_tick_errors_total``): a failing
+group fails its own futures; everything else keeps ticking.
 
 Env knobs (all overridable via :class:`Config`):
 
@@ -33,6 +43,9 @@ Env knobs (all overridable via :class:`Config`):
 - ``SRJ_TPU_SERVE_TICK`` — tick interval seconds (default 0.002)
 - ``SRJ_TPU_SERVE_MAX_TENANTS`` — tenant-label cardinality cap (64)
 - ``SRJ_TPU_SERVE_HIWATER`` — shed high-water mark (default 3/4 depth)
+- ``SRJ_TPU_SERVE_MAX_BATCH`` — max requests drained per tick (default
+  0 = unlimited; bounding it makes the queue's low-water hysteresis
+  meaningful, since depth then falls gradually instead of to zero)
 """
 
 from __future__ import annotations
@@ -82,6 +95,9 @@ class Config:
     high_water: Optional[int] = dataclasses.field(
         default_factory=lambda: (
             _env_int("SRJ_TPU_SERVE_HIWATER", 0) or None))
+    max_batch: Optional[int] = dataclasses.field(
+        default_factory=lambda: (
+            _env_int("SRJ_TPU_SERVE_MAX_BATCH", 0) or None))
 
 
 # -- metric families (created lazily so registry resets don't strand us) ----
@@ -117,6 +133,13 @@ def _fam():
             "srj_tpu_serve_fallback_requests_total",
             "Requests retried per-request after a failed group dispatch.",
             ("op",)),
+        "cancelled": m.counter(
+            "srj_tpu_serve_cancelled_total",
+            "Requests whose future was cancelled while queued, by op.",
+            ("op",)),
+        "tick_errors": m.counter(
+            "srj_tpu_serve_tick_errors_total",
+            "Unexpected scheduler errors survived by the tick loop."),
         "queue_s": m.histogram(
             "srj_tpu_serve_queue_seconds",
             "Submit-to-dispatch latency, by op.", ("op",)),
@@ -130,8 +153,8 @@ def _fam():
             "1 while backpressure shedding is active."),
         "tenants": m.gauge(
             "srj_tpu_serve_tenants",
-            "Distinct tenants seen (label cap: later ones fold into "
-            "_overflow)."),
+            "Distinct tenants tracked, capped at max_tenants (excess "
+            "tenants fold into _overflow and are not tracked)."),
     }
 
 
@@ -184,14 +207,15 @@ class Scheduler:
         if not drain:
             for reqs in self.queue.drain().values():
                 for r in reqs:
-                    r.future.set_exception(
-                        QueueFull("closed", 0, self.config.max_depth))
+                    self._resolve(r.future, exc=QueueFull(
+                        "closed", 0, self.config.max_depth))
         self._stop.set()
         t = self._thread
         if t is not None:
             t.join(timeout)
         if drain:
-            self.tick()          # anything the loop didn't get to
+            while self.tick():   # bounded (max_batch) drains may need
+                pass             # several passes to empty the queue
         from spark_rapids_jni_tpu.obs import exporter as _exporter
         _exporter.unregister_health_provider("serve")
 
@@ -200,13 +224,15 @@ class Scheduler:
     def _tenant_label(self, tenant: str) -> str:
         with self._lock:
             lbl = self._tenant_labels.get(tenant)
-            if lbl is None:
-                lbl = tenant if (len(self._tenant_labels)
-                                 < self.config.max_tenants) \
-                    else OVERFLOW_TENANT
-                self._tenant_labels[tenant] = lbl
-                self._m["tenants"].set(len(self._tenant_labels))
-            return lbl
+            if lbl is not None:
+                return lbl
+            if len(self._tenant_labels) >= self.config.max_tenants:
+                # at the cardinality cap: do NOT remember the id, or a
+                # tenant-id flood would grow this dict without bound
+                return OVERFLOW_TENANT
+            self._tenant_labels[tenant] = tenant
+            self._m["tenants"].set(len(self._tenant_labels))
+            return tenant
 
     def submit(self, tenant: str, op: str, **kwargs
                ) -> "concurrent.futures.Future":
@@ -236,17 +262,54 @@ class Scheduler:
     def _loop(self) -> None:
         while not self._stop.is_set():
             self.queue.wait(self.config.tick_s)
+            self._tick_guarded()
+        self._tick_guarded()     # drain whatever raced the stop flag
+
+    def _tick_guarded(self) -> None:
+        # the daemon thread must survive ANY tick bug — an escaped
+        # exception here would hang every tenant's pending futures
+        try:
             self.tick()
-        self.tick()              # drain whatever raced the stop flag
+        except Exception:        # noqa: BLE001 — counted, loop lives on
+            try:
+                self._m["tick_errors"].inc()
+            except Exception:    # noqa: BLE001 — even a metrics bug
+                pass             # must not take the loop down
+
+    @staticmethod
+    def _resolve(fut, result=None, exc=None) -> bool:
+        """Resolve ``fut`` if it still can be (not cancelled, not already
+        resolved); True when this call resolved it.  One unresolvable
+        future must never abort resolution of the rest of a group."""
+        if fut.done():
+            return False
+        try:
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(result)
+            return True
+        except concurrent.futures.InvalidStateError:
+            return False
 
     def tick(self) -> int:
-        """Process every pending group now; returns requests served."""
-        groups = self.queue.drain()
+        """Process pending groups now (all of them, or up to
+        ``Config.max_batch`` requests); returns requests served."""
+        groups = self.queue.drain(self.config.max_batch)
         self._m["depth"].set(self.queue.depth)
         self._m["shedding"].set(1 if self.queue.shedding else 0)
         n = 0
         for (op, sig), reqs in groups.items():
-            n += self._execute_group(op, sig, reqs)
+            try:
+                n += self._execute_group(op, sig, reqs)
+            except Exception as e:   # noqa: BLE001 — fail the group,
+                # keep ticking: the other groups' tenants are innocent
+                self._m["tick_errors"].inc()
+                for r in reqs:
+                    if self._resolve(r.future, exc=e):
+                        self._m["failures"].inc(
+                            tenant=self._tenant_label(r.tenant), op=op)
+                n += len(reqs)
         if groups:
             self.ticks += 1
             self.served += n
@@ -255,28 +318,41 @@ class Scheduler:
     def _execute_group(self, op: str, sig, reqs: List[Request]) -> int:
         opdef = serve_ops.get(op)
         t0 = time.perf_counter()
+        # claim every future (executor protocol): a request cancelled
+        # while queued is dropped here, and the survivors can no longer
+        # be cancelled mid-scatter
+        live: List[Request] = []
         for r in reqs:
+            if r.future.set_running_or_notify_cancel():
+                live.append(r)
+            else:
+                self._m["cancelled"].inc(op=op)
+        if not live:
+            return len(reqs)
+        for r in live:
             self._m["queue_s"].observe(t0 - r.t_submit, op=op)
         try:
-            outs = self._dispatch(opdef, sig, [r.payload for r in reqs])
-            for slot, r in enumerate(reqs):
-                r.future.set_result(
-                    opdef.unbatch(outs, slot, r.payload))
+            outs = self._dispatch(opdef, sig, [r.payload for r in live])
+            for slot, r in enumerate(live):
+                self._resolve(r.future, opdef.unbatch(outs, slot, r.payload))
             self._m["batches"].inc(op=op)
-            self._m["coalesced"].inc(len(reqs), op=op)
+            self._m["coalesced"].inc(len(live), op=op)
         except Exception:
             # group poisoned: isolate tenants by retrying each request
             # as its own single-slot batch; only the request whose
-            # retry ALSO fails carries an error
-            for r in reqs:
+            # retry ALSO fails carries an error.  Futures the scatter
+            # loop already resolved are skipped, not re-dispatched.
+            for r in live:
+                if r.future.done():
+                    continue
                 self._m["fallbacks"].inc(op=op)
                 try:
                     outs = self._dispatch(opdef, r.sig, [r.payload])
-                    r.future.set_result(opdef.unbatch(outs, 0, r.payload))
+                    self._resolve(r.future, opdef.unbatch(outs, 0, r.payload))
                 except Exception as e:   # noqa: BLE001 — future carries it
-                    r.future.set_exception(e)
-                    self._m["failures"].inc(
-                        tenant=self._tenant_label(r.tenant), op=op)
+                    if self._resolve(r.future, exc=e):
+                        self._m["failures"].inc(
+                            tenant=self._tenant_label(r.tenant), op=op)
         self._m["exec_s"].observe(time.perf_counter() - t0, op=op)
         return len(reqs)
 

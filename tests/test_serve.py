@@ -5,6 +5,7 @@ per-tenant ``srj_tpu_serve_*`` families in a real /metrics scrape,
 graceful shutdown, and tenant isolation under injected faults."""
 
 import json
+import time
 import urllib.request
 
 import numpy as np
@@ -310,6 +311,9 @@ def test_tenant_label_cardinality_cap(obs_on):
         overflow = sum(v for k, v in vals.items()
                        if labels[k]["tenant"] == OVERFLOW_TENANT)
         assert overflow == 2
+        # overflow tenant ids are NOT remembered: a tenant-id flood
+        # cannot grow scheduler memory past the cap
+        assert len(s._tenant_labels) == 2
     finally:
         s.close()
 
@@ -386,6 +390,121 @@ def test_fault_in_batch_isolates_to_one_tenant(obs_on, sched):
         assert r["num_groups"] == n
     assert _snap_total("srj_tpu_serve_fallback_requests_total") == 3
     assert _snap_total("srj_tpu_serve_request_failures_total") == 1
+
+
+# ---------------------------------------------------------------------------
+# Future-state robustness: cancellation, partial-scatter failure, tick
+# bugs — none of these may kill the scheduler loop or other tenants
+# ---------------------------------------------------------------------------
+
+def test_cancelled_future_skipped_others_served(obs_on, sched):
+    rng = np.random.default_rng(14)
+    c = serve.Client(sched, "alice")
+    data = [_tiny(rng, 9 + i) for i in range(3)]
+    futs = [c.aggregate(k, v) for k, v in data]
+    assert futs[1].cancel()
+    sched.tick()
+    assert futs[1].cancelled()
+    for f, (k, v) in [(futs[0], data[0]), (futs[2], data[2])]:
+        r = f.result(timeout=30)
+        gk, s, h, n = _direct_agg(k, v)
+        assert np.array_equal(r["sums"], s)
+        assert r["num_groups"] == n
+    assert _snap_total("srj_tpu_serve_cancelled_total") == 1
+    # the loop survived the cancelled future: a follow-up round-trips
+    f = c.aggregate(*_tiny(rng))
+    sched.tick()
+    assert f.result(timeout=30)["num_groups"] > 0
+
+
+def test_mid_scatter_unbatch_failure_isolates(obs_on, sched, monkeypatch):
+    """``unbatch`` raising after some futures already resolved must not
+    re-resolve or re-dispatch them (the InvalidStateError pathology):
+    only the still-unresolved requests fall back, and everyone's result
+    stays byte-correct."""
+    from spark_rapids_jni_tpu.serve import ops as serve_ops
+    rng = np.random.default_rng(15)
+    cs = [serve.Client(sched, f"t{i}") for i in range(3)]
+    data = [_tiny(rng, 20 + i) for i in range(3)]
+    opdef = serve_ops.get("agg")
+    real = opdef.unbatch
+    # slot 1 fails in the scatter loop; fallbacks unbatch slot 0 and pass
+    monkeypatch.setattr(
+        opdef, "unbatch",
+        lambda outs, slot, payload: (
+            (_ for _ in ()).throw(RuntimeError("scatter bug"))
+            if slot == 1 else real(outs, slot, payload)))
+    futs = [c.aggregate(k, v) for c, (k, v) in zip(cs, data)]
+    sched.tick()
+    for f, (k, v) in zip(futs, data):
+        r = f.result(timeout=30)
+        gk, s, h, n = _direct_agg(k, v)
+        assert np.array_equal(r["sums"], s)
+        assert r["num_groups"] == n
+    # slot 0 resolved in the scatter loop and was skipped by the
+    # fallback; only the two unresolved requests were retried
+    assert _snap_total("srj_tpu_serve_fallback_requests_total") == 2
+    assert _snap_total("srj_tpu_serve_request_failures_total") == 0
+
+
+def test_group_level_bug_fails_group_not_loop(obs_on, sched):
+    rng = np.random.default_rng(16)
+    c = serve.Client(sched, "alice")
+    futs = [c.aggregate(*_tiny(rng)) for _ in range(2)]
+    boom = RuntimeError("group bug")
+
+    def bad_group(op, sig, reqs):
+        raise boom
+
+    sched._execute_group = bad_group
+    assert sched.tick() == 2          # no escape from tick()
+    for f in futs:
+        assert f.exception(timeout=5) is boom
+    assert _snap_total("srj_tpu_serve_tick_errors_total") == 1
+    del sched._execute_group          # back to the class method
+    f = c.aggregate(*_tiny(rng))
+    sched.tick()
+    assert f.result(timeout=30)["num_groups"] > 0
+
+
+def test_loop_thread_survives_tick_bug(obs_on):
+    rng = np.random.default_rng(17)
+    s = serve.Scheduler().start()
+    try:
+        def bad_tick():
+            raise RuntimeError("tick bug")
+
+        s.tick = bad_tick
+        deadline = time.time() + 30
+        while _snap_total("srj_tpu_serve_tick_errors_total") == 0:
+            assert time.time() < deadline, "loop guard never fired"
+            time.sleep(0.01)
+        assert s._thread.is_alive()
+        del s.tick                    # back to the class method
+        f = serve.Client(s, "alice").aggregate(*_tiny(rng))
+        assert f.result(timeout=30)["num_groups"] > 0
+    finally:
+        s.close()
+
+
+def test_max_batch_partial_drain_low_water_hysteresis(obs_on):
+    rng = np.random.default_rng(18)
+    s = serve.Scheduler(serve.Config(
+        max_depth=16, high_water=4, max_batch=1))
+    try:
+        assert s.queue.low_water == 2
+        c = serve.Client(s, "alice")
+        futs = [c.aggregate(*_tiny(rng)) for _ in range(4)]
+        assert s.queue.shedding       # high-water hit at depth 4
+        assert s.tick() == 1          # depth 3 > low water: still shed
+        assert s.queue.shedding
+        assert s.tick() == 1          # depth 2 == low water: clears
+        assert not s.queue.shedding
+        s.close()                     # drain loops past max_batch
+        for f in futs:
+            assert f.result(timeout=30)["num_groups"] > 0
+    finally:
+        s.close()
 
 
 def test_ops_validate_rejects_malformed():
