@@ -18,6 +18,7 @@ to BENCH_DETAILS.json.
 """
 
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -81,25 +82,57 @@ class CalibrationError(BenchError):
     not that any kernel is slow."""
 
 
+# the axis run's trace context: _run_axis roots it, _leg_span activates
+# it around every leg, and the per-axis obs digest records its trace_id
+_AXIS_TRACE = None
+
+
+@contextlib.contextmanager
+def _leg_span(name):
+    """The single span-emission path for every bench leg (timing legs,
+    the HBM calibration anchor, the ragged streams): one ``leg.<name>``
+    span under the axis trace context, so all leg spans share the axis
+    trace_id that ``_obs_axis_summary`` records."""
+    from spark_rapids_jni_tpu import obs
+    with obs.context.activate(_AXIS_TRACE):
+        with obs.span(f"leg.{name}") as sp:
+            yield sp
+
+
+def _new_bundles(before):
+    """The flight-recorder bundle written since ``before``, if any."""
+    from spark_rapids_jni_tpu.obs import recorder
+    path = recorder.last_bundle()
+    return path if path != before else None
+
+
 def _leg(name, fn, leg_errors=None, *, label=None, required=False, **kw):
     """One timing leg under an obs span: wall/device time, compile count,
     and (on death) the structured exception all land in the event log —
     a failed leg is a record, not a hole.  With ``leg_errors`` a dict the
-    failure is recorded as ``{op, type, error}`` and the leg returns
-    ``None`` (a partial axis record beats none — the 1M from-rows leg
-    has died through whole bad relay windows while every other leg
-    passed); ``required`` legs re-raise as :class:`BenchLegError` so the
-    axis error names the op."""
-    from spark_rapids_jni_tpu import obs
+    failure is recorded as ``{op, type, error}`` (plus ``bundle``, the
+    flight-recorder dump path, when ``SRJ_TPU_DIAG_DIR`` is armed) and
+    the leg returns ``None`` (a partial axis record beats none — the 1M
+    from-rows leg has died through whole bad relay windows while every
+    other leg passed); ``required`` legs re-raise as
+    :class:`BenchLegError` so the axis error names the op."""
+    from spark_rapids_jni_tpu.obs import recorder
+    b0 = recorder.last_bundle()
     try:
-        with obs.span(f"leg.{name}"):
+        with _leg_span(name):
             return _time(fn, label=label or name, **kw)
     except Exception as e:
+        bundle = _new_bundles(b0)
         if required or leg_errors is None:
-            raise BenchLegError(name, e) from e
+            err = BenchLegError(name, e)
+            err.bundle = bundle
+            raise err from e
         leg_errors[name] = {"op": name, "type": type(e).__name__,
                             "error": str(e)[:90]}
-        _log(f"{name}: LEG FAILED {type(e).__name__}: {str(e)[:90]}")
+        if bundle:
+            leg_errors[name]["bundle"] = bundle
+        _log(f"{name}: LEG FAILED {type(e).__name__}: {str(e)[:90]}"
+             + (f" (bundle: {bundle})" if bundle else ""))
         return None
 
 
@@ -425,9 +458,8 @@ def _calibrate_hbm():
     # hazard _time documents); 16 x 256MB stays well inside HBM while
     # remaining far above the tunnel round-trip in cost
     n = 64 * 1024 * 1024
-    from spark_rapids_jni_tpu import obs
     try:
-        with obs.span("leg.hbm_calibration"):
+        with _leg_span("hbm_calibration"):
             x = jax.jit(lambda: jnp.ones((n,), jnp.uint32))()
             _sync(x)
             cp = jax.jit(lambda a: a + jnp.uint32(1))
@@ -535,7 +567,7 @@ def bench_ragged(num_batches):
     def _stream(bucket, label):
         c0 = obs.compile_totals()
         t0 = time.perf_counter()
-        with obs.span(f"leg.ragged_{label}"):
+        with _leg_span(f"ragged_{label}"):
             for t, s in batches:
                 rows = convert_to_rows(t, bucket=bucket)
                 _sync(rows[0].data)
@@ -734,6 +766,10 @@ def _obs_axis_summary():
             d["error_types"] = rec["error_types"]
         ops[name] = d
     out = {"ops": ops, "compiles": summ["compiles"]}
+    if _AXIS_TRACE is not None:
+        # the trace_id every leg span carries: grep it in the JSONL log
+        # (or a flight-recorder bundle) to find this axis run's events
+        out["trace_id"] = _AXIS_TRACE.trace_id
     dropped = obs.dropped()
     if dropped.get("events_dropped") or dropped.get("sink_errors"):
         # the digest above came from a truncated ring — record that, so a
@@ -746,6 +782,8 @@ def _run_axis(axis: str):
     """Run one benchmark axis in this process and print its result JSON."""
     from spark_rapids_jni_tpu import obs
     obs.enable()   # ring buffer (+ the SRJ_TPU_EVENTS sink if configured)
+    global _AXIS_TRACE
+    _AXIS_TRACE = obs.context.root(tenant=f"bench:{axis}")
     # importing obs honors SRJ_TPU_METRICS_PORT: axis legs run one at a
     # time, so the live /metrics endpoint follows the active leg
     from spark_rapids_jni_tpu.obs import exporter

@@ -210,6 +210,86 @@ print(f"trace smoke: {len(evs)} trace events, balanced nesting")
 PY
 rm -f "$TRACE_EVENTS" "$TRACE_OUT"
 
+# flight-recorder smoke: arm the recorder via env, push one injected
+# fault through the serving path's coalesced batch, and assert exactly
+# ONE diagnostics bundle lands and the --bundle CLI renders it; then
+# write a second (fake host 1) sink and check the merged two-host trace
+# against the Perfetto schema — phases legal, flow s/f ids paired, one
+# process lane per host
+FR_DIAG=$(mktemp -d /tmp/srj_fr_smoke.XXXXXX.diag)
+FR_H0=$(mktemp /tmp/srj_fr_smoke.XXXXXX.host0.jsonl)
+FR_H1=$(mktemp /tmp/srj_fr_smoke.XXXXXX.host1.jsonl)
+FR_MERGED=$(mktemp /tmp/srj_fr_smoke.XXXXXX.trace.json)
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu SRJ_TPU_DIAG_DIR="$FR_DIAG" \
+  SRJ_TPU_HOST=0 SRJ_TPU_EVENTS="$FR_H0" python - <<'PY'
+import numpy as np
+from spark_rapids_jni_tpu import faultinj, obs, serve
+
+obs.enable()
+rng = np.random.default_rng(7)
+with serve.Scheduler() as sched:
+    cs = [serve.Client(sched, f"t{i}") for i in range(3)]
+    data = [(rng.integers(0, 16, 40 + i).astype(np.int32),
+             rng.integers(-5, 5, 40 + i).astype(np.int32))
+            for i in range(3)]
+    st = faultinj.install(config={})
+    try:
+        warm = [c.aggregate(k, v, max_groups=32)
+                for c, (k, v) in zip(cs, data)]
+        for f in warm:
+            f.result(timeout=60)
+        st.apply_config({"pjrtExecuteFaults": {
+            "*": {"percent": 100, "injectionType": 1,
+                  "interceptionCount": 2}}})
+        futs = [c.aggregate(k, v, max_groups=32)
+                for c, (k, v) in zip(cs, data)]
+        errs = sum(1 for f in futs if f.exception(timeout=60) is not None)
+    finally:
+        faultinj.uninstall()
+assert errs == 1, f"expected exactly one poisoned tenant, got {errs}"
+from spark_rapids_jni_tpu.obs import recorder
+assert recorder.last_bundle(), "fault produced no diagnostics bundle"
+print(f"flight-recorder smoke: bundle at {recorder.last_bundle()}")
+PY
+test "$(ls -d "$FR_DIAG"/bundle-* | wc -l)" -eq 1
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python -m spark_rapids_jni_tpu.obs --bundle "$FR_DIAG"/bundle-* \
+  | grep -q "flight-recorder bundle"
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  SRJ_TPU_HOST=1 SRJ_TPU_EVENTS="$FR_H1" python -c "
+import numpy as np
+from spark_rapids_jni_tpu import Column, INT32, obs
+from spark_rapids_jni_tpu.ops import murmur3_hash
+obs.enable()
+murmur3_hash([Column.from_numpy(np.arange(64, dtype=np.int32), INT32)])
+"
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python -m spark_rapids_jni_tpu.obs --merge "$FR_H0" "$FR_H1" \
+  --trace "$FR_MERGED"
+python - "$FR_MERGED" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert set(doc) == {"traceEvents", "displayTimeUnit"}, set(doc)
+evs = doc["traceEvents"]
+bad = [e for e in evs if e["ph"] not in ("M", "B", "E", "X", "C", "s", "f")]
+assert not bad, f"illegal phases: {sorted({e['ph'] for e in bad})}"
+starts = [e for e in evs if e["ph"] == "s"]
+finishes = [e for e in evs if e["ph"] == "f"]
+assert starts, "no request->batch flow arrows in merged trace"
+assert {e["id"] for e in starts} == {e["id"] for e in finishes}
+for e in starts + finishes:
+    assert e["cat"] == "srj.flow" and "ts" in e and "pid" in e
+pids = {e["pid"] for e in evs}
+assert pids == {0, 1}, f"expected one lane per host, got pids {pids}"
+names = {e["args"]["name"] for e in evs
+         if e["ph"] == "M" and e["name"] == "process_name"}
+assert names == {"spark_rapids_jni_tpu host0",
+                 "spark_rapids_jni_tpu host1"}, names
+print(f"flight-recorder smoke: merged trace OK — {len(evs)} events, "
+      f"{len(starts)} flow arrows, hosts {sorted(pids)}")
+PY
+rm -rf "$FR_DIAG" "$FR_H0" "$FR_H1" "$FR_MERGED"
+
 # perf-regression gate, advisory for now: reports deltas of the newest
 # checked-in bench round vs the prior one (flip --mode enforce once the
 # round cadence stabilizes); the synthetic self-test proves the gate

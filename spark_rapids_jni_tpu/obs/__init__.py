@@ -30,11 +30,22 @@ extending ``utils/tracing.py`` (named scopes) and ``utils/metrics.py``
   starts it at import; off by default (no thread, no socket).
 - :mod:`~spark_rapids_jni_tpu.obs.trace` — span log -> Chrome/Perfetto
   ``trace_event`` JSON (per-thread lanes, nested durations, compile and
-  transfer counter tracks).
+  transfer counter tracks, request->batch flow arrows, per-host process
+  lanes for merged multihost logs).
+- :mod:`~spark_rapids_jni_tpu.obs.context` — request-scoped trace
+  context (``trace_id``/``span_id``/tenant) with an explicit
+  ``capture()``/``activate()`` handoff for thread pools; spans stamp it
+  into every event automatically.
+- :mod:`~spark_rapids_jni_tpu.obs.recorder` — failure flight recorder:
+  on a failed span or a :class:`~spark_rapids_jni_tpu.obs.recorder.Watchdog`
+  stall, dump the last-K ring events + the failing program's lowered
+  StableHLO + memory/env snapshots as a bundle under
+  ``SRJ_TPU_DIAG_DIR``.
 - ``python -m spark_rapids_jni_tpu.obs <events.jsonl>`` — per-op summary
   table (calls, p50/p95 wall, device ms, volume, compiles, failures), a
-  ``--prom`` Prometheus text exposition, and ``--trace out.json`` for the
-  Perfetto export.
+  ``--prom`` Prometheus text exposition, ``--trace out.json`` for the
+  Perfetto export, ``--merge host*.jsonl`` to combine per-host logs, and
+  ``--bundle <dir>`` to render a flight-recorder bundle.
 
 Enable with ``SRJ_TPU_EVENTS=<path>``, ``SRJ_TPU_OBS=1``, or
 :func:`enable`; off by default and free when off (no fences, no locks).
@@ -47,7 +58,9 @@ from spark_rapids_jni_tpu.obs.spans import (  # noqa: F401
     enable, enabled, events, flush, recording, sink_path, span, span_fn,
 )
 from spark_rapids_jni_tpu.obs import compilemon as _compilemon
+from spark_rapids_jni_tpu.obs import context  # noqa: F401
 from spark_rapids_jni_tpu.obs import metrics  # noqa: F401
+from spark_rapids_jni_tpu.obs import recorder  # noqa: F401
 from spark_rapids_jni_tpu.obs import report  # noqa: F401
 
 compile_totals = _compilemon.totals

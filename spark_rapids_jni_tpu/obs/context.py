@@ -1,0 +1,176 @@
+"""Request-scoped trace context: end-to-end causality across threads.
+
+A :class:`TraceContext` names the *request* a piece of work belongs to:
+``trace_id`` (stable for the whole request), ``span_id`` (the innermost
+enclosing span — the parent of whatever starts next), and the submitting
+``tenant``.  :func:`spark_rapids_jni_tpu.obs.spans.span` reads the
+current context on entry, stamps ``trace_id``/``span_id``/
+``parent_span_id`` into the finished event, and activates a child
+context for its body — so one ``activate()`` at the request boundary is
+enough to tie every op span, staging span and kernel span below it to
+that request, no matter how deep the call chain nests.
+
+Propagation is :mod:`contextvars`-based and therefore **does not** leak
+across threads: a new thread starts with no context (exactly what a
+multi-tenant scheduler needs — tenant A's context cannot bleed into
+tenant B's worker).  Crossing a thread pool is an *explicit handoff*:
+
+    ctx = context.capture()                 # on the submitting thread
+    pool.submit(context.run_with, ctx, fn)  # on the worker
+
+(:func:`wrap` packages the same two steps for callable-shaped APIs; the
+staging prefetcher and the serve scheduler use exactly this.)
+
+Hosts: every obs event is stamped with a ``host`` lane id so per-host
+JSONL logs from a multihost run (``parallel/multihost.py``) can be
+merged into ONE Perfetto trace with one process lane per host
+(``python -m spark_rapids_jni_tpu.obs --merge host*.jsonl --trace ...``).
+The id comes from ``SRJ_TPU_HOST`` if set, else ``jax.process_index()``
+once a distributed runtime is up, else 0; :func:`set_host` pins it.
+
+Everything here is allocation-light (one 8-byte ``os.urandom`` per id)
+and import-cycle-free: this module imports nothing from the rest of
+``obs``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+import os
+from typing import Optional
+
+__all__ = [
+    "TraceContext", "new_id", "root", "current", "capture", "activate",
+    "run_with", "wrap", "set_host", "host_id",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """Immutable context snapshot: safe to hand to any thread."""
+
+    trace_id: str
+    span_id: str
+    tenant: Optional[str] = None
+
+    def child(self, span_id: str) -> "TraceContext":
+        """Same trace, new parent span (what a span activates for its
+        body)."""
+        return dataclasses.replace(self, span_id=span_id)
+
+
+_CTX: "contextvars.ContextVar[Optional[TraceContext]]" = \
+    contextvars.ContextVar("srj_tpu_trace_ctx", default=None)
+
+
+def new_id() -> str:
+    """A fresh 64-bit hex id (trace or span)."""
+    return os.urandom(8).hex()
+
+
+def root(tenant: Optional[str] = None,
+         trace_id: Optional[str] = None) -> TraceContext:
+    """A new root context (fresh trace unless ``trace_id`` is given)."""
+    return TraceContext(trace_id=trace_id or new_id(), span_id=new_id(),
+                        tenant=tenant)
+
+
+def current() -> Optional[TraceContext]:
+    """The active context on THIS thread/task, or None."""
+    return _CTX.get()
+
+
+def capture() -> Optional[TraceContext]:
+    """Snapshot the active context for an explicit cross-thread handoff
+    (the submitting half of the ``capture()``/``activate()`` pair)."""
+    return _CTX.get()
+
+
+@contextlib.contextmanager
+def activate(ctx: Optional[TraceContext]):
+    """Make ``ctx`` the active context for the block (``None`` is a
+    no-op, so ``activate(capture())`` is always safe)."""
+    if ctx is None:
+        yield None
+        return
+    token = _CTX.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _CTX.reset(token)
+
+
+def run_with(ctx: Optional[TraceContext], fn, *args, **kwargs):
+    """Call ``fn`` under ``ctx`` — the worker half of the handoff,
+    shaped for ``executor.submit(run_with, capture(), fn, item)``."""
+    if ctx is None:
+        return fn(*args, **kwargs)
+    token = _CTX.set(ctx)
+    try:
+        return fn(*args, **kwargs)
+    finally:
+        _CTX.reset(token)
+
+
+def wrap(fn):
+    """Bind the CURRENT context into a callable: the returned function
+    runs ``fn`` under the context active at ``wrap`` time, whatever
+    thread it ends up on."""
+    ctx = _CTX.get()
+    if ctx is None:
+        return fn
+
+    def bound(*args, **kwargs):
+        return run_with(ctx, fn, *args, **kwargs)
+
+    return bound
+
+
+# -- internal: span() integration (not part of the public handoff API) ------
+
+def _set(ctx: TraceContext):
+    """Raw set returning the reset token (spans push/pop their child
+    context with this instead of paying a generator frame per span)."""
+    return _CTX.set(ctx)
+
+
+def _reset(token) -> None:
+    _CTX.reset(token)
+
+
+# ---------------------------------------------------------------------------
+# Host lane id (multihost trace merging)
+# ---------------------------------------------------------------------------
+
+_HOST: Optional[int] = None
+
+
+def set_host(host: int) -> None:
+    """Pin this process's host lane id (``parallel.multihost`` calls
+    this with ``jax.process_index()`` after distributed bring-up)."""
+    global _HOST
+    _HOST = int(host)
+
+
+def host_id() -> int:
+    """This process's host lane id, resolved once: ``SRJ_TPU_HOST`` env
+    -> pinned :func:`set_host` value -> ``jax.process_index()`` ->
+    0."""
+    global _HOST
+    if _HOST is not None:
+        return _HOST
+    env = os.environ.get("SRJ_TPU_HOST")
+    if env:
+        try:
+            _HOST = int(env)
+            return _HOST
+        except ValueError:
+            pass
+    try:
+        import jax
+        _HOST = int(jax.process_index())
+    except Exception:
+        _HOST = 0
+    return _HOST

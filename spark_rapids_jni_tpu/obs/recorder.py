@@ -1,0 +1,530 @@
+"""Failure flight recorder: capture *which program* failed, not just that
+one did.
+
+BENCH_r05's blocker — ``from_rows``/``query_grouped`` dying with an opaque
+``TPU backend error`` — is unexplainable from span events alone: by the
+time the error surfaces, the lowered program, the bucket it was compiled
+for, and the request that asked for it are all gone.  This module keeps
+them.  Dispatch sites call :func:`register_program` with the jitted
+callable and the abstract shapes it was invoked with (cheap: a dict write
+and K ``ShapeDtypeStruct``s — no lowering happens unless something
+fails).  When a span finishes with ``status="error"``
+(:func:`on_error`, hooked from ``spans._finish``) or a :class:`Watchdog`
+deadline expires mid-tick, the recorder dumps a **bundle** directory
+under ``SRJ_TPU_DIAG_DIR``:
+
+    bundle-error-000-12345/
+      MANIFEST.json   what, when, why, which files
+      events.json     last-K ring events (the flight data)
+      repro.json      minimal repro descriptor: op, sig, bucket, shapes,
+                      error, trace_id + linked request trace ids/tenants
+      program-*.txt   the failing program's StableHLO, lowered on demand
+                      via jax.jit(...).lower(avals) keyed by (op, sig,
+                      bucket)
+      memory.json     PJRT allocator stats at failure time
+      env.json        SRJ_TPU_* knobs, jax version, device inventory
+
+``python -m spark_rapids_jni_tpu.obs --bundle <dir>`` pretty-prints one.
+
+Armed by ``SRJ_TPU_DIAG_DIR=<dir>`` (or :func:`arm`); disarmed it is
+free — ``on_error`` is one attribute check, ``register_program`` a no-op.
+Bundles are deduped per (span name, error type) and capped at
+``SRJ_TPU_DIAG_MAX`` per process so a hot failing loop cannot fill a
+disk.  Like the rest of obs, nothing here ever raises into the operation
+it observes.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "arm", "disarm", "armed", "diag_dir", "register_program", "on_error",
+    "dump_bundle", "last_bundle", "format_bundle", "Watchdog", "reset",
+]
+
+_DEF_MAX_BUNDLES = 8
+_DEF_EVENTS = 256
+_MAX_PROGRAMS = 64          # registry cap (LRU): newest dispatches win
+_MAX_DUMP_PROGRAMS = 4      # fallback when no exact (op, sig, bucket) match
+
+
+class _Rec:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.dir: Optional[str] = os.environ.get("SRJ_TPU_DIAG_DIR") or None
+        self.seq = 0
+        self.seen: set = set()      # (name, error_type) dedupe
+        self.last: Optional[str] = None
+        # one exception unwinds through every enclosing span; its first
+        # error span dumps the bundle, later ones only augment it.  Held
+        # as a weakref: a raw id() would collide when the allocator hands
+        # a later, unrelated exception the dead one's address
+        self.last_err_ref = None
+        self.last_err_path: Optional[str] = None
+        # (op, sig_str, bucket) -> (callable, avals) — lowering deferred
+        self.programs: "collections.OrderedDict[Tuple, Tuple]" = \
+            collections.OrderedDict()
+
+
+_R = _Rec()
+
+
+def arm(path: str) -> None:
+    """Point the recorder at ``path`` (created on first bundle)."""
+    with _R.lock:
+        _R.dir = path
+
+
+def disarm() -> None:
+    with _R.lock:
+        _R.dir = None
+
+
+def armed() -> bool:
+    return _R.dir is not None
+
+
+def diag_dir() -> Optional[str]:
+    return _R.dir
+
+
+def reset(programs: bool = False) -> None:
+    """Forget dedupe/sequence state (tests); optionally the program
+    registry too."""
+    with _R.lock:
+        _R.seq = 0
+        _R.seen.clear()
+        _R.last = None
+        _R.last_err_ref = None
+        _R.last_err_path = None
+        if programs:
+            _R.programs.clear()
+
+
+def last_bundle() -> Optional[str]:
+    """Path of the most recent bundle this process wrote, if any."""
+    return _R.last
+
+
+# ---------------------------------------------------------------------------
+# Program registry
+# ---------------------------------------------------------------------------
+
+def register_program(op: str, sig: Any, bucket: Any, fn, args=()) -> None:
+    """Remember how to reproduce the program a dispatch is about to run:
+    ``fn`` (jitted or plain callable) plus the abstract shapes of
+    ``args``.  Costs one dict write; the StableHLO text is only lowered
+    if this (op, sig, bucket) later shows up in a failure bundle."""
+    if _R.dir is None:
+        return
+    try:
+        import jax
+        avals = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args
+                      if hasattr(a, "shape") and hasattr(a, "dtype"))
+        key = (str(op), str(sig), str(bucket))
+        with _R.lock:
+            _R.programs.pop(key, None)
+            _R.programs[key] = (fn, avals)
+            while len(_R.programs) > _MAX_PROGRAMS:
+                _R.programs.popitem(last=False)
+    except Exception:
+        pass
+
+
+def _lower_text(fn, avals) -> str:
+    """StableHLO/lowered text for ``fn(*avals)`` — jit-wraps plain
+    callables; never raises."""
+    import jax
+    try:
+        lowered = fn.lower(*avals)
+    except AttributeError:
+        lowered = jax.jit(fn).lower(*avals)
+    try:
+        # location metadata carries the srj::op[b<N>] named scopes — the
+        # alignment between bundle key and HLO op-metadata is the point
+        return lowered.compiler_ir(dialect="stablehlo") \
+            .operation.get_asm(enable_debug_info=True)
+    except Exception:
+        pass
+    try:
+        return lowered.as_text()
+    except Exception:
+        return str(lowered)
+
+
+def _matching_programs(ev: Dict) -> List[Tuple[Tuple, Tuple]]:
+    """Programs relevant to a failure event: exact (op, sig, bucket) key
+    from the event attrs when present, else the newest few."""
+    with _R.lock:
+        items = list(_R.programs.items())
+    if not items:
+        return []
+    op = ev.get("op")
+    sig = ev.get("sig")
+    bucket = ev.get("slots", ev.get("bucket"))
+    if op is not None:
+        key = (str(op), str(sig), str(bucket))
+        exact = [(k, v) for k, v in items if k == key]
+        if exact:
+            return exact
+        exact = [(k, v) for k, v in items if k[0] == str(op)]
+        if exact:
+            return exact[-_MAX_DUMP_PROGRAMS:]
+    return items[-_MAX_DUMP_PROGRAMS:]
+
+
+# ---------------------------------------------------------------------------
+# Bundle dump
+# ---------------------------------------------------------------------------
+
+def _env_snapshot() -> Dict:
+    env = {k: v for k, v in sorted(os.environ.items())
+           if k.startswith(("SRJ_TPU_", "SRJ_", "JAX_", "XLA_FLAGS"))}
+    snap: Dict[str, Any] = {"env": env}
+    try:
+        import jax
+        snap["jax_version"] = jax.__version__
+        snap["backend"] = jax.default_backend()
+        snap["devices"] = [str(d) for d in jax.devices()]
+    except Exception:
+        pass
+    try:
+        from spark_rapids_jni_tpu.runtime import shapes
+        snap["bucket_factor"] = shapes.factor()
+    except Exception:
+        pass
+    return snap
+
+
+def _mem_snapshot() -> Dict:
+    try:
+        from spark_rapids_jni_tpu.memory import device_memory_stats
+        return device_memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def _repro(ev: Dict, program_keys: List[Tuple]) -> Dict:
+    keep = ("name", "status", "op", "sig", "slots", "bucket", "rows",
+            "requests", "tenant", "tenants", "error_type", "error",
+            "device_dead", "trace_id", "span_id", "parent_span_id",
+            "links", "link_trace_ids", "host", "thread", "deadline_ms")
+    r = {k: ev[k] for k in keep if k in ev}
+    r["programs"] = [list(k) for k in program_keys]
+    return r
+
+
+def dump_bundle(reason: str, ev: Dict) -> Optional[str]:
+    """Write one flight-recorder bundle for ``ev`` (an obs event dict).
+    Returns the bundle path, or None (disarmed, deduped, capped, or any
+    write failure)."""
+    base = _R.dir
+    if base is None:
+        return None
+    try:
+        max_bundles = int(os.environ.get("SRJ_TPU_DIAG_MAX",
+                                         str(_DEF_MAX_BUNDLES)))
+        with _R.lock:
+            key = (reason, ev.get("name"), ev.get("error_type"))
+            if key in _R.seen:
+                return None
+            if _R.seq >= max_bundles:
+                return None
+            _R.seen.add(key)
+            seq = _R.seq
+            _R.seq += 1
+        path = os.path.join(
+            base, f"bundle-{reason}-{seq:03d}-{os.getpid()}")
+        os.makedirs(path, exist_ok=True)
+
+        files: List[str] = []
+
+        def _write(fname: str, payload) -> None:
+            with open(os.path.join(path, fname), "w") as f:
+                if isinstance(payload, str):
+                    f.write(payload)
+                else:
+                    json.dump(payload, f, indent=2, default=str)
+            files.append(fname)
+
+        # flight data: the last-K ring events (the failing event is the
+        # most recent of them — spans emit before hooking the recorder)
+        from spark_rapids_jni_tpu.obs import spans as _spans
+        k = int(os.environ.get("SRJ_TPU_DIAG_EVENTS", str(_DEF_EVENTS)))
+        _write("events.json", _spans.events()[-k:])
+
+        progs = _matching_programs(ev)
+        for i, (pkey, (fn, avals)) in enumerate(progs):
+            op, sig, bucket = pkey
+            _write(f"program-{i:02d}-{_slug(op)}.txt",
+                   f"# op={op} sig={sig} bucket={bucket}\n"
+                   f"# avals={[str(a) for a in avals]}\n"
+                   + _lower_text(fn, avals))
+
+        _write("repro.json", _repro(ev, [k for k, _ in progs]))
+        _write("memory.json", _mem_snapshot())
+        _write("env.json", _env_snapshot())
+        _write("MANIFEST.json", {
+            "reason": reason, "ts": time.time(),
+            "event": {k: v for k, v in ev.items() if k != "mem"},
+            "files": files + ["MANIFEST.json"],
+            "pid": os.getpid(), "seq": seq,
+        })
+        _R.last = path
+        return path
+    except Exception:
+        return None
+
+
+def _slug(s: str) -> str:
+    return "".join(c if c.isalnum() or c in "._-" else "_" for c in s)[:48]
+
+
+def _augment(path: str, ev: Dict) -> Optional[str]:
+    """Fold a later span of the SAME unwinding exception into an already
+    dumped bundle.  The inner failing span dumps first but the outer
+    spans carry the batch-level attrs that make the bundle attributable
+    (the serve group span's op/sig/slots/links/tenants), so the repro
+    descriptor, event snapshot, and program set are refreshed with the
+    outer event rather than dumping a second bundle per failure."""
+    try:
+        mpath = os.path.join(path, "MANIFEST.json")
+        with open(mpath) as f:
+            man = json.load(f)
+        inner = man.get("event", {})
+        merged = dict(ev)
+        merged["inner_spans"] = (inner.get("inner_spans") or []) \
+            + [inner.get("name")]
+        files = list(man.get("files", []))
+
+        from spark_rapids_jni_tpu.obs import spans as _spans
+        k = int(os.environ.get("SRJ_TPU_DIAG_EVENTS", str(_DEF_EVENTS)))
+        with open(os.path.join(path, "events.json"), "w") as f:
+            json.dump(_spans.events()[-k:], f, indent=2, default=str)
+
+        progs = _matching_programs(merged)
+        have = {fname for fname in files if fname.startswith("program-")}
+        idx = len(have)
+        for pkey, (fn, avals) in progs:
+            op, sig, bucket = pkey
+            fname = f"program-{idx:02d}-{_slug(op)}.txt"
+            header = f"# op={op} sig={sig} bucket={bucket}\n"
+            if any(header in _read_head(os.path.join(path, h))
+                   for h in have):
+                continue
+            with open(os.path.join(path, fname), "w") as f:
+                f.write(header
+                        + f"# avals={[str(a) for a in avals]}\n"
+                        + _lower_text(fn, avals))
+            files.append(fname)
+            idx += 1
+
+        with open(os.path.join(path, "repro.json"), "w") as f:
+            json.dump(_repro(merged, [pk for pk, _ in progs]), f,
+                      indent=2, default=str)
+        man["event"] = {kk: vv for kk, vv in merged.items() if kk != "mem"}
+        man["files"] = files
+        with open(mpath, "w") as f:
+            json.dump(man, f, indent=2, default=str)
+        return path
+    except Exception:
+        return path
+
+
+def _read_head(path: str) -> str:
+    try:
+        with open(path) as f:
+            return f.readline()
+    except Exception:
+        return ""
+
+
+def on_error(ev: Dict, err: Optional[BaseException] = None
+             ) -> Optional[str]:
+    """Span-failure hook (called by ``spans._finish`` after the error
+    event is emitted, so it is already in the ring).  One attribute check
+    when disarmed.  An exception unwinding through nested spans reaches
+    here once per span; only the first dumps a bundle — the rest augment
+    it with their (outer, batch-level) attributes."""
+    if _R.dir is None:
+        return None
+    with _R.lock:
+        same_unwind = (err is not None and _R.last_err_ref is not None
+                       and _R.last_err_ref() is err)
+        prior = _R.last_err_path
+    if same_unwind:
+        return _augment(prior, ev) if prior else None
+    path = dump_bundle("error", ev)
+    if err is not None:
+        with _R.lock:
+            try:
+                _R.last_err_ref = weakref.ref(err)
+            except TypeError:       # weakref-less exception subclass
+                _R.last_err_ref = None
+            _R.last_err_path = path
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Stall watchdog
+# ---------------------------------------------------------------------------
+
+class Watchdog:
+    """Deadline watchdog for scheduler ticks / fenced dispatches.
+
+    ``with wd.guard(op=...):`` arms a one-shot timer; if the block is
+    still running when ``deadline_ms`` elapses, the watchdog emits a
+    ``kind="watchdog"`` event and dumps a ``stall`` bundle — ONCE, until
+    :meth:`reset` (a stalled tick loop re-enters guard every tick; one
+    bundle per stall episode is signal, a bundle per tick is noise).
+
+    Deadline comes from ``SRJ_TPU_WATCHDOG_MS`` when not given; unset or
+    ``<=0`` disables the watchdog entirely (guard is a no-op yield)."""
+
+    def __init__(self, name: str = "watchdog",
+                 deadline_ms: Optional[float] = None):
+        if deadline_ms is None:
+            try:
+                deadline_ms = float(os.environ.get("SRJ_TPU_WATCHDOG_MS", "0"))
+            except ValueError:
+                deadline_ms = 0.0
+        self.name = name
+        self.deadline_ms = float(deadline_ms)
+        self.enabled = self.deadline_ms > 0
+        self._lock = threading.Lock()
+        self._fired = False
+
+    @property
+    def fired(self) -> bool:
+        return self._fired
+
+    def reset(self) -> None:
+        """Re-arm after a stall episode (next overrun fires again)."""
+        with self._lock:
+            self._fired = False
+
+    @contextlib.contextmanager
+    def guard(self, **attrs):
+        if not self.enabled:
+            yield
+            return
+        timer = threading.Timer(self.deadline_ms / 1e3, self._fire, (attrs,))
+        timer.daemon = True
+        timer.start()
+        try:
+            yield
+        finally:
+            timer.cancel()
+
+    def _fire(self, attrs: Dict) -> None:
+        with self._lock:
+            if self._fired:
+                return
+            self._fired = True
+        try:
+            ev = {"kind": "watchdog", "name": self.name, "status": "stall",
+                  "deadline_ms": self.deadline_ms,
+                  "thread": threading.current_thread().name}
+            ev.update(attrs)
+            from spark_rapids_jni_tpu.obs import spans as _spans
+            _spans.emit(ev)
+            try:
+                from spark_rapids_jni_tpu.obs import metrics as _m
+                _m.counter("srj_tpu_watchdog_stalls_total",
+                           "Watchdog deadline overruns.",
+                           ("name",)).inc(name=self.name)
+            except Exception:
+                pass
+            dump_bundle("stall", ev)
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Bundle rendering (the --bundle CLI path)
+# ---------------------------------------------------------------------------
+
+def format_bundle(path: str) -> str:
+    """Human-readable rendering of one bundle directory."""
+    lines: List[str] = []
+
+    def _load(fname):
+        try:
+            with open(os.path.join(path, fname)) as f:
+                return json.load(f)
+        except Exception:
+            return None
+
+    man = _load("MANIFEST.json")
+    if man is None:
+        return f"not a flight-recorder bundle (no MANIFEST.json): {path}"
+    ev = man.get("event", {})
+    lines.append(f"flight-recorder bundle: {path}")
+    lines.append(f"  reason      : {man.get('reason')}")
+    when = man.get("ts")
+    if isinstance(when, (int, float)):
+        lines.append("  captured    : "
+                     + time.strftime("%Y-%m-%d %H:%M:%S",
+                                     time.localtime(when)))
+    lines.append(f"  span        : {ev.get('name')}  "
+                 f"status={ev.get('status')}")
+    if ev.get("error_type"):
+        lines.append(f"  error       : {ev.get('error_type')}: "
+                     f"{ev.get('error')}")
+    if ev.get("deadline_ms"):
+        lines.append(f"  deadline    : {ev.get('deadline_ms')} ms")
+    repro = _load("repro.json") or {}
+    for k in ("op", "sig", "slots", "bucket", "rows", "requests"):
+        if repro.get(k) is not None:
+            lines.append(f"  {k:<12}: {repro[k]}")
+    if repro.get("trace_id"):
+        lines.append(f"  trace_id    : {repro['trace_id']}")
+    if repro.get("tenants"):
+        lines.append(f"  tenants     : {', '.join(map(str, repro['tenants']))}")
+    if repro.get("link_trace_ids"):
+        lines.append("  linked reqs : "
+                     + ", ".join(map(str, repro["link_trace_ids"])))
+    evs = _load("events.json")
+    if isinstance(evs, list):
+        lines.append(f"  ring events : {len(evs)} (events.json)")
+        errs = [e for e in evs if isinstance(e, dict)
+                and e.get("status") == "error"]
+        for e in errs[-3:]:
+            lines.append(f"    - {e.get('name')}: {e.get('error_type')}: "
+                         f"{str(e.get('error'))[:80]}")
+    mem = _load("memory.json")
+    if mem:
+        biu = mem.get("bytes_in_use")
+        peak = mem.get("peak_bytes_in_use")
+        if biu is not None:
+            lines.append(f"  device mem  : {biu} in use"
+                         + (f", {peak} peak" if peak is not None else ""))
+    envd = _load("env.json") or {}
+    if envd.get("jax_version"):
+        lines.append(f"  jax         : {envd['jax_version']} "
+                     f"({envd.get('backend')}, "
+                     f"{len(envd.get('devices', []))} devices)")
+    progs = sorted(f for f in (man.get("files") or [])
+                   if f.startswith("program-"))
+    if progs:
+        lines.append(f"  programs    : {len(progs)}")
+        for p in progs:
+            head = ""
+            try:
+                with open(os.path.join(path, p)) as f:
+                    head = f.readline().strip().lstrip("# ")
+            except Exception:
+                pass
+            lines.append(f"    - {p}  {head}")
+    else:
+        lines.append("  programs    : none captured "
+                     "(dispatch predates arming?)")
+    return "\n".join(lines)

@@ -5,7 +5,10 @@ name: calls, failures, wall p50/p95, total device time, rows/bytes volume,
 compile count and compile-seconds — the at-a-glance answer to "which op is
 slow, which op recompiles, which op fails".  ``--prom`` emits the same
 aggregates as a Prometheus text exposition (one scrape away from a real
-dashboard); ``--json`` dumps the raw summary dict.
+dashboard); ``--json`` dumps the raw summary dict.  ``--merge`` combines
+several per-host JSONL logs (a multihost run) into one stream before
+reporting/tracing; ``--bundle <dir>`` pretty-prints a failure
+flight-recorder bundle instead of reading a log.
 
 Pure stdlib on purpose: the report must load a log from a process that
 died (the whole point of failure capture), so it depends on nothing that
@@ -235,7 +238,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="python -m spark_rapids_jni_tpu.obs",
         description="Summarize a span/event JSONL log "
                     "(written under SRJ_TPU_EVENTS=<path>).")
-    ap.add_argument("path", help="events JSONL file")
+    ap.add_argument("path", nargs="?", help="events JSONL file")
     ap.add_argument("--prom", action="store_true",
                     help="Prometheus text exposition instead of the table")
     ap.add_argument("--json", action="store_true",
@@ -243,9 +246,34 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--trace", metavar="OUT",
                     help="write a Chrome/Perfetto trace_event JSON to OUT "
                          "(open at https://ui.perfetto.dev)")
+    ap.add_argument("--merge", metavar="LOG", nargs="+",
+                    help="merge several per-host JSONL logs (a multihost "
+                         "run's host_trace_sink files) into one stream; "
+                         "events lacking a host stamp get the file's index "
+                         "so each log lands in its own trace lane")
+    ap.add_argument("--bundle", metavar="DIR",
+                    help="pretty-print a failure flight-recorder bundle "
+                         "directory (written under SRJ_TPU_DIAG_DIR)")
     args = ap.parse_args(argv)
+    if args.bundle:
+        from spark_rapids_jni_tpu.obs import recorder
+        out = recorder.format_bundle(args.bundle)
+        print(out)
+        return 2 if out.startswith("not a flight-recorder bundle") else 0
+    if not args.path and not args.merge:
+        ap.error("an events JSONL path (or --merge/--bundle) is required")
     try:
-        events = list(load_events(args.path))
+        if args.merge:
+            events = []
+            for i, path in enumerate(args.merge):
+                for ev in load_events(path):
+                    ev.setdefault("host", i)
+                    events.append(ev)
+            if args.path:
+                ap.error("give logs either positionally or via --merge, "
+                         "not both")
+        else:
+            events = list(load_events(args.path))
     except OSError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2

@@ -44,6 +44,7 @@ from typing import Dict, List, Optional
 import jax
 
 from spark_rapids_jni_tpu.utils import metrics as _metrics
+from spark_rapids_jni_tpu.obs import context as _context
 from spark_rapids_jni_tpu.obs.metrics import observe_event as _observe_event
 
 _RING_CAP = int(os.environ.get("SRJ_TPU_OBS_RING", "4096"))
@@ -192,6 +193,9 @@ def emit(event: Dict) -> None:
         return
     ev = dict(event)
     ev.setdefault("ts", time.time())
+    # host lane id: lets per-host JSONL logs from a multihost run merge
+    # into one trace (report --merge) with one process lane per host
+    ev.setdefault("host", _context.host_id())
     try:
         with _STATE.lock:
             if len(_STATE.ring) == _STATE.ring.maxlen:
@@ -270,7 +274,8 @@ class Span:
     device-complete and stamps the device time."""
 
     __slots__ = ("name", "attrs", "depth", "parent", "t0", "_fence_t",
-                 "compiles", "compile_s", "_mem0")
+                 "compiles", "compile_s", "_mem0", "span_id", "trace_id",
+                 "parent_span_id", "tenant")
 
     def __init__(self, name: str, attrs: Dict, depth: int,
                  parent: Optional[str]):
@@ -283,6 +288,10 @@ class Span:
         self.compiles = 0
         self.compile_s = 0.0
         self._mem0 = None
+        self.span_id = None
+        self.trace_id = None
+        self.parent_span_id = None
+        self.tenant = None
 
     def set(self, **attrs) -> None:
         self.attrs.update(attrs)
@@ -327,6 +336,18 @@ def span(name: str, **attrs):
     sp = Span(name, attrs, depth=len(stack),
               parent=stack[-1].name if stack else None)
     sp._mem0 = _mem_snapshot()
+    # request-scoped causality: under an active TraceContext the span
+    # joins that request's trace and becomes the parent of whatever its
+    # body starts — including work handed to other threads via
+    # context.capture()/run_with()
+    ctx = _context.current()
+    ctx_token = None
+    if ctx is not None:
+        sp.span_id = _context.new_id()
+        sp.trace_id = ctx.trace_id
+        sp.parent_span_id = ctx.span_id
+        sp.tenant = ctx.tenant
+        ctx_token = _context._set(ctx.child(sp.span_id))
     stack.append(sp)
     sp.t0 = time.perf_counter()
     try:
@@ -338,6 +359,8 @@ def span(name: str, **attrs):
         _finish(sp, "ok")
     finally:
         stack.pop()
+        if ctx_token is not None:
+            _context._reset(ctx_token)
 
 
 def _finish(sp: Span, status: str, err: Optional[BaseException] = None
@@ -362,11 +385,27 @@ def _finish(sp: Span, status: str, err: Optional[BaseException] = None
             mem["delta_bytes"] = (mem1.get("bytes_in_use", 0)
                                   - sp._mem0.get("bytes_in_use", 0))
         ev["mem"] = mem
+    if sp.trace_id is not None:
+        ev["trace_id"] = sp.trace_id
+        ev["span_id"] = sp.span_id
+        ev["parent_span_id"] = sp.parent_span_id
+        if sp.tenant is not None:
+            ev.setdefault("tenant", sp.tenant)
     if err is not None:
         ev["error_type"] = type(err).__name__
         ev["error"] = str(err)[:300]
         ev["device_dead"] = _device_dead()
     emit(ev)
+    if err is not None:
+        # flight recorder: errors are rare, so the import + armed check
+        # live entirely on this branch (after emit — the error event must
+        # already be in the ring the bundle snapshots)
+        try:
+            from spark_rapids_jni_tpu.obs import recorder as _recorder
+            if _recorder.armed():
+                _recorder.on_error(ev, err)
+        except Exception:
+            pass
 
 
 def span_fn(name: Optional[str] = None, attrs=None, fence: bool = True):

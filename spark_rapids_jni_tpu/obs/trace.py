@@ -22,6 +22,15 @@ well-nested.  Spans with children emit ``B``/``E`` duration pairs, leaf
 spans emit single ``X`` complete events, counters emit ``C`` samples, and
 thread/process names ride ``M`` metadata records — the four phases a
 trace viewer needs, all well-formed by construction.
+
+Causality.  A span carrying ``links`` (a list of span_ids — the serve
+scheduler's coalesced batch span links every tenant request it served)
+additionally emits Perfetto *flow* events: an ``s`` record bound to each
+linked request slice and a matching ``f`` (``bp="e"``) on the batch
+slice, which the viewer draws as request→batch arrows.  Events stamped
+with a ``host`` lane id (every event is, since the trace-context work)
+are partitioned into one *process* lane per host, so per-host JSONL logs
+merged by ``report --merge`` render as a single multi-lane trace.
 """
 
 from __future__ import annotations
@@ -55,7 +64,7 @@ class _Node:
 # span attributes that are either structural (reconstructed) or huge;
 # everything else (rows, bytes, bucket, error, ...) rides into args
 _SKIP_ATTRS = {"kind", "name", "status", "wall_s", "ts", "depth", "parent",
-               "thread"}
+               "thread", "host"}
 
 
 def _span_args(ev: Dict) -> Dict:
@@ -65,6 +74,10 @@ def _span_args(ev: Dict) -> Dict:
             continue
         if isinstance(v, (str, int, float, bool)) or v is None:
             args[k] = v
+        elif isinstance(v, (list, tuple)) and all(
+                isinstance(x, (str, int, float, bool)) or x is None
+                for x in v):
+            args[k] = list(v)  # links / tenants / link_trace_ids
         else:
             args[k] = str(v)
     if ev.get("status") == "error":
@@ -110,82 +123,140 @@ def _build_thread_trees(events: Iterable[Dict]) -> Dict[str, List[_Node]]:
 
 
 def _emit_span(node: _Node, out: List[Dict], pid: int, tid: int,
-               scale: float, t0: float) -> None:
+               scale: float, t0: float, span_index=None,
+               linkers=None) -> None:
     ts = (node.start - t0) * scale
     dur = (node.end - node.start) * scale
     if node.children:
         out.append({"ph": "B", "name": node.name, "pid": pid, "tid": tid,
                     "ts": ts, "args": node.args})
         for c in node.children:
-            _emit_span(c, out, pid, tid, scale, t0)
+            _emit_span(c, out, pid, tid, scale, t0, span_index, linkers)
         out.append({"ph": "E", "name": node.name, "pid": pid, "tid": tid,
                     "ts": ts + dur})
     else:
         out.append({"ph": "X", "name": node.name, "pid": pid, "tid": tid,
                     "ts": ts, "dur": dur, "args": node.args})
+    # index for flow arrows: where each span_id's slice begins, and which
+    # slices declared links to other spans
+    if span_index is not None:
+        sid = node.args.get("span_id")
+        if sid:
+            span_index[str(sid)] = (pid, tid, ts)
+        links = node.args.get("links")
+        if linkers is not None and isinstance(links, list) and links:
+            out_links = [str(s) for s in links if s]
+            if out_links:
+                linkers.append((out_links, pid, tid, ts))
+
+
+def _host_of(ev: Dict) -> int:
+    h = ev.get("host", 0)
+    try:
+        return int(h)
+    except (TypeError, ValueError):
+        return 0
 
 
 def trace_events(events: Iterable[Dict], pid: int = 0) -> Dict:
     """Convert an obs event stream (JSONL records or the live ring) to a
     Chrome ``trace_event`` document: ``{"traceEvents": [...],
     "displayTimeUnit": "ms"}``, timestamps in microseconds relative to
-    the earliest span/counter sample."""
+    the earliest span/counter sample.  Events from multiple ``host``
+    lanes (a merged multihost log) land in one process lane per host."""
     events = [e for e in events if isinstance(e, dict)]
-    roots = _build_thread_trees(events)
+    by_host: Dict[int, List[Dict]] = {}
+    for e in events:
+        by_host.setdefault(_host_of(e), []).append(e)
+    hosts = sorted(by_host) or [0]
+    multi = len(hosts) > 1
+    # single host keeps the historical lane (pid arg, bare process name);
+    # a merged log gets one pid per host id
+    host_pid = {h: (h if multi else pid) for h in hosts}
+    trees = {h: _build_thread_trees(by_host[h]) for h in hosts}
 
-    # time origin: earliest span start or counter sample, so ts stays
-    # small and positive for the viewer
-    starts = [n.start for nodes in roots.values() for n in nodes]
+    # time origin: earliest span start or counter sample across every
+    # host, so merged lanes stay on one clock and ts stays positive
+    starts = [n.start for roots in trees.values()
+              for nodes in roots.values() for n in nodes]
     starts += [e["ts"] for e in events
                if e.get("kind") in ("compile", "fault")
                and isinstance(e.get("ts"), (int, float))]
     t0 = min(starts) if starts else 0.0
     scale = 1e6  # seconds -> microseconds
 
-    out: List[Dict] = [{
-        "ph": "M", "name": "process_name", "pid": pid,
-        "args": {"name": "spark_rapids_jni_tpu"}}]
+    out: List[Dict] = []
+    span_index: Dict[str, tuple] = {}
+    linkers: List[tuple] = []
+    for h in hosts:
+        hpid = host_pid[h]
+        pname = ("spark_rapids_jni_tpu" if not multi
+                 else f"spark_rapids_jni_tpu host{h}")
+        out.append({"ph": "M", "name": "process_name", "pid": hpid,
+                    "args": {"name": pname}})
 
-    # stable lanes: MainThread first, then first-appearance order (the
-    # staging prefetch worker lands in its own lane by thread name)
-    names = sorted(roots, key=lambda n: (n != "MainThread",))
-    tids = {}
-    for name in names:
-        tid = tids[name] = len(tids)
-        out.append({"ph": "M", "name": "thread_name", "pid": pid,
-                    "tid": tid, "args": {"name": name}})
-    for name in names:
-        for node in roots[name]:
-            _emit_span(node, out, pid, tids[name], scale, t0)
+        # stable lanes: MainThread first, then first-appearance order
+        # (the staging prefetch worker lands in its own lane by name)
+        roots = trees[h]
+        names = sorted(roots, key=lambda n: (n != "MainThread",))
+        tids = {}
+        for name in names:
+            tid = tids[name] = len(tids)
+            out.append({"ph": "M", "name": "thread_name", "pid": hpid,
+                        "tid": tid, "args": {"name": name}})
+        for name in names:
+            for node in roots[name]:
+                _emit_span(node, out, hpid, tids[name], scale, t0,
+                           span_index, linkers)
 
-    # counter tracks: cumulative XLA compiles/compile-seconds and
-    # host<->device transfer bytes over time
-    compiles = 0
-    compile_s = 0.0
-    h2d = d2h = 0
-    for ev in events:
-        ts = ev.get("ts")
-        if not isinstance(ts, (int, float)):
-            continue
-        if ev.get("kind") == "compile":
-            compiles += 1
-            if isinstance(ev.get("duration_s"), (int, float)):
-                compile_s += float(ev["duration_s"])
-            out.append({"ph": "C", "name": "xla_compiles", "pid": pid,
-                        "ts": (ts - t0) * scale,
-                        "args": {"count": compiles,
-                                 "seconds": round(compile_s, 6)}})
-        elif ev.get("kind") == "span" and (
-                isinstance(ev.get("h2d_bytes"), (int, float))
-                or isinstance(ev.get("d2h_bytes"), (int, float))):
-            h2d += int(ev.get("h2d_bytes") or 0)
-            d2h += int(ev.get("d2h_bytes") or 0)
-            out.append({"ph": "C", "name": "transfer_bytes", "pid": pid,
-                        "ts": (ts - t0) * scale,
-                        "args": {"h2d": h2d, "d2h": d2h}})
+        # counter tracks: cumulative XLA compiles/compile-seconds and
+        # host<->device transfer bytes over time, per host lane
+        compiles = 0
+        compile_s = 0.0
+        h2d = d2h = 0
+        for ev in by_host[h]:
+            ts = ev.get("ts")
+            if not isinstance(ts, (int, float)):
+                continue
+            if ev.get("kind") == "compile":
+                compiles += 1
+                if isinstance(ev.get("duration_s"), (int, float)):
+                    compile_s += float(ev["duration_s"])
+                out.append({"ph": "C", "name": "xla_compiles", "pid": hpid,
+                            "ts": (ts - t0) * scale,
+                            "args": {"count": compiles,
+                                     "seconds": round(compile_s, 6)}})
+            elif ev.get("kind") == "span" and (
+                    isinstance(ev.get("h2d_bytes"), (int, float))
+                    or isinstance(ev.get("d2h_bytes"), (int, float))):
+                h2d += int(ev.get("h2d_bytes") or 0)
+                d2h += int(ev.get("d2h_bytes") or 0)
+                out.append({"ph": "C", "name": "transfer_bytes",
+                            "pid": hpid, "ts": (ts - t0) * scale,
+                            "args": {"h2d": h2d, "d2h": d2h}})
+
+    # flow arrows: for every span that linked others (the coalesced batch
+    # span's ``links`` -> its request span_ids), draw request -> batch.
+    # ``s`` binds to the request slice at its start, ``f`` (bp="e") to
+    # the linking slice; clamping f >= s keeps the arrow well-formed even
+    # if clock skew put the batch start before the request start.
+    fid = 0
+    for links, bpid, btid, bts, in linkers:
+        for sid in links:
+            src = span_index.get(sid)
+            if src is None:
+                continue  # request span outside this log (ring eviction)
+            spid, stid, sts = src
+            fid += 1
+            out.append({"ph": "s", "cat": "srj.flow", "name": "request",
+                        "id": fid, "pid": spid, "tid": stid, "ts": sts})
+            out.append({"ph": "f", "bp": "e", "cat": "srj.flow",
+                        "name": "request", "id": fid, "pid": bpid,
+                        "tid": btid, "ts": max(bts, sts)})
 
     # non-metadata events sorted by time; python's stable sort keeps the
-    # tree-walk order (B before children before E) across equal stamps
+    # tree-walk order (B before children before E) across equal stamps,
+    # and each flow ``s`` before its ``f`` on ties
     meta = [e for e in out if e["ph"] == "M"]
     rest = sorted((e for e in out if e["ph"] != "M"),
                   key=lambda e: e["ts"])

@@ -38,6 +38,7 @@ from spark_rapids_jni_tpu.table import (
 )
 from spark_rapids_jni_tpu.obs import span_fn
 from spark_rapids_jni_tpu.runtime import shapes
+from spark_rapids_jni_tpu.utils import tracing
 
 
 def _hash_attrs(table_or_cols, *args, **kwargs):
@@ -376,7 +377,8 @@ def murmur3_hash(table_or_cols, seed: int = DEFAULT_SEED,
     with shapes.pad_span():
         pcols = tuple(shapes.pad_column(c, b, width=Wb or None)
                       for c in cols)
-    out = _murmur3_jit(pcols, int(seed), Wb)
+    with tracing.op_scope("murmur3_hash", b):
+        out = _murmur3_jit(pcols, int(seed), Wb)
     with shapes.unpad_span():
         return shapes.unpad_array(out, n)
 
@@ -641,6 +643,7 @@ def xxhash64(table_or_cols, seed: int = DEFAULT_SEED,
     with shapes.pad_span():
         pcols = tuple(shapes.pad_column(c, b, width=Wb or None)
                       for c in cols)
-    out = _xx64_jit(pcols, int(seed), Wb)
+    with tracing.op_scope("xxhash64", b):
+        out = _xx64_jit(pcols, int(seed), Wb)
     with shapes.unpad_span():
         return shapes.unpad_array(out, n)

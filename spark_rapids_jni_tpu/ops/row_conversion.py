@@ -47,6 +47,7 @@ from spark_rapids_jni_tpu.ops.row_layout import (
 )
 from spark_rapids_jni_tpu.utils.tracing import func_range
 from spark_rapids_jni_tpu.utils import metrics
+from spark_rapids_jni_tpu.utils import tracing
 from spark_rapids_jni_tpu.obs import span_fn
 from spark_rapids_jni_tpu.runtime import shapes
 from spark_rapids_jni_tpu.runtime import staging
@@ -485,7 +486,9 @@ def convert_to_rows(table: Table, *, size_limit: int = MAX_BATCH_BYTES,
         with shapes.pad_span():
             padded = shapes.pad_table(table, b)
         try:
-            out = _convert_to_rows_impl(padded, size_limit, use_pallas, impl)
+            with tracing.op_scope("convert_to_rows", b):
+                out = _convert_to_rows_impl(padded, size_limit,
+                                            use_pallas, impl)
         except ValueError:
             # a tight size_limit can hold the exact-shape table but not
             # its bucket-padded twin (plan_fixed_batches' sub-32-row
@@ -610,8 +613,9 @@ def convert_from_rows(rows: RowsColumn, dtypes: Sequence[DType],
         shapes.note(n, b)
         with shapes.pad_span():
             padded = _pad_rows_blob(rows, b, rs)
-        out = _convert_from_rows_impl(padded, dtypes, layout,
-                                      use_pallas, impl)
+        with tracing.op_scope("convert_from_rows", b):
+            out = _convert_from_rows_impl(padded, dtypes, layout,
+                                          use_pallas, impl)
         with shapes.unpad_span():
             return slice_table(out, 0, n)
     return _convert_from_rows_impl(rows, dtypes, layout, use_pallas, impl)

@@ -55,7 +55,46 @@ def init_distributed(coordinator_address: Optional[str] = None,
             coordinator_address=coordinator_address,
             num_processes=num_processes, process_id=process_id)
         _initialized = True
-    return jax.process_index()
+    pid = jax.process_index()
+    # pin this process's obs host lane so every event it emits can be
+    # merged into one cross-host trace (report --merge, per-host lanes)
+    from spark_rapids_jni_tpu.obs import context as _obs_context
+    _obs_context.set_host(pid)
+    return pid
+
+
+def host_trace_sink(base_path: Optional[str] = None,
+                    enable: bool = True) -> Optional[str]:
+    """Point this process's span sink at a per-host JSONL file and stamp
+    its events with the host lane id.
+
+    ``base_path`` (or ``SRJ_TPU_EVENTS``) names the logical log; each
+    process writes ``<root>.host<process_index><ext>`` so N hosts never
+    contend on one file.  After the run::
+
+        python -m spark_rapids_jni_tpu.obs \\
+            --merge events.host0.jsonl events.host1.jsonl ... \\
+            --trace merged.json
+
+    renders ONE Perfetto trace with a process lane per host.  Returns the
+    per-host sink path (None when no base path is configured anywhere).
+    """
+    from spark_rapids_jni_tpu import obs
+    from spark_rapids_jni_tpu.obs import context as _obs_context
+    pid = jax.process_index()
+    _obs_context.set_host(pid)
+    base = base_path or os.environ.get("SRJ_TPU_EVENTS")
+    if not base:
+        if enable:
+            obs.enable()
+        return None
+    root, ext = os.path.splitext(base)
+    path = f"{root}.host{pid}{ext or '.jsonl'}"
+    if enable:
+        obs.enable(path)
+    else:
+        obs.configure_sink(path)
+    return path
 
 
 def global_mesh(axis_name: str = "data") -> Mesh:

@@ -407,14 +407,19 @@ def shard_table_staged(table: Table, mesh, axis_name: str = "data") -> Table:
 def _prefetch_iter(items, stage_fn, depth: int, ex):
     """The prefetch pump over a caller-owned executor (see
     :func:`prefetch` / :class:`Prefetcher` for the two ownership
-    models)."""
+    models).  Each ``stage_fn`` call runs under the trace context active
+    at ITS submission (the explicit ``capture()``/``run_with`` handoff —
+    contextvars do not cross threads on their own), so staging spans on
+    the worker keep the consumer request's trace_id."""
+    from spark_rapids_jni_tpu.obs import context as _obs_context
     qdepth = _obs_metrics.gauge(
         "srj_tpu_prefetch_queue_depth",
         "Batches staged ahead of the consumer by the prefetch worker.")
     try:
         pending = collections.deque()
         for item in items:
-            pending.append(ex.submit(stage_fn, item))
+            pending.append(ex.submit(_obs_context.run_with,
+                                     _obs_context.capture(), stage_fn, item))
             qdepth.set(len(pending))
             while len(pending) > depth:
                 fut = pending.popleft()

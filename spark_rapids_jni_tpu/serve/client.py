@@ -8,7 +8,10 @@ scheduler)."""
 
 from __future__ import annotations
 
+import contextlib
 from typing import Optional, Sequence
+
+from spark_rapids_jni_tpu.obs import context as _context
 
 __all__ = ["Client"]
 
@@ -17,6 +20,16 @@ class Client:
     def __init__(self, scheduler, tenant: str):
         self._sched = scheduler
         self.tenant = str(tenant)
+
+    @contextlib.contextmanager
+    def traced(self, trace_id: Optional[str] = None):
+        """Group every submission in the block under one trace: requests
+        submitted here share a ``trace_id`` (a session/query boundary),
+        so the exported Perfetto view shows them as one causal unit.
+        Yields the active :class:`obs.context.TraceContext`."""
+        ctx = _context.root(tenant=self.tenant, trace_id=trace_id)
+        with _context.activate(ctx):
+            yield ctx
 
     def aggregate(self, keys, values,
                   max_groups: Optional[int] = None):

@@ -57,7 +57,9 @@ class Request:
     ``sig`` is the op's static coalescing signature (shape-bucket dims);
     requests sharing ``(op, sig)`` batch into one dispatch.  ``rows`` /
     ``nbytes`` feed the per-tenant counters; ``t_submit`` anchors the
-    queue-latency histogram."""
+    queue-latency histogram.  ``trace`` is the request's
+    :class:`obs.context.TraceContext` — the scheduler stamps it into the
+    request span and links the coalesced batch span back to it."""
 
     tenant: str
     op: str
@@ -67,6 +69,7 @@ class Request:
     rows: int
     nbytes: int
     t_submit: float = dataclasses.field(default_factory=time.perf_counter)
+    trace: Any = None
 
 
 class RequestQueue:

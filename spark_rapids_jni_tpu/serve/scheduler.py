@@ -46,6 +46,14 @@ Env knobs (all overridable via :class:`Config`):
 - ``SRJ_TPU_SERVE_MAX_BATCH`` — max requests drained per tick (default
   0 = unlimited; bounding it makes the queue's low-water hysteresis
   meaningful, since depth then falls gradually instead of to zero)
+- ``SRJ_TPU_WATCHDOG_MS`` — tick stall deadline for the flight-recorder
+  watchdog (default 0 = disabled; see :mod:`obs.recorder`)
+
+Tracing: every admitted request gets a :class:`obs.context.TraceContext`
+(joining the submitter's active trace when there is one); resolution
+emits a ``serve.request`` span in a per-tenant lane, and the coalesced
+batch span links back to every member request — rendered as
+request→batch flow arrows by ``obs --trace``.
 """
 
 from __future__ import annotations
@@ -57,7 +65,9 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from spark_rapids_jni_tpu.obs import context as _context
 from spark_rapids_jni_tpu.obs import metrics as _metrics
+from spark_rapids_jni_tpu.obs import recorder as _recorder
 from spark_rapids_jni_tpu.obs import spans as _spans
 from spark_rapids_jni_tpu.runtime import shapes, staging
 from spark_rapids_jni_tpu.serve import ops as serve_ops
@@ -179,6 +189,10 @@ class Scheduler:
         self._closed = False
         self.ticks = 0
         self.served = 0
+        # stall watchdog around every tick (disabled unless
+        # SRJ_TPU_WATCHDOG_MS > 0): an overrun emits a kind="watchdog"
+        # event and dumps one "stall" flight-recorder bundle per episode
+        self.watchdog = _recorder.Watchdog(name="serve.tick")
         from spark_rapids_jni_tpu.obs import exporter as _exporter
         _exporter.register_health_provider("serve", self._health)
 
@@ -207,8 +221,9 @@ class Scheduler:
         if not drain:
             for reqs in self.queue.drain().values():
                 for r in reqs:
-                    self._resolve(r.future, exc=QueueFull(
-                        "closed", 0, self.config.max_depth))
+                    if self._resolve(r.future, exc=QueueFull(
+                            "closed", 0, self.config.max_depth)):
+                        self._finish_request(r, "dropped")
         self._stop.set()
         t = self._thread
         if t is not None:
@@ -241,8 +256,14 @@ class Scheduler:
         opdef = serve_ops.get(op)
         payload, sig, rows, nbytes = opdef.validate(dict(kwargs))
         fut: concurrent.futures.Future = concurrent.futures.Future()
+        # every request gets its own trace context; when the submitter
+        # already holds one (Client.traced), the request joins that
+        # trace_id so a session's requests group in the merged view
+        ctx = _context.current()
+        rt = _context.root(tenant=str(tenant),
+                           trace_id=ctx.trace_id if ctx else None)
         req = Request(tenant=str(tenant), op=op, sig=sig, payload=payload,
-                      future=fut, rows=rows, nbytes=nbytes)
+                      future=fut, rows=rows, nbytes=nbytes, trace=rt)
         try:
             self.queue.submit(req)
         except QueueFull as e:
@@ -269,7 +290,9 @@ class Scheduler:
         # the daemon thread must survive ANY tick bug — an escaped
         # exception here would hang every tenant's pending futures
         try:
-            self.tick()
+            with self.watchdog.guard(ticks=self.ticks,
+                                     depth=self.queue.depth):
+                self.tick()
         except Exception:        # noqa: BLE001 — counted, loop lives on
             try:
                 self._m["tick_errors"].inc()
@@ -309,6 +332,7 @@ class Scheduler:
                     if self._resolve(r.future, exc=e):
                         self._m["failures"].inc(
                             tenant=self._tenant_label(r.tenant), op=op)
+                        self._finish_request(r, "error", err=e)
                 n += len(reqs)
         if groups:
             self.ticks += 1
@@ -327,14 +351,17 @@ class Scheduler:
                 live.append(r)
             else:
                 self._m["cancelled"].inc(op=op)
+                self._finish_request(r, "cancelled")
         if not live:
             return len(reqs)
         for r in live:
             self._m["queue_s"].observe(t0 - r.t_submit, op=op)
         try:
-            outs = self._dispatch(opdef, sig, [r.payload for r in live])
+            outs = self._dispatch(opdef, sig, live)
             for slot, r in enumerate(live):
-                self._resolve(r.future, opdef.unbatch(outs, slot, r.payload))
+                if self._resolve(r.future,
+                                 opdef.unbatch(outs, slot, r.payload)):
+                    self._finish_request(r, "ok")
             self._m["batches"].inc(op=op)
             self._m["coalesced"].inc(len(live), op=op)
         except Exception:
@@ -347,26 +374,69 @@ class Scheduler:
                     continue
                 self._m["fallbacks"].inc(op=op)
                 try:
-                    outs = self._dispatch(opdef, r.sig, [r.payload])
-                    self._resolve(r.future, opdef.unbatch(outs, 0, r.payload))
+                    outs = self._dispatch(opdef, r.sig, [r])
+                    if self._resolve(r.future,
+                                     opdef.unbatch(outs, 0, r.payload)):
+                        self._finish_request(r, "ok")
                 except Exception as e:   # noqa: BLE001 — future carries it
                     if self._resolve(r.future, exc=e):
                         self._m["failures"].inc(
                             tenant=self._tenant_label(r.tenant), op=op)
+                        self._finish_request(r, "error", err=e)
         self._m["exec_s"].observe(time.perf_counter() - t0, op=op)
         return len(reqs)
 
-    def _dispatch(self, opdef, sig, payloads) -> List:
+    def _finish_request(self, r: Request, status: str,
+                        err: Optional[BaseException] = None) -> None:
+        """Emit the request-level span (one per resolved request, in a
+        per-tenant lane).  The span's interval covers submit→resolution
+        and carries the request's trace/span ids, which the coalesced
+        batch span links back to — together they are the request→batch
+        edge in the exported trace."""
+        if r.trace is None or not _spans.recording():
+            return
+        ev = {"kind": "span", "name": "serve.request", "status": status,
+              "wall_s": time.perf_counter() - r.t_submit, "depth": 0,
+              "thread": f"tenant:{self._tenant_label(r.tenant)}",
+              "op": r.op, "tenant": r.tenant, "rows": r.rows,
+              "trace_id": r.trace.trace_id, "span_id": r.trace.span_id}
+        if err is not None:
+            ev["error_type"] = type(err).__name__
+            ev["error"] = str(err)[:300]
+        _spans.emit(ev)
+
+    def _dispatch(self, opdef, sig, reqs: List[Request]) -> List:
         """ONE staged transfer, ONE jitted dispatch, ONE fetch for the
-        whole group (the continuous-batching hot path)."""
-        kb = shapes.bucket_rows(len(payloads))
-        with _spans.span(f"serve.{opdef.name}", requests=len(payloads),
-                         slots=kb) as sp:
-            bufs = opdef.batch(payloads, sig, kb)
-            staged = staging.stage_arrays(bufs)
-            outs = opdef.kernel(sig, kb)(*staged)
-            host = staging.fetch_arrays(list(outs))
-            sp.set(rows=sum(p.get("n", 0) for p in payloads))
+        whole group (the continuous-batching hot path).
+
+        The batch span carries ``links`` (every member request's
+        span_id), their trace ids, and the capped tenant set — a
+        chaos-test failure is attributable to (op, bucket, tenant) from
+        the trace alone.  The dispatch runs under a fresh batch trace
+        context, so the staging and kernel spans underneath join one
+        trace chain; :func:`obs.recorder.register_program` records how to
+        re-lower this exact (op, sig, slots) program if it later fails."""
+        kb = shapes.bucket_rows(len(reqs))
+        payloads = [r.payload for r in reqs]
+        attrs = dict(requests=len(reqs), slots=kb, op=opdef.name,
+                     sig=str(sig))
+        if _spans.recording():
+            links = [r.trace.span_id for r in reqs if r.trace is not None]
+            if links:
+                attrs["links"] = links
+                attrs["link_trace_ids"] = sorted(
+                    {r.trace.trace_id for r in reqs if r.trace is not None})
+            attrs["tenants"] = sorted(
+                {self._tenant_label(r.tenant) for r in reqs})
+        with _context.activate(_context.root()):
+            with _spans.span(f"serve.{opdef.name}", **attrs) as sp:
+                bufs = opdef.batch(payloads, sig, kb)
+                staged = staging.stage_arrays(bufs)
+                kern = opdef.kernel(sig, kb)
+                _recorder.register_program(opdef.name, sig, kb, kern, staged)
+                outs = kern(*staged)
+                host = staging.fetch_arrays(list(outs))
+                sp.set(rows=sum(p.get("n", 0) for p in payloads))
         return host
 
     # -- health ------------------------------------------------------------

@@ -61,6 +61,21 @@ def func_range(name: str | None = None):
     return deco
 
 
+def op_scope(op: str, bucket=None):
+    """Named scope carrying the shape-bucket identity: ``srj::op[b<N>]``.
+
+    Wrap the *jitted call site* of a bucketed dispatch with this so the
+    HLO op-metadata lines up with the flight-recorder bundle key — the
+    same ``(op, bucket)`` pair names the lowered ``program-*.txt`` in a
+    diagnostics bundle (:mod:`spark_rapids_jni_tpu.obs.recorder`), a
+    profiler scope, and the span attrs.  ``bucket=None`` (unbucketed
+    dispatch) drops the suffix; disabled tracing costs one predicate."""
+    if not _enabled:
+        return contextlib.nullcontext()
+    scope = f"srj::{op}" if bucket is None else f"srj::{op}[b{bucket}]"
+    return jax.named_scope(scope)
+
+
 @contextlib.contextmanager
 def trace(log_dir: str = "/tmp/srj_tpu_trace"):
     """Capture a ``jax.profiler`` trace around a block (TensorBoard/XProf

@@ -1,0 +1,446 @@
+"""Request-scoped tracing + flight-recorder tests: contextvar handoff
+across the prefetcher and scheduler threads (no leakage between
+concurrent tenants), flow-link presence in exported Perfetto JSON,
+watchdog fires-once semantics, bundle written on an injected faultinj
+fault and NOT on clean runs, merged multi-host trace lanes, and the
+(op, bucket) named-scope alignment with bundle keys.
+
+Everything here is subprocess-free (tier-1 budget)."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu import faultinj, obs, serve
+from spark_rapids_jni_tpu.obs import context, metrics, recorder, report
+from spark_rapids_jni_tpu.obs.trace import trace_events
+from spark_rapids_jni_tpu.runtime import staging
+from spark_rapids_jni_tpu.utils import tracing
+
+
+@pytest.fixture
+def obs_on():
+    obs.configure_sink(None)
+    obs.clear()
+    metrics.registry().reset()
+    obs.enable()
+    yield
+    obs.disable()
+    obs.configure_sink(None)
+    obs.clear()
+    metrics.registry().reset()
+
+
+@pytest.fixture
+def diag(tmp_path):
+    """Armed flight recorder pointed at a fresh directory."""
+    d = tmp_path / "diag"
+    recorder.reset(programs=True)
+    recorder.arm(str(d))
+    yield d
+    recorder.disarm()
+    recorder.reset(programs=True)
+
+
+@pytest.fixture
+def sched():
+    s = serve.Scheduler()
+    yield s
+    s.close()
+
+
+def _bundles(d):
+    return sorted(p for p in d.iterdir()
+                  if p.name.startswith("bundle-")) if d.exists() else []
+
+
+# ---------------------------------------------------------------------------
+# Context propagation
+# ---------------------------------------------------------------------------
+
+def test_threads_do_not_inherit_context():
+    ctx = context.root(tenant="a")
+    seen = []
+    with context.activate(ctx):
+        t = threading.Thread(target=lambda: seen.append(context.current()))
+        t.start()
+        t.join()
+    assert seen == [None]
+
+
+def test_capture_activate_handoff():
+    ctx = context.root(tenant="a")
+    seen = []
+    with context.activate(ctx):
+        snap = context.capture()
+    t = threading.Thread(
+        target=context.run_with,
+        args=(snap, lambda: seen.append(context.current())))
+    t.start()
+    t.join()
+    assert seen[0] is not None
+    assert seen[0].trace_id == ctx.trace_id
+    # and the worker's context does not linger on this thread
+    assert context.current() is None
+
+
+def test_prefetcher_worker_carries_submitter_context(obs_on):
+    """stage_fn runs on the prefetch worker thread under the context
+    active at ITS submission — staging spans keep the request trace."""
+    ctx = context.root(tenant="pf")
+    results = []
+    with context.activate(ctx):
+        with staging.Prefetcher(
+                range(4),
+                lambda i: (threading.current_thread().name,
+                           context.current()),
+                depth=2) as pf:
+            results = list(pf)
+    assert len(results) == 4
+    for thread_name, seen in results:
+        assert thread_name.startswith("srj-staging-prefetch")
+        assert seen is not None and seen.trace_id == ctx.trace_id
+
+
+def test_span_stamps_trace_chain(obs_on):
+    with context.activate(context.root(tenant="t")) as ctx:
+        with obs.span("outer"):
+            with obs.span("inner"):
+                pass
+    inner, outer = obs.events("span")
+    assert inner["name"] == "inner" and outer["name"] == "outer"
+    assert inner["trace_id"] == outer["trace_id"] == ctx.trace_id
+    assert outer["parent_span_id"] == ctx.span_id
+    assert inner["parent_span_id"] == outer["span_id"]
+    assert inner["tenant"] == "t"
+    # context restored after the block
+    assert context.current() is None
+
+
+def test_no_leakage_between_concurrent_tenants(obs_on):
+    """8 threads x 50 spans, each thread its own tenant context: every
+    event's trace_id must match its own thread's context — the
+    contextvar must not bleed across scheduler-style worker threads."""
+    NT, NS = 8, 50
+    ids = {}
+    barrier = threading.Barrier(NT)
+
+    def worker(i):
+        ctx = context.root(tenant=f"w{i}")
+        ids[f"w{i}"] = ctx.trace_id
+        barrier.wait()
+        with context.activate(ctx):
+            for k in range(NS):
+                with obs.span("conc", i=i, k=k):
+                    pass
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(NT)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    evs = [e for e in obs.events("span") if e["name"] == "conc"]
+    assert len(evs) == NT * NS
+    for e in evs:
+        assert e["trace_id"] == ids[e["tenant"]], \
+            f"event of {e['tenant']} carries another tenant's trace"
+
+
+def test_events_carry_host_lane(obs_on):
+    with obs.span("h"):
+        pass
+    (ev,) = obs.events("span")
+    assert ev["host"] == context.host_id()
+
+
+# ---------------------------------------------------------------------------
+# Serve: request spans, batch links, flow arrows
+# ---------------------------------------------------------------------------
+
+def _submit_three(sched):
+    rng = np.random.default_rng(5)
+    clients = [serve.Client(sched, f"t{i}") for i in range(3)]
+    futs = [c.aggregate(rng.integers(0, 8, 48).astype(np.int32),
+                        rng.integers(-5, 5, 48).astype(np.int32),
+                        max_groups=16)
+            for c in clients]
+    sched.tick()
+    for f in futs:
+        f.result(timeout=30)
+
+
+def test_batch_span_links_requests(obs_on, sched):
+    _submit_three(sched)
+    reqs = [e for e in obs.events("span") if e["name"] == "serve.request"]
+    (batch,) = [e for e in obs.events("span") if e["name"] == "serve.agg"]
+    assert len(reqs) == 3
+    assert all(r["status"] == "ok" for r in reqs)
+    assert sorted(batch["links"]) == sorted(r["span_id"] for r in reqs)
+    assert batch["link_trace_ids"] == sorted(r["trace_id"] for r in reqs)
+    assert batch["tenants"] == ["t0", "t1", "t2"]
+    assert batch["op"] == "agg"
+    # request spans land in per-tenant lanes
+    assert {r["thread"] for r in reqs} == {"tenant:t0", "tenant:t1",
+                                           "tenant:t2"}
+
+
+def test_trace_export_has_flow_arrows(obs_on, sched):
+    _submit_three(sched)
+    doc = trace_events(obs.events())
+    flows = [e for e in doc["traceEvents"] if e["ph"] in ("s", "f")]
+    starts = [e for e in flows if e["ph"] == "s"]
+    finishes = [e for e in flows if e["ph"] == "f"]
+    assert len(starts) == len(finishes) == 3
+    assert {e["id"] for e in starts} == {e["id"] for e in finishes}
+    for f in finishes:
+        assert f["bp"] == "e"
+        s = next(s for s in starts if s["id"] == f["id"])
+        assert f["ts"] >= s["ts"]
+    # arrows start on the per-tenant request lanes and end on the
+    # scheduler lane (different tids within the same process)
+    tid_of = {}
+    for m in doc["traceEvents"]:
+        if m["ph"] == "M" and m["name"] == "thread_name":
+            tid_of[m["args"]["name"]] = m["tid"]
+    assert {s["tid"] for s in starts} == {
+        tid_of["tenant:t0"], tid_of["tenant:t1"], tid_of["tenant:t2"]}
+
+
+def test_clean_events_export_no_flow(obs_on):
+    with obs.span("plain"):
+        pass
+    doc = trace_events(obs.events())
+    assert not [e for e in doc["traceEvents"] if e["ph"] in ("s", "f")]
+
+
+# ---------------------------------------------------------------------------
+# Multihost merge: per-host process lanes
+# ---------------------------------------------------------------------------
+
+def test_merge_renders_per_host_lanes(tmp_path, capsys):
+    logs = []
+    for h in range(2):
+        p = tmp_path / f"events.host{h}.jsonl"
+        with open(p, "w") as f:
+            f.write(json.dumps(
+                {"kind": "span", "name": f"op{h}", "status": "ok",
+                 "wall_s": 0.01, "ts": 100.0 + h, "depth": 0,
+                 "thread": "MainThread", "host": h}) + "\n")
+        logs.append(str(p))
+    out = tmp_path / "merged.json"
+    rc = report.main(["--merge", *logs, "--trace", str(out)])
+    assert rc == 0
+    doc = json.load(open(out))
+    pids = {e["pid"] for e in doc["traceEvents"]}
+    assert pids == {0, 1}
+    pnames = sorted(e["args"]["name"] for e in doc["traceEvents"]
+                    if e["ph"] == "M" and e["name"] == "process_name")
+    assert pnames == ["spark_rapids_jni_tpu host0",
+                      "spark_rapids_jni_tpu host1"]
+
+
+def test_merge_stamps_unmarked_logs_by_index(tmp_path):
+    logs = []
+    for h in range(2):
+        p = tmp_path / f"plain{h}.jsonl"
+        with open(p, "w") as f:
+            # no "host" key: --merge assigns the file index as the lane
+            f.write(json.dumps(
+                {"kind": "span", "name": "x", "status": "ok",
+                 "wall_s": 0.01, "ts": 10.0, "depth": 0,
+                 "thread": "MainThread"}) + "\n")
+        logs.append(str(p))
+    out = tmp_path / "merged.json"
+    assert report.main(["--merge", *logs, "--trace", str(out)]) == 0
+    doc = json.load(open(out))
+    assert {e["pid"] for e in doc["traceEvents"]} == {0, 1}
+
+
+def test_host_trace_sink_per_process_path(tmp_path, obs_on):
+    from spark_rapids_jni_tpu.parallel import multihost
+    base = tmp_path / "events.jsonl"
+    path = multihost.host_trace_sink(str(base))
+    try:
+        assert path == str(tmp_path / "events.host0.jsonl")
+        with obs.span("mh"):
+            pass
+        obs.flush()
+        # filter: the writer may flush carried-over obs_meta counters too
+        (ev,) = [e for e in report.load_events(path)
+                 if e.get("kind") == "span"]
+        assert ev["name"] == "mh" and ev["host"] == 0
+    finally:
+        obs.configure_sink(None)
+
+
+# ---------------------------------------------------------------------------
+# Watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_fires_once_until_reset(obs_on, diag):
+    wd = recorder.Watchdog(name="wd.test", deadline_ms=20)
+    for _ in range(2):      # two consecutive overruns, one episode
+        with wd.guard(op="slow"):
+            time.sleep(0.08)
+    assert wd.fired
+    evs = obs.events("watchdog")
+    assert len(evs) == 1
+    assert evs[0]["name"] == "wd.test" and evs[0]["status"] == "stall"
+    assert len(_bundles(diag)) == 1
+    assert _bundles(diag)[0].name.startswith("bundle-stall-")
+    wd.reset()
+    with wd.guard(op="slow-again"):
+        time.sleep(0.08)
+    assert len(obs.events("watchdog")) == 2
+
+
+def test_watchdog_disabled_is_noop(obs_on):
+    wd = recorder.Watchdog(name="wd.off", deadline_ms=0)
+    assert not wd.enabled
+    with wd.guard():
+        time.sleep(0.01)
+    assert not wd.fired
+    assert obs.events("watchdog") == []
+
+
+def test_watchdog_cancelled_under_deadline(obs_on, diag):
+    wd = recorder.Watchdog(name="wd.fast", deadline_ms=500)
+    with wd.guard():
+        pass
+    time.sleep(0.05)
+    assert not wd.fired
+    assert _bundles(diag) == []
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder bundles
+# ---------------------------------------------------------------------------
+
+def test_no_bundle_on_clean_run(obs_on, diag, sched):
+    _submit_three(sched)
+    assert _bundles(diag) == []
+
+
+def test_bundle_on_injected_fault_identifies_batch(obs_on, diag, sched):
+    """A faultinj fault inside a coalesced batch yields exactly ONE
+    bundle whose repro names the (op, sig, slots) and the linked request
+    trace ids/tenants, with the lowered program text alongside."""
+    rng = np.random.default_rng(13)
+    cs = [serve.Client(sched, f"t{i}") for i in range(3)]
+    data = [(rng.integers(0, 16, 40 + i).astype(np.int32),
+             rng.integers(-5, 5, 40 + i).astype(np.int32))
+            for i in range(3)]
+    st = faultinj.install(config={})
+    try:
+        warm = [c.aggregate(k, v, max_groups=32)
+                for c, (k, v) in zip(cs, data)]
+        sched.tick()
+        for f in warm:
+            f.result(timeout=30)
+        st.apply_config({"pjrtExecuteFaults": {
+            "*": {"percent": 100, "injectionType": 1,
+                  "interceptionCount": 2}}})
+        futs = [c.aggregate(k, v, max_groups=32)
+                for c, (k, v) in zip(cs, data)]
+        sched.tick()
+    finally:
+        faultinj.uninstall()
+    assert sum(1 for f in futs if f.exception(timeout=30)) == 1
+
+    bundles = _bundles(diag)
+    assert len(bundles) == 1        # one failure episode -> one bundle
+    bp = bundles[0]
+    repro = json.loads((bp / "repro.json").read_text())
+    assert repro["op"] == "agg"
+    assert repro["error_type"] == "DeviceAssertError"
+    # the coalesced-batch attrs identify every rider of the failed batch
+    assert repro["tenants"] == ["t0", "t1", "t2"]
+    assert len(repro["links"]) == 3
+    assert len(repro["link_trace_ids"]) == 3
+    progs = [p for p in bp.iterdir() if p.name.startswith("program-")]
+    assert progs
+    assert "module" in progs[0].read_text()   # lowered StableHLO
+    evs = json.loads((bp / "events.json").read_text())
+    assert any(e.get("status") == "error" for e in evs)
+    # the CLI renders it
+    assert report.main(["--bundle", str(bp)]) == 0
+    assert recorder.last_bundle() == str(bp)
+
+
+def test_bundle_dedupe_and_cap(obs_on, diag, monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_DIAG_MAX", "2")
+    for name in ("a", "a", "b", "c"):   # a repeats; cap is 2
+        try:
+            with obs.span(name, op=name):
+                raise RuntimeError("boom")
+        except RuntimeError:
+            pass
+    names = [p.name for p in _bundles(diag)]
+    assert len(names) == 2
+
+
+def test_format_bundle_rejects_non_bundle(tmp_path):
+    out = recorder.format_bundle(str(tmp_path))
+    assert out.startswith("not a flight-recorder bundle")
+    assert report.main(["--bundle", str(tmp_path)]) == 2
+
+
+def test_disarmed_recorder_writes_nothing(obs_on, tmp_path):
+    recorder.reset(programs=True)
+    recorder.disarm()
+    try:
+        with obs.span("solo"):
+            raise RuntimeError("quiet")
+    except RuntimeError:
+        pass
+    assert recorder.last_bundle() is None
+
+
+# ---------------------------------------------------------------------------
+# (op, bucket) named scopes line up with bundle keys
+# ---------------------------------------------------------------------------
+
+def test_op_scope_lands_in_lowered_text():
+    """The recorder's program dump keeps the location metadata, so the
+    srj::op[b<N>] scope names the failing region inside the bundle."""
+    def f(x):
+        with tracing.op_scope("foo", 64):
+            return x + 1
+
+    from spark_rapids_jni_tpu.obs.recorder import _lower_text
+    txt = _lower_text(f, (jax.ShapeDtypeStruct((4,), jnp.int32),))
+    assert "srj::foo[b64]" in txt
+
+
+def test_op_scope_disabled_is_nullcontext():
+    tracing.disable()
+    try:
+        with tracing.op_scope("foo", 64):
+            pass    # no jax scope machinery when tracing is off
+    finally:
+        tracing.enable()
+
+
+def test_register_program_key_matches_span_attrs(obs_on, diag):
+    """The recorder's exact-match path: a failing span whose attrs carry
+    (op, sig, slots) pulls exactly the registered program."""
+    fn = jax.jit(lambda x: x * 2)
+    x = jnp.ones((8,), jnp.int32)
+    recorder.register_program("demo", (8,), 8, fn, (x,))
+    recorder.register_program("other", (4,), 4, fn, (x,))
+    try:
+        with obs.span("demo.dispatch", op="demo", sig=str((8,)), slots=8):
+            raise RuntimeError("kernel died")
+    except RuntimeError:
+        pass
+    (bp,) = _bundles(diag)
+    progs = [p.name for p in bp.iterdir() if p.name.startswith("program-")]
+    assert len(progs) == 1
+    assert "demo" in progs[0]
