@@ -531,6 +531,63 @@ def bench_json_wildcard(num_rows):
             "mid_scanned_GBps": mbytes / tm / 1e9}
 
 
+def bench_kernels(num_rows):
+    """Per-kernel roofline axis: xxhash64, the bloom-filter probe, and a
+    compact get_json leg, each timed standalone with its bytes-scanned
+    GB/s.  The driver rooflines these against the session calibration
+    anchor and publishes them as per-kernel ``pct_of_calibration``
+    headline legs — the numbers every kernel rewrite proves itself with
+    against ``ci/regress_gate.py``."""
+    from spark_rapids_jni_tpu import Column
+    from spark_rapids_jni_tpu.ops import get_json_object, xxhash64
+    from spark_rapids_jni_tpu.ops.spark_bloom import SparkBloomFilter
+
+    rng = np.random.default_rng(13)
+    leg_errors = {}
+    res = {"num_rows": num_rows}
+
+    # xxhash64 over an 8-col int64 table: the join/shuffle key-hash shape
+    cols = [Column.from_numpy(
+        rng.integers(-(1 << 40), 1 << 40, num_rows).astype(np.int64),
+        INT64) for _ in range(8)]
+    jax.block_until_ready([c.data for c in cols])
+    hbytes = sum(c.data.nbytes for c in cols)
+    t = _leg("xxhash64", lambda: xxhash64(cols), leg_errors, iters=12,
+             label=f"xxhash64[{num_rows}]", sync_each=True)
+    if t is not None:
+        res["xxhash64_s"] = t
+        res["xxhash64_GBps"] = hbytes / t / 1e9
+    del cols
+
+    # bloom-filter probe (host-side Spark bit layout; slope timing — no
+    # device round-trip to subtract)
+    vals = Column.from_numpy(
+        rng.integers(0, 1 << 30, num_rows).astype(np.int64), INT64)
+    bf = SparkBloomFilter.optimal(min(num_rows, 1 << 20), 0.03).put(vals)
+    t = _leg("bloom_filter", lambda: bf.might_contain(vals), leg_errors,
+             iters=8, label=f"bloom_filter[{num_rows}]")
+    if t is not None:
+        res["bloom_filter_s"] = t
+        res["bloom_filter_GBps"] = vals.data.nbytes / t / 1e9
+    del vals, bf
+
+    # get_json: simple-path extraction over compact machine docs (row
+    # count capped — the point is the scan rate, not the row axis)
+    nj = min(num_rows, 200_000)
+    docs = [f'{{"a":{i % 100},"b":"x"}}' for i in range(nj)]
+    col = Column.strings_padded(docs)
+    jax.block_until_ready(col.chars2d)
+    t = _leg("get_json", lambda: get_json_object(col, "$.a"), leg_errors,
+             iters=8, label=f"get_json[{nj}]", sync_each=True)
+    if t is not None:
+        res["get_json_rows"] = nj
+        res["get_json_s"] = t
+        res["get_json_GBps"] = col.chars2d.size / t / 1e9
+    if leg_errors:
+        res["leg_errors"] = leg_errors
+    return res
+
+
 def bench_ragged(num_batches):
     """Ragged-batch stream: the same mixed non-pow-2 batch sizes stream
     through to_rows / murmur3 / cast_string_to_int twice — exact-shape
@@ -804,6 +861,8 @@ def _run_axis(axis: str):
             res = bench_transfer(int(n))
         elif kind == "serve":
             res = bench_serve(int(n))
+        elif kind == "kernels":
+            res = bench_kernels(int(n))
         elif kind == "nostrings":
             res = bench_variable(int(n), with_strings=False)
         elif kind == "skewed":
@@ -1049,12 +1108,30 @@ def main():
     results["calibration"] = _axis_subprocess("calibrate", timeout_s=240)
     _flush()
 
+    # persist a good anchor to CALIBRATION.json (the cost model's
+    # registry — the live profile CLI and lazy per-process ceilings read
+    # it); a failed anchor falls back to a still-fresh file instead of
+    # requeueing, so one bad relay window doesn't leave the whole round
+    # unnormalizable
+    from spark_rapids_jni_tpu.obs import costmodel as _costmodel
+    if "calibration_GBps" in results["calibration"]:
+        _costmodel.save_calibration(
+            {"hbm_GBps": results["calibration"]["calibration_GBps"]})
+    elif _costmodel.calibration_fresh():
+        cal_doc = _costmodel.load_calibration()
+        _log(f"calibrate failed; using fresh CALIBRATION.json "
+             f"({cal_doc['hbm_GBps']:.1f} GB/s)")
+        results["calibration"] = {
+            "calibration_GBps": cal_doc["hbm_GBps"],
+            "source": "CALIBRATION.json", "ts": cal_doc.get("ts")}
+        _flush()
+
     # (container key, index, axis spec) of every failed axis: re-queued
     # at END of sweep — relay bad windows last minutes, longer than the
     # in-axis 30-180s backoff can outlast, but usually shorter than the
     # rest of the sweep
     requeue = []
-    if "error" in results["calibration"]:
+    if "calibration_GBps" not in results["calibration"]:
         requeue.append(("calibration", None, "calibrate"))
 
     def _run(key, axis, post=None):
@@ -1088,6 +1165,11 @@ def main():
     # gate sees the serving numbers every round
     _run("serving", "serve:2000")
 
+    # per-kernel roofline axis (xxhash64 / bloom_filter / get_json):
+    # runs under --quick too — the regress gate checks each kernel's
+    # pct_of_calibration every round
+    _run("kernels", f"kernels:{row_axes[0]}")
+
     if not args.quick:
         # the reference's mixed axes: 155 cols with strings at 1M rows
         # (it skips strings >1M for memory, benchmarks/row_conversion.cpp:105)
@@ -1112,6 +1194,9 @@ def main():
         out["requeued"] = True
         if key == "calibration":
             results["calibration"] = out
+            if "calibration_GBps" in out:
+                _costmodel.save_calibration(
+                    {"hbm_GBps": out["calibration_GBps"]})
             # the anchor arrived late: (re-)annotate every axis with it
             for k, v in results.items():
                 if isinstance(v, list):
@@ -1166,6 +1251,31 @@ def main():
             {"metric": "serve_p99_ms",
              "value": sv["p99_ms"], "unit": "ms"},
         ]
+    # per-kernel roofline legs: each kernel's achieved bandwidth as % of
+    # the same-session calibration anchor ({metric, value, unit} entries;
+    # ci/regress_gate.py ingests parsed["roofline"] and names the kernel
+    # in its failure message).  Normalized legs are cross-round
+    # comparable where raw GB/s is not — the whole point of the anchor
+    cal_g = cal.get("calibration_GBps")
+    if cal_g:
+        roofline = []
+
+        def _roof(kernel, gbps):
+            if isinstance(gbps, (int, float)) and gbps > 0:
+                roofline.append({
+                    "metric": f"roofline_{kernel}_pct_of_calibration",
+                    "value": round(100 * gbps / cal_g, 2), "unit": "%"})
+
+        _roof("to_rows", head.get("to_rows_GBps"))
+        _roof("from_rows", head.get("from_rows_GBps"))
+        kern = next((r for r in results.get("kernels", [])
+                     if isinstance(r, dict) and "error" not in r), None)
+        if kern is not None:
+            _roof("xxhash64", kern.get("xxhash64_GBps"))
+            _roof("bloom_filter", kern.get("bloom_filter_GBps"))
+            _roof("get_json", kern.get("get_json_GBps"))
+        if roofline:
+            out["roofline"] = roofline
     print(json.dumps(out))
 
 

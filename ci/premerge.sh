@@ -290,6 +290,72 @@ print(f"flight-recorder smoke: merged trace OK — {len(evs)} events, "
 PY
 rm -rf "$FR_DIAG" "$FR_H0" "$FR_H1" "$FR_MERGED"
 
+# cost-attribution + SLO smoke: declare (via SRJ_TPU_SLO) a utilization
+# objective whose pct_of_calibration floor is deliberately unattainable,
+# run a real kernel workload under the exporter, and assert the burn
+# shows up everywhere it must: burning srj_tpu_slo_* samples on
+# /metrics, the slo sub-document flipped on /healthz, and a non-empty
+# roofline from `obs profile --json` over the same event log
+COST_EVENTS=$(mktemp /tmp/srj_cost_smoke.XXXXXX.jsonl)
+COST_CAL=$(mktemp /tmp/srj_cost_smoke.XXXXXX.calib.json)
+COST_PROF=$(mktemp /tmp/srj_cost_smoke.XXXXXX.profile.json)
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  SRJ_TPU_EVENTS="$COST_EVENTS" SRJ_TPU_CALIBRATION_FILE="$COST_CAL" \
+  SRJ_TPU_SLO="roofline_floor,kind=utilization,op=xxhash64,target=0.5,threshold=99.9,fast_burn=1,slow_burn=1" \
+  python - <<'PY'
+import json, urllib.request
+import numpy as np
+import jax
+from spark_rapids_jni_tpu import Column, INT64, obs
+from spark_rapids_jni_tpu.obs import costmodel, exporter
+from spark_rapids_jni_tpu.ops import xxhash64
+
+# a calibrated ceiling no CPU kernel can approach: every observation
+# lands under the 99.9% floor, so the objective must burn
+costmodel.save_calibration({"hbm_GBps": 819.0})
+obs.enable()
+port = exporter.start(0)
+assert port, "exporter failed to bind"
+cols = [Column.from_numpy(np.arange(4096, dtype=np.int64), INT64)
+        for _ in range(4)]
+for _ in range(5):
+    jax.block_until_ready(xxhash64(cols))
+obs.flush()
+
+hz = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+assert hz["slo"]["status"] == "burning", hz
+assert "roofline_floor" in hz["slo"]["burning"], hz
+body = urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+assert 'srj_tpu_slo_burning{objective="roofline_floor"} 1' in body
+assert 'srj_tpu_slo_burn_rate{objective="roofline_floor",window="fast"}' \
+    in body
+assert 'outcome="bad"' in body      # srj_tpu_slo_events_total fed
+assert "srj_tpu_costmodel_pct_of_calibration" in body
+assert "srj_tpu_costmodel_ceiling_gbps 819" in body
+exporter.stop()
+print(f"cost/SLO smoke: roofline_floor burning on /healthz, "
+      f"srj_tpu_slo_* live on /metrics (port {port})")
+PY
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  SRJ_TPU_CALIBRATION_FILE="$COST_CAL" \
+  python -m spark_rapids_jni_tpu.obs profile "$COST_EVENTS" --json \
+  > "$COST_PROF"
+python - "$COST_PROF" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+rows = doc["rows"]
+assert rows, "obs profile returned an empty roofline"
+row = next(r for r in rows if r["op"] == "xxhash64")
+assert row["bytes"] > 0 and row["calls"] == 5, row
+assert 0 <= row["pct_of_calibration"] < 100, row
+print(f"cost/SLO smoke: obs profile -> {len(rows)} roofline rows, "
+      f"xxhash64 at {row['pct_of_calibration']:.2f}% of "
+      f"{doc['ceiling_GBps']:.0f} GB/s ({doc['source']})")
+PY
+rm -f "$COST_EVENTS" "$COST_CAL" "$COST_PROF"
+
 # perf-regression gate, advisory for now: reports deltas of the newest
 # checked-in bench round vs the prior one (flip --mode enforce once the
 # round cadence stabilizes); the synthetic self-test proves the gate

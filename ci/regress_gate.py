@@ -44,6 +44,7 @@ from typing import Dict, List, Optional, Tuple
 LOWER_IS_BETTER_UNITS = {"s", "sec", "secs", "seconds", "ms", "us", "ns"}
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+_ROOFLINE_RE = re.compile(r"^roofline_(.+)_pct_of_calibration$")
 
 
 def load_round(path: str) -> Dict:
@@ -59,14 +60,19 @@ def round_metrics(doc: Dict) -> Dict[str, Dict]:
     round.  ``parsed`` is a single metric dict today (tolerate a future
     list-of-dicts shape); a headline may also carry a ``secondary`` list
     of extra ``{metric, value, unit}`` entries (the serving axis reports
-    QPS and p99 latency this way), gated under the same tolerance."""
+    QPS and p99 latency this way) and a ``roofline`` list of per-kernel
+    ``roofline_<kernel>_pct_of_calibration`` legs — all gated under the
+    same tolerance (``%`` is not a time unit, so rooflines correctly
+    regress when utilization drops)."""
     parsed = doc.get("parsed")
     if parsed is None:
         return {}
     entries = list(parsed) if isinstance(parsed, list) else [parsed]
     for e in list(entries):
-        if isinstance(e, dict) and isinstance(e.get("secondary"), list):
-            entries.extend(e["secondary"])
+        if isinstance(e, dict):
+            for extra in ("secondary", "roofline"):
+                if isinstance(e.get(extra), list):
+                    entries.extend(e[extra])
     out = {}
     for e in entries:
         if not isinstance(e, dict):
@@ -213,10 +219,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     regressed = [r for r in rows if r["regressed"]]
     if regressed:
         names = ", ".join(r["metric"] for r in regressed)
+        # a regressed roofline leg names its KERNEL outright — the
+        # failure message should say which kernel got slower, not make
+        # the reader decode a metric id
+        kernels = sorted({m.group(1) for r in regressed
+                          for m in [_ROOFLINE_RE.match(r["metric"])]
+                          if m})
+        suffix = f" (kernels: {', '.join(kernels)})" if kernels else ""
         if args.mode == "enforce":
-            print(f"FAIL: regression in {names}", file=sys.stderr)
+            print(f"FAIL: regression in {names}{suffix}",
+                  file=sys.stderr)
             return 3
-        print(f"ADVISORY: regression in {names} "
+        print(f"ADVISORY: regression in {names}{suffix} "
               f"(mode=advisory, not failing the build)", file=sys.stderr)
     return 0
 

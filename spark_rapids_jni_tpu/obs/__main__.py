@@ -1,7 +1,15 @@
-"""CLI entry: ``python -m spark_rapids_jni_tpu.obs <events.jsonl>``."""
+"""CLI entry: ``python -m spark_rapids_jni_tpu.obs <events.jsonl>``
+(report) or ``python -m spark_rapids_jni_tpu.obs profile <events.jsonl>``
+(roofline attribution)."""
 
 import sys
 
+argv = sys.argv[1:]
+if argv and argv[0] == "profile":
+    from spark_rapids_jni_tpu.obs.costmodel import profile_main
+
+    sys.exit(profile_main(argv[1:]))
+
 from spark_rapids_jni_tpu.obs.report import main
 
-sys.exit(main())
+sys.exit(main(argv))

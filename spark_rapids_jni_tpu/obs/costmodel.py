@@ -1,0 +1,545 @@
+"""Per-kernel roofline cost model: calibration registry + attribution ledger.
+
+The bench has always measured an HBM-copy ceiling (``bench.py:_calibrate_hbm``)
+and used it for exactly one number — the headline ``to_rows``
+``pct_of_calibration``.  This module makes that ceiling a first-class,
+persistent artifact and relates *every* observed kernel to it:
+
+- **Calibration registry** — ``save_calibration`` persists the measured
+  ceilings (HBM copy, H2D, D2H, in GB/s) to ``CALIBRATION.json``
+  (``SRJ_TPU_CALIBRATION_FILE`` overrides the path); ``load_calibration``
+  reads it back with a freshness window (``SRJ_TPU_CALIBRATION_MAX_AGE_S``,
+  default 24h).  :func:`ceiling_GBps` is the one-stop read: fresh file →
+  its ceiling; no file → a lazy micro-calibration (one ~32 MiB on-device
+  copy, timed once per process) → the static fallback the bench has
+  always assumed.  Ceilings are per-machine facts, not per-run facts —
+  which is exactly why they belong in a file, not a process.
+
+- **Attribution ledger** — :func:`observe_span` (called from
+  ``metrics.observe_event`` for every finished span) folds each event
+  into a per-``(op, sig, bucket)`` cell: calls, device/wall seconds,
+  bytes, rows, pad waste, compiles.  :meth:`Ledger.profile` derives the
+  roofline view per cell — achieved GB/s (bytes over *device* seconds,
+  falling back to wall when the span was never fenced), % of the
+  calibrated ceiling, bytes-per-device-second, compile-amortization
+  (fraction of wall spent compiling), pad-row waste — and
+  :meth:`Ledger.hotspots` ranks cells by total device time so "where do
+  the device-seconds go" is one call.
+
+- **Tenant cost ledger** — :func:`charge_tenant` accumulates the
+  chargeback families ``srj_tpu_tenant_cost_device_seconds_total`` /
+  ``srj_tpu_tenant_cost_hbm_bytes_total`` /
+  ``srj_tpu_tenant_cost_pad_rows_total`` (fed by the serve scheduler per
+  executed batch, and from any span that carries a ``tenant`` stamp).
+  Tenant labels ride the same cardinality cap as the serve families
+  (``SRJ_TPU_SERVE_MAX_TENANTS``, default 64, fold-to-``_overflow``) so
+  a tenant-id flood cannot grow label space.
+
+- **Scrape-time gauges** — a collect hook (registered on first observe)
+  refreshes ``srj_tpu_costmodel_achieved_gbps{op,bucket}`` /
+  ``srj_tpu_costmodel_pct_of_calibration{op,bucket}`` /
+  ``srj_tpu_costmodel_ceiling_gbps`` right before every ``/metrics``
+  scrape — derived numbers are computed at read time, never on a timer.
+
+- **CLI** — ``python -m spark_rapids_jni_tpu.obs profile <events.jsonl>``
+  replays a span log through a fresh ledger and renders the roofline
+  table (``--json`` for machines, ``--baseline prev.json`` to diff two
+  profiles, ``--top K`` for the hotspot cut).
+
+Everything is guarded: recording never raises, calibration falls back
+rather than failing, and the micro-calibration only touches the
+accelerator when a ceiling is actually asked for.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from spark_rapids_jni_tpu.obs import metrics as _metrics
+
+__all__ = [
+    "DEFAULT_HBM_GBPS", "calibration_path", "save_calibration",
+    "load_calibration", "calibration_fresh", "ceiling_GBps",
+    "Ledger", "ledger", "observe_span", "charge_tenant", "reset",
+    "profile_main",
+]
+
+# the static assumption bench.py has always shipped (v5e-class HBM copy
+# ceiling); used only when there is no CALIBRATION.json and the
+# micro-calibration cannot run
+DEFAULT_HBM_GBPS = 819.0
+
+_ENV_FILE = "SRJ_TPU_CALIBRATION_FILE"
+_ENV_MAX_AGE = "SRJ_TPU_CALIBRATION_MAX_AGE_S"
+_ENV_MAX_TENANTS = "SRJ_TPU_SERVE_MAX_TENANTS"
+
+_MICRO_BYTES = 32 << 20  # one ~32 MiB copy is enough to see HBM rate
+
+
+# ---------------------------------------------------------------------------
+# Calibration registry
+# ---------------------------------------------------------------------------
+
+def calibration_path(path: Optional[str] = None) -> str:
+    """Resolve the calibration file path: explicit arg > env > cwd."""
+    return path or os.environ.get(_ENV_FILE) or "CALIBRATION.json"
+
+
+def max_age_s() -> float:
+    try:
+        return float(os.environ.get(_ENV_MAX_AGE, "86400"))
+    except ValueError:
+        return 86400.0
+
+
+def save_calibration(ceilings: Dict, path: Optional[str] = None,
+                     source: str = "bench",
+                     now: Optional[float] = None) -> Optional[str]:
+    """Persist measured ceilings (``hbm_GBps`` required; ``h2d_GBps`` /
+    ``d2h_GBps`` optional) to the calibration file.  Returns the path
+    written, or ``None`` on failure (calibration is advisory — a
+    read-only cwd must not fail a bench run)."""
+    doc = {"ts": time.time() if now is None else float(now),
+           "source": source}
+    for k in ("hbm_GBps", "h2d_GBps", "d2h_GBps"):
+        v = ceilings.get(k)
+        if isinstance(v, (int, float)) and v > 0:
+            doc[k] = float(v)
+    if "hbm_GBps" not in doc:
+        return None
+    p = calibration_path(path)
+    try:
+        tmp = f"{p}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, p)
+    except OSError:
+        return None
+    _invalidate_cache()
+    return p
+
+
+def load_calibration(path: Optional[str] = None,
+                     max_age: Optional[float] = None,
+                     now: Optional[float] = None) -> Optional[Dict]:
+    """Read the calibration file; ``None`` when missing, malformed, or
+    older than the freshness window (stale hardware facts are worse than
+    a fresh micro-measurement)."""
+    p = calibration_path(path)
+    try:
+        with open(p, "r") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict):
+        return None
+    if not isinstance(doc.get("hbm_GBps"), (int, float)):
+        return None
+    age_cap = max_age_s() if max_age is None else float(max_age)
+    ts = doc.get("ts")
+    if isinstance(ts, (int, float)) and age_cap > 0:
+        t = time.time() if now is None else float(now)
+        if t - ts > age_cap:
+            return None
+    return doc
+
+
+def calibration_fresh(path: Optional[str] = None,
+                      max_age: Optional[float] = None,
+                      now: Optional[float] = None) -> bool:
+    """True when a fresh calibration file exists (what lets the bench
+    skip requeueing a failed calibrate axis)."""
+    return load_calibration(path, max_age, now) is not None
+
+
+_CEILING_LOCK = threading.Lock()
+_CEILING_CACHE: Optional[Tuple[float, str]] = None  # (GBps, source)
+
+
+def _invalidate_cache() -> None:
+    global _CEILING_CACHE
+    with _CEILING_LOCK:
+        _CEILING_CACHE = None
+
+
+def _micro_calibrate() -> Optional[float]:
+    """Time one on-device copy of a ~32 MiB buffer: the cheapest credible
+    stand-in for the bench's full HBM calibration.  Returns GB/s, or
+    ``None`` when the accelerator stack is unusable from here."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        n = _MICRO_BYTES // 4
+        src = jax.block_until_ready(jnp.zeros((n,), jnp.float32))
+        copy = jax.jit(lambda x: x + 0)
+        jax.block_until_ready(copy(src))  # compile outside the timing
+        t0 = time.perf_counter()
+        jax.block_until_ready(copy(src))
+        dt = time.perf_counter() - t0
+        if dt <= 0:
+            return None
+        # read + write, same accounting as bench._calibrate_hbm
+        return 2.0 * n * 4 / dt / 1e9
+    except Exception:
+        return None
+
+
+def ceiling_GBps(path: Optional[str] = None) -> Tuple[float, str]:
+    """The HBM-copy ceiling to roofline against, with provenance:
+    ``(GBps, source)`` where source is ``"file"`` (fresh
+    ``CALIBRATION.json``), ``"micro"`` (lazy one-shot measurement), or
+    ``"default"`` (the static fallback).  Cached per process; persisting
+    a new calibration invalidates the cache."""
+    global _CEILING_CACHE
+    with _CEILING_LOCK:
+        if _CEILING_CACHE is not None:
+            return _CEILING_CACHE
+        doc = load_calibration(path)
+        if doc is not None:
+            _CEILING_CACHE = (float(doc["hbm_GBps"]), "file")
+            return _CEILING_CACHE
+        g = _micro_calibrate()
+        if g is not None and g > 0:
+            _CEILING_CACHE = (g, "micro")
+        else:
+            _CEILING_CACHE = (DEFAULT_HBM_GBPS, "default")
+        return _CEILING_CACHE
+
+
+# ---------------------------------------------------------------------------
+# Attribution ledger
+# ---------------------------------------------------------------------------
+
+_CELL_FIELDS = ("calls", "errors", "wall_s", "device_s", "bytes", "rows",
+                "padded_rows", "padded_bytes", "compiles", "compile_s")
+
+
+class Ledger:
+    """Per-``(op, sig, bucket)`` accumulation of span telemetry, with the
+    roofline derivations computed at read time.  Thread-safe; observing
+    never raises."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cells: Dict[Tuple[str, str, str], Dict[str, float]] = {}
+
+    def observe(self, ev: Dict) -> None:
+        try:
+            if ev.get("kind") != "span":
+                return
+            op = str(ev.get("name", "?"))
+            sig = str(ev.get("sig", ""))
+            bucket = str(ev.get("bucket", ""))
+            key = (op, sig, bucket)
+            with self._lock:
+                c = self._cells.get(key)
+                if c is None:
+                    c = self._cells[key] = {f: 0.0 for f in _CELL_FIELDS}
+                c["calls"] += 1
+                if ev.get("status") == "error":
+                    c["errors"] += 1
+                for field in ("wall_s", "device_s", "bytes", "rows",
+                              "padded_rows", "padded_bytes", "compiles",
+                              "compile_s"):
+                    v = ev.get(field)
+                    if isinstance(v, (int, float)):
+                        c[field] += float(v)
+        except Exception:
+            pass
+
+    @staticmethod
+    def _derive(key: Tuple[str, str, str], c: Dict[str, float],
+                ceiling: float) -> Dict:
+        op, sig, bucket = key
+        dev = c["device_s"]
+        wall = c["wall_s"]
+        # roofline clock: fenced device time when the op ever fenced,
+        # host wall otherwise (a lower bound — flagged via time_base)
+        t = dev if dev > 0 else wall
+        achieved = (c["bytes"] / t / 1e9) if t > 0 else 0.0
+        total_rows = c["rows"] + c["padded_rows"]
+        row = {
+            "op": op, "sig": sig, "bucket": bucket,
+            "calls": int(c["calls"]), "errors": int(c["errors"]),
+            "wall_s": wall, "device_s": dev,
+            "time_base": "device" if dev > 0 else "wall",
+            "bytes": int(c["bytes"]), "rows": int(c["rows"]),
+            "achieved_GBps": achieved,
+            "ceiling_GBps": ceiling,
+            "pct_of_calibration": (100.0 * achieved / ceiling
+                                   if ceiling > 0 else 0.0),
+            "bytes_per_device_s": (c["bytes"] / dev) if dev > 0 else 0.0,
+            "compiles": int(c["compiles"]),
+            "compile_amortization": (c["compile_s"] / wall
+                                     if wall > 0 else 0.0),
+            "padded_rows": int(c["padded_rows"]),
+            "pad_waste_pct": (100.0 * c["padded_rows"] / total_rows
+                              if total_rows > 0 else 0.0),
+        }
+        return row
+
+    def profile(self, ceiling: Optional[float] = None) -> List[Dict]:
+        """Roofline rows for every cell, sorted by total device time
+        descending (the hotspot order)."""
+        if ceiling is None:
+            ceiling = ceiling_GBps()[0]
+        with self._lock:
+            cells = {k: dict(c) for k, c in self._cells.items()}
+        rows = [self._derive(k, c, ceiling) for k, c in cells.items()]
+        rows.sort(key=lambda r: (r["device_s"] or r["wall_s"]),
+                  reverse=True)
+        return rows
+
+    def hotspots(self, k: int = 10,
+                 ceiling: Optional[float] = None) -> List[Dict]:
+        """Top-``k`` cells by total device (fallback wall) seconds."""
+        return self.profile(ceiling)[:max(0, int(k))]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._cells.clear()
+
+
+_LEDGER = Ledger()
+
+
+def ledger() -> Ledger:
+    """The process-default ledger (what span completion feeds)."""
+    return _LEDGER
+
+
+# ---------------------------------------------------------------------------
+# Tenant chargeback (capped label space)
+# ---------------------------------------------------------------------------
+
+_TENANT_LOCK = threading.Lock()
+_TENANT_SEEN: set = set()
+
+
+def _max_tenants() -> int:
+    try:
+        return max(1, int(os.environ.get(_ENV_MAX_TENANTS, "64")))
+    except ValueError:
+        return 64
+
+
+def _tenant_label(tenant) -> str:
+    """Same fold-to-``_overflow`` cap the serve scheduler applies: the
+    first N distinct tenants keep their names, later ones share one
+    label so quantile/counter state stays bounded."""
+    t = str(tenant) if tenant else "_anonymous"
+    with _TENANT_LOCK:
+        if t in _TENANT_SEEN:
+            return t
+        if len(_TENANT_SEEN) < _max_tenants():
+            _TENANT_SEEN.add(t)
+            return t
+    return "_overflow"
+
+
+def charge_tenant(tenant, device_s: float = 0.0, hbm_bytes: float = 0.0,
+                  pad_rows: float = 0.0) -> None:
+    """Accumulate one tenant's share of a batch into the chargeback
+    families.  Called by the serve scheduler per executed request (and
+    from :func:`observe_span` for tenant-stamped spans).  Never raises."""
+    try:
+        label = _tenant_label(tenant)
+        if device_s:
+            _metrics.counter(
+                "srj_tpu_tenant_cost_device_seconds_total",
+                "Device-seconds attributed per tenant.",
+                ("tenant",)).inc(float(device_s), tenant=label)
+        if hbm_bytes:
+            _metrics.counter(
+                "srj_tpu_tenant_cost_hbm_bytes_total",
+                "HBM bytes moved per tenant.",
+                ("tenant",)).inc(float(hbm_bytes), tenant=label)
+        if pad_rows:
+            _metrics.counter(
+                "srj_tpu_tenant_cost_pad_rows_total",
+                "Padded-row waste attributed per tenant.",
+                ("tenant",)).inc(float(pad_rows), tenant=label)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Span feed + scrape-time gauges
+# ---------------------------------------------------------------------------
+
+_HOOK_INSTALLED = False
+
+
+def _publish_gauges() -> None:
+    """Collect hook: refresh the per-(op, bucket) utilization gauges from
+    the ledger right before a scrape."""
+    try:
+        ceiling, _src = ceiling_GBps()
+        ach = _metrics.gauge("srj_tpu_costmodel_achieved_gbps",
+                             "Achieved GB/s per (op, bucket) from the "
+                             "attribution ledger.", ("op", "bucket"))
+        pct = _metrics.gauge("srj_tpu_costmodel_pct_of_calibration",
+                             "Achieved bandwidth as % of the calibrated "
+                             "HBM ceiling, per (op, bucket).",
+                             ("op", "bucket"))
+        _metrics.gauge("srj_tpu_costmodel_ceiling_gbps",
+                       "Calibrated HBM-copy ceiling in GB/s."
+                       ).set(ceiling)
+        for row in _LEDGER.profile(ceiling):
+            if not row["bytes"]:
+                continue
+            ach.set(row["achieved_GBps"], op=row["op"],
+                    bucket=row["bucket"])
+            pct.set(row["pct_of_calibration"], op=row["op"],
+                    bucket=row["bucket"])
+    except Exception:
+        pass
+
+
+def _ensure_hook() -> None:
+    global _HOOK_INSTALLED
+    if not _HOOK_INSTALLED:
+        _HOOK_INSTALLED = True
+        _metrics.register_collect_hook(_publish_gauges)
+
+
+def observe_span(ev: Dict) -> None:
+    """Fold one finished span into the attribution layer (called from
+    ``metrics.observe_event``).  Never raises."""
+    try:
+        _ensure_hook()
+        _LEDGER.observe(ev)
+        tenant = ev.get("tenant")
+        if tenant:
+            # span-level chargeback: device time + bytes the span itself
+            # reported (the serve scheduler charges batches explicitly
+            # via charge_tenant, on serve.request spans these are unset)
+            dev = ev.get("device_s")
+            nb = ev.get("bytes")
+            pr = ev.get("padded_rows")
+            if (isinstance(dev, (int, float)) and dev) or \
+               (isinstance(nb, (int, float)) and nb) or \
+               (isinstance(pr, (int, float)) and pr):
+                charge_tenant(tenant,
+                              device_s=dev if isinstance(
+                                  dev, (int, float)) else 0.0,
+                              hbm_bytes=nb if isinstance(
+                                  nb, (int, float)) else 0.0,
+                              pad_rows=pr if isinstance(
+                                  pr, (int, float)) else 0.0)
+    except Exception:
+        pass
+
+
+def reset() -> None:
+    """Zero the ledger and the tenant-label cache (test isolation)."""
+    _LEDGER.reset()
+    with _TENANT_LOCK:
+        _TENANT_SEEN.clear()
+    _invalidate_cache()
+
+
+# ---------------------------------------------------------------------------
+# CLI: python -m spark_rapids_jni_tpu.obs profile
+# ---------------------------------------------------------------------------
+
+def replay(events: Iterable[Dict]) -> Ledger:
+    """Fold an event stream into a fresh ledger (the CLI path: same
+    arithmetic as the live feed, applied to a JSONL log)."""
+    led = Ledger()
+    for ev in events:
+        led.observe(ev)
+    return led
+
+
+def _fmt_row(r: Dict, base: Optional[Dict] = None) -> str:
+    cell = f"{r['op']}"
+    if r["bucket"]:
+        cell += f"@{r['bucket']}"
+    dev_ms = (r["device_s"] or r["wall_s"]) * 1e3
+    delta = ""
+    if base is not None:
+        d = r["pct_of_calibration"] - base["pct_of_calibration"]
+        delta = f" {d:+8.1f}"
+    return (f"{cell:<40} {r['calls']:>6} {dev_ms:>10.2f} "
+            f"{r['bytes']:>14} {r['achieved_GBps']:>9.2f} "
+            f"{r['ceiling_GBps']:>9.1f} {r['pct_of_calibration']:>6.1f}"
+            f"{delta} {r['pad_waste_pct']:>7.1f} "
+            f"{100.0 * r['compile_amortization']:>9.1f}")
+
+
+def render_profile(rows: List[Dict],
+                   baseline: Optional[List[Dict]] = None) -> str:
+    """Fixed-width roofline table; with ``baseline``, a Δ%% column shows
+    the utilization change per matching (op, sig, bucket) cell."""
+    dcol = "   Δpct" if baseline is not None else ""
+    head = (f"{'op@bucket':<40} {'calls':>6} {'dev_ms':>10} "
+            f"{'bytes':>14} {'GB/s':>9} {'ceil':>9} {'pct':>6}"
+            f"{dcol} {'pad%':>7} {'compile%':>9}")
+    lines = [head, "-" * len(head)]
+    bmap = {}
+    if baseline is not None:
+        bmap = {(b["op"], b["sig"], b["bucket"]): b for b in baseline}
+    for r in rows:
+        base = bmap.get((r["op"], r["sig"], r["bucket"])) \
+            if baseline is not None else None
+        lines.append(_fmt_row(r, base))
+    return "\n".join(lines)
+
+
+def profile_main(argv: Optional[List[str]] = None) -> int:
+    """``python -m spark_rapids_jni_tpu.obs profile <events.jsonl>``."""
+    import argparse
+    import sys
+
+    from spark_rapids_jni_tpu.obs.report import load_events
+
+    ap = argparse.ArgumentParser(
+        prog="python -m spark_rapids_jni_tpu.obs profile",
+        description="Roofline attribution from a span JSONL log: "
+                    "achieved GB/s vs the calibrated HBM ceiling, "
+                    "per (op, bucket).")
+    ap.add_argument("path", help="events JSONL file (SRJ_TPU_EVENTS)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine output: {ceiling, source, rows}")
+    ap.add_argument("--baseline", metavar="PREV",
+                    help="a previous --json dump to diff against")
+    ap.add_argument("--calibration", metavar="FILE",
+                    help="calibration file (default CALIBRATION.json / "
+                         "$SRJ_TPU_CALIBRATION_FILE)")
+    ap.add_argument("--top", type=int, default=0, metavar="K",
+                    help="only the K hottest cells by device time")
+    args = ap.parse_args(argv)
+    try:
+        events = list(load_events(args.path))
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    ceiling, source = ceiling_GBps(args.calibration)
+    rows = replay(events).profile(ceiling)
+    if args.top > 0:
+        rows = rows[:args.top]
+    baseline = None
+    if args.baseline:
+        try:
+            with open(args.baseline, "r") as f:
+                bdoc = json.load(f)
+            baseline = bdoc.get("rows", bdoc) \
+                if isinstance(bdoc, dict) else bdoc
+        except (OSError, ValueError) as e:
+            print(f"error reading baseline: {e}", file=sys.stderr)
+            return 2
+    if args.json:
+        print(json.dumps({"ceiling_GBps": ceiling, "source": source,
+                          "rows": rows}, indent=2))
+    else:
+        print(f"ceiling: {ceiling:.1f} GB/s ({source})")
+        print(render_profile(rows, baseline))
+    # empty profiles exit non-zero so CI can assert data actually flowed
+    return 0 if rows else 1

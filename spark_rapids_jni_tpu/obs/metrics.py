@@ -43,11 +43,26 @@ offline report also computes use the SAME metric names as ``report
   fold into ``tenant="_overflow"`` so a tenant-id flood cannot blow up
   the registry or the scrape size.
 
+Quantiles without unbounded memory: a fourth family kind, ``summary``,
+holds a :class:`P2Quantile` estimator (Jain & Chlamtac's P² algorithm —
+five markers per tracked quantile, O(1) memory and update) per label set
+and exposes Prometheus summary samples (``{quantile="0.99"}`` plus
+``_sum``/``_count``).  Span completion feeds a per-op wall-clock summary
+(``srj_tpu_span_wall_seconds_quantile``), and the serve scheduler feeds a
+per-tenant request-latency summary — per-tenant lanes ride the SAME
+cardinality cap as the other serve families.
+
 Everything here is pure stdlib (the exposition must be servable from a
 process whose accelerator runtime is wedged), and recording never raises
 — the registry exists to observe operations, not to take them down.  The
 text exposition formatter (:func:`format_exposition`) is shared with
 ``report --prom``: one serializer, two data sources.
+
+Collect hooks: :func:`register_collect_hook` adds a callable run (and
+guarded) at the top of :func:`format_prometheus` — derived-metric
+producers (the SLO engine's burn-rate gauges, the cost model's
+utilization gauges) refresh themselves right before every scrape instead
+of polling on a timer.
 """
 
 from __future__ import annotations
@@ -56,9 +71,11 @@ import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = [
-    "Registry", "registry", "counter", "gauge", "histogram",
+    "Registry", "registry", "counter", "gauge", "histogram", "summary",
     "format_exposition", "format_prometheus", "observe_event",
-    "escape_label_value", "DEFAULT_LATENCY_BUCKETS",
+    "escape_label_value", "register_collect_hook",
+    "unregister_collect_hook", "P2Quantile",
+    "DEFAULT_LATENCY_BUCKETS", "DEFAULT_QUANTILES",
 ]
 
 # fixed latency buckets (seconds): sub-ms kernel dispatches up through
@@ -67,6 +84,98 @@ DEFAULT_LATENCY_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 )
+
+# the percentile ladder every summary family tracks by default: the p50
+# the dashboards plot, the p90 the capacity models use, the p99 the SLOs
+# are written against
+DEFAULT_QUANTILES = (0.5, 0.9, 0.99)
+
+
+class P2Quantile:
+    """Streaming quantile estimate in O(1) memory: the P² algorithm
+    (Jain & Chlamtac 1985).  Five markers track the min, the max, the
+    target quantile, and the two mid-quantiles; each new observation
+    shifts marker positions and parabolically adjusts marker heights.
+    Until five observations arrive the exact small-sample value is
+    served from the bootstrap buffer, so n<5 streams are never wrong."""
+
+    __slots__ = ("q", "_n", "_heights", "_pos", "_count")
+
+    def __init__(self, q: float):
+        if not 0.0 < q < 1.0:
+            raise ValueError(f"quantile must be in (0, 1), got {q}")
+        self.q = q
+        self._n: List[float] = []     # bootstrap buffer until 5 samples
+        self._heights: List[float] = []
+        self._pos: List[float] = []
+        self._count = 0
+
+    def observe(self, x: float) -> None:
+        self._count += 1
+        if self._heights:
+            self._update(float(x))
+            return
+        self._n.append(float(x))
+        if len(self._n) == 5:
+            self._n.sort()
+            self._heights = list(self._n)
+            self._pos = [1.0, 2.0, 3.0, 4.0, 5.0]
+            self._n = []
+
+    def _update(self, x: float) -> None:
+        h, pos, q = self._heights, self._pos, self.q
+        if x < h[0]:
+            h[0] = x
+            k = 0
+        elif x >= h[4]:
+            h[4] = x
+            k = 3
+        else:
+            k = 0
+            while k < 3 and x >= h[k + 1]:
+                k += 1
+        for i in range(k + 1, 5):
+            pos[i] += 1.0
+        # desired positions after this observation
+        n = pos[4]
+        want = (1.0,
+                1.0 + (n - 1.0) * q / 2.0,
+                1.0 + (n - 1.0) * q,
+                1.0 + (n - 1.0) * (1.0 + q) / 2.0,
+                n)
+        for i in (1, 2, 3):
+            d = want[i] - pos[i]
+            if (d >= 1.0 and pos[i + 1] - pos[i] > 1.0) or \
+               (d <= -1.0 and pos[i - 1] - pos[i] < -1.0):
+                s = 1.0 if d >= 0 else -1.0
+                # parabolic (P²) interpolation, linear fallback when the
+                # parabola would cross a neighboring marker
+                hp = h[i] + s / (pos[i + 1] - pos[i - 1]) * (
+                    (pos[i] - pos[i - 1] + s)
+                    * (h[i + 1] - h[i]) / (pos[i + 1] - pos[i])
+                    + (pos[i + 1] - pos[i] - s)
+                    * (h[i] - h[i - 1]) / (pos[i] - pos[i - 1]))
+                if not (h[i - 1] < hp < h[i + 1]):
+                    j = i + int(s)
+                    hp = h[i] + s * (h[j] - h[i]) / (pos[j] - pos[i])
+                h[i] = hp
+                pos[i] += s
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def value(self) -> Optional[float]:
+        """Current estimate (exact below five observations; ``None`` when
+        nothing has been observed)."""
+        if self._heights:
+            return self._heights[2]
+        if not self._n:
+            return None
+        vals = sorted(self._n)
+        # nearest-rank on the bootstrap buffer
+        idx = min(len(vals) - 1, max(0, round(self.q * (len(vals) - 1))))
+        return vals[int(idx)]
 
 
 def escape_label_value(v: str) -> str:
@@ -115,16 +224,19 @@ class _Family:
     in telemetry must not fail the operation being observed)."""
 
     __slots__ = ("name", "kind", "help", "labelnames", "buckets",
-                 "_children", "_lock")
+                 "quantiles", "_children", "_lock")
 
     def __init__(self, name: str, kind: str, help_: str,
                  labelnames: Sequence[str], lock: threading.Lock,
-                 buckets: Optional[Sequence[float]] = None):
+                 buckets: Optional[Sequence[float]] = None,
+                 quantiles: Optional[Sequence[float]] = None):
         self.name = name
         self.kind = kind
         self.help = help_
         self.labelnames = tuple(labelnames)
         self.buckets = tuple(buckets) if buckets is not None else None
+        self.quantiles = (tuple(quantiles) if quantiles is not None
+                          else None)
         self._children: Dict[Tuple[str, ...], object] = {}
         self._lock = lock
 
@@ -148,6 +260,16 @@ class _Family:
         with self._lock:
             k = self._key(labels)
             st = self._children.get(k)
+            if self.kind == "summary":
+                if st is None:
+                    st = self._children[k] = {
+                        "p2": {q: P2Quantile(q) for q in self.quantiles},
+                        "sum": 0.0, "count": 0}
+                for p2 in st["p2"].values():
+                    p2.observe(float(value))
+                st["sum"] += float(value)
+                st["count"] += 1
+                return
             if st is None:
                 st = self._children[k] = {
                     "counts": [0] * (len(self.buckets) + 1),
@@ -177,6 +299,16 @@ class _Family:
                 samples.append((f"{self.name}_bucket", lb, st["count"]))
                 samples.append((f"{self.name}_sum", labels, st["sum"]))
                 samples.append((f"{self.name}_count", labels, st["count"]))
+            elif self.kind == "summary":
+                for q in self.quantiles:
+                    v = st["p2"][q].value()
+                    if v is None:
+                        continue
+                    lb = dict(labels)
+                    lb["quantile"] = _fmt_value(q)
+                    samples.append((self.name, lb, v))
+                samples.append((f"{self.name}_sum", labels, st["sum"]))
+                samples.append((f"{self.name}_count", labels, st["count"]))
             else:
                 samples.append((self.name, labels, st))
         return (self.name, self.kind, self.help, samples)
@@ -191,6 +323,11 @@ class _Family:
                                "buckets": dict(zip(
                                    [_fmt_value(b) for b in self.buckets]
                                    + ["+Inf"], st["counts"]))}
+            elif self.kind == "summary":
+                vals[label] = {"sum": st["sum"], "count": st["count"],
+                               "quantiles": {
+                                   _fmt_value(q): st["p2"][q].value()
+                                   for q in self.quantiles}}
             else:
                 vals[label] = st
         return {"kind": self.kind, "values": vals}
@@ -208,12 +345,13 @@ class Registry:
 
     def _family(self, name: str, kind: str, help_: str,
                 labelnames: Sequence[str],
-                buckets: Optional[Sequence[float]] = None) -> _Family:
+                buckets: Optional[Sequence[float]] = None,
+                quantiles: Optional[Sequence[float]] = None) -> _Family:
         with self._lock:
             fam = self._families.get(name)
             if fam is None:
                 fam = _Family(name, kind, help_, labelnames, self._lock,
-                              buckets)
+                              buckets, quantiles)
                 self._families[name] = fam
             elif fam.kind != kind:
                 raise ValueError(
@@ -234,6 +372,13 @@ class Registry:
                   buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS
                   ) -> _Family:
         return self._family(name, "histogram", help_, labelnames, buckets)
+
+    def summary(self, name: str, help_: str = "",
+                labelnames: Sequence[str] = (),
+                quantiles: Sequence[float] = DEFAULT_QUANTILES
+                ) -> _Family:
+        return self._family(name, "summary", help_, labelnames,
+                            quantiles=quantiles)
 
     def collect(self) -> List[Tuple]:
         """``(name, kind, help, samples)`` tuples for every family, in
@@ -282,9 +427,50 @@ def histogram(name: str, help_: str = "",
     return _REGISTRY.histogram(name, help_, labelnames, buckets)
 
 
+def summary(name: str, help_: str = "",
+            labelnames: Sequence[str] = (),
+            quantiles: Sequence[float] = DEFAULT_QUANTILES) -> _Family:
+    return _REGISTRY.summary(name, help_, labelnames, quantiles)
+
+
+# Callables run (guarded) at the top of every scrape so derived-metric
+# producers (SLO burn gauges, cost-model utilization gauges) refresh at
+# read time instead of on a poll timer.
+_COLLECT_HOOKS: List = []
+_HOOK_LOCK = threading.Lock()
+
+
+def register_collect_hook(fn) -> None:
+    """Run ``fn()`` before every :func:`format_prometheus` scrape.
+    Idempotent per callable; exceptions from hooks are swallowed."""
+    with _HOOK_LOCK:
+        if fn not in _COLLECT_HOOKS:
+            _COLLECT_HOOKS.append(fn)
+
+
+def unregister_collect_hook(fn) -> None:
+    with _HOOK_LOCK:
+        try:
+            _COLLECT_HOOKS.remove(fn)
+        except ValueError:
+            pass
+
+
+def _run_collect_hooks() -> None:
+    with _HOOK_LOCK:
+        hooks = list(_COLLECT_HOOKS)
+    for fn in hooks:
+        try:
+            fn()
+        except Exception:
+            pass
+
+
 def format_prometheus(reg: Optional[Registry] = None) -> str:
     """Text exposition of ``reg`` (default registry when omitted) — what
-    the HTTP exporter serves at ``/metrics``."""
+    the HTTP exporter serves at ``/metrics``.  Collect hooks run first so
+    derived families are fresh at scrape time."""
+    _run_collect_hooks()
     return format_exposition((reg or _REGISTRY).collect())
 
 
@@ -304,6 +490,9 @@ _SPAN_SUM_COUNTERS = (
      "Host/device boundary transfers per op."),
     ("padded_rows", "srj_tpu_pad_rows_total",
      "Shape-bucket pad waste (invalid tail rows) per op."),
+    ("padded_bytes", "srj_tpu_pad_bytes_total",
+     "Shape-bucket pad waste (bytes moved for invalid tail rows) "
+     "per op."),
 )
 
 
@@ -323,6 +512,9 @@ def _observe_span(ev: Dict) -> None:
         _REGISTRY.counter("srj_tpu_span_wall_seconds_total",
                           "Host wall seconds per op.",
                           ("op",)).inc(float(wall), op=op)
+        _REGISTRY.summary("srj_tpu_span_wall_seconds_quantile",
+                          "Streaming P2 wall-clock percentiles per op.",
+                          ("op",)).observe(float(wall), op=op)
     dev = ev.get("device_s")
     if isinstance(dev, (int, float)):
         _REGISTRY.histogram("srj_tpu_span_device_seconds",
@@ -355,6 +547,18 @@ def observe_event(ev: Dict) -> None:
         kind = ev.get("kind")
         if kind == "span":
             _observe_span(ev)
+            # feed the attribution layer (lazy imports: costmodel/slo
+            # import this module, so a top-level import would cycle)
+            try:
+                from . import costmodel as _cm
+                _cm.observe_span(ev)
+            except Exception:
+                pass
+            try:
+                from . import slo as _slo
+                _slo.observe_span(ev)
+            except Exception:
+                pass
         elif kind == "compile":
             _REGISTRY.counter("srj_tpu_xla_compiles_total",
                               "XLA backend compiles observed.").inc()

@@ -8,7 +8,10 @@ aggregates as a Prometheus text exposition (one scrape away from a real
 dashboard); ``--json`` dumps the raw summary dict.  ``--merge`` combines
 several per-host JSONL logs (a multihost run) into one stream before
 reporting/tracing; ``--bundle <dir>`` pretty-prints a failure
-flight-recorder bundle instead of reading a log.
+flight-recorder bundle instead of reading a log.  The ``profile``
+subcommand (``python -m spark_rapids_jni_tpu.obs profile <log>``) lives
+in :mod:`~spark_rapids_jni_tpu.obs.costmodel`: the roofline view of the
+same log — achieved GB/s vs the calibrated ceiling per (op, bucket).
 
 Pure stdlib on purpose: the report must load a log from a process that
 died (the whole point of failure capture), so it depends on nothing that

@@ -1,0 +1,431 @@
+"""Declarative SLOs with multi-window burn-rate evaluation.
+
+The metrics registry can say "p99 wall is 180 ms"; this module says
+whether that is *okay*.  An :class:`Objective` declares a target over a
+class of span events:
+
+- ``kind="latency"`` — "``target`` of ``op`` calls complete within
+  ``threshold`` seconds" (e.g. 99% of ``serve.request`` under 250 ms).
+- ``kind="error_rate"`` — "``target`` of ``op`` calls succeed".
+- ``kind="utilization"`` — "``target`` of ``op`` calls achieve at least
+  ``threshold`` % of the calibrated HBM ceiling" (the per-kernel
+  roofline floor, priced by :mod:`~spark_rapids_jni_tpu.obs.costmodel`).
+
+Evaluation is the SRE multi-window burn rate: each observation is good
+or bad; ``burn = bad_fraction / (1 - target)`` over a fast (default 60 s)
+and a slow (default 600 s) window, and the objective is **burning** when
+both exceed their thresholds (defaults 14.4 / 6 — the classic page-worthy
+pair).  State is a fixed ring of one-second buckets per objective — O(1)
+memory per event, and :func:`evaluate` takes an explicit ``now`` so tests
+drive time forward without sleeping.
+
+Surfacing:
+
+- ``/metrics`` — ``srj_tpu_slo_events_total{objective,outcome}`` fed per
+  observation, plus scrape-time gauges (collect hook)
+  ``srj_tpu_slo_burn_rate{objective,window}``,
+  ``srj_tpu_slo_burning{objective}``, ``srj_tpu_slo_target{objective}``.
+- ``/healthz`` — an ``slo`` sub-document (health provider) with the
+  per-objective verdicts, so load balancers see burn as backpressure.
+- Serve shedding — :func:`should_shed` is true while any objective with
+  ``shed_on_burn=True`` burns; the serve scheduler's submit path rejects
+  new work with ``reason="slo_burn"`` until it recovers.
+- Flight recorder — the first fast-burn transition of an objective dumps
+  ONE recorder bundle (``reason="slo_burn:<name>"``) when the recorder
+  is armed; recovery re-arms the objective for a future episode.
+
+Declarative bring-up: ``SRJ_TPU_SLO`` holds ``;``-separated objective
+specs of ``name,key=value,...`` pairs, e.g.::
+
+    SRJ_TPU_SLO="serve_p99,kind=latency,op=serve.request,target=0.99,threshold=0.25,shed=1;json_errors,kind=error_rate,op=get_json_object,target=0.999"
+
+Every entry point is guarded — observation and evaluation never raise.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from spark_rapids_jni_tpu.obs import metrics as _metrics
+
+__all__ = [
+    "Objective", "add", "remove", "clear", "objectives", "observe_span",
+    "evaluate", "should_shed", "healthz", "configure_from_env",
+    "DEFAULT_FAST_WINDOW_S", "DEFAULT_SLOW_WINDOW_S",
+    "DEFAULT_FAST_BURN", "DEFAULT_SLOW_BURN",
+]
+
+DEFAULT_FAST_WINDOW_S = 60
+DEFAULT_SLOW_WINDOW_S = 600
+DEFAULT_FAST_BURN = 14.4
+DEFAULT_SLOW_BURN = 6.0
+
+_KINDS = ("latency", "error_rate", "utilization")
+
+
+class Objective:
+    """One declarative objective.  ``target`` is the good fraction
+    (0 < target < 1); ``threshold`` is the per-kind cut: seconds for
+    ``latency``, ignored for ``error_rate``, a ``pct_of_calibration``
+    floor for ``utilization``.  ``op`` selects span events by exact
+    name."""
+
+    __slots__ = ("name", "kind", "op", "target", "threshold",
+                 "fast_window_s", "slow_window_s", "fast_burn",
+                 "slow_burn", "shed_on_burn")
+
+    def __init__(self, name: str, kind: str, op: str, target: float,
+                 threshold: float = 0.0,
+                 fast_window_s: int = DEFAULT_FAST_WINDOW_S,
+                 slow_window_s: int = DEFAULT_SLOW_WINDOW_S,
+                 fast_burn: float = DEFAULT_FAST_BURN,
+                 slow_burn: float = DEFAULT_SLOW_BURN,
+                 shed_on_burn: bool = False):
+        if kind not in _KINDS:
+            raise ValueError(f"kind must be one of {_KINDS}, got {kind!r}")
+        if not 0.0 < target < 1.0:
+            raise ValueError(f"target must be in (0, 1), got {target}")
+        if slow_window_s < fast_window_s:
+            raise ValueError("slow window must be >= fast window")
+        self.name = name
+        self.kind = kind
+        self.op = op
+        self.target = float(target)
+        self.threshold = float(threshold)
+        self.fast_window_s = int(fast_window_s)
+        self.slow_window_s = int(slow_window_s)
+        self.fast_burn = float(fast_burn)
+        self.slow_burn = float(slow_burn)
+        self.shed_on_burn = bool(shed_on_burn)
+
+    @property
+    def budget(self) -> float:
+        """The error budget: the bad fraction the target tolerates."""
+        return 1.0 - self.target
+
+
+class _Ring:
+    """Per-second good/bad buckets over the slow window: fixed memory,
+    O(1) record, O(window) count."""
+
+    __slots__ = ("size", "_epoch", "_good", "_bad")
+
+    def __init__(self, size: int):
+        self.size = max(1, int(size))
+        self._epoch = [-1] * self.size
+        self._good = [0] * self.size
+        self._bad = [0] * self.size
+
+    def record(self, ts: float, bad: bool) -> None:
+        s = int(ts)
+        i = s % self.size
+        if self._epoch[i] != s:
+            self._epoch[i] = s
+            self._good[i] = 0
+            self._bad[i] = 0
+        if bad:
+            self._bad[i] += 1
+        else:
+            self._good[i] += 1
+
+    def counts(self, now: float, window_s: int):
+        """(good, bad) over the ``window_s`` seconds ending at ``now``."""
+        end = int(now)
+        good = bad = 0
+        for s in range(end - min(window_s, self.size) + 1, end + 1):
+            i = s % self.size
+            if self._epoch[i] == s:
+                good += self._good[i]
+                bad += self._bad[i]
+        return good, bad
+
+
+class _State:
+    __slots__ = ("obj", "ring", "burning", "bundle_dumped", "episode")
+
+    def __init__(self, obj: Objective):
+        self.obj = obj
+        self.ring = _Ring(obj.slow_window_s)
+        self.burning = False
+        self.bundle_dumped = False
+        self.episode = 0    # counts transitions into burning
+
+
+_LOCK = threading.Lock()
+_STATES: Dict[str, _State] = {}
+_HOOK_INSTALLED = False
+
+
+def _ensure_surfaces() -> None:
+    """Install the scrape hook and the /healthz provider (idempotent,
+    lazy: nothing registers until the first objective exists)."""
+    global _HOOK_INSTALLED
+    if _HOOK_INSTALLED:
+        return
+    _HOOK_INSTALLED = True
+    _metrics.register_collect_hook(_publish_gauges)
+    try:
+        from spark_rapids_jni_tpu.obs import exporter as _exporter
+        _exporter.register_health_provider("slo", healthz)
+    except Exception:
+        pass
+
+
+def add(obj: Objective) -> Objective:
+    """Register (or replace, by name) an objective."""
+    with _LOCK:
+        _STATES[obj.name] = _State(obj)
+    _ensure_surfaces()
+    return obj
+
+
+def remove(name: str) -> None:
+    with _LOCK:
+        _STATES.pop(name, None)
+
+
+def clear() -> None:
+    with _LOCK:
+        _STATES.clear()
+
+
+def objectives() -> List[Objective]:
+    with _LOCK:
+        return [st.obj for st in _STATES.values()]
+
+
+# ---------------------------------------------------------------------------
+# Observation
+# ---------------------------------------------------------------------------
+
+def _classify(obj: Objective, ev: Dict) -> Optional[bool]:
+    """``True`` = bad, ``False`` = good, ``None`` = not this objective's
+    event."""
+    if str(ev.get("name", "")) != obj.op:
+        return None
+    if obj.kind == "error_rate":
+        return ev.get("status") == "error"
+    if obj.kind == "latency":
+        w = ev.get("wall_s")
+        if not isinstance(w, (int, float)):
+            return None
+        return float(w) > obj.threshold
+    # utilization: needs bytes + a clock to derive achieved GB/s
+    nb = ev.get("bytes")
+    t = ev.get("device_s")
+    if not isinstance(t, (int, float)) or t <= 0:
+        t = ev.get("wall_s")
+    if not isinstance(nb, (int, float)) or nb <= 0 or \
+            not isinstance(t, (int, float)) or t <= 0:
+        return None
+    try:
+        from spark_rapids_jni_tpu.obs import costmodel as _cm
+        ceiling = _cm.ceiling_GBps()[0]
+    except Exception:
+        return None
+    if ceiling <= 0:
+        return None
+    pct = 100.0 * (float(nb) / float(t) / 1e9) / ceiling
+    return pct < obj.threshold
+
+
+def observe_span(ev: Dict) -> None:
+    """Fold one finished span into every matching objective's window
+    (called from ``metrics.observe_event``).  Never raises."""
+    try:
+        if ev.get("kind") != "span":
+            return
+        with _LOCK:
+            states = list(_STATES.values())
+        if not states:
+            return
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)):
+            ts = time.time()
+        for st in states:
+            bad = _classify(st.obj, ev)
+            if bad is None:
+                continue
+            with _LOCK:
+                st.ring.record(ts, bad)
+            _metrics.counter(
+                "srj_tpu_slo_events_total",
+                "Observations classified per objective.",
+                ("objective", "outcome")).inc(
+                    objective=st.obj.name,
+                    outcome="bad" if bad else "good")
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Evaluation
+# ---------------------------------------------------------------------------
+
+def _burn(good: int, bad: int, budget: float) -> float:
+    n = good + bad
+    if n == 0 or budget <= 0:
+        return 0.0
+    return (bad / n) / budget
+
+
+def _eval_state(st: _State, now: float) -> Dict:
+    obj = st.obj
+    with _LOCK:
+        fg, fb = st.ring.counts(now, obj.fast_window_s)
+        sg, sb = st.ring.counts(now, obj.slow_window_s)
+    fast = _burn(fg, fb, obj.budget)
+    slow = _burn(sg, sb, obj.budget)
+    burning = fast >= obj.fast_burn and slow >= obj.slow_burn
+    return {"name": obj.name, "kind": obj.kind, "op": obj.op,
+            "target": obj.target, "threshold": obj.threshold,
+            "burning": burning,
+            "fast_burn": fast, "slow_burn": slow,
+            "fast_good": fg, "fast_bad": fb,
+            "slow_good": sg, "slow_bad": sb,
+            "shed_on_burn": obj.shed_on_burn}
+
+
+def _on_transition(st: _State, doc: Dict) -> None:
+    """Track burning transitions: count them, and arm exactly one
+    flight-recorder bundle per burn episode."""
+    if doc["burning"] and not st.burning:
+        st.burning = True
+        st.episode += 1
+        _metrics.counter("srj_tpu_slo_burn_transitions_total",
+                         "Objective transitions into burning.",
+                         ("objective",)).inc(objective=st.obj.name)
+        if not st.bundle_dumped:
+            st.bundle_dumped = True
+            try:
+                from spark_rapids_jni_tpu.obs import recorder as _rec
+                if _rec.armed():
+                    # the episode counter keys past the recorder's
+                    # (reason, name) dedupe: each burn EPISODE gets its
+                    # own bundle, re-burns within one episode do not
+                    reason = f"slo_burn:{st.obj.name}"
+                    if st.episode > 1:
+                        reason += f"-ep{st.episode}"
+                    _rec.dump_bundle(
+                        reason,
+                        {"kind": "slo", "name": st.obj.name,
+                         "op": st.obj.op, "episode": st.episode,
+                         "fast_burn": doc["fast_burn"],
+                         "slow_burn": doc["slow_burn"]})
+            except Exception:
+                pass
+    elif not doc["burning"] and st.burning:
+        st.burning = False
+        st.bundle_dumped = False  # recovered: re-arm for a new episode
+
+
+def evaluate(now: Optional[float] = None) -> List[Dict]:
+    """Evaluate every objective at ``now`` (wall clock when omitted);
+    returns the per-objective verdict documents and drives the
+    burning-transition side effects (counter, recorder)."""
+    t = time.time() if now is None else float(now)
+    with _LOCK:
+        states = list(_STATES.values())
+    out = []
+    for st in states:
+        try:
+            doc = _eval_state(st, t)
+            _on_transition(st, doc)
+            out.append(doc)
+        except Exception:
+            pass
+    return out
+
+
+def should_shed(now: Optional[float] = None) -> Optional[str]:
+    """The name of a burning ``shed_on_burn`` objective, or ``None`` —
+    the serve submit path's one-call backpressure check."""
+    for doc in evaluate(now):
+        if doc["burning"] and doc["shed_on_burn"]:
+            return doc["name"]
+    return None
+
+
+def healthz(now: Optional[float] = None) -> Dict:
+    """The ``slo`` sub-document for ``/healthz``: overall status plus
+    per-objective verdicts."""
+    docs = evaluate(now)
+    burning = [d["name"] for d in docs if d["burning"]]
+    return {
+        "status": "burning" if burning else "ok",
+        "burning": burning,
+        "objectives": {
+            d["name"]: {
+                "kind": d["kind"], "op": d["op"], "target": d["target"],
+                "burning": d["burning"],
+                "fast_burn": round(d["fast_burn"], 3),
+                "slow_burn": round(d["slow_burn"], 3),
+            } for d in docs},
+    }
+
+
+def _publish_gauges() -> None:
+    """Collect hook: refresh the burn gauges right before a scrape."""
+    try:
+        burn = _metrics.gauge("srj_tpu_slo_burn_rate",
+                              "Error-budget burn rate per objective and "
+                              "window.", ("objective", "window"))
+        burning = _metrics.gauge("srj_tpu_slo_burning",
+                                 "1 while the objective's fast AND slow "
+                                 "windows both exceed their burn "
+                                 "thresholds.", ("objective",))
+        target = _metrics.gauge("srj_tpu_slo_target",
+                                "Declared good-fraction target per "
+                                "objective.", ("objective",))
+        for d in evaluate():
+            burn.set(d["fast_burn"], objective=d["name"], window="fast")
+            burn.set(d["slow_burn"], objective=d["name"], window="slow")
+            burning.set(1 if d["burning"] else 0, objective=d["name"])
+            target.set(d["target"], objective=d["name"])
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Declarative env bring-up
+# ---------------------------------------------------------------------------
+
+def configure_from_env(spec: Optional[str] = None) -> List[Objective]:
+    """Parse ``SRJ_TPU_SLO`` (or ``spec``) into objectives and register
+    them.  Malformed entries are skipped — a typo in an env var must not
+    take down the workload being observed."""
+    raw = os.environ.get("SRJ_TPU_SLO", "") if spec is None else spec
+    added = []
+    for entry in raw.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        try:
+            parts = [p.strip() for p in entry.split(",") if p.strip()]
+            name = parts[0]
+            kw: Dict = {}
+            for p in parts[1:]:
+                k, _, v = p.partition("=")
+                k = k.strip()
+                v = v.strip()
+                if k in ("kind", "op"):
+                    kw[k] = v
+                elif k in ("target", "threshold", "fast_burn",
+                           "slow_burn"):
+                    kw[k] = float(v)
+                elif k in ("fast_window_s", "slow_window_s"):
+                    kw[k] = int(float(v))
+                elif k == "shed":
+                    kw["shed_on_burn"] = v.lower() in ("1", "true",
+                                                       "yes", "on")
+            added.append(add(Objective(name, **kw)))
+        except Exception:
+            continue
+    return added
+
+
+if os.environ.get("SRJ_TPU_SLO"):
+    configure_from_env()

@@ -35,7 +35,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from spark_rapids_jni_tpu.table import Column, STRING, pack_bools
+from spark_rapids_jni_tpu.table import (
+    Column, STRING, pack_bools, column_nbytes,
+)
 from spark_rapids_jni_tpu.utils.tracing import func_range
 from spark_rapids_jni_tpu.obs import span_fn
 from spark_rapids_jni_tpu.runtime import shapes
@@ -384,7 +386,8 @@ def _scan_automaton(ch: jnp.ndarray, segs: Tuple,
 
 @span_fn(name="get_json_object",
          attrs=lambda col, path, *a, **k: {"rows": col.num_rows,
-                                           "path": path})
+                                           "path": path,
+                                           "bytes": column_nbytes(col)})
 @func_range()
 def get_json_object(col: Column, path: str,
                     max_str_len: Optional[int] = None, *,

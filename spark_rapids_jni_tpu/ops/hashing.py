@@ -34,7 +34,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_jni_tpu.table import (
-    Column, Table, bytes2d_to_words as _bytes_to_u32_lanes,
+    Column, Table, column_nbytes,
+    bytes2d_to_words as _bytes_to_u32_lanes,
 )
 from spark_rapids_jni_tpu.obs import span_fn
 from spark_rapids_jni_tpu.runtime import shapes
@@ -44,7 +45,11 @@ from spark_rapids_jni_tpu.utils import tracing
 def _hash_attrs(table_or_cols, *args, **kwargs):
     cols = (table_or_cols.columns if isinstance(table_or_cols, Table)
             else tuple(table_or_cols))
-    return {"rows": cols[0].num_rows} if cols else {}
+    if not cols:
+        return {}
+    # input payload bytes feed the roofline cost model's achieved-GB/s
+    return {"rows": cols[0].num_rows,
+            "bytes": sum(column_nbytes(c) for c in cols)}
 
 # np (not jnp) scalars: module import must never create a device array —
 # an eager jnp constant here dispatches to the default backend at import

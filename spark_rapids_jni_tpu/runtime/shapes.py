@@ -275,10 +275,19 @@ def unpad_result(out, n: int):
 def note(n: int, b: int) -> None:
     """Stamp ``bucket`` / ``padded_rows`` on the innermost active span
     (the operator's own span when called from an op body) so the report
-    CLI shows padding overhead next to compile counts."""
+    CLI shows padding overhead next to compile counts.  When the span
+    already carries a ``bytes`` attribute (the op extractors set it
+    before padding), the padded tail's byte cost is derived too
+    (``padded_bytes`` — rows are uniform, so tail bytes scale linearly),
+    which is what prices pad waste in the cost model's roofline and the
+    ``srj_tpu_pad_bytes_total`` family."""
     sp = spans.current_span()
     if sp is not None:
-        sp.set(bucket=b, padded_rows=b - n)
+        attrs = {"bucket": b, "padded_rows": b - n}
+        nb = sp.attrs.get("bytes")
+        if isinstance(nb, (int, float)) and nb > 0 and n > 0 and b > n:
+            attrs["padded_bytes"] = int(nb * (b - n) / n)
+        sp.set(**attrs)
 
 
 def pad_span():

@@ -24,9 +24,17 @@ Metrics (``srj_tpu_serve_*`` families, see :mod:`obs.metrics`): requests
 ``_overflow`` — the documented cardinality cap; the scheduler tracks at
 most ``max_tenants`` ids, so a tenant-id flood cannot grow its memory);
 queue/exec latency histograms and batch/coalescing counters are per-op;
-depth, shed state and tenant count are gauges.  The scheduler also
-registers an ``obs.exporter`` health provider, so ``/healthz`` reports
-queue depth and shed state for load-balancer backpressure.
+depth, shed state and tenant count are gauges.  Each resolved request
+also feeds a per-tenant P2 latency summary
+(``srj_tpu_serve_request_seconds_quantile``) and each executed group
+charges its tenants' cost ledgers (``srj_tpu_tenant_cost_*`` via
+:func:`obs.costmodel.charge_tenant`: exec-seconds split by rows, payload
+bytes, pad-row waste) — both under the same tenant-label cap.  The
+scheduler also registers an ``obs.exporter`` health provider, so
+``/healthz`` reports queue depth and shed state for load-balancer
+backpressure; when an :mod:`obs.slo` objective with ``shed_on_burn`` is
+burning, :meth:`submit` rejects with ``QueueFull(reason="slo_burn")``
+until the burn clears.
 
 Futures follow the executor protocol: the tick claims each request via
 ``Future.set_running_or_notify_cancel()`` before dispatch, so a client
@@ -252,7 +260,21 @@ class Scheduler:
     def submit(self, tenant: str, op: str, **kwargs
                ) -> "concurrent.futures.Future":
         """Validate and enqueue one query; raises :class:`QueueFull` on
-        admission rejection, ``ValueError`` on a malformed payload."""
+        admission rejection (including ``reason="slo_burn"`` while a
+        shed-enabled SLO objective burns), ``ValueError`` on a malformed
+        payload."""
+        # SLO backpressure: while a shed_on_burn objective is burning,
+        # reject before validation — the cheapest possible path out
+        try:
+            from spark_rapids_jni_tpu.obs import slo as _slo
+            burning = _slo.should_shed()
+        except Exception:
+            burning = None
+        if burning is not None:
+            e = QueueFull("slo_burn", self.queue.depth,
+                          self.config.max_depth)
+            self._m["rejected"].inc(reason=e.reason)
+            raise e
         opdef = serve_ops.get(op)
         payload, sig, rows, nbytes = opdef.validate(dict(kwargs))
         fut: concurrent.futures.Future = concurrent.futures.Future()
@@ -383,8 +405,33 @@ class Scheduler:
                         self._m["failures"].inc(
                             tenant=self._tenant_label(r.tenant), op=op)
                         self._finish_request(r, "error", err=e)
-        self._m["exec_s"].observe(time.perf_counter() - t0, op=op)
+        exec_s = time.perf_counter() - t0
+        self._m["exec_s"].observe(exec_s, op=op)
+        self._charge(live, exec_s)
         return len(reqs)
+
+    def _charge(self, live: List[Request], exec_s: float) -> None:
+        """Tenant chargeback for one executed group: the group's
+        exec-seconds are split across its requests proportional to rows
+        (the slot a request occupies is what it "buys"), HBM bytes are
+        the request's own payload bytes, and pad-row waste is the
+        request's row-bucket remainder.  Dead batch slots (the group
+        bucket minus live requests) belong to the operator, not a
+        tenant, and are already visible in the batch span."""
+        if not live:
+            return
+        try:
+            from spark_rapids_jni_tpu.obs import costmodel as _costmodel
+            total_rows = sum(max(r.rows, 1) for r in live)
+            for r in live:
+                share = exec_s * max(r.rows, 1) / total_rows
+                pad = (max(0, shapes.bucket_rows(r.rows) - r.rows)
+                       if r.rows > 0 else 0)
+                _costmodel.charge_tenant(
+                    self._tenant_label(r.tenant), device_s=share,
+                    hbm_bytes=r.nbytes, pad_rows=pad)
+        except Exception:   # noqa: BLE001 — chargeback must not fail a tick
+            pass
 
     def _finish_request(self, r: Request, status: str,
                         err: Optional[BaseException] = None) -> None:
@@ -393,10 +440,21 @@ class Scheduler:
         and carries the request's trace/span ids, which the coalesced
         batch span links back to — together they are the request→batch
         edge in the exported trace."""
+        wall = time.perf_counter() - r.t_submit
+        # per-tenant latency digest (P2 summary, capped label space):
+        # recorded for every resolved request, spans on or off
+        try:
+            _metrics.summary(
+                "srj_tpu_serve_request_seconds_quantile",
+                "Streaming P2 percentiles of submit-to-resolution "
+                "latency, by tenant (capped).", ("tenant",)).observe(
+                    wall, tenant=self._tenant_label(r.tenant))
+        except Exception:   # noqa: BLE001 — telemetry must not fail a tick
+            pass
         if r.trace is None or not _spans.recording():
             return
         ev = {"kind": "span", "name": "serve.request", "status": status,
-              "wall_s": time.perf_counter() - r.t_submit, "depth": 0,
+              "wall_s": wall, "depth": 0,
               "thread": f"tenant:{self._tenant_label(r.tenant)}",
               "op": r.op, "tenant": r.tenant, "rows": r.rows,
               "trace_id": r.trace.trace_id, "span_id": r.trace.span_id}

@@ -794,6 +794,24 @@ def string_tail(col: "Column") -> Optional[StringTail]:
     return getattr(col, "_string_tail", None)
 
 
+def column_nbytes(col: "Column") -> int:
+    """Payload bytes a kernel reads from ``col``: the fixed-width data
+    planes, or (strings) whichever char buffer is materialized — the
+    numerator of the cost model's achieved-GB/s.  Dense-padded wins over
+    Arrow when both exist so a column is never double-counted.  Works on
+    tracers too (shapes are static), returns 0 for anything unsized."""
+    if col.dtype.is_string:
+        buf = col.chars2d if col.chars2d is not None else col.chars
+    else:
+        buf = col.data
+    if buf is None or not hasattr(buf, "size"):
+        return 0
+    try:
+        return int(buf.size) * int(np.dtype(buf.dtype).itemsize)
+    except (TypeError, ValueError):
+        return 0
+
+
 def _require_string_tail(col: "Column", lens: np.ndarray, W: int):
     """Tail dict for boundary consumers; raises when rows exceed the
     padded width but the tail is missing (lost through a reconstruction

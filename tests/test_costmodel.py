@@ -1,0 +1,379 @@
+"""Cost-attribution tests: P² streaming quantiles against
+``numpy.percentile`` on adversarial distributions, the calibration
+registry (persist / freshness / ceiling provenance), the attribution
+ledger's roofline derivations, tenant chargeback under the cardinality
+cap, and the ``obs profile`` CLI round trip."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import obs, serve
+from spark_rapids_jni_tpu.obs import costmodel, metrics
+from spark_rapids_jni_tpu.serve.scheduler import OVERFLOW_TENANT
+
+
+@pytest.fixture
+def cm(tmp_path, monkeypatch):
+    """Isolated cost-model state: calibration file under tmp_path, fresh
+    ledger / tenant cache / metric registry on both sides."""
+    monkeypatch.setenv("SRJ_TPU_CALIBRATION_FILE",
+                       str(tmp_path / "CALIBRATION.json"))
+    monkeypatch.delenv("SRJ_TPU_CALIBRATION_MAX_AGE_S", raising=False)
+    costmodel.reset()
+    metrics.registry().reset()
+    yield tmp_path
+    costmodel.reset()
+    metrics.registry().reset()
+
+
+# ---------------------------------------------------------------------------
+# P² streaming quantiles vs numpy.percentile
+# ---------------------------------------------------------------------------
+
+def _dist(name, n, rng):
+    if name == "sorted":
+        return np.arange(n, dtype=float)
+    if name == "reversed":
+        return np.arange(n, dtype=float)[::-1].copy()
+    if name == "bimodal":
+        out = np.concatenate([rng.normal(0.0, 1.0, n // 2),
+                              rng.normal(100.0, 1.0, n - n // 2)])
+        rng.shuffle(out)
+        return out
+    if name == "lognormal":
+        return rng.lognormal(0.0, 2.0, n)
+    return rng.uniform(0.0, 1.0, n)
+
+
+@pytest.mark.parametrize("q", [0.5, 0.9, 0.99])
+@pytest.mark.parametrize(
+    "dist", ["sorted", "reversed", "bimodal", "lognormal", "uniform"])
+def test_p2_rank_error_vs_numpy(dist, q, rng):
+    """The estimate's empirical CDF rank stays within 1% of the target
+    quantile — the property that matters for a percentile display, and
+    one that stays meaningful on plateaued distributions (a bimodal
+    median may lie anywhere in the inter-mode gap; its *rank* is still
+    exactly 0.5)."""
+    data = _dist(dist, 20000, rng)
+    p2 = metrics.P2Quantile(q)
+    for x in data:
+        p2.observe(x)
+    est = p2.value()
+    assert est is not None
+    rank = float(np.mean(data <= est))
+    assert abs(rank - q) <= 0.01, (dist, q, est, rank)
+    assert p2.count == len(data)
+
+
+def test_p2_constant_stream_is_exact():
+    for q in (0.5, 0.9, 0.99):
+        p2 = metrics.P2Quantile(q)
+        for _ in range(1000):
+            p2.observe(3.25)
+        assert p2.value() == 3.25
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4])
+def test_p2_small_samples_exact_nearest_rank(n, rng):
+    """Below five observations the bootstrap buffer serves the exact
+    nearest-rank answer — tiny streams are never extrapolated."""
+    data = rng.uniform(0.0, 10.0, n)
+    for q in (0.5, 0.9, 0.99):
+        p2 = metrics.P2Quantile(q)
+        for x in data:
+            p2.observe(float(x))
+        vals = np.sort(data)
+        expect = vals[min(n - 1, max(0, round(q * (n - 1))))]
+        assert p2.value() == pytest.approx(float(expect))
+
+
+def test_p2_empty_and_validation():
+    assert metrics.P2Quantile(0.5).value() is None
+    for bad in (0.0, 1.0, -0.1, 1.5):
+        with pytest.raises(ValueError):
+            metrics.P2Quantile(bad)
+
+
+def test_summary_family_exposition_and_snapshot(cm):
+    s = metrics.summary("test_req_seconds", "test", ("op",))
+    for i in range(100):
+        s.observe(i / 100.0, op="agg")
+    text = metrics.format_prometheus()
+    assert 'test_req_seconds{op="agg",quantile="0.5"}' in text
+    assert 'test_req_seconds{op="agg",quantile="0.99"}' in text
+    assert 'test_req_seconds_count{op="agg"} 100' in text
+    snap = metrics.registry().snapshot()["test_req_seconds"]
+    assert snap["kind"] == "summary"
+    cell = snap["values"]["op=agg"]
+    assert cell["count"] == 100
+    assert cell["sum"] == pytest.approx(sum(i / 100.0 for i in range(100)))
+    assert cell["quantiles"]["0.5"] == pytest.approx(0.5, abs=0.05)
+
+
+def test_span_wall_quantile_family_fed_from_spans(cm):
+    for w in (0.01, 0.02, 0.03):
+        metrics.observe_event({"kind": "span", "name": "xxhash64",
+                               "status": "ok", "wall_s": w})
+    snap = metrics.registry().snapshot()
+    cell = snap["srj_tpu_span_wall_seconds_quantile"]["values"]["op=xxhash64"]
+    assert cell["count"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Calibration registry
+# ---------------------------------------------------------------------------
+
+def test_calibration_roundtrip_and_ceiling_provenance(cm):
+    p = costmodel.save_calibration(
+        {"hbm_GBps": 512.0, "h2d_GBps": 30.0, "junk": -1})
+    assert p == str(cm / "CALIBRATION.json")
+    doc = costmodel.load_calibration()
+    assert doc["hbm_GBps"] == 512.0
+    assert doc["h2d_GBps"] == 30.0
+    assert "junk" not in doc
+    assert costmodel.calibration_fresh()
+    assert costmodel.ceiling_GBps() == (512.0, "file")
+    # persisting anew invalidates the cached ceiling
+    costmodel.save_calibration({"hbm_GBps": 640.0})
+    assert costmodel.ceiling_GBps() == (640.0, "file")
+
+
+def test_calibration_staleness_window(cm):
+    old = time.time() - 7 * 86400
+    assert costmodel.save_calibration({"hbm_GBps": 512.0}, now=old)
+    assert costmodel.load_calibration() is None      # default 24h window
+    assert not costmodel.calibration_fresh()
+    assert costmodel.load_calibration(max_age=0) is not None  # 0 = no cap
+    g, source = costmodel.ceiling_GBps()
+    assert source in ("micro", "default") and g > 0
+
+
+def test_calibration_malformed_and_missing(cm):
+    assert costmodel.load_calibration() is None       # missing
+    (cm / "CALIBRATION.json").write_text("not json{")
+    assert costmodel.load_calibration() is None       # malformed
+    (cm / "CALIBRATION.json").write_text('{"hbm_GBps": "fast"}')
+    assert costmodel.load_calibration() is None       # wrong type
+    assert costmodel.save_calibration({"h2d_GBps": 1.0}) is None  # no hbm
+
+
+# ---------------------------------------------------------------------------
+# Attribution ledger
+# ---------------------------------------------------------------------------
+
+def _span(op, bucket="", **kw):
+    ev = {"kind": "span", "name": op, "status": "ok", "bucket": bucket}
+    ev.update(kw)
+    return ev
+
+
+def test_ledger_roofline_derivations(cm):
+    led = costmodel.Ledger()
+    led.observe(_span("xxhash64", bucket=8192, wall_s=0.2, device_s=0.1,
+                      bytes=1e9, rows=900, padded_rows=100,
+                      compiles=1, compile_s=0.05))
+    led.observe(_span("xxhash64", bucket=8192, wall_s=0.2, device_s=0.1,
+                      bytes=1e9, rows=900, padded_rows=100))
+    (row,) = led.profile(ceiling=100.0)
+    assert row["op"] == "xxhash64" and row["bucket"] == "8192"
+    assert row["calls"] == 2 and row["errors"] == 0
+    assert row["time_base"] == "device"
+    assert row["achieved_GBps"] == pytest.approx(2e9 / 0.2 / 1e9)  # 10 GB/s
+    assert row["pct_of_calibration"] == pytest.approx(10.0)
+    assert row["bytes_per_device_s"] == pytest.approx(1e10)
+    assert row["pad_waste_pct"] == pytest.approx(10.0)
+    assert row["compile_amortization"] == pytest.approx(0.05 / 0.4)
+
+
+def test_ledger_wall_fallback_and_errors(cm):
+    led = costmodel.Ledger()
+    led.observe(_span("get_json_object", wall_s=0.5, bytes=5e8))
+    led.observe(_span("get_json_object", wall_s=0.5, status="error"))
+    led.observe({"kind": "fault", "name": "ignored"})   # non-span: dropped
+    (row,) = led.profile(ceiling=100.0)
+    assert row["time_base"] == "wall"
+    assert row["achieved_GBps"] == pytest.approx(0.5)   # 5e8 B over 1.0 s
+    assert row["errors"] == 1 and row["calls"] == 2
+
+
+def test_ledger_hotspot_order_and_topk(cm):
+    led = costmodel.Ledger()
+    for op, dev in (("a", 0.01), ("b", 0.5), ("c", 0.1)):
+        led.observe(_span(op, device_s=dev, bytes=1))
+    assert [r["op"] for r in led.profile(ceiling=1.0)] == ["b", "c", "a"]
+    assert [r["op"] for r in led.hotspots(2, ceiling=1.0)] == ["b", "c"]
+
+
+def test_replay_matches_live_feed(cm):
+    events = [_span("a", bucket=8, device_s=0.1, bytes=1e6, rows=10)
+              for _ in range(3)]
+    led = costmodel.Ledger()
+    for ev in events:
+        led.observe(ev)
+    assert costmodel.replay(events).profile(ceiling=10.0) == \
+        led.profile(ceiling=10.0)
+
+
+def test_observe_span_feeds_default_ledger_and_gauges(cm):
+    costmodel.save_calibration({"hbm_GBps": 100.0})
+    metrics.observe_event(_span("xxhash64", bucket=4096, wall_s=0.2,
+                                device_s=0.1, bytes=1e9))
+    rows = costmodel.ledger().profile()
+    assert any(r["op"] == "xxhash64" for r in rows)
+    text = metrics.format_prometheus()  # collect hook fires here
+    assert 'srj_tpu_costmodel_achieved_gbps{op="xxhash64",bucket="4096"}' \
+        in text
+    assert "srj_tpu_costmodel_pct_of_calibration" in text
+    assert "srj_tpu_costmodel_ceiling_gbps 100" in text
+
+
+# ---------------------------------------------------------------------------
+# Tenant chargeback under the cardinality cap
+# ---------------------------------------------------------------------------
+
+def test_charge_tenant_families_and_cap(cm, monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_SERVE_MAX_TENANTS", "2")
+    costmodel.reset()
+    for i in range(4):
+        costmodel.charge_tenant(f"tenant-{i}", device_s=1.0,
+                                hbm_bytes=100.0, pad_rows=5.0)
+    for fam in ("srj_tpu_tenant_cost_device_seconds_total",
+                "srj_tpu_tenant_cost_hbm_bytes_total",
+                "srj_tpu_tenant_cost_pad_rows_total"):
+        vals = metrics.registry().snapshot()[fam]["values"]
+        assert set(vals) == {"tenant=tenant-0", "tenant=tenant-1",
+                             f"tenant={OVERFLOW_TENANT}"}, fam
+        assert vals[f"tenant={OVERFLOW_TENANT}"] == pytest.approx(
+            2 * {"srj_tpu_tenant_cost_device_seconds_total": 1.0,
+                 "srj_tpu_tenant_cost_hbm_bytes_total": 100.0,
+                 "srj_tpu_tenant_cost_pad_rows_total": 5.0}[fam])
+
+
+def test_tenant_stamped_span_charges_chargeback(cm):
+    metrics.observe_event(_span("serve.exec", tenant="acme",
+                                device_s=0.25, bytes=1e6, padded_rows=7))
+    snap = metrics.registry().snapshot()
+    assert snap["srj_tpu_tenant_cost_device_seconds_total"]["values"][
+        "tenant=acme"] == pytest.approx(0.25)
+    assert snap["srj_tpu_tenant_cost_pad_rows_total"]["values"][
+        "tenant=acme"] == pytest.approx(7.0)
+
+
+def test_scheduler_chargeback_and_quantiles_respect_cap(cm, monkeypatch):
+    """End-to-end satellite: the serve scheduler's per-request chargeback
+    and latency digests fold past-cap tenants into ``_overflow``."""
+    monkeypatch.setenv("SRJ_TPU_SERVE_MAX_TENANTS", "2")
+    costmodel.reset()
+    rng = np.random.default_rng(11)
+    s = serve.Scheduler(serve.Config(max_tenants=2))
+    try:
+        futs = []
+        for i in range(4):
+            c = serve.Client(s, f"tenant-{i}")
+            futs.append(c.aggregate(
+                rng.integers(0, 4, 9).astype(np.int32),
+                rng.integers(-3, 3, 9).astype(np.int32)))
+        s.tick()
+        for f in futs:
+            f.result(timeout=30)
+    finally:
+        s.close()
+    snap = metrics.registry().snapshot()
+    cost = snap["srj_tpu_tenant_cost_device_seconds_total"]["values"]
+    assert set(cost) == {"tenant=tenant-0", "tenant=tenant-1",
+                         f"tenant={OVERFLOW_TENANT}"}
+    assert all(v > 0 for v in cost.values())
+    lat = snap["srj_tpu_serve_request_seconds_quantile"]["values"]
+    assert set(lat) == set(cost)
+    assert all(cell["count"] >= 1 for cell in lat.values())
+    assert snap["srj_tpu_tenant_cost_hbm_bytes_total"]["values"][
+        f"tenant={OVERFLOW_TENANT}"] > 0
+
+
+# ---------------------------------------------------------------------------
+# obs profile CLI
+# ---------------------------------------------------------------------------
+
+def _write_events(path, events):
+    with open(path, "w") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+        f.write("torn{line\n")   # a crashed writer must not kill the CLI
+
+
+def test_profile_cli_json_and_baseline(cm, capsys):
+    costmodel.save_calibration({"hbm_GBps": 200.0})
+    log = cm / "events.jsonl"
+    _write_events(log, [
+        _span("xxhash64", bucket=8192, wall_s=0.2, device_s=0.1,
+              bytes=2e9, rows=1000),
+        _span("from_rows", bucket=8192, wall_s=0.1, device_s=0.05,
+              bytes=1e9, rows=1000),
+        {"kind": "compile", "name": "ignored"},
+    ])
+    assert costmodel.profile_main([str(log), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ceiling_GBps"] == 200.0 and doc["source"] == "file"
+    by_op = {r["op"]: r for r in doc["rows"]}
+    assert by_op["xxhash64"]["achieved_GBps"] == pytest.approx(20.0)
+    assert by_op["xxhash64"]["pct_of_calibration"] == pytest.approx(10.0)
+    assert by_op["from_rows"]["pct_of_calibration"] == pytest.approx(10.0)
+    # table view diffs against a previous --json dump
+    base = cm / "base.json"
+    base.write_text(json.dumps(doc))
+    assert costmodel.profile_main([str(log), "--baseline", str(base)]) == 0
+    out = capsys.readouterr().out
+    assert "Δpct" in out and "xxhash64@8192" in out
+
+
+def test_profile_cli_top_k(cm, capsys):
+    log = cm / "events.jsonl"
+    _write_events(log, [_span(op, device_s=d, bytes=1)
+                        for op, d in (("a", 0.01), ("b", 0.5), ("c", 0.1))])
+    assert costmodel.profile_main(
+        [str(log), "--json", "--top", "1"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert [r["op"] for r in doc["rows"]] == ["b"]
+
+
+def test_profile_cli_empty_and_missing(cm, capsys):
+    log = cm / "empty.jsonl"
+    _write_events(log, [{"kind": "compile", "name": "no-spans"}])
+    assert costmodel.profile_main([str(log)]) == 1   # no rows -> nonzero
+    capsys.readouterr()
+    assert costmodel.profile_main([str(cm / "nope.jsonl")]) == 2
+
+
+def test_profile_cli_runs_from_live_span_log(cm, tmp_path, capsys):
+    """The full loop the README documents: record real op spans to JSONL,
+    then replay them through ``obs profile`` — every op that ran shows an
+    achieved-vs-ceiling row."""
+    import jax
+    from spark_rapids_jni_tpu import Column, INT64
+    from spark_rapids_jni_tpu.ops.hashing import xxhash64
+
+    costmodel.save_calibration({"hbm_GBps": 100.0})
+    log = tmp_path / "live.jsonl"
+    obs.configure_sink(str(log))
+    obs.clear()
+    obs.enable()
+    try:
+        cols = [Column.from_numpy(np.arange(512, dtype=np.int64), INT64)
+                for _ in range(2)]
+        for _ in range(2):
+            jax.block_until_ready(xxhash64(cols))
+        obs.flush()
+    finally:
+        obs.disable()
+        obs.configure_sink(None)
+        obs.clear()
+    assert costmodel.profile_main([str(log), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    row = next(r for r in doc["rows"] if r["op"] == "xxhash64")
+    # the hashing span stamps input bytes, so the roofline is non-trivial
+    assert row["bytes"] > 0 and row["calls"] == 2
+    assert row["achieved_GBps"] > 0
+    assert row["pct_of_calibration"] > 0

@@ -1,0 +1,288 @@
+"""SLO burn-rate engine tests: multi-window semantics driven with
+crafted timestamps (no sleeping), recovery re-arming, the flight-recorder
+bundle-per-episode contract, serve shedding on fast burn, /healthz and
+/metrics surfacing over a real socket, and the env-spec parser."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import obs, serve
+from spark_rapids_jni_tpu.obs import (
+    costmodel, exporter, metrics, recorder, slo,
+)
+
+
+@pytest.fixture
+def slo_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_CALIBRATION_FILE",
+                       str(tmp_path / "CALIBRATION.json"))
+    slo.clear()
+    costmodel.reset()
+    metrics.registry().reset()
+    yield tmp_path
+    slo.clear()
+    costmodel.reset()
+    metrics.registry().reset()
+
+
+def _span(op, ts, wall=0.0, status="ok", **extra):
+    ev = {"kind": "span", "name": op, "status": status,
+          "wall_s": wall, "ts": ts}
+    ev.update(extra)
+    return ev
+
+
+T0 = 1_000_000.0   # arbitrary epoch; all tests drive explicit clocks
+
+
+def _latency_obj(name="p99", op="serve.request", shed=False, **kw):
+    return slo.add(slo.Objective(
+        name, "latency", op, target=0.99, threshold=0.25,
+        shed_on_burn=shed, **kw))
+
+
+# ---------------------------------------------------------------------------
+# Burn-window semantics (sleepless)
+# ---------------------------------------------------------------------------
+
+def test_fast_and_slow_burn_fire_together(slo_env):
+    _latency_obj()
+    for i in range(30):
+        slo.observe_span(_span("serve.request", T0 - i, wall=1.0))
+    (doc,) = slo.evaluate(now=T0)
+    # every observation bad: burn = 1 / budget = 100x on both windows
+    assert doc["fast_burn"] == pytest.approx(100.0)
+    assert doc["slow_burn"] == pytest.approx(100.0)
+    assert doc["burning"] is True
+
+
+def test_slow_burn_alone_does_not_fire(slo_env):
+    """Bad traffic confined to the *old* part of the slow window: the
+    slow burn is page-worthy but the fast window is clean, so the
+    objective holds (the multi-window AND is what kills flappy pages)."""
+    _latency_obj()
+    for i in range(50):
+        slo.observe_span(_span("serve.request", T0 - 300 - i, wall=1.0))
+    for i in range(50):
+        slo.observe_span(_span("serve.request", T0 - i, wall=0.001))
+    (doc,) = slo.evaluate(now=T0)
+    assert doc["slow_burn"] >= slo.DEFAULT_SLOW_BURN
+    assert doc["fast_burn"] == pytest.approx(0.0)
+    assert doc["burning"] is False
+
+
+def test_fast_spike_without_slow_budget_does_not_fire(slo_env):
+    """A short spike over an otherwise-healthy slow window: fast burn is
+    huge but the slow window has barely spent budget — no page."""
+    _latency_obj()
+    for i in range(2000):
+        slo.observe_span(_span("serve.request", T0 - 300 - (i % 200),
+                               wall=0.001))
+    for i in range(10):
+        slo.observe_span(_span("serve.request", T0 - i, wall=1.0))
+    (doc,) = slo.evaluate(now=T0)
+    assert doc["fast_burn"] >= slo.DEFAULT_FAST_BURN
+    assert doc["slow_burn"] < slo.DEFAULT_SLOW_BURN
+    assert doc["burning"] is False
+
+
+def test_recovery_resets_burning(slo_env):
+    _latency_obj()
+    for i in range(30):
+        slo.observe_span(_span("serve.request", T0 - i, wall=1.0))
+    assert slo.evaluate(now=T0)[0]["burning"] is True
+    # the bad window ages out entirely; fresh good traffic arrives
+    t1 = T0 + slo.DEFAULT_SLOW_WINDOW_S + 60
+    for i in range(30):
+        slo.observe_span(_span("serve.request", t1 - i, wall=0.001))
+    (doc,) = slo.evaluate(now=t1)
+    assert doc["burning"] is False
+    assert doc["fast_burn"] == pytest.approx(0.0)
+    trans = metrics.registry().snapshot()[
+        "srj_tpu_slo_burn_transitions_total"]["values"]
+    assert trans["objective=p99"] == 1
+
+
+def test_error_rate_objective(slo_env):
+    slo.add(slo.Objective("errs", "error_rate", "get_json_object",
+                          target=0.9))
+    for i in range(10):
+        slo.observe_span(_span("get_json_object", T0 - i,
+                               status="error" if i % 2 else "ok"))
+    (doc,) = slo.evaluate(now=T0)
+    # bad fraction 0.5 against a 0.1 budget: burn 5x — not page-worthy
+    assert doc["fast_burn"] == pytest.approx(5.0)
+    assert doc["burning"] is False
+    ev = metrics.registry().snapshot()["srj_tpu_slo_events_total"]["values"]
+    assert ev["objective=errs,outcome=bad"] == 5
+    assert ev["objective=errs,outcome=good"] == 5
+
+
+def test_utilization_objective_against_calibrated_ceiling(slo_env):
+    costmodel.save_calibration({"hbm_GBps": 100.0})
+    slo.add(slo.Objective("roofline", "utilization", "xxhash64",
+                          target=0.5, threshold=10.0))
+    # 1e9 B / 0.1 s = 10 GB/s = 10% of ceiling -> at the floor, good
+    slo.observe_span(_span("xxhash64", T0, device_s=0.1, bytes=1e9))
+    # 2% of ceiling -> bad
+    slo.observe_span(_span("xxhash64", T0, device_s=0.5, bytes=1e9))
+    # no bytes -> unclassifiable, not counted
+    slo.observe_span(_span("xxhash64", T0, device_s=0.5))
+    (doc,) = slo.evaluate(now=T0)
+    assert doc["fast_good"] == 1 and doc["fast_bad"] == 1
+
+
+def test_objective_validation_and_replace(slo_env):
+    with pytest.raises(ValueError):
+        slo.Objective("x", "nope", "op", target=0.5)
+    with pytest.raises(ValueError):
+        slo.Objective("x", "latency", "op", target=1.5)
+    with pytest.raises(ValueError):
+        slo.Objective("x", "latency", "op", target=0.9,
+                      fast_window_s=600, slow_window_s=60)
+    _latency_obj(name="a")
+    _latency_obj(name="a")          # replace by name, not duplicate
+    assert [o.name for o in slo.objectives()] == ["a"]
+    slo.remove("a")
+    assert slo.objectives() == []
+
+
+# ---------------------------------------------------------------------------
+# Flight-recorder bundle: once per burn episode
+# ---------------------------------------------------------------------------
+
+def test_one_bundle_per_burn_episode(slo_env, tmp_path):
+    recorder.reset()
+    recorder.arm(str(tmp_path / "diag"))
+    try:
+        _latency_obj()
+        for i in range(30):
+            slo.observe_span(_span("serve.request", T0 - i, wall=1.0))
+        slo.evaluate(now=T0)
+        first = recorder.last_bundle()
+        assert first is not None and "slo_burn" in first
+        # still burning: evaluating again must not dump a second bundle
+        slo.evaluate(now=T0 + 1)
+        assert recorder.last_bundle() == first
+        # recover, then a second episode dumps a fresh bundle
+        t1 = T0 + slo.DEFAULT_SLOW_WINDOW_S + 60
+        slo.observe_span(_span("serve.request", t1, wall=0.001))
+        assert slo.evaluate(now=t1)[0]["burning"] is False
+        t2 = t1 + slo.DEFAULT_SLOW_WINDOW_S + 60
+        for i in range(30):
+            slo.observe_span(_span("serve.request", t2 - i, wall=1.0))
+        slo.evaluate(now=t2)
+        second = recorder.last_bundle()
+        assert second is not None and second != first
+    finally:
+        recorder.disarm()
+        recorder.reset()
+
+
+# ---------------------------------------------------------------------------
+# Serve shedding on burn
+# ---------------------------------------------------------------------------
+
+def test_scheduler_sheds_while_burning(slo_env):
+    _latency_obj(shed=True)
+    now = time.time()
+    for i in range(30):
+        slo.observe_span(_span("serve.request", now - i, wall=1.0))
+    assert slo.should_shed() == "p99"
+    rng = np.random.default_rng(3)
+    s = serve.Scheduler()
+    try:
+        c = serve.Client(s, "alice")
+        with pytest.raises(serve.QueueFull) as ei:
+            c.aggregate(rng.integers(0, 4, 9).astype(np.int32),
+                        rng.integers(-3, 3, 9).astype(np.int32))
+        assert ei.value.reason == "slo_burn"
+        # objectives without shed_on_burn never reject traffic
+        slo.clear()
+        _latency_obj(shed=False)
+        for i in range(30):
+            slo.observe_span(_span("serve.request", now - i, wall=1.0))
+        assert slo.should_shed() is None
+        fut = c.aggregate(rng.integers(0, 4, 9).astype(np.int32),
+                          rng.integers(-3, 3, 9).astype(np.int32))
+        s.tick()
+        fut.result(timeout=30)
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# /healthz + /metrics surfacing over a real socket
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def live_exporter(slo_env):
+    obs.configure_sink(None)
+    obs.clear()
+    obs.enable()
+    port = exporter.start(0)
+    assert port is not None
+    yield port
+    exporter.stop()
+    obs.disable()
+    obs.clear()
+
+
+def _scrape(port, path="/metrics"):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+        return resp.read().decode("utf-8")
+
+
+def test_injected_latency_flips_healthz_coresidents_stay_green(
+        live_exporter):
+    """The acceptance scenario: a latency fault on one op flips its SLO
+    to burning on /healthz while a co-resident objective on another op
+    stays green — no TPU, no sleeping (events carry real wall-clock
+    stamps; the fault is the inflated wall_s)."""
+    _latency_obj(name="serve_p99")
+    slo.add(slo.Objective("json_errs", "error_rate", "get_json_object",
+                          target=0.99))
+    now = time.time()
+    for i in range(30):
+        # the injected fault: serve.request walls jump past threshold
+        metrics.observe_event(_span("serve.request", now - i, wall=1.0))
+        metrics.observe_event(_span("get_json_object", now - i,
+                                    wall=0.001))
+    doc = json.loads(_scrape(live_exporter, "/healthz"))
+    assert doc["slo"]["status"] == "burning"
+    assert doc["slo"]["burning"] == ["serve_p99"]
+    assert doc["slo"]["objectives"]["serve_p99"]["burning"] is True
+    assert doc["slo"]["objectives"]["json_errs"]["burning"] is False
+    body = _scrape(live_exporter, "/metrics")
+    assert 'srj_tpu_slo_burning{objective="serve_p99"} 1' in body
+    assert 'srj_tpu_slo_burning{objective="json_errs"} 0' in body
+    assert 'srj_tpu_slo_burn_rate{objective="serve_p99",window="fast"}' \
+        in body
+    assert 'srj_tpu_slo_target{objective="serve_p99"} 0.99' in body
+    assert "srj_tpu_slo_events_total" in body
+
+
+# ---------------------------------------------------------------------------
+# Env-spec bring-up
+# ---------------------------------------------------------------------------
+
+def test_configure_from_env_spec(slo_env):
+    added = slo.configure_from_env(
+        "serve_p99,kind=latency,op=serve.request,target=0.99,"
+        "threshold=0.25,shed=1;"
+        "broken,kind=latency,target=nope;"      # malformed: skipped
+        "json_errs,kind=error_rate,op=get_json_object,target=0.999,"
+        "fast_window_s=30,slow_window_s=300,fast_burn=10,slow_burn=4")
+    assert [o.name for o in added] == ["serve_p99", "json_errs"]
+    p99 = next(o for o in slo.objectives() if o.name == "serve_p99")
+    assert p99.kind == "latency" and p99.shed_on_burn is True
+    assert p99.threshold == 0.25
+    je = next(o for o in slo.objectives() if o.name == "json_errs")
+    assert (je.fast_window_s, je.slow_window_s) == (30, 300)
+    assert (je.fast_burn, je.slow_burn) == (10.0, 4.0)
+    assert je.budget == pytest.approx(0.001)
