@@ -56,6 +56,39 @@ sys.exit(0 if 0 < compiles <= bound else 1)
 PY
 rm -f "$SHAPE_EVENTS"
 
+# pallas-kernel smoke: force the Pallas engine (interpret mode on the
+# CPU mesh) through a from_rows decode, then assert every op span
+# carries impl=pallas and a repeat burst of identical calls costs zero
+# extra compiles — the knob, the attribution, and the program cache in
+# one leg
+PK_EVENTS=$(mktemp /tmp/srj_pallas_smoke.XXXXXX.jsonl)
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu SRJ_TPU_PALLAS=1 \
+  SRJ_TPU_EVENTS="$PK_EVENTS" python -c "
+import numpy as np
+from spark_rapids_jni_tpu import Column, INT32, Table
+from spark_rapids_jni_tpu.ops import convert_from_rows, convert_to_rows
+t = Table((Column.from_numpy(np.arange(256, dtype=np.int32), INT32),
+           Column.from_numpy(np.arange(256, dtype=np.int32) * 3, INT32)))
+batch = convert_to_rows(t)[0]
+convert_from_rows(batch, [INT32, INT32])      # warm: compiles land here
+for _ in range(5):                            # repeat burst: cache hits
+    convert_from_rows(batch, [INT32, INT32])
+"
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python - "$PK_EVENTS" <<'PY'
+import json, sys
+spans = [e for line in open(sys.argv[1]) for e in [json.loads(line)]
+         if e.get("kind") == "span" and e.get("name") == "convert_from_rows"]
+assert len(spans) == 6, f"expected 6 decode spans, got {len(spans)}"
+assert all(s.get("impl") == "pallas" for s in spans), \
+    [s.get("impl") for s in spans]
+burst = sum(s.get("compiles", 0) for s in spans[1:])
+assert burst == 0, f"repeat burst recompiled: {burst} extra compiles"
+print(f"pallas smoke: 6 impl=pallas decode spans, warm compiles "
+      f"{spans[0].get('compiles', 0)}, burst compiles 0")
+PY
+rm -f "$PK_EVENTS"
+
 # staging smoke: ingest a WIDE table (212 int32 columns, the bench's
 # widest axis) under the JSONL sink and fail unless the whole table
 # crossed the host->device boundary as exactly ONE staged transfer —

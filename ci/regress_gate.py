@@ -63,7 +63,11 @@ def round_metrics(doc: Dict) -> Dict[str, Dict]:
     QPS and p99 latency this way) and a ``roofline`` list of per-kernel
     ``roofline_<kernel>_pct_of_calibration`` legs — all gated under the
     same tolerance (``%`` is not a time unit, so rooflines correctly
-    regress when utilization drops)."""
+    regress when utilization drops).  The kernel set is open: once a
+    bench leg emits a roofline entry it is gated from the next round on
+    — ``from_rows`` (the TPU-legal decode) and the per-impl pairs
+    (``xxhash64_pallas``/``xxhash64_xla``, ``from_rows_pallas``/
+    ``from_rows_xla``) ride the same regex as the original kernels."""
     parsed = doc.get("parsed")
     if parsed is None:
         return {}

@@ -227,7 +227,8 @@ class Ledger:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._cells: Dict[Tuple[str, str, str], Dict[str, float]] = {}
+        self._cells: Dict[Tuple[str, str, str, str],
+                          Dict[str, float]] = {}
 
     def observe(self, ev: Dict) -> None:
         try:
@@ -236,7 +237,10 @@ class Ledger:
             op = str(ev.get("name", "?"))
             sig = str(ev.get("sig", ""))
             bucket = str(ev.get("bucket", ""))
-            key = (op, sig, bucket)
+            # impl splits the cell so a Pallas rewrite and the XLA
+            # lowering of the same (op, sig, bucket) ledger separately
+            impl = str(ev.get("impl", ""))
+            key = (op, sig, bucket, impl)
             with self._lock:
                 c = self._cells.get(key)
                 if c is None:
@@ -254,9 +258,9 @@ class Ledger:
             pass
 
     @staticmethod
-    def _derive(key: Tuple[str, str, str], c: Dict[str, float],
+    def _derive(key: Tuple[str, str, str, str], c: Dict[str, float],
                 ceiling: float) -> Dict:
-        op, sig, bucket = key
+        op, sig, bucket, impl = key
         dev = c["device_s"]
         wall = c["wall_s"]
         # roofline clock: fenced device time when the op ever fenced,
@@ -265,7 +269,7 @@ class Ledger:
         achieved = (c["bytes"] / t / 1e9) if t > 0 else 0.0
         total_rows = c["rows"] + c["padded_rows"]
         row = {
-            "op": op, "sig": sig, "bucket": bucket,
+            "op": op, "sig": sig, "bucket": bucket, "impl": impl,
             "calls": int(c["calls"]), "errors": int(c["errors"]),
             "wall_s": wall, "device_s": dev,
             "time_base": "device" if dev > 0 else "wall",
@@ -462,6 +466,8 @@ def _fmt_row(r: Dict, base: Optional[Dict] = None) -> str:
     cell = f"{r['op']}"
     if r["bucket"]:
         cell += f"@{r['bucket']}"
+    if r.get("impl"):
+        cell += f"[{r['impl']}]"
     dev_ms = (r["device_s"] or r["wall_s"]) * 1e3
     delta = ""
     if base is not None:
@@ -485,9 +491,13 @@ def render_profile(rows: List[Dict],
     lines = [head, "-" * len(head)]
     bmap = {}
     if baseline is not None:
-        bmap = {(b["op"], b["sig"], b["bucket"]): b for b in baseline}
+        # .get("impl") so baselines dumped before the impl split still
+        # match their un-tagged cells
+        bmap = {(b["op"], b["sig"], b["bucket"], b.get("impl", "")): b
+                for b in baseline}
     for r in rows:
-        base = bmap.get((r["op"], r["sig"], r["bucket"])) \
+        base = bmap.get((r["op"], r["sig"], r["bucket"],
+                         r.get("impl", ""))) \
             if baseline is not None else None
         lines.append(_fmt_row(r, base))
     return "\n".join(lines)

@@ -118,11 +118,14 @@ def last_bundle() -> Optional[str]:
 # Program registry
 # ---------------------------------------------------------------------------
 
-def register_program(op: str, sig: Any, bucket: Any, fn, args=()) -> None:
+def register_program(op: str, sig: Any, bucket: Any, fn, args=(),
+                     impl: str = "") -> None:
     """Remember how to reproduce the program a dispatch is about to run:
     ``fn`` (jitted or plain callable) plus the abstract shapes of
-    ``args``.  Costs one dict write; the StableHLO text is only lowered
-    if this (op, sig, bucket) later shows up in a failure bundle."""
+    ``args`` and the implementation tag (``pallas``/``xla``/… — a bundle
+    from a failing Pallas kernel must name the engine, not just the op).
+    Costs one dict write; the StableHLO text is only lowered if this
+    (op, sig, bucket) later shows up in a failure bundle."""
     if _R.dir is None:
         return
     try:
@@ -132,7 +135,7 @@ def register_program(op: str, sig: Any, bucket: Any, fn, args=()) -> None:
         key = (str(op), str(sig), str(bucket))
         with _R.lock:
             _R.programs.pop(key, None)
-            _R.programs[key] = (fn, avals)
+            _R.programs[key] = (fn, avals, str(impl))
             while len(_R.programs) > _MAX_PROGRAMS:
                 _R.programs.popitem(last=False)
     except Exception:
@@ -213,10 +216,11 @@ def _mem_snapshot() -> Dict:
 
 
 def _repro(ev: Dict, program_keys: List[Tuple]) -> Dict:
-    keep = ("name", "status", "op", "sig", "slots", "bucket", "rows",
-            "requests", "tenant", "tenants", "error_type", "error",
-            "device_dead", "trace_id", "span_id", "parent_span_id",
-            "links", "link_trace_ids", "host", "thread", "deadline_ms")
+    keep = ("name", "status", "op", "sig", "slots", "bucket", "impl",
+            "rows", "requests", "tenant", "tenants", "error_type",
+            "error", "device_dead", "trace_id", "span_id",
+            "parent_span_id", "links", "link_trace_ids", "host",
+            "thread", "deadline_ms")
     r = {k: ev[k] for k in keep if k in ev}
     r["programs"] = [list(k) for k in program_keys]
     return r
@@ -262,10 +266,10 @@ def dump_bundle(reason: str, ev: Dict) -> Optional[str]:
         _write("events.json", _spans.events()[-k:])
 
         progs = _matching_programs(ev)
-        for i, (pkey, (fn, avals)) in enumerate(progs):
+        for i, (pkey, (fn, avals, impl)) in enumerate(progs):
             op, sig, bucket = pkey
             _write(f"program-{i:02d}-{_slug(op)}.txt",
-                   f"# op={op} sig={sig} bucket={bucket}\n"
+                   f"# op={op} sig={sig} bucket={bucket} impl={impl}\n"
                    f"# avals={[str(a) for a in avals]}\n"
                    + _lower_text(fn, avals))
 
@@ -313,10 +317,10 @@ def _augment(path: str, ev: Dict) -> Optional[str]:
         progs = _matching_programs(merged)
         have = {fname for fname in files if fname.startswith("program-")}
         idx = len(have)
-        for pkey, (fn, avals) in progs:
+        for pkey, (fn, avals, impl) in progs:
             op, sig, bucket = pkey
             fname = f"program-{idx:02d}-{_slug(op)}.txt"
-            header = f"# op={op} sig={sig} bucket={bucket}\n"
+            header = f"# op={op} sig={sig} bucket={bucket} impl={impl}\n"
             if any(header in _read_head(os.path.join(path, h))
                    for h in have):
                 continue
@@ -482,7 +486,7 @@ def format_bundle(path: str) -> str:
     if ev.get("deadline_ms"):
         lines.append(f"  deadline    : {ev.get('deadline_ms')} ms")
     repro = _load("repro.json") or {}
-    for k in ("op", "sig", "slots", "bucket", "rows", "requests"):
+    for k in ("op", "sig", "slots", "bucket", "impl", "rows", "requests"):
         if repro.get(k) is not None:
             lines.append(f"  {k:<12}: {repro[k]}")
     if repro.get("trace_id"):

@@ -351,6 +351,38 @@ def _hash_bucketed(cols, bucket, W: int):
     return shapes.bucket_rows(n, f), shapes.bucket_width(W, f)
 
 
+def _dispatch_hash(op: str, pcols, seed: int, Wb: int, xla_jit):
+    """Pick the tiled Pallas kernel or the generic XLA chain for one
+    bucketed hash call (``SRJ_TPU_PALLAS`` knob, ``runtime/shapes``
+    bucket already applied).  Pallas covers fixed-width non-nested
+    columns only (``Wb == 0``); anything else stays on the XLA chain.
+    Either way the span is stamped with ``impl=`` and the program is
+    registered with the flight recorder under ``(op, sig, bucket)``."""
+    from spark_rapids_jni_tpu.ops import pallas_kernels
+    impl, interp = pallas_kernels.choose(op, jax.default_backend())
+    if impl == "pallas" and Wb == 0 \
+            and pallas_kernels.hashable_fixed(pcols):
+        pallas_kernels.stamp_impl("pallas")
+        b = pcols[0].num_rows
+        sig = (len(pcols), tuple(str(c.dtype) for c in pcols))
+        if op == "murmur3_hash":
+            fn = functools.partial(pallas_kernels.murmur3_fixed,
+                                   seed=seed, interpret=interp)
+        else:
+            fn = functools.partial(pallas_kernels.xxhash64_fixed,
+                                   seed=seed, interpret=interp)
+        # the recorder lowers from flat array avals — close over the
+        # column treedef so the registered fn rebuilds the tuple
+        leaves, treedef = jax.tree_util.tree_flatten(pcols)
+        pallas_kernels.register(
+            op, sig, b,
+            lambda *ls: fn(jax.tree_util.tree_unflatten(treedef, ls)),
+            tuple(leaves), impl="pallas")
+        return fn(pcols)
+    pallas_kernels.stamp_impl("xla")
+    return xla_jit(pcols, seed, Wb)
+
+
 @span_fn(attrs=_hash_attrs)
 def murmur3_hash(table_or_cols, seed: int = DEFAULT_SEED,
                  max_str_len: Optional[int] = None, *,
@@ -383,7 +415,8 @@ def murmur3_hash(table_or_cols, seed: int = DEFAULT_SEED,
         pcols = tuple(shapes.pad_column(c, b, width=Wb or None)
                       for c in cols)
     with tracing.op_scope("murmur3_hash", b):
-        out = _murmur3_jit(pcols, int(seed), Wb)
+        out = _dispatch_hash("murmur3_hash", pcols, int(seed), Wb,
+                             _murmur3_jit)
     with shapes.unpad_span():
         return shapes.unpad_array(out, n)
 
@@ -649,6 +682,6 @@ def xxhash64(table_or_cols, seed: int = DEFAULT_SEED,
         pcols = tuple(shapes.pad_column(c, b, width=Wb or None)
                       for c in cols)
     with tracing.op_scope("xxhash64", b):
-        out = _xx64_jit(pcols, int(seed), Wb)
+        out = _dispatch_hash("xxhash64", pcols, int(seed), Wb, _xx64_jit)
     with shapes.unpad_span():
         return shapes.unpad_array(out, n)

@@ -49,6 +49,7 @@ from spark_rapids_jni_tpu.utils.tracing import func_range
 from spark_rapids_jni_tpu.utils import metrics
 from spark_rapids_jni_tpu.utils import tracing
 from spark_rapids_jni_tpu.obs import span_fn
+from spark_rapids_jni_tpu.obs import spans as _obs_spans
 from spark_rapids_jni_tpu.runtime import shapes
 from spark_rapids_jni_tpu.runtime import staging
 
@@ -262,21 +263,27 @@ def _to_rows_fixed_jit(table: Table, layout: RowLayout,
 
 def _disassemble_fixed_rows(rows2d: jnp.ndarray,
                             layout: RowLayout) -> List[Column]:
-    """Inverse of :func:`_assemble_fixed_rows` for the fixed-width section."""
-    vbytes = rows2d[:, layout.validity_offset:
-                    layout.validity_offset + layout.validity_bytes]
-    cols = []
-    for i, dt in enumerate(layout.dtypes):
-        start, size = layout.col_starts[i], layout.col_sizes[i]
-        byte_slice = rows2d[:, start:start + size]
-        valid = (vbytes[:, i // 8] >> (i % 8)) & 1
-        validity = pack_bools(valid.astype(jnp.bool_))
-        if dt.is_string:
-            raise ValueError("string columns require the variable-width path")
-        data = bytes_to_col(byte_slice, None if dt.kind == "decimal128"
-                            else dt.np_dtype, dt)
-        cols.append(Column(dt, data, validity))
-    return cols
+    """Inverse of :func:`_assemble_fixed_rows` for the fixed-width section.
+
+    Decodes in uint32 WORD space: one strided-lane combine turns the blob
+    into per-row words (``bytes2d_to_words`` — static slices only, no
+    gather, no ``[n, W, 4]`` intermediate) and every column is then a
+    contiguous word-column slice + shift (``_col_from_words``).  This is
+    the root-cause fix for BENCH_r05's ``from_rows`` failures: the
+    previous decode bitcast narrow per-column ``[n, size]`` uint8
+    windows (``bytes_to_col``), and those sub-word bitcasts — like the
+    per-row dynamic-start gathers of the oracle path — are not legal
+    under the TPU backend (``INVALID_ARGUMENT: TPU backend error``).
+    Word space is the same trick the pack side uses for its char scatter
+    (``_to_rows_variable_jit``) and the padded-variable decode already
+    runs (``padded_cols_from_rows`` mode "xla")."""
+    if layout.has_strings:
+        raise ValueError("string columns require the variable-width path")
+    fe_pad = (layout.fixed_end + 3) // 4 * 4
+    f_words = bytes2d_to_words(rows2d[:, :fe_pad])      # [n, fe_pad/4]
+    datas, masks, _ = _cols_from_fwords(f_words, layout)
+    return [Column(dt, datas[i], masks[i])
+            for i, dt in enumerate(layout.dtypes)]
 
 
 @functools.partial(jax.jit, static_argnums=(1,))
@@ -404,7 +411,11 @@ def _resolve_impl(impl: Optional[str], use_pallas: Optional[bool],
                   platform: str) -> str:
     """Pick the fixed-width engine: ``mxu`` (permutation matmul on the
     systolic array — the TPU hot path), ``xla`` (fused concatenate), or
-    ``pallas`` (explicitly tiled kernel).  Auto: mxu on TPU, xla elsewhere."""
+    ``pallas`` (explicitly tiled kernel).  Auto: mxu on TPU, xla
+    elsewhere — unless the ``SRJ_TPU_PALLAS`` knob overrides it (``0``
+    forces the generic XLA lowering everywhere, the kill switch out of
+    a misbehaving kernel engine; ``1`` forces the explicitly tiled
+    Pallas kernels, interpret-mode off-TPU)."""
     if impl is not None:
         if impl not in ("mxu", "xla", "pallas"):
             raise ValueError(f"unknown impl {impl!r}; "
@@ -414,6 +425,12 @@ def _resolve_impl(impl: Optional[str], use_pallas: Optional[bool],
         return "pallas"
     if use_pallas is not None:  # explicit False
         return "xla"
+    from spark_rapids_jni_tpu.ops import pallas_kernels
+    k = pallas_kernels.knob()
+    if k == "0":
+        return "xla"
+    if k == "1":
+        return "pallas"
     return "mxu" if platform == "tpu" else "xla"
 
 
@@ -511,6 +528,8 @@ def _convert_to_rows_impl(table: Table, size_limit: int,
         return _to_rows_variable(table, layout, size_limit)
     platform = _platform_of(table)
     impl = _resolve_impl(impl, use_pallas, platform)
+    from spark_rapids_jni_tpu.ops import pallas_kernels
+    pallas_kernels.stamp_impl("xla" if impl == "xla" else "pallas")
     n = table.num_rows
     # one batching policy: conversion transients are bounded at <=1GB per
     # encode even when the caller's size_limit would allow bigger batches.
@@ -634,12 +653,22 @@ def _convert_from_rows_impl(rows: RowsColumn, dtypes: Sequence[DType],
     n = rows.num_rows
     platform = _platform_of(rows)
     impl = _resolve_impl(impl, use_pallas, platform)
+    from spark_rapids_jni_tpu.ops import pallas_kernels
+    # impl attribution: the explicitly tiled engines (the planes kernel
+    # and the fused MXU decode are both Pallas programs) vs the generic
+    # XLA lowering — obs profile and chargeback split the ledger on this
+    pallas_kernels.stamp_impl("xla" if impl == "xla" else "pallas")
+    sig = (layout.num_columns, layout.fixed_row_size)
     if impl == "pallas":
-        from spark_rapids_jni_tpu.ops import row_kernels
         rows2d = rows.rows2d(layout.fixed_row_size)
-        cols = row_kernels.from_rows_fixed(rows2d, layout,
-                                           interpret=platform != "tpu",
-                                           bucket=None)
+        interp = platform != "tpu"
+        pallas_kernels.register(
+            "convert_from_rows", sig, n,
+            lambda r2d: pallas_kernels.from_rows_fixed(
+                r2d, layout, interpret=interp),
+            (rows2d,), impl="pallas")
+        cols = pallas_kernels.from_rows_fixed(rows2d, layout,
+                                              interpret=interp)
     elif impl == "mxu":
         from spark_rapids_jni_tpu.ops import row_mxu
         if rows.data.size != n * layout.fixed_row_size:
@@ -650,6 +679,10 @@ def _convert_from_rows_impl(rows: RowsColumn, dtypes: Sequence[DType],
         cols = row_mxu.from_rows_fixed(rows.data, layout)
     else:
         rows2d = rows.rows2d(layout.fixed_row_size)
+        pallas_kernels.register(
+            "convert_from_rows", sig, n,
+            lambda r2d: _from_rows_fixed_jit(r2d, layout),
+            (rows2d,), impl="xla")
         cols = _from_rows_fixed_jit(rows2d, layout)
     return Table(tuple(cols))
 
@@ -675,7 +708,17 @@ def convert_to_rows_grouped(gc, *, size_limit: int = MAX_BATCH_BYTES
     chunk = min(size_limit, MAX_BATCH_BYTES)
     per_max = chunk // rs // align * align
     if n == 0 or n < align or per_max == 0:
-        # tiny tables: materialize and take the standard path
+        # tiny tables: materialize and take the standard path.  The
+        # inner convert_to_rows buckets and notes padding on its OWN
+        # span — stamp the bucket attrs on this op's span too (with the
+        # blob bytes so the tail cost is priced), otherwise pad_waste
+        # attribution under-counts every small grouped batch.
+        f = shapes.resolve("auto")
+        if f is not None and n > 0:
+            sp = _obs_spans.current_span()
+            if sp is not None and "bytes" not in sp.attrs:
+                sp.set(bytes=n * rs)
+            shapes.note(n, shapes.bucket_rows(n, f))
         return convert_to_rows(gc.to_table(), size_limit=size_limit)
     nb = -(-n * rs // chunk)
     per = min((-(-n // nb) + align - 1) // align * align, per_max)

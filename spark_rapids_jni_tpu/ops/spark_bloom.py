@@ -158,6 +158,74 @@ class SparkBloomFilter:
         return SparkBloomFilter(k, words)
 
 
+_DEVICE_KINDS = frozenset(
+    {"int8", "int16", "int32", "int64", "date32", "timestamp_us"})
+
+
+def might_contain_device(bf: SparkBloomFilter, col: Column, *,
+                         bucket="auto"):
+    """Device-side per-row probe: hash fused with the bitset test so the
+    uint32-viewed bitset stays VMEM-resident across a row tile
+    (``SRJ_TPU_PALLAS`` selects the Pallas kernel vs one generic XLA
+    program).  Long-castable integer columns only; returns bool [n]
+    (null rows False), byte-identical to :meth:`SparkBloomFilter.
+    might_contain`.  Filters at or above 2**31 bits (256 MiB) fall back
+    to the host probe — the fused kernels index with int32."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_jni_tpu.ops import hashing as H
+    from spark_rapids_jni_tpu.ops import pallas_kernels
+    from spark_rapids_jni_tpu.runtime import shapes
+    from spark_rapids_jni_tpu.utils import metrics, tracing
+    from spark_rapids_jni_tpu.obs import spans
+
+    if col.dtype.kind not in _DEVICE_KINDS or col.children:
+        raise ValueError(
+            f"bloom device probe takes long-castable integer columns, "
+            f"got {col.dtype!r}; use might_contain() for the host path")
+    n = col.num_rows
+    k = bf.num_hash_functions
+    num_bits = bf.num_bits
+    with spans.span("bloom_might_contain", rows=n,
+                    bytes=n * col.dtype.itemsize) as sp:
+        metrics.op("bloom_might_contain", rows=n)
+        if num_bits >= 1 << 31:
+            sp.set(impl="host")
+            return jnp.asarray(bf.might_contain(col))
+        impl, interp = pallas_kernels.choose("bloom_might_contain",
+                                             jax.default_backend())
+        pallas_kernels.stamp_impl("xla" if impl == "xla" else "pallas")
+        hi, lo = H._col_u64_blocks(col)
+        valid = col.valid_bools()
+        f = shapes.resolve(bucket)
+        b = shapes.bucket_rows(n, f) if f is not None else n
+        shapes.note(n, b)
+        with shapes.pad_span():
+            plo = jnp.pad(lo, (0, b - n))
+            phi = jnp.pad(hi, (0, b - n))
+            pvalid = jnp.pad(valid, (0, b - n))
+        bits32 = jnp.asarray(
+            bf.words.astype("<u8", copy=False).view(np.uint32))
+        sig = (str(col.dtype), k, len(bf.words))
+        with tracing.op_scope("bloom_might_contain", b):
+            # statics bound positionally — the jitted entries take
+            # k/num_bits via static_argnums
+            if impl == "pallas":
+                fn = lambda b32, l, h, v: pallas_kernels.bloom_might_contain(
+                    b32, l, h, v, k, num_bits, interpret=interp)
+            else:
+                fn = lambda b32, l, h, v: \
+                    pallas_kernels.bloom_might_contain_xla(
+                        b32, l, h, v, k, num_bits)
+            pallas_kernels.register(
+                "bloom_might_contain", sig, b, fn,
+                (bits32, plo, phi, pvalid), impl=impl)
+            out = fn(bits32, plo, phi, pvalid)
+        with shapes.unpad_span():
+            return shapes.unpad_array(out, n)
+
+
 def _col_to_u64(col: Column):
     """A long-compatible column's values as uint64 bits + validity."""
     data = np.asarray(col.data)

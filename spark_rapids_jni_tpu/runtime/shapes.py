@@ -272,6 +272,25 @@ def unpad_result(out, n: int):
     return out
 
 
+def vmem_tile(bytes_per_row: int, *, budget: int = 4 << 20,
+              floor: int = 32, cap: int = 4096) -> int:
+    """Rows per VMEM tile for a Pallas kernel moving ``bytes_per_row``
+    (input + intermediates + output) per row.
+
+    Pow-2 (rounded DOWN from ``budget // bytes_per_row``) so every
+    bucket on the pow-2 row grid ≥ the tile divides evenly — a bucketed
+    batch never pays a second round of tile-tail padding on top of its
+    bucket padding.  The default 4MB budget leaves room for Pallas'
+    double-buffered pipeline (~2x the block bytes live at once) inside
+    the ~16MB/core VMEM.  ``floor`` keeps blocks sublane-aligned even
+    for very wide schemas (uint8 native tiling is (32, 128))."""
+    t = max(1, budget // max(1, bytes_per_row))
+    p = 1 << (t.bit_length() - 1)          # round down to pow-2
+    floor_p = 1 << max(0, (floor - 1).bit_length())
+    cap_p = 1 << (cap.bit_length() - 1)
+    return max(floor_p, min(cap_p, p))
+
+
 def note(n: int, b: int) -> None:
     """Stamp ``bucket`` / ``padded_rows`` on the innermost active span
     (the operator's own span when called from an op body) so the report

@@ -50,3 +50,10 @@ class Client:
         """JCUDF fixed-width row conversion of all-valid int32 columns;
         resolves to ``{rows, row_size, num_rows}`` (flat uint8)."""
         return self._sched.submit(self.tenant, "rows", columns=columns)
+
+    def from_rows(self, rows, ncols: int):
+        """JCUDF row decode back to ``ncols`` all-valid int32 columns
+        (the inverse of :meth:`to_rows`); resolves to ``{columns,
+        num_rows}``.  ``rows``: flat uint8 blob or ``[n, row_size]``."""
+        return self._sched.submit(self.tenant, "unrows", rows=rows,
+                                  ncols=ncols)

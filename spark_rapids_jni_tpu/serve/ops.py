@@ -272,8 +272,89 @@ class _RowsOp(ServeOp):
                 "row_size": rs, "num_rows": n}
 
 
+# ---------------------------------------------------------------------------
+# unrows: JCUDF fixed-width row decode (all-valid int32 columns)
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=256)
+def _unrows_kernel(ncols: int, b: int, kb: int):
+    from spark_rapids_jni_tpu.ops import pallas_kernels
+    from spark_rapids_jni_tpu.ops import row_conversion as rc
+    layout, _ = _rows_layout(ncols)
+    rs = layout.fixed_row_size
+    # the decode engine is the same knob-gated choice the direct
+    # convert_from_rows path makes, so serving picks up the Pallas
+    # kernel automatically where it is on
+    impl, interp = pallas_kernels.choose("convert_from_rows",
+                                         jax.default_backend())
+
+    def _serve_unrows(rows):                    # [kb, b, rs] uint8
+        flat = rows.reshape(kb * b, rs)
+        if impl == "pallas":
+            cols = pallas_kernels.from_rows_fixed(flat, layout,
+                                                  interpret=interp)
+        else:
+            cols = rc._from_rows_fixed_jit(flat, layout)
+        data = jnp.stack([c.data for c in cols])    # [ncols, kb*b]
+        return (data.reshape(ncols, kb, b).transpose(1, 0, 2),)
+    return _serve_unrows
+
+
+class _UnrowsOp(ServeOp):
+    """JCUDF row unpack for all-valid int32 columns — the decode twin of
+    :class:`_RowsOp`, sharing its layout.  Byte-identity with the direct
+    ``ops.convert_from_rows`` decode is asserted by ``tests``."""
+
+    name = "unrows"
+
+    def validate(self, kwargs):
+        rows = np.asarray(kwargs.pop("rows"))
+        ncols = int(kwargs.pop("ncols"))
+        if kwargs:
+            raise ValueError(f"unknown unrows arguments: {sorted(kwargs)}")
+        if rows.dtype != np.uint8:
+            raise ValueError(f"rows must be uint8 bytes, got {rows.dtype}")
+        layout, _ = _rows_layout(ncols)
+        rs = layout.fixed_row_size
+        if rows.ndim == 1:
+            if rows.size == 0 or rows.size % rs:
+                raise ValueError(
+                    f"rows blob of {rows.size} bytes is not a whole "
+                    f"number of {rs}-byte rows")
+            rows = rows.reshape(-1, rs)
+        elif rows.ndim != 2 or rows.shape[1] != rs:
+            raise ValueError(
+                f"rows must be [n, {rs}] or a flat blob, got {rows.shape}")
+        n = rows.shape[0]
+        if n == 0:
+            raise ValueError("unrows needs at least one row")
+        payload = {"rows": np.ascontiguousarray(rows), "n": n,
+                   "ncols": ncols}
+        sig = (ncols, shapes.bucket_rows(n))
+        return payload, sig, n, rows.nbytes
+
+    def batch(self, payloads, sig, kb):
+        ncols, b = sig
+        layout, _ = _rows_layout(ncols)
+        rs = layout.fixed_row_size
+        out = np.zeros((kb, b, rs), np.uint8)
+        for i, p in enumerate(payloads):
+            out[i, :p["n"]] = p["rows"]
+        return [out]
+
+    def kernel(self, sig, kb):
+        return _unrows_kernel(sig[0], sig[1], kb)
+
+    def unbatch(self, host_outs, slot, payload):
+        (cols,) = host_outs
+        n = payload["n"]
+        return {"columns": [np.asarray(cols[slot, ci, :n])
+                            for ci in range(payload["ncols"])],
+                "num_rows": n}
+
+
 _OPS: Dict[str, ServeOp] = {
-    op.name: op for op in (_AggOp(), _JoinOp(), _RowsOp())}
+    op.name: op for op in (_AggOp(), _JoinOp(), _RowsOp(), _UnrowsOp())}
 
 
 def get(name: str) -> ServeOp:

@@ -1,0 +1,341 @@
+"""Pallas VMEM-tiled kernels (``ops/pallas_kernels.py``): byte-identity
+against the generic XLA lowerings under interpret mode on the CPU mesh,
+the ``SRJ_TPU_PALLAS`` knob contract, and the TPU-legality guard on the
+``from_rows`` decode (no per-row dynamic-start gather in the lowered
+HLO — the root cause of BENCH_r05's real-backend failures)."""
+
+import re
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from spark_rapids_jni_tpu.table import (
+    Table, Column, BOOL8, FLOAT32, FLOAT64, INT8, INT16, INT32, INT64,
+)
+from spark_rapids_jni_tpu.ops import row_conversion as rc
+from spark_rapids_jni_tpu.ops import hashing as H
+from spark_rapids_jni_tpu.ops import pallas_kernels as pk
+from spark_rapids_jni_tpu.ops import spark_bloom as SB
+from spark_rapids_jni_tpu.ops.row_layout import compute_row_layout
+from spark_rapids_jni_tpu.runtime import shapes
+
+FIXED_DTYPES = [INT32, INT64, INT8, INT16, FLOAT64, BOOL8, FLOAT32]
+
+# bucket edges: k±1 around pow-2 grid points, single row, empty
+EDGE_ROWS = [0, 1, 7, 8, 9, 31, 32, 33, 255, 256, 257]
+
+
+def _make_cols(rng, dtypes, n, pattern="most"):
+    cols = []
+    for dt in dtypes:
+        np_dt = dt.np_dtype
+        if np_dt.kind == "f":
+            vals = rng.standard_normal(n).astype(np_dt)
+        elif dt.kind == "bool8":
+            vals = rng.integers(0, 2, n).astype(np_dt)
+        else:
+            info = np.iinfo(np_dt)
+            vals = rng.integers(info.min, info.max, n, dtype=np_dt,
+                                endpoint=True)
+        if pattern == "none":
+            valid = np.zeros(n, dtype=bool)
+        elif pattern == "plain":
+            valid = None
+        else:
+            valid = rng.random(n) > 0.1
+        cols.append(Column.from_numpy(vals, dt, valid))
+    return tuple(cols)
+
+
+def _assert_cols_equal(got, want):
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g.data),
+                                      np.asarray(w.data))
+        assert (g.validity is None) == (w.validity is None)
+        if g.validity is not None:
+            np.testing.assert_array_equal(np.asarray(g.validity),
+                                          np.asarray(w.validity))
+
+
+# ---------------------------------------------------------------------------
+# row-unpack
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", EDGE_ROWS)
+@pytest.mark.parametrize("pattern", ["most", "none"])
+def test_from_rows_pallas_byte_identity(n, pattern):
+    """The planes kernel (interpret mode) decodes every bucket-edge row
+    count byte-identically to the word-slice XLA lowering, including
+    all-null validity."""
+    rng = np.random.default_rng(100 + n)
+    layout = compute_row_layout(FIXED_DTYPES)
+    if n == 0:
+        rows2d = jnp.zeros((0, layout.fixed_row_size), jnp.uint8)
+    else:
+        t = Table(_make_cols(rng, FIXED_DTYPES, n, pattern))
+        rows2d = rc.convert_to_rows(t)[0].rows2d(layout.fixed_row_size)
+    got = pk.from_rows_fixed(rows2d, layout, interpret=True)
+    want = rc._from_rows_fixed_jit(rows2d, layout)
+    _assert_cols_equal(got, want)
+
+
+@pytest.mark.parametrize("tile", [8, 32, 128])
+def test_from_rows_pallas_tile_sizes(tile):
+    """Identity holds for any explicit VMEM row-tile size, including
+    tiles that do not divide the row count."""
+    rng = np.random.default_rng(7)
+    layout = compute_row_layout([INT32, INT64, INT16])
+    t = Table(_make_cols(rng, [INT32, INT64, INT16], 100))
+    rows2d = rc.convert_to_rows(t)[0].rows2d(layout.fixed_row_size)
+    got = pk.from_rows_fixed(rows2d, layout, interpret=True,
+                             tile_rows=tile)
+    want = rc._from_rows_fixed_jit(rows2d, layout)
+    _assert_cols_equal(got, want)
+
+
+@pytest.mark.parametrize("pattern", [None, "most", "none"])
+def test_convert_from_rows_knob_equivalence(monkeypatch, pattern):
+    """The public decode returns identical tables under knob=1 (Pallas,
+    interpret on CPU), knob=0 (kill switch: generic XLA), and the auto
+    default."""
+    rng = np.random.default_rng(11)
+    t = Table(_make_cols(rng, FIXED_DTYPES, 130,
+                         pattern or "plain"))
+    batch = rc.convert_to_rows(t)[0]
+    monkeypatch.delenv("SRJ_TPU_PALLAS", raising=False)
+    auto = rc.convert_from_rows(batch, FIXED_DTYPES)
+    monkeypatch.setenv("SRJ_TPU_PALLAS", "1")
+    pallas = rc.convert_from_rows(batch, FIXED_DTYPES)
+    monkeypatch.setenv("SRJ_TPU_PALLAS", "0")
+    xla = rc.convert_from_rows(batch, FIXED_DTYPES)
+    _assert_cols_equal(pallas.columns, auto.columns)
+    _assert_cols_equal(xla.columns, auto.columns)
+
+
+def test_from_rows_lowering_is_tpu_legal():
+    """The decode's lowered HLO must contain no per-row dynamic-start
+    gather/scatter (the TPU-illegal pattern behind the BENCH_r05
+    ``INVALID_ARGUMENT`` failures).  Constant lane-select gathers from
+    the strided word combine are fine — their index operands are tiny
+    static vectors over byte lanes, not per-row matrices."""
+    n = 64
+    layout = compute_row_layout(FIXED_DTYPES)
+    low = jax.jit(lambda r: rc._from_rows_fixed_jit(r, layout)).lower(
+        jax.ShapeDtypeStruct((n, layout.fixed_row_size), np.uint8)
+    ).as_text()
+    assert "stablehlo.dynamic_slice" not in low
+    assert "dynamic_gather" not in low
+    assert "stablehlo.scatter" not in low
+    for line in low.splitlines():
+        if '"stablehlo.gather"' not in line:
+            continue
+        assert "indices_are_sorted = true" in line, line
+        m = re.search(r"tensor<(\d+)x1xi32>", line)
+        assert m, line
+        # index vectors address byte lanes within a row (< row size),
+        # never a [rows, bytes] gather matrix
+        assert int(m.group(1)) <= layout.fixed_row_size, line
+
+
+# ---------------------------------------------------------------------------
+# hashes
+# ---------------------------------------------------------------------------
+
+HASH_DTYPES = [INT32, INT64, FLOAT64, INT16, FLOAT32, INT8, BOOL8]
+
+
+@pytest.mark.parametrize("n", [n for n in EDGE_ROWS if n > 0])
+@pytest.mark.parametrize("pattern", ["most", "none", "plain"])
+def test_murmur3_pallas_byte_identity(n, pattern):
+    rng = np.random.default_rng(200 + n)
+    cols = _make_cols(rng, HASH_DTYPES, n, pattern)
+    b = shapes.bucket_rows(n)
+    pcols = tuple(shapes.pad_column(c, b) for c in cols)
+    want = np.asarray(H._murmur3_jit(pcols, 42, 0))
+    got = np.asarray(pk.murmur3_fixed(pcols, 42, interpret=True))
+    np.testing.assert_array_equal(want, got)
+
+
+@pytest.mark.parametrize("n", [n for n in EDGE_ROWS if n > 0])
+@pytest.mark.parametrize("pattern", ["most", "none", "plain"])
+def test_xxhash64_pallas_byte_identity(n, pattern):
+    rng = np.random.default_rng(300 + n)
+    cols = _make_cols(rng, HASH_DTYPES, n, pattern)
+    b = shapes.bucket_rows(n)
+    pcols = tuple(shapes.pad_column(c, b) for c in cols)
+    want = np.asarray(H._xx64_jit(pcols, 7, 0))
+    got = np.asarray(pk.xxhash64_fixed(pcols, 7, interpret=True))
+    np.testing.assert_array_equal(want, got)
+
+
+@pytest.mark.parametrize("op", ["murmur3_hash", "xxhash64"])
+@pytest.mark.parametrize("n", [1, 33, 257])
+def test_hash_knob_equivalence(monkeypatch, op, n):
+    """Public hash entries return identical values whichever engine the
+    knob selects — including the ``SRJ_TPU_PALLAS=0`` kill switch."""
+    rng = np.random.default_rng(400 + n)
+    fn = getattr(H, op)
+    cols = _make_cols(rng, [INT32, INT64, INT16], n)
+    monkeypatch.delenv("SRJ_TPU_PALLAS", raising=False)
+    auto = np.asarray(fn(cols, 99))
+    monkeypatch.setenv("SRJ_TPU_PALLAS", "1")
+    pallas = np.asarray(fn(cols, 99))
+    monkeypatch.setenv("SRJ_TPU_PALLAS", "0")
+    xla = np.asarray(fn(cols, 99))
+    np.testing.assert_array_equal(auto, pallas)
+    np.testing.assert_array_equal(auto, xla)
+
+
+def test_hash_pallas_skips_strings(monkeypatch):
+    """String columns stay on the XLA chain even with the knob forced on
+    (the Pallas kernels cover fixed-width columns only) — and the result
+    is unchanged."""
+    docs = Column.strings(["a", "bc", "", "longer-value", "x"] * 7)
+    icol = Column.from_numpy(np.arange(35, dtype=np.int32), INT32)
+    monkeypatch.delenv("SRJ_TPU_PALLAS", raising=False)
+    want = np.asarray(H.murmur3_hash([icol, docs]))
+    monkeypatch.setenv("SRJ_TPU_PALLAS", "1")
+    got = np.asarray(H.murmur3_hash([icol, docs]))
+    np.testing.assert_array_equal(want, got)
+
+
+def test_scalar_oracle_survives_dispatch(monkeypatch):
+    """Spark's pinned scalar vector still holds through the dispatcher:
+    hash(1) == -559580957 (reference spark_hash test)."""
+    col = Column.from_numpy(np.array([1], np.int32), INT32)
+    for knob in ("0", "1"):
+        monkeypatch.setenv("SRJ_TPU_PALLAS", knob)
+        assert int(np.asarray(H.murmur3_hash([col], 42))[0]) == -559580957
+
+
+# ---------------------------------------------------------------------------
+# bloom probe
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", [1, 9, 256, 1023])
+def test_bloom_device_probe_matches_host(monkeypatch, n):
+    rng = np.random.default_rng(500 + n)
+    bf = SB.SparkBloomFilter.optimal(4096, 0.03)
+    ins = rng.integers(-(1 << 62), 1 << 62, 2048, dtype=np.int64)
+    bf.put(Column.from_numpy(ins, INT64, None))
+    probe = np.concatenate([
+        ins[: n // 2 + 1],
+        rng.integers(-(1 << 62), 1 << 62, n, dtype=np.int64)])[:n]
+    valid = rng.random(n) > 0.2
+    col = Column.from_numpy(probe, INT64, valid)
+    want = bf.might_contain(col)
+    for knob in ("0", "1"):
+        monkeypatch.setenv("SRJ_TPU_PALLAS", knob)
+        got = np.asarray(SB.might_contain_device(bf, col))
+        np.testing.assert_array_equal(want, got)
+
+
+def test_bloom_device_all_null(monkeypatch):
+    bf = SB.SparkBloomFilter.optimal(128, 0.03)
+    vals = np.arange(64, dtype=np.int64)
+    bf.put(Column.from_numpy(vals, INT64, None))
+    col = Column.from_numpy(vals, INT64, np.zeros(64, dtype=bool))
+    for knob in ("0", "1"):
+        monkeypatch.setenv("SRJ_TPU_PALLAS", knob)
+        got = np.asarray(SB.might_contain_device(bf, col))
+        assert not got.any()
+
+
+def test_bloom_device_narrow_int_cast(monkeypatch):
+    """byte/short/int probes cast to long exactly as the host path
+    (negative values sign-extend)."""
+    bf = SB.SparkBloomFilter.optimal(512, 0.03)
+    bf.put(Column.from_numpy(
+        np.arange(-200, 200, dtype=np.int64), INT64, None))
+    for np_dt, dt in ((np.int8, INT8), (np.int16, INT16),
+                     (np.int32, INT32)):
+        probe = np.arange(-120, 120, dtype=np_dt)
+        col = Column.from_numpy(probe, dt, None)
+        want = bf.might_contain(col)
+        monkeypatch.setenv("SRJ_TPU_PALLAS", "1")
+        np.testing.assert_array_equal(
+            want, np.asarray(SB.might_contain_device(bf, col)))
+
+
+def test_bloom_device_rejects_strings():
+    bf = SB.SparkBloomFilter.optimal(16, 0.03)
+    with pytest.raises(ValueError, match="long-castable"):
+        SB.might_contain_device(bf, Column.strings(["a", "b"]))
+
+
+# ---------------------------------------------------------------------------
+# knob / selection plumbing
+# ---------------------------------------------------------------------------
+
+def test_choose_contract(monkeypatch):
+    monkeypatch.delenv("SRJ_TPU_PALLAS", raising=False)
+    # auto off-TPU: generic XLA (tier-1 default behavior unchanged)
+    assert pk.choose("convert_from_rows", "cpu") == ("xla", False)
+    assert pk.choose("convert_from_rows", "tpu") == ("pallas", False)
+    monkeypatch.setenv("SRJ_TPU_PALLAS", "0")
+    assert pk.choose("xxhash64", "tpu") == ("xla", False)
+    monkeypatch.setenv("SRJ_TPU_PALLAS", "1")
+    # forced on off-TPU runs in interpret mode
+    assert pk.choose("xxhash64", "cpu") == ("pallas", True)
+    assert pk.choose("xxhash64", "tpu") == ("pallas", False)
+    # unsupported ops never route to pallas
+    assert pk.choose("get_json", "tpu") == ("xla", False)
+
+
+def test_vmem_tile_pow2():
+    """Tile negotiation returns pow-2 row tiles inside [floor, cap] so
+    pow-2 row buckets divide evenly (no tile-tail padding on top of
+    bucket padding)."""
+    for bpr in (1, 3, 17, 64, 513, 4096):
+        t = shapes.vmem_tile(bpr)
+        assert t & (t - 1) == 0
+        assert 32 <= t <= 4096
+    assert shapes.vmem_tile(1 << 30) == 32        # floor
+    assert shapes.vmem_tile(1) == 4096            # cap
+
+
+def test_span_impl_attribution(monkeypatch, tmp_path):
+    """The decode span carries ``impl=pallas`` under knob=1 and
+    ``impl=xla`` under knob=0 — the attribute the costmodel ledger and
+    ``obs profile`` split on."""
+    import json
+    from spark_rapids_jni_tpu import obs
+
+    events = tmp_path / "events.jsonl"
+    rng = np.random.default_rng(1)
+    t = Table(_make_cols(rng, [INT32, INT64], 40))
+    batch = rc.convert_to_rows(t)[0]
+    obs.enable(sink=str(events))
+    try:
+        monkeypatch.setenv("SRJ_TPU_PALLAS", "1")
+        rc.convert_from_rows(batch, [INT32, INT64])
+        monkeypatch.setenv("SRJ_TPU_PALLAS", "0")
+        rc.convert_from_rows(batch, [INT32, INT64])
+        obs.flush()
+    finally:
+        obs.disable()
+    impls = [e.get("impl") for line in events.read_text().splitlines()
+             for e in [json.loads(line)]
+             if e.get("kind") == "span"
+             and e.get("name") == "convert_from_rows"]
+    assert impls == ["pallas", "xla"], impls
+
+
+def test_costmodel_splits_cells_per_impl():
+    from spark_rapids_jni_tpu.obs import costmodel
+
+    led = costmodel.Ledger()
+    for impl in ("pallas", "xla"):
+        led.observe({"kind": "span", "name": "convert_from_rows",
+                     "bucket": 1024, "impl": impl, "wall_s": 0.5,
+                     "device_s": 0.5, "bytes": 1 << 20, "rows": 1024})
+    rows = led.profile(ceiling=100.0)
+    assert {r["impl"] for r in rows} == {"pallas", "xla"}
+    assert all(r["op"] == "convert_from_rows" for r in rows)
+    # rendering tolerates baselines dumped before the impl split
+    legacy = [{k: v for k, v in r.items() if k != "impl"} for r in rows]
+    text = costmodel.render_profile(rows, baseline=legacy)
+    assert "[pallas]" in text and "[xla]" in text

@@ -143,6 +143,26 @@ def test_rows_identity_vs_convert_to_rows(sched):
         assert np.array_equal(np.asarray(r["rows"]).reshape(-1), db)
 
 
+def test_unrows_roundtrips_to_rows(sched):
+    """The decode op inverts the pack op through the serving loop: the
+    columns that went in come back out, whichever engine the
+    SRJ_TPU_PALLAS knob selects for the decode."""
+    rng = np.random.default_rng(31)
+    c = serve.Client(sched, "alice")
+    for ncols, n in [(5, 13), (3, 100), (1, 1)]:
+        cols = [rng.integers(-2**31, 2**31 - 1, n).astype(np.int32)
+                for _ in range(ncols)]
+        f = c.to_rows(cols)
+        sched.tick()
+        packed = f.result(timeout=30)
+        f = c.from_rows(packed["rows"], ncols)
+        sched.tick()
+        r = f.result(timeout=30)
+        assert r["num_rows"] == n
+        for ci in range(ncols):
+            assert np.array_equal(r["columns"][ci], cols[ci])
+
+
 # ---------------------------------------------------------------------------
 # Coalescing: K same-bucket requests -> ONE dispatch, programs bounded by
 # the bucket grid (the compile-telemetry acceptance guard)
