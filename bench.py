@@ -82,6 +82,21 @@ class CalibrationError(BenchError):
     not that any kernel is slow."""
 
 
+class BenchLegTimeout(BenchError):
+    """A leg blew through its wall-clock budget
+    (``SRJ_TPU_BENCH_LEG_TIMEOUT_S``) and was abandoned.  The worker
+    thread may still be wedged inside a device call — daemonized, so
+    the round proceeds and process exit is not held hostage — but its
+    result is discarded either way: a leg that finishes after its
+    budget has already failed."""
+
+    def __init__(self, op, budget_s):
+        super().__init__(
+            f"bench leg {op!r} exceeded its {budget_s:.0f}s wall budget")
+        self.op = op
+        self.budget_s = budget_s
+
+
 # the axis run's trace context: _run_axis roots it, _leg_span activates
 # it around every leg, and the per-axis obs digest records its trace_id
 _AXIS_TRACE = None
@@ -106,6 +121,57 @@ def _new_bundles(before):
     return path if path != before else None
 
 
+def _leg_budget_s():
+    """Per-leg wall-clock budget (``SRJ_TPU_BENCH_LEG_TIMEOUT_S``,
+    default 1800 s; <= 0 disables).  Exists because a single hung leg —
+    a wedged relay window, a device call that never completes — used to
+    stall the whole round past the driver's patience with zero record
+    of which op hung."""
+    try:
+        return float(os.environ.get("SRJ_TPU_BENCH_LEG_TIMEOUT_S", "")
+                     or 1800.0)
+    except ValueError:
+        return 1800.0
+
+
+def _run_leg_bounded(name, thunk):
+    """Run one leg body in a worker thread under the wall budget; on
+    overrun, dump a ``leg_timeout`` flight-recorder bundle (when armed)
+    and raise :class:`BenchLegTimeout` so `_leg` records the hang as a
+    structured failure instead of stalling the round."""
+    import threading
+    budget = _leg_budget_s()
+    if budget <= 0:
+        return thunk()
+    box = {}
+
+    def _worker():
+        try:
+            box["out"] = thunk()
+        except BaseException as e:   # noqa: BLE001 — re-raised below
+            box["err"] = e
+
+    t = threading.Thread(target=_worker, name=f"bench-leg-{name}",
+                         daemon=True)
+    t.start()
+    t.join(budget)
+    if t.is_alive():
+        try:
+            from spark_rapids_jni_tpu.obs import recorder
+            if recorder.armed():
+                recorder.dump_bundle("leg_timeout", {
+                    "kind": "span", "name": f"leg.{name}",
+                    "status": "error", "op": name, "wall_s": budget,
+                    "error_type": "BenchLegTimeout",
+                    "error": f"exceeded {budget:.0f}s wall budget"})
+        except Exception:
+            pass
+        raise BenchLegTimeout(name, budget)
+    if "err" in box:
+        raise box["err"]
+    return box["out"]
+
+
 def _leg(name, fn, leg_errors=None, *, label=None, required=False, **kw):
     """One timing leg under an obs span: wall/device time, compile count,
     and (on death) the structured exception all land in the event log —
@@ -115,12 +181,19 @@ def _leg(name, fn, leg_errors=None, *, label=None, required=False, **kw):
     the leg returns ``None`` (a partial axis record beats none — the 1M
     from-rows leg has died through whole bad relay windows while every
     other leg passed); ``required`` legs re-raise as
-    :class:`BenchLegError` so the axis error names the op."""
+    :class:`BenchLegError` so the axis error names the op.  The whole
+    leg (span and all) runs in a budget-bounded worker thread
+    (:func:`_run_leg_bounded`) — ``_leg_span`` activates the axis trace
+    explicitly, so spans land in the right trace from that thread."""
     from spark_rapids_jni_tpu.obs import recorder
     b0 = recorder.last_bundle()
-    try:
+
+    def _body():
         with _leg_span(name):
             return _time(fn, label=label or name, **kw)
+
+    try:
+        return _run_leg_bounded(name, _body)
     except Exception as e:
         bundle = _new_bundles(b0)
         if required or leg_errors is None:

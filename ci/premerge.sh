@@ -244,8 +244,9 @@ PY
 rm -f "$TRACE_EVENTS" "$TRACE_OUT"
 
 # flight-recorder smoke: arm the recorder via env, push one injected
-# fault through the serving path's coalesced batch, and assert exactly
-# ONE diagnostics bundle lands and the --bundle CLI renders it; then
+# fault through the serving path's coalesced batch (retries pinned off
+# so resilient dispatch can't absorb it), and assert exactly ONE
+# diagnostics bundle lands and the --bundle CLI renders it; then
 # write a second (fake host 1) sink and check the merged two-host trace
 # against the Perfetto schema — phases legal, flow s/f ids paired, one
 # process lane per host
@@ -254,6 +255,7 @@ FR_H0=$(mktemp /tmp/srj_fr_smoke.XXXXXX.host0.jsonl)
 FR_H1=$(mktemp /tmp/srj_fr_smoke.XXXXXX.host1.jsonl)
 FR_MERGED=$(mktemp /tmp/srj_fr_smoke.XXXXXX.trace.json)
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu SRJ_TPU_DIAG_DIR="$FR_DIAG" \
+  SRJ_TPU_RETRY_MAX=1 \
   SRJ_TPU_HOST=0 SRJ_TPU_EVENTS="$FR_H0" python - <<'PY'
 import numpy as np
 from spark_rapids_jni_tpu import faultinj, obs, serve
@@ -408,3 +410,64 @@ if python ci/regress_gate.py --current /tmp/srj_gate_selftest.json \
 fi
 rm -f /tmp/srj_gate_selftest.json
 python ci/regress_gate.py --history . --mode advisory
+
+# resilience smoke: the serving demo under an injected transient fault
+# must complete with zero tenant-visible errors (the retry absorbs it),
+# srj_tpu_retry_total must advance, and the breaker must stay closed;
+# then a forced-open breaker must show up on a /metrics scrape
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  SRJ_TPU_RETRY_BASE_S=0.001 SRJ_TPU_RETRY_CAP_S=0.01 python - <<'PY'
+import numpy as np
+from spark_rapids_jni_tpu import faultinj, obs, serve
+from spark_rapids_jni_tpu.obs import metrics
+from spark_rapids_jni_tpu.runtime import resilience
+
+obs.enable()
+rng = np.random.default_rng(11)
+with serve.Scheduler() as sched:
+    cs = [serve.Client(sched, f"t{i}") for i in range(3)]
+    data = [(rng.integers(0, 16, 40 + i).astype(np.int32),
+             rng.integers(-5, 5, 40 + i).astype(np.int32))
+            for i in range(3)]
+    st = faultinj.install(config={})
+    try:
+        warm = [c.aggregate(k, v, max_groups=32)
+                for c, (k, v) in zip(cs, data)]
+        for f in warm:
+            f.result(timeout=60)
+        st.apply_config({"pjrtExecuteFaults": {
+            "*": {"percent": 100, "injectionType": 1,
+                  "interceptionCount": 1}}})
+        futs = [c.aggregate(k, v, max_groups=32)
+                for c, (k, v) in zip(cs, data)]
+        errs = sum(1 for f in futs
+                   if f.exception(timeout=60) is not None)
+    finally:
+        faultinj.uninstall()
+assert errs == 0, f"resilient serve leaked {errs} tenant errors"
+
+def total(name):
+    vals = metrics.registry().snapshot().get(name, {}).get("values", {})
+    return sum(v for v in vals.values() if isinstance(v, (int, float)))
+
+retries = total("srj_tpu_retry_total")
+assert retries >= 1, "injected transient produced no retries"
+assert total("srj_tpu_serve_request_failures_total") == 0
+assert all(b.state == resilience.CLOSED
+           for b in resilience.breakers().values()), \
+    "a single transient must not open a breaker"
+
+# forced-open breaker is visible on a metrics scrape and /healthz
+resilience.breaker("smoke_op", "sig", 64, "pallas").force_open()
+text = metrics.format_prometheus()
+line = next(l for l in text.splitlines()
+            if l.startswith("srj_tpu_breaker_state")
+            and 'op="smoke_op"' in l)
+assert line.endswith(" 1"), line
+assert any("smoke_op" in k for k in resilience.health()["open"])
+assert not resilience.allow_impl("smoke_op", impl="pallas")
+resilience.reset_breakers()
+print(f"resilience smoke: 3 tenants clean under injected transient "
+      f"({int(retries)} retr{'y' if retries == 1 else 'ies'}, breaker "
+      f"closed); forced-open breaker visible on scrape")
+PY

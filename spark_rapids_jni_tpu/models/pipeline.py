@@ -762,11 +762,17 @@ def hash_aggregate_table(source, key_idxs: Sequence[int],
         # bakes in _ADAPTIVE_AGG_ON and the adaptive callee, so flipping
         # or patching either (tests do both) must force a retrace, not
         # replay a stale trace
-        return _hash_aggregate_jit(source, mask, tuple(key_idxs),
-                                   tuple((i, op) for i, op in measures),
-                                   max_groups,
-                                   (_ADAPTIVE_AGG_ON,
-                                    _hash_aggregate_adaptive))
+        # retry-only resilient dispatch (runtime/resilience.py): a
+        # transient execute fault re-runs the whole bucketed program —
+        # inputs are already staged host-independent device arrays, so
+        # the replay is a pure re-dispatch.  No splitter: a group-by is
+        # a cross-row reduction, halving its rows would change results.
+        from spark_rapids_jni_tpu.runtime import resilience
+        return resilience.run(
+            "hash_aggregate", _hash_aggregate_jit, source, mask,
+            tuple(key_idxs), tuple((i, op) for i, op in measures),
+            max_groups, (_ADAPTIVE_AGG_ON, _hash_aggregate_adaptive),
+            sig=(len(key_idxs), len(measures), max_groups), bucket=b)
     live = jnp.ones((n,), jnp.bool_) if mask is None else mask
 
     key_cols = [_source_column(source, i) for i in key_idxs]

@@ -217,7 +217,8 @@ def ceiling_GBps(path: Optional[str] = None) -> Tuple[float, str]:
 # ---------------------------------------------------------------------------
 
 _CELL_FIELDS = ("calls", "errors", "wall_s", "device_s", "bytes", "rows",
-                "padded_rows", "padded_bytes", "compiles", "compile_s")
+                "padded_rows", "padded_bytes", "compiles", "compile_s",
+                "retries", "retry_s")
 
 
 class Ledger:
@@ -250,7 +251,7 @@ class Ledger:
                     c["errors"] += 1
                 for field in ("wall_s", "device_s", "bytes", "rows",
                               "padded_rows", "padded_bytes", "compiles",
-                              "compile_s"):
+                              "compile_s", "retries", "retry_s"):
                     v = ev.get(field)
                     if isinstance(v, (int, float)):
                         c[field] += float(v)
@@ -285,6 +286,12 @@ class Ledger:
             "padded_rows": int(c["padded_rows"]),
             "pad_waste_pct": (100.0 * c["padded_rows"] / total_rows
                               if total_rows > 0 else 0.0),
+            # resilience attribution (runtime/resilience.py stamps
+            # retries/retry_s on the op span): what share of the cell's
+            # wall went to re-attempts and backoff sleeps
+            "retries": int(c["retries"]),
+            "retry_overhead_pct": (100.0 * c["retry_s"] / wall
+                                   if wall > 0 else 0.0),
         }
         return row
 
@@ -477,7 +484,9 @@ def _fmt_row(r: Dict, base: Optional[Dict] = None) -> str:
             f"{r['bytes']:>14} {r['achieved_GBps']:>9.2f} "
             f"{r['ceiling_GBps']:>9.1f} {r['pct_of_calibration']:>6.1f}"
             f"{delta} {r['pad_waste_pct']:>7.1f} "
-            f"{100.0 * r['compile_amortization']:>9.1f}")
+            f"{100.0 * r['compile_amortization']:>9.1f} "
+            f"{r.get('retries', 0):>7} "
+            f"{r.get('retry_overhead_pct', 0.0):>7.1f}")
 
 
 def render_profile(rows: List[Dict],
@@ -487,7 +496,8 @@ def render_profile(rows: List[Dict],
     dcol = "   Δpct" if baseline is not None else ""
     head = (f"{'op@bucket':<40} {'calls':>6} {'dev_ms':>10} "
             f"{'bytes':>14} {'GB/s':>9} {'ceil':>9} {'pct':>6}"
-            f"{dcol} {'pad%':>7} {'compile%':>9}")
+            f"{dcol} {'pad%':>7} {'compile%':>9} {'retries':>7} "
+            f"{'retry%':>7}")
     lines = [head, "-" * len(head)]
     bmap = {}
     if baseline is not None:

@@ -220,7 +220,7 @@ def _repro(ev: Dict, program_keys: List[Tuple]) -> Dict:
             "rows", "requests", "tenant", "tenants", "error_type",
             "error", "device_dead", "trace_id", "span_id",
             "parent_span_id", "links", "link_trace_ids", "host",
-            "thread", "deadline_ms")
+            "thread", "deadline_ms", "retry_history")
     r = {k: ev[k] for k in keep if k in ev}
     r["programs"] = [list(k) for k in program_keys]
     return r

@@ -554,7 +554,12 @@ def _get_json_object_impl(col: Column, path: str,
     # cast_string punt pattern): string values containing escapes
     # (must decode), and container values (Spark returns NORMALIZED
     # json -- re-serialized without insignificant whitespace)
-    outs = _gjo_device_jit(ch, col.validity, segs, W, mkl)
+    # retry-only resilient dispatch: transient execute faults re-run
+    # the one jitted automaton pass (runtime/resilience.py)
+    from spark_rapids_jni_tpu.runtime import resilience
+    outs = resilience.run("get_json_object", _gjo_device_jit, ch,
+                          col.validity, segs, W, mkl,
+                          sig=(len(segs),), bucket=W)
     return _finish_device_result(col, path, outs)
 
 

@@ -357,12 +357,18 @@ def _dispatch_hash(op: str, pcols, seed: int, Wb: int, xla_jit):
     bucket already applied).  Pallas covers fixed-width non-nested
     columns only (``Wb == 0``); anything else stays on the XLA chain.
     Either way the span is stamped with ``impl=`` and the program is
-    registered with the flight recorder under ``(op, sig, bucket)``."""
+    registered with the flight recorder under ``(op, sig, bucket)``.
+
+    The Pallas path runs under :func:`runtime.resilience.run` with the
+    XLA chain as its twin: transients retry, deterministic Pallas
+    failures fall through to XLA in the same call, and the per-``(op,
+    sig, bucket)`` circuit breaker quarantines a kernel whose failure
+    rate crosses the threshold (both lowerings are bit-exact by
+    construction, so the fallback is invisible to callers)."""
     from spark_rapids_jni_tpu.ops import pallas_kernels
     impl, interp = pallas_kernels.choose(op, jax.default_backend())
     if impl == "pallas" and Wb == 0 \
             and pallas_kernels.hashable_fixed(pcols):
-        pallas_kernels.stamp_impl("pallas")
         b = pcols[0].num_rows
         sig = (len(pcols), tuple(str(c.dtype) for c in pcols))
         if op == "murmur3_hash":
@@ -378,7 +384,18 @@ def _dispatch_hash(op: str, pcols, seed: int, Wb: int, xla_jit):
             op, sig, b,
             lambda *ls: fn(jax.tree_util.tree_unflatten(treedef, ls)),
             tuple(leaves), impl="pallas")
-        return fn(pcols)
+
+        def _primary(cols):
+            pallas_kernels.stamp_impl("pallas")
+            return fn(cols)
+
+        def _twin(cols):
+            pallas_kernels.stamp_impl("xla")
+            return xla_jit(cols, seed, Wb)
+
+        from spark_rapids_jni_tpu.runtime import resilience
+        return resilience.run(op, _primary, pcols, sig=sig, bucket=b,
+                              impl="pallas", fallback=_twin)
     pallas_kernels.stamp_impl("xla")
     return xla_jit(pcols, seed, Wb)
 

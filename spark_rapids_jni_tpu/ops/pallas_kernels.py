@@ -80,12 +80,25 @@ def choose(op: str, platform: Optional[str] = None) -> Tuple[str, bool]:
 
     ``impl`` is ``"pallas"`` or ``"xla"``; ``interpret`` is True when the
     Pallas kernel should run in interpret mode (off-TPU platforms — the
-    CPU tier-1 mesh exercises the kernels this way)."""
+    CPU tier-1 mesh exercises the kernels this way).
+
+    The knob decides *preference*; :mod:`runtime.resilience` decides
+    *eligibility*: when a circuit breaker has quarantined the op's
+    Pallas kernel (failure rate over threshold — see
+    ``srj_tpu_breaker_*`` on ``/metrics``), this routes to the XLA twin
+    until the breaker's half-open probe closes it, even under
+    ``SRJ_TPU_PALLAS=1``."""
     if platform is None:
         platform = jax.default_backend()
     k = knob()
     if k == "0" or op not in SUPPORTED_OPS:
         return "xla", False
+    try:
+        from spark_rapids_jni_tpu.runtime import resilience
+        if not resilience.allow_impl(op, impl="pallas"):
+            return "xla", False
+    except Exception:   # breaker lookup must never break selection
+        pass
     if k == "1":
         return "pallas", platform != "tpu"
     return ("pallas", False) if platform == "tpu" else ("xla", False)

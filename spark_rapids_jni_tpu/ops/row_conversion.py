@@ -660,6 +660,7 @@ def _convert_from_rows_impl(rows: RowsColumn, dtypes: Sequence[DType],
     pallas_kernels.stamp_impl("xla" if impl == "xla" else "pallas")
     sig = (layout.num_columns, layout.fixed_row_size)
     if impl == "pallas":
+        from spark_rapids_jni_tpu.runtime import resilience
         rows2d = rows.rows2d(layout.fixed_row_size)
         interp = platform != "tpu"
         pallas_kernels.register(
@@ -667,8 +668,24 @@ def _convert_from_rows_impl(rows: RowsColumn, dtypes: Sequence[DType],
             lambda r2d: pallas_kernels.from_rows_fixed(
                 r2d, layout, interpret=interp),
             (rows2d,), impl="pallas")
-        cols = pallas_kernels.from_rows_fixed(rows2d, layout,
-                                              interpret=interp)
+
+        # resilient dispatch with the generic XLA decode as the twin:
+        # a deterministic Pallas failure (the BENCH_r05 lowering
+        # rejection class) falls through in the same call, and the
+        # (op, sig, bucket) breaker quarantines a kernel whose failure
+        # rate crosses the threshold
+        def _primary(r2d):
+            pallas_kernels.stamp_impl("pallas")
+            return pallas_kernels.from_rows_fixed(r2d, layout,
+                                                  interpret=interp)
+
+        def _twin(r2d):
+            pallas_kernels.stamp_impl("xla")
+            return _from_rows_fixed_jit(r2d, layout)
+
+        cols = resilience.run("convert_from_rows", _primary, rows2d,
+                              sig=sig, bucket=n, impl="pallas",
+                              fallback=_twin)
     elif impl == "mxu":
         from spark_rapids_jni_tpu.ops import row_mxu
         if rows.data.size != n * layout.fixed_row_size:

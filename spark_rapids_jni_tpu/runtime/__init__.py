@@ -1,8 +1,10 @@
 """Runtime policies that sit between operator entry points and their
 jitted kernels — the shape-bucketing policy
-(:mod:`~spark_rapids_jni_tpu.runtime.shapes`) and the coalesced
+(:mod:`~spark_rapids_jni_tpu.runtime.shapes`), the coalesced
 host↔device transfer layer
-(:mod:`~spark_rapids_jni_tpu.runtime.staging`)."""
+(:mod:`~spark_rapids_jni_tpu.runtime.staging`), and the resilient
+dispatch layer (:mod:`~spark_rapids_jni_tpu.runtime.resilience`)."""
 
+from spark_rapids_jni_tpu.runtime import resilience  # noqa: F401
 from spark_rapids_jni_tpu.runtime import shapes  # noqa: F401
 from spark_rapids_jni_tpu.runtime import staging  # noqa: F401

@@ -1,0 +1,735 @@
+"""Resilient dispatch: classified retries, OOM splitting, circuit
+breakers, deadline propagation.
+
+The fault-injection tool (:mod:`~spark_rapids_jni_tpu.faultinj`), the
+flight recorder, and the SLO engine built the *diagnosis* half of
+robustness; this module is the *recovery* half.  Every wrapped jitted
+program execution (pipeline entries, the row codecs, hashing, the serve
+scheduler's coalesced groups) goes through :func:`run`, which applies
+four policies:
+
+**Error taxonomy** (:func:`classify`).  Exceptions fold into four
+classes, each with its own recovery:
+
+======================  =====================================  =========
+class                   examples                               recovery
+======================  =====================================  =========
+``transient``           injected device assert, ``ABORTED`` /  retry with
+                        ``UNAVAILABLE`` / device-busy runtime  backoff
+                        errors, injected return-code faults
+``resource``            ``RESOURCE_EXHAUSTED`` / HBM OOM,      split the
+                        injected fault with return code 2      batch
+                        (``cudaErrorMemoryAllocation``)
+``deterministic``       shape/dtype/lowering errors,           fall back
+                        ``INVALID_ARGUMENT``, ``UNIMPLEMENTED``to the XLA
+                                                               twin, else
+                                                               raise
+``fatal``               injected device trap, "device          bundle +
+                        unusable" rejections                   device
+                                                               reset +
+                                                               replay
+======================  =====================================  =========
+
+**Retry** (transients): exponential backoff with *decorrelated jitter*
+(``sleep = min(cap, uniform(base, 3 * prev))``), bounded by
+``max_attempts`` AND a per-op wall-clock budget, AND the caller's
+deadline when one is propagated.  Every retry stamps the ambient span
+(``retries`` / ``retry_reason`` / ``retry_s``) so the roofline ledger
+attributes retry overhead per ``op@bucket[impl]``.
+
+**OOM graceful degradation** (resource): when the caller provides a
+:class:`ArraySplitter` (or the serve scheduler recurses on the request
+axis), the batch is halved along the row axis and each half re-runs.
+Halves of a pow-2 bucket land back on the :mod:`runtime.shapes` grid, so
+degradation never compiles a new program shape; results are merged by
+concatenation, byte-identical to the unsplit run (per-row / per-slot
+kernels only — a cross-row reduction must not pass a splitter).
+
+**Circuit breakers**: one :class:`Breaker` per ``(op, sig, bucket,
+impl)``.  A Pallas kernel whose recent failure rate crosses the
+threshold is quarantined — :func:`allow` returns False, callers (and
+``pallas_kernels.choose()``) route to the XLA twin — until the cooldown
+elapses, after which *half-open* probes are let through one at a time; a
+probe success closes the breaker, a failure re-opens it.  Breaker state
+is exported at scrape time (``srj_tpu_breaker_*``) and on ``/healthz``
+under the ``resilience`` sub-document.
+
+**Fatal recovery**: a fatal classification dumps ONE flight-recorder
+bundle carrying the full retry history (``reason="fatal"``), calls
+``faultinj.reset_device()`` to clear the sticky device-dead flag, and
+replays the attempt — the wrapped thunk re-stages its inputs from the
+host-side staging arena (host buffers outlive the device), so the replay
+re-ships everything the dead device lost.
+
+Env knobs (all read per call, so tests and operators can flip them
+live):
+
+- ``SRJ_TPU_RETRY_MAX`` — attempts per op, incl. the first (default 3)
+- ``SRJ_TPU_RETRY_BASE_S`` / ``SRJ_TPU_RETRY_CAP_S`` — decorrelated
+  jitter bounds (defaults 0.05 / 2.0)
+- ``SRJ_TPU_RETRY_BUDGET_S`` — per-op retry wall budget (default 30)
+- ``SRJ_TPU_RETRY_FATAL`` — 0 disables fatal device-reset replay
+- ``SRJ_TPU_BREAKER_THRESHOLD`` — failure rate opening a breaker
+  (default 0.5)
+- ``SRJ_TPU_BREAKER_WINDOW`` — outcomes tracked per breaker (default 8)
+- ``SRJ_TPU_BREAKER_MIN_CALLS`` — volume floor before a breaker can
+  open (default 4)
+- ``SRJ_TPU_BREAKER_COOLDOWN_S`` — open → half-open delay (default 30)
+
+Everything here is host-side control flow: under a jit trace
+:func:`run` is a plain tail call (retrying inside a traced program is
+meaningless), and like the rest of the runtime it never lets its own
+bookkeeping take down the operation it protects.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_jni_tpu.utils import metrics as _um
+
+__all__ = [
+    "TRANSIENT", "RESOURCE", "DETERMINISTIC", "FATAL",
+    "DeadlineExceeded", "classify", "Policy", "default_policy",
+    "Breaker", "breaker", "breakers", "allow_impl", "reset_breakers",
+    "ArraySplitter", "run", "remaining", "health",
+]
+
+TRANSIENT = "transient"
+RESOURCE = "resource"
+DETERMINISTIC = "deterministic"
+FATAL = "fatal"
+
+# injected return code classified as device OOM: the reference tool
+# substitutes CUresult codes, and cudaErrorMemoryAllocation == 2 — so a
+# faultinj rule {"injectionType": 2, "substituteReturnCode": 2} is the
+# chaos-injectable HBM OOM (tests/test_resilience.py drives the
+# split-and-merge path through exactly this rule)
+OOM_RETURN_CODE = 2
+
+_RESOURCE_TOKENS = ("RESOURCE_EXHAUSTED", "OUT OF MEMORY", "OOM",
+                    "ALLOCATION FAILURE", "FAILED TO ALLOCATE")
+_TRANSIENT_TOKENS = ("ABORTED", "UNAVAILABLE", "DEVICE BUSY",
+                     "CONNECTION RESET", "SOCKET CLOSED",
+                     "TRY AGAIN", "TEMPORARILY")
+_FATAL_TOKENS = ("DEVICE UNUSABLE", "DEVICE DEAD", "DEVICE HALTED",
+                 "DATA_LOSS")
+
+
+class DeadlineExceeded(TimeoutError):
+    """The caller's deadline expired before (or while) the op ran.  The
+    work was dropped or abandoned — never half-applied: expiry is always
+    checked *between* attempts, before any dispatch."""
+
+    def __init__(self, op: str, waited_s: float = 0.0):
+        super().__init__(
+            f"{op}: deadline exceeded after {waited_s * 1e3:.1f} ms")
+        self.op = op
+        self.waited_s = waited_s
+
+
+def classify(exc: BaseException) -> str:
+    """Fold one exception into the four-class taxonomy (module
+    docstring).  Unknown errors classify *deterministic* — the safe
+    default: no retry, no fallback masking a real bug."""
+    try:
+        from spark_rapids_jni_tpu import faultinj
+        if isinstance(exc, faultinj.FatalDeviceError):
+            return FATAL
+        if isinstance(exc, faultinj.DeviceAssertError):
+            return TRANSIENT
+        if isinstance(exc, faultinj.InjectedRuntimeError):
+            return RESOURCE if exc.code == OOM_RETURN_CODE else TRANSIENT
+    except Exception:
+        pass
+    if isinstance(exc, DeadlineExceeded):
+        return DETERMINISTIC          # never retried, never fallbacked
+    if isinstance(exc, MemoryError):
+        return RESOURCE
+    msg = str(exc).upper()
+    if any(t in msg for t in _FATAL_TOKENS):
+        return FATAL
+    if any(t in msg for t in _RESOURCE_TOKENS):
+        return RESOURCE
+    if any(t in msg for t in _TRANSIENT_TOKENS):
+        return TRANSIENT
+    return DETERMINISTIC
+
+
+# ---------------------------------------------------------------------------
+# Retry policy
+# ---------------------------------------------------------------------------
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass
+class Policy:
+    """Retry tuning for one :func:`run` call; defaults from env."""
+
+    max_attempts: int = dataclasses.field(
+        default_factory=lambda: max(1, _env_int("SRJ_TPU_RETRY_MAX", 3)))
+    base_s: float = dataclasses.field(
+        default_factory=lambda: _env_float("SRJ_TPU_RETRY_BASE_S", 0.05))
+    cap_s: float = dataclasses.field(
+        default_factory=lambda: _env_float("SRJ_TPU_RETRY_CAP_S", 2.0))
+    budget_s: float = dataclasses.field(
+        default_factory=lambda: _env_float("SRJ_TPU_RETRY_BUDGET_S", 30.0))
+    fatal_recovery: bool = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "SRJ_TPU_RETRY_FATAL", "1") not in ("0", "off", "false"))
+
+
+def default_policy() -> Policy:
+    return Policy()
+
+
+_RNG = random.Random()
+
+
+def backoff_s(prev: float, policy: Policy) -> float:
+    """Decorrelated jitter: uniform over [base, 3*prev], capped.  Unlike
+    plain exponential+jitter, consecutive sleeps decorrelate across
+    concurrent clients hammering the same resource (the AWS architecture
+    blog's winner), while still growing geometrically in expectation."""
+    hi = max(policy.base_s, 3.0 * prev)
+    return min(policy.cap_s, _RNG.uniform(policy.base_s, hi))
+
+
+def remaining(deadline: Optional[float]) -> Optional[float]:
+    """Seconds left until an absolute ``time.monotonic()`` deadline
+    (None = no deadline)."""
+    return None if deadline is None else deadline - time.monotonic()
+
+
+# ---------------------------------------------------------------------------
+# Circuit breakers
+# ---------------------------------------------------------------------------
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+_STATE_CODE = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
+
+
+class Breaker:
+    """Failure-rate circuit breaker for one ``(op, sig, bucket, impl)``
+    implementation cell.
+
+    Closed: everything runs.  When the failure rate over the last
+    ``window`` outcomes reaches ``threshold`` (with at least
+    ``min_calls`` outcomes seen), the breaker opens: :meth:`allow`
+    returns False and callers route to the fallback implementation.
+    After ``cooldown_s`` the breaker is half-open: probes are let
+    through one per ``probe_interval_s`` (a probe that never reports
+    back cannot wedge the breaker — the next interval grants another).
+    A successful probe closes the breaker and clears its window; a
+    failed one re-opens it for a fresh cooldown."""
+
+    def __init__(self, key: Tuple[str, str, str, str],
+                 threshold: Optional[float] = None,
+                 window: Optional[int] = None,
+                 min_calls: Optional[int] = None,
+                 cooldown_s: Optional[float] = None):
+        self.key = key
+        self.threshold = (threshold if threshold is not None
+                          else _env_float("SRJ_TPU_BREAKER_THRESHOLD", 0.5))
+        self.window = (window if window is not None
+                       else max(1, _env_int("SRJ_TPU_BREAKER_WINDOW", 8)))
+        self.min_calls = (min_calls if min_calls is not None
+                          else max(1, _env_int("SRJ_TPU_BREAKER_MIN_CALLS",
+                                               4)))
+        self.cooldown_s = (cooldown_s if cooldown_s is not None
+                           else _env_float("SRJ_TPU_BREAKER_COOLDOWN_S",
+                                           30.0))
+        self._lock = threading.Lock()
+        self._outcomes: collections.deque = collections.deque(
+            maxlen=self.window)
+        self._opened_at: Optional[float] = None
+        self._last_probe: Optional[float] = None
+
+    # -- state ------------------------------------------------------------
+
+    def _state_locked(self, now: float) -> str:
+        if self._opened_at is None:
+            return CLOSED
+        if now - self._opened_at >= self.cooldown_s:
+            return HALF_OPEN
+        return OPEN
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state_locked(time.monotonic())
+
+    def allow(self) -> bool:
+        """True when the primary implementation may run now (closed, or
+        a half-open probe slot is available); False routes the caller to
+        its fallback."""
+        now = time.monotonic()
+        with self._lock:
+            st = self._state_locked(now)
+            if st == CLOSED:
+                return True
+            if st == OPEN:
+                return False
+            # half-open: one probe per interval; grant is timestamped so
+            # a vanished prober self-heals after the next interval
+            interval = max(self.cooldown_s / 4.0, 1e-3)
+            if self._last_probe is None or now - self._last_probe >= interval:
+                self._last_probe = now
+                _fam()["probes"].inc(op=self.key[0], outcome="granted")
+                return True
+            return False
+
+    def record(self, ok: bool) -> None:
+        """Report one primary-implementation outcome."""
+        now = time.monotonic()
+        with self._lock:
+            st = self._state_locked(now)
+            if st == HALF_OPEN:
+                if ok:                    # probe success: close + forget
+                    self._opened_at = None
+                    self._last_probe = None
+                    self._outcomes.clear()
+                    _fam()["probes"].inc(op=self.key[0], outcome="closed")
+                else:                     # probe failure: fresh cooldown
+                    self._opened_at = now
+                    self._last_probe = None
+                    _fam()["probes"].inc(op=self.key[0], outcome="reopened")
+                return
+            self._outcomes.append(bool(ok))
+            if st == CLOSED and not ok:
+                n = len(self._outcomes)
+                fails = sum(1 for o in self._outcomes if not o)
+                if n >= self.min_calls and fails / n >= self.threshold:
+                    self._opened_at = now
+                    _fam()["opens"].inc(op=self.key[0], impl=self.key[3])
+
+    def force_open(self) -> None:
+        """Quarantine immediately (operational kill switch / tests)."""
+        with self._lock:
+            self._opened_at = time.monotonic()
+            self._last_probe = None
+
+    def reset(self) -> None:
+        with self._lock:
+            self._opened_at = None
+            self._last_probe = None
+            self._outcomes.clear()
+
+
+_BREAKERS: Dict[Tuple[str, str, str, str], Breaker] = {}
+_BREAKERS_LOCK = threading.Lock()
+_HEALTH_REGISTERED = False
+_HOOK_INSTALLED = False
+
+
+def _key(op: str, sig: Any = "", bucket: Any = "",
+         impl: str = "pallas") -> Tuple[str, str, str, str]:
+    return (str(op), str(sig), str(bucket), str(impl))
+
+
+def breaker(op: str, sig: Any = "", bucket: Any = "",
+            impl: str = "pallas") -> Breaker:
+    """The process-wide breaker for one implementation cell (created on
+    first use; also lazily registers the ``/healthz`` provider and the
+    scrape-time gauge hook)."""
+    key = _key(op, sig, bucket, impl)
+    with _BREAKERS_LOCK:
+        b = _BREAKERS.get(key)
+        if b is None:
+            b = _BREAKERS[key] = Breaker(key)
+    _ensure_exported()
+    return b
+
+
+def breakers() -> Dict[Tuple[str, str, str, str], Breaker]:
+    """Snapshot of the live breaker registry."""
+    with _BREAKERS_LOCK:
+        return dict(_BREAKERS)
+
+
+def reset_breakers() -> None:
+    """Drop every breaker (tests)."""
+    with _BREAKERS_LOCK:
+        _BREAKERS.clear()
+
+
+def allow_impl(op: str, sig: Any = "", bucket: Any = "",
+               impl: str = "pallas") -> bool:
+    """Routing peek for ``pallas_kernels.choose()``: False when a
+    breaker quarantines this implementation *now*.  With a full key,
+    consults that exact cell; with the default wildcard ``sig``/
+    ``bucket`` it answers for the op as a whole — any open cell for
+    ``(op, impl)`` routes the op away (a sig-blind dispatch site must
+    not re-enter a kernel some bucket proved poisonous).  Half-open
+    cells grant probes through the same throttle :meth:`Breaker.allow`
+    applies, so recovery works from sig-blind sites too."""
+    with _BREAKERS_LOCK:
+        if str(sig) or str(bucket):
+            cells = [_BREAKERS.get(_key(op, sig, bucket, impl))]
+        else:
+            cells = [b for k, b in _BREAKERS.items()
+                     if k[0] == str(op) and k[3] == str(impl)]
+    for b in cells:
+        if b is not None and not b.allow():
+            return False
+    return True
+
+
+def health() -> Dict:
+    """The ``/healthz`` ``resilience`` sub-document: every non-closed
+    breaker by name, plus registry size."""
+    snap = breakers()
+    states = {"|".join(k): b.state for k, b in snap.items()}
+    return {
+        "breakers": len(snap),
+        "open": sorted(k for k, s in states.items() if s == OPEN),
+        "half_open": sorted(k for k, s in states.items()
+                            if s == HALF_OPEN),
+    }
+
+
+def _publish_gauges() -> None:
+    """Collect hook: refresh ``srj_tpu_breaker_state`` right before
+    every scrape (0 closed / 1 open / 2 half-open)."""
+    try:
+        from spark_rapids_jni_tpu.obs import metrics as _m
+        g = _m.gauge(
+            "srj_tpu_breaker_state",
+            "Circuit-breaker state per implementation cell "
+            "(0=closed, 1=open, 2=half_open).",
+            ("op", "sig", "bucket", "impl"))
+        for (op, sig, bucket, impl), b in breakers().items():
+            g.set(_STATE_CODE[b.state], op=op, sig=sig, bucket=bucket,
+                  impl=impl)
+    except Exception:
+        pass
+
+
+def _ensure_exported() -> None:
+    global _HEALTH_REGISTERED, _HOOK_INSTALLED
+    if not _HOOK_INSTALLED:
+        try:
+            from spark_rapids_jni_tpu.obs import metrics as _m
+            _m.register_collect_hook(_publish_gauges)
+            _HOOK_INSTALLED = True
+        except Exception:
+            pass
+    if not _HEALTH_REGISTERED:
+        try:
+            from spark_rapids_jni_tpu.obs import exporter as _exporter
+            _exporter.register_health_provider("resilience", health)
+            _HEALTH_REGISTERED = True
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Metrics
+# ---------------------------------------------------------------------------
+
+def _fam():
+    from spark_rapids_jni_tpu.obs import metrics as m
+    return {
+        "retries": m.counter(
+            "srj_tpu_retry_total",
+            "Dispatch retries, by op and failure class.",
+            ("op", "reason")),
+        "backoff": m.counter(
+            "srj_tpu_retry_backoff_seconds_total",
+            "Wall seconds slept in retry backoff, by op.", ("op",)),
+        "splits": m.counter(
+            "srj_tpu_oom_splits_total",
+            "Resource-exhaustion batch halvings, by op.", ("op",)),
+        "fatal": m.counter(
+            "srj_tpu_fatal_recoveries_total",
+            "Fatal-fault device resets followed by replay, by op.",
+            ("op",)),
+        "opens": m.counter(
+            "srj_tpu_breaker_open_total",
+            "Breaker transitions to open, by op and impl.",
+            ("op", "impl")),
+        "fallbacks": m.counter(
+            "srj_tpu_breaker_fallbacks_total",
+            "Dispatches routed to the fallback implementation by an "
+            "open breaker, by op.", ("op",)),
+        "probes": m.counter(
+            "srj_tpu_breaker_probes_total",
+            "Half-open probe grants and outcomes, by op.",
+            ("op", "outcome")),
+        "exhausted": m.counter(
+            "srj_tpu_retry_exhausted_total",
+            "Ops that failed after every allowed attempt, by op and "
+            "final failure class.", ("op", "reason")),
+    }
+
+
+# ---------------------------------------------------------------------------
+# OOM batch splitting
+# ---------------------------------------------------------------------------
+
+class ArraySplitter:
+    """Row-axis split/merge for per-row kernels whose positional args
+    share a leading row axis.
+
+    ``split`` halves every array argument at ``n // 2`` (non-array args
+    pass through both halves); ``merge`` concatenates result leaves back
+    in order — byte-identical to the unsplit run for any kernel whose
+    row *i* output depends only on row *i* input.  Halves of a pow-2
+    shape-bucket re-bucket onto the same :mod:`runtime.shapes` grid, so
+    degradation re-uses already-compiled programs.  Do NOT pass a
+    splitter for cross-row reductions (aggregation, joins) — the serve
+    scheduler splits those on the *request* axis instead, where slots
+    are independent by construction."""
+
+    def __init__(self, min_rows: int = 1):
+        self.min_rows = max(1, int(min_rows))
+
+    @staticmethod
+    def _rows(args: Sequence[Any]) -> Optional[int]:
+        for a in args:
+            if hasattr(a, "shape") and getattr(a, "ndim", 0) >= 1:
+                return int(a.shape[0])
+        return None
+
+    def can_split(self, args: Sequence[Any]) -> bool:
+        n = self._rows(args)
+        return n is not None and n >= 2 * self.min_rows
+
+    def split(self, args: Sequence[Any]
+              ) -> Tuple[Tuple[Any, ...], Tuple[Any, ...]]:
+        n = self._rows(args)
+        mid = n // 2
+        lo, hi = [], []
+        for a in args:
+            if hasattr(a, "shape") and getattr(a, "ndim", 0) >= 1 \
+                    and int(a.shape[0]) == n:
+                lo.append(a[:mid])
+                hi.append(a[mid:])
+            else:
+                lo.append(a)
+                hi.append(a)
+        return tuple(lo), tuple(hi)
+
+    def merge(self, lo: Any, hi: Any) -> Any:
+        if isinstance(lo, (tuple, list)):
+            merged = [self.merge(a, b) for a, b in zip(lo, hi)]
+            return type(lo)(merged)
+        if hasattr(lo, "shape") and getattr(lo, "ndim", 0) >= 1:
+            if isinstance(lo, np.ndarray):
+                return np.concatenate([lo, hi], axis=0)
+            import jax.numpy as jnp
+            return jnp.concatenate([np.asarray(lo), np.asarray(hi)],
+                                   axis=0) if False else \
+                jnp.concatenate([lo, hi], axis=0)
+        return lo
+
+
+# ---------------------------------------------------------------------------
+# The resilient dispatch wrapper
+# ---------------------------------------------------------------------------
+
+def _stamp(attempts: int, reason: Optional[str], retry_s: float,
+           brk: Optional[Breaker], used_fallback: bool) -> None:
+    """Retry attribution on the ambient span (ledger fields — see
+    ``obs/costmodel.py``): only stamped when something actually
+    happened, so the fault-free hot path writes no attrs."""
+    try:
+        from spark_rapids_jni_tpu.obs import spans
+        sp = spans.current_span()
+        if sp is None:
+            return
+        attrs: Dict[str, Any] = {}
+        if attempts > 1:
+            attrs["retries"] = attempts - 1
+            attrs["retry_s"] = retry_s
+        if reason is not None:
+            attrs["retry_reason"] = reason
+        if brk is not None:
+            attrs["breaker_state"] = brk.state
+        if used_fallback:
+            attrs["breaker_fallback"] = True
+        if attrs:
+            sp.set(**attrs)
+    except Exception:
+        pass
+
+
+def _fatal_bundle(op: str, sig: Any, bucket: Any, impl: str,
+                  err: BaseException, history: List[Dict]) -> None:
+    """ONE flight-recorder bundle per fatal recovery, carrying the full
+    retry history (disarmed recorder: no-op)."""
+    try:
+        from spark_rapids_jni_tpu.obs import recorder
+        if not recorder.armed():
+            return
+        ev = {"kind": "span", "name": op, "status": "error",
+              "op": op, "sig": str(sig), "bucket": bucket, "impl": impl,
+              "error_type": type(err).__name__, "error": str(err)[:300],
+              "retry_history": history, "device_dead": True}
+        recorder.dump_bundle("fatal", ev)
+    except Exception:
+        pass
+
+
+def _reset_device() -> bool:
+    try:
+        from spark_rapids_jni_tpu import faultinj
+        faultinj.reset_device()
+        return True
+    except Exception:
+        return False
+
+
+def run(op: str, fn: Callable, *args,
+        sig: Any = "", bucket: Any = "", impl: str = "",
+        fallback: Optional[Callable] = None,
+        splitter: Optional[ArraySplitter] = None,
+        policy: Optional[Policy] = None,
+        deadline: Optional[float] = None,
+        kwargs: Optional[Dict[str, Any]] = None) -> Any:
+    """Execute ``fn(*args, **kwargs)`` under the resilience policies.
+
+    ``fallback``: the XLA-twin callable (same signature) used when the
+    ``(op, sig, bucket, impl)`` breaker is open or a deterministic
+    failure hits a breaker-tracked implementation.  ``splitter``:
+    row-axis OOM degradation (per-row kernels only).  ``deadline``: an
+    absolute ``time.monotonic()`` instant; expiry between attempts
+    raises :class:`DeadlineExceeded` (the serve scheduler propagates
+    each request's submit-time deadline here, so retry loops can never
+    outlive the caller's patience).  Under a jit trace this is a plain
+    tail call — resilience is host-side policy, not program content."""
+    kwargs = kwargs or {}
+    if not _um.eager():
+        return fn(*args, **kwargs)
+    policy = policy or default_policy()
+    brk = breaker(op, sig, bucket, impl) if (fallback is not None
+                                             and impl) else None
+    fam = _fam()
+    t0 = time.monotonic()
+    stop_at = t0 + policy.budget_s
+    if deadline is not None:
+        stop_at = min(stop_at, deadline)
+
+    history: List[Dict] = []
+    attempts = 0
+    prev_sleep = policy.base_s
+    last_reason: Optional[str] = None
+    use_fallback = False
+    if brk is not None and not brk.allow():
+        use_fallback = True
+        fam["fallbacks"].inc(op=op)
+
+    while True:
+        if deadline is not None and time.monotonic() >= deadline:
+            _stamp(attempts + 1, last_reason, time.monotonic() - t0,
+                   brk, use_fallback)
+            raise DeadlineExceeded(op, time.monotonic() - t0)
+        target = fallback if use_fallback else fn
+        attempts += 1
+        try:
+            out = target(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001 — classified below
+            cls = classify(e)
+            last_reason = cls
+            history.append({
+                "attempt": attempts,
+                "impl": "fallback" if use_fallback else (impl or "?"),
+                "class": cls, "error_type": type(e).__name__,
+                "error": str(e)[:200]})
+            if brk is not None and not use_fallback and cls != RESOURCE:
+                brk.record(False)
+
+            if cls == RESOURCE:
+                if splitter is not None and splitter.can_split(args):
+                    fam["splits"].inc(op=op)
+                    _stamp(attempts, cls, time.monotonic() - t0, brk,
+                           use_fallback)
+                    lo_args, hi_args = splitter.split(args)
+                    common = dict(sig=sig, bucket=bucket, impl=impl,
+                                  fallback=fallback, splitter=splitter,
+                                  policy=policy, deadline=deadline,
+                                  kwargs=kwargs)
+                    lo = run(op, fn, *lo_args, **common)
+                    hi = run(op, fn, *hi_args, **common)
+                    return splitter.merge(lo, hi)
+                # unsplittable OOM: retrying the same footprint can
+                # still win once transient co-residents free, so fall
+                # through to the transient retry path
+
+            elif cls == FATAL:
+                _fatal_bundle(op, sig, bucket, impl, e, history)
+                if not (policy.fatal_recovery
+                        and attempts < policy.max_attempts
+                        and time.monotonic() < stop_at
+                        and _reset_device()):
+                    fam["exhausted"].inc(op=op, reason=cls)
+                    _stamp(attempts, cls, time.monotonic() - t0, brk,
+                           use_fallback)
+                    raise
+                fam["fatal"].inc(op=op)
+                # replay restages: the thunk re-packs and re-ships its
+                # host buffers through the staging arena on every call
+
+            elif cls == DETERMINISTIC:
+                # a deterministic failure can only be saved by the twin
+                if fallback is not None and not use_fallback:
+                    use_fallback = True
+                    fam["fallbacks"].inc(op=op)
+                    fam["retries"].inc(op=op, reason=cls)
+                    continue            # immediately, no backoff
+                fam["exhausted"].inc(op=op, reason=cls)
+                _stamp(attempts, cls, time.monotonic() - t0, brk,
+                       use_fallback)
+                raise
+
+            # transient (and unsplittable-resource) retry gate
+            if attempts >= policy.max_attempts \
+                    or time.monotonic() >= stop_at:
+                # last resort for a breaker-tracked impl: the twin
+                if fallback is not None and not use_fallback \
+                        and brk is not None and not brk.allow():
+                    use_fallback = True
+                    fam["fallbacks"].inc(op=op)
+                    continue
+                fam["exhausted"].inc(op=op, reason=cls)
+                _stamp(attempts, cls, time.monotonic() - t0, brk,
+                       use_fallback)
+                raise
+            if cls != FATAL:            # fatal replays immediately
+                sleep = backoff_s(prev_sleep, policy)
+                sleep = max(0.0, min(sleep,
+                                     stop_at - time.monotonic()))
+                if sleep > 0:
+                    fam["backoff"].inc(sleep, op=op)
+                    time.sleep(sleep)
+                prev_sleep = max(sleep, policy.base_s)
+            fam["retries"].inc(op=op, reason=cls)
+            continue
+
+        if brk is not None and not use_fallback:
+            brk.record(True)
+        _stamp(attempts, last_reason, time.monotonic() - t0, brk,
+               use_fallback)
+        return out

@@ -32,28 +32,44 @@ class Client:
             yield ctx
 
     def aggregate(self, keys, values,
-                  max_groups: Optional[int] = None):
+                  max_groups: Optional[int] = None,
+                  deadline_s: Optional[float] = None):
         """Group-by-sum; resolves to ``{group_keys, sums, have,
-        num_groups}`` (arrays sized ``max_groups``)."""
+        num_groups}`` (arrays sized ``max_groups``).
+
+        ``deadline_s`` (here and on every method below) bounds the
+        request's total queue+dispatch time: past it the scheduler drops
+        the request *before* staging and its future carries
+        :class:`runtime.resilience.DeadlineExceeded`.  Omitted, the
+        ``SRJ_TPU_SERVE_DEADLINE_MS`` scheduler default applies."""
         kw = {} if max_groups is None else {"max_groups": max_groups}
+        if deadline_s is not None:
+            kw["deadline_s"] = deadline_s
         return self._sched.submit(self.tenant, "agg", keys=keys,
                                   values=values, **kw)
 
-    def join(self, build_keys, build_payload, probe_keys):
+    def join(self, build_keys, build_payload, probe_keys,
+             deadline_s: Optional[float] = None):
         """Unique-key equi-join; resolves to ``{payload, matched}``
         aligned with ``probe_keys`` (unmatched payload slots are 0)."""
+        kw = {} if deadline_s is None else {"deadline_s": deadline_s}
         return self._sched.submit(
             self.tenant, "join", build_keys=build_keys,
-            build_payload=build_payload, probe_keys=probe_keys)
+            build_payload=build_payload, probe_keys=probe_keys, **kw)
 
-    def to_rows(self, columns: Sequence):
+    def to_rows(self, columns: Sequence,
+                deadline_s: Optional[float] = None):
         """JCUDF fixed-width row conversion of all-valid int32 columns;
         resolves to ``{rows, row_size, num_rows}`` (flat uint8)."""
-        return self._sched.submit(self.tenant, "rows", columns=columns)
+        kw = {} if deadline_s is None else {"deadline_s": deadline_s}
+        return self._sched.submit(self.tenant, "rows", columns=columns,
+                                  **kw)
 
-    def from_rows(self, rows, ncols: int):
+    def from_rows(self, rows, ncols: int,
+                  deadline_s: Optional[float] = None):
         """JCUDF row decode back to ``ncols`` all-valid int32 columns
         (the inverse of :meth:`to_rows`); resolves to ``{columns,
         num_rows}``.  ``rows``: flat uint8 blob or ``[n, row_size]``."""
+        kw = {} if deadline_s is None else {"deadline_s": deadline_s}
         return self._sched.submit(self.tenant, "unrows", rows=rows,
-                                  ncols=ncols)
+                                  ncols=ncols, **kw)

@@ -282,18 +282,30 @@ def _unrows_kernel(ncols: int, b: int, kb: int):
     from spark_rapids_jni_tpu.ops import row_conversion as rc
     layout, _ = _rows_layout(ncols)
     rs = layout.fixed_row_size
-    # the decode engine is the same knob-gated choice the direct
-    # convert_from_rows path makes, so serving picks up the Pallas
-    # kernel automatically where it is on
-    impl, interp = pallas_kernels.choose("convert_from_rows",
-                                         jax.default_backend())
 
     def _serve_unrows(rows):                    # [kb, b, rs] uint8
+        # the decode engine is the same knob-gated choice the direct
+        # convert_from_rows path makes — resolved PER CALL (not at
+        # closure-build time) so a circuit breaker that quarantines the
+        # Pallas kernel mid-flight reroutes the very next dispatch to
+        # the XLA twin without evicting this cached closure
+        impl, interp = pallas_kernels.choose("convert_from_rows",
+                                             jax.default_backend())
         flat = rows.reshape(kb * b, rs)
         if impl == "pallas":
-            cols = pallas_kernels.from_rows_fixed(flat, layout,
-                                                  interpret=interp)
+            from spark_rapids_jni_tpu.runtime import resilience
+            pallas_kernels.stamp_impl("pallas")
+            brk = resilience.breaker("convert_from_rows", (ncols, rs),
+                                     kb * b, "pallas")
+            try:
+                cols = pallas_kernels.from_rows_fixed(flat, layout,
+                                                      interpret=interp)
+            except Exception:
+                brk.record(False)       # serving failures feed the same
+                raise                   # quarantine choose() consults
+            brk.record(True)
         else:
+            pallas_kernels.stamp_impl("xla")
             cols = rc._from_rows_fixed_jit(flat, layout)
         data = jnp.stack([c.data for c in cols])    # [ncols, kb*b]
         return (data.reshape(ncols, kb, b).transpose(1, 0, 2),)

@@ -59,7 +59,11 @@ class Request:
     ``nbytes`` feed the per-tenant counters; ``t_submit`` anchors the
     queue-latency histogram.  ``trace`` is the request's
     :class:`obs.context.TraceContext` — the scheduler stamps it into the
-    request span and links the coalesced batch span back to it."""
+    request span and links the coalesced batch span back to it.
+    ``deadline`` is an absolute ``time.monotonic()`` instant (None =
+    unbounded): the scheduler drops an expired request *before* staging
+    (status ``deadline_exceeded``, never dispatched) and retry loops
+    under the dispatch respect the remaining budget."""
 
     tenant: str
     op: str
@@ -70,6 +74,7 @@ class Request:
     nbytes: int
     t_submit: float = dataclasses.field(default_factory=time.perf_counter)
     trace: Any = None
+    deadline: Optional[float] = None
 
 
 class RequestQueue:

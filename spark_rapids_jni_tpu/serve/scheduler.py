@@ -54,8 +54,22 @@ Env knobs (all overridable via :class:`Config`):
 - ``SRJ_TPU_SERVE_MAX_BATCH`` — max requests drained per tick (default
   0 = unlimited; bounding it makes the queue's low-water hysteresis
   meaningful, since depth then falls gradually instead of to zero)
+- ``SRJ_TPU_SERVE_DEADLINE_MS`` — default per-request deadline (0 =
+  unbounded); ``Client.submit``'s ``deadline_s`` overrides per request
 - ``SRJ_TPU_WATCHDOG_MS`` — tick stall deadline for the flight-recorder
   watchdog (default 0 = disabled; see :mod:`obs.recorder`)
+
+Resilience (see :mod:`runtime.resilience`): every group dispatch runs
+under :func:`resilience.run` — transient faults (an injected device
+assert, a device-busy error) retry with decorrelated-jitter backoff
+instead of poisoning the group, bounded by the group's tightest request
+deadline; a ``RESOURCE_EXHAUSTED`` that survives retries splits the
+group in half along the *request* axis and recurses (halves re-bucket
+onto the same pow-2 slot grid, so degradation compiles nothing new) and
+merges the slot-major outputs byte-identically; a request whose deadline
+expires while queued is dropped before staging with status
+``deadline_exceeded`` (``srj_tpu_serve_deadline_exceeded_total``) and is
+never dispatched.
 
 Tracing: every admitted request gets a :class:`obs.context.TraceContext`
 (joining the submitter's active trace when there is one); resolution
@@ -73,10 +87,13 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+import numpy as np
+
 from spark_rapids_jni_tpu.obs import context as _context
 from spark_rapids_jni_tpu.obs import metrics as _metrics
 from spark_rapids_jni_tpu.obs import recorder as _recorder
 from spark_rapids_jni_tpu.obs import spans as _spans
+from spark_rapids_jni_tpu.runtime import resilience as _resilience
 from spark_rapids_jni_tpu.runtime import shapes, staging
 from spark_rapids_jni_tpu.serve import ops as serve_ops
 from spark_rapids_jni_tpu.serve.queue import QueueFull, Request, RequestQueue
@@ -116,6 +133,9 @@ class Config:
     max_batch: Optional[int] = dataclasses.field(
         default_factory=lambda: (
             _env_int("SRJ_TPU_SERVE_MAX_BATCH", 0) or None))
+    default_deadline_s: Optional[float] = dataclasses.field(
+        default_factory=lambda: (
+            _env_float("SRJ_TPU_SERVE_DEADLINE_MS", 0.0) / 1e3 or None))
 
 
 # -- metric families (created lazily so registry resets don't strand us) ----
@@ -155,6 +175,11 @@ def _fam():
             "srj_tpu_serve_cancelled_total",
             "Requests whose future was cancelled while queued, by op.",
             ("op",)),
+        "deadline": m.counter(
+            "srj_tpu_serve_deadline_exceeded_total",
+            "Requests dropped because their deadline expired while "
+            "queued (never dispatched), by tenant (capped).",
+            ("tenant",)),
         "tick_errors": m.counter(
             "srj_tpu_serve_tick_errors_total",
             "Unexpected scheduler errors survived by the tick loop."),
@@ -262,7 +287,14 @@ class Scheduler:
         """Validate and enqueue one query; raises :class:`QueueFull` on
         admission rejection (including ``reason="slo_burn"`` while a
         shed-enabled SLO objective burns), ``ValueError`` on a malformed
-        payload."""
+        payload.  ``deadline_s`` (popped before op validation) bounds
+        the request's total queue+dispatch time; omitted, the
+        ``SRJ_TPU_SERVE_DEADLINE_MS`` default applies (0 = unbounded)."""
+        deadline_s = kwargs.pop("deadline_s", None)
+        if deadline_s is None:
+            deadline_s = self.config.default_deadline_s
+        deadline = (time.monotonic() + float(deadline_s)
+                    if deadline_s else None)
         # SLO backpressure: while a shed_on_burn objective is burning,
         # reject before validation — the cheapest possible path out
         try:
@@ -285,7 +317,8 @@ class Scheduler:
         rt = _context.root(tenant=str(tenant),
                            trace_id=ctx.trace_id if ctx else None)
         req = Request(tenant=str(tenant), op=op, sig=sig, payload=payload,
-                      future=fut, rows=rows, nbytes=nbytes, trace=rt)
+                      future=fut, rows=rows, nbytes=nbytes, trace=rt,
+                      deadline=deadline)
         try:
             self.queue.submit(req)
         except QueueFull as e:
@@ -364,11 +397,28 @@ class Scheduler:
     def _execute_group(self, op: str, sig, reqs: List[Request]) -> int:
         opdef = serve_ops.get(op)
         t0 = time.perf_counter()
+        # deadline gate FIRST: an expired request is dropped before its
+        # future is even claimed — it never reaches staging, never
+        # forces a compile, and costs the co-batched tenants nothing
+        now = time.monotonic()
+        fresh: List[Request] = []
+        for r in reqs:
+            if r.deadline is not None and now >= r.deadline:
+                err = _resilience.DeadlineExceeded(
+                    op, time.perf_counter() - r.t_submit)
+                if self._resolve(r.future, exc=err):
+                    self._m["deadline"].inc(
+                        tenant=self._tenant_label(r.tenant))
+                    self._finish_request(r, "deadline_exceeded", err=err)
+            else:
+                fresh.append(r)
+        if not fresh:
+            return len(reqs)
         # claim every future (executor protocol): a request cancelled
         # while queued is dropped here, and the survivors can no longer
         # be cancelled mid-scatter
         live: List[Request] = []
-        for r in reqs:
+        for r in fresh:
             if r.future.set_running_or_notify_cancel():
                 live.append(r)
             else:
@@ -378,8 +428,13 @@ class Scheduler:
             return len(reqs)
         for r in live:
             self._m["queue_s"].observe(t0 - r.t_submit, op=op)
+        # retry loops under the dispatch honour the group's tightest
+        # member deadline — one impatient request caps the whole batch's
+        # backoff budget (it would expire anyway)
+        deadlines = [r.deadline for r in live if r.deadline is not None]
+        group_deadline = min(deadlines) if deadlines else None
         try:
-            outs = self._dispatch(opdef, sig, live)
+            outs = self._dispatch(opdef, sig, live, group_deadline)
             for slot, r in enumerate(live):
                 if self._resolve(r.future,
                                  opdef.unbatch(outs, slot, r.payload)):
@@ -396,15 +451,21 @@ class Scheduler:
                     continue
                 self._m["fallbacks"].inc(op=op)
                 try:
-                    outs = self._dispatch(opdef, r.sig, [r])
+                    outs = self._dispatch(opdef, r.sig, [r], r.deadline)
                     if self._resolve(r.future,
                                      opdef.unbatch(outs, 0, r.payload)):
                         self._finish_request(r, "ok")
                 except Exception as e:   # noqa: BLE001 — future carries it
                     if self._resolve(r.future, exc=e):
-                        self._m["failures"].inc(
-                            tenant=self._tenant_label(r.tenant), op=op)
-                        self._finish_request(r, "error", err=e)
+                        if isinstance(e, _resilience.DeadlineExceeded):
+                            self._m["deadline"].inc(
+                                tenant=self._tenant_label(r.tenant))
+                            self._finish_request(
+                                r, "deadline_exceeded", err=e)
+                        else:
+                            self._m["failures"].inc(
+                                tenant=self._tenant_label(r.tenant), op=op)
+                            self._finish_request(r, "error", err=e)
         exec_s = time.perf_counter() - t0
         self._m["exec_s"].observe(exec_s, op=op)
         self._charge(live, exec_s)
@@ -463,9 +524,15 @@ class Scheduler:
             ev["error"] = str(err)[:300]
         _spans.emit(ev)
 
-    def _dispatch(self, opdef, sig, reqs: List[Request]) -> List:
+    def _dispatch(self, opdef, sig, reqs: List[Request],
+                  deadline: Optional[float] = None) -> List:
         """ONE staged transfer, ONE jitted dispatch, ONE fetch for the
-        whole group (the continuous-batching hot path).
+        whole group (the continuous-batching hot path), executed under
+        :func:`runtime.resilience.run` — transients retry with backoff
+        (every attempt re-packs and re-stages from the host payloads, so
+        a fatal device-reset replay re-ships what the device lost), and
+        a resource exhaustion that survives retries degrades through
+        :meth:`_split_dispatch`.
 
         The batch span carries ``links`` (every member request's
         span_id), their trace ids, and the capped tenant set — a
@@ -488,14 +555,61 @@ class Scheduler:
                 {self._tenant_label(r.tenant) for r in reqs})
         with _context.activate(_context.root()):
             with _spans.span(f"serve.{opdef.name}", **attrs) as sp:
-                bufs = opdef.batch(payloads, sig, kb)
-                staged = staging.stage_arrays(bufs)
-                kern = opdef.kernel(sig, kb)
-                _recorder.register_program(opdef.name, sig, kb, kern, staged)
-                outs = kern(*staged)
-                host = staging.fetch_arrays(list(outs))
+                def attempt():
+                    bufs = opdef.batch(payloads, sig, kb)
+                    staged = staging.stage_arrays(bufs)
+                    kern = opdef.kernel(sig, kb)
+                    _recorder.register_program(
+                        opdef.name, sig, kb, kern, staged)
+                    outs = kern(*staged)
+                    return staging.fetch_arrays(list(outs))
+                try:
+                    host = _resilience.run(
+                        f"serve.{opdef.name}", attempt, sig=sig,
+                        bucket=kb, deadline=deadline)
+                except Exception as e:   # noqa: BLE001 — classified below
+                    if (_resilience.classify(e) == _resilience.RESOURCE
+                            and len(reqs) >= 2):
+                        host = self._split_dispatch(
+                            opdef, sig, reqs, deadline)
+                    else:
+                        raise
                 sp.set(rows=sum(p.get("n", 0) for p in payloads))
         return host
+
+    def _split_dispatch(self, opdef, sig, reqs: List[Request],
+                        deadline: Optional[float]) -> List:
+        """Request-axis OOM degradation: halve the group and recurse,
+        then merge the slot-major outputs so slot ``i`` still belongs to
+        request ``i``.  Halves re-bucket onto the same pow-2 slot grid
+        (``bucket_rows`` of a half is itself a grid point), so
+        degradation re-uses already-compiled programs, and per-slot
+        results are byte-identical to the unsplit run because serve
+        batches are independent by construction — slot ``i`` never reads
+        slot ``j``."""
+        mid = len(reqs) // 2
+        n = len(reqs)
+        try:
+            _resilience._fam()["splits"].inc(op=f"serve.{opdef.name}")
+        except Exception:   # noqa: BLE001 — telemetry must not fail a tick
+            pass
+        try:
+            sp = _spans.current_span()
+            if sp is not None:
+                sp.set(oom_split=True)
+        except Exception:   # noqa: BLE001
+            pass
+        lo = self._dispatch(opdef, sig, reqs[:mid], deadline)
+        hi = self._dispatch(opdef, sig, reqs[mid:], deadline)
+        merged: List = []
+        for a, b in zip(lo, hi):
+            if getattr(a, "ndim", 0) >= 1:
+                merged.append(np.concatenate(
+                    [np.asarray(a)[:mid], np.asarray(b)[:n - mid]],
+                    axis=0))
+            else:
+                merged.append(a)
+        return merged
 
     # -- health ------------------------------------------------------------
 

@@ -328,10 +328,15 @@ def test_no_bundle_on_clean_run(obs_on, diag, sched):
     assert _bundles(diag) == []
 
 
-def test_bundle_on_injected_fault_identifies_batch(obs_on, diag, sched):
+def test_bundle_on_injected_fault_identifies_batch(obs_on, diag, sched,
+                                                   monkeypatch):
     """A faultinj fault inside a coalesced batch yields exactly ONE
     bundle whose repro names the (op, sig, slots) and the linked request
-    trace ids/tenants, with the lowered program text alongside."""
+    trace ids/tenants, with the lowered program text alongside.
+
+    Retries pinned OFF so the 2-fault budget still maps onto group +
+    first-fallback dispatch (recovery itself is test_resilience.py)."""
+    monkeypatch.setenv("SRJ_TPU_RETRY_MAX", "1")
     rng = np.random.default_rng(13)
     cs = [serve.Client(sched, f"t{i}") for i in range(3)]
     data = [(rng.integers(0, 16, 40 + i).astype(np.int32),

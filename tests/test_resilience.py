@@ -1,0 +1,587 @@
+"""Resilient-dispatch chaos matrix (``runtime/resilience.py``).
+
+Unit coverage for the error taxonomy, decorrelated-jitter retry,
+row-axis OOM splitting, and the circuit-breaker state machine; then the
+four end-to-end recovery paths the acceptance criteria name, each driven
+through the real serving scheduler or the real op entry points with the
+:mod:`faultinj` injector:
+
+- transient fault → retried to success, co-batched tenants byte-correct,
+  zero tenant-visible errors
+- injected OOM (return-code 2, the ``cudaErrorMemoryAllocation``
+  analogue) → request-axis split-and-merge, byte-identical to unsplit
+- repeated Pallas fault → breaker opens, the XLA twin serves (including
+  via ``choose()``), a half-open probe closes it again
+- expired deadline → dropped before staging, never dispatched, zero
+  compiles
+
+Everything here is subprocess-free (tier-1 budget).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu import faultinj, obs, serve
+from spark_rapids_jni_tpu.models import pipeline
+from spark_rapids_jni_tpu.obs import metrics, recorder
+from spark_rapids_jni_tpu.runtime import resilience, shapes
+from spark_rapids_jni_tpu.table import INT32, Column, Table
+
+
+@pytest.fixture
+def obs_on():
+    obs.configure_sink(None)
+    obs.clear()
+    metrics.registry().reset()
+    obs.enable()
+    yield
+    obs.disable()
+    obs.configure_sink(None)
+    obs.clear()
+    metrics.registry().reset()
+
+
+@pytest.fixture
+def fast_retry(monkeypatch):
+    """Millisecond backoff so chaos tests never sleep for real."""
+    monkeypatch.setenv("SRJ_TPU_RETRY_BASE_S", "0.001")
+    monkeypatch.setenv("SRJ_TPU_RETRY_CAP_S", "0.002")
+
+
+@pytest.fixture
+def breakers_clean():
+    resilience.reset_breakers()
+    yield
+    resilience.reset_breakers()
+
+
+@pytest.fixture
+def sched():
+    s = serve.Scheduler()
+    yield s
+    s.close()
+
+
+def _snap_total(name):
+    vals = metrics.registry().snapshot().get(name, {}).get("values", {})
+    return sum(v for v in vals.values() if isinstance(v, (int, float)))
+
+
+def _direct_agg(keys, vals, max_groups):
+    b = shapes.bucket_rows(len(keys))
+    kp = np.zeros(b, np.int32); kp[:len(keys)] = keys
+    vp = np.zeros(b, np.int32); vp[:len(vals)] = vals
+    m = np.zeros(b, bool); m[:len(keys)] = True
+    gk, s, h, n = pipeline.hash_aggregate_sum(
+        jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(m), max_groups)
+    return np.asarray(gk), np.asarray(s), np.asarray(h), int(n)
+
+
+# ---------------------------------------------------------------------------
+# Taxonomy
+# ---------------------------------------------------------------------------
+
+def test_classify_injected_faults():
+    assert resilience.classify(
+        faultinj.FatalDeviceError("trap")) == resilience.FATAL
+    assert resilience.classify(
+        faultinj.DeviceAssertError("assert")) == resilience.TRANSIENT
+    # return-code 2 is the chaos-injectable HBM OOM
+    assert resilience.classify(
+        faultinj.InjectedRuntimeError("oom", 2)) == resilience.RESOURCE
+    assert resilience.classify(
+        faultinj.InjectedRuntimeError("x", 35)) == resilience.TRANSIENT
+
+
+def test_classify_runtime_messages():
+    assert resilience.classify(RuntimeError(
+        "RESOURCE_EXHAUSTED: failed to allocate 8G")) == resilience.RESOURCE
+    assert resilience.classify(MemoryError()) == resilience.RESOURCE
+    assert resilience.classify(RuntimeError(
+        "ABORTED: device busy")) == resilience.TRANSIENT
+    assert resilience.classify(RuntimeError(
+        "UNAVAILABLE: socket closed")) == resilience.TRANSIENT
+    assert resilience.classify(RuntimeError(
+        "device unusable until restart")) == resilience.FATAL
+    # unknowns are deterministic: never retried, never masked
+    assert resilience.classify(ValueError(
+        "dtype mismatch")) == resilience.DETERMINISTIC
+    assert resilience.classify(TypeError("x")) == resilience.DETERMINISTIC
+    assert resilience.classify(resilience.DeadlineExceeded(
+        "op")) == resilience.DETERMINISTIC
+
+
+# ---------------------------------------------------------------------------
+# Retry policy
+# ---------------------------------------------------------------------------
+
+def test_transient_retried_to_success(fast_retry):
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("UNAVAILABLE: busy")
+        return 41
+
+    assert resilience.run("u.flaky", flaky) == 41
+    assert calls["n"] == 3
+
+
+def test_attempts_bounded(fast_retry, monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_RETRY_MAX", "2")
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise RuntimeError("UNAVAILABLE: busy")
+
+    with pytest.raises(RuntimeError):
+        resilience.run("u.always", always)
+    assert calls["n"] == 2
+
+
+def test_deterministic_never_retried(fast_retry):
+    calls = {"n": 0}
+
+    def bad():
+        calls["n"] += 1
+        raise ValueError("shape mismatch")
+
+    with pytest.raises(ValueError):
+        resilience.run("u.bad", bad)
+    assert calls["n"] == 1
+
+
+def test_deadline_bounds_retries(fast_retry, monkeypatch):
+    # plenty of attempts left in the budget: the deadline must win
+    monkeypatch.setenv("SRJ_TPU_RETRY_MAX", "1000")
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise RuntimeError("UNAVAILABLE: busy")
+
+    with pytest.raises(resilience.DeadlineExceeded):
+        resilience.run("u.dl", always,
+                       deadline=time.monotonic() + 0.01)
+    assert 1 <= calls["n"] < 1000
+
+
+def test_backoff_decorrelated_jitter_bounds():
+    p = resilience.Policy(base_s=0.1, cap_s=1.0)
+    prev = p.base_s
+    for _ in range(100):
+        s = resilience.backoff_s(prev, p)
+        assert p.base_s <= s <= min(p.cap_s, max(p.base_s, 3 * prev))
+        prev = s
+
+
+# ---------------------------------------------------------------------------
+# OOM splitting (unit)
+# ---------------------------------------------------------------------------
+
+def test_split_merge_byte_identity(fast_retry, monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_RETRY_MAX", "1")
+    sp = resilience.ArraySplitter()
+    x = np.arange(64, dtype=np.int64)
+    shapes_seen = []
+
+    def oomy(a):
+        shapes_seen.append(a.shape[0])
+        if a.shape[0] > 16:
+            raise MemoryError("oom")
+        return a * 3
+
+    out = resilience.run("u.oom", oomy, x, splitter=sp)
+    # byte-identical to the unsplit result, recursion bottomed at <= 16
+    assert np.array_equal(out, x * 3)
+    assert out.dtype == x.dtype
+    assert max(s for s in shapes_seen if s <= 16) <= 16
+    # pow-2 halves stay pow-2: every attempt size is on the bucket grid
+    for s in shapes_seen:
+        assert shapes.bucket_rows(s) == s
+    assert _snap_total("srj_tpu_oom_splits_total") >= 1
+
+
+def test_splitter_refuses_tiny_batches():
+    sp = resilience.ArraySplitter(min_rows=8)
+    assert not sp.can_split((np.arange(8),))
+    assert sp.can_split((np.arange(16),))
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker (unit)
+# ---------------------------------------------------------------------------
+
+def test_breaker_opens_routes_probes_closes(breakers_clean, fast_retry,
+                                            monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_RETRY_MAX", "1")
+    b = resilience.breaker("u.brk", "s", 16, "pallas")
+    b.cooldown_s = 0.05
+    state = {"fail": True}
+
+    def primary():
+        if state["fail"]:
+            raise RuntimeError("UNAVAILABLE: kernel fault")
+        return "pallas"
+
+    def twin():
+        return "xla"
+
+    def call():
+        return resilience.run("u.brk", primary, sig="s", bucket=16,
+                              impl="pallas", fallback=twin)
+
+    # failures below min_calls raise through; at the threshold the
+    # breaker opens and the SAME call is served by the twin
+    served = []
+    for _ in range(6):
+        try:
+            served.append(call())
+        except RuntimeError:
+            served.append(None)
+    assert b.state == resilience.OPEN
+    assert served[-1] == "xla"           # open breaker -> twin serves
+    assert call() == "xla"
+    # choose()-style routing peek agrees, both exact and sig-blind
+    assert not resilience.allow_impl("u.brk", "s", 16, "pallas")
+    assert not resilience.allow_impl("u.brk", impl="pallas")
+    # cooldown -> half-open -> successful probe closes it
+    time.sleep(0.06)
+    assert b.state == resilience.HALF_OPEN
+    state["fail"] = False
+    assert call() == "pallas"            # the probe itself
+    assert b.state == resilience.CLOSED
+    assert resilience.allow_impl("u.brk", "s", 16, "pallas")
+    assert _snap_total("srj_tpu_breaker_open_total") >= 1
+    assert _snap_total("srj_tpu_breaker_fallbacks_total") >= 1
+
+
+def test_breaker_failed_probe_reopens(breakers_clean):
+    b = resilience.breaker("u.reopen", "s", 8, "pallas")
+    b.cooldown_s = 0.02
+    b.force_open()
+    time.sleep(0.03)
+    assert b.state == resilience.HALF_OPEN
+    assert b.allow()                     # the probe grant
+    b.record(False)                      # probe fails
+    assert b.state == resilience.OPEN    # fresh cooldown
+
+
+def test_breaker_probe_throttled_not_wedged(breakers_clean):
+    b = resilience.breaker("u.throttle", "s", 8, "pallas")
+    b.cooldown_s = 0.04
+    b.force_open()
+    time.sleep(0.05)
+    assert b.allow()                     # first probe granted
+    assert not b.allow()                 # second immediately throttled
+    # a prober that never reports back cannot wedge the breaker: the
+    # next interval grants another probe
+    time.sleep(0.02)
+    assert b.allow()
+
+
+def test_breaker_state_exported(breakers_clean, obs_on):
+    resilience.breaker("u.scrape", "s", 8, "pallas").force_open()
+    text = metrics.format_prometheus()
+    assert 'srj_tpu_breaker_state{' in text
+    line = next(l for l in text.splitlines()
+                if l.startswith("srj_tpu_breaker_state")
+                and 'op="u.scrape"' in l)
+    assert line.endswith(" 1")           # 1 == open
+    h = resilience.health()
+    assert any("u.scrape" in k for k in h["open"])
+
+
+# ---------------------------------------------------------------------------
+# Chaos: transient → retried to success, co-batched tenants byte-correct
+# ---------------------------------------------------------------------------
+
+def test_serve_transient_retried_all_tenants_clean(obs_on, sched,
+                                                   fast_retry):
+    rng = np.random.default_rng(21)
+    cs = [serve.Client(sched, f"t{i}") for i in range(3)]
+    data = [(rng.integers(0, 16, 50 + i).astype(np.int32),
+             rng.integers(-5, 5, 50 + i).astype(np.int32))
+            for i in range(3)]
+    st = faultinj.install(config={})
+    try:
+        warm = [c.aggregate(k, v, max_groups=48)
+                for c, (k, v) in zip(cs, data)]
+        sched.tick()
+        for f in warm:
+            f.result(timeout=30)
+        # ONE transient fault against the coalesced dispatch: the
+        # resilient retry absorbs it, no tenant ever sees an error
+        st.apply_config({"pjrtExecuteFaults": {
+            "*": {"percent": 100, "injectionType": 1,   # FI_ASSERT
+                  "interceptionCount": 1}}})
+        futs = [c.aggregate(k, v, max_groups=48)
+                for c, (k, v) in zip(cs, data)]
+        sched.tick()
+        for f, (k, v) in zip(futs, data):
+            r = f.result(timeout=30)
+            gk, s, h, n = _direct_agg(k, v, max_groups=48)
+            assert np.array_equal(r["sums"], s)
+            assert np.array_equal(r["group_keys"], gk)
+            assert r["num_groups"] == n
+    finally:
+        faultinj.uninstall()
+    assert _snap_total("srj_tpu_retry_total") >= 1
+    assert _snap_total("srj_tpu_serve_request_failures_total") == 0
+    assert _snap_total("srj_tpu_serve_fallback_requests_total") == 0
+
+
+# ---------------------------------------------------------------------------
+# Chaos: injected OOM → request-axis split-and-merge byte-identity
+# ---------------------------------------------------------------------------
+
+def test_serve_oom_splits_group_byte_identical(obs_on, sched, fast_retry,
+                                               monkeypatch):
+    # retries pinned off so the one RESOURCE fault deterministically
+    # reaches the split path instead of being absorbed by a retry
+    monkeypatch.setenv("SRJ_TPU_RETRY_MAX", "1")
+    rng = np.random.default_rng(22)
+    cs = [serve.Client(sched, f"t{i}") for i in range(4)]
+    data = [(rng.integers(0, 16, 60 + i).astype(np.int32),
+             rng.integers(-5, 5, 60 + i).astype(np.int32))
+            for i in range(4)]
+    st = faultinj.install(config={})
+    try:
+        warm = [c.aggregate(k, v, max_groups=40)
+                for c, (k, v) in zip(cs, data)]
+        sched.tick()
+        for f in warm:
+            f.result(timeout=30)
+        # FI_RETURN_VALUE with code 2 == cudaErrorMemoryAllocation: the
+        # group's first dispatch OOMs, the halves run fault-free
+        st.apply_config({"pjrtExecuteFaults": {
+            "*": {"percent": 100, "injectionType": 2,
+                  "substituteReturnCode": 2,
+                  "interceptionCount": 1}}})
+        futs = [c.aggregate(k, v, max_groups=40)
+                for c, (k, v) in zip(cs, data)]
+        sched.tick()
+        for f, (k, v) in zip(futs, data):
+            r = f.result(timeout=30)
+            gk, s, h, n = _direct_agg(k, v, max_groups=40)
+            assert np.array_equal(r["sums"], s)
+            assert np.array_equal(r["group_keys"], gk)
+            assert np.array_equal(r["have"], h)
+            assert r["num_groups"] == n
+    finally:
+        faultinj.uninstall()
+    assert _snap_total("srj_tpu_oom_splits_total") >= 1
+    assert _snap_total("srj_tpu_serve_request_failures_total") == 0
+
+
+# ---------------------------------------------------------------------------
+# Chaos: repeated Pallas fault → breaker opens, XLA twin serves,
+# half-open probe closes
+# ---------------------------------------------------------------------------
+
+def test_pallas_breaker_opens_twin_serves_probe_closes(
+        breakers_clean, fast_retry, monkeypatch):
+    from spark_rapids_jni_tpu.ops import pallas_kernels
+    from spark_rapids_jni_tpu.ops.row_conversion import (
+        convert_from_rows, convert_to_rows_fixed_width_optimized)
+    monkeypatch.setenv("SRJ_TPU_RETRY_MAX", "1")
+    monkeypatch.setenv("SRJ_TPU_BREAKER_COOLDOWN_S", "0.05")
+    t = Table(tuple(
+        Column.from_numpy(np.arange(20, dtype=np.int32) * (ci + 1), INT32)
+        for ci in range(3)))
+    rows = convert_to_rows_fixed_width_optimized(t)[0]
+    want = [np.asarray(c.data)[:20] for c in t.columns]
+
+    def decode():
+        out = convert_from_rows(rows, [INT32] * 3, impl="pallas")
+        for ci in range(3):
+            assert np.array_equal(
+                np.asarray(out.columns[ci].data)[:20], want[ci])
+
+    decode()                                # healthy warmup
+    real = pallas_kernels.from_rows_fixed
+
+    def broken(*a, **k):
+        raise RuntimeError("UNAVAILABLE: pallas kernel fault")
+
+    monkeypatch.setattr(pallas_kernels, "from_rows_fixed", broken)
+    # repeated kernel failures: before the breaker opens each call is
+    # served by the in-call twin fallback or raises; once the failure
+    # rate crosses the threshold the breaker opens and EVERY subsequent
+    # call routes straight to XLA — byte-identical results throughout
+    for _ in range(6):
+        try:
+            decode()
+        except RuntimeError:
+            pass
+    brk = resilience.breaker(
+        "convert_from_rows", (3, rows.row_size or 16),
+        shapes.bucket_rows(20), "pallas")
+    # the cell key the op layer used: find the open one
+    open_cells = [b for b in resilience.breakers().values()
+                  if b.key[0] == "convert_from_rows"
+                  and b.state != resilience.CLOSED]
+    assert open_cells, resilience.breakers().keys()
+    # with the breaker open, choose() itself routes the op to XLA
+    impl, _ = pallas_kernels.choose("convert_from_rows", "cpu")
+    assert impl == "xla"
+    decode()                                # served byte-correct by twin
+    # cooldown -> half-open; the kernel is healthy again, so the next
+    # dispatch probes Pallas, succeeds, and the breaker closes
+    monkeypatch.setattr(pallas_kernels, "from_rows_fixed", real)
+    time.sleep(0.06)
+    decode()
+    assert all(b.state == resilience.CLOSED for b in open_cells)
+    impl, _ = pallas_kernels.choose("convert_from_rows", "cpu")
+    # knob is auto on CPU -> xla anyway; the point is allow_impl cleared
+    assert resilience.allow_impl("convert_from_rows", impl="pallas")
+
+
+# ---------------------------------------------------------------------------
+# Chaos: expired deadline → dropped pre-dispatch, zero compiles
+# ---------------------------------------------------------------------------
+
+def test_deadline_expired_dropped_before_dispatch(obs_on, sched):
+    rng = np.random.default_rng(23)
+    c = serve.Client(sched, "impatient")
+    k = rng.integers(0, 16, 30).astype(np.int32)
+    v = rng.integers(-5, 5, 30).astype(np.int32)
+    f = c.aggregate(k, v, max_groups=24, deadline_s=0.001)
+    time.sleep(0.01)                        # let it expire while queued
+    obs.clear()
+    sched.tick()
+    with pytest.raises(resilience.DeadlineExceeded):
+        f.result(timeout=5)
+    assert _snap_total("srj_tpu_serve_deadline_exceeded_total") == 1
+    # never dispatched: no batch, no compile, no staging
+    assert _snap_total("srj_tpu_serve_batches_total") == 0
+    assert not [e for e in obs.events("compile")]
+    assert _snap_total("srj_tpu_serve_request_failures_total") == 0
+
+
+def test_default_deadline_env_knob(obs_on, monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_SERVE_DEADLINE_MS", "1")
+    s = serve.Scheduler()
+    try:
+        assert s.config.default_deadline_s == pytest.approx(0.001)
+        rng = np.random.default_rng(24)
+        c = serve.Client(s, "envy")
+        f = c.aggregate(rng.integers(0, 16, 20).astype(np.int32),
+                        rng.integers(-5, 5, 20).astype(np.int32))
+        time.sleep(0.01)
+        s.tick()
+        with pytest.raises(resilience.DeadlineExceeded):
+            f.result(timeout=5)
+    finally:
+        s.close()
+
+
+def test_fresh_requests_unaffected_by_peer_deadline(obs_on, sched):
+    """An expired request in a group is dropped; its co-batched peers
+    still dispatch and resolve byte-correct."""
+    rng = np.random.default_rng(25)
+    a = serve.Client(sched, "patient")
+    b = serve.Client(sched, "impatient")
+    ka = rng.integers(0, 16, 40).astype(np.int32)
+    va = rng.integers(-5, 5, 40).astype(np.int32)
+    kb = rng.integers(0, 16, 41).astype(np.int32)
+    vb = rng.integers(-5, 5, 41).astype(np.int32)
+    fa = a.aggregate(ka, va, max_groups=24)
+    fb = b.aggregate(kb, vb, max_groups=24, deadline_s=0.001)
+    time.sleep(0.01)
+    sched.tick()
+    with pytest.raises(resilience.DeadlineExceeded):
+        fb.result(timeout=5)
+    r = fa.result(timeout=30)
+    gk, s, h, n = _direct_agg(ka, va, max_groups=24)
+    assert np.array_equal(r["sums"], s)
+    assert r["num_groups"] == n
+
+
+# ---------------------------------------------------------------------------
+# Chaos: fatal trap → one bundle with retry history, device reset, replay
+# ---------------------------------------------------------------------------
+
+def test_serve_fatal_trap_reset_and_replayed(obs_on, sched, fast_retry,
+                                             tmp_path):
+    d = tmp_path / "diag"
+    recorder.reset(programs=True)
+    recorder.arm(str(d))
+    rng = np.random.default_rng(26)
+    cs = [serve.Client(sched, f"t{i}") for i in range(2)]
+    data = [(rng.integers(0, 16, 70 + i).astype(np.int32),
+             rng.integers(-5, 5, 70 + i).astype(np.int32))
+            for i in range(2)]
+    st = faultinj.install(config={})
+    try:
+        warm = [c.aggregate(k, v, max_groups=56)
+                for c, (k, v) in zip(cs, data)]
+        sched.tick()
+        for f in warm:
+            f.result(timeout=30)
+        # FI_TRAP: FatalDeviceError, device sticky-dead until reset.
+        # The resilient dispatch bundles, reset_device()s, and replays
+        # from the host-side staging arena — tenants see only success.
+        st.apply_config({"pjrtExecuteFaults": {
+            "*": {"percent": 100, "injectionType": 0,
+                  "interceptionCount": 1}}})
+        futs = [c.aggregate(k, v, max_groups=56)
+                for c, (k, v) in zip(cs, data)]
+        sched.tick()
+        for f, (k, v) in zip(futs, data):
+            r = f.result(timeout=30)
+            gk, s, h, n = _direct_agg(k, v, max_groups=56)
+            assert np.array_equal(r["sums"], s)
+            assert r["num_groups"] == n
+        assert not faultinj.state().device_dead   # reset happened
+    finally:
+        faultinj.uninstall()
+        faultinj.reset_device()
+    assert _snap_total("srj_tpu_fatal_recoveries_total") >= 1
+    assert _snap_total("srj_tpu_serve_request_failures_total") == 0
+    # exactly one fatal bundle, carrying the retry history
+    bundles = [p for p in d.iterdir()
+               if p.name.startswith("bundle-")] if d.exists() else []
+    fatal = [p for p in bundles if "fatal" in p.name]
+    assert len(fatal) == 1
+    import json
+    repro = json.loads((fatal[0] / "repro.json").read_text())
+    assert repro["retry_history"]
+    assert repro["retry_history"][0]["class"] == resilience.FATAL
+    recorder.disarm()
+    recorder.reset(programs=True)
+
+
+# ---------------------------------------------------------------------------
+# Span attribution
+# ---------------------------------------------------------------------------
+
+def test_retry_attrs_stamped_on_span(obs_on, fast_retry):
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 2:
+            raise RuntimeError("ABORTED: transient")
+        return 1
+
+    with obs.span("retried_op", sig="s", bucket=8, impl="xla"):
+        resilience.run("retried_op", flaky)
+    ev = next(e for e in obs.events(kind="span")
+              if e["name"] == "retried_op")
+    assert ev["retries"] == 1
+    assert ev["retry_reason"] == resilience.TRANSIENT
+    assert ev["retry_s"] > 0
+
+    from spark_rapids_jni_tpu.obs import costmodel
+    led = costmodel.Ledger()
+    led.observe(ev)
+    row = led.profile(ceiling=100.0)[0]
+    assert row["retries"] == 1
+    assert row["retry_overhead_pct"] > 0
