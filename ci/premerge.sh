@@ -471,3 +471,78 @@ print(f"resilience smoke: 3 tenants clean under injected transient "
       f"({int(retries)} retr{'y' if retries == 1 else 'ies'}, breaker "
       f"closed); forced-open breaker visible on scrape")
 PY
+
+# memory-pressure smoke: a staged wide-table ingest must advance the
+# srj_tpu_mem_watermark_bytes gauge on a real /metrics scrape; then the
+# serving demo under a forced-low SRJ_TPU_MEM_HEADROOM_BYTES cap must
+# absorb the pressure with PROACTIVE pre-dispatch splits — zero
+# tenant-visible errors, zero reactive OOM splits, results identical to
+# the uncapped burst — and /healthz must carry the memory sub-document
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python - <<'PY'
+import json, os, urllib.request
+import numpy as np
+from spark_rapids_jni_tpu import INT32, Table, obs, serve
+from spark_rapids_jni_tpu.obs import exporter, metrics
+
+obs.enable()
+port = exporter.start(0)
+assert port, "exporter failed to bind"
+
+# 1) staged wide-table ingest advances the watermark on a real scrape
+cols = 212
+t = Table.from_numpy([np.arange(64, dtype=np.int32)] * cols,
+                     [INT32] * cols)
+assert t.num_columns == cols
+body = urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+wm_line = next(l for l in body.splitlines()
+               if l.startswith("srj_tpu_mem_watermark_bytes"))
+wm = float(wm_line.split()[-1])
+assert wm >= cols * 64 * 4, wm_line
+assert "srj_tpu_mem_live_bytes" in body
+assert "srj_tpu_mem_staged_bytes_total" in body
+
+# 2) serving demo: an uncapped coalesced burst trains the footprint
+# model, then the same burst under a forced-low cap must split
+# pre-dispatch (proactive), never reactively, with zero tenant errors
+def total(name):
+    vals = metrics.registry().snapshot().get(name, {}).get("values", {})
+    return sum(v for v in vals.values() if isinstance(v, (int, float)))
+
+rng = np.random.default_rng(23)
+data = [(rng.integers(0, 16, 37).astype(np.int32),
+         rng.integers(-5, 5, 37).astype(np.int32)) for _ in range(8)]
+sched = serve.Scheduler()          # un-started: deterministic ticks
+try:
+    cs = [serve.Client(sched, f"t{i}") for i in range(8)]
+    warm = [c.aggregate(k, v) for c, (k, v) in zip(cs, data)]
+    assert sched.tick() == 8
+    base = [f.result(timeout=60) for f in warm]
+    os.environ["SRJ_TPU_MEM_HEADROOM_BYTES"] = "600"
+    try:
+        futs = [c.aggregate(k, v) for c, (k, v) in zip(cs, data)]
+        assert sched.tick() == 8
+        capped = [f.result(timeout=60) for f in futs]
+    finally:
+        del os.environ["SRJ_TPU_MEM_HEADROOM_BYTES"]
+finally:
+    sched.close()
+for a, b in zip(base, capped):
+    assert np.array_equal(a["sums"], b["sums"])
+    assert a["num_groups"] == b["num_groups"]
+splits = total("srj_tpu_mem_proactive_splits_total")
+assert splits > 0, "capped serve burst took no proactive splits"
+assert total("srj_tpu_oom_splits_total") == 0, "reactive OOM split fired"
+assert total("srj_tpu_serve_request_failures_total") == 0
+
+# 3) /healthz carries the memory sub-document (the fleet-routing signal)
+hz = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+mem = hz["memory"]
+assert mem["watermark_bytes"] >= wm and mem["leak"] is False, mem
+assert "live_bytes" in mem and "highwater_episodes" in mem
+exporter.stop()
+print(f"memory smoke: watermark {int(wm)} B after a 212-col ingest, "
+      f"{int(splits)} proactive splits under a 600 B cap "
+      f"(0 reactive, 0 tenant errors); /healthz memory doc OK")
+PY

@@ -41,7 +41,11 @@ import re
 import sys
 from typing import Dict, List, Optional, Tuple
 
-LOWER_IS_BETTER_UNITS = {"s", "sec", "secs", "seconds", "ms", "us", "ns"}
+# time units and byte units both regress upward: a slower kernel and a
+# fatter memory footprint (the mem_peak_* figures) fail the same way
+LOWER_IS_BETTER_UNITS = {"s", "sec", "secs", "seconds", "ms", "us", "ns",
+                         "b", "bytes", "kb", "kib", "mb", "mib",
+                         "gb", "gib"}
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 _ROOFLINE_RE = re.compile(r"^roofline_(.+)_pct_of_calibration$")

@@ -42,7 +42,7 @@ import numpy as np
 
 __all__ = [
     "HostStagingArena", "DeviceBufferTracker", "default_arena",
-    "device_memory_stats", "log_level",
+    "device_memory_stats", "reset_peak_memory_stats", "log_level",
 ]
 
 logger = logging.getLogger("spark_rapids_jni_tpu.memory")
@@ -210,6 +210,31 @@ def device_memory_stats(device=None) -> Dict[str, int]:
     except Exception:
         stats = None
     return dict(stats) if stats else {}
+
+
+def reset_peak_memory_stats(device=None) -> bool:
+    """Reset the allocator's ``peak_bytes_in_use`` counter where the
+    PJRT backend exposes a reset hook (probed by name — there is no
+    portable API).  Returns True when a reset actually ran; False on
+    backends without the hook (CPU), matching ``device_memory_stats``'s
+    degrade-to-nothing contract."""
+    import jax
+    if device is None:
+        try:
+            device = jax.local_devices()[0]
+        except Exception:
+            return False
+    for name in ("reset_peak_memory_stats", "reset_memory_stats",
+                 "clear_memory_stats"):
+        fn = getattr(device, name, None)
+        if fn is None:
+            continue
+        try:
+            fn()
+            return True
+        except Exception:
+            return False
+    return False
 
 
 class DeviceBufferTracker:

@@ -305,6 +305,19 @@ class Ledger:
         rows = [self._derive(k, c, ceiling) for k, c in cells.items()]
         rows.sort(key=lambda r: (r["device_s"] or r["wall_s"]),
                   reverse=True)
+        # memory columns: predicted peak bytes per cell from the
+        # footprint model plus live headroom (shared across rows) — the
+        # obs-profile view of HBM pressure next to the roofline view
+        try:
+            from spark_rapids_jni_tpu.obs import memwatch as _memwatch
+            hr = _memwatch.headroom_bytes()
+            for r in rows:
+                fp, _src = _memwatch.predicted_bytes(
+                    r["op"], r["sig"], r["bucket"], r.get("impl", ""))
+                r["footprint_bytes"] = fp
+                r["headroom_bytes"] = hr
+        except Exception:
+            pass
         return rows
 
     def hotspots(self, k: int = 10,
@@ -480,13 +493,18 @@ def _fmt_row(r: Dict, base: Optional[Dict] = None) -> str:
     if base is not None:
         d = r["pct_of_calibration"] - base["pct_of_calibration"]
         delta = f" {d:+8.1f}"
+    fp = r.get("footprint_bytes")
+    hr = r.get("headroom_bytes")
+    fps = f"{int(fp):>12}" if isinstance(fp, (int, float)) else f"{'-':>12}"
+    hrs = f"{int(hr):>12}" if isinstance(hr, (int, float)) else f"{'-':>12}"
     return (f"{cell:<40} {r['calls']:>6} {dev_ms:>10.2f} "
             f"{r['bytes']:>14} {r['achieved_GBps']:>9.2f} "
             f"{r['ceiling_GBps']:>9.1f} {r['pct_of_calibration']:>6.1f}"
             f"{delta} {r['pad_waste_pct']:>7.1f} "
             f"{100.0 * r['compile_amortization']:>9.1f} "
             f"{r.get('retries', 0):>7} "
-            f"{r.get('retry_overhead_pct', 0.0):>7.1f}")
+            f"{r.get('retry_overhead_pct', 0.0):>7.1f} "
+            f"{fps} {hrs}")
 
 
 def render_profile(rows: List[Dict],
@@ -497,7 +515,7 @@ def render_profile(rows: List[Dict],
     head = (f"{'op@bucket':<40} {'calls':>6} {'dev_ms':>10} "
             f"{'bytes':>14} {'GB/s':>9} {'ceil':>9} {'pct':>6}"
             f"{dcol} {'pad%':>7} {'compile%':>9} {'retries':>7} "
-            f"{'retry%':>7}")
+            f"{'retry%':>7} {'footprint':>12} {'headroom':>12}")
     lines = [head, "-" * len(head)]
     bmap = {}
     if baseline is not None:

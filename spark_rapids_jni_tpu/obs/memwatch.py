@@ -1,0 +1,766 @@
+"""HBM pressure observability: live ledger, footprint model, OOM avoidance.
+
+The resilience layer (PR 10) reacts to OOMs after the backend throws;
+this module makes memory pressure *visible before dispatch* so the serve
+scheduler and ``resilience.run`` can split pre-emptively instead:
+
+- **Live memory ledger** — fed from span completion (via
+  ``metrics.observe_event``) and ``staging.py`` arena events.  Tracks
+  per-``(op, sig, bucket, impl)`` peak/steady byte deltas, the
+  process-wide live-bytes watermark with high-water *episode* tracking
+  (each episode fires one flight-recorder bundle, keyed past the
+  recorder's dedupe like ``slo.py`` burn bundles), host staging-arena /
+  staged-blob occupancy, and a leak detector that flags monotone
+  live-bytes growth across serve ticks with no matching release.
+
+- **Footprint model** — learns predicted peak bytes per
+  ``(op, sig, bucket, impl)`` cell from observed span deltas
+  (``mem.peak_delta_bytes`` when the PJRT backend exposes peaks,
+  ``mem.delta_bytes`` next, payload bytes as the CPU-backend proxy) and
+  persists them to ``FOOTPRINTS.json`` next to ``CALIBRATION.json`` with
+  the same atomic-write / freshness / provenance discipline as
+  ``obs/costmodel.py`` (``SRJ_TPU_MEM_FOOTPRINT_FILE`` overrides the
+  path, ``SRJ_TPU_MEM_FOOTPRINT_MAX_AGE_S`` the freshness window).
+  Unknown buckets extrapolate linearly along the pow-2 grid from the
+  nearest learned cell of the same op.
+
+- **Proactive OOM avoidance** — :func:`should_split` compares the
+  predicted footprint against live headroom (``bytes_limit`` −
+  ``bytes_in_use`` from the PJRT allocator, or the synthetic
+  ``SRJ_TPU_MEM_HEADROOM_BYTES`` cap on backends without stats).
+  ``serve/scheduler.py`` consults it before opening the dispatch span
+  and ``runtime/resilience.py`` before the first attempt; both split on
+  the pow-2 grid and count ``srj_tpu_mem_proactive_splits_total`` —
+  separate from the reactive ``srj_tpu_oom_splits_total`` so the bench
+  can prove reactive OOMs go to ~zero under injected caps.
+
+- **Surfacing** — ``srj_tpu_mem_*`` gauge/counter families refresh on a
+  collect hook before every ``/metrics`` scrape; a ``memory``
+  sub-document on ``/healthz`` (headroom, watermark, leak flag — the
+  fleet-routing signal); :func:`timeline` feeds the flight recorder's
+  ``memory_timeline.json``; ``obs/trace.py`` renders live/peak counter
+  tracks from the span ``mem`` docs.
+
+Everything is guarded: observing never raises, persistence failures are
+advisory, and with no env cap and no allocator stats the proactive path
+stands down entirely (headroom unknown ⇒ never split).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Deque, Dict, Iterable, List, Optional, Tuple
+
+from spark_rapids_jni_tpu.obs import metrics as _metrics
+
+__all__ = [
+    "observe_span", "sample", "note_staged", "tracker",
+    "live_bytes", "capacity_bytes", "headroom_bytes", "headroom_fraction",
+    "watermark_bytes", "timeline", "leaking", "highwater_episodes",
+    "record_footprint", "predicted_bytes", "should_split",
+    "count_proactive", "proactive_splits",
+    "footprint_path", "save_footprints", "load_footprints",
+    "footprint_cells", "health", "replay", "reset",
+]
+
+_ENV_CAP = "SRJ_TPU_MEM_HEADROOM_BYTES"
+_ENV_FILE = "SRJ_TPU_MEM_FOOTPRINT_FILE"
+_ENV_MAX_AGE = "SRJ_TPU_MEM_FOOTPRINT_MAX_AGE_S"
+_ENV_PROACTIVE = "SRJ_TPU_MEM_PROACTIVE"
+_ENV_SAFETY = "SRJ_TPU_MEM_SAFETY"
+_ENV_RING = "SRJ_TPU_MEM_RING"
+_ENV_LEAK_TICKS = "SRJ_TPU_MEM_LEAK_TICKS"
+_ENV_LEAK_MIN = "SRJ_TPU_MEM_LEAK_MIN_BYTES"
+_ENV_HIGHWATER = "SRJ_TPU_MEM_HIGHWATER_PCT"
+
+_LOCK = threading.Lock()
+
+# footprint cells: (op, sig, bucket, impl) -> {calls, peak_bytes,
+# ewma_bytes, source}; "measured" cells come from allocator deltas,
+# "payload" cells from staged/declared bytes (the CPU-backend proxy)
+_CELLS: Dict[Tuple[str, str, str, str], Dict] = {}
+
+# watermark ring: (ts, live_bytes) samples — the approach-to-the-cliff
+# record that recorder bundles dump as memory_timeline.json
+_RING: Deque[Tuple[float, int]] = collections.deque(maxlen=512)
+_WATERMARK = 0
+_EPISODES = 0
+_IN_EPISODE = False
+_STAGED_PEAK = 0
+
+_EWMA_ALPHA = 0.25
+
+_FILE_LOCK = threading.Lock()
+_FILE_CACHE: Optional[Tuple[str, Optional[Dict]]] = None  # (path, cells)
+
+_SURFACED = False
+
+_TRACKER = None
+_TRACKER_LOCK = threading.Lock()
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _safety() -> float:
+    return max(0.0, _env_float(_ENV_SAFETY, 1.0))
+
+
+def _leak_ticks() -> int:
+    return max(3, _env_int(_ENV_LEAK_TICKS, 8))
+
+
+def _leak_min_bytes() -> int:
+    return max(1, _env_int(_ENV_LEAK_MIN, 1 << 20))
+
+
+def _highwater_pct() -> float:
+    return min(1.0, max(0.0, _env_float(_ENV_HIGHWATER, 0.9)))
+
+
+def proactive_enabled() -> bool:
+    """Proactive splitting is on by default; ``SRJ_TPU_MEM_PROACTIVE=0``
+    stands it down without touching the ledger."""
+    return os.environ.get(_ENV_PROACTIVE, "1") not in ("0", "false", "no")
+
+
+# ---------------------------------------------------------------------------
+# Live bytes / capacity / headroom
+# ---------------------------------------------------------------------------
+
+def tracker():
+    """The process-default :class:`~spark_rapids_jni_tpu.memory.
+    DeviceBufferTracker` counted into the host-side live estimate.
+    Long-lived device buffers registered here are visible to the leak
+    detector even on backends without allocator stats."""
+    global _TRACKER
+    with _TRACKER_LOCK:
+        if _TRACKER is None:
+            from spark_rapids_jni_tpu import memory as _memory
+            _TRACKER = _memory.DeviceBufferTracker()
+        return _TRACKER
+
+
+def _tracker_bytes() -> int:
+    with _TRACKER_LOCK:
+        t = _TRACKER
+    if t is None:
+        return 0
+    try:
+        return int(t.stats().get("current_bytes") or 0)
+    except Exception:
+        return 0
+
+
+def _arena_bytes() -> int:
+    try:
+        from spark_rapids_jni_tpu import memory as _memory
+        return int(_memory.default_arena().stats().get(
+            "current_bytes") or 0)
+    except Exception:
+        return 0
+
+
+def _device_stats() -> Dict:
+    try:
+        from spark_rapids_jni_tpu import memory as _memory
+        return _memory.device_memory_stats()
+    except Exception:
+        return {}
+
+
+def live_bytes() -> int:
+    """Current live bytes: the PJRT allocator's ``bytes_in_use`` when the
+    backend exposes it, otherwise the host-side estimate (staging-arena
+    occupancy + tracked device buffers).  Never raises."""
+    stats = _device_stats()
+    v = stats.get("bytes_in_use")
+    if isinstance(v, (int, float)):
+        return int(v)
+    return _arena_bytes() + _tracker_bytes()
+
+
+def capacity_bytes() -> Optional[int]:
+    """The allocation ceiling to compute headroom against:
+    ``SRJ_TPU_MEM_HEADROOM_BYTES`` (the injected cap — CI/chaos hook and
+    the only capacity source on stat-less backends) wins over the
+    allocator's ``bytes_limit``; ``None`` when neither exists."""
+    raw = os.environ.get(_ENV_CAP)
+    if raw:
+        try:
+            return max(0, int(raw))
+        except ValueError:
+            pass
+    v = _device_stats().get("bytes_limit")
+    if isinstance(v, (int, float)) and v > 0:
+        return int(v)
+    return None
+
+
+def headroom_bytes() -> Optional[int]:
+    """``capacity - live``, floored at zero; ``None`` when capacity is
+    unknown (proactive splitting stands down rather than guessing)."""
+    cap = capacity_bytes()
+    if cap is None:
+        return None
+    return max(0, cap - live_bytes())
+
+
+def headroom_fraction() -> Optional[float]:
+    """Headroom as a fraction of capacity in [0, 1]; ``None`` when
+    capacity is unknown.  The SLO engine's headroom objective reads
+    this."""
+    cap = capacity_bytes()
+    if not cap:
+        return None
+    hr = max(0, cap - live_bytes())
+    return min(1.0, hr / cap)
+
+
+# ---------------------------------------------------------------------------
+# Watermark ring, high-water episodes, leak detector
+# ---------------------------------------------------------------------------
+
+def _ring_resize_locked() -> None:
+    want = max(16, _env_int(_ENV_RING, 512))
+    global _RING
+    if _RING.maxlen != want:
+        _RING = collections.deque(_RING, maxlen=want)
+
+
+def _record_sample(live: int, ts: Optional[float] = None) -> None:
+    global _WATERMARK, _EPISODES, _IN_EPISODE
+    fire = None
+    with _LOCK:
+        _ring_resize_locked()
+        _RING.append((time.time() if ts is None else float(ts),
+                      int(live)))
+        if live > _WATERMARK:
+            _WATERMARK = int(live)
+        cap = capacity_bytes()
+        if cap:
+            pct = _highwater_pct()
+            if live >= pct * cap and not _IN_EPISODE:
+                _IN_EPISODE = True
+                _EPISODES += 1
+                fire = (_EPISODES, live, cap)
+            elif live < pct * cap and _IN_EPISODE:
+                _IN_EPISODE = False
+    if fire is not None:
+        _on_highwater(*fire)
+
+
+def _on_highwater(episode: int, live: int, cap: int) -> None:
+    """One bundle per episode: the reason carries the episode ordinal so
+    the recorder's (reason, name, error_type) dedupe admits each new
+    crossing (same trick as slo.py burn bundles)."""
+    try:
+        _metrics.counter(
+            "srj_tpu_mem_highwater_episodes_total",
+            "High-water-mark episodes (live bytes crossed the "
+            "SRJ_TPU_MEM_HIGHWATER_PCT fraction of capacity).").inc()
+    except Exception:
+        pass
+    try:
+        from spark_rapids_jni_tpu.obs import recorder as _recorder
+        if _recorder.armed():
+            reason = "mem_highwater" if episode <= 1 \
+                else f"mem_highwater-ep{episode}"
+            _recorder.dump_bundle(reason, {
+                "kind": "mem", "name": "memwatch",
+                "live_bytes": int(live), "capacity_bytes": int(cap),
+                "watermark_bytes": int(_WATERMARK),
+                "episode": int(episode),
+            })
+    except Exception:
+        pass
+
+
+def sample(ts: Optional[float] = None) -> int:
+    """Take one watermark sample (the serve scheduler calls this per
+    tick).  Returns the live-bytes value recorded."""
+    _ensure_surfaces()
+    live = live_bytes()
+    _record_sample(live, ts)
+    return live
+
+
+def note_staged(nbytes: int) -> None:
+    """Arena event from ``staging.stage_arrays``: one blob of ``nbytes``
+    is transiently live during the H2D transfer.  Counts staged volume
+    and records a watermark sample with the blob folded in, so staged
+    wide-table ingest advances the watermark even on backends without
+    allocator stats."""
+    try:
+        _ensure_surfaces()
+        n = int(nbytes)
+        if n <= 0:
+            return
+        global _STAGED_PEAK
+        with _LOCK:
+            if n > _STAGED_PEAK:
+                _STAGED_PEAK = n
+        _metrics.counter(
+            "srj_tpu_mem_staged_bytes_total",
+            "Bytes staged through the host arena into device blobs."
+        ).inc(n)
+        _record_sample(live_bytes() + n)
+    except Exception:
+        pass
+
+
+def watermark_bytes() -> int:
+    """Process-wide live-bytes high-water mark."""
+    with _LOCK:
+        return _WATERMARK
+
+
+def highwater_episodes() -> int:
+    with _LOCK:
+        return _EPISODES
+
+
+def timeline() -> List[Dict]:
+    """The last-N watermark samples, oldest first — what recorder
+    bundles dump as ``memory_timeline.json``."""
+    with _LOCK:
+        return [{"ts": ts, "live_bytes": lv} for ts, lv in _RING]
+
+
+def leaking() -> bool:
+    """True when the last ``SRJ_TPU_MEM_LEAK_TICKS`` samples grew
+    strictly monotonically by at least ``SRJ_TPU_MEM_LEAK_MIN_BYTES``
+    total: live bytes climbing across serve ticks with no matching
+    release.  A flat or sawtooth profile (alloc/release per tick) stays
+    green."""
+    k = _leak_ticks()
+    with _LOCK:
+        tail = [lv for _ts, lv in list(_RING)[-k:]]
+    if len(tail) < k:
+        return False
+    if any(b <= a for a, b in zip(tail, tail[1:])):
+        return False
+    return tail[-1] - tail[0] >= _leak_min_bytes()
+
+
+# ---------------------------------------------------------------------------
+# Footprint model
+# ---------------------------------------------------------------------------
+
+def record_footprint(op: str, sig: str = "", bucket="", impl: str = "",
+                     peak_bytes: float = 0.0,
+                     source: str = "measured") -> None:
+    """Fold one observed peak into the footprint model.  Public so tests
+    and tools can seed cells without replaying a span log."""
+    try:
+        pk = int(peak_bytes)
+        if pk <= 0:
+            return
+        _ensure_surfaces()
+        key = (str(op), str(sig), str(bucket), str(impl))
+        with _LOCK:
+            c = _CELLS.get(key)
+            if c is None:
+                c = _CELLS[key] = {"calls": 0, "peak_bytes": 0,
+                                   "ewma_bytes": 0.0, "source": source}
+            c["calls"] += 1
+            if pk > c["peak_bytes"]:
+                c["peak_bytes"] = pk
+            c["ewma_bytes"] = (pk if c["calls"] == 1 else
+                               (1 - _EWMA_ALPHA) * c["ewma_bytes"]
+                               + _EWMA_ALPHA * pk)
+            # measured deltas outrank payload proxies for the same cell
+            if source == "measured":
+                c["source"] = "measured"
+    except Exception:
+        pass
+
+
+def _span_peak(ev: Dict) -> Tuple[Optional[int], str]:
+    """Best available peak-bytes signal for one span event: true peak
+    delta > steady delta > declared payload bytes."""
+    mem = ev.get("mem")
+    if isinstance(mem, dict):
+        for k in ("peak_delta_bytes", "delta_bytes"):
+            v = mem.get(k)
+            if isinstance(v, (int, float)) and v > 0:
+                return int(v), "measured"
+    for k in ("blob_bytes", "h2d_bytes", "bytes"):
+        v = ev.get(k)
+        if isinstance(v, (int, float)) and v > 0:
+            return int(v), "payload"
+    return None, "none"
+
+
+def observe_span(ev: Dict) -> None:
+    """Fold one finished span into the ledger (called from
+    ``metrics.observe_event`` for every span).  Never raises."""
+    try:
+        if ev.get("kind") != "span":
+            return
+        peak, src = _span_peak(ev)
+        if peak is not None:
+            record_footprint(str(ev.get("name", "?")),
+                             str(ev.get("sig", "")),
+                             str(ev.get("bucket", "")),
+                             str(ev.get("impl", "")),
+                             peak, src)
+        mem = ev.get("mem")
+        if isinstance(mem, dict):
+            v = mem.get("bytes_in_use")
+            if isinstance(v, (int, float)):
+                _record_sample(int(v), ev.get("ts_end"))
+    except Exception:
+        pass
+
+
+def footprint_cells() -> Dict[Tuple[str, str, str, str], Dict]:
+    """Snapshot of the live footprint cells."""
+    with _LOCK:
+        return {k: dict(c) for k, c in _CELLS.items()}
+
+
+def _scaled_estimate(op: str, sig: str, bucket, impl: str,
+                     cells: Dict) -> Optional[int]:
+    """Extrapolate an unknown bucket linearly along the pow-2 grid from
+    learned cells of the same op (same sig+impl preferred).  Returns the
+    most conservative (largest) scaled estimate."""
+    try:
+        want = int(bucket)
+    except (TypeError, ValueError):
+        return None
+    if want <= 0:
+        return None
+    best = None
+    best_exact = None
+    for (cop, csig, cbucket, cimpl), c in cells.items():
+        if cop != op:
+            continue
+        try:
+            have = int(cbucket)
+        except (TypeError, ValueError):
+            continue
+        if have <= 0:
+            continue
+        est = int(c["peak_bytes"] * want / have)
+        if csig == str(sig) and cimpl == str(impl):
+            if best_exact is None or est > best_exact:
+                best_exact = est
+        if best is None or est > best:
+            best = est
+    return best_exact if best_exact is not None else best
+
+
+def predicted_bytes(op: str, sig: str = "", bucket="", impl: str = "",
+                    rows: Optional[int] = None
+                    ) -> Tuple[Optional[int], str]:
+    """Predicted peak bytes for one dispatch cell, with provenance:
+    ``(bytes, source)`` where source is ``"live"`` (exact in-process
+    cell), ``"live-scaled"`` (pow-2 extrapolation), ``"file"`` /
+    ``"file-scaled"`` (persisted ``FOOTPRINTS.json``), or ``(None,
+    "none")`` when the model has never seen the op.  ``rows`` re-buckets
+    the lookup onto the grid (what the resilience splitter passes for
+    half batches)."""
+    b = bucket
+    if rows is not None:
+        try:
+            from spark_rapids_jni_tpu.runtime import shapes as _shapes
+            b = _shapes.bucket_rows(int(rows))
+        except Exception:
+            b = bucket
+    key = (str(op), str(sig), str(b), str(impl))
+    with _LOCK:
+        c = _CELLS.get(key)
+        if c is not None:
+            return int(c["peak_bytes"]), "live"
+        cells = {k: dict(v) for k, v in _CELLS.items()}
+    est = _scaled_estimate(str(op), str(sig), b, str(impl), cells)
+    if est is not None:
+        return est, "live-scaled"
+    fcells = _file_cells()
+    if fcells:
+        c = fcells.get(key)
+        if c is not None:
+            return int(c["peak_bytes"]), "file"
+        est = _scaled_estimate(str(op), str(sig), b, str(impl), fcells)
+        if est is not None:
+            return est, "file-scaled"
+    return None, "none"
+
+
+def should_split(op: str, sig: str = "", bucket="", impl: str = "",
+                 rows: Optional[int] = None) -> bool:
+    """The pre-dispatch consultation: True when the predicted footprint
+    (× ``SRJ_TPU_MEM_SAFETY``) exceeds live headroom.  Conservative on
+    ignorance: unknown capacity or an unseen op never splits."""
+    if not proactive_enabled():
+        return False
+    hr = headroom_bytes()
+    if hr is None:
+        return False
+    pred, _src = predicted_bytes(op, sig, bucket, impl, rows=rows)
+    if pred is None:
+        return False
+    return pred * _safety() > hr
+
+
+def count_proactive(op: str) -> None:
+    """Count one proactive (pre-dispatch) split — the counter the chaos
+    proof asserts on, separate from reactive ``srj_tpu_oom_splits_total``."""
+    try:
+        _metrics.counter(
+            "srj_tpu_mem_proactive_splits_total",
+            "Pre-dispatch batch splits taken because predicted footprint "
+            "exceeded live headroom (proactive OOM avoidance).",
+            ("op",)).inc(op=str(op))
+    except Exception:
+        pass
+
+
+def proactive_splits() -> float:
+    """Total proactive splits across ops (test/CI convenience)."""
+    try:
+        snap = _metrics.registry().snapshot()
+        fam = snap.get("srj_tpu_mem_proactive_splits_total") or {}
+        return float(sum((fam.get("values") or {}).values()))
+    except Exception:
+        return 0.0
+
+
+# ---------------------------------------------------------------------------
+# Persistence (same discipline as costmodel's CALIBRATION.json)
+# ---------------------------------------------------------------------------
+
+def footprint_path(path: Optional[str] = None) -> str:
+    """Resolve the footprint file path: explicit arg > env > cwd —
+    deliberately the same resolution order as ``CALIBRATION.json``."""
+    return path or os.environ.get(_ENV_FILE) or "FOOTPRINTS.json"
+
+
+def max_age_s() -> float:
+    try:
+        return float(os.environ.get(_ENV_MAX_AGE, "86400"))
+    except ValueError:
+        return 86400.0
+
+
+def _invalidate_file_cache() -> None:
+    global _FILE_CACHE
+    with _FILE_LOCK:
+        _FILE_CACHE = None
+
+
+def save_footprints(path: Optional[str] = None, source: str = "observed",
+                    now: Optional[float] = None) -> Optional[str]:
+    """Persist the live cells atomically (tmp + ``os.replace``).  Returns
+    the path written, or ``None`` on failure or an empty model — the
+    footprint file is advisory, a read-only cwd must not fail a run."""
+    cells = footprint_cells()
+    if not cells:
+        return None
+    doc = {"ts": time.time() if now is None else float(now),
+           "source": source,
+           "cells": {"|".join(k): {"peak_bytes": int(c["peak_bytes"]),
+                                   "calls": int(c["calls"]),
+                                   "source": c.get("source", "measured")}
+                     for k, c in cells.items()}}
+    p = footprint_path(path)
+    try:
+        tmp = f"{p}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, p)
+    except OSError:
+        return None
+    _invalidate_file_cache()
+    return p
+
+
+def load_footprints(path: Optional[str] = None,
+                    max_age: Optional[float] = None,
+                    now: Optional[float] = None
+                    ) -> Optional[Dict[Tuple[str, str, str, str], Dict]]:
+    """Read the footprint file back into cell form; ``None`` when
+    missing, malformed, or older than the freshness window."""
+    p = footprint_path(path)
+    try:
+        with open(p, "r") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or not isinstance(doc.get("cells"), dict):
+        return None
+    age_cap = max_age_s() if max_age is None else float(max_age)
+    ts = doc.get("ts")
+    if isinstance(ts, (int, float)) and age_cap > 0:
+        t = time.time() if now is None else float(now)
+        if t - ts > age_cap:
+            return None
+    out: Dict[Tuple[str, str, str, str], Dict] = {}
+    for raw, c in doc["cells"].items():
+        parts = str(raw).split("|")
+        if len(parts) != 4 or not isinstance(c, dict):
+            continue
+        pk = c.get("peak_bytes")
+        if not isinstance(pk, (int, float)) or pk <= 0:
+            continue
+        out[tuple(parts)] = {"peak_bytes": int(pk),
+                             "calls": int(c.get("calls") or 0),
+                             "source": str(c.get("source") or "file")}
+    return out or None
+
+
+def _file_cells() -> Optional[Dict]:
+    """Cached read of the persisted cells, re-resolved when the path
+    changes (tests flip ``SRJ_TPU_MEM_FOOTPRINT_FILE`` per tmpdir)."""
+    global _FILE_CACHE
+    p = footprint_path()
+    with _FILE_LOCK:
+        if _FILE_CACHE is not None and _FILE_CACHE[0] == p:
+            return _FILE_CACHE[1]
+    cells = load_footprints(p)
+    with _FILE_LOCK:
+        _FILE_CACHE = (p, cells)
+    return cells
+
+
+# ---------------------------------------------------------------------------
+# Surfacing: /metrics collect hook + /healthz provider
+# ---------------------------------------------------------------------------
+
+def _publish_gauges() -> None:
+    """Collect hook: refresh the srj_tpu_mem_* gauges right before a
+    scrape — derived numbers computed at read time, never on a timer."""
+    try:
+        live = live_bytes()
+        global _WATERMARK
+        with _LOCK:
+            if live > _WATERMARK:
+                _WATERMARK = live
+            wm = _WATERMARK
+            staged = _STAGED_PEAK
+        g = _metrics.gauge
+        g("srj_tpu_mem_live_bytes",
+          "Live device bytes (allocator bytes_in_use, or the host-side "
+          "arena+tracker estimate on stat-less backends).").set(live)
+        g("srj_tpu_mem_watermark_bytes",
+          "Process-wide live-bytes high-water mark.").set(wm)
+        g("srj_tpu_mem_arena_bytes",
+          "Host staging-arena occupancy.").set(_arena_bytes())
+        g("srj_tpu_mem_tracked_bytes",
+          "Bytes in long-lived tracked device buffers.").set(
+              _tracker_bytes())
+        g("srj_tpu_mem_staged_blob_peak_bytes",
+          "Largest single staged blob seen.").set(staged)
+        g("srj_tpu_mem_leak_flag",
+          "1 when live bytes grew monotonically across the last "
+          "SRJ_TPU_MEM_LEAK_TICKS samples.").set(1 if leaking() else 0)
+        cap = capacity_bytes()
+        if cap is not None:
+            g("srj_tpu_mem_capacity_bytes",
+              "Allocation ceiling (env cap or allocator bytes_limit)."
+              ).set(cap)
+            g("srj_tpu_mem_headroom_bytes",
+              "capacity - live, floored at zero.").set(max(0, cap - live))
+        fp = g("srj_tpu_mem_footprint_bytes",
+               "Predicted peak bytes per (op, bucket) from the "
+               "footprint model.", ("op", "bucket"))
+        for (op, _sig, bucket, _impl), c in footprint_cells().items():
+            fp.set(c["peak_bytes"], op=op, bucket=bucket)
+    except Exception:
+        pass
+
+
+def health() -> Dict:
+    """The ``memory`` sub-document for ``/healthz`` — the fleet-routing
+    signal: headroom, watermark, leak flag."""
+    live = live_bytes()
+    cap = capacity_bytes()
+    with _LOCK:
+        wm = max(_WATERMARK, live)
+        episodes = _EPISODES
+        samples = len(_RING)
+        cells = len(_CELLS)
+    doc = {
+        "live_bytes": int(live),
+        "watermark_bytes": int(wm),
+        "capacity_bytes": cap,
+        "headroom_bytes": (max(0, cap - live) if cap is not None
+                           else None),
+        "leak": leaking(),
+        "highwater_episodes": int(episodes),
+        "samples": int(samples),
+        "footprint_cells": int(cells),
+        "arena_bytes": _arena_bytes(),
+        "tracked_bytes": _tracker_bytes(),
+        "proactive": proactive_enabled(),
+    }
+    frac = headroom_fraction()
+    if frac is not None:
+        doc["headroom_frac"] = round(frac, 4)
+    return doc
+
+
+def _ensure_surfaces() -> None:
+    global _SURFACED
+    if _SURFACED:
+        return
+    _SURFACED = True
+    try:
+        _metrics.register_collect_hook(_publish_gauges)
+    except Exception:
+        pass
+    try:
+        from spark_rapids_jni_tpu.obs import exporter as _exporter
+        _exporter.register_health_provider("memory", health)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Replay + reset
+# ---------------------------------------------------------------------------
+
+def replay(events: Iterable[Dict]) -> None:
+    """Fold an event stream into the live ledger (CLI/offline path: same
+    arithmetic as the live feed)."""
+    for ev in events:
+        observe_span(ev)
+
+
+def reset() -> None:
+    """Zero all ledger state (test isolation).  Leaves the metrics
+    registry and the persisted footprint file alone; drops the file
+    cache so env-path changes re-resolve."""
+    global _WATERMARK, _EPISODES, _IN_EPISODE, _STAGED_PEAK, _TRACKER
+    with _LOCK:
+        _CELLS.clear()
+        _RING.clear()
+        _WATERMARK = 0
+        _EPISODES = 0
+        _IN_EPISODE = False
+        _STAGED_PEAK = 0
+    with _TRACKER_LOCK:
+        t, _TRACKER = _TRACKER, None
+    if t is not None:
+        try:
+            t.release_all()
+        except Exception:
+            pass
+    _invalidate_file_cache()

@@ -559,6 +559,11 @@ def observe_event(ev: Dict) -> None:
                 _slo.observe_span(ev)
             except Exception:
                 pass
+            try:
+                from . import memwatch as _mw
+                _mw.observe_span(ev)
+            except Exception:
+                pass
         elif kind == "compile":
             _REGISTRY.counter("srj_tpu_xla_compiles_total",
                               "XLA backend compiles observed.").inc()

@@ -275,6 +275,16 @@ def dump_bundle(reason: str, ev: Dict) -> Optional[str]:
 
         _write("repro.json", _repro(ev, [k for k, _ in progs]))
         _write("memory.json", _mem_snapshot())
+        # the approach to the cliff: last-N watermark samples from the
+        # memwatch ring, so an OOM bundle shows live bytes climbing, not
+        # just the post-mortem allocator counters
+        try:
+            from spark_rapids_jni_tpu.obs import memwatch as _memwatch
+            tl = _memwatch.timeline()
+            if tl:
+                _write("memory_timeline.json", tl)
+        except Exception:
+            pass
         _write("env.json", _env_snapshot())
         _write("MANIFEST.json", {
             "reason": reason, "ts": time.time(),
@@ -511,6 +521,15 @@ def format_bundle(path: str) -> str:
         if biu is not None:
             lines.append(f"  device mem  : {biu} in use"
                          + (f", {peak} peak" if peak is not None else ""))
+    tl = _load("memory_timeline.json")
+    if isinstance(tl, list) and tl:
+        vals = [s.get("live_bytes") for s in tl
+                if isinstance(s, dict)
+                and isinstance(s.get("live_bytes"), (int, float))]
+        if vals:
+            lines.append(f"  mem timeline: {len(vals)} samples, "
+                         f"{vals[0]} -> {vals[-1]} live bytes "
+                         f"(peak {max(vals)}) — memory_timeline.json")
     envd = _load("env.json") or {}
     if envd.get("jax_version"):
         lines.append(f"  jax         : {envd['jax_version']} "

@@ -10,6 +10,10 @@ class of span events:
 - ``kind="utilization"`` — "``target`` of ``op`` calls achieve at least
   ``threshold`` % of the calibrated HBM ceiling" (the per-kernel
   roofline floor, priced by :mod:`~spark_rapids_jni_tpu.obs.costmodel`).
+- ``kind="headroom"`` — "``target`` of ``op`` calls complete with at
+  least a ``threshold`` fraction of HBM capacity still free" (read from
+  :mod:`~spark_rapids_jni_tpu.obs.memwatch` at event time; stands down
+  when capacity is unknown).
 
 Evaluation is the SRE multi-window burn rate: each observation is good
 or bad; ``burn = bad_fraction / (1 - target)`` over a fast (default 60 s)
@@ -63,15 +67,16 @@ DEFAULT_SLOW_WINDOW_S = 600
 DEFAULT_FAST_BURN = 14.4
 DEFAULT_SLOW_BURN = 6.0
 
-_KINDS = ("latency", "error_rate", "utilization")
+_KINDS = ("latency", "error_rate", "utilization", "headroom")
 
 
 class Objective:
     """One declarative objective.  ``target`` is the good fraction
     (0 < target < 1); ``threshold`` is the per-kind cut: seconds for
     ``latency``, ignored for ``error_rate``, a ``pct_of_calibration``
-    floor for ``utilization``.  ``op`` selects span events by exact
-    name."""
+    floor for ``utilization``, a free-capacity fraction floor in (0, 1)
+    for ``headroom`` (bad when ``memwatch.headroom_fraction()`` at event
+    time is below it).  ``op`` selects span events by exact name."""
 
     __slots__ = ("name", "kind", "op", "target", "threshold",
                  "fast_window_s", "slow_window_s", "fast_burn",
@@ -213,6 +218,19 @@ def _classify(obj: Objective, ev: Dict) -> Optional[bool]:
         if not isinstance(w, (int, float)):
             return None
         return float(w) > obj.threshold
+    if obj.kind == "headroom":
+        # utilization-style objective on free HBM: the op's calls are
+        # "bad" when live headroom at completion time is under the
+        # threshold fraction of capacity; unknown capacity (no env cap,
+        # stat-less backend) classifies nothing rather than guessing
+        try:
+            from spark_rapids_jni_tpu.obs import memwatch as _memwatch
+            frac = _memwatch.headroom_fraction()
+        except Exception:
+            return None
+        if frac is None:
+            return None
+        return frac < obj.threshold
     # utilization: needs bytes + a clock to derive achieved GB/s
     nb = ev.get("bytes")
     t = ev.get("device_s")

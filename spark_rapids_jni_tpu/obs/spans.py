@@ -260,6 +260,18 @@ def _mem_snapshot() -> Dict[str, int]:
         return {}
 
 
+def _reset_peak() -> None:
+    """Reset the allocator's peak counter at span start where the PJRT
+    backend exposes a reset, so ``peak_bytes_in_use`` at span end is the
+    span-local peak rather than a process-lifetime one.  No-op (and
+    harmless) on backends without the hook."""
+    try:
+        from spark_rapids_jni_tpu.memory import reset_peak_memory_stats
+        reset_peak_memory_stats()
+    except Exception:
+        pass
+
+
 def _device_dead() -> bool:
     try:
         from spark_rapids_jni_tpu import faultinj
@@ -336,6 +348,8 @@ def span(name: str, **attrs):
     sp = Span(name, attrs, depth=len(stack),
               parent=stack[-1].name if stack else None)
     sp._mem0 = _mem_snapshot()
+    if sp._mem0:
+        _reset_peak()
     # request-scoped causality: under an active TraceContext the span
     # joins that request's trace and becomes the parent of whatever its
     # body starts — including work handed to other threads via
@@ -384,6 +398,13 @@ def _finish(sp: Span, status: str, err: Optional[BaseException] = None
         if sp._mem0:
             mem["delta_bytes"] = (mem1.get("bytes_in_use", 0)
                                   - sp._mem0.get("bytes_in_use", 0))
+            # true span peak over the start baseline: what the footprint
+            # model trains on when the backend reports peaks (after the
+            # span-start reset this is span-local, not process-lifetime)
+            p1 = mem1.get("peak_bytes_in_use")
+            b0 = sp._mem0.get("bytes_in_use")
+            if isinstance(p1, (int, float)) and isinstance(b0, (int, float)):
+                mem["peak_delta_bytes"] = max(0, int(p1) - int(b0))
         ev["mem"] = mem
     if sp.trace_id is not None:
         ev["trace_id"] = sp.trace_id

@@ -226,14 +226,27 @@ def trace_events(events: Iterable[Dict], pid: int = 0) -> Dict:
                             "ts": (ts - t0) * scale,
                             "args": {"count": compiles,
                                      "seconds": round(compile_s, 6)}})
-            elif ev.get("kind") == "span" and (
-                    isinstance(ev.get("h2d_bytes"), (int, float))
-                    or isinstance(ev.get("d2h_bytes"), (int, float))):
-                h2d += int(ev.get("h2d_bytes") or 0)
-                d2h += int(ev.get("d2h_bytes") or 0)
-                out.append({"ph": "C", "name": "transfer_bytes",
-                            "pid": hpid, "ts": (ts - t0) * scale,
-                            "args": {"h2d": h2d, "d2h": d2h}})
+            elif ev.get("kind") == "span":
+                if (isinstance(ev.get("h2d_bytes"), (int, float))
+                        or isinstance(ev.get("d2h_bytes"), (int, float))):
+                    h2d += int(ev.get("h2d_bytes") or 0)
+                    d2h += int(ev.get("d2h_bytes") or 0)
+                    out.append({"ph": "C", "name": "transfer_bytes",
+                                "pid": hpid, "ts": (ts - t0) * scale,
+                                "args": {"h2d": h2d, "d2h": d2h}})
+                # device-memory counter track: live/peak bytes sampled
+                # at span end (the span ``mem`` doc from the PJRT
+                # allocator) — the Perfetto view of HBM pressure
+                mem = ev.get("mem")
+                if isinstance(mem, dict) and isinstance(
+                        mem.get("bytes_in_use"), (int, float)):
+                    args = {"live": int(mem["bytes_in_use"])}
+                    if isinstance(mem.get("peak_bytes_in_use"),
+                                  (int, float)):
+                        args["peak"] = int(mem["peak_bytes_in_use"])
+                    out.append({"ph": "C", "name": "device_memory_bytes",
+                                "pid": hpid, "ts": (ts - t0) * scale,
+                                "args": args})
 
     # flow arrows: for every span that linked others (the coalesced batch
     # span's ``links`` -> its request span_ids), draw request -> batch.

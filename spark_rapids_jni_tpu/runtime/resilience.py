@@ -641,6 +641,39 @@ def run(op: str, fn: Callable, *args,
         use_fallback = True
         fam["fallbacks"].inc(op=op)
 
+    # proactive OOM avoidance: when the footprint model predicts this
+    # call won't fit in live headroom, split on the pow-2 grid BEFORE the
+    # first attempt instead of waiting for the backend to throw.  Counted
+    # separately from the reactive path (srj_tpu_mem_proactive_splits_
+    # total vs srj_tpu_oom_splits_total); any memwatch misbehavior
+    # degrades to the reactive path, never to a failure.
+    if splitter is not None and splitter.can_split(args):
+        proactive = False
+        try:
+            from spark_rapids_jni_tpu.obs import memwatch as _memwatch
+            proactive = _memwatch.should_split(
+                op, sig=str(sig), bucket=bucket, impl=impl,
+                rows=splitter._rows(args))
+        except Exception:
+            proactive = False
+        if proactive:
+            _memwatch.count_proactive(op)
+            try:
+                from spark_rapids_jni_tpu.obs import spans as _spans
+                sp = _spans.current_span()
+                if sp is not None:
+                    sp.set(proactive_split=True)
+            except Exception:
+                pass
+            lo_args, hi_args = splitter.split(args)
+            common = dict(sig=sig, bucket=bucket, impl=impl,
+                          fallback=fallback, splitter=splitter,
+                          policy=policy, deadline=deadline,
+                          kwargs=kwargs)
+            lo = run(op, fn, *lo_args, **common)
+            hi = run(op, fn, *hi_args, **common)
+            return splitter.merge(lo, hi)
+
     while True:
         if deadline is not None and time.monotonic() >= deadline:
             _stamp(attempts + 1, last_reason, time.monotonic() - t0,

@@ -120,6 +120,19 @@ def bucket_width(w: int, f: Optional[float] = None) -> int:
     return b
 
 
+def split_bucket(b: int, f: Optional[float] = None) -> int:
+    """The grid point a half of a bucket-``b`` batch lands on
+    (``bucket_rows`` of ``b // 2``).  What the proactive OOM-avoidance
+    path (``obs/memwatch.py`` advising ``resilience.ArraySplitter`` and
+    the serve request-axis split) reasons with: halving a batch moves
+    its footprint down the same pow-2 grid the staging blobs and the
+    footprint-model cells are keyed on, so the post-split prediction is
+    a cell lookup, not a guess.  At :data:`MIN_ROWS` the grid bottoms
+    out and ``split_bucket(b) == b`` — splitting further cannot shrink
+    the compiled shape."""
+    return bucket_rows(max(1, int(b) // 2), f)
+
+
 def prefix_mask(n: int, b: int) -> jnp.ndarray:
     """Packed validity (uint8, LSB-first — the ``pack_bools`` layout) with
     rows [0, n) valid and [n, b) invalid.  Built host-side with numpy:

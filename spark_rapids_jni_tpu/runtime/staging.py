@@ -181,6 +181,14 @@ def stage_arrays(bufs: Sequence[np.ndarray], device=None) -> List:
         outs = _unpack_program(sig)(dev_blob)
         sp.set(h2d_bytes=payload, blob_bytes=total, transfer_count=1,
                buffers=len(bufs))
+    # arena event for the memory ledger: the blob is transiently live
+    # during the transfer, which is what advances the watermark on
+    # backends whose allocator exposes no stats
+    try:
+        from spark_rapids_jni_tpu.obs import memwatch as _memwatch
+        _memwatch.note_staged(total)
+    except Exception:
+        pass
     return outs
 
 

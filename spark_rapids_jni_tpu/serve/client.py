@@ -4,7 +4,15 @@ A thin, typed submission surface: each method validates via the op
 registry and returns a ``concurrent.futures.Future`` resolving to the
 op's result dict (call ``.result(timeout)`` to block).  One client per
 tenant; clients are cheap and thread-safe (all state lives in the
-scheduler)."""
+scheduler).
+
+Memory pressure is transparent here by design: when the footprint model
+predicts a coalesced group won't fit in live headroom, the scheduler
+splits it pre-dispatch (``obs/memwatch.py``) and per-slot results are
+byte-identical — a tenant never sees an OOM the proactive path could
+avoid.  :meth:`Client.memory` exposes the same headroom/watermark/leak
+document ``/healthz`` serves, for callers routing work across replicas
+without an exporter socket."""
 
 from __future__ import annotations
 
@@ -20,6 +28,13 @@ class Client:
     def __init__(self, scheduler, tenant: str):
         self._sched = scheduler
         self.tenant = str(tenant)
+
+    @staticmethod
+    def memory() -> dict:
+        """The live memory document (headroom, watermark, leak flag) —
+        identical to the ``memory`` sub-document on ``/healthz``."""
+        from spark_rapids_jni_tpu.obs import memwatch as _memwatch
+        return _memwatch.health()
 
     @contextlib.contextmanager
     def traced(self, trace_id: Optional[str] = None):

@@ -392,6 +392,14 @@ class Scheduler:
         if groups:
             self.ticks += 1
             self.served += n
+            # one watermark sample per working tick: the cadence the
+            # leak detector reasons over (monotone growth across ticks
+            # with no matching release flags srj_tpu_mem_leak_flag)
+            try:
+                from spark_rapids_jni_tpu.obs import memwatch as _memwatch
+                _memwatch.sample()
+            except Exception:   # noqa: BLE001 — telemetry must not fail
+                pass
         return n
 
     def _execute_group(self, op: str, sig, reqs: List[Request]) -> int:
@@ -542,9 +550,25 @@ class Scheduler:
         trace chain; :func:`obs.recorder.register_program` records how to
         re-lower this exact (op, sig, slots) program if it later fails."""
         kb = shapes.bucket_rows(len(reqs))
+        # proactive OOM avoidance: consult the footprint model BEFORE the
+        # span opens or anything stages — a group whose predicted peak
+        # exceeds live headroom splits on the request axis pre-dispatch
+        # (counted separately from reactive splits; memwatch misbehavior
+        # degrades to the reactive path, never to a failure)
+        if len(reqs) >= 2:
+            try:
+                from spark_rapids_jni_tpu.obs import memwatch as _memwatch
+                proactive = _memwatch.should_split(
+                    f"serve.{opdef.name}", sig=str(sig), bucket=kb)
+            except Exception:   # noqa: BLE001 — advisory only
+                proactive = False
+            if proactive:
+                return self._split_dispatch(opdef, sig, reqs, deadline,
+                                            proactive=True)
         payloads = [r.payload for r in reqs]
         attrs = dict(requests=len(reqs), slots=kb, op=opdef.name,
-                     sig=str(sig))
+                     sig=str(sig), bucket=kb,
+                     bytes=sum(r.nbytes for r in reqs))
         if _spans.recording():
             links = [r.trace.span_id for r in reqs if r.trace is not None]
             if links:
@@ -578,7 +602,8 @@ class Scheduler:
         return host
 
     def _split_dispatch(self, opdef, sig, reqs: List[Request],
-                        deadline: Optional[float]) -> List:
+                        deadline: Optional[float],
+                        proactive: bool = False) -> List:
         """Request-axis OOM degradation: halve the group and recurse,
         then merge the slot-major outputs so slot ``i`` still belongs to
         request ``i``.  Halves re-bucket onto the same pow-2 slot grid
@@ -586,17 +611,26 @@ class Scheduler:
         degradation re-uses already-compiled programs, and per-slot
         results are byte-identical to the unsplit run because serve
         batches are independent by construction — slot ``i`` never reads
-        slot ``j``."""
+        slot ``j``.  ``proactive`` marks a pre-dispatch split taken on
+        the footprint model's advice (its own counter family, so the
+        bench can prove reactive OOMs go to zero under injected caps)."""
         mid = len(reqs) // 2
         n = len(reqs)
         try:
-            _resilience._fam()["splits"].inc(op=f"serve.{opdef.name}")
+            if proactive:
+                from spark_rapids_jni_tpu.obs import memwatch as _memwatch
+                _memwatch.count_proactive(f"serve.{opdef.name}")
+            else:
+                _resilience._fam()["splits"].inc(op=f"serve.{opdef.name}")
         except Exception:   # noqa: BLE001 — telemetry must not fail a tick
             pass
         try:
             sp = _spans.current_span()
             if sp is not None:
-                sp.set(oom_split=True)
+                if proactive:
+                    sp.set(proactive_split=True)
+                else:
+                    sp.set(oom_split=True)
         except Exception:   # noqa: BLE001
             pass
         lo = self._dispatch(opdef, sig, reqs[:mid], deadline)

@@ -192,3 +192,71 @@ def test_log_level_env(monkeypatch):
     assert memory.log_level() == memory._LEVELS["DEBUG"]
     monkeypatch.setenv("SRJ_MEMORY_LOG_LEVEL", "bogus")
     assert memory.log_level() == memory._LEVELS["OFF"]
+
+
+class _FakeDevice:
+    """Stand-in PJRT device with a configurable stats surface."""
+
+    def __init__(self, stats=None, raises=False, reset_attr=None):
+        self._stats = stats
+        self._raises = raises
+        self.resets = 0
+        if reset_attr:
+            setattr(self, reset_attr, self._do_reset)
+
+    def memory_stats(self):
+        if self._raises:
+            raise RuntimeError("UNIMPLEMENTED")
+        return self._stats
+
+    def _do_reset(self):
+        self.resets += 1
+
+
+def test_device_memory_stats_backend_without_memory_stats():
+    # a device with no memory_stats attr at all (old PJRT plugin)
+    class Bare:
+        pass
+    assert memory.device_memory_stats(Bare()) == {}
+
+
+def test_device_memory_stats_none_raises_and_partial():
+    assert memory.device_memory_stats(_FakeDevice(stats=None)) == {}
+    assert memory.device_memory_stats(_FakeDevice(raises=True)) == {}
+    # partial dicts pass through untouched: callers probe keys, the
+    # wrapper never invents bytes_limit/peak fields the backend omitted
+    partial = {"bytes_in_use": 123}
+    out = memory.device_memory_stats(_FakeDevice(stats=partial))
+    assert out == {"bytes_in_use": 123}
+    assert out is not partial         # defensive copy
+
+
+def test_device_memory_stats_explicit_device_wins(monkeypatch):
+    # an explicit device arg must bypass jax.local_devices entirely
+    import jax
+    def boom():
+        raise AssertionError("local_devices must not be called")
+    monkeypatch.setattr(jax, "local_devices", boom)
+    dev = _FakeDevice(stats={"bytes_in_use": 7, "bytes_limit": 100})
+    assert memory.device_memory_stats(dev)["bytes_limit"] == 100
+
+
+def test_reset_peak_memory_stats_fallbacks():
+    # no reset hook anywhere (the CPU case): False, never raises
+    assert memory.reset_peak_memory_stats(_FakeDevice()) is False
+    # each probed alias works
+    for attr in ("reset_peak_memory_stats", "reset_memory_stats",
+                 "clear_memory_stats"):
+        dev = _FakeDevice(reset_attr=attr)
+        assert memory.reset_peak_memory_stats(dev) is True
+        assert dev.resets == 1
+    # a hook that raises degrades to False
+    dev = _FakeDevice(reset_attr="reset_peak_memory_stats")
+    dev.reset_peak_memory_stats = lambda: (_ for _ in ()).throw(
+        RuntimeError("device lost"))
+    assert memory.reset_peak_memory_stats(dev) is False
+
+
+def test_reset_peak_memory_stats_default_device():
+    # on this backend (CPU in CI) the default-device path must be total
+    assert memory.reset_peak_memory_stats() in (True, False)
