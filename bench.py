@@ -1448,6 +1448,27 @@ def main():
                           kern.get(f"{kname}_{impl}_GBps"))
         if roofline:
             out["roofline"] = roofline
+    # refresh the shared perf reference: the same headline figures the
+    # regress gate compares rounds against become the provenance-stamped
+    # metrics section of PERF_REFERENCE.json, which the online drift
+    # sentinel and ci/regress_gate.py --reference both read — one
+    # reference for the offline gate and the serving-path sentinel
+    try:
+        from spark_rapids_jni_tpu.obs import drift as _drift
+        ref_metrics = {out["metric"]: {"value": out["value"],
+                                       "unit": out["unit"]}}
+        for e in out.get("secondary", []) + out.get("roofline", []):
+            ref_metrics[e["metric"]] = {"value": e["value"],
+                                        "unit": e["unit"]}
+        if "pct_of_calibration" in out:
+            ref_metrics["pct_of_calibration"] = {
+                "value": out["pct_of_calibration"], "unit": "%"}
+        p = _drift.update_reference_metrics(ref_metrics, source="bench")
+        if p:
+            _log(f"perf reference refreshed: {p} "
+                 f"({len(ref_metrics)} metrics)")
+    except Exception as e:
+        _log(f"perf reference write skipped: {type(e).__name__}: {e}")
     print(json.dumps(out))
 
 

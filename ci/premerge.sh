@@ -306,7 +306,8 @@ import json, sys
 doc = json.load(open(sys.argv[1]))
 assert set(doc) == {"traceEvents", "displayTimeUnit"}, set(doc)
 evs = doc["traceEvents"]
-bad = [e for e in evs if e["ph"] not in ("M", "B", "E", "X", "C", "s", "f")]
+bad = [e for e in evs
+       if e["ph"] not in ("M", "B", "E", "X", "C", "s", "f", "i")]
 assert not bad, f"illegal phases: {sorted({e['ph'] for e in bad})}"
 starts = [e for e in evs if e["ph"] == "s"]
 finishes = [e for e in evs if e["ph"] == "f"]
@@ -546,3 +547,76 @@ print(f"memory smoke: watermark {int(wm)} B after a 212-col ingest, "
       f"{int(splits)} proactive splits under a 600 B cap "
       f"(0 reactive, 0 tenant errors); /healthz memory doc OK")
 PY
+
+# drift + deep-profiling smoke: stream a steady-state workload through
+# the real observe_event fan-out, then inject a sustained slowdown on
+# ONE cell and assert the sentinel alarms that cell only on a real
+# scrape, dumps exactly one flight-recorder bundle naming the cell with
+# a profiler capture linked (or an explicit unavailable marker), the
+# /healthz drift doc flips, and POST /profile serves an on-demand
+# bounded capture over the same socket
+DRIFT_DIAG=$(mktemp -d /tmp/srj_drift_smoke.XXXXXX)
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  SRJ_TPU_DIAG_DIR="$DRIFT_DIAG" SRJ_TPU_DRIFT_WARMUP=4 \
+  SRJ_TPU_DRIFT_SUSTAIN=3 SRJ_TPU_PROFILE_MS=50 \
+  SRJ_TPU_DRIFT_FILE="$DRIFT_DIAG/PERF_REFERENCE.json" \
+  python - <<'PY'
+import json, os, time, urllib.error, urllib.request
+from spark_rapids_jni_tpu.obs import exporter, metrics, recorder
+
+diag = os.environ["SRJ_TPU_DIAG_DIR"]
+recorder.arm(diag)
+port = exporter.start(0)
+assert port, "exporter failed to bind"
+
+def span(name, t):
+    return {"kind": "span", "name": name, "status": "ok", "wall_s": t,
+            "sig": "i32", "bucket": "1024", "impl": "pallas",
+            "bytes": 1e9}
+
+for _ in range(8):                       # co-resident steady state
+    metrics.observe_event(span("kernel_a", 0.010))
+    metrics.observe_event(span("kernel_b", 0.020))
+for _ in range(6):                       # kernel_a ships 5x slower
+    metrics.observe_event(span("kernel_a", 0.050))
+    metrics.observe_event(span("kernel_b", 0.020))
+
+body = urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+alarms = [l for l in body.splitlines()
+          if l.startswith("srj_tpu_drift_alarms_total")]
+assert len(alarms) == 1 and "kernel_a" in alarms[0], alarms
+assert float(alarms[0].split()[-1]) == 1.0, alarms
+assert "srj_tpu_drift_cells_drifting 1" in body, "drifting gauge"
+
+bundles = [p for p in os.listdir(diag) if p.startswith("bundle-drift")]
+assert len(bundles) == 1 and "kernel_a" in bundles[0], bundles
+repro = json.load(open(os.path.join(diag, bundles[0], "repro.json")))
+assert repro["cell"] == "kernel_a|i32|1024|pallas", repro["cell"]
+prof = repro["profile"]
+assert (prof.get("dir") and os.path.isdir(prof["dir"])) \
+    or prof["status"] in ("unavailable", "disabled", "busy"), prof
+
+doc = None                               # on-demand capture on the wire
+for _ in range(50):                      # ride out the anomaly capture
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/profile?ms=20", method="POST")
+        doc = json.loads(urllib.request.urlopen(req, timeout=30).read())
+        break
+    except urllib.error.HTTPError as e:
+        if e.code != 409:
+            raise
+        time.sleep(0.1)
+assert doc and doc["status"] in ("captured", "unavailable"), doc
+
+hz = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+assert hz["drift"]["drifting"] == 1, hz["drift"]
+assert hz["drift"]["worst"]["cell"].startswith("kernel_a"), hz["drift"]
+exporter.stop()
+print(f"drift smoke: kernel_a alarmed once ({len(bundles)} bundle, "
+      f"profile {prof['status']}), kernel_b green; "
+      f"POST /profile -> {doc['status']}")
+PY
+rm -rf "$DRIFT_DIAG"

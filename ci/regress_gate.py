@@ -129,6 +129,32 @@ def compare(cur: Dict[str, Dict], ref: Dict[str, Dict], ref_name: str,
     return rows
 
 
+def reference_metrics(path: str) -> Dict[str, Dict]:
+    """Shared-reference figures from PERF_REFERENCE.json's ``metrics``
+    section (the file bench.py refreshes and the online drift sentinel
+    reads its ``cells`` from).  Always advisory here: the reference is a
+    provenance snapshot, not a gate — comparing against it shows drift
+    since the last refresh without double-failing what the round-over-
+    round comparison already gates."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    mets = doc.get("metrics") if isinstance(doc, dict) else None
+    if not isinstance(mets, dict):
+        return {}
+    out = {}
+    for name, entry in mets.items():
+        if isinstance(entry, (int, float)):
+            out[name] = {"value": float(entry), "unit": ""}
+        elif isinstance(entry, dict) and isinstance(
+                entry.get("value"), (int, float)):
+            out[name] = {"value": float(entry["value"]),
+                         "unit": str(entry.get("unit", ""))}
+    return out
+
+
 def baseline_metrics(path: str) -> Dict[str, Dict]:
     """Published reference figures from BASELINE.json, if any were ever
     filled in (the seed ships ``"published": {}``)."""
@@ -185,6 +211,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--baseline", default=None,
                     help="BASELINE.json path (default: "
                          "<history>/BASELINE.json)")
+    ap.add_argument("--reference", default=None,
+                    help="PERF_REFERENCE.json path (default: "
+                         "<history>/PERF_REFERENCE.json); always "
+                         "advisory, never fails the build")
     args = ap.parse_args(argv)
 
     try:
@@ -217,13 +247,27 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.baseline or os.path.join(args.history, "BASELINE.json"))
     rows += compare(cur, base, "published", args.tolerance)
 
-    if not rows:
+    # the shared drift-sentinel reference rides along advisorily in BOTH
+    # modes: its rows are reported but never counted toward failure
+    ref = reference_metrics(
+        args.reference or os.path.join(args.history,
+                                       "PERF_REFERENCE.json"))
+    ref_rows = compare(cur, ref, "reference", args.tolerance)
+
+    if not rows and not ref_rows:
         print("regress_gate: no overlapping metrics to compare",
               file=sys.stderr)
         return 2
     print(f"perf regression gate: {cur_label} vs {prev_label}"
-          + (" + published baseline" if base else ""))
-    print(format_rows(rows, args.tolerance))
+          + (" + published baseline" if base else "")
+          + (" + perf reference (advisory)" if ref else ""))
+    print(format_rows(rows + ref_rows, args.tolerance))
+    ref_regressed = [r for r in ref_rows if r["regressed"]]
+    if ref_regressed:
+        print("ADVISORY: drifted from PERF_REFERENCE.json in "
+              + ", ".join(r["metric"] for r in ref_regressed)
+              + " (reference comparisons never fail the build)",
+              file=sys.stderr)
     regressed = [r for r in rows if r["regressed"]]
     if regressed:
         names = ", ".join(r["metric"] for r in regressed)

@@ -30,6 +30,11 @@ Injection types (reference ``FaultInjectionType``, ``faultinj.cu:317-340``):
 - 2 ``SUBSTITUTE_RETURN`` — replaces the call's result with an error:
   raises :class:`InjectedRuntimeError` carrying the configured
   ``substituteReturnCode`` (reference substitutes a ``CUresult``).
+- 3 ``LATENCY`` — TPU-side extension with no reference analogue: sleeps
+  ``delayMs`` milliseconds and lets the call proceed *correctly but
+  slower*.  A perf fault, not a correctness fault — what the drift
+  sentinel (:mod:`spark_rapids_jni_tpu.obs.drift`) exists to catch, and
+  what its chaos proof injects.
 
 Config JSON (hot-reloadable when ``dynamic`` is true — the reference uses an
 inotify watcher thread ``faultinj.cu:419-470``; here a daemon thread polls
@@ -78,6 +83,7 @@ _SPDLOG_TO_PY = {0: logging.DEBUG, 1: logging.DEBUG, 2: logging.INFO,
 FI_TRAP = 0
 FI_ASSERT = 1
 FI_RETURN_VALUE = 2
+FI_LATENCY = 3
 
 DOMAIN_COMPILE = "pjrtCompileFaults"
 DOMAIN_EXECUTE = "pjrtExecuteFaults"
@@ -86,7 +92,7 @@ _DOMAINS = (DOMAIN_COMPILE, DOMAIN_EXECUTE, DOMAIN_TRANSFER)
 
 
 _ITYPE_NAMES = {FI_TRAP: "trap", FI_ASSERT: "assert",
-                FI_RETURN_VALUE: "return_value"}
+                FI_RETURN_VALUE: "return_value", FI_LATENCY: "latency"}
 
 
 def _emit_fault(domain: str, name: str, itype: Optional[int] = None,
@@ -155,6 +161,7 @@ class FaultRule:
     percent: float = 0.0
     interception_count: int = 0
     substitute_return_code: int = 1
+    delay_ms: float = 100.0
 
     @classmethod
     def from_json(cls, obj: dict) -> "FaultRule":
@@ -163,6 +170,7 @@ class FaultRule:
             percent=float(obj.get("percent", 0.0)),
             interception_count=int(obj.get("interceptionCount", 0)),
             substitute_return_code=int(obj.get("substituteReturnCode", 1)),
+            delay_ms=float(obj.get("delayMs", 100.0)),
         )
 
 
@@ -311,6 +319,11 @@ class FaultInjectorState:
             raise InjectedRuntimeError(
                 f"faultinj: injected error return at {domain}:{name}",
                 code=rule.substitute_return_code)
+        if itype == FI_LATENCY:
+            # perf fault: stall outside the lock, then let the call run
+            # normally — results stay byte-identical, only slower
+            time.sleep(max(0.0, rule.delay_ms) / 1e3)
+            return
         logger.warning("faultinj: unknown injectionType %d ignored", itype)
 
 

@@ -318,6 +318,15 @@ class Ledger:
                 r["headroom_bytes"] = hr
         except Exception:
             pass
+        # drift column: the sentinel's latest z-score per cell, so the
+        # hotspot table shows which rows are currently off-baseline
+        try:
+            from spark_rapids_jni_tpu.obs import drift as _drift
+            for r in rows:
+                r["drift_z"] = _drift.score(
+                    r["op"], r["sig"], r["bucket"], r.get("impl", ""))
+        except Exception:
+            pass
         return rows
 
     def hotspots(self, k: int = 10,
@@ -497,6 +506,8 @@ def _fmt_row(r: Dict, base: Optional[Dict] = None) -> str:
     hr = r.get("headroom_bytes")
     fps = f"{int(fp):>12}" if isinstance(fp, (int, float)) else f"{'-':>12}"
     hrs = f"{int(hr):>12}" if isinstance(hr, (int, float)) else f"{'-':>12}"
+    dz = r.get("drift_z")
+    dzs = f"{dz:>7.1f}" if isinstance(dz, (int, float)) else f"{'-':>7}"
     return (f"{cell:<40} {r['calls']:>6} {dev_ms:>10.2f} "
             f"{r['bytes']:>14} {r['achieved_GBps']:>9.2f} "
             f"{r['ceiling_GBps']:>9.1f} {r['pct_of_calibration']:>6.1f}"
@@ -504,7 +515,7 @@ def _fmt_row(r: Dict, base: Optional[Dict] = None) -> str:
             f"{100.0 * r['compile_amortization']:>9.1f} "
             f"{r.get('retries', 0):>7} "
             f"{r.get('retry_overhead_pct', 0.0):>7.1f} "
-            f"{fps} {hrs}")
+            f"{fps} {hrs} {dzs}")
 
 
 def render_profile(rows: List[Dict],
@@ -515,7 +526,8 @@ def render_profile(rows: List[Dict],
     head = (f"{'op@bucket':<40} {'calls':>6} {'dev_ms':>10} "
             f"{'bytes':>14} {'GB/s':>9} {'ceil':>9} {'pct':>6}"
             f"{dcol} {'pad%':>7} {'compile%':>9} {'retries':>7} "
-            f"{'retry%':>7} {'footprint':>12} {'headroom':>12}")
+            f"{'retry%':>7} {'footprint':>12} {'headroom':>12} "
+            f"{'drift':>7}")
     lines = [head, "-" * len(head)]
     bmap = {}
     if baseline is not None:
@@ -560,6 +572,13 @@ def profile_main(argv: Optional[List[str]] = None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
     ceiling, source = ceiling_GBps(args.calibration)
+    try:
+        # feed the sentinel the same log so the drift column reflects
+        # the replayed stream, not whatever this process happened to run
+        from spark_rapids_jni_tpu.obs import drift as _drift
+        _drift.replay(events)
+    except Exception:
+        pass
     rows = replay(events).profile(ceiling)
     if args.top > 0:
         rows = rows[:args.top]

@@ -1,0 +1,559 @@
+"""Online performance drift sentinel: the offline regression gate moved
+into the serving path.
+
+``ci/regress_gate.py`` only fires on offline bench rounds; nobody was
+watching for *performance* regressions at runtime — a kernel that ships
+5x slower on one (op, sig, bucket, impl) cell burns latency SLOs for a
+whole bench cycle before anything says why.  This module watches every
+finished span (fourth guarded fan-out in ``metrics.observe_event``,
+after costmodel/slo/memwatch) and keeps per-cell EWMA mean/variance of
+the fenced device time (wall time on unfenced spans) plus achieved
+GB/s.  Each observation is scored against a baseline:
+
+- a **persisted reference** (``PERF_REFERENCE.json``, same atomic-write
+  / freshness / provenance discipline as ``CALIBRATION.json`` and
+  ``FOOTPRINTS.json``) when a fresh file knows the cell — the offline
+  gate and the online sentinel share this one file: ``bench.py``
+  refreshes its ``metrics`` section, serving processes persist their
+  learned ``cells`` section, and ``ci/regress_gate.py`` cross-checks
+  rounds against ``metrics`` advisorily;
+- otherwise a **self-baseline** frozen from the cell's own EWMA after
+  ``SRJ_TPU_DRIFT_WARMUP`` calls (compile-amortised steady state).
+
+A sustained z-score excursion (``z > SRJ_TPU_DRIFT_Z`` for
+``SRJ_TPU_DRIFT_SUSTAIN`` consecutive calls — a single straggler never
+alarms) opens a **drift episode**: ``srj_tpu_drift_alarms_total`` is
+incremented for that cell, a ``kind="drift"`` event enters the obs
+stream (an instant in the Perfetto export), ``obs/profiler.py``
+captures a bounded device profile, and exactly one flight-recorder
+bundle per episode is dumped with the capture linked — the same
+episode-suffixed dedupe discipline as SLO burn and memwatch high-water
+bundles.  Recovery (a non-excursion observation) closes the episode and
+re-arms the cell.
+
+Disarmed (``SRJ_TPU_DRIFT=0``) the per-span cost is a single predicate.
+Everything is guarded: the sentinel never raises into the span path.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Dict, Iterable, Optional, Tuple
+
+from spark_rapids_jni_tpu.obs import metrics as _metrics
+
+__all__ = [
+    "enabled", "observe_span", "score", "cells", "drifting_count",
+    "alarm_count", "health", "reference_path", "save_reference",
+    "load_reference", "update_reference_metrics", "replay", "reset",
+]
+
+_ENV_ARM = "SRJ_TPU_DRIFT"
+_ENV_FILE = "SRJ_TPU_DRIFT_FILE"
+_ENV_MAX_AGE = "SRJ_TPU_DRIFT_MAX_AGE_S"
+_ENV_Z = "SRJ_TPU_DRIFT_Z"
+_ENV_SUSTAIN = "SRJ_TPU_DRIFT_SUSTAIN"
+_ENV_WARMUP = "SRJ_TPU_DRIFT_WARMUP"
+_ENV_ALPHA = "SRJ_TPU_DRIFT_ALPHA"
+_ENV_REL_FLOOR = "SRJ_TPU_DRIFT_REL_FLOOR"
+
+_OFF = ("0", "false", "no")
+
+_DEF_Z = 4.0
+_DEF_SUSTAIN = 5
+_DEF_WARMUP = 8
+_DEF_ALPHA = 0.25
+# baseline std is floored at this fraction of the baseline mean: device
+# timers quantise, and a warmup window that happened to be metronomic
+# must not turn ordinary jitter into alarms
+_DEF_REL_FLOOR = 0.25
+
+Key = Tuple[str, str, str, str]
+
+_LOCK = threading.Lock()
+_CELLS: Dict[Key, Dict] = {}
+_ALARMS = 0
+_SURFACED = False
+
+_FILE_LOCK = threading.Lock()
+_FILE_CACHE: Optional[Tuple[str, Optional[Dict[Key, Dict]]]] = None
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def enabled() -> bool:
+    return os.environ.get(_ENV_ARM, "1") not in _OFF
+
+
+def _z_threshold() -> float:
+    return _env_float(_ENV_Z, _DEF_Z)
+
+
+def _sustain() -> int:
+    return max(1, _env_int(_ENV_SUSTAIN, _DEF_SUSTAIN))
+
+
+def _warmup() -> int:
+    return max(2, _env_int(_ENV_WARMUP, _DEF_WARMUP))
+
+
+def _alpha() -> float:
+    a = _env_float(_ENV_ALPHA, _DEF_ALPHA)
+    return a if 0.0 < a <= 1.0 else _DEF_ALPHA
+
+
+def _rel_floor() -> float:
+    return max(0.0, _env_float(_ENV_REL_FLOOR, _DEF_REL_FLOOR))
+
+
+def _span_bytes(ev: Dict) -> Optional[float]:
+    for k in ("bytes", "blob_bytes", "h2d_bytes"):
+        v = ev.get(k)
+        if isinstance(v, (int, float)) and v > 0:
+            return float(v)
+    return None
+
+
+def cell_id(key: Key) -> str:
+    return "|".join(key)
+
+
+# ---------------------------------------------------------------------------
+# The span feed
+# ---------------------------------------------------------------------------
+
+def observe_span(ev: Dict) -> None:
+    """Fold one finished span into the sentinel (called from
+    ``metrics.observe_event`` for every event).  Never raises.  The
+    disarm check is the first statement: under ``SRJ_TPU_DRIFT=0`` a
+    span costs exactly this predicate and nothing else."""
+    if os.environ.get(_ENV_ARM, "1") in _OFF:
+        return
+    try:
+        _fold(ev)
+    except Exception:
+        pass
+
+
+def _fold(ev: Dict) -> None:
+    if ev.get("kind") != "span" or ev.get("status", "ok") != "ok":
+        return
+    t = ev.get("device_s")
+    time_base = "device"
+    if not isinstance(t, (int, float)) or t <= 0:
+        t = ev.get("wall_s")
+        time_base = "wall"
+    if not isinstance(t, (int, float)) or t <= 0:
+        return
+    _ensure_surfaces()
+    key: Key = (str(ev.get("name", "?")), str(ev.get("sig", "")),
+                str(ev.get("bucket", "")), str(ev.get("impl", "")))
+    nbytes = _span_bytes(ev)
+    gbps = (nbytes / t / 1e9) if nbytes else None
+
+    # a fresh persisted reference that knows this cell wins over
+    # self-baselining; resolve it before taking the cell lock (file I/O
+    # stays off the hot lock, and only the first call per cell pays it)
+    ref = None
+    with _LOCK:
+        known = key in _CELLS
+    if not known:
+        fc = _file_cells()
+        if fc:
+            ref = fc.get(key)
+
+    x = float(t)
+    alpha = _alpha()
+    fire = None
+    global _ALARMS
+    with _LOCK:
+        c = _CELLS.get(key)
+        if c is None:
+            c = _CELLS[key] = {
+                "calls": 0, "ewma_t": 0.0, "ewvar_t": 0.0,
+                "ewma_gbps": None, "base_mean": None, "base_std": None,
+                "base_src": "", "streak": 0, "drifting": False,
+                "episodes": 0, "last_z": None, "time_base": time_base,
+            }
+            if ref is not None:
+                m = ref.get("mean_s")
+                s = ref.get("std_s")
+                if isinstance(m, (int, float)) and m > 0:
+                    c["base_mean"] = float(m)
+                    c["base_std"] = max(
+                        float(s) if isinstance(s, (int, float)) and s > 0
+                        else 0.0,
+                        _rel_floor() * float(m), 1e-9)
+                    c["base_src"] = "file"
+        c["calls"] += 1
+        c["time_base"] = time_base
+        if c["calls"] == 1:
+            c["ewma_t"] = x
+        else:
+            # EW mean/variance recurrence (West): var tracks the same
+            # exponential window as the mean
+            delta = x - c["ewma_t"]
+            c["ewma_t"] += alpha * delta
+            c["ewvar_t"] = (1 - alpha) * (c["ewvar_t"]
+                                          + alpha * delta * delta)
+        if gbps is not None:
+            c["ewma_gbps"] = (gbps if c["ewma_gbps"] is None else
+                              (1 - alpha) * c["ewma_gbps"] + alpha * gbps)
+        if c["base_mean"] is None and c["calls"] >= _warmup():
+            # freeze the self-baseline at steady state
+            c["base_mean"] = c["ewma_t"]
+            c["base_std"] = max(math.sqrt(max(c["ewvar_t"], 0.0)),
+                                _rel_floor() * c["ewma_t"], 1e-9)
+            c["base_src"] = "self"
+            return  # the freezing observation is baseline, not evidence
+        if c["base_mean"] is None:
+            return
+        z = (x - c["base_mean"]) / c["base_std"]
+        c["last_z"] = z
+        if z > _z_threshold():
+            c["streak"] += 1
+            if c["streak"] >= _sustain() and not c["drifting"]:
+                c["drifting"] = True
+                c["episodes"] += 1
+                _ALARMS += 1
+                fire = (key, c["episodes"], z, x,
+                        c["base_mean"], c["base_std"], c["base_src"],
+                        time_base)
+        else:
+            c["streak"] = 0
+            c["drifting"] = False  # recovery re-arms the episode gate
+    if fire is not None:
+        _on_drift(*fire)
+
+
+def _on_drift(key: Key, episode: int, z: float, observed_s: float,
+              base_mean: float, base_std: float, base_src: str,
+              time_base: str) -> None:
+    """Episode-open side effects, run outside the cell lock: counter,
+    obs event, bounded profiler capture, one recorder bundle."""
+    op, sig, bucket, impl = key
+    try:
+        _metrics.counter(
+            "srj_tpu_drift_alarms_total",
+            "Drift episodes opened: sustained z-score excursions of a "
+            "cell's observed time over its baseline.",
+            ("op", "bucket", "impl")).inc(op=op, bucket=bucket, impl=impl)
+    except Exception:
+        pass
+    ev = {"kind": "drift", "name": op, "op": op, "sig": sig,
+          "bucket": bucket, "impl": impl, "cell": cell_id(key),
+          "episode": int(episode), "z": round(float(z), 2),
+          "observed_s": observed_s, "base_mean_s": base_mean,
+          "base_std_s": base_std, "base_src": base_src,
+          "time_base": time_base}
+    try:
+        from spark_rapids_jni_tpu.obs import profiler as _profiler
+        prof = _profiler.maybe_capture(
+            "drift", f"{cell_id(key)}-ep{episode}",
+            attrs={"cell": cell_id(key), "z": round(float(z), 2)})
+        if prof is not None:
+            ev["profile"] = prof
+    except Exception:
+        pass
+    try:
+        from spark_rapids_jni_tpu.obs import spans as _spans
+        _spans.emit(dict(ev))
+    except Exception:
+        pass
+    try:
+        from spark_rapids_jni_tpu.obs import recorder as _recorder
+        if _recorder.armed():
+            reason = f"drift:{op}@{bucket}[{impl}]"
+            if episode > 1:
+                reason += f"-ep{episode}"
+            _recorder.dump_bundle(reason, ev)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Introspection
+# ---------------------------------------------------------------------------
+
+def cells() -> Dict[Key, Dict]:
+    """Snapshot of the live sentinel cells."""
+    with _LOCK:
+        return {k: dict(c) for k, c in _CELLS.items()}
+
+
+def score(op: str, sig: str = "", bucket="", impl: str = ""
+          ) -> Optional[float]:
+    """Latest z-score for one cell, or ``None`` before a baseline exists
+    (what the ``obs profile`` drift column renders)."""
+    key = (str(op), str(sig), str(bucket), str(impl))
+    with _LOCK:
+        c = _CELLS.get(key)
+        return None if c is None else c["last_z"]
+
+
+def drifting_count() -> int:
+    """Cells currently inside an open drift episode (the fleet-routing
+    signal the serve scheduler surfaces)."""
+    with _LOCK:
+        return sum(1 for c in _CELLS.values() if c["drifting"])
+
+
+def alarm_count() -> int:
+    """Total drift episodes opened since process start / reset."""
+    with _LOCK:
+        return _ALARMS
+
+
+# ---------------------------------------------------------------------------
+# Persistence (same discipline as CALIBRATION.json / FOOTPRINTS.json).
+# PERF_REFERENCE.json has two sections sharing one file: "metrics"
+# (bench headline figures, written by bench.py, read advisorily by
+# ci/regress_gate.py) and "cells" (per-cell timing baselines, written
+# by serving processes, read back as the online baseline).  Each writer
+# preserves the other's section.
+# ---------------------------------------------------------------------------
+
+def reference_path(path: Optional[str] = None) -> str:
+    """Resolve the reference file path: explicit arg > env > cwd."""
+    return path or os.environ.get(_ENV_FILE) or "PERF_REFERENCE.json"
+
+
+def max_age_s() -> float:
+    return _env_float(_ENV_MAX_AGE, 86400.0)
+
+
+def _invalidate_file_cache() -> None:
+    global _FILE_CACHE
+    with _FILE_LOCK:
+        _FILE_CACHE = None
+
+
+def _read_doc(p: str) -> Dict:
+    try:
+        with open(p, "r") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return doc if isinstance(doc, dict) else {}
+
+
+def _write_doc(p: str, doc: Dict) -> Optional[str]:
+    try:
+        tmp = f"{p}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, p)
+    except OSError:
+        return None
+    _invalidate_file_cache()
+    return p
+
+
+def save_reference(path: Optional[str] = None, source: str = "observed",
+                   now: Optional[float] = None) -> Optional[str]:
+    """Persist the learned per-cell baselines atomically, preserving any
+    existing ``metrics`` section.  Only baselined cells are worth
+    persisting.  Returns the path written, or ``None`` on failure or an
+    empty model — the reference is advisory, a read-only cwd must not
+    fail a run."""
+    snap = cells()
+    out = {}
+    for k, c in snap.items():
+        if c["base_mean"] is None:
+            continue
+        entry = {"mean_s": float(c["base_mean"]),
+                 "std_s": float(c["base_std"]),
+                 "calls": int(c["calls"]),
+                 "time_base": c.get("time_base", "wall")}
+        if c.get("ewma_gbps") is not None:
+            entry["gbps"] = round(float(c["ewma_gbps"]), 4)
+        out[cell_id(k)] = entry
+    if not out:
+        return None
+    p = reference_path(path)
+    doc = _read_doc(p)
+    doc["ts"] = time.time() if now is None else float(now)
+    doc["source"] = source
+    doc["cells"] = out
+    return _write_doc(p, doc)
+
+
+def update_reference_metrics(metrics_map: Dict[str, Dict],
+                             path: Optional[str] = None,
+                             source: str = "bench",
+                             now: Optional[float] = None
+                             ) -> Optional[str]:
+    """Refresh the ``metrics`` section (bench headline figures,
+    ``{name: {"value": v, "unit": u}}``) preserving any ``cells``
+    section a serving process persisted.  The bench headline axis calls
+    this so the offline gate and online sentinel share one reference."""
+    clean = {}
+    for name, e in (metrics_map or {}).items():
+        if isinstance(e, (int, float)):
+            clean[str(name)] = {"value": float(e), "unit": ""}
+        elif isinstance(e, dict) and isinstance(e.get("value"),
+                                                (int, float)):
+            clean[str(name)] = {"value": float(e["value"]),
+                                "unit": str(e.get("unit", ""))}
+    if not clean:
+        return None
+    p = reference_path(path)
+    doc = _read_doc(p)
+    doc["ts"] = time.time() if now is None else float(now)
+    doc["source"] = source
+    doc["metrics"] = clean
+    return _write_doc(p, doc)
+
+
+def load_reference(path: Optional[str] = None,
+                   max_age: Optional[float] = None,
+                   now: Optional[float] = None
+                   ) -> Optional[Dict[Key, Dict]]:
+    """Read the reference cells back; ``None`` when missing, malformed,
+    or older than the freshness window (a stale reference silently
+    re-baselining today's kernels against last month's timings is worse
+    than no reference)."""
+    p = reference_path(path)
+    doc = _read_doc(p)
+    if not isinstance(doc.get("cells"), dict):
+        return None
+    age_cap = max_age_s() if max_age is None else float(max_age)
+    ts = doc.get("ts")
+    if isinstance(ts, (int, float)) and age_cap > 0:
+        t = time.time() if now is None else float(now)
+        if t - ts > age_cap:
+            return None
+    out: Dict[Key, Dict] = {}
+    for raw, c in doc["cells"].items():
+        parts = str(raw).split("|")
+        if len(parts) != 4 or not isinstance(c, dict):
+            continue
+        m = c.get("mean_s")
+        if not isinstance(m, (int, float)) or m <= 0:
+            continue
+        s = c.get("std_s")
+        out[tuple(parts)] = {
+            "mean_s": float(m),
+            "std_s": (float(s)
+                      if isinstance(s, (int, float)) and s > 0 else 0.0),
+            "gbps": (float(c["gbps"])
+                     if isinstance(c.get("gbps"), (int, float)) else None),
+            "calls": int(c.get("calls") or 0),
+        }
+    return out or None
+
+
+def _file_cells() -> Optional[Dict[Key, Dict]]:
+    """Cached read of the persisted reference, re-resolved when the path
+    changes (tests flip ``SRJ_TPU_DRIFT_FILE`` per tmpdir)."""
+    global _FILE_CACHE
+    p = reference_path()
+    with _FILE_LOCK:
+        if _FILE_CACHE is not None and _FILE_CACHE[0] == p:
+            return _FILE_CACHE[1]
+    ref = load_reference(p)
+    with _FILE_LOCK:
+        _FILE_CACHE = (p, ref)
+    return ref
+
+
+# ---------------------------------------------------------------------------
+# Surfacing: /metrics collect hook + /healthz provider
+# ---------------------------------------------------------------------------
+
+def _publish_gauges() -> None:
+    try:
+        snap = cells()
+        g = _metrics.gauge
+        sc = g("srj_tpu_drift_score",
+               "Latest z-score of observed time over baseline, per cell.",
+               ("op", "bucket", "impl"))
+        for (op, _sig, bucket, impl), c in snap.items():
+            if c["last_z"] is not None:
+                sc.set(round(float(c["last_z"]), 3),
+                       op=op, bucket=bucket, impl=impl)
+        g("srj_tpu_drift_cells_drifting",
+          "Cells currently inside an open drift episode.").set(
+              sum(1 for c in snap.values() if c["drifting"]))
+    except Exception:
+        pass
+
+
+def health() -> Dict:
+    """The ``drift`` sub-document for ``/healthz``."""
+    snap = cells()
+    with _LOCK:
+        alarms = _ALARMS
+    doc = {
+        "enabled": enabled(),
+        "cells": len(snap),
+        "baselined": sum(1 for c in snap.values()
+                         if c["base_mean"] is not None),
+        "drifting": sum(1 for c in snap.values() if c["drifting"]),
+        "alarms": int(alarms),
+        "z_threshold": _z_threshold(),
+        "sustain": _sustain(),
+        "reference": reference_path(),
+        "reference_loaded": _file_cells() is not None,
+    }
+    worst = [(c["last_z"], cell_id(k)) for k, c in snap.items()
+             if c["last_z"] is not None]
+    if worst:
+        z, cid = max(worst)
+        doc["worst"] = {"cell": cid, "z": round(float(z), 2)}
+    return doc
+
+
+def _ensure_surfaces() -> None:
+    global _SURFACED
+    if _SURFACED:
+        return
+    _SURFACED = True
+    try:
+        _metrics.register_collect_hook(_publish_gauges)
+    except Exception:
+        pass
+    try:
+        from spark_rapids_jni_tpu.obs import exporter as _exporter
+        _exporter.register_health_provider("drift", health)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Replay + reset
+# ---------------------------------------------------------------------------
+
+def replay(events: Iterable[Dict]) -> None:
+    """Fold an event stream into the sentinel (CLI/offline path: same
+    arithmetic as the live feed)."""
+    for ev in events:
+        observe_span(ev)
+
+
+def reset() -> None:
+    """Zero all sentinel state (test isolation).  Leaves the metrics
+    registry and the persisted reference file alone; drops the file
+    cache so env-path changes re-resolve."""
+    global _ALARMS
+    with _LOCK:
+        _CELLS.clear()
+        _ALARMS = 0
+    _invalidate_file_cache()

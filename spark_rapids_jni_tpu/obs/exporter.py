@@ -29,6 +29,19 @@ Endpoints:
     state here, which is how load balancers see backpressure).  A
     provider that raises contributes ``{"error": ...}`` instead of
     taking down the endpoint.
+
+``POST /profile[?ms=N]``
+    Trigger one bounded :mod:`spark_rapids_jni_tpu.obs.profiler`
+    capture (synchronous: the response carries the finished capture
+    descriptor).  ``409`` when a capture session is already running,
+    ``503`` when profiling is disabled (``SRJ_TPU_PROFILE=0``).
+    Requests run on the ThreadingHTTPServer's per-request threads, so a
+    capture in flight never blocks a concurrent scrape.
+
+Scrapes are self-observing: ``srj_tpu_scrape_seconds`` (streaming
+percentiles) and ``srj_tpu_scrapes_total`` cover every ``/metrics``
+render, and ``/healthz`` reports the last scrape's duration — a slow
+collect hook is itself visible.
 """
 
 from __future__ import annotations
@@ -38,6 +51,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlsplit
 
 from spark_rapids_jni_tpu.obs import metrics as _metrics
 
@@ -50,6 +64,7 @@ _THREAD: Optional[threading.Thread] = None
 _STARTED_AT: float = 0.0
 _PROVIDERS: dict = {}
 _PROVIDERS_LOCK = threading.Lock()
+_LAST_SCRAPE_S: Optional[float] = None
 
 
 def register_health_provider(name: str, fn) -> None:
@@ -86,6 +101,8 @@ def _healthz() -> dict:
             total("srj_tpu_xla_compile_seconds_total"), 6),
     }
     doc.update(_spans.dropped())
+    if _LAST_SCRAPE_S is not None:
+        doc["last_scrape_s"] = round(_LAST_SCRAPE_S, 6)
     with _PROVIDERS_LOCK:
         providers = list(_PROVIDERS.items())
     for name, fn in providers:
@@ -96,13 +113,35 @@ def _healthz() -> dict:
     return doc
 
 
+def _scrape() -> bytes:
+    """Render one ``/metrics`` exposition, timing the render itself.
+    The timing lands in the registry *after* the render, so a scrape
+    reports the previous scrape's duration — the standard self-scrape
+    lag, and the price of not rendering twice."""
+    global _LAST_SCRAPE_S
+    t0 = time.monotonic()
+    body = _metrics.format_prometheus().encode("utf-8")
+    el = time.monotonic() - t0
+    _LAST_SCRAPE_S = el
+    try:
+        _metrics.summary(
+            "srj_tpu_scrape_seconds",
+            "Wall seconds to render one /metrics exposition "
+            "(collect hooks included).").observe(el)
+        _metrics.counter("srj_tpu_scrapes_total",
+                         "Prometheus /metrics scrapes served.").inc()
+    except Exception:
+        pass
+    return body
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "srj-tpu-metrics/1.0"
 
     def do_GET(self):  # noqa: N802 (http.server API)
         path = self.path.split("?", 1)[0]
         if path == "/metrics":
-            body = _metrics.format_prometheus().encode("utf-8")
+            body = _scrape()
             ctype = "text/plain; version=0.0.4; charset=utf-8"
         elif path == "/healthz":
             body = (json.dumps(_healthz()) + "\n").encode("utf-8")
@@ -112,6 +151,31 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self.send_response(200)
         self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):  # noqa: N802 (http.server API)
+        parts = urlsplit(self.path)
+        if parts.path != "/profile":
+            self.send_error(404, "try POST /profile[?ms=N]")
+            return
+        ms = None
+        try:
+            q = parse_qs(parts.query).get("ms")
+            if q:
+                ms = float(q[0])
+        except ValueError:
+            self.send_error(400, "ms must be a number")
+            return
+        from spark_rapids_jni_tpu.obs import profiler as _profiler
+        doc = _profiler.capture(reason="http", ms=ms, sync=True)
+        status = doc.get("status")
+        code = {"captured": 200, "unavailable": 200, "busy": 409,
+                "disabled": 503}.get(status, 500)
+        body = (json.dumps(doc, default=str) + "\n").encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)

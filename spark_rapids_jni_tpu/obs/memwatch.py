@@ -276,17 +276,27 @@ def _on_highwater(episode: int, live: int, cap: int) -> None:
             "SRJ_TPU_MEM_HIGHWATER_PCT fraction of capacity).").inc()
     except Exception:
         pass
+    ev = {
+        "kind": "mem", "name": "memwatch",
+        "live_bytes": int(live), "capacity_bytes": int(cap),
+        "watermark_bytes": int(_WATERMARK),
+        "episode": int(episode),
+    }
+    try:
+        # capture a bounded profile while the pressure is still on (one
+        # per episode, same dedupe discipline as the bundle itself)
+        from spark_rapids_jni_tpu.obs import profiler as _profiler
+        prof = _profiler.maybe_capture("mem_highwater", f"ep{episode}")
+        if prof is not None:
+            ev["profile"] = prof
+    except Exception:
+        pass
     try:
         from spark_rapids_jni_tpu.obs import recorder as _recorder
         if _recorder.armed():
             reason = "mem_highwater" if episode <= 1 \
                 else f"mem_highwater-ep{episode}"
-            _recorder.dump_bundle(reason, {
-                "kind": "mem", "name": "memwatch",
-                "live_bytes": int(live), "capacity_bytes": int(cap),
-                "watermark_bytes": int(_WATERMARK),
-                "episode": int(episode),
-            })
+            _recorder.dump_bundle(reason, ev)
     except Exception:
         pass
 

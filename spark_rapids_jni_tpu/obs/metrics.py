@@ -456,14 +456,39 @@ def unregister_collect_hook(fn) -> None:
             pass
 
 
+_HOOK_FAILED: set = set()
+
+
 def _run_collect_hooks() -> None:
     with _HOOK_LOCK:
         hooks = list(_COLLECT_HOOKS)
     for fn in hooks:
         try:
             fn()
-        except Exception:
-            pass
+        except Exception as e:
+            # a sick collect hook means silently stale gauges forever —
+            # count every failure, log each distinct hook's first one
+            try:
+                _REGISTRY.counter(
+                    "srj_tpu_obs_events_dropped_total",
+                    "Obs events lost to ring eviction or sink failure.",
+                    ("reason",)).inc(reason="collect_hook")
+            except Exception:
+                pass
+            name = getattr(fn, "__qualname__", None) or repr(fn)
+            with _HOOK_LOCK:
+                first = name not in _HOOK_FAILED
+                if first:
+                    _HOOK_FAILED.add(name)
+            if first:
+                try:
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "collect hook %s failed (first failure; "
+                        "counted into srj_tpu_obs_events_dropped_total"
+                        "{reason=\"collect_hook\"}): %s", name, e)
+                except Exception:
+                    pass
 
 
 def format_prometheus(reg: Optional[Registry] = None) -> str:
@@ -562,6 +587,11 @@ def observe_event(ev: Dict) -> None:
             try:
                 from . import memwatch as _mw
                 _mw.observe_span(ev)
+            except Exception:
+                pass
+            try:
+                from . import drift as _drift
+                _drift.observe_span(ev)
             except Exception:
                 pass
         elif kind == "compile":

@@ -1,0 +1,466 @@
+"""Managed ``jax.profiler`` capture service: anomaly-triggered deep
+profiling.
+
+The reference's tuning story is profiler-driven — nsight captures
+informed the row-conversion kernel constants (``row_conversion.cu:66-70``)
+and NVTX ranges exist so a human can attach a profiler when something
+slows down.  This module closes that loop for the serving path: when an
+anomaly fires (SLO burn episode, serve tick-watchdog overrun, breaker
+opening, memwatch high-water episode, drift alarm), a *bounded* device
+profile is captured automatically while the anomaly is still happening,
+and linked into the flight-recorder bundle that triggered it.
+
+Semantics:
+
+- **Single concurrent session, process-wide.**  ``jax.profiler``
+  raises an unhandled error on a second concurrent ``start_trace``;
+  here every capture (programmatic :func:`capture`, the exporter's
+  ``POST /profile``, anomaly hooks, and ``utils/tracing.trace``)
+  routes through one non-blocking session lock.  A would-be second
+  session gets a clean ``status="busy"`` result (or
+  :class:`SessionBusy` from the context-manager path) instead of a
+  backend raise.
+
+- **Bounded duration.**  ``SRJ_TPU_PROFILE_MS`` (default 500, clamped
+  to [1, 60000]) bounds every capture; anomaly hooks capture
+  asynchronously (a daemon thread sleeps out the budget and stops the
+  trace) so the hot path never blocks on the profiler.
+
+- **Run directory + bundle linking.**  Captures land under
+  ``SRJ_TPU_PROFILE_DIR`` (default: ``<diag dir>/profiles`` when the
+  flight recorder is armed, else ``/tmp/srj_tpu_profiles``) as
+  ``profile-<reason>-<seq>-<pid>/`` with a ``PROFILE.json`` result
+  descriptor.  Anomaly hooks attach the descriptor to the recorder
+  bundle's ``repro.json`` under the ``profile`` key.
+
+- **Graceful degradation.**  On backends without profiler support the
+  capture directory still exists but carries an explicit
+  ``profile_unavailable.json`` marker (``status="unavailable"``) —
+  CPU tier-1 stays green and a bundle always links *something*.
+
+- **Episode rate-limiting.**  :func:`maybe_capture` dedupes on
+  ``(trigger, episode_key)`` with the same one-per-episode discipline
+  as recorder bundles, and caps total captures per process
+  (``SRJ_TPU_PROFILE_MAX``, default 8) so a flapping anomaly cannot
+  fill a disk with traces.
+
+Everything is guarded: a capture failure never raises into the
+operation (or the anomaly hook) that requested it.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from spark_rapids_jni_tpu.obs import metrics as _metrics
+
+__all__ = [
+    "SessionBusy", "capture", "maybe_capture", "session", "active",
+    "profile_root", "profile_ms", "enabled", "health", "last_capture",
+    "reset",
+]
+
+_ENV_ARM = "SRJ_TPU_PROFILE"
+_ENV_MS = "SRJ_TPU_PROFILE_MS"
+_ENV_DIR = "SRJ_TPU_PROFILE_DIR"
+_ENV_MAX = "SRJ_TPU_PROFILE_MAX"
+
+_DEF_MS = 500
+_MAX_MS = 60000
+_DEF_MAX_CAPTURES = 8
+
+MARKER = "profile_unavailable.json"
+
+
+class SessionBusy(RuntimeError):
+    """A ``jax.profiler`` capture session is already active in this
+    process (single concurrent session, enforced here rather than as an
+    unhandled backend raise)."""
+
+
+# the process-wide session: non-blocking acquire is the whole protocol
+_SESSION = threading.Lock()
+
+
+_THREAD: Optional[threading.Thread] = None  # in-flight async capture
+
+
+@atexit.register
+def _drain_on_exit() -> None:
+    # an interpreter exiting with a trace still active (a daemon capture
+    # thread killed mid-budget) crashes in the profiler teardown; wait
+    # out the bounded budget so the capture thread stops its own trace
+    t = _THREAD
+    if t is not None and t.is_alive():
+        try:
+            t.join(timeout=(_MAX_MS / 1e3) + 5.0)
+        except Exception:
+            pass
+
+_LOCK = threading.Lock()
+_SEQ = 0
+_CAPTURES = 0
+_LAST: Optional[Dict] = None
+_EPISODES_SEEN: set = set()
+_UNSUPPORTED: Optional[str] = None  # first start_trace failure, verbatim
+_SURFACED = False
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def enabled() -> bool:
+    """Anomaly/manual captures armed (``SRJ_TPU_PROFILE=0`` stands the
+    whole service down; the session lock still guards ``tracing.trace``)."""
+    return os.environ.get(_ENV_ARM, "1") not in ("0", "false", "no")
+
+
+def profile_ms(ms: Optional[float] = None) -> int:
+    """Capture duration budget, clamped to [1, 60000] ms."""
+    if ms is None:
+        ms = _env_int(_ENV_MS, _DEF_MS)
+    try:
+        return max(1, min(_MAX_MS, int(ms)))
+    except (TypeError, ValueError):
+        return _DEF_MS
+
+
+def profile_root() -> str:
+    """Where capture directories land: env override, else a
+    ``profiles/`` subdir of the armed flight-recorder diag dir (so
+    captures travel with the bundles that link them), else /tmp."""
+    p = os.environ.get(_ENV_DIR)
+    if p:
+        return p
+    try:
+        from spark_rapids_jni_tpu.obs import recorder as _recorder
+        d = _recorder.diag_dir()
+        if d:
+            return os.path.join(d, "profiles")
+    except Exception:
+        pass
+    return "/tmp/srj_tpu_profiles"
+
+
+def active() -> bool:
+    """True while a capture session (any entry point) is running."""
+    return _SESSION.locked()
+
+
+def last_capture() -> Optional[Dict]:
+    with _LOCK:
+        return dict(_LAST) if _LAST else None
+
+
+# seams: tests monkeypatch these to fake backend behavior; production
+# code never touches the profiler machinery anywhere else.  The session
+# is driven directly (not via jax.profiler.start_trace) so the python
+# tracer can be turned OFF: XLA's python_hooks import tensorflow on the
+# capturing thread — seconds of import on the first anomaly capture and
+# a teardown crash when the interpreter exits with hooks installed.
+# Device + host activity is what anomaly captures are for.
+_PS = None                      # active ProfilerSession
+_PS_DIR: Optional[str] = None
+
+
+def _start_trace(log_dir: str) -> None:
+    global _PS, _PS_DIR
+    import jax
+    # backends must exist before the session (jax.profiler does the
+    # same) — otherwise on TPU the tracer misses device activity
+    jax.devices()
+    try:
+        from jax._src.lib import xla_client as _xc
+        opts = _xc.profiler.ProfileOptions()
+        opts.python_tracer_level = 0
+        _PS = _xc.profiler.ProfilerSession(opts)
+        _PS_DIR = log_dir
+    except Exception:
+        # jaxlib without the options surface: public API fallback
+        _PS, _PS_DIR = None, None
+        jax.profiler.start_trace(log_dir)
+
+
+def _stop_trace() -> None:
+    global _PS, _PS_DIR
+    ps, d = _PS, _PS_DIR
+    _PS, _PS_DIR = None, None
+    if ps is not None:
+        ps.stop_and_export(d)
+    else:
+        import jax
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def session(log_dir: str):
+    """Exclusive profiler session around a block (what
+    ``utils/tracing.trace`` routes through).  Raises :class:`SessionBusy`
+    when a capture is already running — the clean error the satellite
+    task demands — and propagates backend errors unchanged otherwise."""
+    if not _SESSION.acquire(blocking=False):
+        raise SessionBusy(
+            "a jax.profiler capture session is already active in this "
+            "process (single concurrent session); stop it or wait for "
+            "the bounded capture to finish")
+    try:
+        _start_trace(log_dir)
+        try:
+            yield log_dir
+        finally:
+            _stop_trace()
+    finally:
+        _SESSION.release()
+
+
+def _count(trigger: str, status: str) -> None:
+    try:
+        _metrics.counter(
+            "srj_tpu_profile_captures_total",
+            "Profiler capture attempts, by trigger and outcome.",
+            ("trigger", "status")).inc(trigger=str(trigger),
+                                       status=str(status))
+    except Exception:
+        pass
+
+
+def _emit(doc: Dict) -> None:
+    """Mirror a capture outcome into the obs event stream (rendered as an
+    instant event by ``obs/trace.py``)."""
+    try:
+        from spark_rapids_jni_tpu.obs import spans as _spans
+        ev = {"kind": "profile", "name": doc.get("reason", "?"),
+              "status": doc.get("status"), "dir": doc.get("dir"),
+              "ms": doc.get("ms")}
+        _spans.emit(ev)
+    except Exception:
+        pass
+
+
+def _finalize(doc: Dict, path: str) -> Dict:
+    """Write the result descriptor into the capture dir and publish it."""
+    global _LAST, _CAPTURES
+    try:
+        with open(os.path.join(path, "PROFILE.json"), "w") as f:
+            json.dump(doc, f, indent=2, default=str)
+            f.write("\n")
+    except OSError:
+        pass
+    with _LOCK:
+        _LAST = dict(doc)
+        if doc.get("status") == "captured":
+            _CAPTURES += 1
+    _count(doc.get("reason", "?"), doc.get("status", "?"))
+    _emit(doc)
+    return doc
+
+
+def capture(reason: str = "manual", ms: Optional[float] = None,
+            sync: bool = True, attrs: Optional[Dict] = None) -> Dict:
+    """One bounded profiler capture.  Returns a result descriptor —
+    never raises:
+
+    - ``{"status": "captured", "dir": ..., "ms": ...}`` on success,
+    - ``{"status": "capturing", ...}`` when ``sync=False`` and the
+      bounded stop is still pending on the background thread,
+    - ``{"status": "unavailable", "dir": ..., "marker": ...}`` when the
+      backend refused ``start_trace`` (an explicit marker file is left
+      in the capture dir so bundles link evidence, not silence),
+    - ``{"status": "busy"}`` when another session holds the lock,
+    - ``{"status": "disabled"}`` under ``SRJ_TPU_PROFILE=0``.
+    """
+    global _SEQ, _UNSUPPORTED
+    _ensure_surfaces()
+    reason = _slug(str(reason) or "manual")
+    if not enabled():
+        doc = {"status": "disabled", "reason": reason}
+        _count(reason, "disabled")
+        return doc
+    budget = profile_ms(ms)
+    if not _SESSION.acquire(blocking=False):
+        doc = {"status": "busy", "reason": reason}
+        _count(reason, "busy")
+        return doc
+    try:
+        with _LOCK:
+            seq = _SEQ
+            _SEQ += 1
+        path = os.path.join(profile_root(),
+                            f"profile-{reason}-{seq:03d}-{os.getpid()}")
+        doc: Dict = {"reason": reason, "ms": budget, "ts": time.time(),
+                     "dir": path}
+        if attrs:
+            doc.update({k: v for k, v in attrs.items() if k not in doc})
+        try:
+            os.makedirs(path, exist_ok=True)
+        except OSError as e:
+            _SESSION.release()
+            doc.update(status="unavailable", error=f"mkdir: {e}")
+            doc.pop("dir", None)
+            _count(reason, "unavailable")
+            return doc
+        def _begin() -> Optional[Dict]:
+            """Start the trace; ``None`` on success, else the finalized
+            unavailable descriptor (explicit marker, never silence)."""
+            global _UNSUPPORTED
+            try:
+                _start_trace(path)
+                return None
+            except Exception as e:
+                # backend without profiler support (or a broken one):
+                # leave an explicit marker where the capture would be
+                _SESSION.release()
+                err = f"{type(e).__name__}: {e}"
+                with _LOCK:
+                    if _UNSUPPORTED is None:
+                        _UNSUPPORTED = err
+                doc.update(status="unavailable", error=err[:300],
+                           marker=MARKER)
+                try:
+                    with open(os.path.join(path, MARKER), "w") as f:
+                        json.dump(doc, f, indent=2, default=str)
+                        f.write("\n")
+                except OSError:
+                    pass
+                return _finalize(doc, path)
+
+        # after a successful start: run out the budget, stop, finalize
+        def _finish() -> Dict:
+            try:
+                time.sleep(budget / 1e3)
+            finally:
+                try:
+                    _stop_trace()
+                    doc["status"] = "captured"
+                except Exception as e:  # stop failed: still evidence
+                    doc["status"] = "unavailable"
+                    doc["error"] = f"stop_trace: {e}"[:300]
+                finally:
+                    _SESSION.release()
+            return _finalize(doc, path)
+
+        if sync:
+            failed = _begin()
+            return failed if failed is not None else _finish()
+
+        # async (anomaly hooks): even start_trace moves off the caller —
+        # its first-time init can cost hundreds of ms, and a watchdog /
+        # breaker / drift hot path must pay nothing beyond the lock grab
+        def _run() -> None:
+            if _begin() is None:
+                _finish()
+
+        doc["status"] = "capturing"
+        t = threading.Thread(target=_run, daemon=True,
+                             name=f"srj-profiler-{reason}")
+        global _THREAD
+        _THREAD = t
+        t.start()
+        _count(reason, "capturing")
+        return dict(doc)
+    except Exception as e:  # belt and braces: never raise into a hook
+        try:
+            _SESSION.release()
+        except RuntimeError:
+            pass
+        return {"status": "unavailable", "reason": reason,
+                "error": str(e)[:300]}
+
+
+def maybe_capture(trigger: str, episode_key: str,
+                  attrs: Optional[Dict] = None) -> Optional[Dict]:
+    """Anomaly-hook entry: one capture attempt per ``(trigger,
+    episode_key)`` episode (same dedupe discipline as recorder bundles),
+    capped at ``SRJ_TPU_PROFILE_MAX`` successful captures per process.
+    Returns the capture descriptor to link into the triggering bundle,
+    or ``None`` (disabled, deduped, capped).  Never raises, never
+    blocks: anomaly captures are asynchronous."""
+    try:
+        if not enabled():
+            return None
+        key = (str(trigger), str(episode_key))
+        cap = max(1, _env_int(_ENV_MAX, _DEF_MAX_CAPTURES))
+        with _LOCK:
+            if key in _EPISODES_SEEN:
+                return None
+            if _CAPTURES >= cap:
+                return None
+            _EPISODES_SEEN.add(key)
+        return capture(reason=trigger, sync=False, attrs=attrs)
+    except Exception:
+        return None
+
+
+def _slug(s: str) -> str:
+    return "".join(c if c.isalnum() or c in "._-" else "_" for c in s)[:48]
+
+
+def health() -> Dict:
+    """The ``profiler`` sub-document for ``/healthz``."""
+    with _LOCK:
+        last = dict(_LAST) if _LAST else None
+        captures = _CAPTURES
+        unsupported = _UNSUPPORTED
+    doc: Dict = {
+        "enabled": enabled(),
+        "active": active(),
+        "captures": int(captures),
+        "budget_ms": profile_ms(),
+        "dir": profile_root(),
+    }
+    if unsupported:
+        doc["unsupported"] = unsupported[:200]
+    if last:
+        doc["last"] = {k: last.get(k)
+                       for k in ("reason", "status", "dir", "ms", "ts")
+                       if last.get(k) is not None}
+    return doc
+
+
+def _publish_gauges() -> None:
+    try:
+        _metrics.gauge(
+            "srj_tpu_profile_active",
+            "1 while a jax.profiler capture session is running.").set(
+                1 if active() else 0)
+    except Exception:
+        pass
+
+
+def _ensure_surfaces() -> None:
+    global _SURFACED
+    if _SURFACED:
+        return
+    _SURFACED = True
+    try:
+        _metrics.register_collect_hook(_publish_gauges)
+    except Exception:
+        pass
+    try:
+        from spark_rapids_jni_tpu.obs import exporter as _exporter
+        _exporter.register_health_provider("profiler", health)
+    except Exception:
+        pass
+
+
+def reset() -> None:
+    """Forget episode dedupe / capture counters (test isolation).  Does
+    not touch a live session: an in-flight bounded capture finishes and
+    releases the lock on its own."""
+    global _SEQ, _CAPTURES, _LAST, _UNSUPPORTED
+    with _LOCK:
+        _SEQ = 0
+        _CAPTURES = 0
+        _LAST = None
+        _UNSUPPORTED = None
+        _EPISODES_SEEN.clear()

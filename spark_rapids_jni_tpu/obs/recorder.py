@@ -220,7 +220,8 @@ def _repro(ev: Dict, program_keys: List[Tuple]) -> Dict:
             "rows", "requests", "tenant", "tenants", "error_type",
             "error", "device_dead", "trace_id", "span_id",
             "parent_span_id", "links", "link_trace_ids", "host",
-            "thread", "deadline_ms", "retry_history")
+            "thread", "deadline_ms", "retry_history",
+            "cell", "episode", "z", "profile")
     r = {k: ev[k] for k in keep if k in ev}
     r["programs"] = [list(k) for k in program_keys]
     return r
@@ -415,6 +416,7 @@ class Watchdog:
         self.enabled = self.deadline_ms > 0
         self._lock = threading.Lock()
         self._fired = False
+        self._episodes = 0
 
     @property
     def fired(self) -> bool:
@@ -443,6 +445,8 @@ class Watchdog:
             if self._fired:
                 return
             self._fired = True
+            self._episodes += 1
+            episode = self._episodes
         try:
             ev = {"kind": "watchdog", "name": self.name, "status": "stall",
                   "deadline_ms": self.deadline_ms,
@@ -455,6 +459,17 @@ class Watchdog:
                 _m.counter("srj_tpu_watchdog_stalls_total",
                            "Watchdog deadline overruns.",
                            ("name",)).inc(name=self.name)
+            except Exception:
+                pass
+            try:
+                # whatever is stalling the tick loop is still stalling
+                # it right now — capture a bounded profile of it and
+                # link it into the stall bundle
+                from spark_rapids_jni_tpu.obs import profiler as _prof
+                prof = _prof.maybe_capture("watchdog",
+                                           f"{self.name}-ep{episode}")
+                if prof is not None:
+                    ev["profile"] = prof
             except Exception:
                 pass
             dump_bundle("stall", ev)
@@ -501,6 +516,14 @@ def format_bundle(path: str) -> str:
             lines.append(f"  {k:<12}: {repro[k]}")
     if repro.get("trace_id"):
         lines.append(f"  trace_id    : {repro['trace_id']}")
+    if repro.get("cell"):
+        lines.append(f"  drift cell  : {repro['cell']}"
+                     + (f"  z={repro['z']}" if repro.get("z") is not None
+                        else ""))
+    prof = repro.get("profile")
+    if isinstance(prof, dict):
+        lines.append(f"  profile     : {prof.get('status')}  "
+                     f"{prof.get('dir') or prof.get('error', '')}")
     if repro.get("tenants"):
         lines.append(f"  tenants     : {', '.join(map(str, repro['tenants']))}")
     if repro.get("link_trace_ids"):

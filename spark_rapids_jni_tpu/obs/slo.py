@@ -318,6 +318,21 @@ def _on_transition(st: _State, doc: Dict) -> None:
                          ("objective",)).inc(objective=st.obj.name)
         if not st.bundle_dumped:
             st.bundle_dumped = True
+            ev = {"kind": "slo", "name": st.obj.name,
+                  "op": st.obj.op, "episode": st.episode,
+                  "fast_burn": doc["fast_burn"],
+                  "slow_burn": doc["slow_burn"]}
+            try:
+                # the burn is happening now: a bounded device profile of
+                # the offending window rides in the burn bundle (one
+                # capture per episode, same dedupe as the bundle)
+                from spark_rapids_jni_tpu.obs import profiler as _prof
+                prof = _prof.maybe_capture(
+                    "slo_burn", f"{st.obj.name}-ep{st.episode}")
+                if prof is not None:
+                    ev["profile"] = prof
+            except Exception:
+                pass
             try:
                 from spark_rapids_jni_tpu.obs import recorder as _rec
                 if _rec.armed():
@@ -327,12 +342,7 @@ def _on_transition(st: _State, doc: Dict) -> None:
                     reason = f"slo_burn:{st.obj.name}"
                     if st.episode > 1:
                         reason += f"-ep{st.episode}"
-                    _rec.dump_bundle(
-                        reason,
-                        {"kind": "slo", "name": st.obj.name,
-                         "op": st.obj.op, "episode": st.episode,
-                         "fast_burn": doc["fast_burn"],
-                         "slow_burn": doc["slow_burn"]})
+                    _rec.dump_bundle(reason, ev)
             except Exception:
                 pass
     elif not doc["burning"] and st.burning:

@@ -180,7 +180,7 @@ def trace_events(events: Iterable[Dict], pid: int = 0) -> Dict:
     starts = [n.start for roots in trees.values()
               for nodes in roots.values() for n in nodes]
     starts += [e["ts"] for e in events
-               if e.get("kind") in ("compile", "fault")
+               if e.get("kind") in ("compile", "fault", "drift", "profile")
                and isinstance(e.get("ts"), (int, float))]
     t0 = min(starts) if starts else 0.0
     scale = 1e6  # seconds -> microseconds
@@ -226,6 +226,18 @@ def trace_events(events: Iterable[Dict], pid: int = 0) -> Dict:
                             "ts": (ts - t0) * scale,
                             "args": {"count": compiles,
                                      "seconds": round(compile_s, 6)}})
+            elif ev.get("kind") in ("drift", "profile"):
+                # sentinel alarms and profiler captures as process-scoped
+                # instants: a drift episode is visible exactly where the
+                # slow spans sit on the timeline
+                args = {k: ev[k] for k in ("cell", "z", "episode",
+                                           "status", "dir", "ms")
+                        if ev.get(k) is not None}
+                out.append({"ph": "i", "s": "p",
+                            "name": f"{ev['kind']}:{ev.get('name', '?')}",
+                            "cat": f"srj.{ev['kind']}", "pid": hpid,
+                            "tid": 0, "ts": (ts - t0) * scale,
+                            "args": args})
             elif ev.get("kind") == "span":
                 if (isinstance(ev.get("h2d_bytes"), (int, float))
                         or isinstance(ev.get("d2h_bytes"), (int, float))):

@@ -266,6 +266,7 @@ class Breaker:
             maxlen=self.window)
         self._opened_at: Optional[float] = None
         self._last_probe: Optional[float] = None
+        self._open_count = 0
 
     # -- state ------------------------------------------------------------
 
@@ -304,6 +305,7 @@ class Breaker:
     def record(self, ok: bool) -> None:
         """Report one primary-implementation outcome."""
         now = time.monotonic()
+        opened = 0
         with self._lock:
             st = self._state_locked(now)
             if st == HALF_OPEN:
@@ -323,7 +325,20 @@ class Breaker:
                 fails = sum(1 for o in self._outcomes if not o)
                 if n >= self.min_calls and fails / n >= self.threshold:
                     self._opened_at = now
+                    self._open_count += 1
+                    opened = self._open_count
                     _fam()["opens"].inc(op=self.key[0], impl=self.key[3])
+        if opened:
+            # outside the breaker lock: a failure storm is in progress
+            # right now — one bounded profiler capture per open episode
+            try:
+                from spark_rapids_jni_tpu.obs import profiler as _profiler
+                _profiler.maybe_capture(
+                    "breaker_open",
+                    f"{'|'.join(self.key)}-ep{opened}",
+                    attrs={"cell": "|".join(self.key)})
+            except Exception:
+                pass
 
     def force_open(self) -> None:
         """Quarantine immediately (operational kill switch / tests)."""

@@ -648,7 +648,7 @@ class Scheduler:
     # -- health ------------------------------------------------------------
 
     def _health(self) -> dict:
-        return {
+        doc = {
             "queue_depth": self.queue.depth,
             "shedding": self.queue.shedding,
             "closed": self.queue.closed,
@@ -658,6 +658,14 @@ class Scheduler:
             "ticks": self.ticks,
             "served": self.served,
         }
+        try:
+            # fleet-routing signal: a balancer should prefer replicas
+            # whose kernels are not mid-drift-episode
+            from spark_rapids_jni_tpu.obs import drift as _drift
+            doc["drift_cells"] = _drift.drifting_count()
+        except Exception:
+            pass
+        return doc
 
     def healthz(self) -> dict:
         """The provider payload, for callers without an exporter."""

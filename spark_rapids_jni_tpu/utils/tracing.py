@@ -80,12 +80,16 @@ def op_scope(op: str, bucket=None):
 def trace(log_dir: str = "/tmp/srj_tpu_trace"):
     """Capture a ``jax.profiler`` trace around a block (TensorBoard/XProf
     loadable — the nsight-capture analogue used to tune the reference's
-    kernel constants, ``row_conversion.cu:66-70``)."""
-    jax.profiler.start_trace(log_dir)
-    try:
+    kernel constants, ``row_conversion.cu:66-70``).
+
+    Routed through the :mod:`spark_rapids_jni_tpu.obs.profiler` session
+    manager: only one capture session exists per process, so entering
+    while another capture runs raises a clean
+    :class:`~spark_rapids_jni_tpu.obs.profiler.SessionBusy` instead of
+    an unhandled ``jax.profiler`` error."""
+    from spark_rapids_jni_tpu.obs import profiler as _profiler
+    with _profiler.session(log_dir):
         yield log_dir
-    finally:
-        jax.profiler.stop_trace()
 
 
 @contextlib.contextmanager

@@ -1,0 +1,487 @@
+"""Online drift-sentinel tests: EWMA/z-score math vs a numpy oracle,
+warmup/sustain episode semantics, the chaos proof (an injected sustained
+slowdown on one (op, sig, bucket, impl) cell alarms that cell only,
+dumps exactly one bundle naming the cell with a profiler capture linked,
+co-resident cells stay green, results stay byte-identical, and the
+disarmed sentinel costs one predicate), PERF_REFERENCE.json persistence
+/ freshness / malformed tolerance / two-section preservation, the
+regress-gate advisory cross-check, `/healthz` + `/metrics` surfacing
+over a real socket, the `obs profile` drift column, Perfetto instant
+events, and the FI_LATENCY chaos fault.  All subprocess-free, all green
+on the CPU backend."""
+
+import json
+import math
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import obs, serve
+from spark_rapids_jni_tpu.faultinj import injector
+from spark_rapids_jni_tpu.obs import (
+    costmodel, drift, exporter, metrics, profiler, recorder, trace,
+)
+
+
+@pytest.fixture
+def drift_env(monkeypatch, tmp_path):
+    """Isolated sentinel state: no inherited knobs, reference file in a
+    tmpdir (never the repo cwd), profiler capped to a few ms, clean
+    ledgers before and after."""
+    for var in ("SRJ_TPU_DRIFT", "SRJ_TPU_DRIFT_Z", "SRJ_TPU_DRIFT_SUSTAIN",
+                "SRJ_TPU_DRIFT_WARMUP", "SRJ_TPU_DRIFT_ALPHA",
+                "SRJ_TPU_DRIFT_REL_FLOOR", "SRJ_TPU_DRIFT_MAX_AGE_S",
+                "SRJ_TPU_PROFILE", "SRJ_TPU_PROFILE_MAX"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("SRJ_TPU_DRIFT_FILE",
+                       str(tmp_path / "PERF_REFERENCE.json"))
+    monkeypatch.setenv("SRJ_TPU_PROFILE_DIR", str(tmp_path / "profiles"))
+    monkeypatch.setenv("SRJ_TPU_PROFILE_MS", "5")
+    drift.reset()
+    profiler.reset()
+    recorder.reset()
+    metrics.registry().reset()
+    yield
+    drift.reset()
+    profiler.reset()
+    recorder.reset()
+    metrics.registry().reset()
+
+
+@pytest.fixture
+def obs_on(drift_env):
+    obs.configure_sink(None)
+    obs.clear()
+    obs.enable()
+    yield
+    obs.disable()
+    obs.configure_sink(None)
+    obs.clear()
+
+
+def _span(name, t, impl="pallas", bucket="1024", sig="i32", **kw):
+    ev = {"kind": "span", "name": name, "status": "ok", "wall_s": t,
+          "sig": sig, "bucket": bucket, "impl": impl, "bytes": 1e9}
+    ev.update(kw)
+    return ev
+
+
+def _cell_key(name, impl="pallas", bucket="1024", sig="i32"):
+    return (name, sig, bucket, impl)
+
+
+# ---------------------------------------------------------------------------
+# EWMA / z-score arithmetic vs a numpy oracle
+# ---------------------------------------------------------------------------
+
+def test_ewma_matches_numpy_oracle(drift_env, monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_DRIFT_WARMUP", "1000")  # never freeze
+    rng = np.random.default_rng(3)
+    xs = rng.uniform(0.001, 0.01, 64)
+    for x in xs:
+        drift.observe_span(_span("oracle_op", float(x)))
+    alpha = 0.25
+    mean, var = float(xs[0]), 0.0
+    for x in xs[1:]:
+        delta = float(x) - mean
+        mean += alpha * delta
+        var = (1 - alpha) * (var + alpha * delta * delta)
+    c = drift.cells()[_cell_key("oracle_op")]
+    assert c["calls"] == len(xs)
+    assert c["ewma_t"] == pytest.approx(mean, rel=1e-12)
+    assert c["ewvar_t"] == pytest.approx(var, rel=1e-12)
+
+
+def test_zscore_against_frozen_baseline(drift_env, monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_DRIFT_WARMUP", "4")
+    monkeypatch.setenv("SRJ_TPU_DRIFT_REL_FLOOR", "0")
+    for _ in range(4):
+        drift.observe_span(_span("zop", 0.010))
+    c = drift.cells()[_cell_key("zop")]
+    assert c["base_src"] == "self"
+    assert c["base_mean"] == pytest.approx(0.010)
+    # metronomic warmup: std is the 1e-9 floor, so z is huge but exact
+    drift.observe_span(_span("zop", 0.020))
+    z = drift.score("zop", "i32", "1024", "pallas")
+    assert z == pytest.approx((0.020 - c["base_mean"]) / c["base_std"])
+
+
+def test_device_time_preferred_over_wall(drift_env):
+    drift.observe_span(_span("dev_op", 5.0, device_s=0.002))
+    c = drift.cells()[_cell_key("dev_op")]
+    assert c["time_base"] == "device"
+    assert c["ewma_t"] == pytest.approx(0.002)
+    # achieved GB/s from the same time base
+    assert c["ewma_gbps"] == pytest.approx(1e9 / 0.002 / 1e9)
+
+
+def test_error_spans_and_non_spans_ignored(drift_env):
+    drift.observe_span(_span("bad_op", 0.01, status="error"))
+    drift.observe_span({"kind": "compile", "duration_s": 1.0})
+    assert drift.cells() == {}
+
+
+# ---------------------------------------------------------------------------
+# Episode semantics: sustain gating, one alarm per episode, recovery
+# ---------------------------------------------------------------------------
+
+def test_single_spike_never_alarms(drift_env, monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_DRIFT_WARMUP", "4")
+    monkeypatch.setenv("SRJ_TPU_DRIFT_SUSTAIN", "3")
+    for _ in range(8):
+        drift.observe_span(_span("spiky", 0.010))
+    drift.observe_span(_span("spiky", 0.500))   # one straggler
+    drift.observe_span(_span("spiky", 0.010))   # back to normal
+    assert drift.alarm_count() == 0
+    assert drift.drifting_count() == 0
+
+
+def test_sustained_excursion_opens_one_episode(drift_env, monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_DRIFT_WARMUP", "4")
+    monkeypatch.setenv("SRJ_TPU_DRIFT_SUSTAIN", "3")
+    for _ in range(8):
+        drift.observe_span(_span("slowing", 0.010))
+    for _ in range(10):                          # well past sustain
+        drift.observe_span(_span("slowing", 0.050))
+    assert drift.alarm_count() == 1              # one episode, not ten
+    assert drift.drifting_count() == 1
+    # recovery closes + re-arms; a second sustained excursion is a
+    # second episode
+    for _ in range(3):
+        drift.observe_span(_span("slowing", 0.010))
+    assert drift.drifting_count() == 0
+    for _ in range(5):
+        drift.observe_span(_span("slowing", 0.050))
+    assert drift.alarm_count() == 2
+
+
+# ---------------------------------------------------------------------------
+# The chaos proof
+# ---------------------------------------------------------------------------
+
+def test_chaos_injected_slowdown_alarms_one_cell_only(obs_on, monkeypatch,
+                                                      tmp_path):
+    monkeypatch.setenv("SRJ_TPU_DRIFT_WARMUP", "4")
+    monkeypatch.setenv("SRJ_TPU_DRIFT_SUSTAIN", "3")
+    diag = tmp_path / "diag"
+    monkeypatch.setenv("SRJ_TPU_DIAG_DIR", str(diag))
+    recorder.arm(str(diag))
+    try:
+        # two co-resident cells reach steady state...
+        for _ in range(8):
+            drift.observe_span(_span("kernel_a", 0.010))
+            drift.observe_span(_span("kernel_b", 0.020))
+        # ...then kernel_a ships 5x slower, sustained
+        for _ in range(6):
+            drift.observe_span(_span("kernel_a", 0.050))
+            drift.observe_span(_span("kernel_b", 0.020))
+
+        # that cell alarms, the co-resident cell stays green
+        assert drift.alarm_count() == 1
+        snap = metrics.registry().snapshot()
+        vals = snap["srj_tpu_drift_alarms_total"]["values"]
+        assert sum(vals.values()) == 1
+        (labels,) = vals.keys()
+        assert "kernel_a" in str(labels) and "kernel_b" not in str(labels)
+        assert drift.score("kernel_b", "i32", "1024", "pallas") < 4.0
+
+        # exactly one bundle, naming the cell, linking a profiler
+        # capture directory (or an explicit unavailable marker)
+        bundles = sorted(p for p in os.listdir(diag)
+                         if p.startswith("bundle-drift"))
+        assert len(bundles) == 1
+        assert "kernel_a" in bundles[0]
+        repro = json.loads(
+            (diag / bundles[0] / "repro.json").read_text())
+        assert repro["cell"] == "kernel_a|i32|1024|pallas"
+        assert repro["z"] > 4.0
+        prof = repro["profile"]
+        if prof.get("dir"):
+            assert os.path.isdir(prof["dir"])
+        else:
+            assert prof["status"] in ("unavailable", "disabled", "busy")
+
+        # continued slowness inside the same episode never re-dumps
+        for _ in range(4):
+            drift.observe_span(_span("kernel_a", 0.050))
+        assert len([p for p in os.listdir(diag)
+                    if p.startswith("bundle-drift")]) == 1
+    finally:
+        recorder.disarm()
+
+
+def test_chaos_second_episode_gets_second_bundle(obs_on, monkeypatch,
+                                                 tmp_path):
+    monkeypatch.setenv("SRJ_TPU_DRIFT_WARMUP", "4")
+    monkeypatch.setenv("SRJ_TPU_DRIFT_SUSTAIN", "2")
+    diag = tmp_path / "diag"
+    recorder.arm(str(diag))
+    try:
+        for _ in range(6):
+            drift.observe_span(_span("flappy", 0.010))
+        for _ in range(3):
+            drift.observe_span(_span("flappy", 0.050))
+        for _ in range(2):
+            drift.observe_span(_span("flappy", 0.010))   # recover
+        for _ in range(3):
+            drift.observe_span(_span("flappy", 0.050))   # re-drift
+        bundles = sorted(p for p in os.listdir(diag)
+                         if p.startswith("bundle-drift"))
+        assert len(bundles) == 2
+        assert any("-ep2" in b for b in bundles)
+    finally:
+        recorder.disarm()
+
+
+def test_disarmed_sentinel_costs_one_predicate(drift_env, monkeypatch):
+    """SRJ_TPU_DRIFT=0 must return before any real work: monkeypatching
+    the fold function with a bomb proves the predicate is the only code
+    that runs per span."""
+    def bomb(ev):
+        raise AssertionError("disarmed sentinel did per-span work")
+    monkeypatch.setattr(drift, "_fold", bomb)
+    monkeypatch.setenv("SRJ_TPU_DRIFT", "0")
+    drift.observe_span(_span("off_op", 0.01))   # would raise if folded
+    assert drift.cells() == {}
+    monkeypatch.setenv("SRJ_TPU_DRIFT", "1")
+    with pytest.raises(AssertionError):
+        bomb(_span("off_op", 0.01))             # the bomb itself works
+
+
+def test_serve_results_byte_identical_armed_vs_disarmed(obs_on,
+                                                        monkeypatch):
+    """The sentinel observes; it must never change tenant results."""
+    rng = np.random.default_rng(11)
+    payloads = [(rng.integers(0, 16, 37).astype(np.int32),
+                 rng.integers(-5, 5, 37).astype(np.int32))
+                for _ in range(4)]
+
+    def burst():
+        s = serve.Scheduler()
+        try:
+            clients = [serve.Client(s, f"t{i}") for i in range(4)]
+            futs = [c.aggregate(k, v)
+                    for c, (k, v) in zip(clients, payloads)]
+            while s.tick():
+                pass
+            return [f.result(timeout=60) for f in futs]
+        finally:
+            s.close()
+
+    monkeypatch.setenv("SRJ_TPU_DRIFT", "1")
+    armed = burst()
+    monkeypatch.setenv("SRJ_TPU_DRIFT", "0")
+    disarmed = burst()
+    import jax
+    for a, d in zip(armed, disarmed):
+        la, ld = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(d)
+        assert len(la) == len(ld)
+        for x, y in zip(la, ld):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_latency_fault_injects_sleep_not_corruption(drift_env):
+    """FI_LATENCY is a perf fault: the intercepted call proceeds
+    normally after the delay — no raise, no device-dead state."""
+    rule = injector.FaultRule.from_json(
+        {"injectionType": 3, "percent": 100, "interceptionCount": 2,
+         "delayMs": 30})
+    assert rule.injection_type == injector.FI_LATENCY
+    assert rule.delay_ms == 30
+    st = injector.FaultInjectorState()
+    st.rules[injector.DOMAIN_EXECUTE]["*"] = rule
+    import time as _time
+    t0 = _time.monotonic()
+    st.maybe_inject(injector.DOMAIN_EXECUTE, "slow_call")   # no raise
+    assert _time.monotonic() - t0 >= 0.025
+    assert not st.device_dead
+    assert rule.interception_count == 1
+    st.maybe_inject(injector.DOMAIN_EXECUTE, "slow_call")
+    # budget exhausted: third call does not sleep
+    t0 = _time.monotonic()
+    st.maybe_inject(injector.DOMAIN_EXECUTE, "slow_call")
+    assert _time.monotonic() - t0 < 0.025
+
+
+# ---------------------------------------------------------------------------
+# PERF_REFERENCE.json: persistence, freshness, two sections, seeding
+# ---------------------------------------------------------------------------
+
+def test_reference_round_trip(drift_env, monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_DRIFT_WARMUP", "4")
+    for _ in range(6):
+        drift.observe_span(_span("persist_op", 0.010))
+    p = drift.save_reference(source="test")
+    assert p and os.path.exists(p)
+    doc = json.loads(open(p).read())
+    assert doc["source"] == "test"
+    assert isinstance(doc["ts"], float)
+    ref = drift.load_reference()
+    cell = ref[_cell_key("persist_op")]
+    assert cell["mean_s"] == pytest.approx(0.010)
+    assert cell["std_s"] > 0
+    assert cell["gbps"] == pytest.approx(100.0, rel=1e-6)
+
+
+def test_reference_freshness_and_malformed(drift_env, monkeypatch, tmp_path):
+    monkeypatch.setenv("SRJ_TPU_DRIFT_WARMUP", "2")
+    for _ in range(4):
+        drift.observe_span(_span("stale_op", 0.010))
+    import time as _time
+    p = drift.save_reference(now=_time.time() - 7 * 86400)
+    assert drift.load_reference() is None            # stale
+    assert drift.load_reference(max_age=0) is not None  # freshness off
+    # malformed files are tolerated, not fatal
+    open(p, "w").write("{not json")
+    assert drift.load_reference() is None
+    open(p, "w").write(json.dumps({"cells": {"badkey": {"mean_s": 1}}}))
+    assert drift.load_reference() is None
+    assert drift.load_reference(tmp_path / "missing.json") is None
+
+
+def test_reference_sections_preserved(drift_env, monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_DRIFT_WARMUP", "2")
+    # bench writes metrics first...
+    assert drift.update_reference_metrics(
+        {"throughput": {"value": 12.5, "unit": "GB/s"},
+         "scalar": 3.0}) is not None
+    # ...a serving process persists cells later: metrics survive
+    for _ in range(4):
+        drift.observe_span(_span("two_sec", 0.010))
+    drift.save_reference()
+    doc = json.loads(open(drift.reference_path()).read())
+    assert doc["metrics"]["throughput"]["value"] == 12.5
+    assert doc["metrics"]["scalar"] == {"value": 3.0, "unit": ""}
+    assert "two_sec|i32|1024|pallas" in doc["cells"]
+    # ...and a bench refresh preserves the cells right back
+    drift.update_reference_metrics({"throughput": {"value": 13.0,
+                                                   "unit": "GB/s"}})
+    doc = json.loads(open(drift.reference_path()).read())
+    assert doc["metrics"]["throughput"]["value"] == 13.0
+    assert "two_sec|i32|1024|pallas" in doc["cells"]
+
+
+def test_file_reference_seeds_baseline(drift_env, monkeypatch):
+    """A fresh reference cell arms the sentinel from the first call —
+    no warmup window for a kernel the reference already knows."""
+    monkeypatch.setenv("SRJ_TPU_DRIFT_SUSTAIN", "2")
+    monkeypatch.setenv("SRJ_TPU_DRIFT_WARMUP", "50")  # would never freeze
+    doc = {"ts": __import__("time").time(), "source": "bench",
+           "cells": {"seeded|i32|1024|pallas":
+                     {"mean_s": 0.010, "std_s": 0.001, "calls": 100}}}
+    open(drift.reference_path(), "w").write(json.dumps(doc))
+    for _ in range(3):
+        drift.observe_span(_span("seeded", 0.050))
+    c = drift.cells()[_cell_key("seeded")]
+    assert c["base_src"] == "file"
+    assert drift.alarm_count() == 1
+
+
+def test_regress_gate_reference_advisory(drift_env, tmp_path):
+    """ci/regress_gate.py reads the same reference; its rows are always
+    advisory — even enforce mode passes on a reference-only drift."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "regress_gate", os.path.join(os.path.dirname(__file__),
+                                     os.pardir, "ci", "regress_gate.py"))
+    gate = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gate)
+
+    ref = tmp_path / "PERF_REFERENCE.json"
+    ref.write_text(json.dumps(
+        {"ts": 1.0, "source": "bench",
+         "metrics": {"tp": {"value": 10.0, "unit": "GB/s"}},
+         "cells": {}}))
+    assert gate.reference_metrics(str(ref)) == {
+        "tp": {"value": 10.0, "unit": "GB/s"}}
+    assert gate.reference_metrics(str(tmp_path / "nope.json")) == {}
+
+    cur = tmp_path / "cur.json"
+    prev = tmp_path / "prev.json"
+    # round-over-round is flat (passes); the reference shows a 50% drop
+    cur.write_text(json.dumps(
+        {"parsed": {"metric": "tp", "value": 5.0, "unit": "GB/s"}}))
+    prev.write_text(json.dumps(
+        {"parsed": {"metric": "tp", "value": 5.0, "unit": "GB/s"}}))
+    rc = gate.main(["--history", str(tmp_path), "--mode", "enforce",
+                    "--current", str(cur), "--previous", str(prev),
+                    "--reference", str(ref)])
+    assert rc == 0    # advisory: reference drift never fails the build
+    # ...but a round-over-round regression still does
+    prev.write_text(json.dumps(
+        {"parsed": {"metric": "tp", "value": 50.0, "unit": "GB/s"}}))
+    rc = gate.main(["--history", str(tmp_path), "--mode", "enforce",
+                    "--current", str(cur), "--previous", str(prev),
+                    "--reference", str(ref)])
+    assert rc == 3
+
+
+# ---------------------------------------------------------------------------
+# Surfacing: scrape, healthz, profile column, Perfetto instants, serve
+# ---------------------------------------------------------------------------
+
+def test_scrape_and_healthz_surfaces(obs_on, monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_DRIFT_WARMUP", "4")
+    monkeypatch.setenv("SRJ_TPU_DRIFT_SUSTAIN", "2")
+    port = exporter.start(0)
+    assert port is not None
+    try:
+        for _ in range(6):
+            drift.observe_span(_span("scraped", 0.010))
+        for _ in range(3):
+            drift.observe_span(_span("scraped", 0.050))
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        assert 'srj_tpu_drift_alarms_total{' in body
+        assert 'op="scraped"' in body
+        assert "srj_tpu_drift_score{" in body
+        assert "srj_tpu_drift_cells_drifting 1" in body
+        hz = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+        d = hz["drift"]
+        assert d["enabled"] and d["alarms"] == 1 and d["drifting"] == 1
+        assert d["worst"]["cell"] == "scraped|i32|1024|pallas"
+    finally:
+        exporter.stop()
+
+
+def test_profile_table_has_drift_column(drift_env, monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_DRIFT_WARMUP", "4")
+    events = [_span("tabled", 0.010) for _ in range(6)]
+    events += [_span("tabled", 0.050)]
+    drift.replay(events)
+    led = costmodel.replay(events)
+    rows = led.profile(ceiling=100.0)
+    row = next(r for r in rows if r["op"] == "tabled")
+    assert isinstance(row["drift_z"], float) and row["drift_z"] > 0
+    text = costmodel.render_profile(rows)
+    assert "drift" in text.splitlines()[0]
+
+
+def test_trace_export_drift_instants(obs_on, monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_DRIFT_WARMUP", "2")
+    monkeypatch.setenv("SRJ_TPU_DRIFT_SUSTAIN", "1")
+    with obs.span("traced_op", bucket="1024"):
+        pass
+    for _ in range(4):
+        drift.observe_span(_span("traced_op", 0.010))
+    drift.observe_span(_span("traced_op", 0.100))
+    doc = trace.trace_events(obs.events())
+    inst = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert inst, "expected drift/profile instant events"
+    names = {e["name"] for e in inst}
+    assert "drift:traced_op" in names
+    di = next(e for e in inst if e["name"] == "drift:traced_op")
+    assert di["args"]["cell"].startswith("traced_op|")
+    assert di["args"]["z"] > 4.0
+    assert all(e["ts"] >= 0 for e in inst)
+
+
+def test_scheduler_health_reports_drift_cells(obs_on):
+    s = serve.Scheduler()
+    try:
+        assert s.healthz()["drift_cells"] == 0
+    finally:
+        s.close()

@@ -1,0 +1,392 @@
+"""Managed-capture-service tests: single-session enforcement (including
+the rerouted ``utils.tracing.trace``), bounded-capture lifecycle against
+faked backend seams, explicit ``profile_unavailable`` degradation,
+``maybe_capture`` episode dedupe and the process-wide cap, real-socket
+``POST /profile``, anomaly-hook wiring (SLO burn, watchdog stall,
+breaker open, memwatch high-water all attempt exactly one capture per
+episode), scrape self-telemetry, and the collect-hook failure
+accounting.  All subprocess-free, all green on the CPU backend."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from spark_rapids_jni_tpu.obs import (
+    exporter, memwatch, metrics, profiler, recorder,
+)
+from spark_rapids_jni_tpu.runtime import resilience
+from spark_rapids_jni_tpu.utils import tracing
+
+
+@pytest.fixture
+def prof_env(monkeypatch, tmp_path):
+    """Isolated profiler state: captures under a tmpdir, tiny budget,
+    no inherited knobs, clean state before and after."""
+    for var in ("SRJ_TPU_PROFILE", "SRJ_TPU_PROFILE_MAX"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("SRJ_TPU_PROFILE_DIR", str(tmp_path / "profiles"))
+    monkeypatch.setenv("SRJ_TPU_PROFILE_MS", "5")
+    profiler.reset()
+    recorder.reset()
+    metrics.registry().reset()
+    yield
+    profiler.reset()
+    recorder.reset()
+    metrics.registry().reset()
+
+
+@pytest.fixture
+def fake_backend(prof_env, monkeypatch):
+    """Replace the jax.profiler seams with recorders, so session
+    semantics are tested without real trace machinery."""
+    calls = {"start": [], "stop": 0}
+    monkeypatch.setattr(profiler, "_start_trace",
+                        lambda d: calls["start"].append(d))
+
+    def _stop():
+        calls["stop"] += 1
+    monkeypatch.setattr(profiler, "_stop_trace", _stop)
+    return calls
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Capture lifecycle
+# ---------------------------------------------------------------------------
+
+def test_sync_capture_lifecycle(fake_backend):
+    doc = profiler.capture(reason="manual", ms=5)
+    assert doc["status"] == "captured"
+    assert fake_backend["start"] == [doc["dir"]]
+    assert fake_backend["stop"] == 1
+    assert os.path.isdir(doc["dir"])
+    assert "manual" in os.path.basename(doc["dir"])
+    saved = json.loads(open(os.path.join(doc["dir"],
+                                         "PROFILE.json")).read())
+    assert saved["status"] == "captured"
+    assert saved["ms"] == 5
+    assert not profiler.active()
+    assert profiler.last_capture()["status"] == "captured"
+
+
+def test_async_capture_finishes_in_background(fake_backend):
+    doc = profiler.capture(reason="anomaly", ms=20, sync=False)
+    assert doc["status"] == "capturing"
+    assert os.path.isdir(doc["dir"])   # dir exists at link time already
+    assert _wait(lambda: os.path.exists(
+        os.path.join(doc["dir"], "PROFILE.json")))
+    assert fake_backend["stop"] == 1
+    assert not profiler.active()
+
+
+def test_budget_clamped(prof_env, monkeypatch):
+    assert profiler.profile_ms(0) == 1
+    assert profiler.profile_ms(10 ** 9) == 60000
+    assert profiler.profile_ms("junk") == 500
+    monkeypatch.setenv("SRJ_TPU_PROFILE_MS", "250")
+    assert profiler.profile_ms() == 250
+
+
+def test_disabled_short_circuits(prof_env, monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_PROFILE", "0")
+    doc = profiler.capture(reason="manual")
+    assert doc["status"] == "disabled"
+    assert profiler.maybe_capture("slo_burn", "ep1") is None
+
+
+def test_unavailable_writes_marker(prof_env, monkeypatch):
+    """A backend without profiler support leaves explicit evidence, not
+    silence — the marker file the chaos proof accepts in bundles."""
+    def refuse(_d):
+        raise RuntimeError("profiler not supported on this backend")
+    monkeypatch.setattr(profiler, "_start_trace", refuse)
+    doc = profiler.capture(reason="manual", ms=5)
+    assert doc["status"] == "unavailable"
+    assert "not supported" in doc["error"]
+    marker = os.path.join(doc["dir"], profiler.MARKER)
+    assert os.path.exists(marker)
+    assert json.loads(open(marker).read())["status"] == "unavailable"
+    assert not profiler.active()     # lock released: next capture runs
+    assert profiler.health()["unsupported"]
+
+
+def test_real_cpu_backend_never_raises(prof_env):
+    """Whatever the CPU backend does with jax.profiler — capture or
+    degrade — the service must come back with a descriptor."""
+    doc = profiler.capture(reason="cpu", ms=5)
+    assert doc["status"] in ("captured", "unavailable")
+    if doc["status"] == "captured":
+        assert os.path.isdir(doc["dir"])
+
+
+# ---------------------------------------------------------------------------
+# Single concurrent session
+# ---------------------------------------------------------------------------
+
+def test_second_capture_is_busy_not_a_raise(fake_backend):
+    with profiler.session("/tmp/srj-test-session"):
+        doc = profiler.capture(reason="manual", ms=5)
+        assert doc["status"] == "busy"
+        with pytest.raises(profiler.SessionBusy):
+            with profiler.session("/tmp/srj-test-session-2"):
+                pass
+    # released: a new session works
+    doc = profiler.capture(reason="manual", ms=5)
+    assert doc["status"] == "captured"
+
+
+def test_tracing_trace_routes_through_session(fake_backend, tmp_path):
+    """The satellite: utils.tracing.trace keeps its public API but a
+    concurrent capture now gets a clean SessionBusy."""
+    with tracing.trace(str(tmp_path / "t1")) as d:
+        assert d == str(tmp_path / "t1")
+        assert fake_backend["start"] == [d]
+        with pytest.raises(profiler.SessionBusy):
+            with tracing.trace(str(tmp_path / "t2")):
+                pass
+    assert fake_backend["stop"] == 1
+
+
+def test_concurrent_captures_one_winner(fake_backend):
+    results = []
+    barrier = threading.Barrier(4)
+
+    def go():
+        barrier.wait()
+        results.append(profiler.capture(reason="race", ms=30))
+    ts = [threading.Thread(target=go) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    statuses = sorted(r["status"] for r in results)
+    assert statuses.count("captured") == 1
+    assert statuses.count("busy") == 3
+
+
+# ---------------------------------------------------------------------------
+# maybe_capture: episode dedupe + cap
+# ---------------------------------------------------------------------------
+
+def test_maybe_capture_dedupes_per_episode(fake_backend):
+    d1 = profiler.maybe_capture("slo_burn", "lat-ep1")
+    assert d1 is not None and d1["status"] == "capturing"
+    assert _wait(lambda: not profiler.active())
+    # same episode: never again, even though the session is free
+    assert profiler.maybe_capture("slo_burn", "lat-ep1") is None
+    # a new episode (and a different trigger) each get one attempt
+    assert profiler.maybe_capture("slo_burn", "lat-ep2") is not None
+    assert _wait(lambda: not profiler.active())
+    assert profiler.maybe_capture("drift", "lat-ep1") is not None
+
+
+def test_maybe_capture_process_cap(fake_backend, monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_PROFILE_MAX", "2")
+    for i in range(2):
+        assert profiler.maybe_capture("drift", f"cell-ep{i}") is not None
+        assert _wait(lambda: not profiler.active())
+    assert profiler.maybe_capture("drift", "cell-ep9") is None
+    assert profiler.health()["captures"] == 2
+
+
+def test_capture_counter_by_trigger_and_status(fake_backend):
+    profiler.capture(reason="manual", ms=5)
+    with profiler.session("/tmp/srj-busy"):
+        profiler.capture(reason="manual", ms=5)
+    vals = metrics.registry().snapshot()[
+        "srj_tpu_profile_captures_total"]["values"]
+    flat = {str(k): v for k, v in vals.items()}
+    assert any("captured" in k for k in flat)
+    assert any("busy" in k for k in flat)
+
+
+# ---------------------------------------------------------------------------
+# POST /profile over a real socket
+# ---------------------------------------------------------------------------
+
+def _post(port, path):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_post_profile_endpoint(fake_backend):
+    port = exporter.start(0)
+    assert port is not None
+    try:
+        status, doc = _post(port, "/profile?ms=5")
+        assert status == 200
+        assert doc["status"] == "captured"
+        assert doc["ms"] == 5
+        assert doc["reason"] == "http"
+
+        # busy while a session is held -> 409
+        with profiler.session("/tmp/srj-busy-http"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(port, "/profile")
+            assert ei.value.code == 409
+            assert json.loads(ei.value.read())["status"] == "busy"
+
+        # bad ms -> 400; unknown path -> 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(port, "/profile?ms=soon")
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(port, "/nope")
+        assert ei.value.code == 404
+    finally:
+        exporter.stop()
+
+
+def test_post_profile_disabled_503(fake_backend, monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_PROFILE", "0")
+    port = exporter.start(0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(port, "/profile")
+        assert ei.value.code == 503
+    finally:
+        exporter.stop()
+
+
+# ---------------------------------------------------------------------------
+# Anomaly hooks: every trigger attempts one capture per episode
+# ---------------------------------------------------------------------------
+
+def test_watchdog_stall_bundle_links_capture(fake_backend, tmp_path,
+                                             monkeypatch):
+    recorder.arm(str(tmp_path / "diag"))
+    try:
+        wd = recorder.Watchdog(name="serve.tick", deadline_ms=20)
+        with wd.guard(op="tick"):
+            time.sleep(0.2)
+        assert wd.fired
+        assert _wait(lambda: recorder.last_bundle() is not None)
+        repro = json.loads(open(os.path.join(
+            recorder.last_bundle(), "repro.json")).read())
+        assert repro["profile"]["status"] in ("capturing", "captured")
+        assert os.path.isdir(repro["profile"]["dir"])
+        rendered = recorder.format_bundle(recorder.last_bundle())
+        assert "profile" in rendered
+    finally:
+        recorder.disarm()
+
+
+def test_breaker_open_attempts_capture(fake_backend, monkeypatch):
+    captured = []
+    monkeypatch.setattr(
+        profiler, "maybe_capture",
+        lambda trigger, key, attrs=None: captured.append((trigger, key)))
+    br = resilience.Breaker(("op_x", "s", "1024", "pallas"),
+                            threshold=0.5, window=4, min_calls=2,
+                            cooldown_s=60.0)
+    br.record(False)
+    br.record(False)
+    assert br.state == "open"
+    assert captured == [("breaker_open", "op_x|s|1024|pallas-ep1")]
+    # while open, further failures do not re-attempt
+    assert len(captured) == 1
+
+
+def test_memwatch_highwater_attempts_capture(fake_backend, monkeypatch):
+    captured = []
+    monkeypatch.setattr(
+        profiler, "maybe_capture",
+        lambda trigger, key, attrs=None: captured.append((trigger, key)))
+    monkeypatch.setenv("SRJ_TPU_MEM_HEADROOM_BYTES", str(1000))
+    monkeypatch.setenv("SRJ_TPU_MEM_HIGHWATER_PCT", "0.8")
+    memwatch.reset()
+    try:
+        memwatch._record_sample(900)
+        assert captured == [("mem_highwater", "ep1")]
+    finally:
+        memwatch.reset()
+
+
+def test_slo_burn_attempts_capture(fake_backend, monkeypatch):
+    captured = []
+    monkeypatch.setattr(
+        profiler, "maybe_capture",
+        lambda trigger, key, attrs=None: captured.append((trigger, key)))
+    from spark_rapids_jni_tpu.obs import slo
+    slo.clear()
+    try:
+        slo.add(slo.Objective("lat", kind="latency", op="burn_op",
+                              target=0.9, threshold=0.001,
+                              fast_burn=1.0, slow_burn=1.0))
+        now = time.time()
+        for _ in range(50):
+            slo.observe_span({"kind": "span", "name": "burn_op",
+                              "status": "ok", "wall_s": 0.5,
+                              "ts": now})
+        slo.evaluate(now)
+        assert ("slo_burn", "lat-ep1") in captured
+    finally:
+        slo.clear()
+
+
+# ---------------------------------------------------------------------------
+# Scrape self-telemetry + collect-hook failure accounting
+# ---------------------------------------------------------------------------
+
+def test_scrape_self_telemetry(prof_env):
+    port = exporter.start(0)
+    try:
+        url = f"http://127.0.0.1:{port}/metrics"
+        urllib.request.urlopen(url, timeout=10).read()
+        body = urllib.request.urlopen(url, timeout=10).read().decode()
+        # self-scrape lag: the 2nd exposition carries the 1st's timing
+        assert "srj_tpu_scrapes_total" in body
+        assert "srj_tpu_scrape_seconds" in body
+        hz = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+        assert hz["last_scrape_s"] >= 0
+        assert hz["profiler"]["enabled"] is True
+    finally:
+        exporter.stop()
+
+
+def test_collect_hook_failures_are_counted(prof_env):
+    def sick():
+        raise RuntimeError("stale gauge source")
+    metrics.register_collect_hook(sick)
+    try:
+        metrics.format_prometheus()
+        metrics.format_prometheus()
+        vals = metrics.registry().snapshot()[
+            "srj_tpu_obs_events_dropped_total"]["values"]
+        flat = {str(k): v for k, v in vals.items()}
+        key = next(k for k in flat if "collect_hook" in k)
+        assert flat[key] == 2   # every failure counted, not just the first
+    finally:
+        metrics.unregister_collect_hook(sick)
+
+
+def test_profiler_health_and_gauge(fake_backend):
+    port = exporter.start(0)
+    try:
+        profiler.capture(reason="manual", ms=5)
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        assert "srj_tpu_profile_active 0" in body
+        assert "srj_tpu_profile_captures_total" in body
+        hz = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+        assert hz["profiler"]["captures"] == 1
+        assert hz["profiler"]["last"]["status"] == "captured"
+    finally:
+        exporter.stop()
