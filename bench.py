@@ -612,7 +612,8 @@ def bench_kernels(num_rows):
     headline legs — the numbers every kernel rewrite proves itself with
     against ``ci/regress_gate.py``."""
     from spark_rapids_jni_tpu import Column
-    from spark_rapids_jni_tpu.ops import get_json_object, xxhash64
+    from spark_rapids_jni_tpu.ops import (
+        get_json_object, murmur3_hash, xxhash64)
     from spark_rapids_jni_tpu.ops.spark_bloom import SparkBloomFilter
 
     rng = np.random.default_rng(13)
@@ -675,7 +676,39 @@ def bench_kernels(num_rows):
         if t is not None:
             res[f"from_rows_{impl}_s"] = t
             res[f"from_rows_{impl}_GBps"] = ubytes / t / 1e9
-    del ucols, batch
+
+    # row-pack per-impl legs: encode the same table through the Pallas
+    # VMEM pack kernel and the oracle XLA pack
+    utab = Table(tuple(ucols))
+    for impl, knob in (("pallas", "1"), ("xla", "0")):
+        t = _leg(f"to_rows_{impl}",
+                 _forced(knob, lambda: convert_to_rows(utab)),
+                 leg_errors, iters=8,
+                 label=f"to_rows_{impl}[{num_rows}]", sync_each=True)
+        if t is not None:
+            res[f"to_rows_{impl}_s"] = t
+            res[f"to_rows_{impl}_GBps"] = ubytes / t / 1e9
+    del ucols, batch, utab
+
+    # variable-width string hashing per-impl legs: a dense-padded string
+    # column plus an int64 key column through the string codecs
+    ns = min(num_rows, 500_000)
+    scol = Column.strings_padded(
+        [f"user-{i % 9973:06d}@example.com" for i in range(ns)])
+    ikey = Column.from_numpy(
+        rng.integers(0, 1 << 30, ns).astype(np.int64), INT64)
+    jax.block_until_ready(scol.chars2d)
+    sbytes = scol.chars2d.size + ikey.data.nbytes
+    for impl, knob in (("pallas", "1"), ("xla", "0")):
+        t = _leg(f"hash_strings_{impl}",
+                 _forced(knob, lambda: murmur3_hash([scol, ikey])),
+                 leg_errors, iters=8,
+                 label=f"hash_strings_{impl}[{ns}]", sync_each=True)
+        if t is not None:
+            res[f"hash_strings_{impl}_rows"] = ns
+            res[f"hash_strings_{impl}_s"] = t
+            res[f"hash_strings_{impl}_GBps"] = sbytes / t / 1e9
+    del scol, ikey
 
     # bloom-filter probe (host-side Spark bit layout; slope timing — no
     # device round-trip to subtract)
@@ -701,6 +734,16 @@ def bench_kernels(num_rows):
         res["get_json_rows"] = nj
         res["get_json_s"] = t
         res["get_json_GBps"] = col.chars2d.size / t / 1e9
+    # per-impl legs: the Pallas grid scan vs the lax.scan chain over the
+    # same padded window
+    for impl, knob in (("pallas", "1"), ("xla", "0")):
+        t = _leg(f"get_json_{impl}",
+                 _forced(knob, lambda: get_json_object(col, "$.a")),
+                 leg_errors, iters=8,
+                 label=f"get_json_{impl}[{nj}]", sync_each=True)
+        if t is not None:
+            res[f"get_json_{impl}_s"] = t
+            res[f"get_json_{impl}_GBps"] = col.chars2d.size / t / 1e9
     if leg_errors:
         res["leg_errors"] = leg_errors
     return res
@@ -1442,7 +1485,8 @@ def main():
             _roof("get_json", kern.get("get_json_GBps"))
             # per-impl legs: the Pallas rewrite and the XLA lowering of
             # the same kernel, gated side by side
-            for kname in ("xxhash64", "from_rows"):
+            for kname in ("xxhash64", "from_rows", "to_rows",
+                          "get_json", "hash_strings"):
                 for impl in ("pallas", "xla"):
                     _roof(f"{kname}_{impl}",
                           kern.get(f"{kname}_{impl}_GBps"))

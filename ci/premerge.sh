@@ -57,35 +57,45 @@ PY
 rm -f "$SHAPE_EVENTS"
 
 # pallas-kernel smoke: force the Pallas engine (interpret mode on the
-# CPU mesh) through a from_rows decode, then assert every op span
-# carries impl=pallas and a repeat burst of identical calls costs zero
-# extra compiles — the knob, the attribution, and the program cache in
-# one leg
+# CPU mesh) through a to_rows pack burst, a from_rows decode burst, and
+# a get_json scan burst, then assert every op span carries impl=pallas
+# and each repeat burst of identical calls costs zero extra compiles —
+# the knob, the attribution, and the program cache in one leg
 PK_EVENTS=$(mktemp /tmp/srj_pallas_smoke.XXXXXX.jsonl)
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu SRJ_TPU_PALLAS=1 \
   SRJ_TPU_EVENTS="$PK_EVENTS" python -c "
 import numpy as np
 from spark_rapids_jni_tpu import Column, INT32, Table
-from spark_rapids_jni_tpu.ops import convert_from_rows, convert_to_rows
+from spark_rapids_jni_tpu.ops import (
+    convert_from_rows, convert_to_rows, get_json_object)
 t = Table((Column.from_numpy(np.arange(256, dtype=np.int32), INT32),
            Column.from_numpy(np.arange(256, dtype=np.int32) * 3, INT32)))
-batch = convert_to_rows(t)[0]
-convert_from_rows(batch, [INT32, INT32])      # warm: compiles land here
-for _ in range(5):                            # repeat burst: cache hits
+batch = convert_to_rows(t)[0]          # pack warm: compiles land here
+for _ in range(5):                     # pack burst: cache hits
+    convert_to_rows(t)
+convert_from_rows(batch, [INT32, INT32])      # decode warm
+for _ in range(5):                            # decode burst
     convert_from_rows(batch, [INT32, INT32])
+docs = Column.strings_padded(
+    ['{\"a\": %d, \"b\": {\"c\": [%d]}}' % (i, i * 3) for i in range(64)])
+get_json_object(docs, '\$.b.c[0]')            # scan warm
+for _ in range(5):                            # scan burst
+    get_json_object(docs, '\$.b.c[0]')
 "
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   python - "$PK_EVENTS" <<'PY'
 import json, sys
-spans = [e for line in open(sys.argv[1]) for e in [json.loads(line)]
-         if e.get("kind") == "span" and e.get("name") == "convert_from_rows"]
-assert len(spans) == 6, f"expected 6 decode spans, got {len(spans)}"
-assert all(s.get("impl") == "pallas" for s in spans), \
-    [s.get("impl") for s in spans]
-burst = sum(s.get("compiles", 0) for s in spans[1:])
-assert burst == 0, f"repeat burst recompiled: {burst} extra compiles"
-print(f"pallas smoke: 6 impl=pallas decode spans, warm compiles "
-      f"{spans[0].get('compiles', 0)}, burst compiles 0")
+events = [json.loads(line) for line in open(sys.argv[1])]
+for op in ("convert_to_rows", "convert_from_rows", "get_json_object"):
+    spans = [e for e in events
+             if e.get("kind") == "span" and e.get("name") == op]
+    assert len(spans) == 6, f"{op}: expected 6 spans, got {len(spans)}"
+    assert all(s.get("impl") == "pallas" for s in spans), \
+        (op, [s.get("impl") for s in spans])
+    burst = sum(s.get("compiles", 0) for s in spans[1:])
+    assert burst == 0, f"{op}: repeat burst recompiled {burst}x"
+    print(f"pallas smoke: {op} — 6 impl=pallas spans, warm compiles "
+          f"{spans[0].get('compiles', 0)}, burst compiles 0")
 PY
 rm -f "$PK_EVENTS"
 

@@ -128,10 +128,12 @@ def _select_lut_bytes(bytes_np, idx, kpos, dtype=jnp.int32):
     return out
 
 
-def _scan_automaton(ch: jnp.ndarray, segs: Tuple,
-                    max_key_len: int):
-    """Run the tokenizer over ``ch [n, W]``; returns per-row capture
-    (start, end, found, bad) positions into the padded window.
+def _automaton_pieces(segs: Tuple, max_key_len: int):
+    """Static transition tables plus the shape-agnostic
+    ``(make_carry0, step)`` pair for the path tokenizer.  Shared by the
+    ``lax.scan`` XLA chain (``_scan_automaton``) and the Pallas scan
+    kernel (``pallas_kernels.get_json_scan``), which replays ``step``
+    inside a ``fori_loop`` over the char window.
 
     Segments are bytes (object key) or int (array subscript).  Index
     levels ride the same frontier machinery: entering the frontier array
@@ -139,7 +141,6 @@ def _scan_automaton(ch: jnp.ndarray, segs: Tuple,
     advance it, and when it reaches the subscript the next element value
     is treated exactly like a matched key's value (descend / capture /
     dead-end by the next segment's type)."""
-    n, W = ch.shape
     L = len(segs)
     # static per-level key byte matrix [L, max_key_len] + lengths, plus
     # index-segment markers/targets (key levels get len-0 dummy keys)
@@ -183,26 +184,29 @@ def _scan_automaton(ch: jnp.ndarray, segs: Tuple,
             "the device automaton")
     i32 = jnp.int32
     u8 = jnp.uint8
-    z8 = jnp.zeros((n,), u8)
-    zb = jnp.zeros((n,), jnp.bool_)
-    zi = jnp.zeros((n,), i32)
-    carry0 = dict(
-        in_str=zb, esc=zb, depth=z8,
-        matched=z8,           # path segments fully matched on the stack
-        in_key=zb,            # currently scanning an object key at the
+
+    def make_carry0(n: int):
+        z8 = jnp.zeros((n,), u8)
+        zb = jnp.zeros((n,), jnp.bool_)
+        zi = jnp.zeros((n,), i32)
+        return dict(
+            in_str=zb, esc=zb, depth=z8,
+            matched=z8,       # path segments fully matched on the stack
+            in_key=zb,        # currently scanning an object key at the
                               # match frontier (depth == matched + 1)
-        key_pos=z8,           # bytes of the key consumed
-        key_ok=~zb,           # key still equals the target segment
-        await_colon=zb,       # key closed, expecting ':'
-        capturing=zb,         # inside the target value
-        cap_depth=z8,         # depth at capture start
-        elem_count=zi,        # elements passed in the frontier array
-        elem_pending=zb,      # target element's value starts next
-        start=zi - 1, end=zi - 1,
-        found=zb, bad=zb,
-        pending=zb, cap_is_str=zb, expect_key=zb,
-        deep=zb,              # nesting exceeded the uint8 depth budget
-    )
+            key_pos=z8,       # bytes of the key consumed
+            key_ok=~zb,       # key still equals the target segment
+            await_colon=zb,   # key closed, expecting ':'
+            capturing=zb,     # inside the target value
+            cap_depth=z8,     # depth at capture start
+            elem_count=zi,    # elements passed in the frontier array
+            elem_pending=zb,  # target element's value starts next
+            start=zi - 1, end=zi - 1,
+            found=zb, bad=zb,
+            pending=zb, cap_is_str=zb, expect_key=zb,
+            deep=zb,          # nesting exceeded the uint8 depth budget
+        )
+
     seg_lens_u8 = seg_lens.astype(np.uint8)
 
     def step(c, pos_and_char):
@@ -378,9 +382,18 @@ def _scan_automaton(ch: jnp.ndarray, segs: Tuple,
                    pending=pending2, deep=deep)
         return out, None
 
-    pos = jnp.arange(W, dtype=i32)
-    final, _ = jax.lax.scan(step, carry0, (pos, ch.T), unroll=_UNROLL)
-    # unterminated scalar at end-of-string: value runs to the char length
+    return make_carry0, step
+
+
+def _scan_automaton(ch: jnp.ndarray, segs: Tuple,
+                    max_key_len: int):
+    """Run the tokenizer over ``ch [n, W]``; returns per-row capture
+    (start, end, found, bad) positions into the padded window."""
+    n, W = ch.shape
+    make_carry0, step = _automaton_pieces(segs, max_key_len)
+    pos = jnp.arange(W, dtype=jnp.int32)
+    final, _ = jax.lax.scan(step, make_carry0(n), (pos, ch.T),
+                            unroll=_UNROLL)
     return final
 
 
@@ -554,12 +567,44 @@ def _get_json_object_impl(col: Column, path: str,
     # cast_string punt pattern): string values containing escapes
     # (must decode), and container values (Spark returns NORMALIZED
     # json -- re-serialized without insignificant whitespace)
-    # retry-only resilient dispatch: transient execute faults re-run
-    # the one jitted automaton pass (runtime/resilience.py)
+    # resilient dispatch: the Pallas scan kernel (when the knob and the
+    # (nsegs, W) eligibility hook select it) with the lax.scan chain as
+    # its twin; transient execute faults re-run either one
+    # (runtime/resilience.py)
     from spark_rapids_jni_tpu.runtime import resilience
-    outs = resilience.run("get_json_object", _gjo_device_jit, ch,
-                          col.validity, segs, W, mkl,
-                          sig=(len(segs),), bucket=W)
+    from spark_rapids_jni_tpu.ops import pallas_kernels
+    impl, interp = pallas_kernels.choose(
+        "get_json_object", jax.default_backend(), sig=(len(segs), W))
+    sig = (len(segs),)
+    if impl == "pallas":
+        if col.validity is None:
+            reg_fn, reg_args = (
+                lambda c: _gjo_device_pallas_jit(c, None, segs, W, mkl,
+                                                 interp), (ch,))
+        else:
+            reg_fn, reg_args = (
+                lambda c, v: _gjo_device_pallas_jit(c, v, segs, W, mkl,
+                                                    interp),
+                (ch, col.validity))
+        pallas_kernels.register("get_json_object", sig, W, reg_fn,
+                                reg_args, impl="pallas")
+
+        def _primary(c, v):
+            pallas_kernels.stamp_impl("pallas")
+            return _gjo_device_pallas_jit(c, v, segs, W, mkl, interp)
+
+        def _twin(c, v):
+            pallas_kernels.stamp_impl("xla")
+            return _gjo_device_jit(c, v, segs, W, mkl)
+
+        outs = resilience.run("get_json_object", _primary, ch,
+                              col.validity, sig=sig, bucket=W,
+                              impl="pallas", fallback=_twin)
+    else:
+        pallas_kernels.stamp_impl("xla")
+        outs = resilience.run("get_json_object", _gjo_device_jit, ch,
+                              col.validity, segs, W, mkl,
+                              sig=sig, bucket=W)
     return _finish_device_result(col, path, outs)
 
 
@@ -639,12 +684,11 @@ def _assemble_in_jit(vals, out_len, valid, needs_host):
         jnp.any(needs_host)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4))
-def _gjo_device_jit(ch, validity, segs, W: int, mkl: int):
-    """The whole non-wildcard device computation in ONE program (the
-    eager path would otherwise dispatch every vector op of the scan
-    individually -- hundreds of tunnel round-trips)."""
-    st = _scan_automaton(ch, segs, mkl)
+def _gjo_finish(ch, validity, st, W: int):
+    """Shared post-scan tail: value extraction, validity fold, the
+    host-punt classes, and the in-jit assemble.  ``st`` is either the
+    ``lax.scan`` chain's final carry or the Pallas scan kernel's field
+    dict — both expose the same start/end/found/capturing/bad/deep."""
     vals, out_len, ok, is_strval, first = _extract_value(ch, st, W)
     mask = jnp.arange(W, dtype=jnp.int32)[None, :] < out_len[:, None]
     if validity is not None:
@@ -661,6 +705,28 @@ def _gjo_device_jit(ch, validity, segs, W: int, mkl: int):
     is_container = valid & ((first == ord("{")) | (first == ord("[")))
     punts = has_bs | is_container | (st["deep"] & in_valid)
     return _assemble_in_jit(vals, out_len, valid, punts)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def _gjo_device_jit(ch, validity, segs, W: int, mkl: int):
+    """The whole non-wildcard device computation in ONE program (the
+    eager path would otherwise dispatch every vector op of the scan
+    individually -- hundreds of tunnel round-trips)."""
+    st = _scan_automaton(ch, segs, mkl)
+    return _gjo_finish(ch, validity, st, W)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
+def _gjo_device_pallas_jit(ch, validity, segs, W: int, mkl: int,
+                           interpret: bool):
+    """The Pallas twin: the VMEM-tiled scan kernel replaces the
+    ``lax.scan`` step chain; the extract/assemble tail is shared
+    verbatim (byte-identity by construction everywhere outside the
+    scan itself)."""
+    from spark_rapids_jni_tpu.ops import pallas_kernels
+    st = pallas_kernels.get_json_scan(ch, segs, mkl,
+                                      interpret=interpret)
+    return _gjo_finish(ch, validity, st, W)
 
 
 def _at(b: jnp.ndarray, pos: jnp.ndarray) -> jnp.ndarray:

@@ -355,9 +355,13 @@ def _dispatch_hash(op: str, pcols, seed: int, Wb: int, xla_jit):
     """Pick the tiled Pallas kernel or the generic XLA chain for one
     bucketed hash call (``SRJ_TPU_PALLAS`` knob, ``runtime/shapes``
     bucket already applied).  Pallas covers fixed-width non-nested
-    columns only (``Wb == 0``); anything else stays on the XLA chain.
-    Either way the span is stamped with ``impl=`` and the program is
-    registered with the flight recorder under ``(op, sig, bucket)``.
+    columns plus dense-padded string columns (the bucketed char window
+    ``Wb`` rides the stacked word matrix); Arrow-layout or width-capped
+    strings and decimal128 stay on the XLA chain via ``choose()``'s
+    per-op eligibility hook, which stamps ``impl=xla,
+    reason=ineligible``.  Either way the span is stamped with ``impl=``
+    and the program is registered with the flight recorder under
+    ``(op, sig, bucket)``.
 
     The Pallas path runs under :func:`runtime.resilience.run` with the
     XLA chain as its twin: transients retry, deterministic Pallas
@@ -366,17 +370,17 @@ def _dispatch_hash(op: str, pcols, seed: int, Wb: int, xla_jit):
     rate crosses the threshold (both lowerings are bit-exact by
     construction, so the fallback is invisible to callers)."""
     from spark_rapids_jni_tpu.ops import pallas_kernels
-    impl, interp = pallas_kernels.choose(op, jax.default_backend())
-    if impl == "pallas" and Wb == 0 \
-            and pallas_kernels.hashable_fixed(pcols):
+    impl, interp = pallas_kernels.choose(op, jax.default_backend(),
+                                         sig=pcols)
+    if impl == "pallas":
         b = pcols[0].num_rows
-        sig = (len(pcols), tuple(str(c.dtype) for c in pcols))
+        sig = (len(pcols), tuple(str(c.dtype) for c in pcols), Wb)
         if op == "murmur3_hash":
-            fn = functools.partial(pallas_kernels.murmur3_fixed,
-                                   seed=seed, interpret=interp)
+            fn = functools.partial(pallas_kernels.murmur3_cols,
+                                   seed=seed, W=Wb, interpret=interp)
         else:
-            fn = functools.partial(pallas_kernels.xxhash64_fixed,
-                                   seed=seed, interpret=interp)
+            fn = functools.partial(pallas_kernels.xxhash64_cols,
+                                   seed=seed, W=Wb, interpret=interp)
         # the recorder lowers from flat array avals — close over the
         # column treedef so the registered fn rebuilds the tuple
         leaves, treedef = jax.tree_util.tree_flatten(pcols)

@@ -21,6 +21,27 @@ kernels over HBM-resident columns* instead of generic XLA lowerings:
   evaluations; the bitset rides a constant-index BlockSpec so it stays
   VMEM-resident across every row tile instead of paying k random HBM
   gathers per row.
+- :func:`to_rows_fixed` — the PACK inverse: fixed-width columns →
+  JCUDF row blob.  The uint32 word planes are OR-assembled outside the
+  kernel (pure bitcasts/shifts, the inverse of
+  :func:`_cols_from_word_planes`); each grid step streams a
+  ``[W, tile]`` plane block into VMEM and expands it to row bytes with
+  the repeat+tiled-shift pattern (``table.byte_planes_from_word_planes``
+  — the documented TPU-safe byte expansion; no ``[n, 4]`` narrow
+  bitcasts, no strided stores).
+- :func:`get_json_scan` — the ``get_json`` character automaton
+  (``ops.get_json._automaton_pieces``) as a Pallas grid over lane tiles
+  of the TRANSPOSED char window: the LUT select-sums and the ~20-field
+  carry stay VMEM-resident while a ``fori_loop`` walks the W character
+  positions, replacing the ``lax.scan`` step chain for bucketed
+  fixed-max-len inputs.  Emits only the fields the extraction tail
+  consumes (start/end/found/capturing/bad/deep).
+- :func:`murmur3_cols` / :func:`xxhash64_cols` — the hash chains grown
+  to STRING columns: a padded char window rides the stacked word
+  matrix as ``Wp//4`` extra word rows plus one length row per string
+  column, and the in-kernel tail-block masking replays
+  ``hashing._mm3_string_col`` / ``_xx64_string_col`` word-for-word
+  (tail bytes come from a select-captured word, never a gather).
 
 Selection is per ``(op, sig, bucket)`` behind ``SRJ_TPU_PALLAS``:
 ``1`` = Pallas everywhere it is supported (interpret-mode off-TPU),
@@ -48,17 +69,20 @@ from spark_rapids_jni_tpu.obs import spans
 from spark_rapids_jni_tpu.runtime import shapes
 
 __all__ = [
-    "knob", "choose", "stamp_impl", "register", "SUPPORTED_OPS",
-    "from_rows_fixed", "murmur3_fixed", "xxhash64_fixed",
+    "knob", "choose", "eligible", "stamp_impl", "register",
+    "SUPPORTED_OPS",
+    "from_rows_fixed", "to_rows_fixed", "get_json_scan",
+    "murmur3_cols", "xxhash64_cols",
+    "murmur3_fixed", "xxhash64_fixed",
     "bloom_might_contain", "bloom_might_contain_xla",
 ]
 
 # ops this module has a tiled kernel for (the (op, dtype, bucket) support
-# matrix is finer: see each entry's eligibility helper and README's
+# matrix is finer: see the per-op ``_ELIGIBLE`` hooks below and README's
 # "Kernel implementations" section)
 SUPPORTED_OPS = frozenset({
-    "convert_from_rows", "murmur3_hash", "xxhash64",
-    "bloom_might_contain",
+    "convert_from_rows", "convert_to_rows", "get_json_object",
+    "murmur3_hash", "xxhash64", "bloom_might_contain",
 })
 
 _ENV = "SRJ_TPU_PALLAS"
@@ -75,23 +99,49 @@ def knob() -> str:
     return "auto"
 
 
-def choose(op: str, platform: Optional[str] = None) -> Tuple[str, bool]:
+def eligible(op: str, sig) -> bool:
+    """Per-op kernel-coverage check: True when the op's Pallas kernel
+    can tile this signature.  ``sig`` is op-defined (see ``_ELIGIBLE``);
+    ``None`` means the call site did not describe the shape — treated as
+    eligible for backwards compatibility.  A hook that raises counts as
+    ineligible (coverage probing must never break selection)."""
+    fn = _ELIGIBLE.get(op)
+    if fn is None or sig is None:
+        return True
+    try:
+        return bool(fn(sig))
+    except Exception:
+        return False
+
+
+def choose(op: str, platform: Optional[str] = None,
+           sig=None) -> Tuple[str, bool]:
     """Resolve one dispatch to ``(impl, interpret)``.
 
     ``impl`` is ``"pallas"`` or ``"xla"``; ``interpret`` is True when the
     Pallas kernel should run in interpret mode (off-TPU platforms — the
     CPU tier-1 mesh exercises the kernels this way).
 
-    The knob decides *preference*; :mod:`runtime.resilience` decides
-    *eligibility*: when a circuit breaker has quarantined the op's
-    Pallas kernel (failure rate over threshold — see
-    ``srj_tpu_breaker_*`` on ``/metrics``), this routes to the XLA twin
-    until the breaker's half-open probe closes it, even under
+    The knob decides *preference*; eligibility is decided HERE: first
+    the per-op :func:`eligible` hook (pass ``sig``, the op-defined shape
+    descriptor — e.g. the column tuple for the hash ops, ``(ncols,
+    row_size)`` for the row converters) routes signatures the kernel
+    cannot tile to the XLA twin with ``impl=xla reason=ineligible``
+    stamped on the ambient span, so call sites need no pre-filters;
+    then :mod:`runtime.resilience`'s circuit breaker: when it has
+    quarantined the op's Pallas kernel (failure rate over threshold —
+    see ``srj_tpu_breaker_*`` on ``/metrics``), this routes to the XLA
+    twin until the breaker's half-open probe closes it, even under
     ``SRJ_TPU_PALLAS=1``."""
     if platform is None:
         platform = jax.default_backend()
     k = knob()
     if k == "0" or op not in SUPPORTED_OPS:
+        return "xla", False
+    if not eligible(op, sig):
+        sp = spans.current_span()
+        if sp is not None:
+            sp.set(impl="xla", reason="ineligible")
         return "xla", False
     try:
         from spark_rapids_jni_tpu.runtime import resilience
@@ -229,34 +279,210 @@ def from_rows_fixed(rows2d: jnp.ndarray, layout, *,
 
 
 # ---------------------------------------------------------------------------
+# row-pack: columns -> word planes -> JCUDF blob
+# ---------------------------------------------------------------------------
+
+def _word_planes_from_table(table, layout) -> jnp.ndarray:
+    """JCUDF word planes ``[W, n]`` uint32 from fixed-width columns —
+    the pack-direction inverse of :func:`_cols_from_word_planes`.  Every
+    column's little-endian bytes OR-accumulate into its word lane(s)
+    (pure bitcasts and static shifts, no gathers: sub-word columns
+    shift into their byte slot, 64-bit plane pairs and decimal128 limbs
+    contribute whole planes), validity bytes land at the validity
+    offset, and alignment gaps stay zero."""
+    from spark_rapids_jni_tpu.ops.row_conversion import _validity_row_bytes
+    n = table.num_rows
+    W = layout.fixed_row_size // 4
+    terms: List[List] = [[] for _ in range(W)]
+
+    def put(byte_off, vec):
+        sh = 8 * (byte_off % 4)
+        terms[byte_off // 4].append(
+            vec << jnp.uint32(sh) if sh else vec)
+
+    for i, dt in enumerate(layout.dtypes):
+        s, sz = layout.col_starts[i], layout.col_sizes[i]
+        data = table.columns[i].data
+        if sz == 16:                        # decimal128 [n, 4] limbs
+            u = (data if data.dtype == jnp.uint32
+                 else jax.lax.bitcast_convert_type(data, jnp.uint32))
+            for j in range(4):
+                put(s + 4 * j, u[:, j])
+        elif sz == 8:
+            if data.ndim == 2:              # [2, n] lo/hi planes (no-x64)
+                put(s, data[0])
+                put(s + 4, data[1])
+            else:                           # native 64-bit under x64
+                pair = jax.lax.bitcast_convert_type(data, jnp.uint32)
+                put(s, pair[:, 0])
+                put(s + 4, pair[:, 1])
+        elif sz == 4:
+            u = (data if data.dtype == jnp.uint32
+                 else jax.lax.bitcast_convert_type(data, jnp.uint32))
+            put(s, u)
+        elif sz == 2:
+            u16 = (data if data.dtype == jnp.uint16
+                   else jax.lax.bitcast_convert_type(data, jnp.uint16))
+            put(s, u16.astype(jnp.uint32))
+        else:
+            if data.dtype == jnp.bool_:
+                u8 = data.astype(jnp.uint8)
+            elif data.dtype == jnp.uint8:
+                u8 = data
+            else:
+                u8 = jax.lax.bitcast_convert_type(data, jnp.uint8)
+            put(s, u8.astype(jnp.uint32))
+    vb = _validity_row_bytes(table, layout)    # [n, validity_bytes]
+    vo = layout.validity_offset
+    for b in range(layout.validity_bytes):
+        put(vo + b, vb[:, b].astype(jnp.uint32))
+    zero = jnp.zeros((n,), jnp.uint32)
+    planes = []
+    for ts in terms:
+        acc = zero
+        for t in ts:
+            acc = acc | t
+        planes.append(acc)
+    return jnp.stack(planes)
+
+
+def _pack_kernel(rows_ref, out_ref):
+    w = rows_ref[...]                          # [W, tile] u32 planes
+    wt = w.T                                   # [tile, W]
+    W = wt.shape[1]
+    # repeat+tiled-shift byte expansion (the TPU-safe pattern
+    # table.byte_planes_from_word_planes documents): word j repeated
+    # into lanes 4j..4j+3, shifted by its byte-in-word, masked to u8 —
+    # the pack-direction u32→u8 cast is the legal narrow direction
+    rep = jnp.repeat(wt, 4, axis=1)            # [tile, 4W]
+    # byte lane 4j+t reads byte t of word j; a 2-D iota keeps the shift
+    # vector kernel-internal (no captured constants, TPU needs >=2D)
+    lane = jax.lax.broadcasted_iota(jnp.uint32, rep.shape, 1)
+    out_ref[...] = ((rep >> ((lane % jnp.uint32(4)) * jnp.uint32(8)))
+                    & jnp.uint32(0xFF)).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def _to_rows_planes_jit(table, layout, tile: int, interpret: bool
+                        ) -> jnp.ndarray:
+    n = table.num_rows
+    rs = layout.fixed_row_size
+    W = rs // 4
+    planes = _word_planes_from_table(table, layout)
+    npad = max(tile, -(-n // tile) * tile)
+    planes = _pad_lanes(planes, npad)
+    rows = pl.pallas_call(
+        _pack_kernel,
+        grid=(npad // tile,),
+        in_specs=[pl.BlockSpec((W, tile), lambda r: (0, r),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((tile, rs), lambda r: (r, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((npad, rs), jnp.uint8),
+        interpret=interpret,
+    )(planes)
+    return rows[:n]
+
+
+@functools.partial(jax.jit, static_argnums=(1, 3, 4, 5))
+def _to_rows_planes_batch_jit(table, layout, start, size: int,
+                              tile: int, interpret: bool) -> jnp.ndarray:
+    """One equal-sized row batch, sliced with a traced start so every
+    full batch reuses ONE compiled program (the multi-batch planner's
+    contract, see ``_convert_to_rows_impl``)."""
+    from spark_rapids_jni_tpu.table import slice_table_dynamic
+    if size != table.num_rows:
+        table = slice_table_dynamic(table, start, size)
+    return _to_rows_planes_jit(table, layout, tile, interpret)
+
+
+def to_rows_fixed(table, layout, start=None, size: Optional[int] = None,
+                  *, interpret: bool = False, tile_rows: int = 0
+                  ) -> jnp.ndarray:
+    """Encode fixed-width columns into the JCUDF 2-D blob via the
+    streaming word-plane pack kernel.  Byte-identical to the XLA pack
+    (``row_conversion._to_rows_fixed_jit``)."""
+    if tile_rows <= 0:
+        # plane tile in + row-blob tile out, double-buffered by Pallas
+        tile_rows = shapes.vmem_tile(2 * layout.fixed_row_size)
+    if size is None:
+        return _to_rows_planes_jit(table, layout, tile_rows, interpret)
+    return _to_rows_planes_batch_jit(table, layout, start, size,
+                                     tile_rows, interpret)
+
+
+# ---------------------------------------------------------------------------
 # hash kernels: murmur3_x86_32 / xxhash64 over column tiles
 # ---------------------------------------------------------------------------
 
 def hashable_fixed(cols) -> bool:
-    """True when the Pallas hash kernels cover these columns: fixed-width
-    ≤ 8-byte scalars, no strings, no nested children, no decimals."""
+    """True when the columns are all fixed-width ≤ 8-byte scalars (no
+    strings, no nested children, no decimals) — the original kernel
+    coverage, kept as a helper for call sites that need the
+    strings-excluded predicate."""
     return all(
         not c.dtype.is_string and not c.children
         and c.dtype.kind != "decimal128" and c.dtype.itemsize <= 8
         for c in cols)
 
 
-def _hash_mats(cols):
-    """Stacked Spark-normalized word matrix [K, n] (per-column word
-    counts static) and validity matrix [C, n] uint8."""
-    from spark_rapids_jni_tpu.ops import hashing as H
-    n = cols[0].num_rows
-    words, counts = [], []
+def hash_cols_eligible(cols) -> bool:
+    """The ``choose()`` eligibility hook for the hash ops: fixed-width
+    ≤ 8-byte scalars plus DENSE-PADDED string columns (the char window
+    rides the stacked word matrix; Arrow-layout or width-capped strings
+    would need per-row gathers outside the kernel, so they stay on the
+    XLA chain).  No nested children, no decimal128."""
+    if not cols:
+        return False
     for c in cols:
-        ws = H._as_u32_words(c)
-        counts.append(len(ws))
-        words.extend(ws)
-    wmat = jnp.stack(words) if words else jnp.zeros((0, n), jnp.uint32)
+        if c.children or getattr(c, "capped", False):
+            return False
+        if c.dtype.is_string:
+            if not c.is_padded:
+                return False
+        elif c.dtype.kind == "decimal128" or c.dtype.itemsize > 8:
+            return False
+    return True
+
+
+def _hash_mats(cols, W: int, mode: str):
+    """ONE stacked word matrix [K, n] in chain order with a static
+    per-column descriptor, plus the validity matrix [C, n] uint8.
+
+    Fixed columns contribute their Spark-normalized words — desc
+    ``("f", nwords)`` (murmur3), or the (hi, lo) 8-byte block pair —
+    desc ``("f", 2)`` (xxhash64).  String columns contribute the padded
+    char window as ``Wp//4`` little-endian word rows plus ONE length
+    row — desc ``("s", Wp//4)`` — where ``Wp`` block-aligns the
+    bucketed window ``W`` to the op's stride (murmur3: 4-byte blocks,
+    xxhash64: 8-byte stripes), exactly as the XLA string paths do."""
+    from spark_rapids_jni_tpu.ops import hashing as H
+    from spark_rapids_jni_tpu.table import bytes2d_to_words
+    n = cols[0].num_rows
+    mats, desc = [], []
+    for c in cols:
+        if c.dtype.is_string:
+            Wp = ((W + 3) // 4 * 4 if mode == "mm3"
+                  else (W + 7) // 8 * 8)
+            if Wp:
+                mats.append(bytes2d_to_words(c.chars_window(Wp)).T)
+            mats.append(c.str_lens().astype(jnp.uint32)[None, :])
+            desc.append(("s", Wp // 4))
+        elif mode == "mm3":
+            ws = H._as_u32_words(c)
+            mats.append(jnp.stack(ws))
+            desc.append(("f", len(ws)))
+        else:
+            hi, lo = H._col_u64_blocks(c)
+            mats.append(jnp.stack([hi, lo]))
+            desc.append(("f", 2))
+    wmat = (jnp.concatenate(mats, axis=0) if mats
+            else jnp.zeros((0, n), jnp.uint32))
     vmat = jnp.stack([
         (c.valid_bools() if c.validity is not None
          else jnp.ones((n,), jnp.bool_)).astype(jnp.uint8)
         for c in cols])
-    return wmat, tuple(counts), vmat
+    return wmat, tuple(desc), vmat
 
 
 def _hash_tile(nrows_of_state: int) -> int:
@@ -265,34 +491,65 @@ def _hash_tile(nrows_of_state: int) -> int:
                             budget=2 << 20, floor=256, cap=1 << 16)
 
 
-def _mm3_kernel(counts, seed, w_ref, v_ref, o_ref):
+def _mm3_string_lanes(h, wrows, lens):
+    """``hashing._mm3_string_col`` replayed words-major over the row
+    slice ``wrows`` [nw, m].  Inside a kernel the tail bytes cannot be
+    gathered per-row (`take_along_axis` is TPU-illegal), so the word
+    holding them is select-captured while the block loop walks the
+    static rows, and Java's getByte sign extension is done
+    arithmetically instead of via an int8 bitcast round-trip."""
+    from spark_rapids_jni_tpu.ops import hashing as H
+    nw = wrows.shape[0]
+    nblocks = lens // 4
+    hc = h
+    if nw:
+        wtail = jnp.zeros_like(h)
+        for j in range(nw):
+            hc = jnp.where(j < nblocks, H._mm3_mix_h1(hc, wrows[j]), hc)
+            wtail = jnp.where(nblocks == j, wrows[j], wtail)
+        for t in range(3):
+            pos = nblocks * 4 + t
+            byte = (wtail >> jnp.uint32(8 * t)) & jnp.uint32(0xFF)
+            k1 = byte | jnp.where(byte >= jnp.uint32(0x80),
+                                  jnp.uint32(0xFFFFFF00), jnp.uint32(0))
+            hc = jnp.where(pos < lens, H._mm3_mix_h1(hc, k1), hc)
+    return H._mm3_fmix(hc, lens)
+
+
+def _mm3_kernel(desc, seed, w_ref, v_ref, o_ref):
     from spark_rapids_jni_tpu.ops import hashing as H
     w = w_ref[...]
     v = v_ref[...]
     h = jnp.full((w.shape[1],), np.uint32(seed), jnp.uint32)
     k = 0
-    for ci, nw in enumerate(counts):
-        hc = h
-        for j in range(nw):
-            hc = H._mm3_mix_h1(hc, w[k + j])
-        hc = H._mm3_fmix(hc, nw * 4)
+    for ci, (kind, nw) in enumerate(desc):
+        if kind == "s":
+            lens = w[k + nw].astype(jnp.int32)
+            hc = _mm3_string_lanes(h, w[k:k + nw], lens)
+            k += nw + 1
+        else:
+            hc = h
+            for j in range(nw):
+                hc = H._mm3_mix_h1(hc, w[k + j])
+            hc = H._mm3_fmix(hc, nw * 4)
+            k += nw
         h = jnp.where(v[ci] != 0, hc, h)
-        k += nw
     o_ref[...] = jax.lax.bitcast_convert_type(h, jnp.int32)[None, :]
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
-def _mm3_pallas_jit(cols, seed: int, interpret: bool) -> jnp.ndarray:
-    wmat, counts, vmat = _hash_mats(cols)
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def _mm3_pallas_jit(cols, seed: int, W: int, interpret: bool
+                    ) -> jnp.ndarray:
+    wmat, desc, vmat = _hash_mats(cols, W, "mm3")
     n = vmat.shape[1]
     K, C = wmat.shape[0], vmat.shape[0]
     tile = _hash_tile(K + C + 2)
     npad = max(tile, -(-n // tile) * tile)
     out = pl.pallas_call(
-        functools.partial(_mm3_kernel, counts, int(seed)),
+        functools.partial(_mm3_kernel, desc, int(seed)),
         grid=(npad // tile,),
         in_specs=[
-            pl.BlockSpec((K, tile), lambda r: (0, r),
+            pl.BlockSpec((max(1, K), tile), lambda r: (0, r),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((C, tile), lambda r: (0, r),
                          memory_space=pltpu.VMEM),
@@ -301,61 +558,140 @@ def _mm3_pallas_jit(cols, seed: int, interpret: bool) -> jnp.ndarray:
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((1, npad), jnp.int32),
         interpret=interpret,
-    )(_pad_lanes(wmat, npad), _pad_lanes(vmat, npad))
+    )(_pad_lanes(wmat if K else jnp.zeros((1, n), jnp.uint32), npad),
+      _pad_lanes(vmat, npad))
     return out[0, :n]
 
 
-def murmur3_fixed(cols, seed: int, *, interpret: bool = False
-                  ) -> jnp.ndarray:
-    """Spark murmur3 chain over fixed-width columns, one VMEM tile of
-    rows per grid step.  Bit-exact with ``hashing._murmur3_chain``."""
-    return _mm3_pallas_jit(tuple(cols), int(seed), interpret)
+def murmur3_cols(cols, seed: int, *, W: int = 0,
+                 interpret: bool = False) -> jnp.ndarray:
+    """Spark murmur3 chain over fixed-width AND dense-padded string
+    columns, one VMEM tile of rows per grid step.  ``W`` is the
+    bucketed char-window width shared by all string columns (0 when
+    none).  Bit-exact with ``hashing._murmur3_chain``."""
+    return _mm3_pallas_jit(tuple(cols), int(seed), int(W), interpret)
 
 
-def _xx_kernel(ncols, seed, hi_ref, lo_ref, v_ref, o_ref):
+#: historical fixed-only entry point; the generalized kernel accepts
+#: the same call shape.
+murmur3_fixed = murmur3_cols
+
+
+def _xx64_string_lanes(h, wrows, lens):
+    """``hashing._xx64_string_col`` replayed words-major over the row
+    slice ``wrows`` [nw, m] (nw = Wp//4, Wp stripe-aligned to 8).  The
+    clamped 4-byte-block and tail-byte words are select-captured from
+    the static rows, mirroring ``_word_at``'s clamp semantics."""
     from spark_rapids_jni_tpu.ops import hashing as H
-    hi = hi_ref[...]
-    lo = lo_ref[...]
+    nw = wrows.shape[0]
+    zeros = jnp.zeros_like(h[0])
+
+    def w64(j):
+        return (wrows[2 * j + 1], wrows[2 * j])
+
+    seed = h
+    nchunks = lens // 32
+    if nw >= 8:                                # Wp >= 32
+        v1 = H._add64(seed, H._const64(H._XXP1_I + H._XXP2_I))
+        v2 = H._add64(seed, H._const64(H._XXP2_I))
+        v3 = seed
+        v4 = H._add64(seed, H._const64(-H._XXP1_I))
+        for g in range(nw // 8):
+            active = g < nchunks
+            v1 = H._where64(active, H._xx_round(v1, w64(4 * g)), v1)
+            v2 = H._where64(active, H._xx_round(v2, w64(4 * g + 1)), v2)
+            v3 = H._where64(active, H._xx_round(v3, w64(4 * g + 2)), v3)
+            v4 = H._where64(active, H._xx_round(v4, w64(4 * g + 3)), v4)
+        big = H._add64(H._add64(H._rotl64(v1, 1), H._rotl64(v2, 7)),
+                       H._add64(H._rotl64(v3, 12), H._rotl64(v4, 18)))
+
+        def merge(acc, vv):
+            acc = H._xor64(acc, H._xx_round((zeros, zeros), vv))
+            return H._add64(H._mul64(acc, H._u64(*H._XXP1)),
+                            H._u64(*H._XXP4))
+
+        big = merge(merge(merge(merge(big, v1), v2), v3), v4)
+        hash_ = H._where64(lens >= 32, big,
+                           H._add64(seed, H._u64(*H._XXP5)))
+    else:
+        hash_ = H._add64(seed, H._u64(*H._XXP5))
+    hash_ = H._add64(hash_, (zeros, lens.astype(jnp.uint32)))
+
+    nlongs = lens // 8
+    for j in range(nw // 2):
+        active = (j >= nchunks * 4) & (j < nlongs)
+        k1 = H._xx_round((zeros, zeros), w64(j))
+        upd = H._add64(H._mul64(H._rotl64(H._xor64(hash_, k1), 27),
+                                H._u64(*H._XXP1)), H._u64(*H._XXP4))
+        hash_ = H._where64(active, upd, hash_)
+
+    if nw:
+        has4 = (lens % 8) >= 4
+        idx32 = jnp.minimum(nlongs * 2, nw - 1)
+        w32 = zeros
+        for j in range(nw):
+            w32 = jnp.where(idx32 == j, wrows[j], w32)
+        upd = H._add64(H._mul64(H._rotl64(
+            H._xor64(hash_, H._mul64((zeros, w32), H._u64(*H._XXP1))),
+            23), H._u64(*H._XXP2)), H._u64(*H._XXP3))
+        hash_ = H._where64(has4, upd, hash_)
+
+        tidx = jnp.minimum(nlongs * 2 + has4.astype(jnp.int32), nw - 1)
+        wt = zeros
+        for j in range(nw):
+            wt = jnp.where(tidx == j, wrows[j], wt)
+        tail_start = nlongs * 8 + jnp.where(has4, 4, 0).astype(jnp.int32)
+        for t in range(3):
+            pos = tail_start + t
+            byte = (wt >> jnp.uint32(8 * t)) & jnp.uint32(0xFF)
+            upd = H._mul64(H._rotl64(
+                H._xor64(hash_, H._mul64((zeros, byte),
+                                         H._u64(*H._XXP5))),
+                11), H._u64(*H._XXP1))
+            hash_ = H._where64(pos < lens, upd, hash_)
+    return H._xx_fmix(hash_)
+
+
+def _xx_kernel(desc, seed, w_ref, v_ref, o_ref):
+    from spark_rapids_jni_tpu.ops import hashing as H
+    w = w_ref[...]
     v = v_ref[...]
-    zeros = jnp.zeros((hi.shape[1],), jnp.uint32)
+    zeros = jnp.zeros((w.shape[1],), jnp.uint32)
     h = (zeros, zeros + jnp.uint32(seed))
-    for ci in range(ncols):
-        blk = (hi[ci], lo[ci])
-        hc = H._add64(H._add64(h, H._u64(*H._XXP5)), H._u64(0, 8))
-        k1 = H._xx_round((zeros, zeros), blk)
-        hc = H._xor64(hc, k1)
-        hc = H._rotl64(hc, 27)
-        hc = H._add64(H._mul64(hc, H._u64(*H._XXP1)), H._u64(*H._XXP4))
-        hc = H._xx_fmix(hc)
+    k = 0
+    for ci, (kind, nw) in enumerate(desc):
+        if kind == "s":
+            lens = w[k + nw].astype(jnp.int32)
+            hc = _xx64_string_lanes(h, w[k:k + nw], lens)
+            k += nw + 1
+        else:
+            blk = (w[k], w[k + 1])             # (hi, lo)
+            hc = H._add64(H._add64(h, H._u64(*H._XXP5)), H._u64(0, 8))
+            k1 = H._xx_round((zeros, zeros), blk)
+            hc = H._xor64(hc, k1)
+            hc = H._rotl64(hc, 27)
+            hc = H._add64(H._mul64(hc, H._u64(*H._XXP1)),
+                          H._u64(*H._XXP4))
+            hc = H._xx_fmix(hc)
+            k += 2
         val = v[ci] != 0
         h = (jnp.where(val, hc[0], h[0]), jnp.where(val, hc[1], h[1]))
     o_ref[...] = jnp.stack([h[1], h[0]])       # (lo, hi) rows
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
-def _xx64_pallas_jit(cols, seed: int, interpret: bool) -> jnp.ndarray:
-    from spark_rapids_jni_tpu.ops import hashing as H
-    n = cols[0].num_rows
-    his, los = [], []
-    for c in cols:
-        hi, lo = H._col_u64_blocks(c)
-        his.append(hi)
-        los.append(lo)
-    hmat, lmat = jnp.stack(his), jnp.stack(los)
-    vmat = jnp.stack([
-        (c.valid_bools() if c.validity is not None
-         else jnp.ones((n,), jnp.bool_)).astype(jnp.uint8)
-        for c in cols])
-    C = len(cols)
-    tile = _hash_tile(3 * C + 4)
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def _xx64_pallas_jit(cols, seed: int, W: int, interpret: bool
+                     ) -> jnp.ndarray:
+    wmat, desc, vmat = _hash_mats(cols, W, "xx64")
+    n = vmat.shape[1]
+    K, C = wmat.shape[0], vmat.shape[0]
+    tile = _hash_tile(K + C + 4)
     npad = max(tile, -(-n // tile) * tile)
     out = pl.pallas_call(
-        functools.partial(_xx_kernel, C, int(seed)),
+        functools.partial(_xx_kernel, desc, int(seed)),
         grid=(npad // tile,),
         in_specs=[
-            pl.BlockSpec((C, tile), lambda r: (0, r),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((C, tile), lambda r: (0, r),
+            pl.BlockSpec((max(1, K), tile), lambda r: (0, r),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((C, tile), lambda r: (0, r),
                          memory_space=pltpu.VMEM),
@@ -364,17 +700,87 @@ def _xx64_pallas_jit(cols, seed: int, interpret: bool) -> jnp.ndarray:
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((2, npad), jnp.uint32),
         interpret=interpret,
-    )(_pad_lanes(hmat, npad), _pad_lanes(lmat, npad),
+    )(_pad_lanes(wmat if K else jnp.zeros((1, n), jnp.uint32), npad),
       _pad_lanes(vmat, npad))
     return out[:, :n].T                        # [n, 2] (lo, hi)
 
 
-def xxhash64_fixed(cols, seed: int, *, interpret: bool = False
-                   ) -> jnp.ndarray:
-    """Spark xxhash64 chain over fixed-width columns ([n, 2] uint32
-    lo/hi, the ``hashing.xxhash64`` contract).  Bit-exact with
-    ``hashing._xx64_chain``."""
-    return _xx64_pallas_jit(tuple(cols), int(seed), interpret)
+def xxhash64_cols(cols, seed: int, *, W: int = 0,
+                  interpret: bool = False) -> jnp.ndarray:
+    """Spark xxhash64 chain over fixed-width AND dense-padded string
+    columns ([n, 2] uint32 lo/hi, the ``hashing.xxhash64`` contract).
+    ``W`` is the bucketed char-window width shared by all string
+    columns (0 when none).  Bit-exact with ``hashing._xx64_chain``."""
+    return _xx64_pallas_jit(tuple(cols), int(seed), int(W), interpret)
+
+
+#: historical fixed-only entry point; the generalized kernel accepts
+#: the same call shape.
+xxhash64_fixed = xxhash64_cols
+
+
+# ---------------------------------------------------------------------------
+# get_json scan kernel: the path automaton over VMEM char tiles
+# ---------------------------------------------------------------------------
+
+def _gjo_scan_kernel(segs, max_key_len, W, chT_ref, o_ref):
+    """One row tile of the get_json path automaton.  The char window
+    rides transposed ([W, tile]) so rows are lanes; the automaton's
+    ``step`` replays inside a ``fori_loop`` over the W positions with
+    the per-position char row loaded at a dynamic sublane offset (a
+    plain VMEM strided load — no gathers)."""
+    from spark_rapids_jni_tpu.ops.get_json import _automaton_pieces
+    make_carry0, step = _automaton_pieces(segs, max_key_len)
+    m = o_ref.shape[1]
+
+    def body(i, c):
+        row = pl.load(chT_ref, (pl.dslice(i, 1), slice(None)))[0]
+        return step(c, (i, row))[0]
+
+    st = jax.lax.fori_loop(0, W, body, make_carry0(m))
+    o_ref[...] = jnp.stack([
+        st["start"], st["end"],
+        st["found"].astype(jnp.int32),
+        st["capturing"].astype(jnp.int32),
+        st["bad"].astype(jnp.int32),
+        st["deep"].astype(jnp.int32)])
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def _gjo_scan_pallas_jit(ch, segs, max_key_len: int, tile: int,
+                         interpret: bool):
+    n, W = ch.shape
+    npad = max(tile, -(-n // tile) * tile)
+    chT = _pad_lanes(ch.T, npad)               # [W, npad] uint8
+    o = pl.pallas_call(
+        functools.partial(_gjo_scan_kernel, segs, max_key_len, W),
+        grid=(npad // tile,),
+        in_specs=[pl.BlockSpec((W, tile), lambda r: (0, r),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((6, tile), lambda r: (0, r),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((6, npad), jnp.int32),
+        interpret=interpret,
+    )(chT)
+    o = o[:, :n]
+    return dict(start=o[0], end=o[1], found=o[2] != 0,
+                capturing=o[3] != 0, bad=o[4] != 0, deep=o[5] != 0)
+
+
+def get_json_scan(ch, segs, max_key_len: int, *,
+                  interpret: bool = False, tile_rows: int = 0):
+    """Run the get_json path automaton over ``ch [n, W]`` (bucketed
+    fixed-max-len char windows) as a Pallas row-tile grid, the
+    state-transition tables VMEM-resident.  Returns the same
+    ``start/end/found/capturing/bad/deep`` fields ``_scan_automaton``'s
+    final carry exposes (bool fields as bools), so the downstream
+    extract/assemble chain is shared verbatim."""
+    if tile_rows <= 0:
+        # per-lane VMEM: the char column (W bytes) + ~40B carry state
+        tile_rows = shapes.vmem_tile(ch.shape[1] + 64, budget=2 << 20,
+                                     floor=256, cap=1 << 15)
+    return _gjo_scan_pallas_jit(ch, tuple(segs), int(max_key_len),
+                                int(tile_rows), bool(interpret))
 
 
 # ---------------------------------------------------------------------------
@@ -462,3 +868,31 @@ def bloom_might_contain(bits32, lo, hi, valid, k: int, num_bits: int,
     Requires ``num_bits < 2**31`` (int32 modulus) — callers gate."""
     return _bloom_pallas_jit(bits32, lo, hi, valid, k, num_bits,
                              interpret)
+
+
+# ---------------------------------------------------------------------------
+# per-op eligibility: sig shapes the kernel cannot tile fall to XLA
+# ---------------------------------------------------------------------------
+
+def _rows_sig_eligible(sig) -> bool:
+    # sig = (num_columns, fixed_row_size): word-plane tiling needs a
+    # word-aligned, non-empty row
+    return sig[1] > 0 and sig[1] % 4 == 0
+
+
+def _gjo_sig_eligible(sig) -> bool:
+    # sig = (num_path_segments, char_window): at least one segment and a
+    # window the row-tile chooser can hold in VMEM
+    return sig[0] >= 1 and 1 <= sig[1] <= (1 << 15)
+
+
+#: ``choose()``'s per-op hooks; ops absent here are always eligible.
+#: Hash-op sigs are the column tuples themselves, the rest are static
+#: shape tuples — see each predicate.
+_ELIGIBLE = {
+    "murmur3_hash": hash_cols_eligible,
+    "xxhash64": hash_cols_eligible,
+    "get_json_object": _gjo_sig_eligible,
+    "convert_to_rows": _rows_sig_eligible,
+    "convert_from_rows": _rows_sig_eligible,
+}

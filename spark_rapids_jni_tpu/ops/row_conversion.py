@@ -387,7 +387,28 @@ def convert_to_rows_fixed_width_optimized(
     layout = compute_row_layout(table.dtypes)
     if layout.has_strings:
         raise ValueError("fixed-width-optimized path does not support strings")
-    rows2d = _oracle_to_rows_jit(table, layout)
+    from spark_rapids_jni_tpu.ops import pallas_kernels
+    sig = (layout.num_columns, layout.fixed_row_size)
+    impl, interp = pallas_kernels.choose(
+        "convert_to_rows", _platform_of(table), sig=sig)
+    if impl == "pallas":
+        from spark_rapids_jni_tpu.runtime import resilience
+
+        def _primary(t):
+            pallas_kernels.stamp_impl("pallas")
+            return pallas_kernels.to_rows_fixed(t, layout,
+                                                interpret=interp)
+
+        def _twin(t):
+            pallas_kernels.stamp_impl("xla")
+            return _oracle_to_rows_jit(t, layout)
+
+        rows2d = resilience.run("convert_to_rows", _primary, table,
+                                sig=sig, bucket=table.num_rows,
+                                impl="pallas", fallback=_twin)
+    else:
+        pallas_kernels.stamp_impl("xla")
+        rows2d = _oracle_to_rows_jit(table, layout)
     return _batch_rows2d(rows2d, layout, size_limit)
 
 
@@ -566,15 +587,35 @@ def _convert_to_rows_impl(table: Table, size_limit: int,
 
     def encode(start=0, size=None):
         if impl == "pallas":
-            from spark_rapids_jni_tpu.ops import row_kernels
-            if size is None:
-                # bucketing (if any) already happened at the convert_to_rows
-                # wrapper; never re-bucket inside the impl
-                return row_kernels.to_rows_fixed(
-                    table, layout, interpret=platform != "tpu", bucket=None)
-            return row_kernels.to_rows_fixed_batch(
-                table, layout, jnp.int32(start), size,
-                interpret=platform != "tpu")
+            # the word-plane pack kernel (pallas_kernels.to_rows_fixed)
+            # under resilient dispatch: the generic XLA assemble is the
+            # twin, and the (op, sig, bucket) breaker quarantines a
+            # kernel build that keeps failing
+            from spark_rapids_jni_tpu.runtime import resilience
+            interp = platform != "tpu"
+            sig = (layout.num_columns, layout.fixed_row_size)
+            b = size if size is not None else n
+            st = jnp.int32(start)
+            leaves, treedef = jax.tree_util.tree_flatten(table)
+            pallas_kernels.register(
+                "convert_to_rows", sig, b,
+                lambda *ls: pallas_kernels.to_rows_fixed(
+                    jax.tree_util.tree_unflatten(treedef, ls), layout,
+                    st, size, interpret=interp),
+                tuple(leaves), impl="pallas")
+
+            def _primary(t):
+                pallas_kernels.stamp_impl("pallas")
+                return pallas_kernels.to_rows_fixed(
+                    t, layout, st, size, interpret=interp)
+
+            def _twin(t):
+                pallas_kernels.stamp_impl("xla")
+                return _to_rows_fixed_jit(t, layout, st, size)
+
+            return resilience.run("convert_to_rows", _primary, table,
+                                  sig=sig, bucket=b, impl="pallas",
+                                  fallback=_twin)
         if impl == "mxu":
             from spark_rapids_jni_tpu.ops import row_mxu
             return row_mxu.to_rows_fixed(table, layout, start, size)

@@ -213,19 +213,55 @@ def _rows_layout(ncols: int):
 
 @functools.lru_cache(maxsize=256)
 def _rows_kernel(ncols: int, b: int, kb: int):
+    from spark_rapids_jni_tpu.ops import pallas_kernels
     layout, vb = _rows_layout(ncols)
     rs = layout.fixed_row_size
     data_bytes = 4 * ncols
     pad = rs - data_bytes - layout.validity_bytes
     vconst = jnp.asarray(vb)
 
-    def _serve_rows(cols):                      # [kb, ncols, b] int32
+    @jax.jit
+    def _xla_rows(cols):                        # [kb, ncols, b] int32
         by = jax.lax.bitcast_convert_type(cols, jnp.uint8)
         data = jnp.transpose(by, (0, 2, 1, 3)).reshape(kb, b, data_bytes)
         v = jnp.broadcast_to(vconst, (kb, b, layout.validity_bytes))
         tail = jnp.zeros((kb, b, pad), jnp.uint8)
-        return (jnp.concatenate([data, v, tail], axis=-1),)
-    return jax.jit(_serve_rows)
+        return jnp.concatenate([data, v, tail], axis=-1)
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def _pallas_rows(cols, interp):
+        from spark_rapids_jni_tpu.table import Table, Column
+        flat = cols.transpose(1, 0, 2).reshape(ncols, kb * b)
+        table = Table(tuple(Column(INT32, flat[ci], None)
+                            for ci in range(ncols)))
+        rows = pallas_kernels.to_rows_fixed(table, layout,
+                                            interpret=interp)
+        return rows.reshape(kb, b, rs)
+
+    def _serve_rows(rows_cols):
+        # the pack engine is the same knob-gated choice the direct
+        # convert_to_rows path makes — resolved PER CALL (not at
+        # closure-build time) so a circuit breaker that quarantines the
+        # Pallas kernel mid-flight reroutes the very next dispatch to
+        # the XLA twin without evicting this cached closure
+        impl, interp = pallas_kernels.choose("convert_to_rows",
+                                             jax.default_backend(),
+                                             sig=(ncols, rs))
+        if impl == "pallas":
+            from spark_rapids_jni_tpu.runtime import resilience
+            pallas_kernels.stamp_impl("pallas")
+            brk = resilience.breaker("convert_to_rows", (ncols, rs),
+                                     kb * b, "pallas")
+            try:
+                out = _pallas_rows(rows_cols, interp)
+            except Exception:
+                brk.record(False)       # serving failures feed the same
+                raise                   # quarantine choose() consults
+            brk.record(True)
+            return (out,)
+        pallas_kernels.stamp_impl("xla")
+        return (_xla_rows(rows_cols),)
+    return _serve_rows
 
 
 class _RowsOp(ServeOp):

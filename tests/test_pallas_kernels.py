@@ -1,8 +1,10 @@
 """Pallas VMEM-tiled kernels (``ops/pallas_kernels.py``): byte-identity
 against the generic XLA lowerings under interpret mode on the CPU mesh,
-the ``SRJ_TPU_PALLAS`` knob contract, and the TPU-legality guard on the
-``from_rows`` decode (no per-row dynamic-start gather in the lowered
-HLO — the root cause of BENCH_r05's real-backend failures)."""
+the ``SRJ_TPU_PALLAS`` knob contract plus per-op eligibility hooks, and
+the TPU-legality guards — no per-row dynamic-start gather in the lowered
+HLO of the row codecs or hash mats builders (the root cause of
+BENCH_r05's real-backend failures), and a select-only automaton step for
+the get_json scan kernel."""
 
 import re
 
@@ -141,6 +143,99 @@ def test_from_rows_lowering_is_tpu_legal():
 
 
 # ---------------------------------------------------------------------------
+# row-pack
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", [n for n in EDGE_ROWS if n > 0])
+@pytest.mark.parametrize("pattern", ["most", "none", "plain"])
+def test_to_rows_pallas_byte_identity(n, pattern):
+    """The pack kernel (interpret mode) encodes every bucket-edge row
+    count byte-identically to the oracle XLA pack, including all-null
+    validity and no-validity columns."""
+    rng = np.random.default_rng(600 + n)
+    layout = compute_row_layout(FIXED_DTYPES)
+    t = Table(_make_cols(rng, FIXED_DTYPES, n, pattern))
+    got = np.asarray(pk.to_rows_fixed(t, layout, interpret=True))
+    want = np.asarray(rc._oracle_to_rows_jit(t, layout))
+    np.testing.assert_array_equal(want, got)
+
+
+@pytest.mark.parametrize("tile", [8, 32, 128])
+def test_to_rows_pallas_tile_sizes(tile):
+    """Identity holds for any explicit VMEM row-tile size, including
+    tiles that do not divide the row count."""
+    rng = np.random.default_rng(17)
+    dts = [INT32, INT64, INT16]
+    layout = compute_row_layout(dts)
+    t = Table(_make_cols(rng, dts, 100))
+    got = np.asarray(pk.to_rows_fixed(t, layout, interpret=True,
+                                      tile_rows=tile))
+    want = np.asarray(rc._oracle_to_rows_jit(t, layout))
+    np.testing.assert_array_equal(want, got)
+
+
+def test_to_rows_pallas_batch_slice():
+    """The dynamic batch window (start/size) packs identically to
+    slicing the oracle's full encode — the multi-batch planner path."""
+    rng = np.random.default_rng(23)
+    layout = compute_row_layout(FIXED_DTYPES)
+    t = Table(_make_cols(rng, FIXED_DTYPES, 200))
+    got = np.asarray(pk.to_rows_fixed(t, layout, start=32, size=64,
+                                      interpret=True))
+    want = np.asarray(rc._oracle_to_rows_jit(t, layout))[32:96]
+    np.testing.assert_array_equal(want, got)
+
+
+@pytest.mark.parametrize("pattern", [None, "most", "none"])
+def test_convert_to_rows_knob_equivalence(monkeypatch, pattern):
+    """The public encode returns identical row blobs under knob=1
+    (Pallas, interpret on CPU), knob=0 (kill switch), and auto."""
+    rng = np.random.default_rng(29)
+    t = Table(_make_cols(rng, FIXED_DTYPES, 130, pattern or "plain"))
+    layout = compute_row_layout(FIXED_DTYPES)
+
+    def blob(knob):
+        if knob is None:
+            monkeypatch.delenv("SRJ_TPU_PALLAS", raising=False)
+        else:
+            monkeypatch.setenv("SRJ_TPU_PALLAS", knob)
+        batches = rc.convert_to_rows(t)
+        return [np.asarray(b.rows2d(layout.fixed_row_size))
+                for b in batches]
+
+    auto, pallas, xla = blob(None), blob("1"), blob("0")
+    assert len(auto) == len(pallas) == len(xla)
+    for a, p, x in zip(auto, pallas, xla):
+        np.testing.assert_array_equal(a, p)
+        np.testing.assert_array_equal(a, x)
+
+
+def test_to_rows_lowering_is_tpu_legal():
+    """The pack's XLA glue (word-plane builder) and the XLA twin must
+    contain no per-row dynamic-start gather/scatter in their lowered
+    HLO — the same legality bar as the decode."""
+    rng = np.random.default_rng(31)
+    layout = compute_row_layout(FIXED_DTYPES)
+    t = Table(_make_cols(rng, FIXED_DTYPES, 64))
+    for low in (
+        jax.jit(lambda tt: pk._word_planes_from_table(tt, layout))
+        .lower(t).as_text(),
+        jax.jit(lambda tt: rc._to_rows_fixed_jit(tt, layout))
+        .lower(t).as_text(),
+    ):
+        assert "stablehlo.dynamic_slice" not in low
+        assert "dynamic_gather" not in low
+        assert "stablehlo.scatter" not in low
+        for line in low.splitlines():
+            if '"stablehlo.gather"' not in line:
+                continue
+            assert "indices_are_sorted = true" in line, line
+            m = re.search(r"tensor<(\d+)x1xi32>", line)
+            assert m, line
+            assert int(m.group(1)) <= layout.fixed_row_size, line
+
+
+# ---------------------------------------------------------------------------
 # hashes
 # ---------------------------------------------------------------------------
 
@@ -189,17 +284,125 @@ def test_hash_knob_equivalence(monkeypatch, op, n):
     np.testing.assert_array_equal(auto, xla)
 
 
-def test_hash_pallas_skips_strings(monkeypatch):
-    """String columns stay on the XLA chain even with the knob forced on
-    (the Pallas kernels cover fixed-width columns only) — and the result
-    is unchanged."""
+def _string_rows(rng, n):
+    """String mix exercising the codec edges: empty strings, rows at the
+    padded max width, non-aligned tails (1..3 bytes past a word), and
+    nulls."""
+    alpha = "abcdefghijklmnopqrstuvwxyz0123456789-_."
+    out = []
+    for i in range(n):
+        if i % 11 == 0:
+            out.append("")                       # empty string
+        elif i % 7 == 0:
+            out.append(None)                     # null row
+        elif i % 5 == 0:
+            out.append(alpha)                    # max-len row (39 = 4k+3)
+        else:
+            ln = int(rng.integers(1, len(alpha) + 1))
+            out.append("".join(
+                alpha[int(j)] for j in rng.integers(0, len(alpha), ln)))
+    return out
+
+
+def _padded_hash_cols(rng, n, with_fixed=True):
+    scol = Column.strings_padded(_string_rows(rng, n))
+    cols = [scol]
+    if with_fixed:
+        cols += list(_make_cols(rng, [INT32, INT64], n))
+    W = scol.chars2d.shape[1]
+    b = shapes.bucket_rows(n)
+    Wb = shapes.bucket_width(W)
+    return tuple(shapes.pad_column(c, b, width=Wb or None)
+                 for c in cols), Wb
+
+
+@pytest.mark.parametrize("n", [1, 9, 33, 257])
+def test_murmur3_string_pallas_byte_identity(n):
+    """The variable-width murmur3 codec (tail masking + sign-extended
+    bytes) matches the XLA chain bit-for-bit on mixed string +
+    fixed-width columns."""
+    rng = np.random.default_rng(700 + n)
+    pcols, Wb = _padded_hash_cols(rng, n)
+    want = np.asarray(H._murmur3_jit(pcols, 42, Wb))
+    got = np.asarray(pk.murmur3_cols(pcols, 42, W=Wb, interpret=True))
+    np.testing.assert_array_equal(want, got)
+
+
+@pytest.mark.parametrize("n", [1, 9, 33, 257])
+def test_xxhash64_string_pallas_byte_identity(n):
+    """The xxhash64 string codec (32-byte chunks, 8-byte stripes,
+    clamped 4-byte block, 3-byte tail) matches the XLA chain."""
+    rng = np.random.default_rng(800 + n)
+    pcols, Wb = _padded_hash_cols(rng, n)
+    want = np.asarray(H._xx64_jit(pcols, 7, Wb))
+    got = np.asarray(pk.xxhash64_cols(pcols, 7, W=Wb, interpret=True))
+    np.testing.assert_array_equal(want, got)
+
+
+def test_hash_string_all_empty():
+    """A column whose every string is empty lowers to a zero-word codec
+    (lens row only) and still matches XLA."""
+    scol = Column.strings_padded(["", "", None, "", ""])
+    b = shapes.bucket_rows(5)
+    pcols = (shapes.pad_column(scol, b),)
+    Wb = shapes.bucket_width(scol.chars2d.shape[1])
+    want = np.asarray(H._murmur3_jit(pcols, 42, Wb))
+    got = np.asarray(pk.murmur3_cols(pcols, 42, W=Wb, interpret=True))
+    np.testing.assert_array_equal(want, got)
+
+
+@pytest.mark.parametrize("op", ["murmur3_hash", "xxhash64"])
+def test_hash_string_knob_equivalence(monkeypatch, op):
+    """Public hash entries over padded string columns return identical
+    values whichever engine the knob selects."""
+    rng = np.random.default_rng(47)
+    fn = getattr(H, op)
+    scol = Column.strings_padded(_string_rows(rng, 70))
+    icol = Column.from_numpy(np.arange(70, dtype=np.int32), INT32)
+    monkeypatch.delenv("SRJ_TPU_PALLAS", raising=False)
+    auto = np.asarray(fn([scol, icol], 99))
+    monkeypatch.setenv("SRJ_TPU_PALLAS", "1")
+    pallas = np.asarray(fn([scol, icol], 99))
+    monkeypatch.setenv("SRJ_TPU_PALLAS", "0")
+    xla = np.asarray(fn([scol, icol], 99))
+    np.testing.assert_array_equal(auto, pallas)
+    np.testing.assert_array_equal(auto, xla)
+
+
+def test_hash_pallas_skips_arrow_strings(monkeypatch):
+    """Arrow-layout (offsets+chars) string columns are ineligible — the
+    per-row dynamic-start gather their window extraction needs is the
+    TPU-illegal pattern — so they fall to XLA even with the knob forced
+    on, and the result is unchanged.  Dense-padded strings ride Pallas
+    (covered by the knob-equivalence test above)."""
     docs = Column.strings(["a", "bc", "", "longer-value", "x"] * 7)
     icol = Column.from_numpy(np.arange(35, dtype=np.int32), INT32)
+    assert not pk.hash_cols_eligible((docs, icol))
     monkeypatch.delenv("SRJ_TPU_PALLAS", raising=False)
     want = np.asarray(H.murmur3_hash([icol, docs]))
     monkeypatch.setenv("SRJ_TPU_PALLAS", "1")
     got = np.asarray(H.murmur3_hash([icol, docs]))
     np.testing.assert_array_equal(want, got)
+
+
+def test_hash_string_lowering_is_tpu_legal():
+    """The string-hash mats builder (padded windows -> stacked word
+    rows) must lower without per-row dynamic-start gathers: padded
+    ``chars_window`` is a static slice, so only tiny sorted lane-index
+    gathers from byte packing may appear."""
+    rng = np.random.default_rng(53)
+    pcols, Wb = _padded_hash_cols(rng, 33)
+    for mode in ("mm3", "xx64"):
+        low = jax.jit(
+            lambda cs: pk._hash_mats(cs, Wb, mode)[0]
+        ).lower(pcols).as_text()
+        assert "stablehlo.dynamic_slice" not in low
+        assert "dynamic_gather" not in low
+        assert "stablehlo.scatter" not in low
+        for line in low.splitlines():
+            if '"stablehlo.gather"' not in line:
+                continue
+            assert "indices_are_sorted = true" in line, line
 
 
 def test_scalar_oracle_survives_dispatch(monkeypatch):
@@ -209,6 +412,95 @@ def test_scalar_oracle_survives_dispatch(monkeypatch):
     for knob in ("0", "1"):
         monkeypatch.setenv("SRJ_TPU_PALLAS", knob)
         assert int(np.asarray(H.murmur3_hash([col], 42))[0]) == -559580957
+
+
+# ---------------------------------------------------------------------------
+# get_json scan
+# ---------------------------------------------------------------------------
+
+GJ_DOCS = [
+    '{"a": [1, 2, 3], "b": {"c": [{"d": 4}, {"d": 5}, {"d": 6}]}}',
+    '{"b": {"c": "str"}, "a": []}',
+    '{"a": [true, null], "b": {"c": {"x": 1}}}',
+    "",                                    # empty row
+    '{"a": "unterminated',                 # malformed
+    '{"b": {"c": "esc\\"aped"}}',          # escaped quote in capture
+    '[1, 2]',                              # non-object top level
+    '{"aa": 1, "a": [10, 20, 30, 40]}',    # key-prefix collision
+]
+
+GJ_PATHS = ["$.b.c", "$.a[1]", "$.a", "$.b.c[2].d"]
+
+_GJ_FIELDS = ("start", "end", "found", "capturing", "bad", "deep")
+
+
+def _gj_window(docs, pad=0):
+    bs = [d.encode() for d in docs]
+    W = max((len(b) for b in bs), default=1) + pad
+    ch = np.zeros((len(bs), max(W, 1)), np.uint8)
+    for i, b in enumerate(bs):
+        ch[i, : len(b)] = np.frombuffer(b, np.uint8)
+    return jnp.asarray(ch)
+
+
+@pytest.mark.parametrize("path", GJ_PATHS)
+def test_get_json_scan_pallas_identity(path):
+    """The Pallas grid scan lands the same per-row automaton state
+    (capture window, found/bad/deep flags) as the ``lax.scan`` chain,
+    across object keys, array subscripts, and malformed rows."""
+    from spark_rapids_jni_tpu.ops import get_json as GJ
+    segs = tuple(GJ._parse_path(path))
+    mkl = max((len(s) for s in segs if isinstance(s, bytes)), default=1)
+    ch = _gj_window(GJ_DOCS)
+    want = GJ._scan_automaton(ch, segs, mkl)
+    got = pk.get_json_scan(ch, segs, mkl, interpret=True)
+    for f in _GJ_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(want[f]), np.asarray(got[f]), err_msg=f)
+
+
+@pytest.mark.parametrize("tile", [1, 4, 8])
+def test_get_json_scan_tile_sizes(tile):
+    """Identity holds for row tiles that do not divide the row count."""
+    from spark_rapids_jni_tpu.ops import get_json as GJ
+    segs = tuple(GJ._parse_path("$.b.c"))
+    ch = _gj_window(GJ_DOCS * 3, pad=5)
+    want = GJ._scan_automaton(ch, segs, 1)
+    got = pk.get_json_scan(ch, segs, 1, interpret=True, tile_rows=tile)
+    for f in _GJ_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(want[f]), np.asarray(got[f]), err_msg=f)
+
+
+@pytest.mark.parametrize("path", GJ_PATHS)
+def test_get_json_knob_equivalence(monkeypatch, path):
+    """Public ``get_json_object`` returns the same extracted values
+    under knob=1 (Pallas scan), knob=0, and auto — nulls included."""
+    from spark_rapids_jni_tpu.ops import get_json as GJ
+    col = Column.strings(GJ_DOCS + [None])
+
+    def run(knob):
+        if knob is None:
+            monkeypatch.delenv("SRJ_TPU_PALLAS", raising=False)
+        else:
+            monkeypatch.setenv("SRJ_TPU_PALLAS", knob)
+        return GJ.get_json_object(col, path).to_pylist()
+
+    auto, pallas, xla = run(None), run("1"), run("0")
+    assert auto == pallas == xla
+
+
+def test_get_json_step_is_gather_free():
+    """The automaton step the Pallas kernel replays per char column must
+    stay select/compare-only — a gather or scatter in the step would be
+    Mosaic-illegal inside the kernel body."""
+    from spark_rapids_jni_tpu.ops.get_json import _automaton_pieces
+    make_carry0, step = _automaton_pieces((b"ab", 1, b"c"), 4)
+    jaxpr = str(jax.make_jaxpr(
+        lambda c, ch: step(c, (jnp.int32(3), ch))[0]
+    )(make_carry0(8), jnp.zeros((8,), jnp.uint8)))
+    assert "gather" not in jaxpr
+    assert "scatter" not in jaxpr
 
 
 # ---------------------------------------------------------------------------
@@ -283,6 +575,36 @@ def test_choose_contract(monkeypatch):
     assert pk.choose("xxhash64", "tpu") == ("pallas", False)
     # unsupported ops never route to pallas
     assert pk.choose("get_json", "tpu") == ("xla", False)
+
+
+def test_choose_eligibility_hooks(monkeypatch):
+    """Per-op ``eligible(sig)`` hooks veto signatures the kernels cannot
+    serve; ineligible sigs fall to XLA even on TPU with the knob on."""
+    monkeypatch.setenv("SRJ_TPU_PALLAS", "1")
+    # hash ops: sig is the padded column tuple — Arrow-layout strings
+    # (per-row gather window) are out, dense-padded strings ride
+    arrow = (Column.strings(["a", "bc"]),)
+    padded = (Column.strings_padded(["a", "bc"]),)
+    assert pk.choose("murmur3_hash", "tpu", sig=arrow) == ("xla", False)
+    assert pk.choose("murmur3_hash", "tpu", sig=padded) == \
+        ("pallas", False)
+    assert pk.choose("xxhash64", "tpu", sig=arrow) == ("xla", False)
+    # get_json: sig is (num_segs, window_width) — zero-width and
+    # oversized windows stay on the scan chain
+    assert pk.choose("get_json_object", "tpu", sig=(1, 64)) == \
+        ("pallas", False)
+    assert pk.choose("get_json_object", "tpu", sig=(1, 0)) == \
+        ("xla", False)
+    assert pk.choose("get_json_object", "tpu", sig=(1, 1 << 20)) == \
+        ("xla", False)
+    # row ops: sig is (num_columns, fixed_row_size) — rs is 8-aligned
+    # for every real layout, so only degenerate sigs are vetoed
+    assert pk.choose("convert_to_rows", "tpu", sig=(3, 48)) == \
+        ("pallas", False)
+    assert pk.choose("convert_to_rows", "tpu", sig=(0, 0)) == \
+        ("xla", False)
+    # sig=None (caller has no signature) never vetoes
+    assert pk.choose("murmur3_hash", "tpu") == ("pallas", False)
 
 
 def test_vmem_tile_pow2():
