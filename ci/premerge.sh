@@ -405,17 +405,22 @@ rm -f "$COST_EVENTS" "$COST_CAL" "$COST_PROF"
 # perf-regression gate, advisory for now: reports deltas of the newest
 # checked-in bench round vs the prior one (flip --mode enforce once the
 # round cadence stabilizes); the synthetic self-test proves the gate
-# actually fires on a doctored 2x regression before we trust its pass
-python - <<'PY'
-import glob, json
-latest = sorted(glob.glob("BENCH_r*.json"))[-1]
-d = json.load(open(latest))
+# actually fires on a doctored 2x regression before we trust its pass.
+# The doctored round is compared against its own undoctored copy, not
+# the previous round: identical metric grids guarantee overlap even
+# when the newest round is a non-comparable interpret-mode fallback
+# (its grid differs from the prior real-hardware round, and a
+# no-overlap rc=2 would let the self-test "pass" without ever
+# exercising the regression path)
+LATEST=$(ls BENCH_r*.json | sort | tail -1)
+python - "$LATEST" <<'PY'
+import json, sys
+d = json.load(open(sys.argv[1]))
 d["parsed"]["value"] = d["parsed"]["value"] / 2.0
 json.dump(d, open("/tmp/srj_gate_selftest.json", "w"))
 PY
-PREV=$(ls BENCH_r*.json | sort | tail -2 | head -1)
 if python ci/regress_gate.py --current /tmp/srj_gate_selftest.json \
-     --previous "$PREV" --mode enforce > /dev/null 2>&1; then
+     --previous "$LATEST" --mode enforce > /dev/null 2>&1; then
   echo "regress_gate self-test FAILED: synthetic 2x regression passed" >&2
   exit 1
 fi

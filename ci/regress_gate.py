@@ -27,6 +27,12 @@ Usage::
 the synthetic-regression self-test in CI feeds a doctored round through
 the same code path the real gate runs.
 
+Rounds stamped ``"comparable": false`` (off-TPU interpret-mode fallback
+rounds — bench.py stamps the flag into its headline automatically when
+it runs without a TPU) are skipped by auto-discovery on both sides of
+the comparison pair: their figures measure kernel wiring, not hardware,
+so gating them against a real round in either direction is noise.
+
 Pure stdlib, no repo imports: the gate must run in a CI step even when
 the package itself is broken — that is half the point of a gate.
 """
@@ -100,6 +106,25 @@ def discover_rounds(history_dir: str) -> List[Tuple[int, str]]:
         if m:
             rounds.append((int(m.group(1)), path))
     return sorted(rounds)
+
+
+def round_comparable(doc: Dict) -> bool:
+    """Whether a round's figures may be gated against neighbouring
+    rounds.  A round stamps ``"comparable": false`` (top level, or
+    inside ``parsed`` — bench.py stamps the latter on off-TPU runs)
+    when its numbers measure wiring rather than hardware: the CPU
+    interpret-mode fallback rounds recorded in containers without a
+    TPU run a different metric grid at ~1000x lower bandwidth, and
+    comparing them against a real-hardware round in either direction
+    is noise.  Auto-discovery skips flagged rounds on BOTH sides of
+    the pair; explicit ``--current``/``--previous`` overrides load
+    whatever they are given (the synthetic self-test relies on that)."""
+    if doc.get("comparable") is False:
+        return False
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and parsed.get("comparable") is False:
+        return False
+    return True
 
 
 def lower_is_better(unit: str) -> bool:
@@ -219,21 +244,29 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     try:
         if args.current and args.previous:
-            cur_path, prev_path = args.current, args.previous
-            cur_label = os.path.basename(cur_path)
-            prev_label = os.path.basename(prev_path)
+            cur_doc, prev_doc = load_round(args.current), load_round(
+                args.previous)
+            cur_label = os.path.basename(args.current)
+            prev_label = os.path.basename(args.previous)
         else:
             rounds = discover_rounds(args.history)
-            if len(rounds) < 2:
-                print(f"regress_gate: need >= 2 rounds in {args.history}, "
-                      f"found {len(rounds)} — nothing to gate",
+            docs, skipped = [], []
+            for _, path in rounds:
+                doc = load_round(path)
+                (docs if round_comparable(doc) else skipped).append(
+                    (os.path.basename(path), doc))
+            if skipped:
+                print("regress_gate: skipping non-comparable round(s): "
+                      + ", ".join(name for name, _ in skipped),
                       file=sys.stderr)
+            if len(docs) < 2:
+                print(f"regress_gate: need >= 2 comparable rounds in "
+                      f"{args.history}, found {len(docs)} — nothing to "
+                      f"gate", file=sys.stderr)
                 return 2
-            (_, prev_path), (_, cur_path) = rounds[-2], rounds[-1]
-            cur_label = os.path.basename(cur_path)
-            prev_label = os.path.basename(prev_path)
-        cur = round_metrics(load_round(cur_path))
-        prev = round_metrics(load_round(prev_path))
+            (prev_label, prev_doc), (cur_label, cur_doc) = docs[-2], docs[-1]
+        cur = round_metrics(cur_doc)
+        prev = round_metrics(prev_doc)
     except (OSError, ValueError) as e:
         print(f"regress_gate: {e}", file=sys.stderr)
         return 2

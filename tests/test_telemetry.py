@@ -361,3 +361,35 @@ def test_gate_needs_two_rounds(tmp_path):
                                        "unit": "GB/s"}}))
     res = _gate("--history", str(tmp_path))
     assert res.returncode == 2
+
+
+def test_gate_skips_non_comparable_round(tmp_path):
+    """An off-TPU fallback round (different metric grid, bogus
+    timings) stamps ``comparable: false``; auto-discovery must pair
+    the two real rounds around it instead of dying on no-overlap."""
+    for n, val in ((1, 10.0), (2, 11.0)):
+        (tmp_path / f"BENCH_r0{n}.json").write_text(json.dumps(
+            {"n": n, "parsed": {"metric": "m", "value": val,
+                                "unit": "GB/s"}}))
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(
+        {"n": 3, "comparable": False,
+         "parsed": {"metric": "interpret_only_m", "value": 0.01,
+                    "unit": "GB/s"}}))
+    res = _gate("--history", str(tmp_path), "--mode", "enforce")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "BENCH_r03.json" in res.stderr          # names what it skipped
+    assert "BENCH_r02.json vs BENCH_r01.json" in res.stdout
+
+
+def test_gate_needs_two_comparable_rounds(tmp_path):
+    """The flag also rides inside ``parsed`` (bench.py stamps it there
+    on off-TPU runs); one real + one flagged round is not a pair."""
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"n": 1, "parsed": {"metric": "m", "value": 1.0,
+                            "unit": "GB/s"}}))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"n": 2, "parsed": {"comparable": False, "metric": "m",
+                            "value": 0.001, "unit": "GB/s"}}))
+    res = _gate("--history", str(tmp_path))
+    assert res.returncode == 2
+    assert "BENCH_r02.json" in res.stderr
