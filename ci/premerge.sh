@@ -56,6 +56,50 @@ sys.exit(0 if 0 < compiles <= bound else 1)
 PY
 rm -f "$SHAPE_EVENTS"
 
+# plan-fusion smoke: stream a ragged burst (then an identical warm
+# repeat burst) through a 4-node filter->project->aggregate plan under
+# the JSONL sink, then fail unless every submission ran the whole chain
+# as ONE fused dispatch and the warm burst recompiled nothing — the
+# cheap end-to-end version of tests/test_plan.py's compile-count guard
+PLAN_EVENTS=$(mktemp /tmp/srj_plan_smoke.XXXXXX.jsonl)
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu SRJ_TPU_EVENTS="$PLAN_EVENTS" \
+  python - <<'PY'
+import numpy as np
+import jax.numpy as jnp
+from spark_rapids_jni_tpu.runtime import plan
+
+pln = plan.Plan([
+    plan.scan("k", "v"),
+    plan.filter(lambda v: v > jnp.int32(0), ["v"]),
+    plan.project({"d": (lambda k, v: v * jnp.int32(2) + k, ["k", "v"])}),
+    plan.aggregate(["k"], [("d", "sum")], 32),
+])
+rng = np.random.default_rng(3)
+sizes = (5, 11, 19, 27, 42, 53, 61)
+for n in sizes + sizes:            # second pass = warm repeat burst
+    plan.execute(pln, {"k": rng.integers(0, 8, n).astype(np.int32),
+                       "v": rng.integers(-9, 9, n).astype(np.int32)})
+d = plan.dispatch_totals()["dispatches"]
+assert d == 2 * len(sizes), f"fused chain took {d} dispatches"
+assert plan.cache_stats()["hits"] >= len(sizes)
+PY
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python - "$PLAN_EVENTS" <<'PY'
+import json, sys
+events = [json.loads(line) for line in open(sys.argv[1])]
+spans = [e for e in events if e.get("kind") == "span"
+         and str(e.get("name", "")).startswith("plan[")]
+assert len(spans) == 14, f"expected 14 plan spans, got {len(spans)}"
+assert all(s["fused"] == 3 and s["dispatches"] == 1 for s in spans), \
+    [(s.get("fused"), s.get("dispatches")) for s in spans]
+warm = sum(s.get("compiles", 0) for s in spans[7:])
+assert warm == 0, f"warm repeat burst recompiled {warm}x"
+cold = sum(s.get("compiles", 0) for s in spans[:7])
+print(f"plan smoke: 14 fused single-dispatch stages under "
+      f"{spans[0]['name']}, cold compiles {cold}, warm compiles 0")
+PY
+rm -f "$PLAN_EVENTS"
+
 # pallas-kernel smoke: force the Pallas engine (interpret mode on the
 # CPU mesh) through a to_rows pack burst, a from_rows decode burst, and
 # a get_json scan burst, then assert every op span carries impl=pallas

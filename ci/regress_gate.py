@@ -48,10 +48,13 @@ import sys
 from typing import Dict, List, Optional, Tuple
 
 # time units and byte units both regress upward: a slower kernel and a
-# fatter memory footprint (the mem_peak_* figures) fail the same way
+# fatter memory footprint (the mem_peak_* figures) fail the same way;
+# compiled-program and dispatch counts (the plan-fusion figures) regress
+# upward too — more programs per plan or more dispatches per stage means
+# the fuser or its LRU stopped doing its job
 LOWER_IS_BETTER_UNITS = {"s", "sec", "secs", "seconds", "ms", "us", "ns",
                          "b", "bytes", "kb", "kib", "mb", "mib",
-                         "gb", "gib"}
+                         "gb", "gib", "programs", "dispatches"}
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 _ROOFLINE_RE = re.compile(r"^roofline_(.+)_pct_of_calibration$")

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from spark_rapids_jni_tpu.table import Column, Table
+from spark_rapids_jni_tpu.table import Column, Table, column_nbytes
 from spark_rapids_jni_tpu.obs import span_fn
 from spark_rapids_jni_tpu.ops.hashing import murmur3_hash, pmod
 from spark_rapids_jni_tpu.runtime import shapes
@@ -41,6 +41,12 @@ from spark_rapids_jni_tpu.runtime import staging
 # Expression operators
 # ---------------------------------------------------------------------------
 
+def _table_attrs(table, *a, **k):
+    return {"rows": table.num_rows,
+            "bytes": sum(column_nbytes(c) for c in table.columns)}
+
+
+@span_fn(attrs=_table_attrs)
 def project(table: Table, exprs: Sequence[Callable], dtypes) -> Table:
     """Evaluate elementwise expressions over columns: each expr receives the
     tuple of column data arrays and returns a new data array."""
@@ -51,6 +57,7 @@ def project(table: Table, exprs: Sequence[Callable], dtypes) -> Table:
     return Table(tuple(cols))
 
 
+@span_fn(attrs=_table_attrs)
 def filter_mask(table: Table, pred: Callable,
                 cols: Optional[Sequence[int]] = None) -> jnp.ndarray:
     """Boolean selection mask from a predicate over column data arrays.
@@ -365,6 +372,34 @@ def hash_aggregate_multi(keys: Sequence[jnp.ndarray],
 
 MAX_GROUPS = 128
 
+_FLAGSHIP_PLAN = None
+
+
+def flagship_plan():
+    """The flagship chain as a logical plan (``runtime/plan.py``): join
+    items -> filter -> project revenue -> group-by date.  Built once;
+    the content fingerprint keys the fused-program cache."""
+    global _FLAGSHIP_PLAN
+    if _FLAGSHIP_PLAN is None:
+        from spark_rapids_jni_tpu.runtime import plan as _plan
+        _FLAGSHIP_PLAN = _plan.Plan([
+            _plan.scan("sold_date", "item_key", "quantity", "price"),
+            _plan.join("build_item_key", "item_key",
+                       build_payload="build_item_price",
+                       out="item_price"),
+            _plan.filter(
+                lambda price, item_price:
+                    price > jnp.float32(1.2) * item_price,
+                ["price", "item_price"]),
+            _plan.project({"revenue": (
+                lambda price, quantity:
+                    price * quantity.astype(jnp.float32),
+                ["price", "quantity"])}),
+            _plan.aggregate(["sold_date"], [("revenue", "sum")],
+                            MAX_GROUPS),
+        ])
+    return _FLAGSHIP_PLAN
+
 
 def flagship_query_step(sold_date, item_key, quantity, price,
                         build_item_key, build_item_price):
@@ -373,14 +408,18 @@ def flagship_query_step(sold_date, item_key, quantity, price,
     join items -> filter (price above item average proxy) -> project
     (revenue) -> group-by date -> sum.  All arrays int32/float32; one fused
     XLA program on a single chip.
+
+    The body is :func:`flagship_plan` through the plan executor: under a
+    jit trace (every existing caller) it inlines to the same fused chain
+    as before; called eagerly it runs as one cached program per
+    (fingerprint, bucket) with staging/resilience/span attribution.
     """
-    item_price, matched = sort_merge_join(build_item_key, build_item_price,
-                                          item_key)
-    mask = matched & (price > jnp.float32(1.2) * item_price)
-    revenue = price * quantity.astype(jnp.float32)
-    gkeys, sums, have, num_groups = hash_aggregate_sum(
-        sold_date, revenue, mask, MAX_GROUPS)
-    return gkeys, sums, have, num_groups
+    from spark_rapids_jni_tpu.runtime import plan as _plan
+    return _plan.execute(flagship_plan(), {
+        "sold_date": sold_date, "item_key": item_key,
+        "quantity": quantity, "price": price,
+        "build_item_key": build_item_key,
+        "build_item_price": build_item_price})
 
 
 def distributed_query_step(mesh, axis_name="data",
@@ -395,29 +434,32 @@ def distributed_query_step(mesh, axis_name="data",
     "training step" analogue the driver dry-runs multi-chip.
     """
     from jax.sharding import PartitionSpec as P
-    from spark_rapids_jni_tpu.parallel.shuffle import bucket_exchange
+    from spark_rapids_jni_tpu.runtime import plan as _plan
     num_parts = mesh.shape[axis_name]
 
-    def step(sold_date, quantity):
-        n_local = sold_date.shape[0]
-        # per-(sender, target) bucket slack: group-key skew concentrates
-        # rows, so default well above the uniform expectation
-        capacity = max(8, int(capacity_factor * n_local / num_parts))
-        # hash on the raw int32 data (Spark int hash contract)
-        from spark_rapids_jni_tpu.table import INT32
-        pids = pmod(murmur3_hash([Column(INT32, sold_date)]), num_parts)
+    pln = _plan.Plan([
+        _plan.scan("sold_date", "quantity"),
+        _plan.exchange("sold_date", ("sold_date", "quantity"),
+                       num_parts, axis_name, capacity_factor),
+        _plan.aggregate(["sold_date"], [("quantity", "sum")], MAX_GROUPS),
+    ])
+    body = _plan.as_traced(pln, ("sold_date", "quantity"),
+                           with_overflow=True)
 
-        payload = jnp.stack([sold_date, quantity], axis=1)
-        exchange = bucket_exchange(num_parts, capacity, axis_name)
-        recv, valid, _, overflow = exchange(payload, pids)
-        gkeys, sums, have, num_groups = hash_aggregate_sum(
-            recv[:, 0], recv[:, 1], valid, MAX_GROUPS)
+    def step(sold_date, quantity):
+        (gkeys, sums, have, num_groups), overflow = body(
+            sold_date, quantity)
         return gkeys, sums, have, num_groups[None], overflow[None]
 
-    from spark_rapids_jni_tpu.utils.compat import shard_map
-    spec = P(axis_name)
-    return shard_map(step, mesh=mesh, in_specs=(spec, spec),
-                     out_specs=spec, check_vma=False)
+    def build():
+        from spark_rapids_jni_tpu.utils.compat import shard_map
+        spec = P(axis_name)
+        return shard_map(step, mesh=mesh, in_specs=(spec, spec),
+                         out_specs=spec, check_vma=False)
+
+    # one shard_map wrapper per (plan fingerprint, mesh): re-binding the
+    # same step shape to the same mesh returns the cached callable
+    return _plan.cached_sharded(pln, mesh, build)
 
 
 def distributed_q72_step(mesh, axis_name="data",
@@ -439,40 +481,47 @@ def distributed_q72_step(mesh, axis_name="data",
     host can retry with more slack.
     """
     from jax.sharding import PartitionSpec as P
-    from spark_rapids_jni_tpu.parallel.shuffle import bucket_exchange
-    from spark_rapids_jni_tpu.table import INT32
+    from spark_rapids_jni_tpu.runtime import plan as _plan
     num_parts = mesh.shape[axis_name]
 
-    def step(item_key, week, quantity, build_item, build_inv):
-        n_local = item_key.shape[0]
-        capacity = max(8, int(capacity_factor * n_local / num_parts))
-        pids = pmod(murmur3_hash([Column(INT32, item_key)]), num_parts)
-        payload = jnp.stack([item_key, week, quantity], axis=1)
-        exchange = bucket_exchange(num_parts, capacity, axis_name)
-        recv, valid, _, x_overflow = exchange(payload, pids)
-        r_item, r_week, r_qty = recv[:, 0], recv[:, 1], recv[:, 2]
+    pln = _plan.Plan([
+        _plan.scan("item_key", "week", "quantity"),
+        _plan.exchange("item_key", ("item_key", "week", "quantity"),
+                       num_parts, axis_name, capacity_factor),
+        _plan.join("build_item", "item_key", build_payload="build_inv",
+                   out="inv_q", how="dup", expansion=join_expansion),
+        _plan.filter(lambda inv_q, quantity: inv_q < quantity,
+                     ["inv_q", "quantity"]),
+        _plan.project({"one": (
+            lambda inv_q: jnp.ones_like(inv_q), ["inv_q"])}),
+        _plan.aggregate(["item_key", "week"],
+                        [("one", "sum"), ("quantity", "sum")],
+                        max_groups),
+    ])
+    body = _plan.as_traced(
+        pln, ("item_key", "week", "quantity", "build_item", "build_inv"),
+        with_overflow=True)
 
-        join_cap = recv.shape[0] * join_expansion
-        pidx, inv_q, jvalid, _, j_overflow = sort_merge_join_dup(
-            build_item, build_inv, r_item, join_cap)
-        live = jvalid & valid[pidx] & (inv_q < r_qty[pidx])
-        gkeys, sums, have, num_groups = hash_aggregate_sum_multi(
-            [r_item[pidx], r_week[pidx]],
-            [jnp.ones_like(inv_q), r_qty[pidx]],
-            live, max_groups)
+    def step(item_key, week, quantity, build_item, build_inv):
+        (gkeys, sums, have, num_groups), ovf = body(
+            item_key, week, quantity, build_item, build_inv)
         # aggregate capacity overflow is an overflow like any other: the
         # drivers check ONE flag before trusting the partials
         # (num_groups still reports the true distinct-key count)
-        overflow = x_overflow | j_overflow | (num_groups > max_groups)
+        overflow = ovf | (num_groups > max_groups)
         return (gkeys[0], gkeys[1], sums[0], sums[1], have,
                 num_groups[None], overflow[None])
 
-    from spark_rapids_jni_tpu.utils.compat import shard_map
-    spec = P(axis_name)
-    rep = P()
-    return shard_map(step, mesh=mesh,
-                     in_specs=(spec, spec, spec, rep, rep),
-                     out_specs=(spec,) * 6 + (spec,), check_vma=False)
+    def build():
+        from spark_rapids_jni_tpu.utils.compat import shard_map
+        spec = P(axis_name)
+        rep = P()
+        return shard_map(step, mesh=mesh,
+                         in_specs=(spec, spec, spec, rep, rep),
+                         out_specs=(spec,) * 6 + (spec,),
+                         check_vma=False)
+
+    return _plan.cached_sharded(pln, mesh, build)
 
 
 def distributed_q95_step(mesh, axis_name="data",
@@ -491,35 +540,40 @@ def distributed_q95_step(mesh, axis_name="data",
     overflow) per device.  ``overflow`` ORs the shuffle-bucket and
     aggregate-capacity overflows (semi joins cannot overflow)."""
     from jax.sharding import PartitionSpec as P
-    from spark_rapids_jni_tpu.parallel.shuffle import bucket_exchange
-    from spark_rapids_jni_tpu.table import INT32
+    from spark_rapids_jni_tpu.runtime import plan as _plan
     num_parts = mesh.shape[axis_name]
 
-    def step(order_key, ship_date, net, returned_orders):
-        n_local = order_key.shape[0]
-        capacity = max(8, int(capacity_factor * n_local / num_parts))
-        pids = pmod(murmur3_hash([Column(INT32, order_key)]), num_parts)
-        payload = jnp.stack([order_key, ship_date, net], axis=1)
-        exchange = bucket_exchange(num_parts, capacity, axis_name)
-        recv, valid, _, x_overflow = exchange(payload, pids)
-        r_order, r_date, r_net = recv[:, 0], recv[:, 1], recv[:, 2]
+    pln = _plan.Plan([
+        _plan.scan("order_key", "ship_date", "net"),
+        _plan.exchange("order_key", ("order_key", "ship_date", "net"),
+                       num_parts, axis_name, capacity_factor),
+        _plan.join("returned_orders", "order_key", how="semi"),
+        _plan.aggregate(["ship_date"],
+                        [("order_key", "count"), ("net", "sum"),
+                         ("net", "min"), ("net", "max")],
+                        max_groups),
+    ])
+    body = _plan.as_traced(
+        pln, ("order_key", "ship_date", "net", "returned_orders"),
+        with_overflow=True)
 
-        live = valid & join_semi_mask(returned_orders, r_order)
-        gkeys, outs, have, num_groups = hash_aggregate_multi(
-            [r_date],
-            [(r_order, "count"), (r_net, "sum"), (r_net, "min"),
-             (r_net, "max")],
-            live, max_groups)
-        overflow = x_overflow | (num_groups > max_groups)
+    def step(order_key, ship_date, net, returned_orders):
+        (gkeys, outs, have, num_groups), ovf = body(
+            order_key, ship_date, net, returned_orders)
+        overflow = ovf | (num_groups > max_groups)
         return (gkeys[0], outs[0], outs[1], outs[2], outs[3], have,
                 num_groups[None], overflow[None])
 
-    from spark_rapids_jni_tpu.utils.compat import shard_map
-    spec = P(axis_name)
-    rep = P()
-    return shard_map(step, mesh=mesh,
-                     in_specs=(spec, spec, spec, rep),
-                     out_specs=(spec,) * 7 + (spec,), check_vma=False)
+    def build():
+        from spark_rapids_jni_tpu.utils.compat import shard_map
+        spec = P(axis_name)
+        rep = P()
+        return shard_map(step, mesh=mesh,
+                         in_specs=(spec, spec, spec, rep),
+                         out_specs=(spec,) * 7 + (spec,),
+                         check_vma=False)
+
+    return _plan.cached_sharded(pln, mesh, build)
 
 
 def sort_order(keys: Sequence[jnp.ndarray],
@@ -767,12 +821,57 @@ def hash_aggregate_table(source, key_idxs: Sequence[int],
         # inputs are already staged host-independent device arrays, so
         # the replay is a pure re-dispatch.  No splitter: a group-by is
         # a cross-row reduction, halving its rows would change results.
-        from spark_rapids_jni_tpu.runtime import resilience
-        return resilience.run(
-            "hash_aggregate", _hash_aggregate_jit, source, mask,
+        # run_program layers the plan machinery on top: LRU accounting
+        # keyed (plan fingerprint, bucket), the fingerprint in the
+        # resilience op name, and the plan=<fp8> span the ledger /
+        # drift sentinel / footprint model attribute by
+        from spark_rapids_jni_tpu.runtime import plan as _plan
+        return _plan.run_program(
+            _table_agg_plan(tuple(key_idxs),
+                            tuple((i, op) for i, op in measures),
+                            max_groups),
+            _hash_aggregate_jit, source, mask,
             tuple(key_idxs), tuple((i, op) for i, op in measures),
             max_groups, (_ADAPTIVE_AGG_ON, _hash_aggregate_adaptive),
             sig=(len(key_idxs), len(measures), max_groups), bucket=b)
+    # the unbucketed path (bucket=None, GroupedColumns sources, capped
+    # strings, nested columns) used to run the body bare — no retry, no
+    # breaker, invisible to the plan ledger — so coverage depended on
+    # which entry the caller picked.  Same executor now: run_program
+    # tail-calls under a trace (_hash_aggregate_jit re-enters here), and
+    # eagerly wraps the body in the identical resilience + span shell.
+    from spark_rapids_jni_tpu.runtime import plan as _plan
+    return _plan.run_program(
+        _table_agg_plan(tuple(key_idxs),
+                        tuple((i, op) for i, op in measures), max_groups),
+        _hash_aggregate_body, source, mask, tuple(key_idxs),
+        tuple((i, op) for i, op in measures), max_groups,
+        sig=(len(key_idxs), len(measures), max_groups))
+
+
+@functools.lru_cache(maxsize=256)
+def _table_agg_plan(key_idxs, measures, max_groups):
+    """Fingerprint proxy plan for a table group-by: one scan + one
+    aggregate node over synthetic column names derived from the indices.
+    Never executed through ``plan.execute`` — it exists so both
+    ``hash_aggregate_table`` entries share one plan identity per
+    (keys, measures, capacity) in the program cache, breaker keys and
+    profile rows."""
+    from spark_rapids_jni_tpu.runtime import plan as _plan
+    return _plan.Plan([
+        _plan.scan("table"),
+        _plan.aggregate(
+            [f"k{i}" for i in key_idxs],
+            [("c*" if i is None else f"c{i}", op) for i, op in measures],
+            max_groups),
+    ])
+
+
+def _hash_aggregate_body(source, mask, key_idxs, measures, max_groups):
+    """The unbucketed group-by body (see :func:`hash_aggregate_table` for
+    the contract) — jit-compatible; both entries land here."""
+    from spark_rapids_jni_tpu.table import pack_bools, INT32
+    n = _source_num_rows(source)
     live = jnp.ones((n,), jnp.bool_) if mask is None else mask
 
     key_cols = [_source_column(source, i) for i in key_idxs]

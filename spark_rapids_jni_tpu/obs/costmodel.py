@@ -247,6 +247,11 @@ class Ledger:
                 if c is None:
                     c = self._cells[key] = {f: 0.0 for f in _CELL_FIELDS}
                 c["calls"] += 1
+                # plan fingerprint (runtime/plan.py spans): constant per
+                # op name, kept as a cell annotation for the profile
+                p = ev.get("plan")
+                if p:
+                    c["plan"] = str(p)
                 if ev.get("status") == "error":
                     c["errors"] += 1
                 for field in ("wall_s", "device_s", "bytes", "rows",
@@ -271,6 +276,7 @@ class Ledger:
         total_rows = c["rows"] + c["padded_rows"]
         row = {
             "op": op, "sig": sig, "bucket": bucket, "impl": impl,
+            "plan": str(c.get("plan", "")),
             "calls": int(c["calls"]), "errors": int(c["errors"]),
             "wall_s": wall, "device_s": dev,
             "time_base": "device" if dev > 0 else "wall",
@@ -508,7 +514,8 @@ def _fmt_row(r: Dict, base: Optional[Dict] = None) -> str:
     hrs = f"{int(hr):>12}" if isinstance(hr, (int, float)) else f"{'-':>12}"
     dz = r.get("drift_z")
     dzs = f"{dz:>7.1f}" if isinstance(dz, (int, float)) else f"{'-':>7}"
-    return (f"{cell:<40} {r['calls']:>6} {dev_ms:>10.2f} "
+    pl = r.get("plan") or "-"
+    return (f"{pl:>8} {cell:<40} {r['calls']:>6} {dev_ms:>10.2f} "
             f"{r['bytes']:>14} {r['achieved_GBps']:>9.2f} "
             f"{r['ceiling_GBps']:>9.1f} {r['pct_of_calibration']:>6.1f}"
             f"{delta} {r['pad_waste_pct']:>7.1f} "
@@ -523,7 +530,7 @@ def render_profile(rows: List[Dict],
     """Fixed-width roofline table; with ``baseline``, a Δ%% column shows
     the utilization change per matching (op, sig, bucket) cell."""
     dcol = "   Δpct" if baseline is not None else ""
-    head = (f"{'op@bucket':<40} {'calls':>6} {'dev_ms':>10} "
+    head = (f"{'plan':>8} {'op@bucket':<40} {'calls':>6} {'dev_ms':>10} "
             f"{'bytes':>14} {'GB/s':>9} {'ceil':>9} {'pct':>6}"
             f"{dcol} {'pad%':>7} {'compile%':>9} {'retries':>7} "
             f"{'retry%':>7} {'footprint':>12} {'headroom':>12} "

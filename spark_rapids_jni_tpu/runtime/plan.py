@@ -1,0 +1,876 @@
+"""Logical-plan IR with cross-op fusion — one compiled program per stage.
+
+The reference sits *under* Spark's physical plan; this module is the tiny
+plan layer our reproduction grew to need once ``models/pipeline.py``'s
+entries became hand-wired call chains paying one jitted dispatch and one
+HBM round-trip per op per bucket.  A :class:`Plan` is an ordered list of
+:class:`Node`\\ s over named column streams:
+
+==============  ===========================================================
+node            semantics
+==============  ===========================================================
+``scan``        binds named row-aligned plan inputs as the column stream
+``filter``      ANDs a predicate over named columns into the row mask
+``project``     adds named columns computed from existing ones
+``aggregate``   terminal group-by (sum / multi-measure) over the live rows
+``join``        equi-join against a named build side (unique / dup / semi)
+``exchange``    ``bucket_exchange`` all-to-all (sharded plans only)
+==============  ===========================================================
+
+Every plan has a stable **content fingerprint**: a sha256 over node kinds
+and canonicalized params, where callables hash by bytecode + consts +
+closure values — two plans differing only in a literal get distinct
+fingerprints, while re-building the same plan object is free to cache on.
+
+The **fuser** collapses each maximal ``filter→project→…→aggregate|join``
+chain into ONE jitted program; ``SRJ_TPU_PLAN_FUSE=0`` falls back to
+node-at-a-time execution (one program per node — the A/B baseline the
+bench plan axis and byte-identity tests run against).  Compiled programs
+live in an LRU keyed exactly on ``(plan fingerprint, shape bucket,
+mesh)`` (``SRJ_TPU_PLAN_CACHE`` sets the capacity), so N batch sizes
+cost O(log N) programs per plan via the ``runtime/shapes.py`` pow-2
+grid.
+
+Execution runs under the full existing machinery: inputs promote to
+device via one staged transfer (``runtime/staging.py``), each program
+dispatch goes through ``resilience.run`` with the plan fingerprint in
+the op name (retry/breaker coverage), and the whole execution is a span
+stamped ``plan=<fp8> nodes=<k> fused=<m>`` so the costmodel ledger,
+drift sentinel and footprint model attribute per fused stage.  Inside a
+jit trace :func:`execute` is a plain inlined tail call (the caller's
+program already fuses everything), mirroring the ``resilience.run``
+contract.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import hashlib
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.runtime import shapes
+from spark_rapids_jni_tpu.utils import metrics as _um
+
+__all__ = [
+    "Node", "Plan", "scan", "filter", "project", "aggregate", "join",
+    "exchange", "execute", "run_program", "as_traced", "cached_sharded",
+    "fuse_enabled", "cache_capacity", "cache_stats", "clear_cache",
+    "dispatch_totals",
+]
+
+_FUSE_ENV = "SRJ_TPU_PLAN_FUSE"
+_CACHE_ENV = "SRJ_TPU_PLAN_CACHE"
+_FUSIBLE = ("filter", "project", "aggregate", "join")
+
+
+def fuse_enabled() -> bool:
+    """Cross-op fusion armed (``SRJ_TPU_PLAN_FUSE=0`` falls back to
+    node-at-a-time execution — the A/B baseline)."""
+    return os.environ.get(_FUSE_ENV, "1") not in ("0", "false", "no")
+
+
+def cache_capacity() -> int:
+    """Compiled-program LRU capacity (``SRJ_TPU_PLAN_CACHE``)."""
+    raw = os.environ.get(_CACHE_ENV, "")
+    try:
+        v = int(raw)
+        return v if v > 0 else 128
+    except ValueError:
+        return 128
+
+
+# ---------------------------------------------------------------------------
+# IR nodes
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Node:
+    """One plan node: a kind plus canonical ``(name, value)`` params."""
+    kind: str
+    params: Tuple[Tuple[str, Any], ...]
+
+    def get(self, name: str, default=None):
+        for k, v in self.params:
+            if k == name:
+                return v
+        return default
+
+
+def _node(kind: str, **params) -> Node:
+    return Node(kind, tuple(sorted(params.items())))
+
+
+def scan(*columns: str) -> Node:
+    """Bind named row-aligned plan inputs as the column stream."""
+    if not columns:
+        raise ValueError("scan needs at least one column name")
+    return _node("scan", columns=tuple(columns))
+
+
+def filter(pred, refs: Sequence[str]) -> Node:  # noqa: A001 - IR verb
+    """AND ``pred(*refs)`` into the row mask."""
+    return _node("filter", pred=pred, refs=tuple(refs))
+
+
+def project(outputs: Dict[str, Tuple[Any, Sequence[str]]]) -> Node:
+    """Add named columns: ``{name: (fn, refs)}``.  Every expression reads
+    the pre-node state (parallel projection), so ordering cannot matter."""
+    canon = tuple(sorted((str(k), (fn, tuple(refs)))
+                         for k, (fn, refs) in outputs.items()))
+    return _node("project", outputs=canon)
+
+
+def aggregate(keys: Sequence[str], measures: Sequence[Tuple[str, str]],
+              max_groups: int) -> Node:
+    """Terminal group-by.  ``measures``: ``(ref, op)`` pairs with op in
+    sum/count/min/max/avg.  Single key + single sum lowers to
+    :func:`models.pipeline.hash_aggregate_sum`; all-sum multi to
+    ``hash_aggregate_sum_multi``; mixed ops to ``hash_aggregate_multi``
+    — the result tuple is whatever the underlying kernel returns."""
+    return _node("aggregate", keys=tuple(keys),
+                 measures=tuple((str(r), str(op)) for r, op in measures),
+                 max_groups=int(max_groups))
+
+
+def join(build_keys: str, probe: str, build_payload: Optional[str] = None,
+         out: Optional[str] = None, how: str = "unique",
+         build_live: Optional[str] = None, out_matched: Optional[str] = None,
+         fold_matched: bool = True, expansion: int = 4) -> Node:
+    """Equi-join the stream against a named build-side input.
+
+    ``how="unique"``: PK-FK gather — ``out`` gets the payload, the match
+    mask folds into the row mask (``fold_matched=False`` + ``out_matched``
+    exposes it as a column instead).  ``how="dup"``: duplicate-key inner
+    join; the stream is re-indexed through the join's probe indices and
+    grows an overflow flag (``expansion`` bounds the output capacity as a
+    multiple of the probe rows).  ``how="semi"``: existence mask only.
+    """
+    if how not in ("unique", "dup", "semi"):
+        raise ValueError(f"unknown join how={how!r}")
+    if how != "semi" and (build_payload is None or out is None):
+        raise ValueError(f"{how} join needs build_payload and out")
+    return _node("join", build_keys=str(build_keys), probe=str(probe),
+                 build_payload=build_payload, out=out, how=how,
+                 build_live=build_live, out_matched=out_matched,
+                 fold_matched=bool(fold_matched), expansion=int(expansion))
+
+
+def exchange(key: str, payload: Sequence[str], num_parts: int,
+             axis_name: str = "data",
+             capacity_factor: float = 8.0) -> Node:
+    """``bucket_exchange`` all-to-all over ``payload`` columns, routed by
+    the murmur3 hash of ``key`` (Spark's int hash contract).  Only valid
+    in sharded plans (the body must run under ``shard_map``); replaces
+    the stream with the received rows, the mask with slot validity, and
+    ORs the bucket-overflow flag into the plan's overflow."""
+    return _node("exchange", key=str(key), payload=tuple(payload),
+                 num_parts=int(num_parts), axis_name=str(axis_name),
+                 capacity_factor=float(capacity_factor))
+
+
+# ---------------------------------------------------------------------------
+# Content fingerprint
+# ---------------------------------------------------------------------------
+
+def _fp_callable(fn, h) -> None:
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        h.update(repr(fn).encode())
+        return
+    h.update(code.co_code)
+    h.update(",".join(code.co_names).encode())
+    h.update(",".join(code.co_varnames).encode())
+    for c in code.co_consts:
+        if hasattr(c, "co_code"):
+            _fp_callable(_CodeHolder(c), h)
+        else:
+            h.update(repr(c).encode())
+    for cell in (fn.__closure__ or ()):
+        try:
+            v = cell.cell_contents
+        except ValueError:          # unfilled cell
+            v = "<empty>"
+        if callable(v):
+            _fp_callable(v, h)
+        else:
+            h.update(repr(v).encode())
+
+
+class _CodeHolder:
+    """Adapter so nested code objects recurse through :func:`_fp_callable`
+    (comprehensions, nested lambdas)."""
+    __slots__ = ("__code__", "__closure__")
+
+    def __init__(self, code):
+        self.__code__ = code
+        self.__closure__ = None
+
+
+def _fp_value(v, h) -> None:
+    if callable(v) and not isinstance(v, type):
+        _fp_callable(v, h)
+    elif isinstance(v, (tuple, list)):
+        h.update(b"(")
+        for x in v:
+            _fp_value(x, h)
+            h.update(b",")
+        h.update(b")")
+    else:
+        h.update(repr(v).encode())
+
+
+# ---------------------------------------------------------------------------
+# Plan
+# ---------------------------------------------------------------------------
+
+class Plan:
+    """An ordered node list over named column streams (see module doc)."""
+
+    def __init__(self, nodes: Sequence[Node],
+                 outputs: Optional[Sequence[str]] = None):
+        self.nodes: Tuple[Node, ...] = tuple(nodes)
+        self.outputs = tuple(outputs) if outputs else None
+        if not self.nodes:
+            raise ValueError("empty plan")
+        for n in self.nodes:
+            if not isinstance(n, Node):
+                raise TypeError(f"not a Node: {n!r}")
+        aggs = [i for i, n in enumerate(self.nodes)
+                if n.kind == "aggregate"]
+        if aggs and aggs[0] != len(self.nodes) - 1:
+            raise ValueError("aggregate must be the terminal node")
+        self._fp: Optional[str] = None
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    def fingerprint(self) -> str:
+        """Stable sha256 content fingerprint (hex)."""
+        if self._fp is None:
+            h = hashlib.sha256()
+            for n in self.nodes:
+                h.update(n.kind.encode())
+                h.update(b"{")
+                for k, v in n.params:
+                    h.update(k.encode())
+                    h.update(b"=")
+                    _fp_value(v, h)
+                    h.update(b";")
+                h.update(b"}")
+            if self.outputs:
+                h.update(("->" + ",".join(self.outputs)).encode())
+            self._fp = h.hexdigest()
+        return self._fp
+
+    @property
+    def fp8(self) -> str:
+        return self.fingerprint[:8]
+
+    # -- shape -------------------------------------------------------------
+
+    @property
+    def stream_inputs(self) -> Tuple[str, ...]:
+        cols: List[str] = []
+        for n in self.nodes:
+            if n.kind == "scan":
+                cols.extend(n.get("columns"))
+        return tuple(cols)
+
+    @property
+    def side_inputs(self) -> Tuple[str, ...]:
+        """Build-side input names (join builds) — row counts independent
+        of the stream, bucketed separately."""
+        names: List[str] = []
+        for n in self.nodes:
+            if n.kind != "join":
+                continue
+            for p in ("build_keys", "build_payload", "build_live"):
+                v = n.get(p)
+                if v is not None and v not in names:
+                    names.append(v)
+        return tuple(names)
+
+    def body_indices(self) -> List[int]:
+        return [i for i, n in enumerate(self.nodes) if n.kind != "scan"]
+
+    def segments(self, fused: Optional[bool] = None) -> List[List[int]]:
+        """Node-index groups, each compiled as ONE jitted program.  Fused:
+        maximal runs of fusible kinds; unfused: one node per segment.
+        ``exchange`` always breaks a chain (it is a collective)."""
+        if fused is None:
+            fused = fuse_enabled()
+        segs: List[List[int]] = []
+        for i in self.body_indices():
+            kind = self.nodes[i].kind
+            if (fused and kind in _FUSIBLE and segs
+                    and self.nodes[segs[-1][-1]].kind in _FUSIBLE):
+                segs[-1].append(i)
+            else:
+                segs.append([i])
+        return segs
+
+    def max_fused(self, fused: Optional[bool] = None) -> int:
+        segs = self.segments(fused)
+        return max(len(s) for s in segs) if segs else 0
+
+
+# ---------------------------------------------------------------------------
+# Node emitters (trace-time semantics)
+# ---------------------------------------------------------------------------
+
+def _col(st: Dict, name: str):
+    try:
+        return st["cols"][name]
+    except KeyError:
+        raise KeyError(
+            f"plan references unknown column {name!r}; "
+            f"have {sorted(st['cols'])}") from None
+
+
+def _mask(st: Dict):
+    m = st["mask"]
+    if m is None:
+        n = next(iter(st["cols"].values())).shape[0]
+        m = jnp.ones((n,), jnp.bool_)
+    return m
+
+
+def _or_ovf(st: Dict, flag) -> None:
+    st["ovf"] = flag if st["ovf"] is None else (st["ovf"] | flag)
+
+
+def _emit_filter(node: Node, st: Dict) -> None:
+    pred = node.get("pred")
+    m = pred(*[_col(st, r) for r in node.get("refs")])
+    st["mask"] = m if st["mask"] is None else (st["mask"] & m)
+
+
+def _emit_project(node: Node, st: Dict) -> None:
+    prev = dict(st["cols"])
+    for name, (fn, refs) in node.get("outputs"):
+        st["cols"][name] = fn(*[prev[r] for r in refs])
+
+
+def _emit_join(node: Node, st: Dict) -> None:
+    from spark_rapids_jni_tpu.models import pipeline as _pl
+    how = node.get("how")
+    bk = _col(st, node.get("build_keys"))
+    probe = _col(st, node.get("probe"))
+    if how == "semi":
+        m = _pl.join_semi_mask(bk, probe)
+        st["mask"] = m if st["mask"] is None else (st["mask"] & m)
+        return
+    bp = _col(st, node.get("build_payload"))
+    if how == "dup":
+        cap = probe.shape[0] * node.get("expansion")
+        pidx, payload, jvalid, _, j_ovf = _pl.sort_merge_join_dup(
+            bk, bp, probe, cap)
+        # the stream re-indexes through the join's probe indices: every
+        # column (and the mask) gathers by pidx, so later filters and
+        # the aggregate see join-output row order
+        sides = {node.get("build_keys"), node.get("build_payload"),
+                 node.get("build_live")} - {None}
+        st["cols"] = {k: (v if k in sides else v[pidx])
+                      for k, v in st["cols"].items()}
+        st["cols"][node.get("out")] = payload
+        m = _mask(st)
+        st["mask"] = jvalid & m[pidx]
+        _or_ovf(st, j_ovf)
+        return
+    live_ref = node.get("build_live")
+    if live_ref is not None:
+        payload, matched = _pl.sort_merge_join_live(
+            bk, bp, _col(st, live_ref), probe)
+    else:
+        payload, matched = _pl.sort_merge_join(bk, bp, probe)
+    st["cols"][node.get("out")] = payload
+    if node.get("out_matched"):
+        st["cols"][node.get("out_matched")] = matched
+    if node.get("fold_matched"):
+        st["mask"] = matched if st["mask"] is None \
+            else (st["mask"] & matched)
+
+
+def _emit_aggregate(node: Node, st: Dict) -> None:
+    from spark_rapids_jni_tpu.models import pipeline as _pl
+    keys = [_col(st, k) for k in node.get("keys")]
+    measures = node.get("measures")
+    mg = node.get("max_groups")
+    m = _mask(st)
+    ops = [op for _, op in measures]
+    if len(keys) == 1 and len(measures) == 1 and ops[0] == "sum":
+        st["result"] = _pl.hash_aggregate_sum(
+            keys[0], _col(st, measures[0][0]), m, mg)
+    elif all(op == "sum" for op in ops):
+        st["result"] = _pl.hash_aggregate_sum_multi(
+            keys, [_col(st, r) for r, _ in measures], m, mg)
+    else:
+        st["result"] = _pl.hash_aggregate_multi(
+            keys, [(_col(st, r), op) for r, op in measures], m, mg)
+
+
+def _emit_exchange(node: Node, st: Dict) -> None:
+    from spark_rapids_jni_tpu.ops.hashing import murmur3_hash, pmod
+    from spark_rapids_jni_tpu.parallel.shuffle import bucket_exchange
+    from spark_rapids_jni_tpu.table import Column, INT32
+    key = _col(st, node.get("key"))
+    refs = node.get("payload")
+    num_parts = node.get("num_parts")
+    n_local = key.shape[0]
+    # per-(sender, target) bucket slack: group-key skew concentrates
+    # rows, so default well above the uniform expectation
+    capacity = max(8, int(node.get("capacity_factor")
+                          * n_local / num_parts))
+    pids = pmod(murmur3_hash([Column(INT32, key)]), num_parts)
+    payload = jnp.stack([_col(st, r) for r in refs], axis=1)
+    body = bucket_exchange(num_parts, capacity, node.get("axis_name"))
+    recv, valid, _, x_ovf = body(payload, pids)
+    # payload columns rebind to the received rows; everything else
+    # (join build sides — row counts independent of the stream) rides
+    # through untouched.  Stream columns NOT in the payload are stale
+    # after the exchange — referencing one later is a plan-author bug.
+    for i, r in enumerate(refs):
+        st["cols"][r] = recv[:, i]
+    st["mask"] = valid
+    _or_ovf(st, x_ovf)
+
+
+_EMIT = {"filter": _emit_filter, "project": _emit_project,
+         "join": _emit_join, "aggregate": _emit_aggregate,
+         "exchange": _emit_exchange}
+
+
+def _run_nodes(plan: Plan, idxs: Sequence[int], st: Dict) -> Dict:
+    for i in idxs:
+        _EMIT[plan.nodes[i].kind](plan.nodes[i], st)
+    return st
+
+
+def _finish(plan: Plan, st: Dict):
+    if plan.outputs:
+        return tuple(_col(st, name) for name in plan.outputs)
+    if st["result"] is not None:
+        return st["result"]
+    return st["cols"], st["mask"]
+
+
+def as_traced(plan: Plan, input_names: Sequence[str],
+              mask_name: Optional[str] = None,
+              with_overflow: bool = False):
+    """A plain traced function of the whole plan: ``fn(*arrays) ->
+    outputs`` with arrays bound to ``input_names`` in order
+    (``mask_name`` binds one of them as the row mask instead of a
+    column).  No padding, no cache, no spans — the building block for
+    vmapped serve kernels and ``shard_map`` bodies, where the caller
+    owns compilation.  ``with_overflow=True`` returns ``(outputs,
+    overflow)`` with the OR of exchange/join capacity overflows (False
+    scalar when the plan has none) — the distributed steps' host-checked
+    retry contract."""
+    names = tuple(input_names)
+    idxs = plan.body_indices()
+
+    def fn(*arrays):
+        d = dict(zip(names, arrays))
+        mask = d.pop(mask_name, None) if mask_name else None
+        st = {"cols": d, "mask": mask, "ovf": None, "result": None}
+        _run_nodes(plan, idxs, st)
+        out = _finish(plan, st)
+        if with_overflow:
+            ovf = st["ovf"] if st["ovf"] is not None \
+                else jnp.zeros((), jnp.bool_)
+            return out, ovf
+        return out
+
+    fn.__name__ = f"plan_{plan.fp8}"
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# Compiled-program LRU keyed (fingerprint, bucket, mesh)
+# ---------------------------------------------------------------------------
+
+class _ProgramCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._lru: "collections.OrderedDict[Tuple, Any]" = \
+            collections.OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key: Tuple):
+        with self._lock:
+            v = self._lru.get(key)
+            if v is None:
+                self.misses += 1
+                return None
+            self._lru.move_to_end(key)
+            self.hits += 1
+            return v
+
+    def put(self, key: Tuple, value) -> None:
+        cap = cache_capacity()
+        with self._lock:
+            self._lru[key] = value
+            self._lru.move_to_end(key)
+            while len(self._lru) > cap:
+                self._lru.popitem(last=False)
+                self.evictions += 1
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            keys = list(self._lru)
+            return {"programs": len(keys),
+                    "plans": len({k[0] for k in keys}),
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._lru.clear()
+            self.hits = self.misses = self.evictions = 0
+
+
+_CACHE = _ProgramCache()
+_FUSED_NODES: Dict[str, int] = {}
+_DISPATCHES = {"n": 0}
+_STATE_LOCK = threading.Lock()
+
+
+def cache_stats() -> Dict:
+    return _CACHE.snapshot()
+
+
+def clear_cache() -> None:
+    """Drop every compiled program and zero the counters (test
+    isolation; the jitted closures ARE the cache values, so eviction
+    releases the programs)."""
+    _CACHE.clear()
+    with _STATE_LOCK:
+        _FUSED_NODES.clear()
+        _DISPATCHES["n"] = 0
+
+
+def dispatch_totals() -> Dict[str, int]:
+    """Cumulative plan-program dispatches (one per segment execution) —
+    the bench plan axis reads the fused-vs-unfused delta from here."""
+    with _STATE_LOCK:
+        return {"dispatches": _DISPATCHES["n"]}
+
+
+_EXPORTED = False
+_EXPORT_LOCK = threading.Lock()
+
+
+def _publish_gauges() -> None:
+    from spark_rapids_jni_tpu.obs import metrics as _metrics
+    snap = _CACHE.snapshot()
+    _metrics.gauge("srj_tpu_plan_cached_programs",
+                   "Compiled plan programs held by the LRU."
+                   ).set(snap["programs"])
+    g = _metrics.gauge("srj_tpu_plan_fused_nodes",
+                       "Nodes fused into one program per plan.",
+                       ("plan",))
+    with _STATE_LOCK:
+        fused = dict(_FUSED_NODES)
+    for fp8, m in fused.items():
+        g.set(m, plan=fp8)
+
+
+def _health() -> Dict:
+    snap = _CACHE.snapshot()
+    snap["fuse"] = fuse_enabled()
+    snap["capacity"] = cache_capacity()
+    with _STATE_LOCK:
+        snap["dispatches"] = _DISPATCHES["n"]
+        snap["fused_nodes"] = dict(_FUSED_NODES)
+    return snap
+
+
+def _ensure_exported() -> None:
+    global _EXPORTED
+    if _EXPORTED:
+        return
+    with _EXPORT_LOCK:
+        if _EXPORTED:
+            return
+        try:
+            from spark_rapids_jni_tpu.obs import exporter, metrics
+            metrics.counter("srj_tpu_plan_cache_hits_total",
+                            "Compiled-plan LRU hits.")
+            metrics.counter("srj_tpu_plan_cache_misses_total",
+                            "Compiled-plan LRU misses.")
+            metrics.counter("srj_tpu_plan_dispatches_total",
+                            "Plan program dispatches (one per executed "
+                            "segment).", ("plan",))
+            metrics.register_collect_hook(_publish_gauges)
+            exporter.register_health_provider("plans", _health)
+        except Exception:
+            pass
+        _EXPORTED = True
+
+
+def _count(family: str, n: int = 1) -> None:
+    try:
+        from spark_rapids_jni_tpu.obs import metrics
+        metrics.counter(family).inc(n)
+    except Exception:
+        pass
+
+
+def _note_dispatch(fp8: str, n: int = 1) -> None:
+    with _STATE_LOCK:
+        _DISPATCHES["n"] += n
+    try:
+        from spark_rapids_jni_tpu.obs import metrics
+        metrics.counter("srj_tpu_plan_dispatches_total").inc(n, plan=fp8)
+    except Exception:
+        pass
+
+
+def _cache_lookup(key: Tuple, build):
+    """LRU get-or-build with hit/miss counters and the fused-nodes
+    gauge refresh on build."""
+    entry = _CACHE.get(key)
+    if entry is not None:
+        _count("srj_tpu_plan_cache_hits_total")
+        return entry
+    _count("srj_tpu_plan_cache_misses_total")
+    entry = build()
+    _CACHE.put(key, entry)
+    return entry
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+
+def _segment_fn(plan: Plan, idxs: Sequence[int]):
+    nodes = tuple(idxs)
+
+    def run(cols, mask, ovf):
+        st = {"cols": dict(cols), "mask": mask, "ovf": ovf,
+              "result": None}
+        _run_nodes(plan, nodes, st)
+        return st["cols"], st["mask"], st["ovf"], st["result"]
+
+    run.__name__ = f"plan_{plan.fp8}_seg{nodes[0]}"
+    return run
+
+
+def _stage_inputs(inputs: Dict[str, Any]) -> Dict[str, Any]:
+    """Promote host numpy inputs to device in ONE staged transfer
+    (``staging.stage_arrays``); device arrays pass through untouched."""
+    from spark_rapids_jni_tpu.runtime import staging
+    host = [(k, v) for k, v in inputs.items() if isinstance(v, np.ndarray)]
+    if not host:
+        return dict(inputs)
+    staged = staging.stage_arrays([v for _, v in host])
+    out = dict(inputs)
+    for (k, _), dev in zip(host, staged):
+        out[k] = dev
+    return out
+
+
+def _input_bytes(inputs: Dict[str, Any]) -> int:
+    total = 0
+    for v in inputs.values():
+        try:
+            total += int(v.nbytes)
+        except Exception:
+            pass
+    return total
+
+
+def execute(plan: Plan, inputs: Dict[str, Any],
+            mask: Optional[Any] = None, bucket="auto"):
+    """Run ``plan`` over named input arrays and return the terminal
+    node's result (the aggregate tuple, or ``plan.outputs`` columns).
+
+    Eagerly: inputs stage once, stream rows pad up the shape-bucket
+    grid (the padded tail is dead via the mask), each fused segment
+    executes as one cached jitted program under ``resilience.run``, and
+    the whole run is a ``plan[<fp8>]`` span.  Inside a jit trace this
+    is a plain inlined call — the caller's program owns compilation."""
+    stream = plan.stream_inputs
+    if not stream:
+        raise ValueError("plan has no scan node")
+    if not _um.eager():
+        st = {"cols": dict(inputs), "mask": mask, "ovf": None,
+              "result": None}
+        _run_nodes(plan, plan.body_indices(), st)
+        return _finish(plan, st)
+
+    _ensure_exported()
+    inputs = _stage_inputs(inputs)
+    n = int(inputs[stream[0]].shape[0])
+    f = shapes.resolve(bucket)
+    b = shapes.bucket_rows(n, f) if f is not None else max(n, 1)
+    fused = fuse_enabled()
+    cols: Dict[str, Any] = {}
+    live = None
+    with shapes.pad_span():
+        for name in stream:
+            arr = inputs[name]
+            if int(arr.shape[0]) != n:
+                raise ValueError(
+                    f"stream input {name!r} has {arr.shape[0]} rows, "
+                    f"expected {n}")
+            cols[name] = shapes.pad_to(arr, (b,) + tuple(arr.shape[1:])) \
+                if b != n else arr
+        live = shapes.pad_mask(mask, n, b)
+        # build sides bucket on their own row count; only unique joins
+        # pad (keys AND payload together, with a generated prefix
+        # liveness threaded into the probe) — dup and semi joins have
+        # no liveness channel, so a padded key-0 row would spuriously
+        # match and they run exact-shape instead
+        side_pads: List[Tuple[str, int]] = []
+        padded_builds: set = set()
+        live_keys: set = set()
+        for nd in plan.nodes:
+            if (nd.kind == "join" and nd.get("how") == "unique"
+                    and nd.get("build_live") is None):
+                padded_builds.add(nd.get("build_keys"))
+                padded_builds.add(nd.get("build_payload"))
+                live_keys.add(nd.get("build_keys"))
+        for name in plan.side_inputs:
+            arr = inputs[name]
+            m = int(arr.shape[0])
+            bm = shapes.bucket_rows(m, f) \
+                if (f is not None and name in padded_builds) else m
+            cols[name] = shapes.pad_to(arr, (bm,) + tuple(arr.shape[1:])) \
+                if bm != m else arr
+            side_pads.append((name, bm))
+            if name in live_keys and bm != m:
+                # host-built prefix liveness: no XLA compile
+                cols[name + "__live"] = jnp.asarray(np.arange(bm) < m)
+
+    # a padded unique-join build side needs its liveness threaded in:
+    # rewrite those join nodes to the _live form against the generated
+    # prefix mask (fingerprint unchanged — liveness is an execution
+    # detail of the bucket, not plan content)
+    exec_plan = _with_build_liveness(plan, set(cols) - set(inputs))
+
+    x64 = bool(jax.config.jax_enable_x64)
+    dtype_sig = tuple(sorted((k, str(v.dtype)) for k, v in cols.items()))
+    key = (plan.fingerprint, (b, tuple(side_pads), dtype_sig, fused, x64),
+           None)
+
+    def _build():
+        with _STATE_LOCK:
+            _FUSED_NODES[plan.fp8] = max(
+                _FUSED_NODES.get(plan.fp8, 0), exec_plan.max_fused(fused))
+        return [(tuple(idxs), jax.jit(_segment_fn(exec_plan, idxs)))
+                for idxs in exec_plan.segments(fused)]
+
+    programs = _cache_lookup(key, _build)
+
+    from spark_rapids_jni_tpu.obs import spans as _spans
+    from spark_rapids_jni_tpu.runtime import resilience
+    k = len(plan.body_indices())
+    op = f"plan[{plan.fp8}]"
+    sig = (len(stream), len(plan.side_inputs), k)
+    with _spans.span(op, plan=plan.fp8, nodes=k,
+                     fused=exec_plan.max_fused(fused),
+                     dispatches=len(programs), sig=str(sig),
+                     rows=n, bytes=_input_bytes(inputs)) as sp:
+        shapes.note(n, b)
+        ovf = None
+        result = None
+        for idxs, jfn in programs:
+            cols, live, ovf, r = resilience.run(
+                op, jfn, cols, live, ovf, sig=sig, bucket=b)
+            _note_dispatch(plan.fp8)
+            if r is not None:
+                result = r
+        st = {"cols": cols, "mask": live, "ovf": ovf, "result": result}
+        out = _finish(plan, st)
+        if plan.outputs or result is None:
+            # column outputs pad with the stream: slice back to n rows
+            with shapes.unpad_span():
+                if plan.outputs:
+                    out = tuple(shapes.unpad_array(a, n) for a in out)
+                else:
+                    out = ({kk: shapes.unpad_array(v, n)
+                            for kk, v in out[0].items()},
+                           shapes.unpad_array(out[1], n)
+                           if out[1] is not None else None)
+        sp.fence(out)
+    return out
+
+
+def _with_build_liveness(plan: Plan, generated: set) -> Plan:
+    """Rewrite unique-join nodes whose build side gained a generated
+    ``<name>__live`` prefix mask to consume it."""
+    if not generated:
+        return plan
+    nodes = []
+    changed = False
+    for nd in plan.nodes:
+        lv = (nd.get("build_keys") or "") + "__live"
+        if (nd.kind == "join" and nd.get("how") == "unique"
+                and nd.get("build_live") is None and lv in generated):
+            nodes.append(join(
+                build_keys=nd.get("build_keys"), probe=nd.get("probe"),
+                build_payload=nd.get("build_payload"), out=nd.get("out"),
+                how="unique", build_live=lv,
+                out_matched=nd.get("out_matched"),
+                fold_matched=nd.get("fold_matched")))
+            changed = True
+        else:
+            nodes.append(nd)
+    if not changed:
+        return plan
+    p = Plan(nodes, outputs=plan.outputs)
+    p._fp = plan.fingerprint      # execution detail, same plan content
+    return p
+
+
+def run_program(plan: Plan, fn, *args, sig="", bucket="", kwargs=None):
+    """Execute an externally-traced program under the plan machinery:
+    LRU accounting keyed ``(fingerprint, bucket, mesh=None)``,
+    ``resilience.run`` with the fingerprint in the op name, and the
+    ``plan[<fp8>]`` span — the route ``hash_aggregate_table`` takes so
+    its retry/breaker/attribution coverage no longer depends on which
+    entry the caller picked.  ``fn`` owns its own jit cache; the LRU
+    entry here is the dispatch record for telemetry and eviction
+    accounting."""
+    if not _um.eager():
+        return fn(*args, **(kwargs or {}))
+    _ensure_exported()
+    key = (plan.fingerprint, ("prog", str(bucket), str(sig)), None)
+    _cache_lookup(key, lambda: fn)
+    from spark_rapids_jni_tpu.obs import spans as _spans
+    from spark_rapids_jni_tpu.runtime import resilience
+    k = len(plan.body_indices())
+    op = f"plan[{plan.fp8}]"
+    with _spans.span(op, plan=plan.fp8, nodes=k, fused=k, dispatches=1,
+                     sig=str(sig)) as sp:
+        out = resilience.run(op, fn, *args, sig=sig, bucket=bucket,
+                             kwargs=kwargs)
+        _note_dispatch(plan.fp8)
+        sp.fence(out)
+    return out
+
+
+def cached_sharded(plan: Plan, mesh, build):
+    """LRU slot for a mesh-bound compiled step: key ``(fingerprint,
+    "sharded", mesh)`` — the mesh leg of the (fingerprint, bucket,
+    mesh) triple.  ``build()`` constructs the shard_map-wrapped step on
+    a miss; the distributed step factories route through here so
+    re-binding the same plan to the same mesh returns the same
+    callable."""
+    _ensure_exported()
+    try:
+        key = (plan.fingerprint, "sharded", mesh)
+        hash(key)
+    except TypeError:
+        return build()
+    return _cache_lookup(key, build)

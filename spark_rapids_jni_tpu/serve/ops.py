@@ -92,13 +92,25 @@ class ServeOp:
 # agg: group-by-sum (models.pipeline.hash_aggregate_sum)
 # ---------------------------------------------------------------------------
 
+@functools.lru_cache(maxsize=64)
+def _agg_plan(max_groups: int):
+    """The serve aggregate as a logical plan — the SAME plan identity a
+    direct ``plan.execute`` of this chain would use, so the scheduler's
+    (op, sig) group key carries the plan fingerprint and the profile /
+    breaker rows line up across the serving and direct entries."""
+    from spark_rapids_jni_tpu.runtime import plan as _plan
+    return _plan.Plan([
+        _plan.scan("keys", "values"),
+        _plan.aggregate(["keys"], [("values", "sum")], max_groups),
+    ])
+
+
 @functools.lru_cache(maxsize=256)
 def _agg_kernel(b: int, max_groups: int, kb: int):
-    def _serve_agg(keys, values, mask):
-        return jax.vmap(
-            lambda k, v, m: pipeline.hash_aggregate_sum(
-                k, v, m, max_groups))(keys, values, mask)
-    return jax.jit(_serve_agg)
+    from spark_rapids_jni_tpu.runtime import plan as _plan
+    body = _plan.as_traced(_agg_plan(max_groups),
+                           ("keys", "values", "mask"), mask_name="mask")
+    return jax.jit(jax.vmap(body))
 
 
 class _AggOp(ServeOp):
@@ -115,11 +127,16 @@ class _AggOp(ServeOp):
         n = keys.shape[0]
         payload = {"keys": keys, "values": values, "n": n,
                    "max_groups": max_groups}
-        sig = (shapes.bucket_rows(n), max_groups)
+        # the plan fingerprint rides at the END of the signature: the
+        # positional (bucket, max_groups) contract of kernel() holds,
+        # and the scheduler's per-(op, sig) coalescing key now groups
+        # by plan identity too
+        sig = (shapes.bucket_rows(n), max_groups,
+               _agg_plan(max_groups).fp8)
         return payload, sig, n, keys.nbytes + values.nbytes
 
     def batch(self, payloads, sig, kb):
-        b, _ = sig
+        b = sig[0]
         mask = np.zeros((kb, b), np.bool_)
         for i, p in enumerate(payloads):
             mask[i, :p["n"]] = True
@@ -144,11 +161,28 @@ class _AggOp(ServeOp):
 # join: unique-key equi-join (models.pipeline.sort_merge_join_live)
 # ---------------------------------------------------------------------------
 
+@functools.lru_cache(maxsize=1)
+def _join_plan():
+    """The serve unique-key join as a logical plan (see
+    :func:`_agg_plan`): build-live threaded in so the coalescer's padded
+    build rows stay dead, match mask exposed as a column."""
+    from spark_rapids_jni_tpu.runtime import plan as _plan
+    return _plan.Plan(
+        [_plan.scan("probe_keys"),
+         _plan.join("build_keys", "probe_keys",
+                    build_payload="build_payload", out="payload",
+                    build_live="build_live", fold_matched=False,
+                    out_matched="matched")],
+        outputs=("payload", "matched"))
+
+
 @functools.lru_cache(maxsize=256)
 def _join_kernel(bm: int, bn: int, kb: int):
-    def _serve_join(bk, bp, bl, pk):
-        return jax.vmap(pipeline.sort_merge_join_live)(bk, bp, bl, pk)
-    return jax.jit(_serve_join)
+    from spark_rapids_jni_tpu.runtime import plan as _plan
+    body = _plan.as_traced(
+        _join_plan(),
+        ("build_keys", "build_payload", "build_live", "probe_keys"))
+    return jax.jit(jax.vmap(body))
 
 
 class _JoinOp(ServeOp):
@@ -165,11 +199,12 @@ class _JoinOp(ServeOp):
         m, n = bk.shape[0], pk.shape[0]
         payload = {"build_keys": bk, "build_payload": bp,
                    "probe_keys": pk, "m": m, "n": n}
-        sig = (shapes.bucket_rows(m), shapes.bucket_rows(n))
+        sig = (shapes.bucket_rows(m), shapes.bucket_rows(n),
+               _join_plan().fp8)
         return payload, sig, n, bk.nbytes + bp.nbytes + pk.nbytes
 
     def batch(self, payloads, sig, kb):
-        bm, bn = sig
+        bm, bn = sig[0], sig[1]
         live = np.zeros((kb, bm), np.bool_)
         for i, p in enumerate(payloads):
             live[i, :p["m"]] = True

@@ -1,7 +1,10 @@
 """Continuous-batching scheduler: ticks, mega-dispatch, tenant accounting.
 
 One background thread runs the tick loop: each tick drains the request
-queue into coalescing groups (``(op, shape-bucket signature)``), and for
+queue into coalescing groups (``(op, shape-bucket signature)`` — for
+plan-backed ops the signature's last element is the logical-plan
+fingerprint from :mod:`runtime.plan`, so requests coalesce per plan
+identity too), and for
 every group stages ONE mega-batch blob host→device
 (:func:`runtime.staging.stage_arrays`), runs ONE jitted vmapped kernel,
 fetches every output in ONE transfer (:func:`staging.fetch_arrays`), and

@@ -1,0 +1,402 @@
+"""Logical-plan fusion contract tests (``runtime/plan.py``).
+
+Four layers:
+
+1. Byte-identity: fused execution vs node-at-a-time fallback
+   (``SRJ_TPU_PLAN_FUSE=0``) across null patterns and bucket-edge row
+   counts — an int32 chain, so equality is exact.
+2. The compile-count guard (the tentpole acceptance contract): one
+   program per (plan fingerprint, bucket), a repeat burst at seen
+   buckets adds zero compiles, and two plans differing only in a
+   literal get distinct fingerprints.
+3. LRU mechanics: ``SRJ_TPU_PLAN_CACHE`` bounds the program cache and
+   evicts oldest-first; metrics / healthz expose the counters.
+4. Serve integration: a coalesced burst still costs ONE dispatch per
+   (op, sig) group now that the signature carries the plan fingerprint.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu import obs, serve
+from spark_rapids_jni_tpu.models import pipeline
+from spark_rapids_jni_tpu.obs import exporter, metrics
+from spark_rapids_jni_tpu.runtime import plan, shapes
+from spark_rapids_jni_tpu.table import Column, INT32, Table
+
+
+@pytest.fixture
+def obs_on():
+    obs.configure_sink(None)
+    obs.clear()
+    metrics.registry().reset()
+    obs.enable()
+    yield
+    obs.disable()
+    obs.configure_sink(None)
+    obs.clear()
+    metrics.registry().reset()
+
+
+@pytest.fixture(autouse=True)
+def fresh_cache():
+    plan.clear_cache()
+    yield
+    plan.clear_cache()
+
+
+def _chain(threshold=3, max_groups=32):
+    """filter -> project -> aggregate over int32 columns: the canonical
+    fusible chain, integer-exact so fused/unfused must match bytewise."""
+    return plan.Plan([
+        plan.scan("k", "v"),
+        plan.filter(lambda v: v > jnp.int32(threshold), ["v"]),
+        plan.project({"d": (lambda k, v: v * jnp.int32(2) + k,
+                            ["k", "v"])}),
+        plan.aggregate(["k"], [("d", "sum")], max_groups),
+    ])
+
+
+def _inputs(n, seed=0):
+    r = np.random.default_rng(seed)
+    return {"k": r.integers(0, 8, n).astype(np.int32),
+            "v": r.integers(-10, 10, n).astype(np.int32)}
+
+
+EDGES = [0, 1, 7, 8, 9, 31, 32, 33]
+
+
+def _null_patterns(n):
+    yield None
+    yield np.ones(n, bool)
+    yield np.zeros(n, bool)
+    m = np.zeros(n, bool)
+    m[::2] = True
+    yield m
+    yield np.random.default_rng(n).random(n) > 0.4
+
+
+# ---------------------------------------------------------------------------
+# IR / fingerprint layer
+# ---------------------------------------------------------------------------
+
+def test_fingerprint_stable_across_rebuilds():
+    assert _chain().fingerprint == _chain().fingerprint
+    assert len(_chain().fingerprint) == 64
+    assert _chain().fp8 == _chain().fingerprint[:8]
+
+
+def test_literal_difference_changes_fingerprint():
+    """Two plans differing ONLY in a predicate literal are different
+    programs — callables hash by bytecode + consts + closure values."""
+    assert _chain(threshold=3).fingerprint != _chain(threshold=4).fingerprint
+    # closure-captured literal, same bytecode
+    def mk(t):
+        return plan.Plan([
+            plan.scan("v"),
+            plan.filter(lambda v: v > t, ["v"]),
+            plan.aggregate(["v"], [("v", "sum")], 8),
+        ])
+    assert mk(1).fingerprint != mk(2).fingerprint
+    assert mk(1).fingerprint == mk(1).fingerprint
+
+
+def test_param_difference_changes_fingerprint():
+    assert _chain(max_groups=32).fingerprint != \
+        _chain(max_groups=64).fingerprint
+
+
+def test_fuser_segments():
+    p = _chain()
+    assert p.segments(fused=True) == [[1, 2, 3]]
+    assert p.segments(fused=False) == [[1], [2], [3]]
+    assert p.max_fused(True) == 3
+
+
+def test_exchange_breaks_fusion():
+    p = plan.Plan([
+        plan.scan("k", "v"),
+        plan.filter(lambda v: v > 0, ["v"]),
+        plan.exchange("k", ("k", "v"), 2),
+        plan.aggregate(["k"], [("v", "sum")], 8),
+    ])
+    assert p.segments(fused=True) == [[1], [2], [3]]
+
+
+def test_aggregate_must_be_terminal():
+    with pytest.raises(ValueError):
+        plan.Plan([
+            plan.scan("k", "v"),
+            plan.aggregate(["k"], [("v", "sum")], 8),
+            plan.filter(lambda v: v > 0, ["v"]),
+        ])
+
+
+# ---------------------------------------------------------------------------
+# Byte-identity: fused vs node-at-a-time, edge rows x null patterns
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", EDGES)
+def test_fused_unfused_byte_identity(n, monkeypatch):
+    p = _chain()
+    ins = _inputs(n, seed=n)
+    for mask in _null_patterns(n):
+        monkeypatch.delenv("SRJ_TPU_PLAN_FUSE", raising=False)
+        out_f = plan.execute(p, ins, mask=mask)
+        monkeypatch.setenv("SRJ_TPU_PLAN_FUSE", "0")
+        out_n = plan.execute(p, ins, mask=mask)
+        monkeypatch.delenv("SRJ_TPU_PLAN_FUSE", raising=False)
+        assert len(out_f) == len(out_n) == 4
+        for a, b in zip(out_f, out_n):
+            assert np.asarray(a).dtype == np.asarray(b).dtype
+            assert np.array_equal(np.asarray(a), np.asarray(b)), (n, mask)
+
+
+@pytest.mark.parametrize("n", [1, 9, 33])
+def test_fused_matches_node_at_a_time_oracle(n):
+    """The fused program must equal literally calling the pipeline ops
+    one at a time on padded arrays (the pre-plan wiring)."""
+    ins = _inputs(n, seed=100 + n)
+    out = plan.execute(_chain(), ins)
+    b = shapes.bucket_rows(n)
+    k = np.zeros(b, np.int32); k[:n] = ins["k"]
+    v = np.zeros(b, np.int32); v[:n] = ins["v"]
+    live = np.zeros(b, bool); live[:n] = True
+    mask = live & (v > 3)
+    d = v * 2 + k
+    ref = pipeline.hash_aggregate_sum(
+        jnp.asarray(k), jnp.asarray(d), jnp.asarray(mask), 32)
+    for a, r in zip(out, ref):
+        assert np.array_equal(np.asarray(a), np.asarray(r))
+
+
+def test_execute_inlines_under_trace():
+    """Inside a jit trace the executor is a plain inlined tail call, so
+    jit-wrapped callers keep one outer program."""
+    p = _chain()
+    ins = _inputs(17, seed=5)
+
+    @jax.jit
+    def f(k, v):
+        return plan.execute(p, {"k": k, "v": v})
+
+    traced = f(jnp.asarray(ins["k"]), jnp.asarray(ins["v"]))
+    eager = plan.execute(p, ins)
+    # traced path runs unpadded; compare the live group prefix
+    ng = int(eager[3])
+    assert int(traced[3]) == ng
+    assert np.array_equal(np.asarray(traced[0])[:ng],
+                          np.asarray(eager[0])[:ng])
+    assert np.array_equal(np.asarray(traced[1])[:ng],
+                          np.asarray(eager[1])[:ng])
+
+
+# ---------------------------------------------------------------------------
+# Compile-count guard (the tentpole acceptance contract)
+# ---------------------------------------------------------------------------
+
+SIZES = sorted({1, 7} | set(range(3, 57, 3)))
+ROW_BUCKETS = sorted({shapes.bucket_rows(n) for n in SIZES})
+
+
+def _plan_compiles(fp8):
+    return [e for e in obs.events("compile")
+            if e.get("span") == f"plan[{fp8}]"]
+
+
+def test_one_program_per_plan_bucket(obs_on):
+    p = _chain()
+    for n in SIZES:
+        plan.execute(p, _inputs(n, seed=n))
+    got = len(_plan_compiles(p.fp8))
+    assert 0 < got <= len(ROW_BUCKETS), (got, ROW_BUCKETS)
+    # ... and the program cache agrees: one fused program per bucket
+    snap = plan.cache_stats()
+    assert snap["plans"] == 1
+    assert snap["programs"] <= len(ROW_BUCKETS)
+
+
+def test_repeat_burst_adds_zero_compiles(obs_on):
+    p = _chain()
+    for n in SIZES:
+        plan.execute(p, _inputs(n, seed=n))
+    obs.clear()
+    fresh = sorted({n + 1 for n in SIZES
+                    if shapes.bucket_rows(n + 1) == shapes.bucket_rows(n)})
+    for n in fresh:
+        plan.execute(p, _inputs(n, seed=1000 + n))
+    assert len(_plan_compiles(p.fp8)) == 0
+    # every fresh submission was a cache hit
+    assert plan.cache_stats()["hits"] >= len(fresh)
+
+
+def test_fused_cuts_dispatches(obs_on, monkeypatch):
+    """The headline: a 4-node chain fused costs 1 dispatch per
+    submission vs 3 unfused — >= 3x fewer on the same ragged grid."""
+    p = _chain()
+    sizes = [5, 9, 14, 20, 33, 41]
+    d0 = plan.dispatch_totals()["dispatches"]
+    for n in sizes:
+        plan.execute(p, _inputs(n, seed=n))
+    fused_d = plan.dispatch_totals()["dispatches"] - d0
+    monkeypatch.setenv("SRJ_TPU_PLAN_FUSE", "0")
+    d0 = plan.dispatch_totals()["dispatches"]
+    for n in sizes:
+        plan.execute(p, _inputs(n, seed=n))
+    unfused_d = plan.dispatch_totals()["dispatches"] - d0
+    assert fused_d == len(sizes)
+    assert unfused_d == 3 * len(sizes)
+
+
+def test_fuse_toggle_is_part_of_cache_key(monkeypatch):
+    """Flipping SRJ_TPU_PLAN_FUSE must not replay programs compiled in
+    the other mode (segment boundaries differ)."""
+    p = _chain()
+    ins = _inputs(9, seed=7)
+    plan.execute(p, ins)
+    h0 = plan.cache_stats()["hits"]
+    monkeypatch.setenv("SRJ_TPU_PLAN_FUSE", "0")
+    plan.execute(p, ins)
+    assert plan.cache_stats()["hits"] == h0   # miss, not a stale hit
+
+
+# ---------------------------------------------------------------------------
+# LRU + metrics + healthz
+# ---------------------------------------------------------------------------
+
+def test_lru_eviction(monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_PLAN_CACHE", "2")
+    p = _chain()
+    for n in (8, 16, 32, 64):          # 4 distinct buckets, capacity 2
+        plan.execute(p, _inputs(n, seed=n))
+    snap = plan.cache_stats()
+    assert snap["programs"] <= 2
+    assert snap["evictions"] >= 2
+    # evicted bucket recompiles: oldest-first went away
+    m0 = snap["misses"]
+    plan.execute(p, _inputs(8, seed=8))
+    assert plan.cache_stats()["misses"] == m0 + 1
+
+
+def test_metrics_and_healthz(obs_on):
+    p = _chain()
+    plan.execute(p, _inputs(9, seed=1))
+    plan.execute(p, _inputs(9, seed=2))
+    snap = metrics.registry().snapshot()
+    assert _total(snap, "srj_tpu_plan_cache_misses_total") >= 1
+    assert _total(snap, "srj_tpu_plan_cache_hits_total") >= 1
+    assert _total(snap, "srj_tpu_plan_dispatches_total") >= 2
+    # collect hooks publish the gauges at scrape time
+    text = metrics.format_prometheus()
+    assert "srj_tpu_plan_cached_programs" in text
+    assert "srj_tpu_plan_fused_nodes" in text
+    doc = exporter._healthz()
+    assert doc["plans"]["programs"] >= 1
+    assert doc["plans"]["fuse"] is True
+    assert doc["plans"]["fused_nodes"][p.fp8] == 3
+
+
+def _total(snap, name):
+    vals = snap.get(name, {}).get("values", {})
+    return sum(v for v in vals.values() if isinstance(v, (int, float)))
+
+
+def test_spans_stamp_plan_attribution(obs_on):
+    p = _chain()
+    plan.execute(p, _inputs(9, seed=3))
+    evs = [e for e in obs.events(kind="span")
+           if e["name"] == f"plan[{p.fp8}]"]
+    assert evs
+    ev = evs[-1]
+    assert ev["plan"] == p.fp8
+    assert ev["nodes"] == 3
+    assert ev["fused"] == 3
+    assert ev["bucket"] == 16          # shapes.note stamped the pad
+    # ... and the profile grows a plan column from exactly this event
+    from spark_rapids_jni_tpu.obs import costmodel
+    led = costmodel.replay(obs.events(kind="span"))
+    row = next(r for r in led.profile(ceiling=100.0)
+               if r["op"] == f"plan[{p.fp8}]")
+    assert row["plan"] == p.fp8
+    assert "plan" in costmodel.render_profile([row]).splitlines()[0]
+
+
+def test_run_program_covers_unbucketed_aggregate(obs_on):
+    """The bugfix: hash_aggregate_table's unbucketed entry now runs
+    under the plan machinery — same resilience op name, same span."""
+    r = np.random.default_rng(9)
+    t = Table((Column.from_numpy(r.integers(0, 5, 21).astype(np.int32),
+                                 INT32),
+               Column.from_numpy(r.integers(-9, 9, 21).astype(np.int32),
+                                 INT32)))
+    res, have, ng = pipeline.hash_aggregate_table(
+        t, [0], [(1, "sum")], 16, bucket=None)
+    assert int(ng) > 0
+    evs = [e for e in obs.events(kind="span")
+           if e["name"].startswith("plan[")]
+    assert evs, "unbucketed aggregate did not run under a plan span"
+    # both entries share ONE plan identity per (keys, measures, capacity)
+    res_b, _, ng_b = pipeline.hash_aggregate_table(
+        t, [0], [(1, "sum")], 16)
+    evs_b = [e for e in obs.events(kind="span")
+             if e["name"].startswith("plan[")]
+    assert {e["name"] for e in evs_b} == {evs[0]["name"]}
+    assert int(ng_b) == int(ng)
+    for ca, cb in zip(res.columns, res_b.columns):
+        assert ca.to_pylist() == cb.to_pylist()
+
+
+# ---------------------------------------------------------------------------
+# Serve integration: coalescing survives the fingerprint-bearing sig
+# ---------------------------------------------------------------------------
+
+def _snap_total(name):
+    vals = metrics.registry().snapshot().get(name, {}).get("values", {})
+    return sum(v for v in vals.values() if isinstance(v, (int, float)))
+
+
+def test_serve_burst_one_dispatch_per_plan_sig_group(obs_on):
+    from spark_rapids_jni_tpu.serve import ops as serve_ops
+    sched = serve.Scheduler()
+    try:
+        rng = np.random.default_rng(11)
+        clients = [serve.Client(sched, f"t{i}") for i in range(6)]
+        sizes = [100 + 2 * i for i in range(6)]
+        assert len({shapes.bucket_rows(n) for n in sizes}) == 1
+        futs = [c.aggregate(rng.integers(0, 16, n).astype(np.int32),
+                            rng.integers(-5, 5, n).astype(np.int32))
+                for c, n in zip(clients, sizes)]
+        assert sched.tick() == 6
+        for f in futs:
+            assert f.result(timeout=30)["num_groups"] > 0
+        # one (op, sig) group -> ONE mega-batch dispatch, and the sig's
+        # tail element is the plan fingerprint
+        assert _snap_total("srj_tpu_serve_batches_total") == 1
+        assert _snap_total("srj_tpu_serve_coalesced_requests_total") == 6
+        fp8 = serve_ops._agg_plan(pipeline.MAX_GROUPS).fp8
+        _, sig, _, _ = serve_ops.get("agg").validate(
+            {"keys": np.ones(4, np.int32), "values": np.ones(4, np.int32)})
+        assert sig[-1] == fp8
+    finally:
+        sched.close()
+
+
+def test_serve_distinct_plans_do_not_coalesce(obs_on):
+    """max_groups changes the plan fingerprint, so the two requests land
+    in different groups: two dispatches, not one."""
+    sched = serve.Scheduler()
+    try:
+        rng = np.random.default_rng(12)
+        c1, c2 = serve.Client(sched, "a"), serve.Client(sched, "b")
+        k = rng.integers(0, 4, 9).astype(np.int32)
+        v = rng.integers(-3, 3, 9).astype(np.int32)
+        f1 = c1.aggregate(k, v, max_groups=32)
+        f2 = c2.aggregate(k, v, max_groups=64)
+        assert sched.tick() == 2
+        f1.result(timeout=30), f2.result(timeout=30)
+        assert _snap_total("srj_tpu_serve_batches_total") == 2
+    finally:
+        sched.close()
