@@ -903,6 +903,74 @@ def bench_plan(num_batches):
     return res
 
 
+def bench_shuffle(num_rows):
+    """Shuffle-throughput axis on an 8-device mesh: the two-phase ragged
+    exchange versus the legacy pad-to-max protocol on a hot-key skew
+    (half the rows hash to one partition).  Records rows/s and padded
+    wire bytes per protocol — the padding figure is the tentpole claim:
+    the ragged protocol's wire envelope tracks true sizes where legacy
+    pads every bucket to the global max.  The sweep pins this axis to
+    the forced 8-device host-platform CPU mesh so every container
+    measures the same protocol grid; real-ICI figures need a pod run."""
+    from spark_rapids_jni_tpu import Column, Table
+    from spark_rapids_jni_tpu.parallel import make_mesh, shard_table
+    from spark_rapids_jni_tpu.parallel import shuffle as _shuffle
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        return {"error": f"shuffle axis needs 8 devices, "
+                         f"found {len(devs)}"}
+    mesh = make_mesh(devs[:8])
+    n = max(512, (num_rows // 64) * 64)
+    rng = np.random.default_rng(23)
+    hot = rng.random(n) < 0.5
+    key = np.where(hot, np.int64(7),
+                   rng.integers(0, 1 << 30, n)).astype(np.int64)
+    pay = rng.integers(-2**31, 2**31, n, dtype=np.int32)
+    ts = shard_table(Table((Column.from_numpy(key, INT64),
+                            Column.from_numpy(pay, INT32))), mesh)
+    reps = 5
+    res = {"num_rows": n, "n_devices": 8,
+           "platform": devs[0].platform}
+
+    def _one(label, ragged):
+        os.environ["SRJ_TPU_SHUFFLE_RAGGED"] = "1" if ragged else "0"
+        try:
+            out = _shuffle.shuffle_table_sharded(ts, [0], mesh)  # warm
+            jax.block_until_ready((out.rows, out.num_valid))
+            h0 = _shuffle._health()
+            t0 = time.perf_counter()
+            with _leg_span(f"shuffle_{label}"):
+                for _ in range(reps):
+                    out = _shuffle.shuffle_table_sharded(ts, [0], mesh)
+                    jax.block_until_ready((out.rows, out.num_valid))
+            wall = time.perf_counter() - t0
+            h1 = _shuffle._health()
+            sent = h1["send_bytes"] - h0["send_bytes"]
+            padded = (sum(h1["padded_bytes"].values())
+                      - sum(h0["padded_bytes"].values()))
+            res[f"shuffle_{label}_rows_per_s"] = round(reps * n / wall, 1)
+            res[f"shuffle_{label}_padded_bytes"] = int(padded // reps)
+            res[f"shuffle_{label}_wire_ratio"] = round(
+                (sent + padded) / max(1, sent), 3)
+            res[f"shuffle_{label}_route"] = h1["last"]["route"]
+            if ragged:
+                res["skew_factor"] = h1["last"]["skew"]
+            _log(f"shuffle {label}: "
+                 f"{res[f'shuffle_{label}_rows_per_s']:.0f} rows/s, "
+                 f"{res[f'shuffle_{label}_padded_bytes']} padded B/x, "
+                 f"route {res[f'shuffle_{label}_route']}")
+        finally:
+            os.environ.pop("SRJ_TPU_SHUFFLE_RAGGED", None)
+
+    _one("two_phase", True)
+    _one("legacy", False)
+    res["padding_improvement"] = round(
+        res["shuffle_legacy_padded_bytes"]
+        / max(1, res["shuffle_two_phase_padded_bytes"]), 2)
+    return res
+
+
 def bench_serve(num_requests, tenants=4, miss_rate=0.3):
     """Serving axis: sustained multi-tenant QPS plus submit-to-result
     latency percentiles through the continuous-batching scheduler
@@ -1136,6 +1204,8 @@ def _run_axis(axis: str):
             res = bench_serve(int(n))
         elif kind == "plan":
             res = bench_plan(int(n))
+        elif kind == "shuffle":
+            res = bench_shuffle(int(n))
         elif kind == "kernels":
             res = bench_kernels(int(n))
         elif kind == "nostrings":
@@ -1270,15 +1340,19 @@ def _verify_variable(num_rows, num_cols=155, native_rows=50_000):
           flush=True)
 
 
-def _axis_subprocess(axis: str, timeout_s: int = 540, attempts: int = 3):
+def _axis_subprocess(axis: str, timeout_s: int = 540, attempts: int = 3,
+                     env=None):
     """Each axis gets a fresh process (and TPU client): an OOM on one axis
     cannot poison the allocator state of the next.  Failed axes retry in
     a fresh process (with a settling pause): the shared axon relay
     intermittently rejects transfers with spurious InvalidArgument
     errors that clear within a minute — observed 2026-07-31 with the
-    same binary passing/failing across minutes."""
+    same binary passing/failing across minutes.  ``env`` overlays extra
+    variables onto the child environment (the shuffle axis pins itself
+    to the 8-device host-platform mesh this way)."""
     import subprocess
     cmd = [sys.executable, os.path.abspath(__file__), "--one", axis]
+    run_env = {**os.environ, **env} if env else None
     last = None
     backoff = [30, 180]        # bad relay windows last minutes: spread
     for attempt in range(attempts):
@@ -1294,7 +1368,8 @@ def _axis_subprocess(axis: str, timeout_s: int = 540, attempts: int = 3):
             time.sleep(wait)
         try:
             proc = subprocess.run(cmd, capture_output=True, text=True,
-                                  timeout=timeout_s, cwd=os.path.dirname(
+                                  timeout=timeout_s, env=run_env,
+                                  cwd=os.path.dirname(
                                       os.path.abspath(__file__)))
         except subprocess.TimeoutExpired:
             last = {"axis": axis, "error": f"timeout after {timeout_s}s"}
@@ -1312,6 +1387,52 @@ def _axis_subprocess(axis: str, timeout_s: int = 540, attempts: int = 3):
         last = {"axis": axis, "error": f"exit {proc.returncode}: "
                 + " | ".join(tail)}
     return last
+
+
+def _write_multichip_round(sh, history_dir="."):
+    """Persist a shuffle-axis record as the next ``MULTICHIP_r*.json``
+    round — the pod-family history ``ci/regress_gate.py`` gates
+    round-over-round (``rows/s`` up is better, ``padded bytes`` down).
+    Off-TPU rounds stamp ``comparable: false``, the same skip protocol
+    the BENCH family uses, so CPU-mesh wiring figures never gate
+    against a real pod round."""
+    import glob as _glob
+    import re as _re
+    nums = [int(m.group(1)) for p in _glob.glob(
+                os.path.join(history_dir, "MULTICHIP_r*.json"))
+            for m in [_re.search(r"MULTICHIP_r(\d+)\.json$", p)] if m]
+    path = os.path.join(
+        history_dir, f"MULTICHIP_r{max(nums, default=0) + 1:02d}.json")
+    doc = {
+        "n_devices": sh.get("n_devices", 8),
+        "platform": sh.get("platform", "cpu"),
+        "parsed": {
+            "metric": "shuffle_two_phase_rows_per_s",
+            "value": sh["shuffle_two_phase_rows_per_s"],
+            "unit": "rows/s",
+            "secondary": [
+                {"metric": "shuffle_legacy_rows_per_s",
+                 "value": sh["shuffle_legacy_rows_per_s"],
+                 "unit": "rows/s"},
+                {"metric": "shuffle_two_phase_padded_bytes",
+                 "value": sh["shuffle_two_phase_padded_bytes"],
+                 "unit": "bytes"},
+                {"metric": "shuffle_legacy_padded_bytes",
+                 "value": sh["shuffle_legacy_padded_bytes"],
+                 "unit": "bytes"},
+            ],
+        },
+        "skew_factor": sh.get("skew_factor"),
+        "route": sh.get("shuffle_two_phase_route"),
+        "padding_improvement": sh.get("padding_improvement"),
+    }
+    if doc["platform"] != "tpu":
+        doc["comparable"] = False
+        doc["parsed"]["comparable"] = False
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    return path
 
 
 def _collect_leg_failures(results):
@@ -1407,16 +1528,16 @@ def main():
     # rest of the sweep
     requeue = []
     if "calibration_GBps" not in results["calibration"]:
-        requeue.append(("calibration", None, "calibrate"))
+        requeue.append(("calibration", None, "calibrate", None))
 
-    def _run(key, axis, post=None):
-        out = _axis_subprocess(axis)
+    def _run(key, axis, post=None, env=None):
+        out = _axis_subprocess(axis, env=env)
         if post:
             post(out)
         _annotate(out)
         results.setdefault(key, []).append(out)
         if "error" in out or "leg_errors" in out:
-            requeue.append((key, len(results[key]) - 1, axis))
+            requeue.append((key, len(results[key]) - 1, axis, env))
         _flush()  # partial results survive a driver timeout
 
     def _badness(out):
@@ -1450,6 +1571,18 @@ def main():
     # regress gate sees the program/dispatch figures every round
     _run("plan_fusion", "plan:28")
 
+    # pod-scale shuffle axis: the two-phase ragged exchange vs the
+    # legacy pad-to-max protocol on a skewed 8-way exchange.  Pinned to
+    # the 8-device host-platform CPU mesh so every container measures
+    # the same protocol grid (a single chip has no 8-way mesh); the
+    # round lands in MULTICHIP_r*.json, stamped comparable:false off-TPU
+    _run("shuffle_exchange", "shuffle:100000", env={
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                      + " --xla_force_host_platform_device_count=8")
+        .strip(),
+    })
+
     if not args.quick:
         # the reference's mixed axes: 155 cols with strings at 1M rows
         # (it skips strings >1M for memory, benchmarks/row_conversion.cpp:105)
@@ -1463,9 +1596,9 @@ def main():
         # without the bucket policy
         _run("ragged_stream", "ragged:28")
 
-    for key, idx, axis in requeue:
+    for key, idx, axis, env in requeue:
         _log(f"requeue {axis}: re-running failed axis at end of sweep")
-        out = _axis_subprocess(axis)
+        out = _axis_subprocess(axis, env=env)
         if key != "calibration" and idx < len(results[key]) \
                 and _badness(out) >= _badness(results[key][idx]):
             continue                # keep the (no worse) original record
@@ -1501,6 +1634,16 @@ def main():
                     out["leg_errors"] = fe
                 results[key][idx] = _annotate(out)
         _flush()
+
+    sh = next((r for r in results.get("shuffle_exchange", [])
+               if isinstance(r, dict)
+               and r.get("shuffle_two_phase_rows_per_s")), None)
+    if sh is not None:
+        try:
+            _log(f"multichip round written: {_write_multichip_round(sh)}")
+        except Exception as e:
+            _log(f"multichip round write skipped: "
+                 f"{type(e).__name__}: {e}")
 
     leg_failures = _collect_leg_failures(results)
     fixed = results.get("fixed_width", [])

@@ -168,6 +168,63 @@ sys.exit(0 if transfers == 1 else 1)
 PY
 rm -f "$STAGING_EVENTS"
 
+# shuffle smoke: a skewed exchange on the forced 8-device CPU mesh with
+# the exporter live — assert from a real /metrics scrape that the
+# two-phase ragged protocol's padded wire bytes undercut the legacy
+# pad-to-max exchange on the same skew, and that a warm repeat burst
+# at an already-seen capacity grid point recompiles NOTHING
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+XLA_FLAGS="--xla_force_host_platform_device_count=8" python - <<'PY'
+import os, re, urllib.request
+import numpy as np
+import jax
+from spark_rapids_jni_tpu import Column, INT32, INT64, Table, obs
+from spark_rapids_jni_tpu.obs import exporter
+from spark_rapids_jni_tpu.parallel import (
+    make_mesh, shard_table, shuffle_table_sharded)
+
+obs.enable()
+port = exporter.start(0)
+assert port, "exporter failed to bind"
+mesh = make_mesh(jax.devices()[:8])
+rng = np.random.default_rng(5)
+n = 8 * 512
+hot = rng.random(n) < 0.5   # half the rows hash to one hot partition
+key = np.where(hot, 7, rng.integers(0, 1 << 30, n)).astype(np.int64)
+ts = shard_table(
+    Table((Column.from_numpy(key, INT64),
+           Column.from_numpy(rng.integers(-9, 9, n).astype(np.int32),
+                             INT32))), mesh)
+res = shuffle_table_sharded(ts, key_cols=[0], mesh=mesh)       # cold
+obs.clear()
+for _ in range(3):                                             # warm
+    res = shuffle_table_sharded(ts, key_cols=[0], mesh=mesh)
+jax.block_until_ready((res.rows, res.num_valid))
+warm = [e for e in obs.events("compile")
+        if e.get("span") == "shuffle_table_sharded"]
+assert not warm, f"warm shuffle burst recompiled: {warm}"
+os.environ["SRJ_TPU_SHUFFLE_RAGGED"] = "0"
+shuffle_table_sharded(ts, key_cols=[0], mesh=mesh)
+del os.environ["SRJ_TPU_SHUFFLE_RAGGED"]
+body = urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+
+def padded(route):
+    ms = [m for m in re.finditer(
+        r'srj_tpu_shuffle_padded_bytes_total\{([^}]*)\}\s+([0-9.eE+-]+)',
+        body) if f'route="{route}"' in m.group(1)]
+    assert ms, f"no padded-bytes series for route={route}"
+    return sum(float(m.group(2)) for m in ms)
+
+ragged_route = "staged" if 'route="staged"' in body else "collective"
+per_exchange = padded(ragged_route) / 4    # cold + 3 warm exchanges
+legacy = padded("legacy")                  # 1 legacy exchange
+assert per_exchange < legacy, (per_exchange, legacy)
+print(f"shuffle smoke: padded bytes/exchange {per_exchange:.0f} "
+      f"({ragged_route}) < {legacy:.0f} (legacy), warm burst 0 compiles")
+exporter.stop()
+PY
+
 # live-telemetry smoke: run a workload with the HTTP exporter on, scrape
 # /metrics over a real socket mid-process, and assert the span counters
 # the workload must have produced are nonzero — proves the registry is

@@ -33,6 +33,12 @@ it runs without a TPU) are skipped by auto-discovery on both sides of
 the comparison pair: their figures measure kernel wiring, not hardware,
 so gating them against a real round in either direction is noise.
 
+``MULTICHIP_r*.json`` rounds (the pod dryrun / shuffle-bench family)
+gate round-over-round under the same skip protocol; legacy status-only
+rounds with no parsed metrics are never comparable, and fewer than two
+comparable multichip rounds skips that section advisorily instead of
+failing discovery.
+
 Pure stdlib, no repo imports: the gate must run in a CI step even when
 the package itself is broken — that is half the point of a gate.
 """
@@ -57,6 +63,7 @@ LOWER_IS_BETTER_UNITS = {"s", "sec", "secs", "seconds", "ms", "us", "ns",
                          "gb", "gib", "programs", "dispatches"}
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+_MC_ROUND_RE = re.compile(r"MULTICHIP_r(\d+)\.json$")
 _ROOFLINE_RE = re.compile(r"^roofline_(.+)_pct_of_calibration$")
 
 
@@ -102,13 +109,20 @@ def round_metrics(doc: Dict) -> Dict[str, Dict]:
     return out
 
 
-def discover_rounds(history_dir: str) -> List[Tuple[int, str]]:
+def discover_rounds(history_dir: str, pattern: str = "BENCH_r*.json",
+                    regex: re.Pattern = _ROUND_RE) -> List[Tuple[int, str]]:
     rounds = []
-    for path in glob.glob(os.path.join(history_dir, "BENCH_r*.json")):
-        m = _ROUND_RE.search(os.path.basename(path))
+    for path in glob.glob(os.path.join(history_dir, pattern)):
+        m = regex.search(os.path.basename(path))
         if m:
             rounds.append((int(m.group(1)), path))
     return sorted(rounds)
+
+
+def discover_multichip_rounds(history_dir: str) -> List[Tuple[int, str]]:
+    """MULTICHIP_r*.json rounds (the pod dryrun / shuffle-bench family),
+    same numbering convention as BENCH rounds."""
+    return discover_rounds(history_dir, "MULTICHIP_r*.json", _MC_ROUND_RE)
 
 
 def round_comparable(doc: Dict) -> bool:
@@ -128,6 +142,15 @@ def round_comparable(doc: Dict) -> bool:
     if isinstance(parsed, dict) and parsed.get("comparable") is False:
         return False
     return True
+
+
+def mc_round_comparable(doc: Dict) -> bool:
+    """Multichip rounds follow the same skip protocol as BENCH rounds,
+    with one extra rule: legacy-schema rounds — the bare dryrun status
+    records ``{n_devices, rc, ok, skipped, tail}`` with no parsed
+    metrics — are never comparable.  They predate the shuffle bench axis
+    and carry nothing to gate."""
+    return round_comparable(doc) and bool(round_metrics(doc))
 
 
 def lower_is_better(unit: str) -> bool:
@@ -283,6 +306,34 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.baseline or os.path.join(args.history, "BASELINE.json"))
     rows += compare(cur, base, "published", args.tolerance)
 
+    # multichip rounds gate round-over-round too, under the same skip
+    # protocol; unlike the BENCH family their absence is advisory (pods
+    # are scarcer than chips), so < 2 comparable rounds skips the
+    # section instead of failing discovery
+    mc_label = None
+    try:
+        mc_docs, mc_skipped = [], []
+        for _, path in discover_multichip_rounds(args.history):
+            doc = load_round(path)
+            (mc_docs if mc_round_comparable(doc) else mc_skipped).append(
+                (os.path.basename(path), doc))
+        if mc_skipped:
+            print("regress_gate: skipping non-comparable multichip "
+                  "round(s): " + ", ".join(n for n, _ in mc_skipped),
+                  file=sys.stderr)
+        if len(mc_docs) >= 2:
+            (mcp_label, mcp_doc), (mc_label, mc_doc) = (
+                mc_docs[-2], mc_docs[-1])
+            rows += compare(round_metrics(mc_doc), round_metrics(mcp_doc),
+                            mcp_label, args.tolerance)
+        else:
+            print(f"regress_gate: {len(mc_docs)} comparable multichip "
+                  "round(s) — skipping the multichip section (advisory)",
+                  file=sys.stderr)
+    except (OSError, ValueError) as e:
+        print(f"regress_gate: multichip discovery failed: {e} "
+              "(advisory, continuing)", file=sys.stderr)
+
     # the shared drift-sentinel reference rides along advisorily in BOTH
     # modes: its rows are reported but never counted toward failure
     ref = reference_metrics(
@@ -296,6 +347,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     print(f"perf regression gate: {cur_label} vs {prev_label}"
           + (" + published baseline" if base else "")
+          + (f" + multichip {mc_label}" if mc_label else "")
           + (" + perf reference (advisory)" if ref else ""))
     print(format_rows(rows + ref_rows, args.tolerance))
     ref_regressed = [r for r in ref_rows if r["regressed"]]

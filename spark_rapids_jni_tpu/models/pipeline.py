@@ -439,8 +439,11 @@ def distributed_query_step(mesh, axis_name="data",
 
     pln = _plan.Plan([
         _plan.scan("sold_date", "quantity"),
-        _plan.exchange("sold_date", ("sold_date", "quantity"),
-                       num_parts, axis_name, capacity_factor),
+        # payload auto-derived from downstream references: the plan
+        # fingerprint is identical to the old hand-declared tuple
+        _plan.exchange("sold_date", num_parts=num_parts,
+                       axis_name=axis_name,
+                       capacity_factor=capacity_factor),
         _plan.aggregate(["sold_date"], [("quantity", "sum")], MAX_GROUPS),
     ])
     body = _plan.as_traced(pln, ("sold_date", "quantity"),
@@ -486,8 +489,9 @@ def distributed_q72_step(mesh, axis_name="data",
 
     pln = _plan.Plan([
         _plan.scan("item_key", "week", "quantity"),
-        _plan.exchange("item_key", ("item_key", "week", "quantity"),
-                       num_parts, axis_name, capacity_factor),
+        _plan.exchange("item_key", num_parts=num_parts,
+                       axis_name=axis_name,
+                       capacity_factor=capacity_factor),
         _plan.join("build_item", "item_key", build_payload="build_inv",
                    out="inv_q", how="dup", expansion=join_expansion),
         _plan.filter(lambda inv_q, quantity: inv_q < quantity,
@@ -545,8 +549,9 @@ def distributed_q95_step(mesh, axis_name="data",
 
     pln = _plan.Plan([
         _plan.scan("order_key", "ship_date", "net"),
-        _plan.exchange("order_key", ("order_key", "ship_date", "net"),
-                       num_parts, axis_name, capacity_factor),
+        _plan.exchange("order_key", num_parts=num_parts,
+                       axis_name=axis_name,
+                       capacity_factor=capacity_factor),
         _plan.join("returned_orders", "order_key", how="semi"),
         _plan.aggregate(["ship_date"],
                         [("order_key", "count"), ("net", "sum"),
@@ -1540,7 +1545,12 @@ def distributed_q6_table_step(mesh, axis_name="data",
 
     def step(tbl, items):
         n_local = tbl.num_rows
-        capacity = max(8, int(capacity_factor * n_local / num_parts))
+        # pow-2 capacity grid: static shape, so the grid is what
+        # bounds the compiled exchange variants over shard sizes
+        from spark_rapids_jni_tpu.parallel.shuffle import \
+            exchange_capacity as _xcap
+        capacity = _xcap(int(capacity_factor * n_local / num_parts),
+                         num_parts)
         shuffled, valids, _slot_valid, x_overflow = \
             _exchange_with_validity(tbl, 0, num_parts, capacity,
                                     axis_name)
@@ -1980,7 +1990,7 @@ def _exchange_with_validity(table: Table, key_idx: int, num_parts: int,
     pairs (each pair rides as two payload words and is rebuilt on the
     receive side), and at most 31 of them (one validity bit each in the
     int32 flag word)."""
-    from spark_rapids_jni_tpu.parallel.shuffle import bucket_exchange
+    from spark_rapids_jni_tpu.parallel import shuffle as _shuffle
     from spark_rapids_jni_tpu.table import pack_bools
     cols = table.columns
     if len(cols) > 31:
@@ -2003,7 +2013,14 @@ def _exchange_with_validity(table: Table, key_idx: int, num_parts: int,
             spans.append((len(words), 1))
             words.append(c.data)
     payload = jnp.stack(words + [flags], axis=1)
-    exchange = bucket_exchange(num_parts, capacity, axis_name)
+    # two-phase size-exchange body by default (byte-identical; kill
+    # switch SRJ_TPU_SHUFFLE_RAGGED=0 restores the legacy body)
+    if _shuffle.ragged_enabled():
+        exchange = _shuffle.two_phase_exchange(num_parts, capacity,
+                                               axis_name)
+    else:
+        exchange = _shuffle.bucket_exchange(num_parts, capacity,
+                                            axis_name)
     recv, slot_valid, _, overflow = exchange(payload, pids)
     r_flags = recv[:, len(words)]
     valids = [slot_valid & ((r_flags & (1 << j)) != 0)
@@ -2054,7 +2071,12 @@ def distributed_q72_table_step(mesh, axis_name="data",
 
     def step(tbl, build):
         n_local = tbl.num_rows
-        capacity = max(8, int(capacity_factor * n_local / num_parts))
+        # pow-2 capacity grid: static shape, so the grid is what
+        # bounds the compiled exchange variants over shard sizes
+        from spark_rapids_jni_tpu.parallel.shuffle import \
+            exchange_capacity as _xcap
+        capacity = _xcap(int(capacity_factor * n_local / num_parts),
+                         num_parts)
         shuffled, valids, _slot_valid, x_overflow = _exchange_with_validity(
             tbl, 0, num_parts, capacity, axis_name)
         r_item, r_week, r_qty = shuffled.columns
@@ -2134,7 +2156,12 @@ def distributed_q95_table_step(mesh, axis_name="data",
 
     def step(tbl, returned):
         n_local = tbl.num_rows
-        capacity = max(8, int(capacity_factor * n_local / num_parts))
+        # pow-2 capacity grid: static shape, so the grid is what
+        # bounds the compiled exchange variants over shard sizes
+        from spark_rapids_jni_tpu.parallel.shuffle import \
+            exchange_capacity as _xcap
+        capacity = _xcap(int(capacity_factor * n_local / num_parts),
+                         num_parts)
         shipped, _valids, _slot_valid, x_overflow = _exchange_with_validity(
             tbl, 0, num_parts, capacity, axis_name)
         # semi mask requires a valid order key, which already carries

@@ -97,10 +97,16 @@ def host_trace_sink(base_path: Optional[str] = None,
     return path
 
 
-def global_mesh(axis_name: str = "data") -> Mesh:
+def global_mesh(axis_name: str = "data", devices=None) -> Mesh:
     """1-D mesh over every device of every process (ICI-major device
-    order, the default ``jax.devices()`` order)."""
-    return make_mesh(jax.devices(), axis_name)
+    order, the default ``jax.devices()`` order).  ``devices`` overrides
+    the global device list for hermetic callers — the multichip dryrun
+    resolves its self-provisioned CPU devices explicitly (touching
+    ``jax.devices()`` could initialize a broken default backend) but
+    still builds its mesh HERE, so the dryrun exercises the same
+    mesh-construction path the pod shuffle runs on."""
+    return make_mesh(jax.devices() if devices is None else devices,
+                     axis_name)
 
 
 def stage_table_global(host_columns: Sequence[np.ndarray],

@@ -162,17 +162,94 @@ def join(build_keys: str, probe: str, build_payload: Optional[str] = None,
                  fold_matched=bool(fold_matched), expansion=int(expansion))
 
 
-def exchange(key: str, payload: Sequence[str], num_parts: int,
-             axis_name: str = "data",
+def exchange(key: str, payload: Optional[Sequence[str]] = None,
+             num_parts: int = 0, axis_name: str = "data",
              capacity_factor: float = 8.0) -> Node:
-    """``bucket_exchange`` all-to-all over ``payload`` columns, routed by
-    the murmur3 hash of ``key`` (Spark's int hash contract).  Only valid
-    in sharded plans (the body must run under ``shard_map``); replaces
-    the stream with the received rows, the mask with slot validity, and
-    ORs the bucket-overflow flag into the plan's overflow."""
-    return _node("exchange", key=str(key), payload=tuple(payload),
+    """Bucket all-to-all over ``payload`` columns, routed by the murmur3
+    hash of ``key`` (Spark's int hash contract).  Only valid in sharded
+    plans (the body must run under ``shard_map``); replaces the stream
+    with the received rows, the mask with slot validity, and ORs the
+    bucket-overflow flag into the plan's overflow.
+
+    ``payload=None`` (the default) auto-derives the payload at plan
+    construction: the stream columns that exist upstream of the exchange
+    AND are referenced by any downstream node, in stream order — exactly
+    the tuple a careful author would declare, so the fingerprint matches
+    the hand-declared plan.  The body is the two-phase size-exchange
+    protocol (``parallel.shuffle.two_phase_exchange``) unless
+    ``SRJ_TPU_SHUFFLE_RAGGED=0`` restores the legacy body."""
+    if num_parts <= 0:
+        raise ValueError("exchange needs num_parts >= 1")
+    return _node("exchange", key=str(key),
+                 payload=tuple(payload) if payload is not None else None,
                  num_parts=int(num_parts), axis_name=str(axis_name),
                  capacity_factor=float(capacity_factor))
+
+
+def _derive_exchange_payloads(nodes: Sequence[Node]) -> Tuple[Node, ...]:
+    """Resolve ``payload=None`` exchange nodes to the concrete column
+    tuple: stream columns live at the exchange point, restricted to those
+    a downstream node references (the exchange key always rides).  Runs
+    at Plan construction — BEFORE the fingerprint is computed — so an
+    auto-derived plan fingerprints identically to its hand-declared
+    twin.  Processed back-to-front so a later exchange's derived payload
+    feeds an earlier one's reference scan."""
+    out = list(nodes)
+    for i in range(len(out) - 1, -1, -1):
+        n = out[i]
+        if n.kind != "exchange" or n.get("payload") is not None:
+            continue
+        # stream columns in existence order at the exchange point;
+        # join build sides are side inputs, never stream columns
+        stream: List[str] = []
+
+        def _add(name):
+            if name is not None and name not in stream:
+                stream.append(name)
+
+        for m in out[:i]:
+            if m.kind == "scan":
+                for c in m.get("columns"):
+                    _add(c)
+            elif m.kind == "project":
+                for name, _ in m.get("outputs"):
+                    _add(name)
+            elif m.kind == "join" and m.get("how") != "semi":
+                _add(m.get("out"))
+                _add(m.get("out_matched"))
+        # downstream references, skipping names generated downstream
+        refs = {n.get("key")}
+        gen: set = set()
+        for m in out[i + 1:]:
+            if m.kind == "filter":
+                need = list(m.get("refs"))
+            elif m.kind == "project":
+                need = [r for _, (_, rs) in m.get("outputs") for r in rs]
+            elif m.kind == "join":
+                need = [m.get("probe")]
+            elif m.kind == "aggregate":
+                need = (list(m.get("keys"))
+                        + [r for r, _ in m.get("measures")])
+            elif m.kind == "exchange":
+                need = [m.get("key")] + list(m.get("payload") or ())
+            else:
+                need = []
+            refs |= {r for r in need if r is not None and r not in gen}
+            if m.kind == "project":
+                gen |= {name for name, _ in m.get("outputs")}
+            elif m.kind == "join":
+                gen |= {m.get("out"), m.get("out_matched")} - {None}
+        payload = tuple(c for c in stream if c in refs)
+        if not payload:
+            raise ValueError(
+                f"exchange on {n.get('key')!r}: cannot auto-derive a "
+                "payload — no upstream stream column is referenced "
+                "downstream")
+        out[i] = _node("exchange", key=n.get("key"), payload=payload,
+                       num_parts=n.get("num_parts"),
+                       axis_name=n.get("axis_name"),
+                       capacity_factor=n.get("capacity_factor"))
+    return tuple(out)
 
 
 # ---------------------------------------------------------------------------
@@ -246,6 +323,9 @@ class Plan:
                 if n.kind == "aggregate"]
         if aggs and aggs[0] != len(self.nodes) - 1:
             raise ValueError("aggregate must be the terminal node")
+        if any(n.kind == "exchange" and n.get("payload") is None
+               for n in self.nodes):
+            self.nodes = _derive_exchange_payloads(self.nodes)
         self._fp: Optional[str] = None
 
     # -- identity ----------------------------------------------------------
@@ -418,19 +498,30 @@ def _emit_aggregate(node: Node, st: Dict) -> None:
 
 def _emit_exchange(node: Node, st: Dict) -> None:
     from spark_rapids_jni_tpu.ops.hashing import murmur3_hash, pmod
-    from spark_rapids_jni_tpu.parallel.shuffle import bucket_exchange
+    from spark_rapids_jni_tpu.parallel import shuffle as _shuffle
     from spark_rapids_jni_tpu.table import Column, INT32
     key = _col(st, node.get("key"))
     refs = node.get("payload")
     num_parts = node.get("num_parts")
     n_local = key.shape[0]
     # per-(sender, target) bucket slack: group-key skew concentrates
-    # rows, so default well above the uniform expectation
-    capacity = max(8, int(node.get("capacity_factor")
-                          * n_local / num_parts))
+    # rows, so default well above the uniform expectation.  Quantized up
+    # the pow-2 capacity grid: capacity is a static shape, so the grid
+    # is what keeps repeat bursts over varying shard sizes from
+    # compiling one exchange program per size.
+    capacity = _shuffle.exchange_capacity(
+        int(node.get("capacity_factor") * n_local / num_parts), num_parts)
     pids = pmod(murmur3_hash([Column(INT32, key)]), num_parts)
     payload = jnp.stack([_col(st, r) for r in refs], axis=1)
-    body = bucket_exchange(num_parts, capacity, node.get("axis_name"))
+    # the two-phase body's size all_gather subsumes the legacy second
+    # counts collective; byte-identical either way (kill switch:
+    # SRJ_TPU_SHUFFLE_RAGGED=0)
+    if _shuffle.ragged_enabled():
+        body = _shuffle.two_phase_exchange(num_parts, capacity,
+                                           node.get("axis_name"))
+    else:
+        body = _shuffle.bucket_exchange(num_parts, capacity,
+                                        node.get("axis_name"))
     recv, valid, _, x_ovf = body(payload, pids)
     # payload columns rebind to the received rows; everything else
     # (join build sides — row counts independent of the stream) rides

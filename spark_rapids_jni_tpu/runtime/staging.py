@@ -408,6 +408,31 @@ def shard_table_staged(table: Table, mesh, axis_name: str = "data") -> Table:
     return Table(tuple(cols))
 
 
+def stage_ragged_shards(per_device_bufs, mesh, axis_name: str = "data"):
+    """Stage already-routed ragged per-device buffer lists: one arena
+    sub-blob per mesh device (the ``shard_table_staged`` transport, minus
+    the uniform-slicing step — the caller did the routing and each
+    device's buffers may have *different* shapes).
+
+    Returns ``(staged, wire_bytes)``: ``staged[d]`` is the list of
+    committed device arrays for device ``d`` in ``mesh.devices.flat``
+    order, and ``wire_bytes`` is the total quantized blob length that
+    actually crossed the host→device boundary — the pow-2 envelope of
+    the true payload, which is what the shuffle's padded-byte accounting
+    reports."""
+    devs = list(mesh.devices.flat)
+    if len(per_device_bufs) != len(devs):
+        raise ValueError(
+            f"stage_ragged_shards: {len(per_device_bufs)} buffer lists "
+            f"for a {len(devs)}-device mesh")
+    staged, wire = [], 0
+    for bufs, dev in zip(per_device_bufs, devs):
+        _, payload = _layout(bufs)
+        wire += _blob_len(payload) if payload else 0
+        staged.append(stage_arrays(bufs, device=dev))
+    return staged, wire
+
+
 # ---------------------------------------------------------------------------
 # Double-buffered prefetch
 # ---------------------------------------------------------------------------

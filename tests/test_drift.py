@@ -418,6 +418,53 @@ def test_regress_gate_reference_advisory(drift_env, tmp_path):
     assert rc == 3
 
 
+def test_regress_gate_multichip_rounds(tmp_path):
+    """MULTICHIP_r*.json rounds gate round-over-round with the same
+    skip protocol: legacy status-only rounds and comparable:false
+    rounds are skipped, < 2 comparable rounds is advisory (exit 0), a
+    real multichip regression fails enforce mode."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "regress_gate", os.path.join(os.path.dirname(__file__),
+                                     os.pardir, "ci", "regress_gate.py"))
+    gate = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gate)
+
+    def bench(i, v):
+        (tmp_path / f"BENCH_r{i:02d}.json").write_text(json.dumps(
+            {"parsed": {"metric": "tp", "value": v, "unit": "GB/s"}}))
+
+    def mc(i, doc):
+        (tmp_path / f"MULTICHIP_r{i:02d}.json").write_text(
+            json.dumps(doc))
+
+    bench(1, 5.0)
+    bench(2, 5.0)
+    # legacy dryrun status record: no parsed metrics -> never comparable
+    mc(1, {"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+           "tail": ""})
+    assert not gate.mc_round_comparable(
+        gate.load_round(str(tmp_path / "MULTICHIP_r01.json")))
+    # one comparable round only -> advisory skip, BENCH pair still gates
+    mc(2, {"parsed": {"metric": "shuffle_rows_per_s", "value": 100.0,
+                      "unit": "rows/s"}})
+    assert gate.main(["--history", str(tmp_path),
+                      "--mode", "enforce"]) == 0
+    # off-TPU round is skipped even though it parses
+    mc(3, {"comparable": False,
+           "parsed": {"metric": "shuffle_rows_per_s", "value": 1.0,
+                      "unit": "rows/s"}})
+    assert gate.main(["--history", str(tmp_path),
+                      "--mode", "enforce"]) == 0
+    # a second comparable round gates: 10x throughput drop fails
+    mc(4, {"parsed": {"metric": "shuffle_rows_per_s", "value": 10.0,
+                      "unit": "rows/s"}})
+    assert gate.main(["--history", str(tmp_path),
+                      "--mode", "enforce"]) == 3
+    assert gate.main(["--history", str(tmp_path),
+                      "--mode", "advisory"]) == 0
+
+
 # ---------------------------------------------------------------------------
 # Surfacing: scrape, healthz, profile column, Perfetto instants, serve
 # ---------------------------------------------------------------------------

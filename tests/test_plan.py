@@ -400,3 +400,60 @@ def test_serve_distinct_plans_do_not_coalesce(obs_on):
         assert _snap_total("srj_tpu_serve_batches_total") == 2
     finally:
         sched.close()
+
+
+# ---------------------------------------------------------------------------
+# Exchange payload auto-derivation (column-pruned shuffles)
+# ---------------------------------------------------------------------------
+
+def test_exchange_payload_derived_from_downstream_refs():
+    """exchange() with no payload ships exactly the columns downstream
+    nodes reference, and the fingerprint matches the hand-declared
+    equivalent — unchanged plans keep their compiled programs."""
+    MG = 4096
+    auto = plan.Plan([
+        plan.scan("sold_date", "quantity"),
+        plan.exchange("sold_date", num_parts=8),
+        plan.aggregate(["sold_date"], [("quantity", "sum")], MG),
+    ])
+    hand = plan.Plan([
+        plan.scan("sold_date", "quantity"),
+        plan.exchange("sold_date", ("sold_date", "quantity"), 8),
+        plan.aggregate(["sold_date"], [("quantity", "sum")], MG),
+    ])
+    assert auto.nodes[1].get("payload") == ("sold_date", "quantity")
+    assert auto.fingerprint == hand.fingerprint
+
+
+def test_exchange_payload_derivation_sees_through_join_chain():
+    """Derivation walks joins/filters/projects: the q72 shape ships all
+    three scanned columns, the q95 semi-join shape likewise — and
+    columns generated downstream (join outputs) are never shipped."""
+    MG = 4096
+    q72 = plan.Plan([
+        plan.scan("item_key", "week", "quantity"),
+        plan.exchange("item_key", num_parts=8),
+        plan.join("build_item", "item_key", build_payload="build_inv",
+                  out="inv_q", how="dup", expansion=4),
+        plan.filter(lambda inv_q, quantity: inv_q < quantity,
+                    ["inv_q", "quantity"]),
+        plan.project({"one": (lambda inv_q: jnp.ones_like(inv_q),
+                              ["inv_q"])}),
+        plan.aggregate(["item_key", "week"],
+                       [("one", "sum"), ("quantity", "sum")], MG),
+    ])
+    assert q72.nodes[1].get("payload") == ("item_key", "week", "quantity")
+    q95 = plan.Plan([
+        plan.scan("order_key", "ship_date", "net"),
+        plan.exchange("order_key", num_parts=8),
+        plan.join("returned_orders", "order_key", how="semi"),
+        plan.aggregate(["ship_date"],
+                       [("order_key", "count"), ("net", "sum"),
+                        ("net", "min"), ("net", "max")], MG),
+    ])
+    assert q95.nodes[1].get("payload") == ("order_key", "ship_date", "net")
+
+
+def test_exchange_requires_positive_num_parts():
+    with pytest.raises(ValueError, match="num_parts"):
+        plan.exchange("k", num_parts=0)

@@ -300,3 +300,235 @@ def test_multihost_staging_with_strings(rng, mesh):
                      np.asarray(out.columns[1].data), mask) if m)
     exp = sorted((v, int(p)) for v, p in zip(vals, pay))
     assert got == exp
+
+
+# ---------------------------------------------------------------------------
+# Two-phase ragged exchange (the pod-scale protocol): legacy equivalence,
+# transport routes, retry observability, compile-count guard
+# ---------------------------------------------------------------------------
+
+from spark_rapids_jni_tpu import obs
+from spark_rapids_jni_tpu.obs import metrics as _metrics
+from spark_rapids_jni_tpu.parallel import shuffle as shuffle_mod
+
+
+@pytest.fixture
+def obs_on():
+    obs.configure_sink(None)
+    obs.clear()
+    _metrics.registry().reset()
+    obs.enable()
+    yield
+    obs.disable()
+    obs.configure_sink(None)
+    obs.clear()
+    _metrics.registry().reset()
+
+
+def _keys_for_pids(num_parts=8):
+    """Representative int64 keys per hash partition id, so tests can
+    construct exact destination patterns through the real hash."""
+    cand = np.arange(1, 1 << 14, dtype=np.int64)
+    pid = np.asarray(hash_partition_ids(
+        Table((Column.from_numpy(cand, INT64),)), num_parts))
+    return {p: cand[pid == p] for p in range(num_parts)}
+
+
+def _skew_keys(pattern, rng, n, num_parts=8):
+    reps = _keys_for_pids(num_parts)
+    n_local = n // num_parts
+    if pattern == "uniform":
+        return rng.integers(0, 1 << 30, n).astype(np.int64)
+    if pattern == "one_hot":
+        # sender d routes every row to partition (d + 1) % P: maximal
+        # per-pair raggedness with every device still busy
+        return np.concatenate([
+            np.full(n_local, reps[(d + 1) % num_parts][0], np.int64)
+            for d in range(num_parts)])
+    if pattern == "empty_parts":
+        # odd partitions receive nothing at all
+        pool = np.concatenate([reps[p][:8]
+                               for p in range(0, num_parts, 2)])
+        return rng.choice(pool, n).astype(np.int64)
+    if pattern == "all_to_one":
+        return np.full(n, reps[3][0], np.int64)
+    raise AssertionError(pattern)
+
+
+def _valid_streams(res, num_parts=8):
+    """Per-device byte image of the delivered valid rows — the protocol
+    contract is on this stream, not on pad slots."""
+    rows = np.asarray(res.rows)
+    valid = np.asarray(res.row_valid).astype(bool)
+    per = rows.shape[0] // num_parts
+    return [rows[d * per:(d + 1) * per][valid[d * per:(d + 1) * per]]
+            .tobytes() for d in range(num_parts)]
+
+
+SKEWS = ["uniform", "one_hot", "empty_parts", "all_to_one"]
+
+
+@pytest.mark.parametrize("method", ["all_to_all", "ring"])
+@pytest.mark.parametrize("pattern", SKEWS)
+def test_two_phase_matches_legacy(rng, mesh, monkeypatch, pattern, method):
+    """Byte-identity of the two-phase protocol vs the legacy pad-to-max
+    exchange across the skew grid — the kill switch must be a pure
+    performance toggle."""
+    n = 8 * 64
+    key = _skew_keys(pattern, rng, n)
+    pay = rng.integers(-2**31, 2**31, n, dtype=np.int32)
+    ts = shard_table(Table((Column.from_numpy(key, INT64),
+                            Column.from_numpy(pay, INT32))), mesh)
+    res = shuffle_table_sharded(ts, key_cols=[0], mesh=mesh, method=method)
+    assert not bool(np.asarray(res.overflow)[0])
+    monkeypatch.setenv("SRJ_TPU_SHUFFLE_RAGGED", "0")
+    ref = shuffle_table_sharded(ts, key_cols=[0], mesh=mesh, method=method)
+    assert not bool(np.asarray(ref.overflow)[0])
+    assert _valid_streams(res) == _valid_streams(ref)
+    np.testing.assert_array_equal(np.asarray(res.num_valid),
+                                  np.asarray(ref.num_valid))
+
+
+@pytest.mark.parametrize("route", ["collective", "staged"])
+def test_forced_route_matches_legacy(rng, mesh, monkeypatch, route):
+    """Both phase-2 transports — the uniform collective and the staged
+    ragged sub-blob path — deliver the legacy stream on a hard skew."""
+    n = 8 * 64
+    key = _skew_keys("all_to_one", rng, n)
+    pay = rng.integers(-2**31, 2**31, n, dtype=np.int32)
+    ts = shard_table(Table((Column.from_numpy(key, INT64),
+                            Column.from_numpy(pay, INT32))), mesh)
+    monkeypatch.setenv("SRJ_TPU_SHUFFLE_ROUTE", route)
+    res = shuffle_table_sharded(ts, key_cols=[0], mesh=mesh)
+    assert not bool(np.asarray(res.overflow)[0])
+    assert shuffle_mod._health()["last"]["route"] == route
+    monkeypatch.delenv("SRJ_TPU_SHUFFLE_ROUTE")
+    monkeypatch.setenv("SRJ_TPU_SHUFFLE_RAGGED", "0")
+    ref = shuffle_table_sharded(ts, key_cols=[0], mesh=mesh)
+    assert not bool(np.asarray(ref.overflow)[0])
+    assert _valid_streams(res) == _valid_streams(ref)
+    np.testing.assert_array_equal(np.asarray(res.num_valid),
+                                  np.asarray(ref.num_valid))
+
+
+def test_staged_route_pads_less_than_legacy(rng, mesh, monkeypatch):
+    """The acceptance number: on a one-hot skew the staged transport's
+    wire padding must undercut the legacy pad-to-max exchange."""
+    n = 8 * 64
+    key = _skew_keys("one_hot", rng, n)
+    ts = shard_table(Table((Column.from_numpy(key, INT64),)), mesh)
+    monkeypatch.setenv("SRJ_TPU_SHUFFLE_ROUTE", "staged")
+    shuffle_table_sharded(ts, key_cols=[0], mesh=mesh)
+    staged_wire = shuffle_mod._health()["last"]["wire_bytes"]
+    monkeypatch.delenv("SRJ_TPU_SHUFFLE_ROUTE")
+    monkeypatch.setenv("SRJ_TPU_SHUFFLE_RAGGED", "0")
+    shuffle_table_sharded(ts, key_cols=[0], mesh=mesh)
+    legacy_wire = shuffle_mod._health()["last"]["wire_bytes"]
+    assert staged_wire < legacy_wire, (staged_wire, legacy_wire)
+
+
+def test_kill_switch_read_at_call_time(rng, mesh, monkeypatch):
+    """SRJ_TPU_SHUFFLE_RAGGED is consulted per call: flipping it mid
+    process swaps protocols and healthz tracks the live value."""
+    n = 8 * 32
+    _, ts = _make_sharded(rng, mesh, n)
+    monkeypatch.setenv("SRJ_TPU_SHUFFLE_RAGGED", "0")
+    assert not shuffle_mod.ragged_enabled()
+    shuffle_table_sharded(ts, key_cols=[0], mesh=mesh)
+    doc = shuffle_mod._health()
+    assert doc["ragged"] is False
+    assert doc["last"]["route"] == "legacy"
+    monkeypatch.delenv("SRJ_TPU_SHUFFLE_RAGGED")
+    assert shuffle_mod.ragged_enabled()
+    shuffle_table_sharded(ts, key_cols=[0], mesh=mesh)
+    doc = shuffle_mod._health()
+    assert doc["ragged"] is True
+    assert doc["last"]["route"] != "legacy"
+
+
+def test_capacity_retries_counted_and_on_grid(rng, mesh, obs_on):
+    """Estimated-path overflow retries increment the counter and land
+    back on the pow-2 capacity grid (so the retried program is a cache
+    hit for every later caller at that grid point)."""
+    n = 8 * 64
+    key = np.full(n, 12345, dtype=np.int64)
+    ts = shard_table(Table((Column.from_numpy(key, INT64),)), mesh)
+    before = shuffle_mod._health()["capacity_retries"]
+    res = shuffle_table_sharded(ts, key_cols=[0], mesh=mesh,
+                                capacity_factor=1.0)
+    assert not bool(np.asarray(res.overflow)[0])
+    doc = shuffle_mod._health()
+    retries = doc["capacity_retries"] - before
+    assert retries >= 1
+    cap = doc["last"]["capacity"]
+    assert cap == shuffle_mod.exchange_capacity(cap, 8)
+    vals = _metrics.registry().snapshot().get(
+        "srj_tpu_shuffle_capacity_retries_total", {}).get("values", {})
+    assert sum(v for v in vals.values()
+               if isinstance(v, (int, float))) >= retries
+
+
+def test_exchange_metrics_and_healthz(rng, mesh, obs_on):
+    """Every exchange lands in the srj_tpu_shuffle_* families and the
+    healthz sub-doc: route-labelled counts, byte totals, skew gauge."""
+    n = 8 * 64
+    key = _skew_keys("all_to_one", rng, n)
+    ts = shard_table(Table((Column.from_numpy(key, INT64),)), mesh)
+    shuffle_table_sharded(ts, key_cols=[0], mesh=mesh)
+    snap = _metrics.registry().snapshot()
+
+    def total(name):
+        vals = snap.get(name, {}).get("values", {})
+        return sum(v for v in vals.values() if isinstance(v, (int, float)))
+
+    assert total("srj_tpu_shuffle_exchanges_total") >= 1
+    assert total("srj_tpu_shuffle_send_bytes_total") > 0
+    assert total("srj_tpu_shuffle_recv_bytes_total") > 0
+    text = _metrics.format_prometheus()
+    assert "srj_tpu_shuffle_skew_factor" in text
+    from spark_rapids_jni_tpu.obs import exporter
+    doc = exporter._healthz()["shuffle"]
+    assert doc["send_bytes"] > 0
+    assert doc["last"]["skew"] > 1.0   # all-to-one is maximally skewed
+    # the span stamps the roofline cell keys for the costmodel ledger
+    ev = [e for e in obs.events(kind="span")
+          if e["name"] == "shuffle_table_sharded"][-1]
+    assert ev["bucket"] == doc["last"]["capacity"]
+    assert ev["padded_bytes"] >= 0 and ev["wire_bytes"] > 0
+
+
+def test_exchange_programs_olog_over_skews(mesh, obs_on, monkeypatch):
+    """The compile-telemetry guard: >= 20 distinct skew shapes compile
+    at most one exchange program per pow-2 capacity grid point (O(log N)
+    programs), and a warm repeat burst adds ZERO compiles."""
+    monkeypatch.setenv("SRJ_TPU_SHUFFLE_ROUTE", "collective")
+    n = 8 * 64
+    reps = _keys_for_pids(8)
+    hot = reps[5][0]
+    fracs = np.linspace(0.0, 1.0, 21)
+    caps = set()
+
+    def burst():
+        for i, f in enumerate(fracs):
+            r = np.random.default_rng(100 + i)
+            m = r.random(n) < f
+            key = np.where(m, hot,
+                           r.integers(0, 1 << 30, n)).astype(np.int64)
+            ts = shard_table(Table((Column.from_numpy(key, INT64),)),
+                             mesh)
+            res = shuffle_table_sharded(ts, key_cols=[0], mesh=mesh)
+            assert not bool(np.asarray(res.overflow)[0])
+            caps.add(shuffle_mod._health()["last"]["capacity"])
+
+    def compiles():
+        return [e for e in obs.events("compile")
+                if e.get("span") == "shuffle_table_sharded"]
+
+    burst()
+    # every capacity is a pow-2 grid point -> O(log N) distinct programs
+    assert 1 <= len(caps) <= int(np.log2(n)) + 1
+    # cold burst: at most sizes + pack + one exchange program per cap
+    assert len(compiles()) <= len(caps) + 4, (len(compiles()), caps)
+    obs.clear()
+    burst()
+    assert compiles() == []
