@@ -1164,6 +1164,16 @@ def _obs_axis_summary():
             out["mem_watermark_bytes"] = int(wm)
     except Exception:
         pass
+    # node-level plan statistics (EXPLAIN ANALYZE substrate): per-plan
+    # run counts and EWMA selectivity/rows-out per node, so BENCH rounds
+    # carry measured cardinalities alongside the timing digest
+    try:
+        from spark_rapids_jni_tpu.obs import planstats
+        ps = planstats.summary()
+        if ps.get("plans"):
+            out["plan_stats"] = ps
+    except Exception:
+        pass
     if _AXIS_TRACE is not None:
         # the trace_id every leg span carries: grep it in the JSONL log
         # (or a flight-recorder bundle) to find this axis run's events

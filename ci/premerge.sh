@@ -671,6 +671,36 @@ PY
 # a profiler capture linked (or an explicit unavailable marker), the
 # /healthz drift doc flips, and POST /profile serves an on-demand
 # bounded capture over the same socket
+EXPLAIN_STATS=$(mktemp /tmp/srj_explain_smoke.XXXXXX.json)
+EXPLAIN_DOC=$(mktemp /tmp/srj_explain_smoke.XXXXXX.doc.json)
+rm -f "$EXPLAIN_STATS"     # the CLI run writes it; start from nothing
+# EXPLAIN ANALYZE smoke: run the flagship query with plan stats armed
+# and persisted, then assert the analyze doc carries measured per-node
+# rows, a filter selectivity strictly inside (0,1), and that the warm
+# repeat of the same query recompiled NOTHING while armed — the
+# end-to-end version of tests/test_planstats.py's arming guard
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  SRJ_TPU_PLAN_STATS_FILE="$EXPLAIN_STATS" \
+  python -m spark_rapids_jni_tpu.obs explain flagship --run --analyze \
+  --json > "$EXPLAIN_DOC"
+python - "$EXPLAIN_DOC" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+nodes = doc["analyze"]["nodes"]
+assert all(n["rows_in"] > 0 for n in nodes if n["kind"] != "scan"), nodes
+flt = next(n for n in nodes if n["kind"] == "filter")
+assert 0.0 < flt["selectivity"] < 1.0, flt
+assert doc["analyze"]["warm_compiles"] == 0, doc["analyze"]
+print(f"explain smoke: flagship analyze — {len(nodes)} nodes, filter "
+      f"sel {flt['selectivity']:.3f}, warm repeat compiles 0")
+PY
+# a fresh process must render the annotated tree from the persisted
+# stats file alone (no --run): the EXPLAIN history survives the run
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python -m spark_rapids_jni_tpu.obs explain flagship --analyze \
+  --file "$EXPLAIN_STATS" | grep -q "sel"
+rm -f "$EXPLAIN_STATS" "$EXPLAIN_DOC"
+
 DRIFT_DIAG=$(mktemp -d /tmp/srj_drift_smoke.XXXXXX)
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   SRJ_TPU_DIAG_DIR="$DRIFT_DIAG" SRJ_TPU_DRIFT_WARMUP=4 \

@@ -1,6 +1,7 @@
 """CLI entry: ``python -m spark_rapids_jni_tpu.obs <events.jsonl>``
-(report) or ``python -m spark_rapids_jni_tpu.obs profile <events.jsonl>``
-(roofline attribution)."""
+(report), ``python -m spark_rapids_jni_tpu.obs profile <events.jsonl>``
+(roofline attribution) or ``python -m spark_rapids_jni_tpu.obs explain
+[plan] [--analyze]`` (plan tree with measured runtime statistics)."""
 
 import sys
 
@@ -9,6 +10,11 @@ if argv and argv[0] == "profile":
     from spark_rapids_jni_tpu.obs.costmodel import profile_main
 
     sys.exit(profile_main(argv[1:]))
+
+if argv and argv[0] == "explain":
+    from spark_rapids_jni_tpu.obs.planstats import explain_main
+
+    sys.exit(explain_main(argv[1:]))
 
 from spark_rapids_jni_tpu.obs.report import main
 

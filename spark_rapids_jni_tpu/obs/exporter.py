@@ -26,7 +26,9 @@ Endpoints:
     sub-document per registered *health provider*
     (:func:`register_health_provider`): subsystems with liveness state
     of their own (the serve scheduler reports queue depth and shed
-    state here, which is how load balancers see backpressure).  A
+    state here, which is how load balancers see backpressure; the
+    plan-stats layer contributes a ``plan_stats`` sub-document with
+    per-plan run/cache/selectivity state).  A
     provider that raises contributes ``{"error": ...}`` instead of
     taking down the endpoint.
 

@@ -594,6 +594,11 @@ def observe_event(ev: Dict) -> None:
                 _drift.observe_span(ev)
             except Exception:
                 pass
+            try:
+                from . import planstats as _planstats
+                _planstats.observe_span(ev)
+            except Exception:
+                pass
         elif kind == "compile":
             _REGISTRY.counter("srj_tpu_xla_compiles_total",
                               "XLA backend compiles observed.").inc()

@@ -1,0 +1,950 @@
+"""Plan-level execution statistics — EXPLAIN ANALYZE for fused plans.
+
+``runtime/plan.py`` fuses logical chains into one compiled program per
+stage, which is great for dispatch counts and terrible for visibility:
+nothing records what each *node* did at runtime.  This module is the
+measured-statistics substrate the adaptive-optimizer work will price
+against (Spark AQE re-plans from observed stats, not estimates):
+
+==========================  ==============================================
+stat                        source
+==========================  ==============================================
+rows in/out, selectivity    per-node live-row counts computed *inside*
+                            the fused program (one ``sum(mask)`` per
+                            node — no extra dispatches, no extra syncs
+                            beyond the per-segment fence)
+bytes moved                 stream row width x rows out (estimate) plus
+                            the staged input bytes per run
+device-time share           fenced wall per fused segment
+pad waste                   pow-2 grid padding: ``(bucket - rows)/bucket``
+cache hit/miss              compiled-program LRU outcome per fingerprint
+exchange skew               the phase-1 ``[P, P]`` size matrix and skew
+                            factor ``parallel/shuffle.py`` already
+                            computes, attributed via :func:`plan_scope`
+tenant batches              which tenants ride each plan fp8 (serve
+                            scheduler groups)
+==========================  ==============================================
+
+Stats are keyed ``(plan fingerprint, node id, bucket, mesh)`` in a
+bounded in-memory store with EWMA summaries, persisted to
+``PLAN_STATS.json`` under the same atomic-write / provenance / freshness
+discipline as ``obs/costmodel.py``'s CALIBRATION.json (and gitignored
+like it).  Surfaces:
+
+* ``python -m spark_rapids_jni_tpu.obs explain [plan] [--analyze]
+  [--json] [--run]`` — plan tree with fused-segment boundaries;
+  ``--analyze`` annotates measured rows / selectivity / device-ms /
+  skew with a Δ against the prior persisted run.
+* ``srj_tpu_plan_node_*`` metric families and a ``plan_stats``
+  /healthz sub-document on the exporter.
+* per-segment lanes in the Perfetto trace (``obs/trace.py``) carrying
+  node names, fed by the ``segments`` / ``seg_device_s`` span attrs.
+
+Knobs: ``SRJ_TPU_PLAN_STATS=0`` kills the whole layer (byte-identical
+results either way — counts never feed the data path),
+``SRJ_TPU_PLAN_STATS_FILE`` arms autosave to that path,
+``SRJ_TPU_PLAN_STATS_MAX_AGE_S`` caps persisted-stats freshness and
+``SRJ_TPU_PLAN_STATS_MAX_CELLS`` bounds the store.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "enabled", "stats_path", "max_age_s", "max_cells",
+    "describe_plan", "register_plan", "note_cache", "observe_execution",
+    "inline_node_stat", "observe_exchange", "observe_tenant_batch",
+    "observe_span", "plan_scope", "snapshot", "summary", "save", "load",
+    "reset", "render", "explain_main",
+]
+
+_ENV = "SRJ_TPU_PLAN_STATS"
+_ENV_FILE = "SRJ_TPU_PLAN_STATS_FILE"
+_ENV_MAX_AGE = "SRJ_TPU_PLAN_STATS_MAX_AGE_S"
+_ENV_MAX_CELLS = "SRJ_TPU_PLAN_STATS_MAX_CELLS"
+_DEFAULT_FILE = "PLAN_STATS.json"
+_ALPHA = 0.25            # EWMA weight of the newest observation
+_SAVE_MIN_S = 1.0        # autosave throttle (seconds between writes)
+_MAX_PLANS = 128
+_MAX_TENANTS = 64        # per-plan tenant label cap (overflow folds)
+_MAX_COUNTS = 16         # largest P whose [P,P] matrix persists verbatim
+
+_LOCK = threading.Lock()
+_PLANS: "collections.OrderedDict[str, Dict]" = collections.OrderedDict()
+_CELLS: "collections.OrderedDict[Tuple, Dict]" = collections.OrderedDict()
+_LAST_SAVE = [0.0]
+_TLS = threading.local()
+
+
+# ---------------------------------------------------------------------------
+# Knobs
+# ---------------------------------------------------------------------------
+
+def enabled() -> bool:
+    """Plan-stats layer armed (``SRJ_TPU_PLAN_STATS=0`` is the kill
+    switch — execution is byte-identical either way)."""
+    return os.environ.get(_ENV, "1") not in ("0", "false", "no")
+
+
+def stats_path(path: Optional[str] = None) -> str:
+    return path or os.environ.get(_ENV_FILE) or _DEFAULT_FILE
+
+
+def max_age_s() -> float:
+    """Persisted-stats freshness window (default one day — stale
+    cardinalities would mislead Δ comparisons and the optimizer)."""
+    try:
+        return float(os.environ.get(_ENV_MAX_AGE, "86400"))
+    except ValueError:
+        return 86400.0
+
+
+def max_cells() -> int:
+    try:
+        v = int(os.environ.get(_ENV_MAX_CELLS, "4096"))
+        return v if v > 0 else 4096
+    except ValueError:
+        return 4096
+
+
+# ---------------------------------------------------------------------------
+# Plan structure (the static EXPLAIN half)
+# ---------------------------------------------------------------------------
+
+def _node_label(node) -> str:
+    k = node.kind
+    if k == "scan":
+        return "scan(" + ", ".join(node.get("columns")) + ")"
+    if k == "filter":
+        return "filter(" + ", ".join(node.get("refs")) + ")"
+    if k == "project":
+        return "project(" + ", ".join(
+            name for name, _ in node.get("outputs")) + ")"
+    if k == "join":
+        out = node.get("out") or "mask"
+        return (f"join({node.get('probe')} x {node.get('build_keys')}"
+                f" -> {out}, {node.get('how')})")
+    if k == "aggregate":
+        ms = ", ".join(f"{op}({r})" for r, op in node.get("measures"))
+        return ("aggregate(by " + ", ".join(node.get("keys"))
+                + ": " + ms + ")")
+    if k == "exchange":
+        return (f"exchange(key={node.get('key')}, "
+                f"P={node.get('num_parts')})")
+    return k
+
+
+def describe_plan(plan) -> Dict:
+    """Static structure doc for one plan: node ids/kinds/labels and the
+    fused + unfused segment boundaries.  Persisted alongside the stats
+    so ``explain <fp8>`` renders from the file alone."""
+    return {
+        "fp8": plan.fp8,
+        "fingerprint": plan.fingerprint,
+        "outputs": list(plan.outputs) if plan.outputs else None,
+        "nodes": [{"id": f"n{i}", "kind": nd.kind,
+                   "label": _node_label(nd)}
+                  for i, nd in enumerate(plan.nodes)],
+        "segments": {
+            "fused": [[f"n{i}" for i in seg]
+                      for seg in plan.segments(True)],
+            "unfused": [[f"n{i}" for i in seg]
+                        for seg in plan.segments(False)],
+        },
+    }
+
+
+def _new_plan_rec(struct: Optional[Dict]) -> Dict:
+    return {"struct": struct, "runs": 0, "spans": 0, "rows": 0,
+            "bytes": 0, "wall_s": 0.0, "device_s": 0.0, "compiles": 0,
+            "cache_hits": 0, "cache_misses": 0, "dispatches": 0,
+            "pad_rows": 0, "pad_frac_ewma": None, "last_bucket": None,
+            "tenants": {}}
+
+
+def _plan_rec(fp8: str, struct: Optional[Dict] = None) -> Dict:
+    """Get-or-create the per-plan record (caller holds ``_LOCK``)."""
+    rec = _PLANS.get(fp8)
+    if rec is None:
+        rec = _new_plan_rec(struct)
+        _PLANS[fp8] = rec
+        while len(_PLANS) > _MAX_PLANS:
+            old, _ = _PLANS.popitem(last=False)
+            for key in [k for k in _CELLS if k[0] == old]:
+                del _CELLS[key]
+    elif struct is not None and rec.get("struct") is None:
+        rec["struct"] = struct
+    _PLANS.move_to_end(fp8)
+    return rec
+
+
+def register_plan(plan) -> None:
+    """Record a plan's static structure (idempotent, cheap after the
+    first call per fingerprint)."""
+    if not enabled():
+        return
+    try:
+        fp8 = plan.fp8
+    except Exception:
+        return
+    with _LOCK:
+        rec = _PLANS.get(fp8)
+        if rec is not None and rec.get("struct") is not None:
+            _PLANS.move_to_end(fp8)
+            return
+    struct = describe_plan(plan)
+    with _LOCK:
+        _plan_rec(fp8, struct)
+    _ensure_exported()
+
+
+# ---------------------------------------------------------------------------
+# Cells: (fp8, node_id, bucket, mesh) -> aggregate
+# ---------------------------------------------------------------------------
+
+def _ewma(prev: Optional[float], x: float) -> float:
+    return x if prev is None else _ALPHA * x + (1.0 - _ALPHA) * prev
+
+
+def _cell(fp8: str, node_id: str, bucket: int, mesh: str,
+          kind: str) -> Dict:
+    """Get-or-create one stat cell (caller holds ``_LOCK``)."""
+    key = (fp8, node_id, int(bucket), mesh)
+    c = _CELLS.get(key)
+    if c is None:
+        c = {"kind": kind, "calls": 0, "rows_in": 0, "rows_out": 0,
+             "last_rows_in": 0, "last_rows_out": 0, "sel_ewma": None,
+             "rows_out_ewma": None, "bytes": 0, "wall_s": 0.0,
+             "device_s": 0.0}
+        _CELLS[key] = c
+        cap = max_cells()
+        while len(_CELLS) > cap:
+            _CELLS.popitem(last=False)
+    else:
+        _CELLS.move_to_end(key)
+    return c
+
+
+def _observe_node(fp8: str, node_id: str, kind: str, bucket: int,
+                  mesh: str, rows_in: int, rows_out: int,
+                  row_width: int) -> None:
+    c = _cell(fp8, node_id, bucket, mesh, kind)
+    c["calls"] += 1
+    c["rows_in"] += int(rows_in)
+    c["rows_out"] += int(rows_out)
+    c["last_rows_in"] = int(rows_in)
+    c["last_rows_out"] = int(rows_out)
+    c["bytes"] += int(rows_out) * int(row_width)
+    if rows_in > 0:
+        c["sel_ewma"] = _ewma(c["sel_ewma"], rows_out / rows_in)
+    c["rows_out_ewma"] = _ewma(c["rows_out_ewma"], float(rows_out))
+
+
+def note_cache(fp8: str, hit: bool) -> None:
+    """Compiled-program LRU outcome, attributed per fingerprint."""
+    if not enabled():
+        return
+    with _LOCK:
+        rec = _plan_rec(fp8)
+        rec["cache_hits" if hit else "cache_misses"] += 1
+
+
+def observe_execution(plan, *, bucket: int, rows: int, input_bytes: int,
+                      pad_rows: int, fused: bool, row_width: int,
+                      node_stats: Sequence[Tuple[int, str, int, int]],
+                      seg_stats: Sequence[Tuple[int, List[str], float]],
+                      mesh: Optional[str] = None) -> None:
+    """Fold one eager :func:`runtime.plan.execute` run into the store.
+
+    ``node_stats``: ``(node_index, kind, rows_in, rows_out)`` per body
+    node, in execution order.  ``seg_stats``: ``(segment_index,
+    node_ids, fenced_seconds)`` per dispatched program.  Never raises.
+    """
+    if not enabled():
+        return
+    try:
+        fp8 = plan.fp8
+        m = str(mesh) if mesh else "-"
+        with _LOCK:
+            rec = _plan_rec(fp8)
+            rec["runs"] += 1
+            rec["rows"] += int(rows)
+            rec["bytes"] += int(input_bytes)
+            rec["dispatches"] += len(seg_stats)
+            rec["pad_rows"] += int(pad_rows)
+            rec["last_bucket"] = int(bucket)
+            if bucket > 0:
+                rec["pad_frac_ewma"] = _ewma(rec["pad_frac_ewma"],
+                                             pad_rows / bucket)
+            for i, kind, rin, rout in node_stats:
+                _observe_node(fp8, f"n{int(i)}", kind, bucket, m,
+                              rin, rout, row_width)
+            for j, node_ids, dev_s in seg_stats:
+                c = _cell(fp8, f"s{int(j)}", bucket, m, "segment")
+                c["calls"] += 1
+                c["device_s"] += float(dev_s)
+                c["nodes"] = list(node_ids)
+        _ensure_exported()
+    except Exception:
+        pass
+
+
+def inline_node_stat(fp8: str, node_index: int, kind: str, bucket: int,
+                     row_width: int, prev, cnt) -> None:
+    """Host callback for the inlined (in-trace) execute path: receives
+    the previous and current live-row counts via ``jax.debug.callback``,
+    which fires once per *invocation* of the enclosing compiled program
+    (and batches under vmap — hence the sums).  Keeps inlined and fused
+    eager executions producing comparable stat rows."""
+    if not enabled():
+        return
+    try:
+        import numpy as np
+        rows_in = int(np.sum(np.asarray(prev)))
+        rows_out = int(np.sum(np.asarray(cnt)))
+        with _LOCK:
+            _plan_rec(fp8)
+            _observe_node(fp8, f"n{int(node_index)}", str(kind),
+                          int(bucket), "-", rows_in, rows_out,
+                          int(row_width))
+        _ensure_exported()
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Exchange attribution
+# ---------------------------------------------------------------------------
+
+class _Scope:
+    """Context manager binding host-side shuffle observations to a plan
+    node (thread-local stack — shuffles run on the calling thread)."""
+
+    def __init__(self, fp8: str, node_id: str):
+        self._item = (fp8, node_id)
+
+    def __enter__(self):
+        stack = getattr(_TLS, "stack", None)
+        if stack is None:
+            stack = _TLS.stack = []
+        stack.append(self._item)
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            _TLS.stack.pop()
+        except Exception:
+            pass
+        return False
+
+
+def plan_scope(plan, node_id: Optional[str] = None) -> _Scope:
+    """Bind subsequent host-side exchange observations (on this thread)
+    to ``plan`` — by default to its first ``exchange`` node.  ``plan``
+    may be a Plan object (registered as a side effect) or a bare fp8
+    string."""
+    if isinstance(plan, str):
+        fp8 = plan
+    else:
+        register_plan(plan)
+        fp8 = plan.fp8
+        if node_id is None:
+            for i, nd in enumerate(getattr(plan, "nodes", ())):
+                if nd.kind == "exchange":
+                    node_id = f"n{i}"
+                    break
+    return _Scope(fp8, node_id or "x0")
+
+
+def _current_scope() -> Tuple[str, str]:
+    stack = getattr(_TLS, "stack", None)
+    if stack:
+        return stack[-1]
+    return "(shuffle)", "x0"
+
+
+def observe_exchange(*, route: str, method: str, capacity: int,
+                     skew: Optional[float], true_bytes: int = 0,
+                     wire_bytes: int = 0, counts=None) -> None:
+    """Fold one host-side exchange into the store, attributed to the
+    innermost :func:`plan_scope` (or the shared ``(shuffle)`` bucket).
+    ``counts`` is the phase-1 ``[P, P]`` per-(sender, dest) row matrix
+    when the exact path observed it.  Never raises."""
+    if not enabled():
+        return
+    try:
+        fp8, node_id = _current_scope()
+        with _LOCK:
+            _plan_rec(fp8)
+            c = _cell(fp8, node_id, int(capacity), "-", "exchange")
+            c["calls"] += 1
+            c["bytes"] += int(true_bytes)
+            c["wire_bytes"] = c.get("wire_bytes", 0) + int(wire_bytes)
+            c["route"] = str(route)
+            c["method"] = str(method)
+            if skew is not None and skew == skew:      # finite only
+                c["skew_ewma"] = _ewma(c.get("skew_ewma"), float(skew))
+                c["last_skew"] = float(skew)
+            if counts is not None:
+                try:
+                    import numpy as np
+                    a = np.asarray(counts)
+                    if a.ndim == 2 and a.shape[0] <= _MAX_COUNTS:
+                        c["counts"] = a.astype(int).tolist()
+                    else:
+                        c["counts_recv_totals"] = \
+                            a.sum(axis=0).astype(int).tolist()
+                except Exception:
+                    pass
+        _ensure_exported()
+    except Exception:
+        pass
+
+
+def observe_tenant_batch(fp8: str, tenant_rows: Dict[str, int],
+                         requests: int = 0) -> None:
+    """Per-tenant batch stats from the serve scheduler: for plan-backed
+    ops the coalescing sig carries the plan fp8, so EXPLAIN can show
+    which tenants ride each plan.  Never raises."""
+    if not enabled():
+        return
+    try:
+        with _LOCK:
+            rec = _plan_rec(fp8)
+            t = rec["tenants"]
+            for label, rows in tenant_rows.items():
+                key = str(label)
+                if key not in t and len(t) >= _MAX_TENANTS:
+                    key = "_overflow"
+                e = t.setdefault(key, {"batches": 0, "rows": 0})
+                e["batches"] += 1
+                e["rows"] += int(rows)
+            rec["tenant_requests"] = \
+                rec.get("tenant_requests", 0) + int(requests)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Span fan-out (wall/device/compiles per plan + autosave trigger)
+# ---------------------------------------------------------------------------
+
+def observe_span(ev: Dict) -> None:
+    """Fold one ``plan[<fp8>]`` span event into the per-plan record —
+    called from ``metrics.observe_event`` for every recorded span.
+    Never raises (guarded at the fan-out)."""
+    if not enabled():
+        return
+    name = str(ev.get("name", ""))
+    if not (name.startswith("plan[") and name.endswith("]")):
+        return
+    fp8 = str(ev.get("plan") or name[5:-1])
+    if not fp8 or "#" in fp8:
+        return
+    with _LOCK:
+        rec = _plan_rec(fp8)
+        rec["spans"] += 1
+        for field, key in (("wall_s", "wall_s"),
+                           ("device_s", "device_s")):
+            v = ev.get(field)
+            if isinstance(v, (int, float)):
+                rec[key] += float(v)
+        if isinstance(ev.get("compiles"), int):
+            rec["compiles"] += ev["compiles"]
+    _maybe_autosave()
+
+
+def _maybe_autosave() -> None:
+    path = os.environ.get(_ENV_FILE)
+    if not path:
+        return
+    now = time.monotonic()
+    if _LAST_SAVE[0] and now - _LAST_SAVE[0] < _SAVE_MIN_S:
+        return
+    _LAST_SAVE[0] = now
+    save(path, source="autosave")
+
+
+# ---------------------------------------------------------------------------
+# Snapshots / persistence
+# ---------------------------------------------------------------------------
+
+def _cell_key_str(key: Tuple) -> str:
+    return f"{key[1]}|{key[2]}|{key[3]}"
+
+
+def snapshot(fp8: Optional[str] = None) -> Dict:
+    """JSON-safe snapshot of the store: ``{"plans": {fp8: {...,
+    "cells": {"<node>|<bucket>|<mesh>": cell}}}}``.  ``fp8`` narrows to
+    one plan."""
+    with _LOCK:
+        plans: Dict[str, Dict] = {}
+        for p, rec in _PLANS.items():
+            if fp8 is not None and p != fp8:
+                continue
+            plans[p] = {k: v for k, v in rec.items()}
+            plans[p]["tenants"] = dict(rec["tenants"])
+            plans[p]["cells"] = {}
+        for key, c in _CELLS.items():
+            p = key[0]
+            if p in plans:
+                plans[p]["cells"][_cell_key_str(key)] = dict(c)
+    return {"plans": plans}
+
+
+def summary() -> Dict:
+    """Compact digest for the bench obs axis: per-plan run counts plus
+    EWMA selectivity / rows-out per node (aggregated over buckets by
+    taking the most-recent cell per node)."""
+    with _LOCK:
+        out: Dict[str, Dict] = {}
+        for p, rec in _PLANS.items():
+            out[p] = {"runs": rec["runs"], "rows": rec["rows"],
+                      "cache": [rec["cache_hits"], rec["cache_misses"]],
+                      "pad_frac": rec["pad_frac_ewma"], "nodes": {}}
+        for (p, node_id, _b, _m), c in _CELLS.items():
+            if p in out and node_id.startswith("n"):
+                out[p]["nodes"][node_id] = {
+                    "kind": c["kind"], "sel": c["sel_ewma"],
+                    "rows_out": c["rows_out_ewma"]}
+    return {"plans": out}
+
+
+def save(path: Optional[str] = None, source: str = "run",
+         now: Optional[float] = None) -> Optional[str]:
+    """Persist the store (atomic tmp+rename, with ``ts``/``source``
+    provenance).  Returns the path written, or ``None`` on failure —
+    stats are advisory, a read-only cwd must not fail a run."""
+    doc = snapshot()
+    doc["ts"] = time.time() if now is None else float(now)
+    doc["source"] = source
+    p = stats_path(path)
+    try:
+        tmp = f"{p}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, p)
+    except OSError:
+        return None
+    return p
+
+
+def load(path: Optional[str] = None, max_age: Optional[float] = None,
+         now: Optional[float] = None) -> Optional[Dict]:
+    """Read a persisted stats doc; ``None`` when missing, malformed, or
+    older than the freshness window (stale cardinalities would mislead
+    the Δ comparison and the optimizer)."""
+    p = stats_path(path)
+    try:
+        with open(p, "r") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or not isinstance(doc.get("plans"), dict):
+        return None
+    ts = doc.get("ts")
+    if not isinstance(ts, (int, float)):
+        return None
+    age_cap = max_age_s() if max_age is None else float(max_age)
+    t = time.time() if now is None else float(now)
+    if t - ts > age_cap:
+        return None
+    return doc
+
+
+def reset() -> None:
+    """Drop every stat (test isolation)."""
+    with _LOCK:
+        _PLANS.clear()
+        _CELLS.clear()
+    _LAST_SAVE[0] = 0.0
+
+
+# ---------------------------------------------------------------------------
+# Metrics / healthz export
+# ---------------------------------------------------------------------------
+
+_EXPORTED = False
+_EXPORT_LOCK = threading.Lock()
+
+
+def _publish_gauges() -> None:
+    from spark_rapids_jni_tpu.obs import metrics as _metrics
+    g_rows = _metrics.gauge("srj_tpu_plan_node_rows_total",
+                            "Cumulative rows through each plan node.",
+                            ("plan", "node", "dir"))
+    g_sel = _metrics.gauge("srj_tpu_plan_node_selectivity",
+                           "EWMA selectivity (rows out / rows in) per "
+                           "plan node.", ("plan", "node"))
+    g_calls = _metrics.gauge("srj_tpu_plan_node_calls_total",
+                             "Executions observed per plan node.",
+                             ("plan", "node"))
+    g_dev = _metrics.gauge("srj_tpu_plan_segment_device_seconds_total",
+                           "Fenced device seconds per fused segment.",
+                           ("plan", "segment"))
+    g_skew = _metrics.gauge("srj_tpu_plan_exchange_skew",
+                            "EWMA exchange skew factor (hottest dest "
+                            "share x P) per plan node.", ("plan", "node"))
+    g_pad = _metrics.gauge("srj_tpu_plan_pad_fraction",
+                           "EWMA pow-2 pad waste per plan.", ("plan",))
+    with _LOCK:
+        agg: Dict[Tuple, Dict] = {}
+        for (p, node_id, _b, _m), c in _CELLS.items():
+            a = agg.setdefault((p, node_id), {
+                "kind": c["kind"], "calls": 0, "rows_in": 0,
+                "rows_out": 0, "device_s": 0.0, "sel": None,
+                "skew": None})
+            a["calls"] += c["calls"]
+            a["rows_in"] += c["rows_in"]
+            a["rows_out"] += c["rows_out"]
+            a["device_s"] += c["device_s"]
+            if c.get("sel_ewma") is not None:
+                a["sel"] = c["sel_ewma"]
+            if c.get("skew_ewma") is not None:
+                a["skew"] = c["skew_ewma"]
+        pads = {p: rec["pad_frac_ewma"] for p, rec in _PLANS.items()
+                if rec["pad_frac_ewma"] is not None}
+    for (p, node_id), a in agg.items():
+        if a["kind"] == "segment":
+            g_dev.set(a["device_s"], plan=p, segment=node_id)
+            continue
+        g_calls.set(a["calls"], plan=p, node=node_id)
+        g_rows.set(a["rows_in"], plan=p, node=node_id, dir="in")
+        g_rows.set(a["rows_out"], plan=p, node=node_id, dir="out")
+        if a["sel"] is not None:
+            g_sel.set(a["sel"], plan=p, node=node_id)
+        if a["skew"] is not None:
+            g_skew.set(a["skew"], plan=p, node=node_id)
+    for p, frac in pads.items():
+        g_pad.set(frac, plan=p)
+
+
+def _health() -> Dict:
+    with _LOCK:
+        plans = {}
+        for p, rec in _PLANS.items():
+            plans[p] = {"runs": rec["runs"],
+                        "cache_hits": rec["cache_hits"],
+                        "cache_misses": rec["cache_misses"],
+                        "pad_frac": rec["pad_frac_ewma"],
+                        "device_s": round(rec["device_s"], 6),
+                        "compiles": rec["compiles"],
+                        "tenants": len(rec["tenants"])}
+        cells = len(_CELLS)
+    return {"enabled": enabled(), "cells": cells,
+            "file": os.environ.get(_ENV_FILE), "plans": plans}
+
+
+def _ensure_exported() -> None:
+    global _EXPORTED
+    if _EXPORTED:
+        return
+    with _EXPORT_LOCK:
+        if _EXPORTED:
+            return
+        try:
+            from spark_rapids_jni_tpu.obs import exporter, metrics
+            metrics.register_collect_hook(_publish_gauges)
+            exporter.register_health_provider("plan_stats", _health)
+        except Exception:
+            pass
+        _EXPORTED = True
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN CLI
+# ---------------------------------------------------------------------------
+
+def _named_plans() -> Dict[str, Any]:
+    def _flagship():
+        from spark_rapids_jni_tpu.models import pipeline
+        return pipeline.flagship_plan()
+    return {"flagship": _flagship}
+
+
+def _agg_node_cells(plans_doc: Dict, fp8: str) -> Dict[str, Dict]:
+    """Collapse a plan's cells over (bucket, mesh) into one row per
+    node/segment id: cumulative counts plus the latest EWMA."""
+    out: Dict[str, Dict] = {}
+    rec = plans_doc.get(fp8) or {}
+    for key, c in (rec.get("cells") or {}).items():
+        node_id = key.split("|", 1)[0]
+        a = out.setdefault(node_id, {
+            "kind": c.get("kind"), "calls": 0, "rows_in": 0,
+            "rows_out": 0, "bytes": 0, "device_s": 0.0, "sel": None,
+            "rows_out_ewma": None, "skew": None, "counts": None,
+            "last_rows_in": 0, "last_rows_out": 0, "nodes": None})
+        a["calls"] += c.get("calls", 0)
+        a["rows_in"] += c.get("rows_in", 0)
+        a["rows_out"] += c.get("rows_out", 0)
+        a["bytes"] += c.get("bytes", 0)
+        a["device_s"] += c.get("device_s", 0.0)
+        a["last_rows_in"] = c.get("last_rows_in", 0)
+        a["last_rows_out"] = c.get("last_rows_out", 0)
+        for src, dst in (("sel_ewma", "sel"),
+                         ("rows_out_ewma", "rows_out_ewma"),
+                         ("skew_ewma", "skew"), ("counts", "counts"),
+                         ("nodes", "nodes")):
+            if c.get(src) is not None:
+                a[dst] = c[src]
+    return out
+
+
+def _fmt(v, digits=3) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{digits}f}"
+    return str(v)
+
+
+def render(struct: Dict, stats: Optional[Dict] = None,
+           prior: Optional[Dict] = None, fused: bool = True) -> str:
+    """Text plan tree.  ``stats``/``prior`` are ``snapshot()["plans"]``
+    -shaped dicts; when given, each node line carries measured rows /
+    selectivity / device-ms / skew and a Δ vs the prior run."""
+    fp8 = struct["fp8"]
+    segs = struct["segments"]["fused" if fused else "unfused"]
+    lines = [f"plan[{fp8}]  {len(struct['nodes']) - 1} body nodes -> "
+             f"{len(segs)} segment(s)   sha256:{struct['fingerprint'][:16]}…"]
+    nodes = {n["id"]: n for n in struct["nodes"]}
+    rec = (stats or {}).get(fp8) or {}
+    cells = _agg_node_cells(stats, fp8) if stats else {}
+    prior_cells = _agg_node_cells(prior, fp8) if prior else {}
+    if rec:
+        cache = f"{rec.get('cache_hits', 0)}h/{rec.get('cache_misses', 0)}m"
+        pad = rec.get("pad_frac_ewma")
+        lines.append(
+            f"  runs {rec.get('runs', 0)}  rows {rec.get('rows', 0)}"
+            f"  cache {cache}  pad {_fmt(pad)}"
+            f"  device_ms {_fmt(rec.get('device_s', 0.0) * 1e3, 2)}"
+            f"  compiles {rec.get('compiles', 0)}")
+        if rec.get("tenants"):
+            tl = ", ".join(sorted(rec["tenants"])[:6])
+            lines.append(f"  tenants {len(rec['tenants'])}: {tl}")
+    for n in struct["nodes"]:
+        if nodes[n["id"]]["kind"] == "scan":
+            lines.append(f"  {n['id']}  {n['label']}")
+    total_dev = sum(c["device_s"] for c in cells.values()
+                    if c.get("kind") == "segment") or None
+    for j, seg in enumerate(segs):
+        seg_line = f"  seg s{j}  [" + " ".join(seg) + "]"
+        sc = cells.get(f"s{j}")
+        if sc and sc.get("device_s"):
+            share = (sc["device_s"] / total_dev) if total_dev else None
+            seg_line += (f"  device_ms {_fmt(sc['device_s'] * 1e3, 2)}"
+                         + (f" ({share * 100:.0f}%)" if share else ""))
+        lines.append(seg_line)
+        for node_id in seg:
+            nd = nodes.get(node_id, {"kind": "?", "label": node_id})
+            line = f"    {node_id}  {nd['label']}"
+            c = cells.get(node_id)
+            if c and c["calls"]:
+                line += (f"  rows {c['last_rows_in']}->"
+                         f"{c['last_rows_out']}")
+                if c["sel"] is not None:
+                    line += f"  sel {_fmt(c['sel'])}"
+                    pc = prior_cells.get(node_id)
+                    if pc and pc.get("sel") is not None:
+                        line += f"  Δsel {c['sel'] - pc['sel']:+.3f}"
+                if c["skew"] is not None:
+                    line += f"  skew {_fmt(c['skew'], 2)}"
+            lines.append(line)
+    return "\n".join(lines)
+
+
+def _analyze_doc(struct: Dict, stats: Dict, prior: Optional[Dict],
+                 warm_compiles: Optional[int]) -> Dict:
+    """Machine-readable ``--analyze`` section (what the CI smoke
+    asserts against)."""
+    fp8 = struct["fp8"]
+    cells = _agg_node_cells(stats, fp8)
+    prior_cells = _agg_node_cells(prior, fp8) if prior else {}
+    nodes = []
+    for n in struct["nodes"]:
+        if n["kind"] == "scan":
+            continue
+        c = cells.get(n["id"])
+        row = {"id": n["id"], "kind": n["kind"], "label": n["label"]}
+        if c and c["calls"]:
+            row.update(calls=c["calls"], rows_in=c["last_rows_in"],
+                       rows_out=c["last_rows_out"],
+                       selectivity=c["sel"], bytes=c["bytes"],
+                       skew=c["skew"])
+            pc = prior_cells.get(n["id"])
+            if pc and pc.get("sel") is not None and c["sel"] is not None:
+                row["delta_selectivity"] = c["sel"] - pc["sel"]
+        nodes.append(row)
+    segments = [{"id": nid, "nodes": c.get("nodes"),
+                 "device_s": c["device_s"], "calls": c["calls"]}
+                for nid, c in sorted(cells.items())
+                if c.get("kind") == "segment"]
+    doc = {"plan": fp8, "nodes": nodes, "segments": segments,
+           "summary": (stats.get(fp8) or {})}
+    doc["summary"] = {k: v for k, v in doc["summary"].items()
+                      if k != "cells"}
+    if warm_compiles is not None:
+        doc["warm_compiles"] = int(warm_compiles)
+    return doc
+
+
+def _run_flagship(rows: int, seed: int) -> int:
+    """Execute the flagship query on seeded synthetic columns, cold then
+    warm, with the obs ring armed; returns the number of XLA compiles
+    observed during the warm repeat (the zero-recompile proof)."""
+    import numpy as np
+    from spark_rapids_jni_tpu import obs
+    from spark_rapids_jni_tpu.models import pipeline
+
+    rng = np.random.default_rng(seed)
+    n, m = int(rows), 64
+    cols = {
+        "sold_date": rng.integers(0, 32, n).astype(np.int32),
+        "item_key": rng.integers(0, m, n).astype(np.int32),
+        "quantity": rng.integers(1, 10, n).astype(np.int32),
+        "price": (rng.random(n) * 10).astype(np.float32),
+        "build_item_key": np.arange(m, dtype=np.int32),
+        "build_item_price": (rng.random(m) * 5).astype(np.float32),
+    }
+    plan = pipeline.flagship_plan()
+    was_enabled = obs.enabled()
+    if not was_enabled:
+        obs.enable()
+    try:
+        from spark_rapids_jni_tpu.runtime import plan as _rt_plan
+        _rt_plan.execute(plan, cols)                       # cold
+        before = len(obs.events("compile"))
+        _rt_plan.execute(plan, cols)                       # warm repeat
+        warm = len(obs.events("compile")) - before
+    finally:
+        if not was_enabled:
+            obs.disable()
+    return warm
+
+
+def explain_main(argv: Optional[Sequence[str]] = None) -> int:
+    """``python -m spark_rapids_jni_tpu.obs explain`` entry point.
+
+    Exit codes: 0 rendered; 1 ``--analyze`` had no measured stats;
+    2 unknown plan / unreadable stats file."""
+    try:
+        return _explain(argv)
+    except BrokenPipeError:
+        # a reader that hung up early (| head) is not an error; point
+        # stdout at devnull so the interpreter's exit flush can't raise
+        import sys
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+        return 0
+
+
+def _explain(argv: Optional[Sequence[str]]) -> int:
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="python -m spark_rapids_jni_tpu.obs explain",
+        description="Render a plan tree; --analyze annotates each node "
+                    "with measured runtime statistics.")
+    ap.add_argument("plan", nargs="?", default="flagship",
+                    help="named plan (%s) or an fp8 present in the "
+                         "stats file" % ", ".join(sorted(_named_plans())))
+    ap.add_argument("--analyze", action="store_true",
+                    help="annotate nodes with measured stats + Δ vs the "
+                         "prior persisted run")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the machine-readable doc instead of text")
+    ap.add_argument("--run", action="store_true",
+                    help="execute the named plan on synthetic rows "
+                         "(cold + warm repeat) to produce fresh stats, "
+                         "then persist them")
+    ap.add_argument("--rows", type=int, default=4096,
+                    help="synthetic row count for --run (default 4096)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--file", default=None,
+                    help="stats file (default $SRJ_TPU_PLAN_STATS_FILE "
+                         "or PLAN_STATS.json)")
+    ap.add_argument("--unfused", action="store_true",
+                    help="show the node-at-a-time segment boundaries")
+    args = ap.parse_args(list(argv) if argv is not None else None)
+
+    prior = load(args.file)
+    named = _named_plans()
+    plan_obj = None
+    warm_compiles = None
+    if args.plan in named:
+        try:
+            plan_obj = named[args.plan]()
+        except Exception as e:
+            print(f"explain: cannot build plan {args.plan!r}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    if args.run:
+        if args.plan != "flagship":
+            print("explain: --run supports only the flagship plan",
+                  file=sys.stderr)
+            return 2
+        warm_compiles = _run_flagship(args.rows, args.seed)
+        save(args.file, source="explain")
+
+    if plan_obj is not None:
+        struct = describe_plan(plan_obj)
+        register_plan(plan_obj)
+    else:
+        # a bare fp8 (prefix): resolve from memory, then from the file
+        struct = None
+        snap_plans = snapshot()["plans"]
+        pools = [snap_plans] + ([prior["plans"]] if prior else [])
+        for pool in pools:
+            for p, rec in pool.items():
+                if p.startswith(args.plan) and rec.get("struct"):
+                    struct = rec["struct"]
+                    break
+            if struct:
+                break
+        if struct is None:
+            print(f"explain: unknown plan {args.plan!r} (not a named "
+                  "plan, and no persisted structure found)",
+                  file=sys.stderr)
+            return 2
+
+    fp8 = struct["fp8"]
+    live = snapshot(fp8)["plans"]
+    has_live = bool((live.get(fp8) or {}).get("runs")
+                    or (live.get(fp8) or {}).get("cells"))
+    stats = live if has_live else (prior or {}).get("plans")
+    stats_src = "memory" if has_live else ("file" if prior else None)
+    if stats is not None and not (stats.get(fp8) or {}).get("cells"):
+        stats = None
+        stats_src = None
+
+    if args.analyze and stats is None:
+        print(render(struct, fused=not args.unfused))
+        print("(no measured stats: run the workload with "
+              "SRJ_TPU_PLAN_STATS_FILE set, or pass --run)",
+              file=sys.stderr)
+        return 1
+
+    prior_plans = (prior or {}).get("plans") \
+        if stats_src == "memory" else None
+    if args.as_json:
+        doc: Dict[str, Any] = {"plan": struct}
+        if args.analyze:
+            doc["analyze"] = _analyze_doc(struct, stats, prior_plans,
+                                          warm_compiles)
+        print(json.dumps(doc, indent=1, sort_keys=True))
+        return 0
+    print(render(struct, stats if args.analyze else None,
+                 prior_plans if args.analyze else None,
+                 fused=not args.unfused))
+    if warm_compiles is not None:
+        print(f"warm repeat compiles: {warm_compiles}")
+    return 0

@@ -286,6 +286,21 @@ def dump_bundle(reason: str, ev: Dict) -> Optional[str]:
                 _write("memory_timeline.json", tl)
         except Exception:
             pass
+        # plan-backed ops: snapshot the failing plan's node statistics
+        # (rows/selectivity/segments) so the bundle shows what the plan
+        # had been doing before it died
+        name = str(ev.get("name", ""))
+        fp8 = ev.get("plan") or (
+            name[5:-1] if name.startswith("plan[") and name.endswith("]")
+            else None)
+        if isinstance(fp8, str) and fp8:
+            try:
+                from spark_rapids_jni_tpu.obs import planstats as _ps
+                snap = _ps.snapshot(fp8)
+                if snap.get("plans"):
+                    _write("plan_stats.json", snap)
+            except Exception:
+                pass
         _write("env.json", _env_snapshot())
         _write("MANIFEST.json", {
             "reason": reason, "ts": time.time(),
@@ -553,6 +568,15 @@ def format_bundle(path: str) -> str:
             lines.append(f"  mem timeline: {len(vals)} samples, "
                          f"{vals[0]} -> {vals[-1]} live bytes "
                          f"(peak {max(vals)}) — memory_timeline.json")
+    ps = _load("plan_stats.json")
+    if isinstance(ps, dict) and isinstance(ps.get("plans"), dict):
+        for fp8, rec in ps["plans"].items():
+            cells = rec.get("cells") or {}
+            node_cells = sum(1 for k in cells
+                             if k.split("|", 1)[0].startswith("n"))
+            lines.append(f"  plan stats  : plan[{fp8}] runs="
+                         f"{rec.get('runs')} {node_cells} node cells, "
+                         f"{len(cells)} total (plan_stats.json)")
     envd = _load("env.json") or {}
     if envd.get("jax_version"):
         lines.append(f"  jax         : {envd['jax_version']} "

@@ -12,6 +12,10 @@ flight-recorder bundle instead of reading a log.  The ``profile``
 subcommand (``python -m spark_rapids_jni_tpu.obs profile <log>``) lives
 in :mod:`~spark_rapids_jni_tpu.obs.costmodel`: the roofline view of the
 same log — achieved GB/s vs the calibrated ceiling per (op, bucket).
+The ``explain`` subcommand (``python -m spark_rapids_jni_tpu.obs
+explain [plan] [--analyze]``) lives in
+:mod:`~spark_rapids_jni_tpu.obs.planstats`: the plan tree annotated
+with measured per-node runtime statistics.
 
 Pure stdlib on purpose: the report must load a log from a process that
 died (the whole point of failure capture), so it depends on nothing that

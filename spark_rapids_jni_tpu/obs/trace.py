@@ -150,6 +150,41 @@ def _emit_span(node: _Node, out: List[Dict], pid: int, tid: int,
                 linkers.append((out_links, pid, tid, ts))
 
 
+def _plan_segment_slices(events: Iterable[Dict]) -> List[tuple]:
+    """Per-segment slices from stats-armed plan spans: each ``plan[...]``
+    span carrying ``segments``/``seg_device_s`` attrs yields one slice
+    per fused segment, named by its node kinds, laid out inside the span
+    interval proportionally to the fenced per-segment seconds."""
+    slices: List[tuple] = []
+    for ev in events:
+        if ev.get("kind") != "span":
+            continue
+        name = str(ev.get("name", ""))
+        segs = ev.get("segments")
+        if not name.startswith("plan[") or not isinstance(segs, list) \
+                or not segs:
+            continue
+        wall = ev.get("wall_s")
+        end = ev.get("ts")
+        if not isinstance(wall, (int, float)) \
+                or not isinstance(end, (int, float)):
+            continue
+        devs = ev.get("seg_device_s")
+        if not (isinstance(devs, list) and len(devs) == len(segs)
+                and all(isinstance(d, (int, float)) for d in devs)):
+            devs = [1.0] * len(segs)
+        total = sum(devs) or 1.0
+        start = float(end) - float(wall)
+        cursor = start
+        for j, (label, d) in enumerate(zip(segs, devs)):
+            dur = float(wall) * float(d) / total
+            slices.append((str(label), cursor, dur,
+                           {"plan": ev.get("plan"), "seg": j,
+                            "device_ms": round(float(d) * 1e3, 3)}))
+            cursor += dur
+    return slices
+
+
 def _host_of(ev: Dict) -> int:
     h = ev.get("host", 0)
     try:
@@ -208,6 +243,21 @@ def trace_events(events: Iterable[Dict], pid: int = 0) -> Dict:
             for node in roots[name]:
                 _emit_span(node, out, hpid, tids[name], scale, t0,
                            span_index, linkers)
+
+        # plan-segment lane: stats-armed plan spans carry ``segments``
+        # (node-kind labels per fused segment) and ``seg_device_s``
+        # (fenced seconds per segment), so a fused stage decomposes
+        # visually — one synthetic lane per host, slices proportional to
+        # each segment's fenced share of the span
+        seg_slices = _plan_segment_slices(by_host[h])
+        if seg_slices:
+            seg_tid = len(tids)
+            out.append({"ph": "M", "name": "thread_name", "pid": hpid,
+                        "tid": seg_tid, "args": {"name": "plan segments"}})
+            for label, start, dur_s, args in seg_slices:
+                out.append({"ph": "X", "name": label, "pid": hpid,
+                            "tid": seg_tid, "ts": (start - t0) * scale,
+                            "dur": dur_s * scale, "args": args})
 
         # counter tracks: cumulative XLA compiles/compile-seconds and
         # host<->device transfer bytes over time, per host lane

@@ -727,12 +727,25 @@ def _count_retry() -> None:
 
 
 def _record_exchange(route: str, method: str, true_bytes: int,
-                     wire_bytes: int, capacity: int, skew: float) -> None:
+                     wire_bytes: int, capacity: int, skew: float,
+                     counts=None) -> None:
     padded = max(0, int(wire_bytes) - int(true_bytes))
     # the estimated/legacy paths never observe counts, so their skew is
     # unknown — store None, not NaN: NaN breaks both the Prometheus
     # exposition (int(nan)) and strict-JSON healthz consumers
     skew = float(skew) if math.isfinite(skew) else None
+    try:
+        # plan-stats feed: the phase-1 [P, P] size matrix and skew are
+        # exactly what EXPLAIN ANALYZE reports for exchange nodes,
+        # attributed via planstats.plan_scope when a plan is bound
+        from spark_rapids_jni_tpu.obs import planstats
+        if planstats.enabled():
+            planstats.observe_exchange(
+                route=route, method=method, capacity=int(capacity),
+                skew=skew, true_bytes=int(true_bytes),
+                wire_bytes=int(wire_bytes), counts=counts)
+    except Exception:
+        pass
     with _STATS_LOCK:
         _STATS["exchanges"][route] = _STATS["exchanges"].get(route, 0) + 1
         _STATS["send_bytes"] += int(true_bytes)
@@ -864,7 +877,7 @@ def _ragged_exact(table, key_cols, mesh, axis_name, seed, method, layout,
         wire = xplan.collective_wire_bytes
         capacity = xplan.capacity
     _record_exchange(route, method, xplan.true_bytes, wire, capacity,
-                     xplan.skew)
+                     xplan.skew, counts=xplan.counts)
     _stamp_span(sp, route, capacity, xplan.true_bytes, wire, row_size,
                 xplan.skew)
     return ShuffleResult(rows, valid, num_valid, overflow, widths)

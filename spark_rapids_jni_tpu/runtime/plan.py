@@ -45,10 +45,13 @@ contract.
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
+import functools
 import hashlib
 import os
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -726,34 +729,109 @@ def _note_dispatch(fp8: str, n: int = 1) -> None:
         pass
 
 
-def _cache_lookup(key: Tuple, build):
+def _cache_lookup(key: Tuple, build, fp8: Optional[str] = None):
     """LRU get-or-build with hit/miss counters and the fused-nodes
     gauge refresh on build."""
     entry = _CACHE.get(key)
     if entry is not None:
         _count("srj_tpu_plan_cache_hits_total")
+        _note_plan_cache(fp8, True)
         return entry
     _count("srj_tpu_plan_cache_misses_total")
+    _note_plan_cache(fp8, False)
     entry = build()
     _CACHE.put(key, entry)
     return entry
+
+
+def _note_plan_cache(fp8: Optional[str], hit: bool) -> None:
+    if not fp8:
+        return
+    try:
+        from spark_rapids_jni_tpu.obs import planstats
+        if planstats.enabled():
+            planstats.note_cache(fp8, hit)
+    except Exception:
+        pass
+
+
+def _stats_enabled() -> bool:
+    """Plan-stats layer armed (``SRJ_TPU_PLAN_STATS=0`` kills it).
+    Counts never feed the data path, so results are byte-identical
+    either way; the flag still joins the program-cache key because the
+    armed program returns the extra count outputs."""
+    try:
+        from spark_rapids_jni_tpu.obs import planstats
+        return planstats.enabled()
+    except Exception:
+        return False
+
+
+def _row_width(cols: Dict[str, Any], plan: Plan) -> int:
+    """Stream row width in bytes (per-node byte-volume estimate)."""
+    w = 0
+    for name in plan.stream_inputs:
+        v = cols.get(name)
+        if v is None:
+            continue
+        try:
+            w += int(np.dtype(v.dtype).itemsize)
+        except Exception:
+            pass
+    return w
 
 
 # ---------------------------------------------------------------------------
 # Executor
 # ---------------------------------------------------------------------------
 
-def _segment_fn(plan: Plan, idxs: Sequence[int]):
+def _segment_fn(plan: Plan, idxs: Sequence[int], with_stats: bool = False):
     nodes = tuple(idxs)
 
     def run(cols, mask, ovf):
         st = {"cols": dict(cols), "mask": mask, "ovf": ovf,
               "result": None}
-        _run_nodes(plan, nodes, st)
-        return st["cols"], st["mask"], st["ovf"], st["result"]
+        if not with_stats:
+            _run_nodes(plan, nodes, st)
+            return st["cols"], st["mask"], st["ovf"], st["result"]
+        # stats-armed: one live-row popcount per node, fused into the
+        # same program (counts depend on the mask only — the data path
+        # is untouched, so results stay byte-identical)
+        counts = []
+        for i in nodes:
+            _EMIT[plan.nodes[i].kind](plan.nodes[i], st)
+            counts.append(jnp.sum(_mask(st).astype(jnp.int32)))
+        return (st["cols"], st["mask"], st["ovf"], st["result"],
+                tuple(counts))
 
     run.__name__ = f"plan_{plan.fp8}_seg{nodes[0]}"
     return run
+
+
+def _trace_node_stats(plan: Plan, idxs: Sequence[int], st: Dict) -> None:
+    """Inlined-path stats: ``execute`` under an enclosing jit trace runs
+    node-at-a-time with no span to stamp, so per-node live-row counts
+    ship host-side through ``jax.debug.callback`` — it fires once per
+    *invocation* of the caller's compiled program (and batches under
+    vmap), keeping inlined and fused eager executions producing
+    comparable stat rows."""
+    from spark_rapids_jni_tpu.obs import planstats
+    planstats.register_plan(plan)
+    first = next(iter(st["cols"].values()))
+    b = int(first.shape[0])
+    width = _row_width(st["cols"], plan)
+    prev = jnp.sum(_mask(st).astype(jnp.int32))
+    for i in idxs:
+        _EMIT[plan.nodes[i].kind](plan.nodes[i], st)
+        cnt = jnp.sum(_mask(st).astype(jnp.int32))
+        try:
+            jax.debug.callback(
+                functools.partial(planstats.inline_node_stat, plan.fp8,
+                                  i, plan.nodes[i].kind, b, width),
+                prev, cnt)
+        except Exception:
+            pass
+        prev = cnt
 
 
 def _stage_inputs(inputs: Dict[str, Any]) -> Dict[str, Any]:
@@ -796,7 +874,10 @@ def execute(plan: Plan, inputs: Dict[str, Any],
     if not _um.eager():
         st = {"cols": dict(inputs), "mask": mask, "ovf": None,
               "result": None}
-        _run_nodes(plan, plan.body_indices(), st)
+        if _stats_enabled():
+            _trace_node_stats(plan, plan.body_indices(), st)
+        else:
+            _run_nodes(plan, plan.body_indices(), st)
         return _finish(plan, st)
 
     _ensure_exported()
@@ -850,34 +931,60 @@ def execute(plan: Plan, inputs: Dict[str, Any],
     exec_plan = _with_build_liveness(plan, set(cols) - set(inputs))
 
     x64 = bool(jax.config.jax_enable_x64)
+    stats_on = _stats_enabled()
+    if stats_on:
+        from spark_rapids_jni_tpu.obs import planstats as _planstats
+        _planstats.register_plan(plan)
     dtype_sig = tuple(sorted((k, str(v.dtype)) for k, v in cols.items()))
-    key = (plan.fingerprint, (b, tuple(side_pads), dtype_sig, fused, x64),
+    # the stats flag joins the cache key: the armed program returns the
+    # per-node count outputs, so it is a different compiled artifact —
+    # keyed apart, each mode warms independently with zero recompiles
+    key = (plan.fingerprint,
+           (b, tuple(side_pads), dtype_sig, fused, x64, stats_on),
            None)
 
     def _build():
         with _STATE_LOCK:
             _FUSED_NODES[plan.fp8] = max(
                 _FUSED_NODES.get(plan.fp8, 0), exec_plan.max_fused(fused))
-        return [(tuple(idxs), jax.jit(_segment_fn(exec_plan, idxs)))
+        return [(tuple(idxs),
+                 jax.jit(_segment_fn(exec_plan, idxs,
+                                     with_stats=stats_on)))
                 for idxs in exec_plan.segments(fused)]
 
-    programs = _cache_lookup(key, _build)
+    programs = _cache_lookup(key, _build, fp8=plan.fp8)
 
     from spark_rapids_jni_tpu.obs import spans as _spans
     from spark_rapids_jni_tpu.runtime import resilience
     k = len(plan.body_indices())
     op = f"plan[{plan.fp8}]"
     sig = (len(stream), len(plan.side_inputs), k)
-    with _spans.span(op, plan=plan.fp8, nodes=k,
-                     fused=exec_plan.max_fused(fused),
-                     dispatches=len(programs), sig=str(sig),
-                     rows=n, bytes=_input_bytes(inputs)) as sp:
+    ibytes = _input_bytes(inputs)
+    scope = _planstats.plan_scope(plan) if stats_on \
+        else contextlib.nullcontext()
+    with scope, _spans.span(op, plan=plan.fp8, nodes=k,
+                            fused=exec_plan.max_fused(fused),
+                            dispatches=len(programs), sig=str(sig),
+                            rows=n, bytes=ibytes) as sp:
         shapes.note(n, b)
         ovf = None
         result = None
+        seg_times: List[float] = []
+        seg_counts: List[Tuple[Tuple[int, ...], Any]] = []
         for idxs, jfn in programs:
-            cols, live, ovf, r = resilience.run(
-                op, jfn, cols, live, ovf, sig=sig, bucket=b)
+            if stats_on:
+                t0 = time.perf_counter()
+                cols, live, ovf, r, cnts = resilience.run(
+                    op, jfn, cols, live, ovf, sig=sig, bucket=b)
+                # fence the segment so its device share is measurable;
+                # segments are data-dependent, so this only trades away
+                # dispatch pipelining, not parallelism
+                jax.block_until_ready((cols, live, ovf, r, cnts))
+                seg_times.append(time.perf_counter() - t0)
+                seg_counts.append((idxs, cnts))
+            else:
+                cols, live, ovf, r = resilience.run(
+                    op, jfn, cols, live, ovf, sig=sig, bucket=b)
             _note_dispatch(plan.fp8)
             if r is not None:
                 result = r
@@ -894,7 +1001,46 @@ def execute(plan: Plan, inputs: Dict[str, Any],
                            shapes.unpad_array(out[1], n)
                            if out[1] is not None else None)
         sp.fence(out)
+        if stats_on:
+            _harvest_stats(_planstats, plan, exec_plan, sp, seg_counts,
+                           seg_times, mask=mask, n=n, b=b,
+                           ibytes=ibytes, fused=fused,
+                           width=_row_width(inputs, plan))
     return out
+
+
+def _harvest_stats(_planstats, plan: Plan, exec_plan: Plan, sp,
+                   seg_counts, seg_times, *, mask, n: int, b: int,
+                   ibytes: int, fused: bool, width: int) -> None:
+    """Convert the fenced per-segment count outputs into planstats rows
+    and span attrs (``segments``/``seg_device_s`` feed the Perfetto
+    per-segment lanes).  Advisory: never raises."""
+    try:
+        try:
+            initial_live = n if mask is None \
+                else int(np.asarray(mask).sum())
+        except Exception:
+            initial_live = n
+        node_stats = []
+        prev = initial_live
+        for idxs, cnts in seg_counts:
+            for i, c in zip(idxs, cnts):
+                rows_out = int(np.asarray(c))
+                node_stats.append((i, exec_plan.nodes[i].kind, prev,
+                                   rows_out))
+                prev = rows_out
+        seg_stats = [(j, [f"n{i}" for i in idxs], dev)
+                     for j, ((idxs, _), dev)
+                     in enumerate(zip(seg_counts, seg_times))]
+        sp.set(segments=["+".join(exec_plan.nodes[i].kind for i in idxs)
+                         for idxs, _ in seg_counts],
+               seg_device_s=[round(d, 6) for d in seg_times])
+        _planstats.observe_execution(
+            plan, bucket=b, rows=n, input_bytes=ibytes, pad_rows=b - n,
+            fused=fused, row_width=width, node_stats=node_stats,
+            seg_stats=seg_stats)
+    except Exception:
+        pass
 
 
 def _with_build_liveness(plan: Plan, generated: set) -> Plan:
@@ -937,7 +1083,7 @@ def run_program(plan: Plan, fn, *args, sig="", bucket="", kwargs=None):
         return fn(*args, **(kwargs or {}))
     _ensure_exported()
     key = (plan.fingerprint, ("prog", str(bucket), str(sig)), None)
-    _cache_lookup(key, lambda: fn)
+    _cache_lookup(key, lambda: fn, fp8=plan.fp8)
     from spark_rapids_jni_tpu.obs import spans as _spans
     from spark_rapids_jni_tpu.runtime import resilience
     k = len(plan.body_indices())
@@ -964,4 +1110,4 @@ def cached_sharded(plan: Plan, mesh, build):
         hash(key)
     except TypeError:
         return build()
-    return _cache_lookup(key, build)
+    return _cache_lookup(key, build, fp8=plan.fp8)

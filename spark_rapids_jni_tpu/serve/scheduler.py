@@ -480,7 +480,29 @@ class Scheduler:
         exec_s = time.perf_counter() - t0
         self._m["exec_s"].observe(exec_s, op=op)
         self._charge(live, exec_s)
+        self._plan_batch_stats(sig, live)
         return len(reqs)
+
+    def _plan_batch_stats(self, sig, live: List[Request]) -> None:
+        """Plan-backed ops carry the plan fp8 as the coalescing sig's
+        last element; feed the planstats store so EXPLAIN shows which
+        tenants ride each plan (advisory — never fails a tick)."""
+        try:
+            fp8 = sig[-1] if isinstance(sig, tuple) and sig else None
+            if not (isinstance(fp8, str) and len(fp8) == 8
+                    and all(c in "0123456789abcdef" for c in fp8)):
+                return
+            from spark_rapids_jni_tpu.obs import planstats as _planstats
+            if not _planstats.enabled():
+                return
+            rows: Dict[str, int] = {}
+            for r in live:
+                lbl = self._tenant_label(r.tenant)
+                rows[lbl] = rows.get(lbl, 0) + max(r.rows, 0)
+            _planstats.observe_tenant_batch(fp8, rows,
+                                            requests=len(live))
+        except Exception:
+            pass
 
     def _charge(self, live: List[Request], exec_s: float) -> None:
         """Tenant chargeback for one executed group: the group's

@@ -1,0 +1,457 @@
+"""EXPLAIN ANALYZE plan-statistics tests (``obs/planstats.py``).
+
+Layers:
+
+1. Stat correctness: per-node rows/selectivity vs a numpy oracle, with
+   fused-vs-unfused stat identity across null patterns and bucket-edge
+   row counts (the same EDGES the byte-identity suite uses).
+2. The inlined-path satellite: ``execute`` under an enclosing jit trace
+   records the same stat rows as the fused eager path, once per
+   *invocation* (pinned — this is the branch that used to lose stats).
+3. Arming invariants: byte-identical results with ``SRJ_TPU_PLAN_STATS=0``
+   and zero extra compiles on a warm repeat burst while armed.
+4. Persistence: roundtrip / freshness window / malformed tolerance,
+   under costmodel's atomic-write discipline.
+5. Exchange skew capture from a forced 8-device host mesh, attributed
+   via ``plan_scope``.
+6. Surfaces: explain CLI exit codes, real-socket ``/metrics`` +
+   ``/healthz``, flight-recorder bundle snapshot, serve tenant batches.
+"""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu import Column, INT32, INT64, Table, obs, serve
+from spark_rapids_jni_tpu.models import pipeline
+from spark_rapids_jni_tpu.obs import exporter, metrics, planstats, recorder
+from spark_rapids_jni_tpu.runtime import plan, shapes
+
+
+@pytest.fixture
+def obs_on():
+    obs.configure_sink(None)
+    obs.clear()
+    metrics.registry().reset()
+    obs.enable()
+    yield
+    obs.disable()
+    obs.configure_sink(None)
+    obs.clear()
+    metrics.registry().reset()
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    plan.clear_cache()
+    planstats.reset()
+    yield
+    plan.clear_cache()
+    planstats.reset()
+
+
+def _chain(threshold=3, max_groups=32):
+    return plan.Plan([
+        plan.scan("k", "v"),
+        plan.filter(lambda v: v > jnp.int32(threshold), ["v"]),
+        plan.project({"d": (lambda k, v: v * jnp.int32(2) + k,
+                            ["k", "v"])}),
+        plan.aggregate(["k"], [("d", "sum")], max_groups),
+    ])
+
+
+def _inputs(n, seed=0):
+    r = np.random.default_rng(seed)
+    return {"k": r.integers(0, 8, n).astype(np.int32),
+            "v": r.integers(-10, 10, n).astype(np.int32)}
+
+
+EDGES = [0, 1, 7, 8, 9, 31, 32, 33]
+
+
+def _null_patterns(n):
+    yield None
+    yield np.ones(n, bool)
+    yield np.zeros(n, bool)
+    m = np.zeros(n, bool)
+    m[::2] = True
+    yield m
+    yield np.random.default_rng(n).random(n) > 0.4
+
+
+def _node_rows(fp8):
+    """{node_id: (rows_in, rows_out)} of the latest run, aggregated over
+    buckets (one bucket per test run here)."""
+    cells = planstats.snapshot(fp8)["plans"][fp8]["cells"]
+    return {k.split("|", 1)[0]: (c["last_rows_in"], c["last_rows_out"])
+            for k, c in cells.items() if k.startswith("n")}
+
+
+# ---------------------------------------------------------------------------
+# Stat correctness
+# ---------------------------------------------------------------------------
+
+def test_selectivity_matches_numpy_oracle():
+    p = _chain()
+    ins = _inputs(50, seed=7)
+    plan.execute(p, ins)
+    live = int((ins["v"] > 3).sum())
+    rows = _node_rows(p.fp8)
+    assert rows["n1"] == (50, live)          # filter
+    assert rows["n2"] == (live, live)        # project keeps the mask
+    assert rows["n3"] == (live, live)        # aggregate consumes it
+    cells = planstats.snapshot(p.fp8)["plans"][p.fp8]["cells"]
+    sel = cells[[k for k in cells if k.startswith("n1")][0]]["sel_ewma"]
+    assert sel == pytest.approx(live / 50)
+
+
+def test_mask_feeds_initial_live_rows():
+    p = _chain()
+    n = 40
+    ins = _inputs(n, seed=8)
+    mask = np.random.default_rng(1).random(n) > 0.5
+    plan.execute(p, ins, mask=mask)
+    live0 = int(mask.sum())
+    live1 = int((mask & (ins["v"] > 3)).sum())
+    rows = _node_rows(p.fp8)
+    assert rows["n1"] == (live0, live1)
+
+
+@pytest.mark.parametrize("n", EDGES)
+def test_fused_vs_unfused_stat_identity(n, monkeypatch):
+    """The stat rows are a property of the plan, not of how it was cut
+    into programs: node-at-a-time execution must record the same
+    (rows_in, rows_out) per node as the fused chain, for every null
+    pattern and bucket-edge size."""
+    p = _chain()
+    for i, mask in enumerate(_null_patterns(n)):
+        ins = _inputs(n, seed=100 + i)
+        monkeypatch.delenv("SRJ_TPU_PLAN_FUSE", raising=False)
+        planstats.reset()
+        plan.execute(p, ins, mask=mask)
+        fused_rows = _node_rows(p.fp8)
+        monkeypatch.setenv("SRJ_TPU_PLAN_FUSE", "0")
+        planstats.reset()
+        plan.execute(p, ins, mask=mask)
+        unfused_rows = _node_rows(p.fp8)
+        assert fused_rows == unfused_rows, (n, i)
+        # unfused: one segment per node; fused: one segment total
+        segs = [k for k in planstats.snapshot(p.fp8)["plans"][p.fp8]
+                ["cells"] if k.startswith("s")]
+        assert len(segs) == 3
+
+
+def test_segment_device_time_recorded():
+    p = _chain()
+    plan.execute(p, _inputs(33, seed=3))
+    rec = planstats.snapshot(p.fp8)["plans"][p.fp8]
+    segs = {k: c for k, c in rec["cells"].items() if k.startswith("s")}
+    assert len(segs) == 1
+    (c,) = segs.values()
+    assert c["device_s"] > 0
+    assert c["nodes"] == ["n1", "n2", "n3"]
+    assert rec["pad_frac_ewma"] == pytest.approx((64 - 33) / 64)
+
+
+# ---------------------------------------------------------------------------
+# Inlined-path satellite
+# ---------------------------------------------------------------------------
+
+def test_inlined_trace_records_comparable_stats():
+    """``execute`` under an enclosing jit trace runs node-at-a-time with
+    no span — the branch that used to record nothing.  Same bucket-sized
+    inputs must now yield the same per-node stat rows as the eager fused
+    path, once per invocation."""
+    p = _chain()
+    n = 16                      # bucket-aligned: eager pads to the same shape
+    ins = _inputs(n, seed=5)
+    out_eager = plan.execute(p, ins)
+    eager_rows = _node_rows(p.fp8)
+    planstats.reset()
+    plan.clear_cache()
+
+    @jax.jit
+    def f(k, v):
+        return plan.execute(p, {"k": k, "v": v})
+
+    out_inline = f(ins["k"], ins["v"])
+    jax.block_until_ready(out_inline)
+    jax.effects_barrier()
+    assert _node_rows(p.fp8) == eager_rows
+    for a, b in zip(out_eager, out_inline):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # fires per invocation, not per compile
+    f(ins["k"], ins["v"])
+    jax.effects_barrier()
+    cells = planstats.snapshot(p.fp8)["plans"][p.fp8]["cells"]
+    assert all(c["calls"] == 2 for k, c in cells.items()
+               if k.startswith("n"))
+
+
+# ---------------------------------------------------------------------------
+# Arming invariants
+# ---------------------------------------------------------------------------
+
+def test_byte_identity_stats_armed_vs_killed(monkeypatch):
+    p = _chain()
+    for n in EDGES:
+        ins = _inputs(n, seed=n)
+        monkeypatch.delenv("SRJ_TPU_PLAN_STATS", raising=False)
+        armed = plan.execute(p, ins)
+        monkeypatch.setenv("SRJ_TPU_PLAN_STATS", "0")
+        killed = plan.execute(p, ins)
+        monkeypatch.delenv("SRJ_TPU_PLAN_STATS", raising=False)
+        for a, b in zip(armed, killed):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # the kill switch really recorded nothing
+    assert plan.execute(p, _inputs(9)) is not None
+    monkeypatch.setenv("SRJ_TPU_PLAN_STATS", "0")
+    planstats.reset()
+    plan.execute(p, _inputs(9))
+    assert planstats.snapshot()["plans"] == {}
+
+
+def test_armed_warm_burst_adds_zero_compiles(obs_on):
+    """Stats-arming must not change the compile story: after one cold
+    pass per bucket, a repeat burst at seen buckets adds zero compiles
+    (the count outputs ride in the same cached program)."""
+    p = _chain()
+    for n in EDGES:
+        plan.execute(p, _inputs(n, seed=n))
+    warm_start = len(obs.events("compile"))
+    for n in EDGES:
+        plan.execute(p, _inputs(n, seed=1000 + n))
+    assert len(obs.events("compile")) == warm_start
+
+
+# ---------------------------------------------------------------------------
+# Persistence
+# ---------------------------------------------------------------------------
+
+def test_persistence_roundtrip(tmp_path):
+    p = _chain()
+    plan.execute(p, _inputs(20, seed=2))
+    path = str(tmp_path / "PLAN_STATS.json")
+    assert planstats.save(path, source="test") == path
+    doc = planstats.load(path)
+    assert doc is not None and doc["source"] == "test"
+    rec = doc["plans"][p.fp8]
+    assert rec["runs"] == 1
+    assert rec["struct"]["nodes"][1]["kind"] == "filter"
+    assert any(k.startswith("n1") for k in rec["cells"])
+    # no stray tmp file left behind (atomic replace)
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_persistence_freshness_window(tmp_path):
+    p = _chain()
+    plan.execute(p, _inputs(8))
+    path = str(tmp_path / "PLAN_STATS.json")
+    planstats.save(path, now=1000.0)
+    assert planstats.load(path, max_age=50.0, now=1040.0) is not None
+    assert planstats.load(path, max_age=50.0, now=1060.0) is None
+
+
+def test_persistence_malformed_tolerated(tmp_path):
+    path = str(tmp_path / "PLAN_STATS.json")
+    assert planstats.load(path) is None                  # missing
+    for garbage in ("not json{", "[]", '{"plans": 3, "ts": 1}',
+                    '{"plans": {}}'):                    # no ts
+        with open(path, "w") as f:
+            f.write(garbage)
+        assert planstats.load(path) is None
+    assert planstats.save("/proc/definitely/not/writable.json") is None
+
+
+def test_autosave_on_plan_span(tmp_path, obs_on, monkeypatch):
+    path = str(tmp_path / "PLAN_STATS.json")
+    monkeypatch.setenv("SRJ_TPU_PLAN_STATS_FILE", path)
+    plan.execute(_chain(), _inputs(12, seed=4))
+    doc = planstats.load(path)
+    assert doc is not None and doc["source"] == "autosave"
+
+
+# ---------------------------------------------------------------------------
+# Exchange skew capture (forced 8-device host mesh)
+# ---------------------------------------------------------------------------
+
+def test_exchange_skew_capture(rng, cpu_devices):
+    from spark_rapids_jni_tpu.parallel import (
+        make_mesh, shard_table, shuffle_table_sharded,
+    )
+    mesh = make_mesh(cpu_devices[:8])
+    n = 8 * 64
+    hot = rng.random(n) < 0.62
+    key = np.where(hot, 7, rng.integers(0, 1 << 30, n)).astype(np.int64)
+    payload = rng.integers(-2**31, 2**31, n, dtype=np.int32)
+    t = Table((Column.from_numpy(key, INT64),
+               Column.from_numpy(payload, INT32)))
+    ts = shard_table(t, mesh)
+    xp = plan.Plan([
+        plan.scan("key", "payload"),
+        plan.exchange("key", num_parts=8),
+        plan.aggregate(["key"], [("payload", "sum")], 64),
+    ])
+    with planstats.plan_scope(xp):
+        res = shuffle_table_sharded(ts, key_cols=[0], mesh=mesh)
+    assert int(np.asarray(res.num_valid).sum()) == n
+    cells = planstats.snapshot(xp.fp8)["plans"][xp.fp8]["cells"]
+    xc = [c for k, c in cells.items() if c["kind"] == "exchange"]
+    assert len(xc) == 1
+    c = xc[0]
+    # the node id resolved to the plan's exchange node
+    assert any(k.startswith("n1|") for k in cells)
+    assert c["skew_ewma"] is not None and c["skew_ewma"] > 1.5
+    counts = np.asarray(c["counts"])
+    assert counts.shape == (8, 8)
+    assert counts.sum() == n
+    # unattributed shuffles land in the shared bucket, not a plan
+    shuffle_table_sharded(ts, key_cols=[0], mesh=mesh)
+    snap = planstats.snapshot("(shuffle)")["plans"]
+    assert "(shuffle)" in snap
+
+
+# ---------------------------------------------------------------------------
+# Surfaces: CLI / metrics / healthz / recorder / serve
+# ---------------------------------------------------------------------------
+
+def test_explain_cli_exit_codes(tmp_path, capsys):
+    path = str(tmp_path / "PLAN_STATS.json")
+    # static tree for a named plan: exit 0, no stats required
+    assert planstats.explain_main(["flagship", "--file", path]) == 0
+    out = capsys.readouterr().out
+    assert "seg s0" in out and "filter" in out
+    # --analyze with no stats anywhere: exit 1
+    assert planstats.explain_main(
+        ["flagship", "--analyze", "--file", path]) == 1
+    capsys.readouterr()
+    # unknown plan: exit 2
+    assert planstats.explain_main(["bogus", "--file", path]) == 2
+    capsys.readouterr()
+
+
+def test_explain_analyze_from_live_stats(tmp_path, capsys):
+    p = _chain()
+    plan.execute(p, _inputs(24, seed=9))
+    path = str(tmp_path / "PLAN_STATS.json")
+    planstats.save(path, source="test")
+    # by fp8, stats from memory: annotated rows + json doc
+    assert planstats.explain_main(
+        [p.fp8, "--analyze", "--file", path]) == 0
+    out = capsys.readouterr().out
+    assert "sel" in out and "rows 24->" in out
+    assert planstats.explain_main(
+        [p.fp8, "--analyze", "--json", "--file", path]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    flt = next(n for n in doc["analyze"]["nodes"]
+               if n["kind"] == "filter")
+    assert 0.0 < flt["selectivity"] < 1.0
+    assert flt["rows_in"] == 24
+    # a fresh store still renders from the persisted doc alone
+    planstats.reset()
+    assert planstats.explain_main(
+        [p.fp8, "--analyze", "--file", path]) == 0
+    assert "sel" in capsys.readouterr().out
+
+
+def test_metrics_and_healthz_over_socket(obs_on):
+    p = _chain()
+    plan.execute(p, _inputs(30, seed=6))
+    port = exporter.start(0)
+    assert port is not None
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        assert f'srj_tpu_plan_node_selectivity{{plan="{p.fp8}",node="n1"}}' \
+            in body
+        assert 'srj_tpu_plan_node_rows_total{' in body
+        assert 'srj_tpu_plan_segment_device_seconds_total{' in body
+        assert 'srj_tpu_plan_pad_fraction{' in body
+        hz = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+        ps = hz["plan_stats"]
+        assert ps["enabled"] is True
+        assert ps["plans"][p.fp8]["runs"] == 1
+        assert ps["cells"] >= 4
+    finally:
+        exporter.stop()
+
+
+def test_recorder_bundle_carries_plan_stats(tmp_path, obs_on, monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_DIAG_DIR", str(tmp_path))
+    recorder.arm(str(tmp_path))
+    try:
+        p = _chain()
+        plan.execute(p, _inputs(18, seed=10))
+        ev = {"kind": "span", "name": f"plan[{p.fp8}]", "plan": p.fp8,
+              "status": "error", "error_type": "RuntimeError",
+              "error": "boom", "ts": 1.0, "wall_s": 0.1}
+        bundle = recorder.dump_bundle("error", ev)
+        assert bundle is not None
+        with open(os.path.join(bundle, "plan_stats.json")) as f:
+            snap = json.load(f)
+        assert p.fp8 in snap["plans"]
+        assert any(k.startswith("n1") for k in
+                   snap["plans"][p.fp8]["cells"])
+        text = recorder.format_bundle(bundle)
+        assert "plan stats" in text and p.fp8 in text
+    finally:
+        recorder.disarm()
+
+
+def test_serve_batch_feeds_tenant_plan_stats(obs_on):
+    sched = serve.Scheduler()
+    try:
+        rng = np.random.default_rng(13)
+        clients = [serve.Client(sched, f"t{i}") for i in range(3)]
+        futs = [c.aggregate(rng.integers(0, 16, 64).astype(np.int32),
+                            rng.integers(-5, 5, 64).astype(np.int32))
+                for c in clients]
+        assert sched.tick() == 3
+        for f in futs:
+            assert f.result(timeout=30)["num_groups"] > 0
+        from spark_rapids_jni_tpu.serve import ops as serve_ops
+        fp8 = serve_ops._agg_plan(pipeline.MAX_GROUPS).fp8
+        rec = planstats.snapshot(fp8)["plans"][fp8]
+        assert set(rec["tenants"]) == {"t0", "t1", "t2"}
+        assert all(t["rows"] == 64 and t["batches"] == 1
+                   for t in rec["tenants"].values())
+        assert rec["tenant_requests"] == 3
+    finally:
+        sched.close()
+
+
+def test_store_is_bounded(monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_PLAN_STATS_MAX_CELLS", "8")
+    p = _chain()
+    for n in [1, 8, 16, 32, 64, 128, 256, 512]:
+        plan.execute(p, _inputs(n, seed=n))
+    with planstats._LOCK:
+        assert len(planstats._CELLS) <= 8
+
+
+def test_span_carries_segment_attrs(obs_on):
+    plan.execute(_chain(), _inputs(10, seed=11))
+    evs = [e for e in obs.events(kind="span")
+           if str(e.get("name", "")).startswith("plan[")]
+    assert evs
+    ev = evs[-1]
+    assert ev["segments"] == ["filter+project+aggregate"]
+    assert len(ev["seg_device_s"]) == 1
+    # the Perfetto converter decomposes the span into a segment lane
+    from spark_rapids_jni_tpu.obs import trace
+    doc = trace.trace_events(obs.events())
+    lanes = [e for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "thread_name"
+             and e.get("args", {}).get("name") == "plan segments"]
+    assert len(lanes) == 1
+    seg_tid = lanes[0]["tid"]
+    slices = [e for e in doc["traceEvents"]
+              if e.get("ph") == "X" and e.get("tid") == seg_tid]
+    assert any(s["name"] == "filter+project+aggregate" for s in slices)
