@@ -988,6 +988,95 @@ def bench_serve(num_requests, tenants=4, miss_rate=0.3):
     return res
 
 
+def bench_fleet(num_requests, replicas=3, tenants=4):
+    """Fleet failover axis: a 3-replica supervised fleet serves a
+    sustained multi-tenant burst through the health-aware router while
+    the chaos harness SIGKILLs the affinity owner mid-burst.  Measures
+    the cost of surviving: steady-state vs through-failover latency
+    percentiles, lost requests (must be 0 — failed futures are counted,
+    not hidden), and how fast + how warm the replacement came back
+    (ready seconds, persistent-cache hits, backend compiles vs the
+    coldest cold start)."""
+    import numpy as np
+    from spark_rapids_jni_tpu.runtime import shapes as _shapes
+    from spark_rapids_jni_tpu.serve import chaos as _chaos
+    from spark_rapids_jni_tpu.serve import fleet as _fleet
+    from spark_rapids_jni_tpu.serve import router as _router
+
+    sizes = (100, 900)
+    sup = _fleet.Supervisor(replicas=replicas, heartbeat_ms=200, env={
+        "SRJ_TPU_FLEET_WARM_OPS": ",".join(f"agg:{s}" for s in sizes),
+        "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
+    })
+    res = {"fleet_replicas": replicas, "fleet_requests": num_requests}
+    rt = None
+    try:
+        t0 = time.monotonic()
+        sup.start(wait_ready=True, timeout_s=240)
+        res["fleet_start_s"] = round(time.monotonic() - t0, 2)
+        cold = [sup.healthz(i)["replica"] for i in range(replicas)]
+        coldest = max(r["backend_compiles"] for r in cold)
+        rt = _router.Router(supervisor=sup, health_ttl_s=0.1)
+        victim = rt._candidates("agg", _shapes.bucket_rows(sizes[0]),
+                                [])[0][0]
+
+        def burst(n, phase):
+            futs, lat = [], []
+            t_start = time.monotonic()
+            for i in range(n):
+                size = sizes[i % 2]
+                keys = ((np.arange(size, dtype=np.int64) * 7919
+                         + i * 131) % 97).astype(np.int32)
+                vals = np.ones(size, dtype=np.int32)
+                futs.append((time.monotonic(), rt.aggregate(
+                    keys, vals, deadline_s=120,
+                    tenant=f"t{i % tenants}")))
+            lost = 0
+            for t_sub, f in futs:
+                try:
+                    f.result(240)
+                    lat.append(time.monotonic() - t_sub)
+                except Exception:
+                    lost += 1
+            wall = time.monotonic() - t_start
+            lat.sort()
+            res[f"fleet_{phase}_qps"] = round(n / max(1e-9, wall), 1)
+            res[f"fleet_{phase}_p50_ms"] = round(
+                lat[len(lat) // 2] * 1e3, 2) if lat else None
+            res[f"fleet_{phase}_p99_ms"] = round(
+                lat[int(len(lat) * 0.99)] * 1e3, 2) if lat else None
+            res[f"fleet_{phase}_lost"] = lost
+
+        burst(num_requests // 2, "steady")
+        harness = _chaos.ChaosHarness(sup, f"0.2:kill:{victim}").start()
+        burst(num_requests - num_requests // 2, "failover")
+        harness.join(30)
+        t_wait = time.monotonic()
+        repl = None
+        while time.monotonic() - t_wait < 180:
+            r = sup.replica(victim)
+            doc = sup.healthz(victim)
+            if (r is not None and r.restarts >= 1 and doc
+                    and doc.get("replica", {}).get("ready")):
+                repl = doc["replica"]
+                break
+            time.sleep(0.3)
+        if repl is not None:
+            res["fleet_replacement_ready_s"] = round(
+                time.monotonic() - t_wait, 2)
+            res["fleet_replacement_cache_hits"] = repl["cache_hits"]
+            res["fleet_replacement_backend_compiles"] = \
+                repl["backend_compiles"]
+            res["fleet_cold_backend_compiles"] = coldest
+        else:
+            res["fleet_replacement_ready_s"] = None
+    finally:
+        if rt is not None:
+            rt.close()
+        sup.stop()
+    return res
+
+
 def _count_boundary_dispatches(fn):
     """Run ``fn`` once counting host->device boundary crossings: explicit
     ``jax.device_put`` calls plus ``jnp.asarray`` calls handed a numpy
@@ -1212,6 +1301,8 @@ def _run_axis(axis: str):
             res = bench_transfer(int(n))
         elif kind == "serve":
             res = bench_serve(int(n))
+        elif kind == "fleet":
+            res = bench_fleet(int(n))
         elif kind == "plan":
             res = bench_plan(int(n))
         elif kind == "shuffle":
@@ -1571,6 +1662,14 @@ def main():
     # gate sees the serving numbers every round
     _run("serving", "serve:2000")
 
+    # fleet failover axis: 3 supervised replicas, kill the affinity
+    # owner mid-burst, measure through-failover latency + lost count
+    # (must be 0) + warm-replacement telemetry.  Pinned to CPU like the
+    # shuffle axis: replica subprocesses must not contend for the chip
+    if not args.quick:
+        _run("fleet_failover", "fleet:200",
+             env={"JAX_PLATFORMS": "cpu"})
+
     # per-kernel roofline axis (xxhash64 / bloom_filter / get_json):
     # runs under --quick too — the regress gate checks each kernel's
     # pct_of_calibration every round
@@ -1705,6 +1804,20 @@ def main():
             {"metric": "serve_p99_ms",
              "value": sv["p99_ms"], "unit": "ms"},
         ]
+    # fleet failover figures: lost requests (must stay 0) and the
+    # through-failover p99 — the price of surviving a replica kill
+    fl = next((r for r in results.get("fleet_failover", [])
+               if isinstance(r, dict)
+               and r.get("fleet_failover_p99_ms") is not None), None)
+    if fl is not None:
+        out.setdefault("secondary", []).extend([
+            {"metric": "fleet_failover_p99_ms",
+             "value": fl["fleet_failover_p99_ms"], "unit": "ms"},
+            {"metric": "fleet_lost_requests",
+             "value": (fl.get("fleet_steady_lost", 0)
+                       + fl.get("fleet_failover_lost", 0)),
+             "unit": "requests"},
+        ])
     # plan-fusion figures: fused dispatch and program counts on the
     # ragged grid — "dispatches"/"programs" are lower-is-better units in
     # ci/regress_gate.py, so a fusion break (more programs per plan, or

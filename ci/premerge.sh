@@ -766,3 +766,103 @@ print(f"drift smoke: kernel_a alarmed once ({len(bundles)} bundle, "
       f"POST /profile -> {doc['status']}")
 PY
 rm -rf "$DRIFT_DIAG"
+
+# fleet failover smoke: 3 supervised replicas serve a 4-tenant burst;
+# the chaos harness SIGKILLs the small-bucket affinity owner mid-burst.
+# Gate: zero lost/wrong responses (byte-identical to a single-scheduler
+# reference), the successor comes up ready WARM (>0 shipped-cache hits,
+# strictly fewer backend compiles than the coldest cold start), and a
+# breaker forced open on one survivor shows up in the other survivor's
+# gossip-imported state.
+FLEET_DIR=$(mktemp -d /tmp/srj_fleet_smoke.XXXXXX)
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  SRJ_TPU_FLEET_SMOKE_DIR="$FLEET_DIR" \
+  python - <<'PY'
+import os, time
+import numpy as np
+from spark_rapids_jni_tpu import serve
+from spark_rapids_jni_tpu.runtime import shapes
+from spark_rapids_jni_tpu.serve import chaos, fleet, router
+
+sizes = (100, 900)
+sup = fleet.Supervisor(
+    replicas=3, fleet_dir=os.environ["SRJ_TPU_FLEET_SMOKE_DIR"],
+    heartbeat_ms=200,
+    env={"SRJ_TPU_FLEET_WARM_OPS": ",".join(f"agg:{s}" for s in sizes),
+         "JAX_PLATFORMS": "cpu"})
+sup.start(wait_ready=True, timeout_s=240)
+cold = [sup.healthz(i)["replica"] for i in range(3)]
+coldest = max(r["backend_compiles"] for r in cold)
+assert coldest > 0, cold
+
+def payload(size, i):
+    keys = ((np.arange(size, dtype=np.int64) * 7919 + i * 131)
+            % 97).astype(np.int32)
+    return keys, (np.arange(size, dtype=np.int64) % 13).astype(np.int32)
+
+ref = {}
+with serve.Scheduler() as s:
+    c = serve.Client(s, "ref")
+    for size in sizes:
+        k, v = payload(size, size)
+        ref[size] = c.aggregate(k, v).result(240)
+
+rt = router.Router(supervisor=sup, health_ttl_s=0.1)
+victim = rt._candidates("agg", shapes.bucket_rows(sizes[0]), [])[0][0]
+harness = chaos.ChaosHarness(sup, f"0.3:kill:{victim}").start()
+
+futs = []
+for i in range(32):
+    size = sizes[i % 2]
+    k, v = payload(size, size)
+    futs.append((size, rt.aggregate(k, v, deadline_s=120,
+                                    tenant=f"t{i % 4}")))
+    time.sleep(0.03)
+wrong = lost = 0
+for size, f in futs:
+    out = f.result(240)
+    if not all(np.array_equal(out[x], ref[size][x])
+               for x in ("group_keys", "sums", "have")):
+        wrong += 1
+harness.join(30)
+assert harness.log and harness.log[0]["ok"], harness.log
+assert lost == 0 and wrong == 0, (lost, wrong)
+
+repl = None
+deadline = time.time() + 180
+while time.time() < deadline:
+    r = sup.replica(victim)
+    doc = sup.healthz(victim)
+    if (r is not None and r.restarts >= 1 and doc
+            and doc.get("replica", {}).get("ready")):
+        repl = doc["replica"]
+        break
+    time.sleep(0.3)
+assert repl is not None, "successor never became ready"
+assert repl["cache_hits"] > 0, repl
+assert repl["backend_compiles"] < coldest, (repl, coldest)
+
+survivors = [i for i in range(3) if i != victim]
+chaos.ChaosHarness(
+    sup, f"0:force_breaker:{survivors[0]}:"
+         f"op=serve.agg,sig=ci,bucket=100,impl=pallas").start().join(15)
+cell = "serve.agg|ci|100|pallas"
+seen = False
+deadline = time.time() + 30
+while time.time() < deadline:
+    doc = sup.healthz(survivors[1])
+    res = (doc or {}).get("resilience") or {}
+    if cell in (res.get("open") or []) \
+            and cell in (res.get("imported") or []):
+        seen = True
+        break
+    time.sleep(0.25)
+rt.close(); sup.stop()
+assert seen, f"breaker {cell} never gossiped to replica {survivors[1]}"
+print(f"fleet smoke: {len(futs)} requests through kill of replica "
+      f"{victim}, 0 lost 0 wrong; successor warm "
+      f"(hits={repl['cache_hits']}, backend={repl['backend_compiles']} "
+      f"< cold={coldest}); breaker gossiped "
+      f"{survivors[0]} -> {survivors[1]}")
+PY
+rm -rf "$FLEET_DIR"

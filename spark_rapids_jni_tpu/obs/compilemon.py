@@ -24,13 +24,21 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional
 
-# the actual backend compile (cache misses only — in-process and
-# persistent cache hits skip it), the signal that distinguishes "XLA
-# built a program" from "the trace was replayed"
+# the backend compile path.  NOTE: in current jax this duration event
+# fires on persistent-cache HITS too (the hit is timed under the same
+# wrapper; it is just ~10x cheaper) — so "compiles" alone cannot
+# distinguish a warm replica from a cold one.  The record events below
+# can: ``cache_hits`` counts persistent-cache deserializations and
+# ``cache_requests`` counts compiles that consulted the cache, so
+# *actual* backend compiles = compiles - cache_hits.  The fleet's
+# warm-start proof (tests/test_fleet.py) is built on exactly this.
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_REQ_EVENT = "/jax/compilation_cache/compile_requests_use_cache"
 
 _lock = threading.Lock()
-_totals = {"compiles": 0, "compile_s": 0.0}
+_totals = {"compiles": 0, "compile_s": 0.0,
+           "cache_hits": 0, "cache_requests": 0}
 _installed = False
 
 
@@ -52,6 +60,17 @@ def _listener(name: str, secs: float, **kwargs) -> None:
                 "span": stack[-1].name if stack else None})
 
 
+def _event_listener(name: str, **kwargs) -> None:
+    """Unit-count events (no duration): persistent compilation-cache
+    hits and cache-consulting compile requests."""
+    if name == _CACHE_HIT_EVENT:
+        with _lock:
+            _totals["cache_hits"] += 1
+    elif name == _CACHE_REQ_EVENT:
+        with _lock:
+            _totals["cache_requests"] += 1
+
+
 def install() -> bool:
     """Register the listener with ``jax.monitoring`` (idempotent).  Returns
     False when the monitoring API is unavailable (compile counts then stay
@@ -70,6 +89,10 @@ def install() -> bool:
         monitoring.register_event_duration_secs_listener(_listener)
     except Exception:
         return False
+    try:
+        monitoring.register_event_listener(_event_listener)
+    except Exception:
+        pass          # hit/request counts stay zero; compiles still work
     _installed = True
     return True
 

@@ -28,9 +28,23 @@ Endpoints:
     of their own (the serve scheduler reports queue depth and shed
     state here, which is how load balancers see backpressure; the
     plan-stats layer contributes a ``plan_stats`` sub-document with
-    per-plan run/cache/selectivity state).  A
+    per-plan run/cache/selectivity state; a fleet supervisor
+    contributes a ``fleet`` sub-document with per-replica liveness,
+    restart counts and heartbeat ages).  A
     provider that raises contributes ``{"error": ...}`` instead of
     taking down the endpoint.
+
+``GET /readyz``
+    Liveness vs *readiness* split: ``/healthz`` answers "is the process
+    alive", ``/readyz`` answers "should this process receive traffic".
+    Returns 503 until every registered *readiness provider*
+    (:func:`register_readiness_provider`, ``fn() -> bool``) reports
+    True — a warm-starting serve replica registers one and flips it
+    only after its shipped caches are loaded and its warmup programs
+    traced, so a fleet router holds traffic off it until then.  With no
+    providers registered the process is vacuously ready (200).  A
+    provider that raises counts as *not ready* (the conservative
+    reading: an unknown state must not attract traffic).
 
 ``POST /profile[?ms=N]``
     Trigger one bounded :mod:`spark_rapids_jni_tpu.obs.profiler`
@@ -58,13 +72,17 @@ from urllib.parse import parse_qs, urlsplit
 from spark_rapids_jni_tpu.obs import metrics as _metrics
 
 __all__ = ["start", "stop", "running", "port",
-           "register_health_provider", "unregister_health_provider"]
+           "register_health_provider", "unregister_health_provider",
+           "register_readiness_provider", "unregister_readiness_provider",
+           "ready", "register_route", "unregister_route"]
 
 _LOCK = threading.Lock()
 _SERVER: Optional[ThreadingHTTPServer] = None
 _THREAD: Optional[threading.Thread] = None
 _STARTED_AT: float = 0.0
 _PROVIDERS: dict = {}
+_READY_PROVIDERS: dict = {}
+_ROUTES: dict = {}
 _PROVIDERS_LOCK = threading.Lock()
 _LAST_SCRAPE_S: Optional[float] = None
 
@@ -82,6 +100,65 @@ def unregister_health_provider(name: str) -> None:
     teardown)."""
     with _PROVIDERS_LOCK:
         _PROVIDERS.pop(name, None)
+
+
+def register_readiness_provider(name: str, fn) -> None:
+    """Add a named readiness check (``fn() -> bool``) gating ``/readyz``.
+    All registered checks must return truthy for the process to report
+    ready; re-registering a name replaces it."""
+    with _PROVIDERS_LOCK:
+        _READY_PROVIDERS[name] = fn
+
+
+def unregister_readiness_provider(name: str) -> None:
+    with _PROVIDERS_LOCK:
+        _READY_PROVIDERS.pop(name, None)
+
+
+def ready() -> bool:
+    """True when every registered readiness provider reports True (a
+    raising provider counts as not ready; no providers = vacuously
+    ready).  The same answer ``/readyz`` serves, for in-process
+    callers without a socket."""
+    return _readyz()[0]
+
+
+def _readyz():
+    with _PROVIDERS_LOCK:
+        providers = list(_READY_PROVIDERS.items())
+    checks = {}
+    ok = True
+    for name, fn in providers:
+        try:
+            up = bool(fn())
+        except Exception as e:  # unknown state must not attract traffic
+            checks[name] = {"error": f"{type(e).__name__}: {e}"}
+            ok = False
+            continue
+        checks[name] = up
+        ok = ok and up
+    return ok, {"ready": ok, "checks": checks}
+
+
+def register_route(method: str, path: str, fn) -> None:
+    """Mount an extra endpoint on the live exporter socket:
+    ``fn(query: dict, body: bytes) -> (status: int, doc)`` where ``doc``
+    is JSON-serialized for the response body (a serve replica mounts its
+    ``POST /v1/submit`` and ``POST /chaos`` handlers here, so one port
+    per process carries metrics, health, and traffic).  A handler that
+    raises answers 500 without taking down the server."""
+    with _PROVIDERS_LOCK:
+        _ROUTES[(method.upper(), path)] = fn
+
+
+def unregister_route(method: str, path: str) -> None:
+    with _PROVIDERS_LOCK:
+        _ROUTES.pop((method.upper(), path), None)
+
+
+def _route(method: str, path: str):
+    with _PROVIDERS_LOCK:
+        return _ROUTES.get((method.upper(), path))
 
 
 def _healthz() -> dict:
@@ -140,26 +217,55 @@ def _scrape() -> bytes:
 class _Handler(BaseHTTPRequestHandler):
     server_version = "srj-tpu-metrics/1.0"
 
-    def do_GET(self):  # noqa: N802 (http.server API)
-        path = self.path.split("?", 1)[0]
-        if path == "/metrics":
-            body = _scrape()
-            ctype = "text/plain; version=0.0.4; charset=utf-8"
-        elif path == "/healthz":
-            body = (json.dumps(_healthz()) + "\n").encode("utf-8")
-            ctype = "application/json"
-        else:
-            self.send_error(404, "try /metrics or /healthz")
-            return
-        self.send_response(200)
+    def _respond(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
 
+    def _dispatch_route(self, fn, parts) -> None:
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+            payload = self.rfile.read(n) if n else b""
+            query = {k: v[-1] for k, v in parse_qs(parts.query).items()}
+            code, doc = fn(query, payload)
+            body = (json.dumps(doc, default=str) + "\n").encode("utf-8")
+        except Exception as e:  # a sick handler must not kill the server
+            code = 500
+            body = (json.dumps(
+                {"error": f"{type(e).__name__}: {e}"}) + "\n").encode()
+        self._respond(code, body, "application/json")
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        parts = urlsplit(self.path)
+        path = parts.path
+        if path == "/metrics":
+            self._respond(200, _scrape(),
+                          "text/plain; version=0.0.4; charset=utf-8")
+            return
+        if path == "/healthz":
+            body = (json.dumps(_healthz()) + "\n").encode("utf-8")
+            self._respond(200, body, "application/json")
+            return
+        if path == "/readyz":
+            ok, doc = _readyz()
+            body = (json.dumps(doc) + "\n").encode("utf-8")
+            self._respond(200 if ok else 503, body, "application/json")
+            return
+        fn = _route("GET", path)
+        if fn is not None:
+            self._dispatch_route(fn, parts)
+            return
+        self.send_error(404, "try /metrics, /healthz or /readyz")
+
     def do_POST(self):  # noqa: N802 (http.server API)
         parts = urlsplit(self.path)
         if parts.path != "/profile":
+            fn = _route("POST", parts.path)
+            if fn is not None:
+                self._dispatch_route(fn, parts)
+                return
             self.send_error(404, "try POST /profile[?ms=N]")
             return
         ms = None

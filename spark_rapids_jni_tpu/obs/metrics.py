@@ -41,7 +41,21 @@ offline report also computes use the SAME metric names as ``report
   cardinality cap**: only the first ``SRJ_TPU_SERVE_MAX_TENANTS``
   (default 64) distinct tenants get their own label value; later ones
   fold into ``tenant="_overflow"`` so a tenant-id flood cannot blow up
-  the registry or the scrape size.
+  the registry or the scrape size.  ``serve_resubmits_total{tenant}``
+  counts admission retries after ``QueueFull(full)`` under a deadline
+  (:meth:`serve.Client._submit`).
+- ``srj_tpu_fleet_*`` — the serving fleet (:mod:`serve.fleet` /
+  :mod:`serve.router`): supervisor-side ``replicas{state}`` gauge
+  (starting|up|dead), ``restarts_total`` / ``heartbeat_misses_total``
+  (``{replica}``), ``deaths_total`` (``{replica,cause}`` =
+  exit|heartbeat|stall), ``gossip_corrupt_total`` (torn gossip reads
+  that loaded as empty); router-side ``routed_total{replica}``,
+  ``failovers_total{op}`` (in-flight re-routes after a transport
+  failure), ``requeues_total{op}`` (QueueFull(full) answers re-routed
+  to another replica), ``no_replica_total`` (rounds with nothing
+  routable).
+- ``srj_tpu_diag_evictions_total`` — flight-recorder bundles evicted to
+  honor the ``SRJ_TPU_DIAG_MAX_BYTES`` disk cap (:mod:`obs.recorder`).
 
 Quantiles without unbounded memory: a fourth family kind, ``summary``,
 holds a :class:`P2Quantile` estimator (Jain & Chlamtac's P² algorithm —

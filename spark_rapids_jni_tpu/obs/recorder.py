@@ -30,8 +30,13 @@ Armed by ``SRJ_TPU_DIAG_DIR=<dir>`` (or :func:`arm`); disarmed it is
 free — ``on_error`` is one attribute check, ``register_program`` a no-op.
 Bundles are deduped per (span name, error type) and capped at
 ``SRJ_TPU_DIAG_MAX`` per process so a hot failing loop cannot fill a
-disk.  Like the rest of obs, nothing here ever raises into the operation
-it observes.
+disk.  ``SRJ_TPU_DIAG_MAX_BYTES`` additionally caps the diag dir by
+*bytes across processes*: before writing a new bundle, the oldest
+existing bundles are evicted until total usage fits under the cap
+(``srj_tpu_diag_evictions_total``) — the per-process count cap cannot
+protect a disk from a crash-looping fleet whose every incarnation is a
+fresh pid.  Like the rest of obs, nothing here ever raises into the
+operation it observes.
 """
 
 from __future__ import annotations
@@ -188,6 +193,56 @@ def _matching_programs(ev: Dict) -> List[Tuple[Tuple, Tuple]]:
 # Bundle dump
 # ---------------------------------------------------------------------------
 
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                pass
+    return total
+
+
+def _evict_for_bytes(base: str) -> None:
+    """Enforce ``SRJ_TPU_DIAG_MAX_BYTES`` (0/unset = unlimited): drop the
+    oldest ``bundle-*`` directories under ``base`` until existing usage
+    is below the cap, so the bundle about to be written displaces
+    history instead of growing the footprint.  Cross-process by design
+    (mtime order, not this process's seq) — a crash-looping fleet of
+    fresh pids shares one disk.  Best-effort; never raises."""
+    try:
+        max_bytes = int(os.environ.get("SRJ_TPU_DIAG_MAX_BYTES", "0") or 0)
+        if max_bytes <= 0 or not os.path.isdir(base):
+            return
+        bundles = []
+        for name in os.listdir(base):
+            p = os.path.join(base, name)
+            if name.startswith("bundle-") and os.path.isdir(p):
+                try:
+                    bundles.append((os.path.getmtime(p), p, _dir_bytes(p)))
+                except OSError:
+                    pass
+        bundles.sort()                              # oldest first
+        total = sum(sz for _t, _p, sz in bundles)
+        import shutil
+        for _t, p, sz in bundles:
+            if total < max_bytes:
+                break
+            shutil.rmtree(p, ignore_errors=True)
+            total -= sz
+            try:
+                from spark_rapids_jni_tpu.obs import metrics as _m
+                _m.counter(
+                    "srj_tpu_diag_evictions_total",
+                    "Flight-recorder bundles evicted to honor "
+                    "SRJ_TPU_DIAG_MAX_BYTES.").inc()
+            except Exception:
+                pass
+    except Exception:
+        pass
+
+
 def _env_snapshot() -> Dict:
     env = {k: v for k, v in sorted(os.environ.items())
            if k.startswith(("SRJ_TPU_", "SRJ_", "JAX_", "XLA_FLAGS"))}
@@ -246,6 +301,7 @@ def dump_bundle(reason: str, ev: Dict) -> Optional[str]:
             _R.seen.add(key)
             seq = _R.seq
             _R.seq += 1
+        _evict_for_bytes(base)
         path = os.path.join(
             base, f"bundle-{reason}-{seq:03d}-{os.getpid()}")
         os.makedirs(path, exist_ok=True)

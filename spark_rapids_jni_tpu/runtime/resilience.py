@@ -100,6 +100,7 @@ __all__ = [
     "TRANSIENT", "RESOURCE", "DETERMINISTIC", "FATAL",
     "DeadlineExceeded", "classify", "Policy", "default_policy",
     "Breaker", "breaker", "breakers", "allow_impl", "reset_breakers",
+    "export_breakers", "import_breakers",
     "ArraySplitter", "run", "remaining", "health",
 ]
 
@@ -267,6 +268,12 @@ class Breaker:
         self._opened_at: Optional[float] = None
         self._last_probe: Optional[float] = None
         self._open_count = 0
+        # who opened this cell: "local" (this process saw the failures,
+        # or an operator force-opened it here) vs "gossip" (imported
+        # from a fleet peer).  Only local state is re-exported, so a
+        # quarantine gossiped around a fleet can never echo between
+        # replicas forever after the originator recovers.
+        self.origin = "local"
 
     # -- state ------------------------------------------------------------
 
@@ -307,6 +314,10 @@ class Breaker:
         now = time.monotonic()
         opened = 0
         with self._lock:
+            # a locally observed outcome is local evidence: whatever
+            # state it leads to (probe close, reopen, fresh open) is
+            # this process's own and export-worthy
+            self.origin = "local"
             st = self._state_locked(now)
             if st == HALF_OPEN:
                 if ok:                    # probe success: close + forget
@@ -390,6 +401,70 @@ def reset_breakers() -> None:
         _BREAKERS.clear()
 
 
+def export_breakers() -> Dict[str, Dict]:
+    """Serializable snapshot of every non-closed breaker cell THIS
+    process opened (``origin == "local"``): the fleet gossip payload.
+    Cells that were themselves imported from gossip are excluded — a
+    peer's quarantine must not be re-published under our name, or it
+    would echo around the fleet after the originator recovers.  Each
+    entry carries the open age so an importer can resume the cooldown
+    mid-flight instead of restarting it."""
+    out: Dict[str, Dict] = {}
+    now = time.monotonic()
+    for k, b in breakers().items():
+        with b._lock:
+            if b.origin != "local" or b._opened_at is None:
+                continue
+            out["|".join(k)] = {
+                "state": b._state_locked(now),
+                "age_s": round(max(0.0, now - b._opened_at), 3),
+                "cooldown_s": b.cooldown_s,
+            }
+    return out
+
+
+def import_breakers(doc: Dict, origin: str = "gossip") -> int:
+    """Adopt a peer's exported breaker state: every listed cell is
+    opened here with the remote's remaining cooldown (``origin`` tagged
+    so it is never re-exported).  Local evidence wins — a cell this
+    process opened itself, or currently holds open from its own
+    outcomes, is left untouched.  Cells previously imported under the
+    same ``origin`` but absent from ``doc`` are reset (the originator
+    recovered; the quarantine lifts fleet-wide on the next gossip
+    round).  Returns the number of cells now quarantined on the peer's
+    word; malformed input imports nothing and never raises."""
+    if not isinstance(doc, dict):
+        return 0
+    valid = {}
+    for cell, info in doc.items():
+        parts = str(cell).split("|")
+        if len(parts) == 4 and isinstance(info, dict):
+            valid[tuple(parts)] = info
+    n = 0
+    now = time.monotonic()
+    for key, b in breakers().items():
+        if b.origin == origin and key not in valid:
+            with b._lock:
+                if b.origin == origin:      # unchanged since the peek
+                    b._opened_at = None
+                    b._last_probe = None
+                    b._outcomes.clear()
+    for key, info in valid.items():
+        b = breaker(*key)
+        with b._lock:
+            if b.origin == "local" and b._opened_at is not None:
+                continue                    # our own open outranks gossip
+            try:
+                age = max(0.0, float(info.get("age_s", 0.0)))
+            except (TypeError, ValueError):
+                age = 0.0
+            b.origin = origin
+            b._opened_at = now - age
+            b._last_probe = None
+            n += 1
+    return n
+
+
 def allow_impl(op: str, sig: Any = "", bucket: Any = "",
                impl: str = "pallas") -> bool:
     """Routing peek for ``pallas_kernels.choose()``: False when a
@@ -417,11 +492,14 @@ def health() -> Dict:
     breaker by name, plus registry size."""
     snap = breakers()
     states = {"|".join(k): b.state for k, b in snap.items()}
+    origins = {"|".join(k): b.origin for k, b in snap.items()}
     return {
         "breakers": len(snap),
         "open": sorted(k for k, s in states.items() if s == OPEN),
         "half_open": sorted(k for k, s in states.items()
                             if s == HALF_OPEN),
+        "imported": sorted(k for k, s in states.items()
+                           if s != CLOSED and origins[k] != "local"),
     }
 
 

@@ -23,7 +23,13 @@ Admission control raises :class:`QueueFull` instead of blocking;
 ``srj_tpu_serve_*`` metric families cover per-tenant rows/bytes/latency
 (tenant label capped at ``SRJ_TPU_SERVE_MAX_TENANTS`` distinct values).
 ``python -m spark_rapids_jni_tpu.serve`` runs a self-contained demo.
-"""
+
+Fleet mode scales this horizontally: :class:`fleet.Supervisor` runs N
+replica processes (``serve.replica`` — scheduler + exporter each),
+:class:`router.Router` routes on health with (op, bucket) affinity and
+fails in-flight requests over on idempotency keys, and
+:class:`chaos.ChaosHarness` kills/stalls/OOMs replicas on a schedule to
+prove it.  See the README "Fleet" section."""
 
 from spark_rapids_jni_tpu.serve.client import Client  # noqa: F401
 from spark_rapids_jni_tpu.serve.queue import QueueFull  # noqa: F401
@@ -31,5 +37,13 @@ from spark_rapids_jni_tpu.serve.scheduler import (  # noqa: F401
     Config, Scheduler,
 )
 from spark_rapids_jni_tpu.serve import ops  # noqa: F401
+from spark_rapids_jni_tpu.serve import chaos, fleet  # noqa: F401
+from spark_rapids_jni_tpu.serve.chaos import (  # noqa: F401
+    ChaosEvent, ChaosHarness,
+)
+from spark_rapids_jni_tpu.serve.fleet import Supervisor  # noqa: F401
+from spark_rapids_jni_tpu.serve.router import Router  # noqa: F401
 
-__all__ = ["Client", "Config", "QueueFull", "Scheduler", "ops"]
+__all__ = ["ChaosEvent", "ChaosHarness", "Client", "Config",
+           "QueueFull", "Router", "Scheduler", "Supervisor", "chaos",
+           "fleet", "ops"]

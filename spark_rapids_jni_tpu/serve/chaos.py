@@ -1,0 +1,174 @@
+"""Fleet chaos harness: kill / stall / OOM a chosen replica on a
+schedule, mid-burst.
+
+The fleet analogue of the per-process ``faultinj`` injector: where
+``faultinj`` perturbs one dispatch boundary, this harness perturbs the
+*fleet topology* while traffic is in flight, so the failover path
+(idempotency keys, router re-routing, warm replacement — see
+:mod:`serve.fleet` and :mod:`serve.router`) is exercised under load
+instead of trusted.
+
+A schedule is a list of :class:`ChaosEvent` (or the compact string
+form, one event per ``;``)::
+
+    "1.5:kill:0; 3.0:stall:1:ms=2000; 5.0:oom:2:count=3"
+     ^at_s ^action ^replica           ^params (k=v, comma-separated)
+
+Actions
+-------
+``kill``
+    Hard SIGKILL via ``Supervisor.kill`` — no shutdown grace, the
+    replica dies with requests in flight.  The supervisor's monitor
+    declares it and (under ``auto_restart``) respawns the slot warm.
+``stall`` (``ms=N``)
+    ``POST /chaos`` — the replica's submit path wedges for N ms while
+    its heartbeat keeps answering: the watchdog-declared-death case.
+``oom`` (``count=N``)
+    ``POST /chaos`` — arms ``faultinj`` on the replica to fail its next
+    N dispatches with the OOM return code; the serve fallback and
+    breaker machinery absorb them.
+``force_breaker`` (``op=...,sig=...,bucket=...,impl=...``)
+    Force-open one breaker cell on the replica — the gossip propagation
+    test's trigger.
+``reset``
+    Clear stall + uninstall faultinj on the replica.
+
+The harness runs on its own thread (``start()`` / ``join()``); every
+applied event lands in :attr:`ChaosHarness.log` with its wall-clock
+offset and outcome, so tests and the bench fleet axis can assert the
+schedule actually happened."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional, Sequence, Union
+
+__all__ = ["ChaosEvent", "ChaosHarness", "parse_schedule"]
+
+_ACTIONS = ("kill", "stall", "oom", "force_breaker", "reset")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosEvent:
+    at_s: float                 # offset from harness start
+    action: str                 # one of _ACTIONS
+    replica: int
+    params: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.action not in _ACTIONS:
+            raise ValueError(
+                f"unknown chaos action {self.action!r}; "
+                f"expected one of {_ACTIONS}")
+
+
+def parse_schedule(spec: Union[str, Sequence[ChaosEvent]]
+                   ) -> List[ChaosEvent]:
+    """``"1.5:kill:0; 3:stall:1:ms=2000"`` → sorted event list (a
+    sequence of :class:`ChaosEvent` passes through, sorted)."""
+    if not isinstance(spec, str):
+        return sorted(spec, key=lambda e: e.at_s)
+    events: List[ChaosEvent] = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        if len(fields) < 3:
+            raise ValueError(
+                f"bad chaos event {part!r}: want at_s:action:replica"
+                f"[:k=v,...]")
+        params: Dict[str, str] = {}
+        for kv in ":".join(fields[3:]).split(","):
+            kv = kv.strip()
+            if kv:
+                k, _, v = kv.partition("=")
+                params[k.strip()] = v.strip()
+        events.append(ChaosEvent(at_s=float(fields[0]),
+                                 action=fields[1].strip(),
+                                 replica=int(fields[2]),
+                                 params=params))
+    return sorted(events, key=lambda e: e.at_s)
+
+
+class ChaosHarness:
+    """Apply a chaos schedule against a live :class:`fleet.Supervisor`.
+
+    ::
+
+        harness = chaos.ChaosHarness(sup, "1.0:kill:1")
+        harness.start()
+        ... drive traffic ...
+        harness.join()
+        assert harness.log[0]["ok"]
+    """
+
+    def __init__(self, supervisor,
+                 schedule: Union[str, Sequence[ChaosEvent]],
+                 host: str = "127.0.0.1"):
+        self.supervisor = supervisor
+        self.schedule = parse_schedule(schedule)
+        self.host = host
+        self.log: List[dict] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def start(self) -> "ChaosHarness":
+        self._thread = threading.Thread(
+            target=self._run, name="srj-fleet-chaos", daemon=True)
+        self._thread.start()
+        return self
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.join(5.0)
+
+    # -- internals ---------------------------------------------------------
+
+    def _run(self) -> None:
+        t0 = time.monotonic()
+        for ev in self.schedule:
+            wait = ev.at_s - (time.monotonic() - t0)
+            if wait > 0 and self._stop.wait(wait):
+                return
+            entry = {"at_s": round(time.monotonic() - t0, 3),
+                     "action": ev.action, "replica": ev.replica,
+                     "params": dict(ev.params), "ok": False}
+            try:
+                self._apply(ev)
+                entry["ok"] = True
+            except Exception as e:   # chaos must not crash the test
+                entry["error"] = f"{type(e).__name__}: {e}"
+            self.log.append(entry)
+
+    def _apply(self, ev: ChaosEvent) -> None:
+        if ev.action == "kill":
+            self.supervisor.kill(ev.replica, hard=True)
+            return
+        body: Dict[str, object] = {"action": ev.action}
+        body.update(ev.params)
+        for k in ("ms", "count", "code"):
+            if k in body:
+                body[k] = float(body[k])     # type: ignore[arg-type]
+        port = self.supervisor.endpoints().get(ev.replica)
+        if port is None:
+            raise RuntimeError(
+                f"replica {ev.replica} has no live endpoint")
+        req = urllib.request.Request(
+            f"http://{self.host}:{port}/chaos",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=10.0) as resp:
+            doc = json.loads(resp.read())
+        if not doc.get("ok"):
+            raise RuntimeError(f"chaos {ev.action} rejected: {doc}")

@@ -17,9 +17,12 @@ without an exporter socket."""
 from __future__ import annotations
 
 import contextlib
+import time
 from typing import Optional, Sequence
 
 from spark_rapids_jni_tpu.obs import context as _context
+from spark_rapids_jni_tpu.runtime import resilience as _resilience
+from spark_rapids_jni_tpu.serve.queue import QueueFull
 
 __all__ = ["Client"]
 
@@ -35,6 +38,61 @@ class Client:
         identical to the ``memory`` sub-document on ``/healthz``."""
         from spark_rapids_jni_tpu.obs import memwatch as _memwatch
         return _memwatch.health()
+
+    @staticmethod
+    def ready() -> bool:
+        """Readiness of this serving process: True when every registered
+        readiness provider (``obs.exporter``) reports ready — the same
+        answer ``GET /readyz`` gives a fleet router over the socket.  A
+        plain in-process scheduler with no warm-start phase registers no
+        providers and is vacuously ready."""
+        from spark_rapids_jni_tpu.obs import exporter as _exporter
+        return _exporter.ready()
+
+    def _submit(self, op: str, deadline_s: Optional[float], kwargs: dict):
+        """Submit with admission-retry: a ``QueueFull(reason="full")``
+        is a *momentary* condition (one tick of drain frees a slot), so
+        with a deadline in hand we retry under decorrelated-jitter
+        backoff (the :mod:`runtime.resilience` policy) until admitted or
+        the deadline expires — never sleeping past ``deadline_s``, and
+        passing the scheduler only the *remaining* budget so the queued
+        request still expires at the caller's original instant.  On
+        expiry raises :class:`resilience.DeadlineExceeded`.  Shedding /
+        SLO-burn / closed rejections re-raise immediately (those clear
+        on the queue's terms, not the caller's), as does ``full`` with
+        no deadline to bound the retry loop."""
+        if deadline_s is None:
+            return self._sched.submit(self.tenant, op, **kwargs)
+        deadline = time.monotonic() + float(deadline_s)
+        policy = _resilience.default_policy()
+        prev_sleep = policy.base_s
+        while True:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise _resilience.DeadlineExceeded(
+                    f"serve.{op}", float(deadline_s))
+            try:
+                return self._sched.submit(self.tenant, op,
+                                          deadline_s=left, **kwargs)
+            except QueueFull as e:
+                if e.reason != "full":
+                    raise
+                sleep = min(_resilience.backoff_s(prev_sleep, policy),
+                            max(0.0, deadline - time.monotonic()))
+                if sleep <= 0:
+                    raise _resilience.DeadlineExceeded(
+                        f"serve.{op}", float(deadline_s))
+                try:
+                    from spark_rapids_jni_tpu.obs import metrics as _m
+                    _m.counter(
+                        "srj_tpu_serve_resubmits_total",
+                        "Admission retries after QueueFull(full), by "
+                        "tenant (capped).", ("tenant",)).inc(
+                            tenant=self._sched._tenant_label(self.tenant))
+                except Exception:
+                    pass
+                time.sleep(sleep)
+                prev_sleep = max(sleep, policy.base_s)
 
     @contextlib.contextmanager
     def traced(self, trace_id: Optional[str] = None):
@@ -55,36 +113,34 @@ class Client:
         ``deadline_s`` (here and on every method below) bounds the
         request's total queue+dispatch time: past it the scheduler drops
         the request *before* staging and its future carries
-        :class:`runtime.resilience.DeadlineExceeded`.  Omitted, the
-        ``SRJ_TPU_SERVE_DEADLINE_MS`` scheduler default applies."""
+        :class:`runtime.resilience.DeadlineExceeded`.  It also bounds
+        admission: a ``QueueFull(reason="full")`` rejection retries with
+        backoff until the deadline instead of failing the caller on a
+        momentarily-full queue (see :meth:`_submit`).  Omitted, the
+        ``SRJ_TPU_SERVE_DEADLINE_MS`` scheduler default applies (with
+        no admission retry)."""
         kw = {} if max_groups is None else {"max_groups": max_groups}
-        if deadline_s is not None:
-            kw["deadline_s"] = deadline_s
-        return self._sched.submit(self.tenant, "agg", keys=keys,
-                                  values=values, **kw)
+        kw.update(keys=keys, values=values)
+        return self._submit("agg", deadline_s, kw)
 
     def join(self, build_keys, build_payload, probe_keys,
              deadline_s: Optional[float] = None):
         """Unique-key equi-join; resolves to ``{payload, matched}``
         aligned with ``probe_keys`` (unmatched payload slots are 0)."""
-        kw = {} if deadline_s is None else {"deadline_s": deadline_s}
-        return self._sched.submit(
-            self.tenant, "join", build_keys=build_keys,
-            build_payload=build_payload, probe_keys=probe_keys, **kw)
+        return self._submit("join", deadline_s, dict(
+            build_keys=build_keys, build_payload=build_payload,
+            probe_keys=probe_keys))
 
     def to_rows(self, columns: Sequence,
                 deadline_s: Optional[float] = None):
         """JCUDF fixed-width row conversion of all-valid int32 columns;
         resolves to ``{rows, row_size, num_rows}`` (flat uint8)."""
-        kw = {} if deadline_s is None else {"deadline_s": deadline_s}
-        return self._sched.submit(self.tenant, "rows", columns=columns,
-                                  **kw)
+        return self._submit("rows", deadline_s, dict(columns=columns))
 
     def from_rows(self, rows, ncols: int,
                   deadline_s: Optional[float] = None):
         """JCUDF row decode back to ``ncols`` all-valid int32 columns
         (the inverse of :meth:`to_rows`); resolves to ``{columns,
         num_rows}``.  ``rows``: flat uint8 blob or ``[n, row_size]``."""
-        kw = {} if deadline_s is None else {"deadline_s": deadline_s}
-        return self._sched.submit(self.tenant, "unrows", rows=rows,
-                                  ncols=ncols, **kw)
+        return self._submit("unrows", deadline_s,
+                            dict(rows=rows, ncols=ncols))

@@ -1,0 +1,536 @@
+"""Fleet supervisor: N serve replicas, heartbeat supervision, warm
+replacement.
+
+The horizontally-scaled serving fleet (ROADMAP): a :class:`Supervisor`
+spawns ``SRJ_TPU_FLEET_REPLICAS`` replica processes (each is
+``python -m spark_rapids_jni_tpu.serve.replica`` — the existing
+scheduler + exporter on its own ephemeral port) and keeps them alive:
+
+**Heartbeat supervision.**  A monitor thread polls each replica every
+``SRJ_TPU_FLEET_HEARTBEAT_MS``: a dead process (``proc.poll()``), a
+socket error / timeout on ``/healthz`` repeated ``SRJ_TPU_FLEET_
+MISS_LIMIT`` times, or a replica self-reporting ``stalled`` (its chaos
+stall flag — the watchdog-declared case) all mark the replica dead; the
+supervisor hard-kills the remains and respawns the slot.  Routers
+(:mod:`serve.router`) learn the replacement's new port from
+:meth:`endpoints` on their next routing round; in-flight requests to the
+dead replica fail over on their idempotency keys.
+
+**Warm replacement.**  The fleet shares one directory of persisted
+state: the jit compilation cache (``<fleet_dir>/jitcache`` — jax's
+persistent cache, shipped to every replica via
+``SRJ_TPU_FLEET_CACHE_DIR`` while ``SRJ_TPU_FLEET_WARM_SHIP`` is on)
+plus ``CALIBRATION.json`` / ``FOOTPRINTS.json`` / ``PLAN_STATS.json``
+(seeded from the supervisor's cwd when present, then maintained by the
+replicas themselves through the files' existing atomic-write
+discipline).  A replacement replica therefore warm-starts: its warmup
+programs deserialize from the shipped cache instead of recompiling
+(provable via ``obs.compilemon`` — ``cache_hits`` > 0 and backend
+compiles strictly below a cold start), and it prices/admits with the
+fleet's live calibration and footprint knowledge from its first
+request.
+
+**Gossip.**  ``SRJ_TPU_FLEET_GOSSIP_FILE`` (default
+``<fleet_dir>/GOSSIP.json``) is a small JSON document each replica
+read-merges-writes on a timer: its own section carries liveness plus
+``resilience.export_breakers()`` — the breaker/drift-quarantine cells
+*that replica itself* opened.  Every replica imports every peer's cells
+(``resilience.import_breakers``, origin-tagged so imports are never
+re-exported), so one replica's Pallas quarantine protects the rest of
+the fleet within one gossip period.  The file is advisory and torn-write
+tolerant: :func:`load_gossip` returns empty-with-warning on a truncated
+or malformed read (a replica killed mid-write must never poison its
+successor — ``tests/test_fleet.py`` proves the truncation shapes).
+
+Knobs: ``SRJ_TPU_FLEET_REPLICAS`` (default 3), ``SRJ_TPU_FLEET_
+HEARTBEAT_MS`` (500), ``SRJ_TPU_FLEET_GOSSIP_FILE``, ``SRJ_TPU_FLEET_
+WARM_SHIP`` (1), ``SRJ_TPU_FLEET_MISS_LIMIT`` (3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+__all__ = ["Supervisor", "load_gossip", "publish_gossip", "gossip_path"]
+
+STATE_FILES = ("CALIBRATION.json", "FOOTPRINTS.json", "PLAN_STATS.json")
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+# ---------------------------------------------------------------------------
+# Gossip file (atomic RMW, torn-write tolerant)
+# ---------------------------------------------------------------------------
+
+def gossip_path(fleet_dir: Optional[str] = None) -> str:
+    return (os.environ.get("SRJ_TPU_FLEET_GOSSIP_FILE")
+            or os.path.join(fleet_dir or ".", "GOSSIP.json"))
+
+
+def load_gossip(path: str) -> Dict:
+    """Read the fleet gossip doc; a missing file is simply ``{}`` and a
+    torn/truncated/malformed one (a replica killed mid-write) loads as
+    empty **with a warning** — never an exception: the gossip file is
+    advisory state, and a corrupt advisory must not take down the
+    replica reading it."""
+    try:
+        with open(path, "r") as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return {}
+    except (OSError, ValueError) as e:
+        import sys as _sys
+        print(f"[serve.fleet] unreadable gossip file {path!r} "
+              f"({type(e).__name__}: {e}); treating as empty",
+              file=_sys.stderr)
+        try:
+            from spark_rapids_jni_tpu.obs import metrics as _m
+            _m.counter(
+                "srj_tpu_fleet_gossip_corrupt_total",
+                "Gossip-file reads that found a torn or malformed "
+                "document and fell back to empty.").inc()
+        except Exception:
+            pass
+        return {}
+    if not isinstance(doc, dict) \
+            or not isinstance(doc.get("replicas", {}), dict):
+        return {}
+    return doc
+
+
+def publish_gossip(path: str, replica_id, section: Dict) -> Dict:
+    """Read-merge-write one replica's section into the gossip doc
+    (tmp + ``os.replace``, so readers only ever see whole documents).
+    Concurrent writers race whole-file last-writer-wins; a lost merge is
+    repaired on the loser's next period — acceptable for advisory state
+    refreshed every heartbeat.  Returns the merged doc (peers included),
+    so the caller can import in the same pass.  Never raises."""
+    doc = load_gossip(path)
+    reps = doc.setdefault("replicas", {})
+    reps[str(replica_id)] = section
+    doc["ts"] = time.time()
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+    except OSError:
+        pass
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# Supervisor
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _Replica:
+    rid: int
+    proc: Optional[subprocess.Popen] = None
+    port: Optional[int] = None
+    state: str = "starting"          # starting | up | dead
+    misses: int = 0
+    restarts: int = 0
+    started_at: float = 0.0
+
+
+def _fam():
+    from spark_rapids_jni_tpu.obs import metrics as m
+    return {
+        "replicas": m.gauge(
+            "srj_tpu_fleet_replicas",
+            "Fleet replicas by state.", ("state",)),
+        "restarts": m.counter(
+            "srj_tpu_fleet_restarts_total",
+            "Replica respawns after a declared death, by replica id.",
+            ("replica",)),
+        "misses": m.counter(
+            "srj_tpu_fleet_heartbeat_misses_total",
+            "Heartbeat probes that failed or timed out, by replica id.",
+            ("replica",)),
+        "deaths": m.counter(
+            "srj_tpu_fleet_deaths_total",
+            "Replica death declarations, by replica id and cause "
+            "(exit|heartbeat|stall).", ("replica", "cause")),
+    }
+
+
+class Supervisor:
+    """Spawn, supervise and warm-replace N serve replicas.
+
+    Use as a context manager::
+
+        with fleet.Supervisor(replicas=3) as sup:
+            router = serve.Router(supervisor=sup)
+            fut = router.aggregate(keys, values, deadline_s=10)
+
+    ``auto_restart`` (default True) respawns a dead replica's slot
+    warm; chaos harnesses flip it off when a test wants to observe the
+    degraded fleet instead."""
+
+    def __init__(self, replicas: Optional[int] = None,
+                 fleet_dir: Optional[str] = None,
+                 heartbeat_ms: Optional[float] = None,
+                 warm_ship: Optional[bool] = None,
+                 auto_restart: bool = True,
+                 env: Optional[Dict[str, str]] = None,
+                 host: str = "127.0.0.1"):
+        self.n = replicas if replicas is not None \
+            else _env_int("SRJ_TPU_FLEET_REPLICAS", 3)
+        self._own_dir = fleet_dir is None
+        self.fleet_dir = fleet_dir or tempfile.mkdtemp(prefix="srj-fleet-")
+        hb = heartbeat_ms if heartbeat_ms is not None \
+            else _env_int("SRJ_TPU_FLEET_HEARTBEAT_MS", 500)
+        self.heartbeat_s = max(0.05, float(hb) / 1e3)
+        self.warm_ship = warm_ship if warm_ship is not None else (
+            os.environ.get("SRJ_TPU_FLEET_WARM_SHIP", "1")
+            not in ("0", "off", "false"))
+        self.auto_restart = auto_restart
+        self.miss_limit = max(1, _env_int("SRJ_TPU_FLEET_MISS_LIMIT", 3))
+        self.host = host
+        self.gossip_file = gossip_path(self.fleet_dir)
+        self._extra_env = dict(env or {})
+        self._replicas: Dict[int, _Replica] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self._m = _fam()
+        self._seed_state_files()
+
+    # -- warm-state shipping ----------------------------------------------
+
+    def _seed_state_files(self) -> None:
+        """Ship the launcher's persisted state into the fleet dir: the
+        calibration/footprint/plan-stats files each replica will point
+        at (copied when the launcher has them — the replicas maintain
+        them from there), and the shared jit-cache dir."""
+        os.makedirs(self.fleet_dir, exist_ok=True)
+        if self.warm_ship:
+            os.makedirs(os.path.join(self.fleet_dir, "jitcache"),
+                        exist_ok=True)
+        env_of = {"CALIBRATION.json": "SRJ_TPU_CALIBRATION_FILE",
+                  "FOOTPRINTS.json": "SRJ_TPU_MEM_FOOTPRINT_FILE",
+                  "PLAN_STATS.json": "SRJ_TPU_PLAN_STATS_FILE"}
+        for name in STATE_FILES:
+            dst = os.path.join(self.fleet_dir, name)
+            src = os.environ.get(env_of[name]) or name
+            try:
+                if os.path.abspath(src) != os.path.abspath(dst) \
+                        and os.path.isfile(src):
+                    shutil.copy2(src, dst)
+            except OSError:
+                pass
+
+    def _child_env(self, rid: int) -> Dict[str, str]:
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        env.update({
+            "SRJ_TPU_FLEET_DIR": self.fleet_dir,
+            "SRJ_TPU_FLEET_ID": str(rid),
+            "SRJ_TPU_FLEET_GOSSIP_FILE": self.gossip_file,
+            "SRJ_TPU_CALIBRATION_FILE":
+                os.path.join(self.fleet_dir, "CALIBRATION.json"),
+            "SRJ_TPU_MEM_FOOTPRINT_FILE":
+                os.path.join(self.fleet_dir, "FOOTPRINTS.json"),
+            "SRJ_TPU_PLAN_STATS_FILE":
+                os.path.join(self.fleet_dir, "PLAN_STATS.json"),
+        })
+        if self.warm_ship:
+            env["SRJ_TPU_FLEET_CACHE_DIR"] = os.path.join(
+                self.fleet_dir, "jitcache")
+        else:
+            env.pop("SRJ_TPU_FLEET_CACHE_DIR", None)
+        env.setdefault("SRJ_TPU_FLEET_GOSSIP_MS",
+                       str(int(self.heartbeat_s * 1e3)))
+        env.update(self._extra_env)
+        return env
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, wait_ready: bool = True,
+              timeout_s: float = 180.0) -> "Supervisor":
+        for rid in range(self.n):
+            self._spawn(rid)
+        if wait_ready:
+            deadline = time.monotonic() + timeout_s
+            for rid in range(self.n):
+                self.wait_ready(rid, max(1.0, deadline - time.monotonic()))
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="srj-fleet-monitor",
+            daemon=True)
+        self._monitor.start()
+        try:
+            from spark_rapids_jni_tpu.obs import exporter as _exporter
+            _exporter.register_health_provider("fleet", self.health)
+        except Exception:
+            pass
+        return self
+
+    def __enter__(self) -> "Supervisor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _hello_path(self, rid: int) -> str:
+        return os.path.join(self.fleet_dir, f"replica-{rid}.json")
+
+    def _spawn(self, rid: int) -> None:
+        try:
+            os.remove(self._hello_path(rid))
+        except OSError:
+            pass
+        log = open(os.path.join(self.fleet_dir, f"replica-{rid}.log"),
+                   "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "spark_rapids_jni_tpu.serve.replica",
+             "--id", str(rid), "--port", "0",
+             "--fleet-dir", self.fleet_dir],
+            env=self._child_env(rid), cwd=self.fleet_dir,
+            stdout=log, stderr=subprocess.STDOUT)
+        log.close()       # the child holds its own fd now
+        with self._lock:
+            r = self._replicas.get(rid) or _Replica(rid=rid)
+            r.proc, r.port, r.state = proc, None, "starting"
+            r.misses, r.started_at = 0, time.monotonic()
+            self._replicas[rid] = r
+        self._publish_gauges()
+
+    def _read_hello(self, r: _Replica) -> Optional[int]:
+        """Non-blocking read of the replica's hello file (written once
+        its exporter is up); learns the bound port when the pid matches
+        the *current* incarnation — a stale hello from a killed
+        predecessor must not resurrect its port."""
+        if r.proc is None:
+            return None
+        try:
+            with open(self._hello_path(r.rid)) as f:
+                doc = json.load(f)
+            if doc.get("pid") == r.proc.pid and doc.get("port"):
+                with self._lock:
+                    r.port = int(doc["port"])
+                return r.port
+        except (OSError, ValueError):
+            pass
+        return None
+
+    def _wait_hello(self, rid: int, timeout_s: float) -> Optional[int]:
+        with self._lock:
+            r = self._replicas.get(rid)
+        if r is None or r.proc is None:
+            return None
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if r.proc.poll() is not None:
+                return None
+            port = self._read_hello(r)
+            if port is not None:
+                return port
+            time.sleep(0.05)
+        return None
+
+    def wait_ready(self, rid: int, timeout_s: float = 120.0) -> bool:
+        """Block until the replica answers 200 on ``/readyz``."""
+        deadline = time.monotonic() + timeout_s
+        port = self._wait_hello(
+            rid, max(0.1, deadline - time.monotonic()))
+        if port is None:
+            return False
+        while time.monotonic() < deadline:
+            try:
+                urllib.request.urlopen(
+                    f"http://{self.host}:{port}/readyz", timeout=2.0)
+                with self._lock:
+                    r = self._replicas.get(rid)
+                    if r is not None:
+                        r.state = "up"
+                self._publish_gauges()
+                return True
+            except Exception:
+                time.sleep(0.1)
+        return False
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        self._stop.set()
+        t = self._monitor
+        if t is not None:
+            t.join(self.heartbeat_s * 4 + 1.0)
+        with self._lock:
+            procs = [(r.rid, r.proc) for r in self._replicas.values()
+                     if r.proc is not None]
+        for _rid, p in procs:
+            if p.poll() is None:
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+        deadline = time.monotonic() + timeout_s
+        for _rid, p in procs:
+            try:
+                p.wait(max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                try:
+                    p.kill()
+                    p.wait(5.0)
+                except OSError:
+                    pass
+        try:
+            from spark_rapids_jni_tpu.obs import exporter as _exporter
+            _exporter.unregister_health_provider("fleet")
+        except Exception:
+            pass
+
+    # -- chaos / introspection --------------------------------------------
+
+    def kill(self, rid: int, hard: bool = True) -> None:
+        """Kill one replica (``hard`` = SIGKILL: the chaos case — no
+        shutdown grace, in-flight requests die with it).  The monitor
+        declares it dead on its next pass and, under ``auto_restart``,
+        respawns the slot warm."""
+        with self._lock:
+            r = self._replicas.get(rid)
+        if r is None or r.proc is None:
+            return
+        try:
+            r.proc.send_signal(
+                signal.SIGKILL if hard else signal.SIGTERM)
+        except OSError:
+            pass
+
+    def endpoints(self) -> Dict[int, int]:
+        """Live ``{replica_id: port}`` for replicas that have said
+        hello and are not declared dead."""
+        with self._lock:
+            return {r.rid: r.port for r in self._replicas.values()
+                    if r.port is not None and r.state != "dead"}
+
+    def replica(self, rid: int) -> Optional[_Replica]:
+        with self._lock:
+            return self._replicas.get(rid)
+
+    def healthz(self, rid: int, timeout: float = 2.0) -> Optional[dict]:
+        with self._lock:
+            r = self._replicas.get(rid)
+        if r is None or r.port is None:
+            return None
+        try:
+            return json.loads(urllib.request.urlopen(
+                f"http://{self.host}:{r.port}/healthz",
+                timeout=timeout).read())
+        except Exception:
+            return None
+
+    def health(self) -> dict:
+        """The ``fleet`` sub-document on ``/healthz``."""
+        with self._lock:
+            reps = {r.rid: {"state": r.state, "port": r.port,
+                            "restarts": r.restarts, "misses": r.misses}
+                    for r in self._replicas.values()}
+        return {
+            "replicas": self.n,
+            "up": sorted(k for k, v in reps.items()
+                         if v["state"] == "up"),
+            "restarts": sum(v["restarts"] for v in reps.values()),
+            "detail": reps,
+            "gossip_file": self.gossip_file,
+            "warm_ship": self.warm_ship,
+        }
+
+    def _publish_gauges(self) -> None:
+        try:
+            with self._lock:
+                states = [r.state for r in self._replicas.values()]
+            for st in ("starting", "up", "dead"):
+                self._m["replicas"].set(states.count(st), state=st)
+        except Exception:
+            pass
+
+    # -- the monitor -------------------------------------------------------
+
+    def _monitor_loop(self) -> None:
+        hb_timeout = max(0.5, self.heartbeat_s * 2)
+        while not self._stop.wait(self.heartbeat_s):
+            with self._lock:
+                reps = list(self._replicas.values())
+            for r in reps:
+                if r.proc is None or r.state == "dead":
+                    continue
+                cause = None
+                if r.proc.poll() is not None:
+                    cause = "exit"
+                else:
+                    if r.port is None:
+                        # a (re)spawned slot says hello when its
+                        # exporter binds; learn the port here so routers
+                        # see the replacement without any wait_ready
+                        self._read_hello(r)
+                    doc = None
+                    if r.port is not None:
+                        try:
+                            doc = json.loads(urllib.request.urlopen(
+                                f"http://{self.host}:{r.port}/healthz",
+                                timeout=hb_timeout).read())
+                        except Exception:
+                            doc = None
+                    if doc is None:
+                        if r.port is not None or (
+                                time.monotonic() - r.started_at
+                                > 60 * self.heartbeat_s):
+                            r.misses += 1
+                            self._m["misses"].inc(replica=str(r.rid))
+                        if r.misses >= self.miss_limit:
+                            cause = "heartbeat"
+                    else:
+                        r.misses = 0
+                        if r.state != "up" and (
+                                doc.get("replica") or {}).get("ready"):
+                            with self._lock:
+                                r.state = "up"
+                        rep = doc.get("replica") or {}
+                        if rep.get("stalled"):
+                            # watchdog-declared: the replica admits its
+                            # serving path is wedged — same as dead for
+                            # routing AND replacement purposes
+                            cause = "stall"
+                if cause is None:
+                    continue
+                self._declare_dead(r, cause)
+            self._publish_gauges()
+
+    def _declare_dead(self, r: _Replica, cause: str) -> None:
+        self._m["deaths"].inc(replica=str(r.rid), cause=cause)
+        with self._lock:
+            r.state = "dead"
+            r.port = None
+        if r.proc is not None and r.proc.poll() is None:
+            try:
+                r.proc.kill()       # make the declaration true
+                r.proc.wait(5.0)
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+        if self.auto_restart and not self._stop.is_set():
+            with self._lock:
+                r.restarts += 1
+            self._m["restarts"].inc(replica=str(r.rid))
+            self._spawn(r.rid)

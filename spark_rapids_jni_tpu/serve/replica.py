@@ -1,0 +1,397 @@
+"""One fleet replica: the existing scheduler + exporter on its own
+port, plus the fleet protocol.
+
+``python -m spark_rapids_jni_tpu.serve.replica --id N --port 0
+--fleet-dir DIR`` runs the standard serving stack (:class:`serve.
+Scheduler` with coalescing, admission control, memory-aware splitting;
+``obs.exporter`` with ``/metrics`` ``/healthz`` ``/readyz``) and mounts
+the fleet endpoints on the same socket:
+
+``POST /v1/submit``
+    Body ``{"key", "tenant", "op", "deadline_s", "kwargs"}`` with
+    kwargs in the router's wire codec (:func:`serve.router.encode_doc`).
+    ``key`` is the request's **idempotency key**: results of completed
+    requests are cached in a bounded LRU keyed on it, so a router
+    re-delivering after a lost ACK gets the recorded response replayed
+    byte-for-byte instead of a second execution.  (A re-delivery to a
+    *different* replica recomputes — safe because every serve op is a
+    deterministic int32 kernel, so the recompute is byte-identical.)
+    Errors come back structured (``queue_full`` with reason/depth/limit,
+    ``deadline``, ``validation``, ``app``) and are **not** cached: a
+    momentary rejection must not be replayed forever on retry.
+
+``POST /chaos``
+    Fault-injection control for the chaos harness: ``stall`` (wedge the
+    submit path for N ms — heartbeats still answer, so this is the
+    watchdog-declared-death case), ``oom`` (arm ``faultinj`` to fail the
+    next N dispatches), ``force_breaker`` (quarantine an impl cell),
+    ``kill`` (hard ``os._exit`` after the response flushes), ``reset``.
+
+**Warm start.**  When the supervisor ships ``SRJ_TPU_FLEET_CACHE_DIR``,
+the jax persistent compilation cache is pointed there *before* any
+compile, so warmup programs (``SRJ_TPU_FLEET_WARM_OPS``) deserialize
+from the fleet's shared cache instead of recompiling — provable from
+this replica's ``/healthz``: ``replica.cache_hits`` > 0 and
+``replica.backend_compiles`` strictly below a cold peer's.  The replica
+reports ``ready: false`` (and ``/readyz`` 503) until warmup completes;
+the router holds traffic off it meanwhile.
+
+**Gossip.**  A background thread publishes this replica's liveness and
+``resilience.export_breakers()`` into the fleet gossip file every
+``SRJ_TPU_FLEET_GOSSIP_MS`` and imports every peer's cells
+(per-peer origin tags, so a quarantine lifts fleet-wide when its
+originator recovers and is never echoed back under our name)."""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["main"]
+
+_READY = threading.Event()
+_STALL_UNTIL = 0.0          # monotonic instant; submit path sleeps past it
+_STALL_LOCK = threading.Lock()
+
+
+def _configure_warm_cache() -> Optional[str]:
+    """Point jax's persistent compilation cache at the fleet's shared
+    dir *before the first compile* (cache config is read at trace
+    time).  Thresholds open the cache to every entry — the serve ops
+    are small CPU/TPU programs a production threshold would skip."""
+    cache_dir = os.environ.get("SRJ_TPU_FLEET_CACHE_DIR")
+    if not cache_dir:
+        return None
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update(
+            "jax_persistent_cache_min_entry_size_bytes", -1)
+        return cache_dir
+    except Exception as e:
+        print(f"[serve.replica] warm cache config failed: {e}",
+              file=sys.stderr)
+        return None
+
+
+def _stalled() -> bool:
+    with _STALL_LOCK:
+        return time.monotonic() < _STALL_UNTIL
+
+
+class _Dedupe:
+    """Bounded LRU of completed ``ok`` responses keyed on idempotency
+    key — the replay store that makes re-delivery after a lost ACK
+    return the already-computed bytes instead of executing twice."""
+
+    def __init__(self, cap: Optional[int] = None):
+        if cap is None:
+            try:
+                cap = int(os.environ.get("SRJ_TPU_FLEET_DEDUPE", "4096"))
+            except ValueError:
+                cap = 4096
+        self.cap = max(1, cap)
+        self._d: "collections.OrderedDict[str, dict]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+        self.replays = 0
+
+    def get(self, key: str) -> Optional[dict]:
+        with self._lock:
+            doc = self._d.get(key)
+            if doc is not None:
+                self._d.move_to_end(key)
+                self.replays += 1
+            return doc
+
+    def put(self, key: str, doc: dict) -> None:
+        with self._lock:
+            self._d[key] = doc
+            self._d.move_to_end(key)
+            while len(self._d) > self.cap:
+                self._d.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._d)
+
+
+def _error_doc(key: str, e: BaseException) -> dict:
+    from spark_rapids_jni_tpu.runtime import resilience as _resilience
+    from spark_rapids_jni_tpu.serve.queue import QueueFull
+    err: Dict = {"type": type(e).__name__, "msg": str(e)}
+    if isinstance(e, QueueFull):
+        err.update(kind="queue_full", reason=e.reason,
+                   depth=e.depth, limit=e.limit)
+    elif isinstance(e, (_resilience.DeadlineExceeded, TimeoutError)):
+        err["kind"] = "deadline"
+    elif isinstance(e, (ValueError, TypeError, KeyError)):
+        err["kind"] = "validation"
+    else:
+        err["kind"] = "app"
+    return {"key": key, "ok": False, "error": err}
+
+
+def _make_submit_handler(scheduler, dedupe: _Dedupe):
+    from spark_rapids_jni_tpu.serve import router as _router
+    from spark_rapids_jni_tpu.serve.client import Client
+
+    def handler(query: dict, body: bytes):
+        # chaos stall: wedge the serving path (health stays answerable
+        # on the exporter's other threads — this is the stall the
+        # supervisor's watchdog, not the heartbeat, must catch)
+        while _stalled():
+            time.sleep(0.01)
+        try:
+            req = json.loads(body or b"{}")
+        except ValueError as e:
+            return 400, {"ok": False,
+                         "error": {"kind": "validation",
+                                   "type": "ValueError",
+                                   "msg": f"bad JSON body: {e}"}}
+        key = str(req.get("key") or "")
+        if key:
+            cached = dedupe.get(key)
+            if cached is not None:
+                return 200, cached
+        op = str(req.get("op") or "")
+        tenant = str(req.get("tenant") or "fleet")
+        deadline_s = req.get("deadline_s")
+        try:
+            kwargs = _router.decode_doc(req.get("kwargs") or {})
+            client = Client(scheduler, tenant)
+            fut = client._submit(
+                op, None if deadline_s is None else float(deadline_s),
+                kwargs)
+            timeout = (float(deadline_s) + 30.0
+                       if deadline_s is not None else 600.0)
+            result = fut.result(timeout)
+        except BaseException as e:         # noqa: BLE001 — wire boundary
+            return 200, _error_doc(key, e)
+        doc = {"key": key, "ok": True,
+               "result": _router.encode_doc(result)}
+        if key:
+            dedupe.put(key, doc)
+        return 200, doc
+
+    return handler
+
+
+def _make_chaos_handler():
+    def handler(query: dict, body: bytes):
+        global _STALL_UNTIL
+        try:
+            req = json.loads(body or b"{}")
+        except ValueError:
+            req = {}
+        action = str(req.get("action") or query.get("action") or "")
+        if action == "stall":
+            ms = float(req.get("ms", 1000))
+            with _STALL_LOCK:
+                _STALL_UNTIL = time.monotonic() + ms / 1e3
+            return 200, {"ok": True, "action": action, "ms": ms}
+        if action == "oom":
+            count = int(req.get("count", 1))
+            from spark_rapids_jni_tpu.faultinj import injector
+            injector.install(config={
+                "pjrtExecuteFaults": {"*": {
+                    "percent": 100.0,
+                    "injectionType": 2,          # substituted error return
+                    "substituteReturnCode": 2,   # the OOM code
+                    "interceptionCount": count}}})
+            return 200, {"ok": True, "action": action, "count": count}
+        if action == "force_breaker":
+            from spark_rapids_jni_tpu.runtime import resilience
+            cell = (str(req.get("op", "")), str(req.get("sig", "")),
+                    str(req.get("bucket", "")),
+                    str(req.get("impl", "pallas")))
+            resilience.breaker(*cell).force_open()
+            return 200, {"ok": True, "action": action,
+                         "cell": "|".join(cell)}
+        if action == "reset":
+            try:
+                from spark_rapids_jni_tpu.faultinj import injector
+                injector.uninstall()
+            except Exception:
+                pass
+            with _STALL_LOCK:
+                _STALL_UNTIL = 0.0
+            return 200, {"ok": True, "action": action}
+        if action == "kill":
+            # answer first, die just after the response flushes — the
+            # REAL kill path (supervisor SIGKILL) needs no cooperation;
+            # this one exists for schedules driven over HTTP only
+            code = int(req.get("code", 137))
+            threading.Timer(0.05, os._exit, args=(code,)).start()
+            return 200, {"ok": True, "action": action, "code": code}
+        return 400, {"ok": False,
+                     "error": {"kind": "validation",
+                               "msg": f"unknown chaos action {action!r}"}}
+
+    return handler
+
+
+def _warmup(scheduler, spec: str) -> int:
+    """Run the warm set: ``"agg:1000,agg:100"`` → one request per
+    entry, sized to land in that row bucket.  With a shipped jit cache
+    these deserialize; cold they compile and *populate* the shared
+    cache for every later replica.  Returns the number of entries."""
+    import numpy as np
+    from spark_rapids_jni_tpu.serve.client import Client
+    client = Client(scheduler, "warmup")
+    n_done = 0
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        op, _, size = entry.partition(":")
+        try:
+            n = max(1, int(size or 1))
+        except ValueError:
+            n = 1
+        keys = (np.arange(n, dtype=np.int32) % 7).astype(np.int32)
+        vals = np.ones(n, dtype=np.int32)
+        try:
+            if op == "agg":
+                client.aggregate(keys, vals).result(300.0)
+            elif op == "join":
+                bk = np.arange(max(1, n // 2), dtype=np.int32)
+                client.join(bk, bk + 1, keys).result(300.0)
+            elif op == "rows":
+                client.to_rows([keys, vals]).result(300.0)
+            elif op == "unrows":
+                rows = client.to_rows([keys, vals]).result(300.0)
+                client.from_rows(rows["rows"], 2).result(300.0)
+            else:
+                continue
+            n_done += 1
+        except Exception as e:
+            print(f"[serve.replica] warmup {entry!r} failed: {e}",
+                  file=sys.stderr)
+    return n_done
+
+
+def _gossip_loop(path: str, rid: str, stop: threading.Event,
+                 period_s: float) -> None:
+    from spark_rapids_jni_tpu.runtime import resilience
+    from spark_rapids_jni_tpu.serve import fleet as _fleet
+    while not stop.wait(period_s):
+        try:
+            section = {"ts": time.time(), "pid": os.getpid(),
+                       "breakers": resilience.export_breakers()}
+            merged = _fleet.publish_gossip(path, rid, section)
+            for peer, peer_sec in (merged.get("replicas") or {}).items():
+                if str(peer) == str(rid) or not isinstance(peer_sec,
+                                                           dict):
+                    continue
+                resilience.import_breakers(
+                    peer_sec.get("breakers") or {},
+                    origin=f"gossip:{peer}")
+        except Exception as e:
+            print(f"[serve.replica] gossip round failed: {e}",
+                  file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="serve.replica")
+    ap.add_argument("--id", default=os.environ.get(
+        "SRJ_TPU_FLEET_ID", "0"))
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--fleet-dir", default=os.environ.get(
+        "SRJ_TPU_FLEET_DIR", "."))
+    args = ap.parse_args(argv)
+    rid = str(args.id)
+
+    cache_dir = _configure_warm_cache()   # BEFORE anything compiles
+
+    from spark_rapids_jni_tpu import obs
+    from spark_rapids_jni_tpu.obs import compilemon, exporter
+    from spark_rapids_jni_tpu.serve.scheduler import Scheduler
+    obs.enable()
+
+    scheduler = Scheduler().start()
+    dedupe = _Dedupe()
+
+    def _replica_health() -> dict:
+        t = compilemon.totals()
+        compiles = int(t.get("compiles", 0))
+        hits = int(t.get("cache_hits", 0))
+        return {
+            "id": rid,
+            "pid": os.getpid(),
+            "ready": _READY.is_set(),
+            "stalled": _stalled(),
+            "warm_cache": cache_dir,
+            "compiles": compiles,
+            "cache_hits": hits,
+            "cache_requests": int(t.get("cache_requests", 0)),
+            "backend_compiles": max(0, compiles - hits),
+            "dedupe": len(dedupe),
+            "replays": dedupe.replays,
+        }
+
+    exporter.register_readiness_provider("replica", _READY.is_set)
+    exporter.register_health_provider("replica", _replica_health)
+    exporter.register_route("POST", "/v1/submit",
+                            _make_submit_handler(scheduler, dedupe))
+    exporter.register_route("POST", "/chaos", _make_chaos_handler())
+
+    port = exporter.start(args.port)
+    if port is None:
+        print("[serve.replica] exporter bind failed", file=sys.stderr)
+        return 2
+
+    # hello file: the supervisor learns our bound port from here
+    hello = os.path.join(args.fleet_dir, f"replica-{rid}.json")
+    tmp = f"{hello}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"id": rid, "pid": os.getpid(), "port": port,
+                   "ts": time.time()}, f)
+    os.replace(tmp, hello)
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except (OSError, ValueError):
+            pass
+
+    gossip_file = os.environ.get("SRJ_TPU_FLEET_GOSSIP_FILE")
+    if gossip_file:
+        try:
+            period = max(0.05, float(os.environ.get(
+                "SRJ_TPU_FLEET_GOSSIP_MS", "500")) / 1e3)
+        except ValueError:
+            period = 0.5
+        threading.Thread(
+            target=_gossip_loop, args=(gossip_file, rid, stop, period),
+            name="srj-fleet-gossip", daemon=True).start()
+
+    n_warm = _warmup(scheduler, os.environ.get(
+        "SRJ_TPU_FLEET_WARM_OPS", "agg:1000,agg:100"))
+    _READY.set()            # /readyz flips 503 -> 200; router admits us
+    t = compilemon.totals()
+    print(f"[serve.replica] id={rid} port={port} ready "
+          f"(warmed {n_warm} programs, compiles={t.get('compiles', 0)} "
+          f"cache_hits={t.get('cache_hits', 0)})", flush=True)
+
+    stop.wait()
+    try:
+        scheduler.close(drain=False, timeout=10.0)
+    except Exception:
+        pass
+    exporter.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,450 @@
+"""Fleet router: health-scored, affinity-sharded routing with
+idempotent failover.
+
+The client side of the serving fleet (:mod:`serve.fleet`).  A
+:class:`Router` exposes the same four-op surface as :class:`serve.Client`
+but dispatches over HTTP to N replica processes, making three promises:
+
+**Routing is health-aware.**  Every pick reads each replica's
+``/healthz`` (cached for a short TTL so a burst doesn't scrape per
+request): replicas that are not ``ready`` (warm-starting — see
+``/readyz``), shedding, burning their SLO, mid-stall, or short on memory
+headroom are excluded; ties among the healthy break toward the lowest
+queue depth.  Backpressure is therefore cluster-aware — one replica's
+high-water mark routes traffic around it instead of into it.
+
+**Sharding preserves coalescing.**  Requests are sharded by
+``(op, shape-bucket)`` rendezvous hashing, so the K concurrent requests
+that would have coalesced into one mega-batch on a single scheduler
+still land on the *same* replica and still coalesce — spreading a bucket
+uniformly over N replicas would cost N compiles and N dispatches for the
+same work.  Rendezvous (highest-random-weight) hashing keeps the map
+stable under membership churn: a replica death remaps only its own
+buckets.
+
+**Failover never loses or duplicates work.**  Every submit carries an
+idempotency key.  A request is *acknowledged* only when the replica's
+response is fully read; on replica death mid-request (connection error,
+timeout, or the supervisor declaring a stall) the router re-routes the
+unacknowledged request — same key — to a surviving replica, under the
+existing :mod:`runtime.resilience` retry budget (``SRJ_TPU_RETRY_MAX``
+transport failures per request, decorrelated-jitter backoff between
+rounds) and the caller's deadline.  Replicas dedupe on the key and
+replay the stored response byte-for-byte, so a request that was
+*actually* served by a replica that died before answering is recomputed
+deterministically (int32 kernels, bucketed shapes), and one that is
+re-delivered to a live replica is answered from its dedupe cache without
+recompute.  A ``QueueFull(full)`` answer from one replica re-routes to
+the next-best candidate under the same deadline — admission pressure is
+a routing signal, not a failure.
+
+Arrays cross the wire as ``{"__nd__": dtype, shape, base64(raw)}`` so
+results are byte-identical to an in-process run — the chaos proof in
+``tests/test_fleet.py`` compares them with ``np.array_equal`` against a
+single-scheduler reference.
+"""
+
+from __future__ import annotations
+
+import base64
+import concurrent.futures
+import hashlib
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_jni_tpu.runtime import resilience as _resilience
+
+__all__ = ["Router", "encode_doc", "decode_doc", "affinity_bucket"]
+
+
+# ---------------------------------------------------------------------------
+# Wire codec (shared with serve.replica)
+# ---------------------------------------------------------------------------
+
+def _encode_value(v: Any) -> Any:
+    if isinstance(v, (list, tuple)):
+        return [_encode_value(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _encode_value(x) for k, x in v.items()}
+    if hasattr(v, "shape") and hasattr(v, "dtype"):
+        a = np.asarray(v)
+        return {"__nd__": str(a.dtype), "shape": list(a.shape),
+                "b64": base64.b64encode(
+                    np.ascontiguousarray(a).tobytes()).decode("ascii")}
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    return v
+
+
+def _decode_value(v: Any) -> Any:
+    if isinstance(v, dict):
+        if "__nd__" in v:
+            a = np.frombuffer(base64.b64decode(v["b64"]),
+                              dtype=np.dtype(v["__nd__"]))
+            return a.reshape(v["shape"]).copy()
+        return {k: _decode_value(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_decode_value(x) for x in v]
+    return v
+
+
+def encode_doc(doc: Dict) -> Dict:
+    """JSON-safe encoding of a kwargs/result dict: ndarrays (at any
+    nesting depth) become ``{"__nd__": dtype, shape, b64}`` with their
+    exact raw bytes — the decode side reconstructs bit-identical
+    arrays."""
+    return {k: _encode_value(v) for k, v in doc.items()}
+
+
+def decode_doc(doc: Dict) -> Dict:
+    """Inverse of :func:`encode_doc`."""
+    return {k: _decode_value(v) for k, v in doc.items()}
+
+
+def affinity_bucket(op: str, kwargs: Dict) -> int:
+    """The shape-bucket used for (op, bucket) affinity sharding: the
+    pow-2 row bucket of the request's dominant row count — the same
+    coalescing dimension the scheduler groups on, so same-bucket
+    requests route to the same replica and still batch."""
+    try:
+        from spark_rapids_jni_tpu.runtime import shapes as _shapes
+        if op == "agg":
+            n = len(kwargs.get("keys", ()))
+        elif op == "join":
+            n = len(kwargs.get("probe_keys", ()))
+        elif op == "rows":
+            cols = kwargs.get("columns") or ()
+            n = len(cols[0]) if len(cols) else 0
+        elif op == "unrows":
+            r = kwargs.get("rows")
+            n = int(np.asarray(r).shape[0]) if r is not None else 0
+        else:
+            n = 0
+        return int(_shapes.bucket_rows(max(1, int(n))))
+    except Exception:
+        return 0
+
+
+def _fam():
+    from spark_rapids_jni_tpu.obs import metrics as m
+    return {
+        "routed": m.counter(
+            "srj_tpu_fleet_routed_total",
+            "Requests routed to a replica, by replica id.", ("replica",)),
+        "failovers": m.counter(
+            "srj_tpu_fleet_failovers_total",
+            "In-flight requests re-routed to a surviving replica after "
+            "a transport failure (replica death, timeout, stall), by "
+            "op.", ("op",)),
+        "requeues": m.counter(
+            "srj_tpu_fleet_requeues_total",
+            "Requests re-routed to another replica after a "
+            "QueueFull(full) answer, by op.", ("op",)),
+        "no_replica": m.counter(
+            "srj_tpu_fleet_no_replica_total",
+            "Routing rounds that found no routable replica (all dead, "
+            "not ready, or shedding)."),
+    }
+
+
+class Router:
+    """Client-side fleet router over a :class:`serve.fleet.Supervisor`
+    (or a static ``{replica_id: port}`` endpoint map).
+
+    The four op methods mirror :class:`serve.Client` and return
+    ``concurrent.futures.Future``\\ s resolving to the same result dicts
+    (arrays decoded back to ``np.ndarray``)."""
+
+    def __init__(self, supervisor=None,
+                 endpoints: Optional[Dict[int, int]] = None,
+                 tenant: str = "fleet",
+                 health_ttl_s: float = 0.2,
+                 request_timeout_s: float = 60.0,
+                 host: str = "127.0.0.1"):
+        if supervisor is None and endpoints is None:
+            raise ValueError("Router needs a supervisor or endpoints")
+        self._sup = supervisor
+        self._static = dict(endpoints or {})
+        self.tenant = tenant
+        self.host = host
+        self.health_ttl_s = float(health_ttl_s)
+        self.request_timeout_s = float(request_timeout_s)
+        self._m = _fam()
+        self._lock = threading.Lock()
+        self._health: Dict[int, Tuple[float, Optional[dict]]] = {}
+        workers = int(os.environ.get("SRJ_TPU_FLEET_ROUTER_THREADS",
+                                     "8") or 8)
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(2, workers),
+            thread_name_prefix="srj-fleet-router")
+
+    # -- membership / health ----------------------------------------------
+
+    def endpoints(self) -> Dict[int, int]:
+        """Live ``{replica_id: port}`` — re-resolved per routing round so
+        a replacement replica's fresh port is picked up immediately."""
+        if self._sup is not None:
+            return self._sup.endpoints()
+        return dict(self._static)
+
+    def _healthz(self, rid: int, port: int) -> Optional[dict]:
+        now = time.monotonic()
+        with self._lock:
+            hit = self._health.get(rid)
+            if hit is not None and now - hit[0] < self.health_ttl_s:
+                return hit[1]
+        doc: Optional[dict] = None
+        try:
+            doc = json.loads(urllib.request.urlopen(
+                f"http://{self.host}:{port}/healthz",
+                timeout=max(0.5, self.health_ttl_s * 10)).read())
+        except Exception:
+            doc = None
+        with self._lock:
+            self._health[rid] = (now, doc)
+        return doc
+
+    def _forget_health(self, rid: int) -> None:
+        with self._lock:
+            self._health.pop(rid, None)
+
+    @staticmethod
+    def _routable(doc: Optional[dict]) -> bool:
+        """Should this replica receive NEW traffic right now?"""
+        if not isinstance(doc, dict):
+            return False
+        rep = doc.get("replica") or {}
+        if not rep.get("ready", False) or rep.get("stalled", False):
+            return False
+        srv = doc.get("serve") or {}
+        if srv.get("shedding") or srv.get("closed"):
+            return False
+        slo = doc.get("slo") or {}
+        if isinstance(slo, dict) and slo.get("shedding"):
+            return False
+        mem = doc.get("memory") or {}
+        head = mem.get("headroom_bytes")
+        if isinstance(head, (int, float)) and head <= 0:
+            return False
+        return True
+
+    @staticmethod
+    def _depth(doc: Optional[dict]) -> int:
+        try:
+            return int((doc or {}).get("serve", {}).get("queue_depth", 0))
+        except Exception:
+            return 0
+
+    def _candidates(self, op: str, bucket: int,
+                    exclude: Sequence[int] = ()) -> List[Tuple[int, int]]:
+        """Replicas ranked for this ``(op, bucket)``: rendezvous order
+        over the routable set (affinity — the hash winner owns the
+        bucket), with heavily-loaded winners demoted behind lighter
+        peers (queue depth is the health tiebreak)."""
+        eps = self.endpoints()
+        ranked: List[Tuple[float, int, int, int]] = []
+        fallback: List[Tuple[float, int, int]] = []
+        for rid, port in eps.items():
+            h = hashlib.blake2b(f"{op}|{bucket}|{rid}".encode(),
+                                digest_size=8).digest()
+            score = int.from_bytes(h, "big")
+            doc = self._healthz(rid, port)
+            if rid in exclude or not self._routable(doc):
+                fallback.append((-score, rid, port))
+                continue
+            ranked.append((-score, self._depth(doc), rid, port))
+        if ranked:
+            # affinity first; but a winner drowning in queue depth while
+            # a peer sits near-empty forfeits the bucket for this round
+            ranked.sort()
+            best_depth = min(d for _s, d, _r, _p in ranked)
+            for _s, d, rid, port in ranked:
+                if d <= best_depth + 32:
+                    return ([(rid, port)]
+                            + [(r, p) for _sc, _d, r, p in ranked
+                               if r != rid])
+            return [(r, p) for _s, _d, r, p in ranked]
+        # nothing routable: last resort is the excluded/unhealthy set in
+        # affinity order (a shedding replica beats a lost request)
+        fallback.sort()
+        return [(r, p) for _s, r, p in fallback]
+
+    def ready(self, all_replicas: bool = False) -> bool:
+        """True when at least one replica (or with ``all_replicas``,
+        every replica) reports ready on ``/readyz``."""
+        eps = self.endpoints()
+        if not eps:
+            return False
+        states = []
+        for rid, port in eps.items():
+            try:
+                urllib.request.urlopen(
+                    f"http://{self.host}:{port}/readyz", timeout=2.0)
+                states.append(True)
+            except Exception:
+                states.append(False)
+        return all(states) if all_replicas else any(states)
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, op: str, deadline_s: Optional[float] = None,
+               tenant: Optional[str] = None,
+               **kwargs) -> "concurrent.futures.Future":
+        """Route one request; returns a Future resolving to the op's
+        decoded result dict.  The idempotency key is minted here — every
+        failover re-send of this request carries the same key."""
+        key = uuid.uuid4().hex
+        return self._pool.submit(self._submit_sync, op, dict(kwargs),
+                                 deadline_s, tenant or self.tenant, key)
+
+    def _submit_sync(self, op: str, kwargs: Dict,
+                     deadline_s: Optional[float], tenant: str,
+                     key: str) -> Dict:
+        bucket = affinity_bucket(op, kwargs)
+        deadline = (time.monotonic() + float(deadline_s)
+                    if deadline_s else None)
+        policy = _resilience.default_policy()
+        enc_kwargs = encode_doc(kwargs)
+        transport_failures = 0
+        prev_sleep = policy.base_s
+        failed: List[int] = []       # transport failures (suspect dead)
+        avoid: List[int] = []        # QueueFull(full) this round only
+        last_exc: Optional[Exception] = None
+        while True:
+            left = _resilience.remaining(deadline)
+            if left is not None and left <= 0:
+                raise last_exc or _resilience.DeadlineExceeded(
+                    f"fleet.{op}", float(deadline_s or 0))
+            cands = self._candidates(op, bucket, exclude=failed + avoid)
+            if not cands:
+                self._m["no_replica"].inc()
+                # membership may be mid-failover (replacement starting):
+                # clear the exclusion sets and back off for one round
+                failed, avoid = [], []
+                if not self._backoff(prev_sleep, policy, deadline):
+                    raise last_exc or RuntimeError(
+                        f"fleet.{op}: no routable replica")
+                prev_sleep = min(policy.cap_s, 3 * prev_sleep)
+                continue
+            rid, port = cands[0]
+            body = json.dumps({
+                "key": key, "tenant": tenant, "op": op,
+                "deadline_s": left, "kwargs": enc_kwargs,
+            }).encode("utf-8")
+            timeout = self.request_timeout_s
+            if left is not None:
+                timeout = max(0.05, min(timeout, left))
+            try:
+                req = urllib.request.Request(
+                    f"http://{self.host}:{port}/v1/submit", data=body,
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                raw = urllib.request.urlopen(req, timeout=timeout).read()
+                doc = json.loads(raw)            # fully read == acked
+            except Exception as e:
+                # transport failure: the replica is dead, stalled, or
+                # unreachable — the request is UNACKNOWLEDGED and safe
+                # to re-route under the same idempotency key
+                transport_failures += 1
+                last_exc = e
+                failed.append(rid)
+                self._forget_health(rid)
+                self._m["failovers"].inc(op=op)
+                if transport_failures >= policy.max_attempts:
+                    raise
+                continue
+            self._m["routed"].inc(replica=str(rid))
+            if doc.get("ok"):
+                return decode_doc(doc.get("result") or {})
+            err = doc.get("error") or {}
+            kind = err.get("kind")
+            if kind == "queue_full" and err.get("reason") == "full" \
+                    and deadline is not None:
+                # admission pressure: try the next-best replica, with
+                # backoff once the whole fleet is pushing back
+                self._m["requeues"].inc(op=op)
+                last_exc = self._app_error(op, err)
+                if len(cands) > 1:
+                    avoid.append(rid)
+                else:
+                    if not self._backoff(prev_sleep, policy, deadline):
+                        raise last_exc
+                    prev_sleep = min(policy.cap_s, 3 * prev_sleep)
+                    avoid = []
+                continue
+            raise self._app_error(op, err)
+
+    @staticmethod
+    def _backoff(prev: float, policy, deadline: Optional[float]) -> bool:
+        sleep = _resilience.backoff_s(prev, policy)
+        left = _resilience.remaining(deadline)
+        if left is not None:
+            sleep = min(sleep, left)
+            if sleep <= 0:
+                return False
+        time.sleep(max(0.0, sleep))
+        return True
+
+    @staticmethod
+    def _app_error(op: str, err: Dict) -> Exception:
+        """Rebuild a replica-side failure as the exception the
+        in-process Client would have raised."""
+        kind = err.get("kind")
+        msg = err.get("msg") or "replica error"
+        if kind == "queue_full":
+            from spark_rapids_jni_tpu.serve.queue import QueueFull
+            return QueueFull(err.get("reason") or "full",
+                             int(err.get("depth") or 0),
+                             int(err.get("limit") or 0))
+        if kind == "deadline":
+            return _resilience.DeadlineExceeded(f"fleet.{op}")
+        if kind == "validation":
+            return ValueError(msg)
+        return RuntimeError(f"fleet.{op}: {err.get('type')}: {msg}")
+
+    # -- the Client-shaped surface ----------------------------------------
+
+    def aggregate(self, keys, values, max_groups: Optional[int] = None,
+                  deadline_s: Optional[float] = None,
+                  tenant: Optional[str] = None):
+        kw = {} if max_groups is None else {"max_groups": max_groups}
+        return self.submit("agg", deadline_s, tenant, keys=keys,
+                           values=values, **kw)
+
+    def join(self, build_keys, build_payload, probe_keys,
+             deadline_s: Optional[float] = None,
+             tenant: Optional[str] = None):
+        return self.submit("join", deadline_s, tenant,
+                           build_keys=build_keys,
+                           build_payload=build_payload,
+                           probe_keys=probe_keys)
+
+    def to_rows(self, columns: Sequence,
+                deadline_s: Optional[float] = None,
+                tenant: Optional[str] = None):
+        return self.submit("rows", deadline_s, tenant, columns=columns)
+
+    def from_rows(self, rows, ncols: int,
+                  deadline_s: Optional[float] = None,
+                  tenant: Optional[str] = None):
+        return self.submit("unrows", deadline_s, tenant, rows=rows,
+                           ncols=ncols)
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=True)
+
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

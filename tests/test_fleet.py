@@ -1,0 +1,532 @@
+"""Fleet tests: replica supervision, warm failover, chaos, and the
+satellite guarantees that make the fleet safe to run.
+
+The centerpiece (:func:`test_fleet_chaos_kill_midburst`) is the
+acceptance proof from the roadmap: a 3-replica fleet serving a
+concurrent multi-tenant burst survives a hard SIGKILL of the affinity
+owner mid-burst with **zero lost requests**, every result byte-identical
+to a single-scheduler reference, the replacement reaches ready **warm**
+(strictly fewer backend compiles than the coldest initial replica, with
+persistent-cache hits to show for it), and a breaker forced open on one
+replica is honored by the others via gossip.
+
+The satellites ride alongside: liveness/readiness split on the exporter,
+``Client.submit`` admission retry under a deadline, flight-recorder
+byte-cap eviction, and crash-consistency of every fleet-shared file
+(a replica killed mid-write must leave a file that loads as
+empty-with-warning, never one that raises)."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import obs, serve
+from spark_rapids_jni_tpu.obs import (exporter, memwatch, metrics,
+                                      planstats, recorder)
+from spark_rapids_jni_tpu.runtime import resilience, shapes
+from spark_rapids_jni_tpu.serve import chaos, fleet, router
+
+
+@pytest.fixture
+def clean_metrics():
+    metrics.registry().reset()
+    yield
+    metrics.registry().reset()
+
+
+@pytest.fixture
+def clean_breakers():
+    resilience.reset_breakers()
+    yield
+    resilience.reset_breakers()
+
+
+@pytest.fixture
+def live_exporter(clean_metrics):
+    port = exporter.start(0)
+    assert port is not None
+    yield port
+    exporter.stop()
+
+
+def _get(port, path):
+    """(status, parsed body) without raising on 4xx/5xx."""
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# ---------------------------------------------------------------------------
+# Satellite: liveness vs readiness
+# ---------------------------------------------------------------------------
+
+class TestReadiness:
+    def test_no_providers_is_vacuously_ready(self, live_exporter):
+        status, doc = _get(live_exporter, "/readyz")
+        assert status == 200 and doc["ready"] is True
+        assert serve.Client.ready() is True
+
+    def test_readyz_503_until_provider_flips(self, live_exporter):
+        warm = threading.Event()
+        exporter.register_readiness_provider("warmup", warm.is_set)
+        try:
+            # liveness stays green while readiness is red: a
+            # warm-starting replica is alive, just not admissible
+            status, doc = _get(live_exporter, "/readyz")
+            assert status == 503 and doc["ready"] is False
+            assert doc["checks"]["warmup"] is False
+            live, _ = _get(live_exporter, "/healthz")
+            assert live == 200
+            assert serve.Client.ready() is False
+
+            warm.set()
+            status, doc = _get(live_exporter, "/readyz")
+            assert status == 200 and doc["ready"] is True
+            assert serve.Client.ready() is True
+        finally:
+            exporter.unregister_readiness_provider("warmup")
+
+    def test_raising_provider_means_not_ready(self, live_exporter):
+        def bad():
+            raise RuntimeError("probe exploded")
+        exporter.register_readiness_provider("bad", bad)
+        try:
+            status, doc = _get(live_exporter, "/readyz")
+            assert status == 503
+            assert "error" in doc["checks"]["bad"]
+        finally:
+            exporter.unregister_readiness_provider("bad")
+
+
+# ---------------------------------------------------------------------------
+# Satellite: Client.submit honors the deadline on QueueFull(full)
+# ---------------------------------------------------------------------------
+
+class TestAdmissionRetry:
+    def _full_sched(self):
+        s = serve.Scheduler(serve.Config(max_depth=1))
+        c = serve.Client(s, "t0")
+        keys = np.arange(8, dtype=np.int32)
+        vals = np.ones(8, dtype=np.int32)
+        blocker = c.aggregate(keys, vals)     # fills the queue (no tick)
+        return s, c, keys, vals, blocker
+
+    def test_retry_until_deadline_then_deadline_exceeded(
+            self, clean_metrics):
+        s, c, keys, vals, _ = self._full_sched()
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(resilience.DeadlineExceeded):
+                c.aggregate(keys, vals, deadline_s=0.4)
+            elapsed = time.monotonic() - t0
+            # retried across the window (not an instant failure), and
+            # never slept meaningfully past the deadline
+            assert 0.3 <= elapsed < 2.0
+            vals_ = metrics.registry().snapshot()[
+                "srj_tpu_serve_resubmits_total"]["values"]
+            assert sum(vals_.values()) >= 1
+        finally:
+            s.close(drain=False)
+
+    def test_no_deadline_fails_fast(self, clean_metrics):
+        s, c, keys, vals, _ = self._full_sched()
+        try:
+            with pytest.raises(serve.QueueFull) as ei:
+                c.aggregate(keys, vals)
+            assert ei.value.reason == "full"
+        finally:
+            s.close(drain=False)
+
+    def test_retry_succeeds_when_queue_drains(self, clean_metrics):
+        s, c, keys, vals, _ = self._full_sched()
+        try:
+            drained = threading.Event()
+
+            def drain():
+                time.sleep(0.15)
+                while not drained.is_set():
+                    s.tick()
+                    time.sleep(0.01)
+
+            t = threading.Thread(target=drain, daemon=True)
+            t.start()
+            fut = c.aggregate(keys, vals, deadline_s=30.0)
+            out = fut.result(60.0)
+            drained.set()
+            t.join(5.0)
+            assert out["num_groups"] == 8
+        finally:
+            s.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# Satellite: flight-recorder byte cap
+# ---------------------------------------------------------------------------
+
+class TestDiagByteCap:
+    def _dump(self, name):
+        return recorder.dump_bundle(
+            "test", {"name": name, "error_type": f"E_{name}",
+                     "op": name})
+
+    def test_oldest_bundle_evicted_for_bytes(self, tmp_path,
+                                             monkeypatch,
+                                             clean_metrics):
+        d = tmp_path / "diag"
+        recorder.reset(programs=True)
+        recorder.arm(str(d))
+        try:
+            monkeypatch.delenv("SRJ_TPU_DIAG_MAX_BYTES", raising=False)
+            first = self._dump("op_a")
+            assert first is not None
+            # inflate the oldest bundle well past the cap we are about
+            # to set, and age it so mtime ordering is unambiguous
+            (d / os.path.basename(first) / "filler.bin").write_bytes(
+                b"\0" * 65536)
+            old = time.time() - 60
+            os.utime(os.path.join(str(d), os.path.basename(first)),
+                     (old, old))
+
+            monkeypatch.setenv("SRJ_TPU_DIAG_MAX_BYTES", "32768")
+            second = self._dump("op_b")
+            assert second is not None
+            names = {p.name for p in d.iterdir()
+                     if p.name.startswith("bundle-")}
+            assert os.path.basename(first) not in names
+            assert os.path.basename(second) in names
+            vals = metrics.registry().snapshot()[
+                "srj_tpu_diag_evictions_total"]["values"]
+            assert sum(vals.values()) >= 1
+        finally:
+            recorder.disarm()
+            recorder.reset(programs=True)
+
+    def test_unset_cap_is_unlimited(self, tmp_path, monkeypatch,
+                                    clean_metrics):
+        d = tmp_path / "diag"
+        recorder.reset(programs=True)
+        recorder.arm(str(d))
+        try:
+            monkeypatch.delenv("SRJ_TPU_DIAG_MAX_BYTES", raising=False)
+            a = self._dump("op_c")
+            b = self._dump("op_d")
+            assert a is not None and b is not None
+            names = {p.name for p in d.iterdir()}
+            assert os.path.basename(a) in names
+            assert os.path.basename(b) in names
+        finally:
+            recorder.disarm()
+            recorder.reset(programs=True)
+
+
+# ---------------------------------------------------------------------------
+# Satellite: crash-consistency of fleet-shared files
+# ---------------------------------------------------------------------------
+
+def _truncations(payload: bytes):
+    """Mid-write kill -9 shapes: empty, a prefix, all-but-one byte."""
+    yield b""
+    yield payload[: max(1, len(payload) // 3)]
+    yield payload[: len(payload) // 2]
+    yield payload[:-1]
+
+
+class TestCrashConsistency:
+    def test_torn_plan_stats_loads_as_none(self, tmp_path):
+        doc = {"ts": time.time(), "version": 1,
+               "plans": {"p1": {"rows": 100}}}
+        payload = json.dumps(doc).encode()
+        p = tmp_path / "PLAN_STATS.json"
+        for torn in _truncations(payload):
+            p.write_bytes(torn)
+            assert planstats.load(str(p)) is None
+
+    def test_torn_footprints_load_as_none(self, tmp_path):
+        doc = {"ts": time.time(), "cells": {
+            "agg|s|100|pallas": {"peak_bytes": 4096, "calls": 3}}}
+        payload = json.dumps(doc).encode()
+        p = tmp_path / "FOOTPRINTS.json"
+        for torn in _truncations(payload):
+            p.write_bytes(torn)
+            assert memwatch.load_footprints(str(p)) is None
+
+    def test_torn_gossip_loads_as_empty(self, tmp_path, clean_metrics,
+                                        capsys):
+        doc = {"ts": time.time(), "replicas": {
+            "0": {"pid": 1, "breakers": {
+                "op|s|b|pallas": {"age_s": 1.0}}}}}
+        payload = json.dumps(doc).encode()
+        p = tmp_path / "GOSSIP.json"
+        for torn in _truncations(payload):
+            p.write_bytes(torn)
+            assert fleet.load_gossip(str(p)) == {}
+        assert "treating as empty" in capsys.readouterr().err
+        vals = metrics.registry().snapshot()[
+            "srj_tpu_fleet_gossip_corrupt_total"]["values"]
+        assert sum(vals.values()) >= 1
+
+    def test_missing_gossip_is_silently_empty(self, tmp_path, capsys):
+        assert fleet.load_gossip(str(tmp_path / "nope.json")) == {}
+        assert capsys.readouterr().err == ""
+
+    def test_wrong_shape_gossip_is_empty(self, tmp_path):
+        p = tmp_path / "GOSSIP.json"
+        p.write_text(json.dumps([1, 2, 3]))
+        assert fleet.load_gossip(str(p)) == {}
+        p.write_text(json.dumps({"replicas": "not-a-dict"}))
+        assert fleet.load_gossip(str(p)) == {}
+
+
+# ---------------------------------------------------------------------------
+# Breaker gossip: export / import semantics
+# ---------------------------------------------------------------------------
+
+class TestBreakerGossip:
+    CELL = ("op.g", "sig", "100", "pallas")
+    KEY = "|".join(CELL)
+
+    def test_export_only_local_opens(self, clean_breakers):
+        resilience.breaker(*self.CELL).force_open()
+        resilience.breaker("op.closed", "s", "1", "xla")  # closed cell
+        doc = resilience.export_breakers()
+        assert set(doc) == {self.KEY}
+        assert doc[self.KEY]["state"] in ("open", "half_open")
+        assert doc[self.KEY]["age_s"] >= 0.0
+
+    def test_import_opens_and_never_echoes(self, clean_breakers):
+        n = resilience.import_breakers(
+            {self.KEY: {"state": "open", "age_s": 1.0,
+                        "cooldown_s": 30.0}})
+        assert n == 1
+        assert not resilience.allow_impl(*self.CELL)
+        # the no-echo guarantee: an imported quarantine is a peer's
+        # evidence, not ours — it must not appear in our export
+        assert resilience.export_breakers() == {}
+        h = resilience.health()
+        assert self.KEY in h["open"]
+        assert self.KEY in h["imported"]
+
+    def test_local_open_outranks_gossip(self, clean_breakers):
+        b = resilience.breaker(*self.CELL)
+        b.force_open()
+        opened = b._opened_at
+        resilience.import_breakers(
+            {self.KEY: {"age_s": 9999.0, "cooldown_s": 30.0}})
+        assert b.origin == "local"
+        assert b._opened_at == opened
+        assert self.KEY in resilience.export_breakers()
+
+    def test_absent_cell_resets_on_next_import(self, clean_breakers):
+        resilience.import_breakers(
+            {self.KEY: {"age_s": 0.0, "cooldown_s": 30.0}},
+            origin="gossip:0")
+        assert not resilience.allow_impl(*self.CELL)
+        # originator recovered: its next doc no longer lists the cell
+        resilience.import_breakers({}, origin="gossip:0")
+        assert resilience.allow_impl(*self.CELL)
+
+    def test_per_origin_isolation(self, clean_breakers):
+        resilience.import_breakers(
+            {self.KEY: {"age_s": 0.0}}, origin="gossip:0")
+        # a different peer's empty doc must not lift peer 0's cell
+        resilience.import_breakers({}, origin="gossip:1")
+        assert not resilience.allow_impl(*self.CELL)
+
+    def test_malformed_import_is_a_noop(self, clean_breakers):
+        assert resilience.import_breakers("nonsense") == 0
+        assert resilience.import_breakers(
+            {"badkey": {"age_s": 1}, "a|b": {}, self.KEY: "notdict"}) == 0
+
+    def test_local_outcome_reclaims_origin(self, clean_breakers):
+        resilience.import_breakers({self.KEY: {"age_s": 0.0}})
+        b = resilience.breaker(*self.CELL)
+        assert b.origin == "gossip"
+        b.record(True)
+        assert b.origin == "local"
+
+
+# ---------------------------------------------------------------------------
+# Router plumbing (no fleet needed)
+# ---------------------------------------------------------------------------
+
+class TestRouterPlumbing:
+    def test_wire_codec_roundtrip(self):
+        doc = {
+            "keys": np.arange(7, dtype=np.int32),
+            "floats": np.linspace(0, 1, 5, dtype=np.float64),
+            "nested": {"rows": np.ones((3, 4), dtype=np.uint8),
+                       "n": np.int64(9), "f": np.float32(0.5)},
+            "plain": [1, 2.5, "x", None, True],
+        }
+        out = router.decode_doc(json.loads(json.dumps(
+            router.encode_doc(doc))))
+        assert np.array_equal(out["keys"], doc["keys"])
+        assert out["keys"].dtype == np.int32
+        assert np.array_equal(out["floats"], doc["floats"])
+        assert out["nested"]["rows"].shape == (3, 4)
+        assert out["nested"]["rows"].dtype == np.uint8
+        assert out["nested"]["n"] == 9
+        assert out["plain"] == [1, 2.5, "x", None, True]
+
+    def test_affinity_bucket_follows_rows(self):
+        keys = np.arange(137, dtype=np.int32)
+        assert (router.affinity_bucket("agg", {"keys": keys})
+                == shapes.bucket_rows(137))
+        assert (router.affinity_bucket("rows", {"columns": [keys]})
+                == shapes.bucket_rows(137))
+        # degenerate inputs still land in a stable (minimum) bucket
+        assert (router.affinity_bucket("agg", {})
+                == shapes.bucket_rows(1))
+        assert router.affinity_bucket("unknown-op", {"x": 1}) \
+            == shapes.bucket_rows(1)
+
+    def test_parse_schedule_rejects_unknown_action(self):
+        with pytest.raises(ValueError, match="unknown chaos action"):
+            chaos.parse_schedule("1.0:explode:0")
+        with pytest.raises(ValueError, match="bad chaos event"):
+            chaos.parse_schedule("1.0:kill")
+
+    def test_parse_schedule_sorts_and_params(self):
+        evs = chaos.parse_schedule(
+            "3:stall:1:ms=2000; 1.5:kill:0")
+        assert [e.action for e in evs] == ["kill", "stall"]
+        assert evs[1].params == {"ms": "2000"}
+
+    def test_router_requires_a_target(self):
+        with pytest.raises(ValueError):
+            router.Router()
+
+
+# ---------------------------------------------------------------------------
+# The acceptance proof: kill a replica mid-burst
+# ---------------------------------------------------------------------------
+
+class TestFleetChaos:
+    SIZES = (100, 900)        # two distinct row buckets (100 and 1000)
+
+    @staticmethod
+    def _payload(size, i):
+        keys = ((np.arange(size, dtype=np.int64) * 7919 + i * 131)
+                % 97).astype(np.int32)
+        vals = (np.arange(size, dtype=np.int64) % 13).astype(np.int32)
+        return keys, vals
+
+    def test_fleet_chaos_kill_midburst(self, tmp_path, clean_metrics,
+                                       clean_breakers):
+        env = {
+            "SRJ_TPU_FLEET_WARM_OPS": "agg:100,agg:900",
+            "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
+        }
+        sup = fleet.Supervisor(
+            replicas=3, fleet_dir=str(tmp_path / "fleet"),
+            heartbeat_ms=200, env=env)
+        rt = None
+        try:
+            sup.start(wait_ready=True, timeout_s=240)
+
+            initial = {}
+            for rid in range(3):
+                doc = sup.healthz(rid)
+                assert doc is not None, f"replica {rid} unreachable"
+                assert doc["replica"]["ready"]
+                initial[rid] = doc["replica"]
+            coldest = max(r["backend_compiles"]
+                          for r in initial.values())
+            assert coldest > 0, (
+                "someone must have filled the empty fleet cache: "
+                f"{initial}")
+
+            # single-scheduler reference: the byte-identity oracle
+            ref = {}
+            with serve.Scheduler() as s:
+                c = serve.Client(s, "ref")
+                for size in self.SIZES:
+                    for i in range(2):
+                        keys, vals = self._payload(size, i)
+                        ref[(size, i)] = c.aggregate(
+                            keys, vals).result(240)
+
+            rt = router.Router(supervisor=sup, health_ttl_s=0.1)
+            # kill the affinity owner of the small bucket: the replica
+            # guaranteed to hold in-flight requests when the axe falls
+            victim = rt._candidates(
+                "agg", shapes.bucket_rows(100), [])[0][0]
+            harness = chaos.ChaosHarness(
+                sup, f"0.3:kill:{victim}").start()
+
+            futs = []
+            t_burst = time.monotonic()
+            for i in range(32):
+                size = self.SIZES[i % 2]
+                keys, vals = self._payload(size, i % 2)
+                futs.append(
+                    ((size, i % 2),
+                     rt.aggregate(keys, vals, deadline_s=120,
+                                  tenant=f"t{i % 4}")))
+                time.sleep(0.03)     # spread the burst across the kill
+            assert time.monotonic() - t_burst > 0.3  # kill fell inside
+
+            lost = 0
+            for refkey, fut in futs:
+                out = fut.result(240)       # zero lost: all resolve
+                expect = ref[refkey]
+                for field in ("group_keys", "sums", "have"):
+                    assert np.array_equal(out[field], expect[field]), (
+                        f"divergent {field} for {refkey}")
+                assert out["num_groups"] == expect["num_groups"]
+            assert lost == 0
+            harness.join(30)
+            assert harness.log and harness.log[0]["ok"], harness.log
+
+            # the replacement comes up warm: persistent-cache hits, and
+            # strictly fewer backend compiles than the coldest cold start
+            repl = None
+            deadline = time.time() + 180
+            while time.time() < deadline:
+                r = sup.replica(victim)
+                doc = sup.healthz(victim)
+                if (r is not None and r.restarts >= 1 and doc
+                        and doc.get("replica", {}).get("ready")):
+                    repl = doc["replica"]
+                    break
+                time.sleep(0.3)
+            assert repl is not None, "replacement never became ready"
+            assert repl["cache_hits"] > 0, repl
+            assert repl["backend_compiles"] < coldest, (repl, initial)
+
+            # gossip: a breaker forced open on one survivor is honored
+            # by another within a gossip period or three
+            survivors = [rid for rid in range(3) if rid != victim]
+            src, dst = survivors[0], survivors[1]
+            chaos.ChaosHarness(
+                sup,
+                f"0:force_breaker:{src}:"
+                f"op=serve.agg,sig=gsig,bucket=100,impl=pallas"
+            ).start().join(15)
+            cell = "serve.agg|gsig|100|pallas"
+            seen = False
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                doc = sup.healthz(dst)
+                res = (doc or {}).get("resilience") or {}
+                if (cell in (res.get("open") or [])
+                        and cell in (res.get("imported") or [])):
+                    seen = True
+                    break
+                time.sleep(0.25)
+            assert seen, (
+                f"breaker {cell} from replica {src} never reached "
+                f"replica {dst} via gossip")
+        finally:
+            if rt is not None:
+                rt.close()
+            sup.stop()
