@@ -903,6 +903,155 @@ def bench_plan(num_batches):
     return res
 
 
+def bench_optimizer(num_batches):
+    """Adaptive-optimizer axis: the same skewed ragged stream through a
+    join chain twice — optimized (``SRJ_TPU_PLAN_OPT=1``: probe-side
+    predicate pushdown + projection pruning + adaptive re-planning)
+    versus structural fusion only (``SRJ_TPU_PLAN_OPT=0``, the PR-14
+    baseline).  The record is wall, dispatches, staged input bytes, and
+    rows flowing INTO the join (planstats cells), plus a static
+    exchange-wire comparison of a prunable distributed plan.  The
+    optimizer's claim: pushdown cuts rows into the join by the filter's
+    selectivity, pruning cuts staged/exchange bytes, and re-planning
+    adds ZERO steady-state recompiles (warm repeat burst)."""
+    import jax.numpy as jnp
+    from spark_rapids_jni_tpu import obs
+    from spark_rapids_jni_tpu.obs import planstats
+    from spark_rapids_jni_tpu.parallel import shuffle as _shuffle
+    from spark_rapids_jni_tpu.runtime import (optimizer as _opt,
+                                              plan as _plan, shapes)
+
+    rng = np.random.default_rng(23)
+    sizes = []
+    while len(sizes) < num_batches:
+        # skewed ragged grid: mostly small batches with a hot tail
+        n = int(rng.integers(3000, 8000)) if rng.random() < 0.2 \
+            else int(rng.integers(60, 600))
+        if n != shapes.bucket_rows(n):
+            sizes.append(n)
+    m = 64
+    build = {"bk": np.arange(m, dtype=np.int32),
+             "bp": ((np.arange(m, dtype=np.int32) * 7) % 90)
+             .astype(np.int32)}
+    batches = []
+    for n in sizes:
+        b = {"k": rng.integers(0, m, n).astype(np.int32),
+             "v": rng.integers(-99, 99, n).astype(np.int32),
+             # w is never referenced: projection-pruning bait
+             "w": rng.integers(0, 99, n).astype(np.int32)}
+        b.update(build)
+        batches.append(b)
+    _log(f"optimizer: {num_batches} batches, sizes "
+         f"{min(sizes)}..{max(sizes)}")
+
+    # probe-side filter (v > 79 keeps ~10%) authored ABOVE the join:
+    # pushdown must move it below, so ~90% fewer rows reach the join
+    pln = _plan.Plan([
+        _plan.scan("k", "v", "w"),
+        _plan.join("bk", "k", build_payload="bp", out="p"),
+        _plan.filter(lambda v: v > jnp.int32(79), ["v"]),
+        _plan.project({"s": (lambda v, p: v + p, ["v", "p"])}),
+        _plan.aggregate(["k"], [("s", "sum")], m),
+    ])
+
+    def _stream(opt_on, label):
+        os.environ["SRJ_TPU_PLAN_OPT"] = "1" if opt_on else "0"
+        try:
+            _plan.clear_cache()
+            _opt.reset()
+            planstats.reset()
+            c0 = obs.compile_totals()
+            d0 = _plan.dispatch_totals()["dispatches"]
+            t0 = time.perf_counter()
+            with _leg_span(f"optimizer_{label}"):
+                for ins in batches:
+                    out = _plan.execute(pln, dict(ins))
+                    _sync(out[1])
+            wall = time.perf_counter() - t0
+            c1 = obs.compile_totals()
+            rec = {"wall_s": round(wall, 4),
+                   "compiles": int(c1["compiles"] - c0["compiles"]),
+                   "dispatches": int(
+                       _plan.dispatch_totals()["dispatches"] - d0)}
+            # warm repeat: after any mid-stream re-plan has settled the
+            # steady state must add zero compiles
+            c0 = obs.compile_totals()
+            t0 = time.perf_counter()
+            with _leg_span(f"optimizer_{label}_repeat"):
+                for ins in batches:
+                    out = _plan.execute(pln, dict(ins))
+                    _sync(out[1])
+            rec["repeat_wall_s"] = round(time.perf_counter() - t0, 4)
+            rec["repeat_compiles"] = int(
+                obs.compile_totals()["compiles"] - c0["compiles"])
+            # what actually ran: the optimized twin's fingerprint when
+            # the rewriter fired, the authored one otherwise
+            exec_pln = _opt.optimize(pln)[0] if opt_on else pln
+            join_i = next(i for i, nd in enumerate(exec_pln.nodes)
+                          if nd.kind == "join")
+            prec = planstats.snapshot(exec_pln.fp8)["plans"] \
+                .get(exec_pln.fp8) or {}
+            rows_in = sum(c.get("rows_in", 0)
+                          for key, c in (prec.get("cells") or {}).items()
+                          if key.split("|", 1)[0] == f"n{join_i}")
+            rec["rows_into_join"] = int(rows_in)
+            rec["staged_bytes"] = int(prec.get("bytes", 0))
+            rec["plan_fp8"] = exec_pln.fp8
+            rec["rules"] = sorted({f["rule"] for f in
+                                   _opt.optimize(pln)[1]}) if opt_on \
+                else []
+            _log(f"optimizer {label}: {rec['rows_into_join']} rows into "
+                 f"join, {rec['staged_bytes']} staged bytes, "
+                 f"{rec['dispatches']} dispatches in {rec['wall_s']:.2f}s"
+                 f"; repeat burst {rec['repeat_compiles']} compiles")
+            return rec
+        finally:
+            os.environ.pop("SRJ_TPU_PLAN_OPT", None)
+
+    optimized = _stream(True, "opt")
+    baseline = _stream(False, "base")
+
+    # exchange wire: the prunable distributed plan, priced statically on
+    # a skewed 8-way size matrix (lane count is what pruning changes;
+    # capacity is identical for both plans).  The post-exchange filter
+    # reads TWO payload columns nothing else consumes: pushdown folds
+    # them into one __pd lane, so the payload goes 4 -> 3 lanes
+    xpln = _plan.Plan([
+        _plan.scan("k", "v", "w1", "w2"),
+        _plan.exchange("k", ("k", "v", "w1", "w2"), 8),
+        _plan.filter(lambda w1, w2: (w1 + w2) % jnp.int32(3) == 0,
+                     ["w1", "w2"]),
+        _plan.aggregate(["k"], [("v", "sum")], m),
+    ])
+    xopt = _opt.optimize(xpln)[0]
+    counts = np.full((8, 8), 64, np.int64)
+    counts[:, 0] = 4096                       # hot destination
+    def _wire(p):
+        xn = next(nd for nd in p.nodes if nd.kind == "exchange")
+        rs = 4 * len(xn.get("payload"))
+        return _shuffle.plan_exchange(counts, 8, rs) \
+            .collective_wire_bytes
+    wire0, wire1 = _wire(xpln), _wire(xopt)
+
+    res = {"num_batches": num_batches, "sizes_min": min(sizes),
+           "sizes_max": max(sizes), "optimized": optimized,
+           "baseline": baseline,
+           "opt_rows_into_join_ratio": round(
+               optimized["rows_into_join"]
+               / max(1, baseline["rows_into_join"]), 4),
+           "opt_staged_bytes_ratio": round(
+               optimized["staged_bytes"]
+               / max(1, baseline["staged_bytes"]), 4),
+           "exchange_wire_bytes": wire1,
+           "exchange_wire_bytes_baseline": wire0,
+           "opt_exchange_wire_ratio": round(wire1 / max(1, wire0), 4)}
+    _log(f"optimizer: rows-into-join ratio "
+         f"{res['opt_rows_into_join_ratio']}, staged-bytes ratio "
+         f"{res['opt_staged_bytes_ratio']}, exchange-wire ratio "
+         f"{res['opt_exchange_wire_ratio']}")
+    return res
+
+
 def bench_shuffle(num_rows):
     """Shuffle-throughput axis on an 8-device mesh: the two-phase ragged
     exchange versus the legacy pad-to-max protocol on a hot-key skew
@@ -1305,6 +1454,8 @@ def _run_axis(axis: str):
             res = bench_fleet(int(n))
         elif kind == "plan":
             res = bench_plan(int(n))
+        elif kind == "optimizer":
+            res = bench_optimizer(int(n))
         elif kind == "shuffle":
             res = bench_shuffle(int(n))
         elif kind == "kernels":
@@ -1680,6 +1831,12 @@ def main():
     # regress gate sees the program/dispatch figures every round
     _run("plan_fusion", "plan:28")
 
+    # adaptive-optimizer axis: optimized vs structural-fused on a
+    # skewed ragged grid — rows into the join, staged bytes, exchange
+    # wire bytes; runs under --quick too so the regress gate sees the
+    # pushdown/pruning ratios every round
+    _run("plan_optimizer", "optimizer:24")
+
     # pod-scale shuffle axis: the two-phase ragged exchange vs the
     # legacy pad-to-max protocol on a skewed 8-way exchange.  Pinned to
     # the 8-device host-platform CPU mesh so every container measures
@@ -1832,6 +1989,20 @@ def main():
              "value": pf["fused"]["dispatches"], "unit": "dispatches"},
             {"metric": "plan_fused_programs_ragged28",
              "value": pf["fused"]["programs"], "unit": "programs"},
+        ])
+    # adaptive-optimizer figures: rows into the join and exchange wire
+    # bytes, optimized over baseline — "ratio" is a lower-is-better
+    # unit in ci/regress_gate.py, so a pushdown or pruning break (the
+    # ratio drifting back toward 1.0) fails the round
+    po = next((r for r in results.get("plan_optimizer", [])
+               if isinstance(r, dict)
+               and r.get("opt_rows_into_join_ratio") is not None), None)
+    if po is not None:
+        out.setdefault("secondary", []).extend([
+            {"metric": "opt_rows_into_join_ratio",
+             "value": po["opt_rows_into_join_ratio"], "unit": "ratio"},
+            {"metric": "opt_exchange_wire_ratio",
+             "value": po["opt_exchange_wire_ratio"], "unit": "ratio"},
         ])
     # memory figure: the headline axis process's peak live bytes (the
     # memwatch watermark / span peak maximum from the obs digest) — a

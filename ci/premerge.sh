@@ -100,6 +100,100 @@ print(f"plan smoke: 14 fused single-dispatch stages under "
 PY
 rm -f "$PLAN_EVENTS"
 
+# adaptive-optimizer smoke: the flagship join shape under two selective
+# pre-join filters authored ABOVE the join (in the wrong order).  The
+# optimizer must push them below the join (rows into the join strictly
+# below the unoptimized run), the adaptive re-plan must reorder them
+# once measured selectivities mature, every output must stay
+# byte-identical to SRJ_TPU_PLAN_OPT=0, and a warm burst after the
+# re-plan settles must recompile nothing
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  SRJ_TPU_PLAN_OPT_MATURITY=2 SRJ_TPU_PLAN_OPT_WINDOW=3 \
+  python - <<'PY'
+import os
+import numpy as np
+import jax.numpy as jnp
+from spark_rapids_jni_tpu import obs
+from spark_rapids_jni_tpu.obs import planstats
+from spark_rapids_jni_tpu.runtime import optimizer, plan
+
+obs.enable()
+pln = plan.Plan([
+    plan.scan("sold_date", "item_key", "quantity", "price"),
+    plan.join("build_item_key", "item_key",
+              build_payload="build_item_price", out="item_price"),
+    # authored weak-first: the re-plan must flip them
+    plan.filter(lambda quantity: quantity > jnp.int32(1), ["quantity"]),
+    plan.filter(lambda sold_date: sold_date < jnp.int32(3),
+                ["sold_date"]),
+    plan.project({"revenue": (
+        lambda quantity, price, item_price:
+        quantity * (price - item_price),
+        ["quantity", "price", "item_price"])}),
+    plan.aggregate(["sold_date"], [("revenue", "sum")], 32),
+])
+rng = np.random.default_rng(7)
+m = 64
+batches = []
+for n in (37, 61, 118, 45, 90, 61, 37):
+    batches.append({
+        "sold_date": rng.integers(0, 32, n).astype(np.int32),
+        "item_key": rng.integers(0, m, n).astype(np.int32),
+        "quantity": rng.integers(1, 10, n).astype(np.int32),
+        "price": rng.integers(1, 50, n).astype(np.int32),
+        "build_item_key": np.arange(m, dtype=np.int32),
+        "build_item_price": rng.integers(1, 20, m).astype(np.int32)})
+
+def rows_into_join(fp8, join_i):
+    rec = planstats.snapshot(fp8)["plans"].get(fp8) or {}
+    return sum(c.get("rows_in", 0)
+               for k, c in (rec.get("cells") or {}).items()
+               if k.split("|", 1)[0] == f"n{join_i}"), \
+           sum(c.get("calls", 0)
+               for k, c in (rec.get("cells") or {}).items()
+               if k.split("|", 1)[0] == f"n{join_i}")
+
+os.environ["SRJ_TPU_PLAN_OPT"] = "0"
+plan.clear_cache(); optimizer.reset(); planstats.reset()
+base = [plan.execute(pln, dict(b)) for b in batches]
+join_i = next(i for i, nd in enumerate(pln.nodes) if nd.kind == "join")
+b_rows, b_calls = rows_into_join(pln.fp8, join_i)
+
+del os.environ["SRJ_TPU_PLAN_OPT"]
+plan.clear_cache(); optimizer.reset(); planstats.reset()
+for _ in range(3):                 # enough rounds for the re-plan
+    for b, ref in zip(batches, base):
+        got = plan.execute(pln, dict(b))
+        for x, y in zip(ref, got):
+            assert np.array_equal(np.asarray(x), np.asarray(y)), \
+                "optimized output diverged"
+doc = optimizer.decisions()[pln.fp8]
+rules = {f["rule"] for f in doc["rules"]}
+assert "pushdown_join" in rules, rules
+assert doc["generation"] >= 1, "re-plan never fired"
+exec_fp8 = doc["optimized"]
+struct = (planstats.snapshot(exec_fp8)["plans"]
+          .get(exec_fp8) or {}).get("struct")
+o_join_i = int(next(n["id"] for n in struct["nodes"]
+                    if n["kind"] == "join")[1:])
+o_rows, o_calls = rows_into_join(exec_fp8, o_join_i)
+assert o_calls and b_calls
+assert o_rows / o_calls < b_rows / b_calls, \
+    f"pushdown did not cut rows into join: {o_rows}/{o_calls} vs " \
+    f"{b_rows}/{b_calls}"
+replans = doc["replans"]
+c0 = obs.compile_totals()["compiles"]
+for b in batches:                  # settled warm burst
+    plan.execute(pln, dict(b))
+warm = obs.compile_totals()["compiles"] - c0
+assert warm == 0, f"settled warm burst recompiled {warm}x"
+assert optimizer.decisions()[pln.fp8]["replans"] == replans
+print(f"optimizer smoke: rules {sorted(rules)}, generation "
+      f"{doc['generation']}, rows into join {o_rows // max(1, o_calls)}"
+      f"/call vs {b_rows // max(1, b_calls)}/call unoptimized, "
+      f"byte-identical, warm compiles 0")
+PY
+
 # pallas-kernel smoke: force the Pallas engine (interpret mode on the
 # CPU mesh) through a to_rows pack burst, a from_rows decode burst, and
 # a get_json scan burst, then assert every op span carries impl=pallas

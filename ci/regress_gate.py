@@ -57,10 +57,12 @@ from typing import Dict, List, Optional, Tuple
 # fatter memory footprint (the mem_peak_* figures) fail the same way;
 # compiled-program and dispatch counts (the plan-fusion figures) regress
 # upward too — more programs per plan or more dispatches per stage means
-# the fuser or its LRU stopped doing its job
+# the fuser or its LRU stopped doing its job; optimizer ratios
+# (optimized over baseline, e.g. opt_rows_into_join_ratio) regress
+# toward 1.0 from below, so they gate the same direction
 LOWER_IS_BETTER_UNITS = {"s", "sec", "secs", "seconds", "ms", "us", "ns",
                          "b", "bytes", "kb", "kib", "mb", "mib",
-                         "gb", "gib", "programs", "dispatches"}
+                         "gb", "gib", "programs", "dispatches", "ratio"}
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 _MC_ROUND_RE = re.compile(r"MULTICHIP_r(\d+)\.json$")

@@ -105,7 +105,8 @@ def save_calibration(ceilings: Dict, path: Optional[str] = None,
     read-only cwd must not fail a bench run)."""
     doc = {"ts": time.time() if now is None else float(now),
            "source": source}
-    for k in ("hbm_GBps", "h2d_GBps", "d2h_GBps"):
+    for k in ("hbm_GBps", "h2d_GBps", "d2h_GBps",
+              "shuffle_staged_crossover"):
         v = ceilings.get(k)
         if isinstance(v, (int, float)) and v > 0:
             doc[k] = float(v)
@@ -147,6 +148,40 @@ def load_calibration(path: Optional[str] = None,
         if t - ts > age_cap:
             return None
     return doc
+
+
+def update_calibration(extras: Dict, path: Optional[str] = None) -> \
+        Optional[str]:
+    """Merge measured extras (currently ``shuffle_staged_crossover`` —
+    the optimizer's staged-vs-collective wire-cost ratio) into an
+    EXISTING fresh calibration file.  The ceilings and their ``ts``
+    provenance are untouched; each extra gets its own ``<key>_ts``.
+    Returns the path written, or ``None`` when there is no fresh
+    calibration to ride along with (the crossover refines that
+    artifact, it does not replace it) or the write fails."""
+    doc = load_calibration(path)
+    if doc is None:
+        return None
+    wrote = False
+    for k in ("shuffle_staged_crossover",):
+        v = extras.get(k)
+        if isinstance(v, (int, float)) and v > 0:
+            doc[k] = float(v)
+            doc[f"{k}_ts"] = time.time()
+            wrote = True
+    if not wrote:
+        return None
+    p = calibration_path(path)
+    try:
+        tmp = f"{p}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, p)
+    except OSError:
+        return None
+    _invalidate_cache()
+    return p
 
 
 def calibration_fresh(path: Optional[str] = None,

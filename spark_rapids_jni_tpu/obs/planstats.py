@@ -60,8 +60,8 @@ __all__ = [
     "enabled", "stats_path", "max_age_s", "max_cells",
     "describe_plan", "register_plan", "note_cache", "observe_execution",
     "inline_node_stat", "observe_exchange", "observe_tenant_batch",
-    "observe_span", "plan_scope", "snapshot", "summary", "save", "load",
-    "reset", "render", "explain_main",
+    "observe_span", "plan_scope", "note_optimizer", "snapshot",
+    "summary", "save", "load", "reset", "render", "explain_main",
 ]
 
 _ENV = "SRJ_TPU_PLAN_STATS"
@@ -253,6 +253,18 @@ def note_cache(fp8: str, hit: bool) -> None:
     with _LOCK:
         rec = _plan_rec(fp8)
         rec["cache_hits" if hit else "cache_misses"] += 1
+
+
+def note_optimizer(fp8: str, doc: Dict) -> None:
+    """Attach the optimizer's decision provenance (rules fired, origin
+    and optimized fingerprints, generation counter, per-filter estimated
+    selectivity) to a plan record.  Persisted with the snapshot so
+    ``obs explain --analyze`` renders it from the file alone."""
+    if not enabled():
+        return
+    with _LOCK:
+        rec = _plan_rec(fp8)
+        rec["optimizer"] = dict(doc)
 
 
 def observe_execution(plan, *, bucket: int, rows: int, input_bytes: int,
@@ -729,6 +741,23 @@ def render(struct: Dict, stats: Optional[Dict] = None,
         if rec.get("tenants"):
             tl = ", ".join(sorted(rec["tenants"])[:6])
             lines.append(f"  tenants {len(rec['tenants'])}: {tl}")
+        opt = rec.get("optimizer")
+        if opt:
+            rules = ", ".join(sorted(
+                {r.get("rule") if isinstance(r, dict) else str(r)
+                 for r in opt.get("rules") or ()})) or "none"
+            lines.append(
+                f"  optimizer gen {opt.get('generation', 0)}"
+                f"  replans {opt.get('replans', 0)}  rules [{rules}]"
+                f"  origin {str(opt.get('origin', '?'))[:8]}"
+                f" -> {str(opt.get('optimized', '?'))[:8]}")
+            for f in opt.get("filters") or ():
+                c = cells.get(f.get("node"))
+                meas = c["sel"] if c and c.get("sel") is not None else None
+                lines.append(
+                    f"    opt {f.get('node')}  est_sel"
+                    f" {_fmt(f.get('est_sel'))}  measured"
+                    f" {_fmt(meas)}")
     for n in struct["nodes"]:
         if nodes[n["id"]]["kind"] == "scan":
             lines.append(f"  {n['id']}  {n['label']}")
@@ -790,9 +819,44 @@ def _analyze_doc(struct: Dict, stats: Dict, prior: Optional[Dict],
            "summary": (stats.get(fp8) or {})}
     doc["summary"] = {k: v for k, v in doc["summary"].items()
                       if k != "cells"}
+    opt = _optimizer_doc(stats, fp8, cells)
+    if opt is not None:
+        doc["optimizer"] = opt
     if warm_compiles is not None:
         doc["warm_compiles"] = int(warm_compiles)
     return doc
+
+
+def _optimizer_doc(stats: Dict, fp8: str,
+                   cells: Dict) -> Optional[Dict]:
+    """Optimizer provenance for ``--analyze``: the decision doc stored
+    by :func:`note_optimizer` with each rewritten filter's estimated
+    selectivity joined against its measured EWMA, plus the live priced
+    route/impl picks (their rejected alternative included)."""
+    rec = (stats or {}).get(fp8) or {}
+    opt = rec.get("optimizer")
+    if opt is None:
+        return None
+    out = dict(opt)
+    filters = []
+    for f in opt.get("filters") or ():
+        row = dict(f)
+        c = cells.get(f.get("node"))
+        if c and c.get("sel") is not None:
+            row["measured_sel"] = c["sel"]
+        filters.append(row)
+    out["filters"] = filters
+    try:
+        from spark_rapids_jni_tpu.runtime import optimizer as _opt
+        route = _opt.route_summary()
+        if route:
+            out["route"] = route
+        impl = _opt.impl_summary()
+        if impl:
+            out["impl"] = impl
+    except Exception:
+        pass
+    return out
 
 
 def _run_flagship(rows: int, seed: int) -> int:

@@ -132,7 +132,11 @@ def choose(op: str, platform: Optional[str] = None,
     quarantined the op's Pallas kernel (failure rate over threshold —
     see ``srj_tpu_breaker_*`` on ``/metrics``), this routes to the XLA
     twin until the breaker's half-open probe closes it, even under
-    ``SRJ_TPU_PALLAS=1``."""
+    ``SRJ_TPU_PALLAS=1``.
+
+    In auto mode (no knob) the pick is priced off the costmodel ledger
+    via :func:`runtime.optimizer.price_impl` once both impls' cells
+    mature — the env knob remains a forced override."""
     if platform is None:
         platform = jax.default_backend()
     k = knob()
@@ -151,6 +155,18 @@ def choose(op: str, platform: Optional[str] = None,
         pass
     if k == "1":
         return "pallas", platform != "tpu"
+    # Auto: price the pick off the live costmodel ledger when both impl
+    # cells have matured (the optimizer requires the winner to clear its
+    # improvement margin); otherwise fall back to the platform default.
+    try:
+        from spark_rapids_jni_tpu.runtime import optimizer as _opt
+        priced = _opt.price_impl(op, sig)
+    except Exception:   # pricing must never break selection
+        priced = None
+    if priced == "pallas":
+        return "pallas", platform != "tpu"
+    if priced == "xla":
+        return "xla", False
     return ("pallas", False) if platform == "tpu" else ("xla", False)
 
 

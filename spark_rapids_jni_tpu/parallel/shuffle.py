@@ -546,30 +546,66 @@ def _staged_transport_ok(mesh: Mesh) -> bool:
     return len(mesh.shape) == 1
 
 
+def _note_route(route: str, source: str) -> str:
+    """Stamp one route decision on the optimizer's counters
+    (``srj_tpu_plan_opt_route_total{route,source}``).  Never raises."""
+    try:
+        from spark_rapids_jni_tpu.runtime import optimizer as _opt
+        _opt.note_route(route, source)
+    except Exception:
+        pass
+    return route
+
+
 def _choose_route(xplan: ExchangePlan, mesh: Mesh, method: str) -> str:
-    """Collective vs staged on observed skew.  The collective pays
-    ``P² × max-bucket`` regardless of emptiness, so once its padding
-    ratio clears ``SRJ_TPU_SHUFFLE_STAGED_MIN_PAD`` (default 4×) AND the
-    staged blob envelope is actually smaller, the bytes win the host
-    round-trip.  ``SRJ_TPU_SHUFFLE_ROUTE=collective|staged`` forces."""
+    """Collective vs staged, priced off measured wire costs.
+
+    Priority order: ``SRJ_TPU_SHUFFLE_ROUTE=collective|staged`` is a
+    forced override; transport constraints (multi-process pods, ring
+    method) force collective; an explicitly-set
+    ``SRJ_TPU_SHUFFLE_STAGED_MIN_PAD`` forces the legacy pad-ratio rule
+    with that threshold.  Otherwise the pick is **priced**: staged wins
+    when ``collective_wire_bytes > C × staged_wire_bytes`` with ``C``
+    the measured staged-vs-collective throughput crossover (live
+    costmodel ledger, falling back to the value persisted alongside
+    calibration — ``runtime.optimizer.staged_crossover``).  With no
+    measurement anywhere, the old 4.0 pad-ratio heuristic remains the
+    default.  Every decision is stamped
+    ``srj_tpu_plan_opt_route_total{route,source=forced|priced|default}``.
+    """
     forced = os.environ.get(_ROUTE_ENV, "").strip().lower()
     if forced in ("collective", "staged"):
         if forced == "staged" and not _staged_transport_ok(mesh):
-            return "collective"
-        return forced
+            return _note_route("collective", "forced")
+        return _note_route(forced, "forced")
     if method != "all_to_all" or not _staged_transport_ok(mesh):
-        return "collective"
+        return _note_route("collective", "default")
     if xplan.true_bytes <= 0:
-        return "collective"
+        return _note_route("collective", "default")
+    raw_pad = os.environ.get(_MIN_PAD_ENV, "").strip()
+    if raw_pad:
+        try:
+            min_pad = float(raw_pad)
+        except ValueError:
+            min_pad = 4.0
+        ratio = xplan.collective_wire_bytes / xplan.true_bytes
+        if ratio >= min_pad and (xplan.staged_wire_bytes
+                                 < xplan.collective_wire_bytes):
+            return _note_route("staged", "forced")
+        return _note_route("collective", "forced")
     try:
-        min_pad = float(os.environ.get(_MIN_PAD_ENV, "4.0"))
-    except ValueError:
-        min_pad = 4.0
+        from spark_rapids_jni_tpu.runtime import optimizer as _opt
+        priced = _opt.price_route(xplan)
+    except Exception:
+        priced = None
+    if priced is not None:
+        return _note_route(priced[0], priced[1])
+    # no measured crossover anywhere: the historical 4.0 placeholder
     ratio = xplan.collective_wire_bytes / xplan.true_bytes
-    if ratio >= min_pad and (xplan.staged_wire_bytes
-                             < xplan.collective_wire_bytes):
-        return "staged"
-    return "collective"
+    if ratio >= 4.0 and (xplan.staged_wire_bytes
+                         < xplan.collective_wire_bytes):
+        return _note_route("staged", "default")
+    return _note_route("collective", "default")
 
 
 @functools.lru_cache(maxsize=256)
@@ -763,6 +799,15 @@ def _record_exchange(route: str, method: str, true_bytes: int,
         metrics.counter("srj_tpu_shuffle_recv_bytes_total").inc(true_bytes)
         metrics.counter("srj_tpu_shuffle_padded_bytes_total").inc(
             padded, route=route)
+    except Exception:
+        pass
+    try:
+        # once the ledger has seen BOTH routes, persist the measured
+        # staged-vs-collective crossover next to the calibration file
+        # (throttled inside; replaces the 4.0 min-pad placeholder for
+        # later processes on this host)
+        from spark_rapids_jni_tpu.runtime import optimizer as _opt
+        _opt.maybe_persist_crossover()
     except Exception:
         pass
 

@@ -1,0 +1,942 @@
+"""Cost-based adaptive plan optimizer — the decision layer over the plan IR.
+
+``runtime/plan.py`` fuses chains structurally and ``obs/planstats.py``
+measures everything, but until now nothing *decided* anything with those
+numbers: rewrite order, impl choice and exchange route were structural
+defaults or env knobs.  This module is the Spark-AQE-shaped decision
+side, in three parts:
+
+**1. Rule-based rewriter** (:func:`optimize`) — semantics-preserving
+rewrites over the node list, each proven byte-identical by the
+equivalence grid in ``tests/test_optimizer.py``:
+
+=====================  ====================================================
+rule                   transformation
+=====================  ====================================================
+``pushdown_join``      bubble a filter left across joins (and intervening
+                       projects) when its refs are pre-join stream
+                       columns — legal because the mask ANDs commute and
+                       dup-join gathers are elementwise
+                       (``pred(col)[pidx] == pred(col[pidx])``)
+``pushdown_exchange``  evaluate a post-exchange filter's predicate BELOW
+                       the exchange: a generated ``__pd<i>`` int32 column
+                       rides the payload and the filter re-reads it —
+                       applied only when it sheds at least as many payload
+                       lanes as it adds, so exchange wire bytes never grow
+``reorder_filters``    most-selective-first ordering of adjacent filter
+                       runs using measured ``sel_ewma`` (adjacent filters
+                       commute — both AND into the mask)
+``prune_projections``  drop project outputs, scan columns and exchange
+                       payload lanes no downstream node references —
+                       shrinking staged bytes and exchange wire bytes
+=====================  ====================================================
+
+Rewritten plans are ordinary :class:`~runtime.plan.Plan` objects, so they
+fingerprint **distinctly** and land on the same pow-2 bucket /
+program-cache grid as any other plan (no per-input trace keys — the
+Awkward-JIT re-tracing pitfall).  ``SRJ_TPU_PLAN_OPT=0`` is the kill
+switch: :func:`for_execution` returns the original plan object untouched,
+restoring today's fingerprints and cache keys bit-for-bit.
+
+**2. Cost-based physical selection** — :func:`price_impl` prices the
+pallas-vs-xla pick per op off the live costmodel ledger (achieved GB/s
+per ``(op, sig, bucket, impl)`` cell); :func:`price_route` prices the
+shuffle's staged-vs-collective route off measured per-route wire
+throughput, replacing the ``SRJ_TPU_SHUFFLE_STAGED_MIN_PAD=4.0``
+placeholder with a measured crossover (persisted alongside calibration
+via :func:`maybe_persist_crossover`).  The env knobs remain *forced
+overrides*; unmeasured cells fall back to today's defaults.
+
+**3. Adaptive re-planning** — :func:`for_execution` keys a decision per
+original fingerprint.  Once the executing plan's filter stat cells
+mature (``SRJ_TPU_PLAN_OPT_MATURITY`` calls) and a minimum observation
+window has passed (``SRJ_TPU_PLAN_OPT_WINDOW`` executions), the filter
+ordering is re-derived from the measured EWMAs and swapped in behind the
+program-cache LRU — but only when the estimated scan-cost improvement
+clears ``SRJ_TPU_PLAN_OPT_MARGIN``, so selectivity noise (and the EWMA's
+own settling) cannot oscillate plans.
+
+Surfaces: ``srj_tpu_plan_rewrites_total{rule}``,
+``srj_tpu_plan_replans_total{plan}``,
+``srj_tpu_plan_opt_route_total{route,source}``, an ``optimizer``
+/healthz sub-document, and per-plan provenance pushed into
+``obs/planstats.py`` so ``obs explain --analyze`` renders which rules
+fired and estimated-vs-measured selectivity per rewritten node.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "enabled", "maturity_calls", "replan_window", "improvement_margin",
+    "optimize", "for_execution", "observe_program", "coalescing_fp8",
+    "decision_doc", "decisions", "reset",
+    "price_impl", "price_route", "route_prices", "staged_crossover",
+    "maybe_persist_crossover", "note_route", "route_summary",
+    "impl_summary",
+]
+
+_ENV = "SRJ_TPU_PLAN_OPT"
+_ENV_MATURITY = "SRJ_TPU_PLAN_OPT_MATURITY"
+_ENV_WINDOW = "SRJ_TPU_PLAN_OPT_WINDOW"
+_ENV_MARGIN = "SRJ_TPU_PLAN_OPT_MARGIN"
+
+_CROSSOVER_KEY = "shuffle_staged_crossover"
+
+
+def enabled() -> bool:
+    """Optimizer armed (``SRJ_TPU_PLAN_OPT=0`` is the kill switch —
+    plans execute exactly as authored, same fingerprints, same
+    program-cache keys)."""
+    return os.environ.get(_ENV, "1").strip().lower() not in (
+        "0", "off", "no", "false")
+
+
+def maturity_calls() -> int:
+    """Stat-cell call count before measured selectivity is trusted for
+    re-planning."""
+    try:
+        v = int(os.environ.get(_ENV_MATURITY, "8"))
+        return v if v > 0 else 8
+    except ValueError:
+        return 8
+
+
+def replan_window() -> int:
+    """Minimum executions between re-plan evaluations (hysteresis
+    half 1: the observation window)."""
+    try:
+        v = int(os.environ.get(_ENV_WINDOW, "16"))
+        return v if v > 0 else 16
+    except ValueError:
+        return 16
+
+
+def improvement_margin() -> float:
+    """Relative scan-cost improvement a candidate ordering must clear to
+    replace the current plan (hysteresis half 2: the margin)."""
+    try:
+        v = float(os.environ.get(_ENV_MARGIN, "0.1"))
+        return v if v >= 0 else 0.1
+    except ValueError:
+        return 0.1
+
+
+# ---------------------------------------------------------------------------
+# Rewriter
+# ---------------------------------------------------------------------------
+
+def _defined_names(node) -> set:
+    """Column names a node (re)defines in the stream."""
+    if node.kind == "project":
+        return {name for name, _ in node.get("outputs")}
+    if node.kind == "join":
+        return {node.get("out"), node.get("out_matched")} - {None}
+    if node.kind == "scan":
+        return set(node.get("columns"))
+    return set()
+
+
+def _side_names(node) -> set:
+    if node.kind != "join":
+        return set()
+    return {node.get("build_keys"), node.get("build_payload"),
+            node.get("build_live")} - {None}
+
+
+def _node_refs(node) -> List[str]:
+    """Stream columns a node reads."""
+    k = node.kind
+    if k == "filter":
+        return list(node.get("refs"))
+    if k == "project":
+        return [r for _, (_, rs) in node.get("outputs") for r in rs]
+    if k == "join":
+        return [node.get("probe")]
+    if k == "aggregate":
+        return list(node.get("keys")) + [r for r, _ in node.get("measures")]
+    if k == "exchange":
+        return [node.get("key")] + list(node.get("payload") or ())
+    return []
+
+
+def _pd_project(pred, refs: Tuple[str, ...], name: str):
+    """The generated pre-exchange predicate column (int32 so it stacks
+    with the int32 payload lanes without promotion)."""
+    from spark_rapids_jni_tpu.runtime import plan as _p
+    import jax.numpy as jnp
+
+    def _eval(*cols, _pred=pred):
+        return _pred(*cols).astype(jnp.int32)
+
+    return _p.project({name: (_eval, tuple(refs))})
+
+
+def _pd_filter(name: str):
+    from spark_rapids_jni_tpu.runtime import plan as _p
+    return _p.filter(lambda live: live != 0, [name])
+
+
+def _rule_pushdown_exchange(entries: List[Tuple[Any, Optional[int]]],
+                            fired: List[Dict]) -> None:
+    """Evaluate eligible post-exchange filters below the exchange.
+
+    The exchange emitter discards the pre-exchange mask (it exchanges
+    every local row and replaces the mask with slot validity), so a
+    filter cannot simply move across it.  Instead the predicate is
+    computed pre-exchange into a generated ``__pd<i>`` int32 column that
+    rides the payload, and the filter re-reads that column — the
+    delivered values are the pre-exchange values, so the post-exchange
+    mask is bit-identical.  Applied only when every predicate ref has no
+    other post-exchange consumer (so pruning sheds at least as many
+    payload lanes as the ``__pd`` lane adds — wire bytes never grow)."""
+    from spark_rapids_jni_tpu.runtime import plan as _p
+    i = 0
+    while i < len(entries):
+        node, tag = entries[i]
+        if node.kind != "filter":
+            i += 1
+            continue
+        refs = tuple(node.get("refs"))
+        if any(r.startswith("__pd") for r in refs):
+            i += 1
+            continue
+        # nearest exchange upstream of the filter
+        xi = None
+        for j in range(i - 1, -1, -1):
+            if entries[j][0].kind == "exchange":
+                xi = j
+                break
+        if xi is None:
+            i += 1
+            continue
+        xnode = entries[xi][0]
+        avail = {xnode.get("key")} | set(xnode.get("payload") or ())
+        if not set(refs) <= avail:
+            i += 1
+            continue
+        # refs must not be redefined between the exchange and the filter
+        redefined = set()
+        for j in range(xi + 1, i):
+            redefined |= _defined_names(entries[j][0])
+        if set(refs) & redefined:
+            i += 1
+            continue
+        # pay-off gate: each ref's only post-exchange consumer is this
+        # filter (the pruner will then drop its payload lane, netting
+        # the generated lane out), and at least one ref is a droppable
+        # payload lane (the key lane always rides, so a key-only
+        # predicate would grow the wire)
+        other_consumers = set()
+        for j in range(xi + 1, len(entries)):
+            if j == i:
+                continue
+            other_consumers |= set(_node_refs(entries[j][0]))
+        if set(refs) & other_consumers:
+            i += 1
+            continue
+        if not (set(refs) & (set(xnode.get("payload") or ())
+                             - {xnode.get("key")})):
+            i += 1
+            continue
+        pd_name = f"__pd{tag if tag is not None else i}"
+        payload = tuple(xnode.get("payload") or ()) + (pd_name,)
+        new_x = _p.exchange(xnode.get("key"), payload,
+                            xnode.get("num_parts"),
+                            xnode.get("axis_name"),
+                            xnode.get("capacity_factor"))
+        entries[xi] = (new_x, entries[xi][1])
+        entries[i] = (_pd_filter(pd_name), tag)
+        entries.insert(xi, (_pd_project(node.get("pred"), refs, pd_name),
+                            None))
+        fired.append({"rule": "pushdown_exchange",
+                      "node": _tag_id(tag, i),
+                      "detail": f"pred({', '.join(refs)}) evaluated "
+                                f"below exchange as {pd_name}"})
+        i += 2      # account for the inserted project
+    return
+
+
+def _rule_pushdown_join(entries: List[Tuple[Any, Optional[int]]],
+                        fired: List[Dict]) -> None:
+    """Bubble filters left across joins (and intervening projects).
+
+    Legal when the filter's refs are pre-join stream columns: not a join
+    output, not a side input, not produced by a crossed project.  The
+    move is byte-identical — masks AND commutatively, and the dup join's
+    stream gather is elementwise.  A move is committed only when it
+    crosses at least one join (or parks the filter directly behind an
+    exchange), so fingerprints never churn for nothing."""
+    changed = True
+    while changed:
+        changed = False
+        for i in range(1, len(entries)):
+            node, tag = entries[i]
+            if node.kind != "filter":
+                continue
+            refs = set(node.get("refs"))
+            p = i
+            crossed_join = False
+            while p > 0:
+                prev = entries[p - 1][0]
+                if prev.kind == "project":
+                    if refs & _defined_names(prev):
+                        break
+                elif prev.kind == "join":
+                    if refs & (_defined_names(prev) | _side_names(prev)):
+                        break
+                    crossed_join = True
+                else:
+                    break       # scan / exchange / filter: stop
+                p -= 1
+            parked_at_exchange = (p < i and p > 0
+                                  and entries[p - 1][0].kind == "exchange")
+            if p < i and (crossed_join or parked_at_exchange):
+                entries[p:i + 1] = ([entries[i]] + entries[p:i])
+                fired.append({"rule": "pushdown_join",
+                              "node": _tag_id(tag, p),
+                              "detail": f"moved {i - p} position(s) "
+                                        "upstream"})
+                changed = True
+                break
+
+
+def _run_cost(sels: Sequence[Optional[float]]) -> float:
+    """Relative scan cost of an ordered filter run: rows examined per
+    input row — 1 for the first filter, the running selectivity product
+    for each subsequent one.  Unknown selectivity prices as 1.0."""
+    cost, live = 0.0, 1.0
+    for s in sels:
+        cost += live
+        live *= min(1.0, max(0.0, 1.0 if s is None else float(s)))
+    return cost
+
+
+def _rule_reorder_filters(entries: List[Tuple[Any, Optional[int]]],
+                          sels: Dict[int, float],
+                          fired: List[Dict]) -> None:
+    """Most-selective-first ordering of adjacent filter runs, committed
+    only when the estimated scan-cost improvement clears the margin
+    (adjacent filters commute: both AND into the mask)."""
+    i = 0
+    while i < len(entries):
+        if entries[i][0].kind != "filter":
+            i += 1
+            continue
+        j = i
+        while j < len(entries) and entries[j][0].kind == "filter":
+            j += 1
+        run = entries[i:j]
+        if len(run) > 1:
+            def _sel(e):
+                return sels.get(e[1]) if e[1] is not None else None
+            cur = [_sel(e) for e in run]
+            order = sorted(range(len(run)),
+                           key=lambda k: (cur[k] if cur[k] is not None
+                                          else 1.01, k))
+            new = [run[k] for k in order]
+            if new != run:
+                old_cost = _run_cost(cur)
+                new_cost = _run_cost([cur[k] for k in order])
+                if old_cost > 0 and \
+                        (old_cost - new_cost) / old_cost > \
+                        improvement_margin():
+                    entries[i:j] = new
+                    fired.append({
+                        "rule": "reorder_filters",
+                        "node": _tag_id(run[0][1], i),
+                        "detail": "sel order "
+                                  + ",".join(_fmt_sel(s) for s in cur)
+                                  + " -> "
+                                  + ",".join(_fmt_sel(cur[k])
+                                             for k in order)})
+        i = j
+
+
+def _fmt_sel(s: Optional[float]) -> str:
+    return "?" if s is None else f"{s:.3f}"
+
+
+def _rule_prune(entries: List[Tuple[Any, Optional[int]]],
+                outputs: Optional[Tuple[str, ...]],
+                fired: List[Dict]) -> None:
+    """Drop project outputs, scan columns and exchange payload lanes no
+    downstream node references.  Only runs when the plan's outputs are
+    explicit (named outputs or a terminal aggregate) — a bare
+    cols-and-mask plan implicitly outputs every column."""
+    from spark_rapids_jni_tpu.runtime import plan as _p
+    has_agg = any(e[0].kind == "aggregate" for e in entries)
+    if not outputs and not has_agg:
+        return
+    changed = True
+    while changed:
+        changed = False
+        needed = set(outputs or ())
+        # walk back-to-front: a node's refs become needed upstream
+        for i in range(len(entries) - 1, -1, -1):
+            node, tag = entries[i]
+            if node.kind == "project":
+                keep = tuple((name, spec)
+                             for name, spec in node.get("outputs")
+                             if name in needed)
+                if len(keep) != len(node.get("outputs")):
+                    dropped = [name for name, _
+                               in node.get("outputs")
+                               if name not in needed]
+                    if not keep:
+                        del entries[i]
+                    else:
+                        entries[i] = (_p.project(
+                            {name: spec for name, spec in keep}), tag)
+                    fired.append({"rule": "prune_projections",
+                                  "node": _tag_id(tag, i),
+                                  "detail": "dropped "
+                                            + ", ".join(dropped)})
+                    changed = True
+                    break
+                # parallel projection: every output reads the PRE-node
+                # state, so discard all defined names before adding any
+                # expression refs (a ref may legitimately shadow one)
+                for name, _spec in keep:
+                    needed.discard(name)
+                for _name, (_, rs) in keep:
+                    needed.update(rs)
+            elif node.kind == "exchange":
+                payload = tuple(node.get("payload") or ())
+                keep_p = tuple(c for c in payload
+                               if c == node.get("key") or c in needed)
+                if keep_p != payload:
+                    entries[i] = (_p.exchange(
+                        node.get("key"), keep_p, node.get("num_parts"),
+                        node.get("axis_name"),
+                        node.get("capacity_factor")), tag)
+                    fired.append({
+                        "rule": "prune_projections",
+                        "node": _tag_id(tag, i),
+                        "detail": "payload lanes "
+                                  + str(len(payload)) + " -> "
+                                  + str(len(keep_p))})
+                    changed = True
+                    break
+                needed.update(_node_refs(node))
+            elif node.kind == "scan":
+                cols = tuple(node.get("columns"))
+                keep_c = tuple(c for c in cols if c in needed)
+                if not keep_c:
+                    keep_c = cols[:1]     # the row count must come from
+                                          # somewhere
+                if keep_c != cols:
+                    entries[i] = (_p.scan(*keep_c), tag)
+                    fired.append({"rule": "prune_projections",
+                                  "node": _tag_id(tag, i),
+                                  "detail": "scan columns "
+                                            + str(len(cols)) + " -> "
+                                            + str(len(keep_c))})
+                    changed = True
+                    break
+            else:
+                needed.update(_node_refs(node))
+                needed.update(_side_names(node))
+
+
+def _tag_id(tag: Optional[int], pos: int) -> str:
+    return f"n{tag}" if tag is not None else f"p{pos}"
+
+
+def optimize(plan, sels: Optional[Dict[int, float]] = None):
+    """Apply every rewrite rule to ``plan``.
+
+    ``sels`` maps original node indices to estimated selectivities (the
+    reorder rule's input).  Returns ``(new_plan, rules_fired,
+    node_map)`` where ``node_map`` maps original node indices to their
+    position in the rewritten plan; when no rule fires, ``new_plan`` is
+    the original plan object."""
+    from spark_rapids_jni_tpu.runtime import plan as _p
+    entries: List[Tuple[Any, Optional[int]]] = \
+        [(nd, i) for i, nd in enumerate(plan.nodes)]
+    fired: List[Dict] = []
+    _rule_pushdown_exchange(entries, fired)
+    _rule_pushdown_join(entries, fired)
+    _rule_reorder_filters(entries, sels or {}, fired)
+    _rule_prune(entries, plan.outputs, fired)
+    node_map = {tag: i for i, (_, tag) in enumerate(entries)
+                if tag is not None}
+    if not fired:
+        return plan, [], node_map
+    new_plan = _p.Plan([nd for nd, _ in entries], outputs=plan.outputs)
+    return new_plan, fired, node_map
+
+
+# ---------------------------------------------------------------------------
+# Decision registry + adaptive re-planning
+# ---------------------------------------------------------------------------
+
+class _Decision:
+    """Everything the optimizer knows about one original fingerprint."""
+    __slots__ = ("orig_fp", "orig_fp8", "plan", "rules", "node_map",
+                 "est_sels", "generation", "replans", "calls",
+                 "calls_at_replan")
+
+    def __init__(self, orig_fp: str, orig_fp8: str):
+        self.orig_fp = orig_fp
+        self.orig_fp8 = orig_fp8
+        self.plan = None              # optimized Plan, or None (no change)
+        self.rules: List[Dict] = []
+        self.node_map: Dict[int, int] = {}
+        self.est_sels: Dict[int, float] = {}
+        self.generation = 0
+        self.replans = 0
+        self.calls = 0
+        self.calls_at_replan = 0
+
+
+_REG_LOCK = threading.Lock()
+_REG: Dict[str, _Decision] = {}
+
+
+def reset() -> None:
+    """Drop every decision (test isolation)."""
+    with _REG_LOCK:
+        _REG.clear()
+    with _PRICE_LOCK:
+        _ROUTE_LAST.clear()
+        _IMPL_LAST.clear()
+
+
+def _measured_sels(fp8: str) -> Dict[str, Dict]:
+    """Per-node measured selectivity for one plan fingerprint: in-memory
+    planstats cells first, the persisted ``PLAN_STATS.json`` as the
+    cross-process fallback.  ``{node_id: {"sel": ewma, "calls": n}}``."""
+    try:
+        from spark_rapids_jni_tpu.obs import planstats
+        rec = planstats.snapshot(fp8)["plans"].get(fp8)
+        if not rec or not rec.get("cells"):
+            doc = planstats.load()
+            rec = ((doc or {}).get("plans") or {}).get(fp8)
+        out: Dict[str, Dict] = {}
+        for key, c in ((rec or {}).get("cells") or {}).items():
+            nid = key.split("|", 1)[0]
+            if not nid.startswith("n"):
+                continue
+            a = out.setdefault(nid, {"sel": None, "calls": 0})
+            a["calls"] += int(c.get("calls", 0))
+            if c.get("sel_ewma") is not None:
+                a["sel"] = float(c["sel_ewma"])
+        return out
+    except Exception:
+        return {}
+
+
+def _sels_for_original(plan, d: Optional[_Decision]) -> Dict[int, float]:
+    """Selectivity estimates keyed by ORIGINAL node index: measured
+    cells of the currently-executing fingerprint (mapped back through
+    ``node_map``), falling back to the original fingerprint's cells."""
+    exec_fp8 = (d.plan.fp8 if d is not None and d.plan is not None
+                else plan.fp8)
+    cells = _measured_sels(exec_fp8)
+    out: Dict[int, float] = {}
+    mature: Dict[int, bool] = {}
+    for i, nd in enumerate(plan.nodes):
+        if nd.kind != "filter":
+            continue
+        exec_i = d.node_map.get(i, i) if d is not None else i
+        c = cells.get(f"n{exec_i}")
+        if c is None and exec_fp8 != plan.fp8:
+            c = _measured_sels(plan.fp8).get(f"n{i}")
+        if c and c.get("sel") is not None:
+            out[i] = float(c["sel"])
+            mature[i] = c.get("calls", 0) >= maturity_calls()
+    out["__mature__"] = all(mature.values()) and bool(mature)  # type: ignore
+    return out
+
+
+def _build_decision(plan) -> _Decision:
+    """First sight of a fingerprint: apply the static rules (plus the
+    stats-driven ordering when persisted selectivities are already
+    mature) and record the provenance."""
+    d = _Decision(plan.fingerprint, plan.fp8)
+    sels = _sels_for_original(plan, None)
+    mature = bool(sels.pop("__mature__", False))
+    new_plan, fired, node_map = optimize(plan, sels if mature else None)
+    d.node_map = node_map
+    d.rules = fired
+    d.est_sels = {k: v for k, v in sels.items() if isinstance(k, int)}
+    if fired:
+        d.plan = new_plan
+        for f in fired:
+            _count_rewrite(f["rule"])
+    _note_provenance(plan, d)
+    return d
+
+
+def _note_provenance(plan, d: _Decision) -> None:
+    """Push the decision doc into planstats (under both fingerprints) so
+    ``obs explain --analyze`` renders it, and persist with the stats."""
+    try:
+        from spark_rapids_jni_tpu.obs import planstats
+        if not planstats.enabled():
+            return
+        doc = decision_doc(d)
+        planstats.note_optimizer(d.orig_fp8, doc)
+        if d.plan is not None:
+            planstats.register_plan(d.plan)
+            planstats.note_optimizer(d.plan.fp8, doc)
+    except Exception:
+        pass
+
+
+def decision_doc(d: _Decision) -> Dict:
+    """JSON-safe provenance for one decision (what explain renders)."""
+    return {
+        "origin": d.orig_fp8,
+        "optimized": d.plan.fp8 if d.plan is not None else None,
+        "generation": d.generation,
+        "replans": d.replans,
+        "calls": d.calls,
+        "rules": list(d.rules),
+        "filters": [{"node": f"n{d.node_map.get(i, i)}",
+                     "origin": f"n{i}", "est_sel": s}
+                    for i, s in sorted(d.est_sels.items())],
+    }
+
+
+def decisions() -> Dict[str, Dict]:
+    """Snapshot of every decision, keyed by original fp8."""
+    with _REG_LOCK:
+        ds = list(_REG.values())
+    return {d.orig_fp8: decision_doc(d) for d in ds}
+
+
+def _maybe_replan(plan, d: _Decision) -> None:
+    """AQE half: once the observation window has passed and the
+    executing plan's filter cells are mature, re-derive the ordering
+    from measured EWMAs; swap only when the estimated improvement
+    clears the margin (hysteresis — noise cannot oscillate plans)."""
+    if d.calls - d.calls_at_replan < replan_window():
+        return
+    d.calls_at_replan = d.calls
+    sels = _sels_for_original(plan, d)
+    if not sels.pop("__mature__", False):
+        return
+    est = {k: v for k, v in sels.items() if isinstance(k, int)}
+    new_plan, fired, node_map = optimize(plan, est)
+    cur_fp = (d.plan or plan).fingerprint
+    if new_plan.fingerprint == cur_fp:
+        d.est_sels = est
+        return
+    d.plan = new_plan if new_plan is not plan else None
+    d.rules = fired
+    d.node_map = node_map
+    d.est_sels = est
+    d.generation += 1
+    d.replans += 1
+    for f in fired:
+        _count_rewrite(f["rule"])
+    try:
+        from spark_rapids_jni_tpu.obs import metrics
+        metrics.counter("srj_tpu_plan_replans_total",
+                        "Adaptive re-plans (plan swapped for a "
+                        "re-optimized twin).", ("plan",)
+                        ).inc(1, plan=d.orig_fp8)
+    except Exception:
+        pass
+    _note_provenance(plan, d)
+
+
+def for_execution(plan):
+    """The executor hook: resolve ``plan`` to the plan that should run.
+
+    Returns ``(exec_plan, decision)``.  With the kill switch off, or for
+    plans the rewriter leaves untouched, ``exec_plan`` IS the argument
+    (same object — fingerprints and program-cache keys bit-identical to
+    an optimizer-less build)."""
+    if not enabled():
+        return plan, None
+    if getattr(plan, "_opt_origin", None) is not None:
+        return plan, None
+    _ensure_exported()
+    fp = plan.fingerprint
+    with _REG_LOCK:
+        d = _REG.get(fp)
+    if d is None:
+        d = _build_decision(plan)
+        with _REG_LOCK:
+            d = _REG.setdefault(fp, d)
+    d.calls += 1
+    _maybe_replan(plan, d)
+    if d.plan is None:
+        return plan, d
+    d.plan._opt_origin = d.orig_fp8      # never re-optimized recursively
+    d.plan._opt_generation = d.generation
+    return d.plan, d
+
+
+def observe_program(plan) -> Optional[_Decision]:
+    """Maturity accounting for :func:`runtime.plan.run_program` — the
+    externally-traced route executes an already-compiled program, so the
+    plan cannot be swapped; the call still counts toward the decision's
+    observation window."""
+    if not enabled():
+        return None
+    if getattr(plan, "_opt_origin", None) is not None:
+        return None
+    fp = plan.fingerprint
+    with _REG_LOCK:
+        d = _REG.get(fp)
+    if d is None:
+        d = _build_decision(plan)
+        with _REG_LOCK:
+            d = _REG.setdefault(fp, d)
+    d.calls += 1
+    return d
+
+
+def coalescing_fp8(plan) -> str:
+    """The fingerprint the executor would actually run — what serve
+    adapters put in their coalescing signatures, so requests batch on
+    the optimized program, not the authored one."""
+    try:
+        if not enabled():
+            return plan.fp8
+        fp = plan.fingerprint
+        with _REG_LOCK:
+            d = _REG.get(fp)
+        if d is None:
+            d = _build_decision(plan)
+            with _REG_LOCK:
+                d = _REG.setdefault(fp, d)
+        return d.plan.fp8 if d.plan is not None else plan.fp8
+    except Exception:
+        return plan.fp8
+
+
+# ---------------------------------------------------------------------------
+# Priced physical selection (ledger-backed)
+# ---------------------------------------------------------------------------
+
+_PRICE_LOCK = threading.Lock()
+_ROUTE_LAST: Dict[str, Any] = {}
+_IMPL_LAST: Dict[str, Dict] = {}
+_PERSIST_TICK = [0]
+
+
+def _ledger_rows():
+    from spark_rapids_jni_tpu.obs import costmodel
+    # ceiling=1.0 skips the lazy micro-calibration — pricing compares
+    # impls against each other, not against the roofline
+    return costmodel.ledger().profile(ceiling=1.0)
+
+
+def route_prices() -> Dict[str, float]:
+    """Measured wire throughput (GB/s) per shuffle route, aggregated
+    over the ledger's per-(row-size, capacity) shuffle cells."""
+    agg: Dict[str, List[float]] = {}
+    try:
+        for r in _ledger_rows():
+            if (r.get("op") == "shuffle_table_sharded"
+                    and r.get("impl") in ("staged", "collective")):
+                t = r.get("device_s") or r.get("wall_s") or 0.0
+                b = r.get("bytes", 0)
+                if t > 0 and b > 0:
+                    a = agg.setdefault(r["impl"], [0.0, 0.0])
+                    a[0] += float(b)
+                    a[1] += float(t)
+    except Exception:
+        return {}
+    return {impl: b / t / 1e9 for impl, (b, t) in agg.items() if t > 0}
+
+
+def staged_crossover() -> Tuple[Optional[float], str]:
+    """The measured staged-vs-collective crossover ``C`` (staged wins
+    when ``collective_wire_bytes > C * staged_wire_bytes``): the ratio
+    of measured per-route throughputs, falling back to the value
+    persisted alongside calibration.  ``(None, "none")`` when neither
+    exists — callers then keep today's 4.0 pad-ratio heuristic."""
+    p = route_prices()
+    if p.get("staged") and p.get("collective"):
+        return p["collective"] / p["staged"], "ledger"
+    try:
+        from spark_rapids_jni_tpu.obs import costmodel
+        doc = costmodel.load_calibration()
+        if doc and isinstance(doc.get(_CROSSOVER_KEY), (int, float)) \
+                and doc[_CROSSOVER_KEY] > 0:
+            return float(doc[_CROSSOVER_KEY]), "calibration"
+    except Exception:
+        pass
+    return None, "none"
+
+
+def price_route(xplan) -> Optional[Tuple[str, str]]:
+    """Priced staged-vs-collective pick for one exchange plan:
+    ``(route, source)``, or ``None`` when no measured crossover exists
+    (the caller falls back to the static pad-ratio heuristic).  The
+    decision compares estimated wire *time* per route:
+    ``staged_wire/G_staged < collective_wire/G_collective``."""
+    try:
+        c, src = staged_crossover()
+        if c is None:
+            return None
+        staged_wins = (
+            xplan.staged_wire_bytes < xplan.collective_wire_bytes
+            and xplan.collective_wire_bytes > c * xplan.staged_wire_bytes)
+        route = "staged" if staged_wins else "collective"
+        with _PRICE_LOCK:
+            _ROUTE_LAST.update(
+                route=route, source="priced", crossover=round(c, 4),
+                crossover_source=src,
+                collective_wire_bytes=int(xplan.collective_wire_bytes),
+                staged_wire_bytes=int(xplan.staged_wire_bytes))
+        return route, "priced"
+    except Exception:
+        return None
+
+
+def maybe_persist_crossover(every: int = 8) -> Optional[float]:
+    """Persist the ledger-measured crossover alongside calibration
+    (throttled: every ``every``-th call actually writes, and only when a
+    calibration file already exists — the crossover is a refinement of
+    that artifact, not a replacement)."""
+    with _PRICE_LOCK:
+        _PERSIST_TICK[0] += 1
+        if _PERSIST_TICK[0] % max(1, int(every)):
+            return None
+    try:
+        p = route_prices()
+        if not (p.get("staged") and p.get("collective")):
+            return None
+        c = p["collective"] / p["staged"]
+        from spark_rapids_jni_tpu.obs import costmodel
+        if costmodel.update_calibration({_CROSSOVER_KEY: c}) is not None:
+            return c
+    except Exception:
+        pass
+    return None
+
+
+def note_route(route: str, source: str) -> None:
+    """Count one route decision (``source``: ``priced`` — ledger-backed
+    pick, ``forced`` — env override, ``default`` — static fallback)."""
+    _ensure_exported()
+    with _PRICE_LOCK:
+        _ROUTE_LAST.update(route=route, source=source)
+    try:
+        from spark_rapids_jni_tpu.obs import metrics
+        metrics.counter("srj_tpu_plan_opt_route_total",
+                        "Shuffle route decisions by source.",
+                        ("route", "source")).inc(1, route=route,
+                                                 source=source)
+    except Exception:
+        pass
+
+
+def route_summary() -> Dict:
+    with _PRICE_LOCK:
+        return dict(_ROUTE_LAST)
+
+
+def price_impl(op: str, sig=None) -> Optional[str]:
+    """Ledger-priced pallas-vs-xla pick for one op: the impl with higher
+    measured throughput, when BOTH impls have mature measurements and
+    the winner clears the improvement margin.  ``None`` means no verdict
+    (the caller keeps the platform default)."""
+    if not enabled():
+        return None
+    agg: Dict[str, List[float]] = {}
+    try:
+        sig_s = str(sig) if sig is not None else None
+        rows = [r for r in _ledger_rows() if r.get("op") == op
+                and r.get("impl") in ("pallas", "xla")]
+        if sig_s is not None and any(r.get("sig") == sig_s for r in rows):
+            rows = [r for r in rows if r.get("sig") == sig_s]
+        for r in rows:
+            t = r.get("device_s") or r.get("wall_s") or 0.0
+            b = r.get("bytes", 0)
+            if t > 0 and b > 0:
+                a = agg.setdefault(r["impl"], [0.0, 0.0, 0.0])
+                a[0] += float(b)
+                a[1] += float(t)
+                a[2] += float(r.get("calls", 0))
+    except Exception:
+        return None
+    if not ({"pallas", "xla"} <= set(agg)):
+        return None
+    if any(a[2] < maturity_calls() for a in agg.values()):
+        return None
+    gbps = {impl: b / t / 1e9 for impl, (b, t, _) in agg.items()}
+    winner = max(gbps, key=gbps.get)
+    loser = "xla" if winner == "pallas" else "pallas"
+    if gbps[winner] <= gbps[loser] * (1.0 + improvement_margin()):
+        return None
+    with _PRICE_LOCK:
+        _IMPL_LAST[op] = {"impl": winner, "alternative": loser,
+                          "gbps": {k: round(v, 3)
+                                   for k, v in gbps.items()},
+                          "source": "priced"}
+    return winner
+
+
+def impl_summary() -> Dict[str, Dict]:
+    with _PRICE_LOCK:
+        return {k: dict(v) for k, v in _IMPL_LAST.items()}
+
+
+# ---------------------------------------------------------------------------
+# Metrics / healthz export
+# ---------------------------------------------------------------------------
+
+_EXPORTED = False
+_EXPORT_LOCK = threading.Lock()
+
+
+def _count_rewrite(rule: str) -> None:
+    _ensure_exported()
+    try:
+        from spark_rapids_jni_tpu.obs import metrics
+        metrics.counter("srj_tpu_plan_rewrites_total",
+                        "Plan rewrite rules fired.", ("rule",)
+                        ).inc(1, rule=rule)
+    except Exception:
+        pass
+
+
+def _health() -> Dict:
+    with _REG_LOCK:
+        ds = list(_REG.values())
+    plans = {}
+    for d in ds:
+        plans[d.orig_fp8] = {
+            "optimized": d.plan.fp8 if d.plan is not None else None,
+            "generation": d.generation, "replans": d.replans,
+            "calls": d.calls,
+            "rules": sorted({f["rule"] for f in d.rules}),
+        }
+    return {"enabled": enabled(), "window": replan_window(),
+            "margin": improvement_margin(),
+            "maturity": maturity_calls(), "plans": plans,
+            "route": route_summary(), "impl": impl_summary()}
+
+
+def _ensure_exported() -> None:
+    global _EXPORTED
+    if _EXPORTED:
+        return
+    with _EXPORT_LOCK:
+        if _EXPORTED:
+            return
+        try:
+            from spark_rapids_jni_tpu.obs import exporter, metrics
+            metrics.counter("srj_tpu_plan_rewrites_total",
+                            "Plan rewrite rules fired.", ("rule",))
+            metrics.counter("srj_tpu_plan_replans_total",
+                            "Adaptive re-plans (plan swapped for a "
+                            "re-optimized twin).", ("plan",))
+            metrics.counter("srj_tpu_plan_opt_route_total",
+                            "Shuffle route decisions by source.",
+                            ("route", "source"))
+            exporter.register_health_provider("optimizer", _health)
+        except Exception:
+            pass
+        _EXPORTED = True

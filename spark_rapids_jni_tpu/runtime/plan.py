@@ -567,6 +567,7 @@ def as_traced(plan: Plan, input_names: Sequence[str],
     overflow)`` with the OR of exchange/join capacity overflows (False
     scalar when the plan has none) — the distributed steps' host-checked
     retry contract."""
+    plan = _optimized(plan)
     names = tuple(input_names)
     idxs = plan.body_indices()
 
@@ -858,6 +859,21 @@ def _input_bytes(inputs: Dict[str, Any]) -> int:
     return total
 
 
+def _optimized(plan: Plan) -> Plan:
+    """Swap in the optimizer's rewritten twin of ``plan``.  Pure
+    pass-through (the SAME object — identical fingerprints and
+    program-cache keys) when ``SRJ_TPU_PLAN_OPT=0`` or no rewrite rule
+    fires; the optimized twin is an ordinary Plan with its own distinct
+    fingerprint, so it rides the bucket/program-cache grid like any
+    other plan."""
+    try:
+        from spark_rapids_jni_tpu.runtime import optimizer as _opt
+        p, _ = _opt.for_execution(plan)
+        return p
+    except Exception:
+        return plan
+
+
 def execute(plan: Plan, inputs: Dict[str, Any],
             mask: Optional[Any] = None, bucket="auto"):
     """Run ``plan`` over named input arrays and return the terminal
@@ -867,10 +883,19 @@ def execute(plan: Plan, inputs: Dict[str, Any],
     grid (the padded tail is dead via the mask), each fused segment
     executes as one cached jitted program under ``resilience.run``, and
     the whole run is a ``plan[<fp8>]`` span.  Inside a jit trace this
-    is a plain inlined call — the caller's program owns compilation."""
+    is a plain inlined call — the caller's program owns compilation.
+
+    The adaptive optimizer (``runtime/optimizer.py``) may substitute a
+    rewritten twin here; when it does, inputs its projection pruning
+    orphaned are dropped before staging (the staged-bytes win)."""
+    authored = plan
+    plan = _optimized(plan)
     stream = plan.stream_inputs
     if not stream:
         raise ValueError("plan has no scan node")
+    if plan is not authored:
+        keep = set(stream) | set(plan.side_inputs)
+        inputs = {k: v for k, v in inputs.items() if k in keep}
     if not _um.eager():
         st = {"cols": dict(inputs), "mask": mask, "ovf": None,
               "result": None}
@@ -1082,6 +1107,14 @@ def run_program(plan: Plan, fn, *args, sig="", bucket="", kwargs=None):
     if not _um.eager():
         return fn(*args, **(kwargs or {}))
     _ensure_exported()
+    try:
+        # the program is already traced from this plan, so it cannot be
+        # swapped — the call still feeds the optimizer's observation
+        # window (maturity accounting for adaptive re-planning)
+        from spark_rapids_jni_tpu.runtime import optimizer as _opt
+        _opt.observe_program(plan)
+    except Exception:
+        pass
     key = (plan.fingerprint, ("prog", str(bucket), str(sig)), None)
     _cache_lookup(key, lambda: fn, fp8=plan.fp8)
     from spark_rapids_jni_tpu.obs import spans as _spans

@@ -67,6 +67,18 @@ def _stack_pad(arrs: Sequence[np.ndarray], kb: int, width: int,
     return out
 
 
+def _coalescing_fp8(plan) -> str:
+    """The fp8 the optimizer would actually execute for ``plan`` (never
+    counts toward its observation window) — after a re-plan the
+    coalescing key changes with the fingerprint, so stale batches never
+    mix generations.  Falls back to the authored fp8."""
+    try:
+        from spark_rapids_jni_tpu.runtime import optimizer as _opt
+        return _opt.coalescing_fp8(plan)
+    except Exception:
+        return plan.fp8
+
+
 class ServeOp:
     """Interface of one coalescable op (see module docstring)."""
 
@@ -130,9 +142,10 @@ class _AggOp(ServeOp):
         # the plan fingerprint rides at the END of the signature: the
         # positional (bucket, max_groups) contract of kernel() holds,
         # and the scheduler's per-(op, sig) coalescing key now groups
-        # by plan identity too
+        # by plan identity too — the fingerprint the optimizer would
+        # actually execute, so a re-plan starts a fresh coalescing key
         sig = (shapes.bucket_rows(n), max_groups,
-               _agg_plan(max_groups).fp8)
+               _coalescing_fp8(_agg_plan(max_groups)))
         return payload, sig, n, keys.nbytes + values.nbytes
 
     def batch(self, payloads, sig, kb):
@@ -200,7 +213,7 @@ class _JoinOp(ServeOp):
         payload = {"build_keys": bk, "build_payload": bp,
                    "probe_keys": pk, "m": m, "n": n}
         sig = (shapes.bucket_rows(m), shapes.bucket_rows(n),
-               _join_plan().fp8)
+               _coalescing_fp8(_join_plan()))
         return payload, sig, n, bk.nbytes + bp.nbytes + pk.nbytes
 
     def batch(self, payloads, sig, kb):

@@ -335,8 +335,12 @@ def test_bundle_on_injected_fault_identifies_batch(obs_on, diag, sched,
     trace ids/tenants, with the lowered program text alongside.
 
     Retries pinned OFF so the 2-fault budget still maps onto group +
-    first-fallback dispatch (recovery itself is test_resilience.py)."""
+    first-fallback dispatch (recovery itself is test_resilience.py).
+    Drift sentinel pinned OFF too: the faulted dispatch's latency spike
+    can trip a serve.request drift alarm (baselines seeded by earlier
+    tests), adding a second bundle this test doesn't expect."""
     monkeypatch.setenv("SRJ_TPU_RETRY_MAX", "1")
+    monkeypatch.setenv("SRJ_TPU_DRIFT", "0")
     rng = np.random.default_rng(13)
     cs = [serve.Client(sched, f"t{i}") for i in range(3)]
     data = [(rng.integers(0, 16, 40 + i).astype(np.int32),

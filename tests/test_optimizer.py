@@ -1,0 +1,622 @@
+"""Adaptive plan-optimizer contract tests (``runtime/optimizer.py``).
+
+Five layers:
+
+1. Rewrite legality: each rule's structural effect, and the illegality
+   guards (a filter referencing a join output or a non-payload column
+   must NOT cross that join / exchange).
+2. Byte-identity: optimized vs unoptimized execution across the 5 null
+   patterns and bucket-edge row counts for every rule and the combined
+   plan — int32 chains, so equality is exact.
+3. Kill switch: ``SRJ_TPU_PLAN_OPT=0`` makes ``for_execution`` the
+   identity (same plan OBJECT, same fingerprints, same program-cache
+   keys as an optimizer-less build).
+4. Adaptation: measured selectivity triggers exactly one re-plan with a
+   zero-compile warm burst after it settles; adversarial alternating
+   selectivity cannot oscillate plans (hysteresis).
+5. Pricing: staged-vs-collective crossover from ledger / calibration,
+   pallas-vs-xla impl pricing with maturity + margin gates, crossover
+   persistence, metrics / healthz surfaces.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu import obs
+from spark_rapids_jni_tpu.obs import costmodel, exporter, metrics, planstats
+from spark_rapids_jni_tpu.parallel import shuffle as shuffle_mod
+from spark_rapids_jni_tpu.runtime import optimizer, plan
+
+
+@pytest.fixture
+def obs_on():
+    obs.configure_sink(None)
+    obs.clear()
+    metrics.registry().reset()
+    obs.enable()
+    yield
+    obs.disable()
+    obs.configure_sink(None)
+    obs.clear()
+    metrics.registry().reset()
+
+
+@pytest.fixture(autouse=True)
+def fresh(tmp_path, monkeypatch):
+    """Isolate every test: fresh program cache / decisions / stats, and
+    point the stats + calibration files into tmp so autosave never
+    touches the repo working directory."""
+    monkeypatch.setenv("SRJ_TPU_PLAN_STATS_FILE",
+                       str(tmp_path / "PLAN_STATS.json"))
+    monkeypatch.setenv("SRJ_TPU_CALIBRATION_FILE",
+                       str(tmp_path / "CALIBRATION.json"))
+    monkeypatch.delenv("SRJ_TPU_PLAN_OPT", raising=False)
+    monkeypatch.delenv("SRJ_TPU_SHUFFLE_ROUTE", raising=False)
+    monkeypatch.delenv("SRJ_TPU_SHUFFLE_STAGED_MIN_PAD", raising=False)
+    plan.clear_cache()
+    optimizer.reset()
+    planstats.reset()
+    yield
+    plan.clear_cache()
+    optimizer.reset()
+    planstats.reset()
+
+
+EDGES = [0, 1, 7, 8, 9, 31, 32, 33]
+
+
+def _null_patterns(n):
+    yield None
+    yield np.ones(n, bool)
+    yield np.zeros(n, bool)
+    m = np.zeros(n, bool)
+    m[::2] = True
+    yield m
+    yield np.random.default_rng(n).random(n) > 0.4
+
+
+def _join_chain():
+    """Probe-side filter above a join (pushable), join-output filter
+    (NOT pushable), unused scan column ``w`` (prunable): one plan that
+    exercises pushdown_join + prune and their guards together."""
+    return plan.Plan([
+        plan.scan("k", "v", "w"),
+        plan.join("bk", "k", build_payload="bp", out="p"),
+        plan.filter(lambda v: v > jnp.int32(5), ["v"]),
+        plan.filter(lambda p: p < jnp.int32(60), ["p"]),
+        plan.project({"s": (lambda v, p: v + p, ["v", "p"])}),
+        plan.aggregate(["k"], [("s", "sum")], 16),
+    ])
+
+
+def _join_inputs(n, seed=0):
+    r = np.random.default_rng(seed)
+    m = 16
+    return {"k": r.integers(0, m, n).astype(np.int32),
+            "v": r.integers(-20, 20, n).astype(np.int32),
+            "w": r.integers(0, 9, n).astype(np.int32),
+            "bk": np.arange(m, dtype=np.int32),
+            "bp": ((np.arange(m, dtype=np.int32) * 7) % 90)
+            .astype(np.int32)}
+
+
+def _two_filter_chain(t1=3, t2=5):
+    return plan.Plan([
+        plan.scan("k", "v"),
+        plan.filter(lambda v: v > jnp.int32(t1), ["v"]),
+        plan.filter(lambda k: k < jnp.int32(t2), ["k"]),
+        plan.aggregate(["k"], [("v", "sum")], 16),
+    ])
+
+
+def _kv_inputs(n, seed=0):
+    r = np.random.default_rng(seed)
+    return {"k": r.integers(0, 8, n).astype(np.int32),
+            "v": r.integers(-10, 10, n).astype(np.int32)}
+
+
+def _exec_pair(p_ref, p_opt, ins, mask):
+    """Run both plans with the optimizer disabled (plans as authored)
+    and return the result tuples."""
+    os.environ["SRJ_TPU_PLAN_OPT"] = "0"
+    try:
+        plan.clear_cache()
+        a = plan.execute(p_ref, dict(ins), mask=mask)
+        b = plan.execute(p_opt, dict(ins), mask=mask)
+    finally:
+        del os.environ["SRJ_TPU_PLAN_OPT"]
+    return a, b
+
+
+def _assert_same(a, b, ctx):
+    if not isinstance(a, tuple):
+        a, b = (a,), (b,)
+    for x, y in zip(a, b):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), ctx
+
+
+# ---------------------------------------------------------------------------
+# Rewrite legality + structure
+# ---------------------------------------------------------------------------
+
+def test_pushdown_join_moves_probe_filter_only():
+    p = _join_chain()
+    new, fired, node_map = optimizer.optimize(p)
+    rules = [f["rule"] for f in fired]
+    assert "pushdown_join" in rules
+    kinds = [nd.kind for nd in new.nodes]
+    # probe filter now sits below the join; the join-output filter stays
+    assert kinds.index("filter") < kinds.index("join")
+    post_join = [nd for nd in new.nodes[kinds.index("join"):]
+                 if nd.kind == "filter"]
+    assert len(post_join) == 1 and post_join[0].get("refs") == ("p",)
+    assert new.fingerprint != p.fingerprint
+
+
+def test_prune_drops_unused_scan_column():
+    p = _join_chain()
+    new, fired, _ = optimizer.optimize(p)
+    assert "prune_projections" in [f["rule"] for f in fired]
+    assert "w" not in new.nodes[0].get("columns")
+
+
+def test_filter_on_join_output_does_not_cross():
+    p = plan.Plan([
+        plan.scan("k", "v"),
+        plan.join("bk", "k", build_payload="bp", out="p"),
+        plan.filter(lambda p: p > jnp.int32(0), ["p"]),
+        plan.aggregate(["k"], [("v", "sum"), ("p", "sum")], 16),
+    ])
+    new, fired, _ = optimizer.optimize(p)
+    assert "pushdown_join" not in [f["rule"] for f in fired]
+    kinds = [nd.kind for nd in new.nodes]
+    assert kinds.index("join") < kinds.index("filter")
+
+
+def test_filter_on_project_output_does_not_cross_project():
+    p = plan.Plan([
+        plan.scan("k", "v"),
+        plan.join("bk", "k", build_payload="bp", out="jp"),
+        plan.project({"d": (lambda v: v * jnp.int32(2), ["v"])}),
+        plan.filter(lambda d: d > jnp.int32(0), ["d"]),
+        plan.aggregate(["k"], [("d", "sum"), ("jp", "sum")], 16),
+    ])
+    new, _, _ = optimizer.optimize(p)
+    kinds = [nd.kind for nd in new.nodes]
+    assert kinds.index("project") < kinds.index("filter")
+
+
+def test_pushdown_exchange_structure_and_guards():
+    # w is read ONLY by the filter -> predicate evaluates below the
+    # exchange into a __pd payload lane and the w lane is dropped
+    p = plan.Plan([
+        plan.scan("k", "v", "w"),
+        plan.exchange("k", ("k", "v", "w"), 4),
+        plan.filter(lambda w: w % jnp.int32(3) == 0, ["w"]),
+        plan.aggregate(["k"], [("v", "sum")], 16),
+    ])
+    new, fired, _ = optimizer.optimize(p)
+    assert "pushdown_exchange" in [f["rule"] for f in fired]
+    kinds = [nd.kind for nd in new.nodes]
+    xi = kinds.index("exchange")
+    assert new.nodes[xi - 1].kind == "project"        # pred below
+    payload = new.nodes[xi].get("payload")
+    assert "w" not in payload
+    assert any(c.startswith("__pd") for c in payload)
+    assert len(payload) <= 3                           # wire never grows
+
+    # v is also consumed by the aggregate -> no droppable lane -> the
+    # rewrite would grow the wire; rule must skip
+    p2 = plan.Plan([
+        plan.scan("k", "v"),
+        plan.exchange("k", ("k", "v"), 4),
+        plan.filter(lambda v: v > jnp.int32(5), ["v"]),
+        plan.aggregate(["k"], [("v", "sum")], 16),
+    ])
+    _, fired2, _ = optimizer.optimize(p2)
+    assert "pushdown_exchange" not in [f["rule"] for f in fired2]
+
+    # filter referencing a non-payload column cannot cross the exchange
+    p3 = plan.Plan([
+        plan.scan("k", "v", "w"),
+        plan.exchange("k", ("k", "v"), 4),
+        plan.filter(lambda w: w > jnp.int32(0), ["w"]),
+        plan.aggregate(["k"], [("v", "sum")], 16),
+    ])
+    _, fired3, _ = optimizer.optimize(p3)
+    assert "pushdown_exchange" not in [f["rule"] for f in fired3]
+
+
+def test_reorder_filters_most_selective_first():
+    p = _two_filter_chain()
+    # n1 keeps 90%, n2 keeps 10% -> n2 should run first
+    new, fired, node_map = optimizer.optimize(p, {1: 0.9, 2: 0.1})
+    assert "reorder_filters" in [f["rule"] for f in fired]
+    assert node_map[2] < node_map[1]
+    # margin hysteresis: near-equal selectivities must NOT commit
+    same, fired2, _ = optimizer.optimize(p, {1: 0.52, 2: 0.50})
+    assert same is p and not fired2
+
+
+def test_flagship_filter_is_not_pushable():
+    """The flagship filter references the join output ``item_price`` —
+    the canonical illegality-guard case."""
+    from spark_rapids_jni_tpu.models import pipeline
+    p = pipeline.flagship_plan()
+    _, fired, _ = optimizer.optimize(p)
+    assert "pushdown_join" not in [f["rule"] for f in fired]
+
+
+# ---------------------------------------------------------------------------
+# Byte-identity grid (every rule + the combined plan)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", EDGES)
+def test_pushdown_join_and_prune_byte_identity(n):
+    p = _join_chain()
+    new, fired, _ = optimizer.optimize(p)
+    assert fired
+    for mask in _null_patterns(n):
+        a, b = _exec_pair(p, new, _join_inputs(n, seed=n), mask)
+        _assert_same(a, b, (n, "join+prune"))
+
+
+@pytest.mark.parametrize("n", EDGES)
+def test_reorder_byte_identity(n):
+    p = _two_filter_chain()
+    new, fired, _ = optimizer.optimize(p, {1: 0.9, 2: 0.1})
+    assert fired
+    for mask in _null_patterns(n):
+        a, b = _exec_pair(p, new, _kv_inputs(n, seed=n), mask)
+        _assert_same(a, b, (n, "reorder"))
+
+
+@pytest.mark.parametrize("n", [0, 1, 8, 33])
+def test_end_to_end_optimized_execution_byte_identity(n, monkeypatch):
+    """OPT=1 end to end (the executor swaps the plan) vs OPT=0."""
+    p = _join_chain()
+    ins = _join_inputs(n, seed=n)
+    for mask in _null_patterns(n):
+        monkeypatch.setenv("SRJ_TPU_PLAN_OPT", "0")
+        plan.clear_cache()
+        optimizer.reset()
+        a = plan.execute(p, dict(ins), mask=mask)
+        monkeypatch.setenv("SRJ_TPU_PLAN_OPT", "1")
+        plan.clear_cache()
+        optimizer.reset()
+        b = plan.execute(p, dict(ins), mask=mask)
+        _assert_same(a, b, (n, "end-to-end"))
+
+
+def test_exchange_pushdown_byte_identity_on_mesh():
+    """The __pd rewrite of a distributed plan computes identical bytes
+    on a real 4-partition mesh: delivered payload values are
+    pre-exchange values, so pred-below == pred-above."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from spark_rapids_jni_tpu.utils.compat import shard_map
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs 4 host devices")
+    mesh = Mesh(np.array(devs[:4]), ("data",))
+    p = plan.Plan([
+        plan.scan("k", "v", "w"),
+        plan.exchange("k", ("k", "v", "w"), 4),
+        plan.filter(lambda w: w % jnp.int32(3) == 0, ["w"]),
+        plan.aggregate(["k"], [("v", "sum")], 16),
+    ])
+    new, fired, _ = optimizer.optimize(p)
+    assert "pushdown_exchange" in [f["rule"] for f in fired]
+    r = np.random.default_rng(3)
+    n = 4 * 32
+    k = r.integers(0, 16, n).astype(np.int32)
+    v = r.integers(-20, 20, n).astype(np.int32)
+    w = r.integers(0, 9, n).astype(np.int32)
+
+    def run(pl):
+        body = plan.as_traced(pl, ("k", "v", "w"))
+
+        def step(ka, va, wa):
+            gk, sums, have, ng = body(ka, va, wa)
+            return gk, sums, have, ng[None]
+
+        spec = P("data")
+        f = shard_map(step, mesh=mesh, in_specs=(spec,) * 3,
+                      out_specs=spec, check_vma=False)
+        return jax.jit(f)(k, v, w)
+
+    os.environ["SRJ_TPU_PLAN_OPT"] = "0"
+    try:
+        a = run(p)
+        b = run(new)
+    finally:
+        del os.environ["SRJ_TPU_PLAN_OPT"]
+    _assert_same(a, b, "exchange-pushdown")
+
+
+# ---------------------------------------------------------------------------
+# Kill switch
+# ---------------------------------------------------------------------------
+
+def test_kill_switch_is_identity(monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_PLAN_OPT", "0")
+    p = _join_chain()
+    got, d = optimizer.for_execution(p)
+    assert got is p and d is None
+    assert optimizer.coalescing_fp8(p) == p.fp8
+
+
+def test_kill_switch_preserves_cache_keys(monkeypatch):
+    """With the switch off, the program cache keys carry the ORIGINAL
+    fingerprint — bit-identical to an optimizer-less build."""
+    p = _join_chain()
+    monkeypatch.setenv("SRJ_TPU_PLAN_OPT", "0")
+    plan.execute(p, _join_inputs(20))
+    keys = {k[0] for k in plan._CACHE._lru}
+    assert keys == {p.fingerprint}
+    # armed: the swapped twin owns the keys instead
+    monkeypatch.setenv("SRJ_TPU_PLAN_OPT", "1")
+    plan.clear_cache()
+    optimizer.reset()
+    plan.execute(p, _join_inputs(20))
+    new, _, _ = optimizer.optimize(p)
+    keys = {k[0] for k in plan._CACHE._lru}
+    assert keys == {new.fingerprint}
+
+
+def test_untouched_plan_is_same_object():
+    """A plan no rule can improve must flow through unchanged — same
+    object, so fingerprints and cache keys cannot drift."""
+    p = plan.Plan([
+        plan.scan("k", "v"),
+        plan.filter(lambda v: v > jnp.int32(3), ["v"]),
+        plan.aggregate(["k"], [("v", "sum")], 16),
+    ])
+    got, d = optimizer.for_execution(p)
+    assert got is p
+    assert d is not None and d.plan is None
+
+
+# ---------------------------------------------------------------------------
+# Adaptive re-planning: hysteresis + zero-compile warm burst
+# ---------------------------------------------------------------------------
+
+def _feed_sels(fp8, sels, bucket=32):
+    """Inject one observation per filter node: rows_in=1000,
+    rows_out=1000*sel (drives the planstats EWMA the executor reads)."""
+    for idx, sel in sels.items():
+        planstats.inline_node_stat(fp8, idx, "filter", bucket, 8,
+                                   np.int64(1000),
+                                   np.int64(int(1000 * sel)))
+
+
+def test_consistent_selectivity_triggers_single_replan(monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_PLAN_OPT_MATURITY", "2")
+    monkeypatch.setenv("SRJ_TPU_PLAN_OPT_WINDOW", "3")
+    p = _two_filter_chain()
+    for _ in range(12):
+        got, d = optimizer.for_execution(p)
+        # feed the SEMANTIC filters wherever they now sit: original n1
+        # keeps 90%, original n2 keeps 10% (selectivity follows the
+        # filter through a swap, as real measurements would)
+        nm = d.node_map if d.plan is not None else {}
+        _feed_sels(got.fp8, {nm.get(1, 1): 0.9, nm.get(2, 2): 0.1})
+    assert d.replans == 1 and d.generation == 1
+    assert d.plan is not None
+    # the swap put the selective filter first
+    assert d.node_map[2] < d.node_map[1]
+    # decision provenance landed in planstats under both fingerprints
+    doc = optimizer.decisions()[p.fp8]
+    assert doc["generation"] == 1
+    snap = planstats.snapshot(p.fp8)["plans"]
+    assert snap[p.fp8]["optimizer"]["replans"] == 1
+
+
+def test_alternating_selectivity_cannot_oscillate(monkeypatch):
+    """Adversarial alternation: each window reports the opposite
+    ordering.  The EWMA + improvement margin must pin the plan after at
+    most one swap."""
+    monkeypatch.setenv("SRJ_TPU_PLAN_OPT_MATURITY", "2")
+    monkeypatch.setenv("SRJ_TPU_PLAN_OPT_WINDOW", "2")
+    p = _two_filter_chain()
+    flip = False
+    for _ in range(40):
+        got, d = optimizer.for_execution(p)
+        sels = {1: 0.9, 2: 0.1} if flip else {1: 0.1, 2: 0.9}
+        flip = not flip
+        _feed_sels(got.fp8, sels)
+    assert d.replans <= 1
+
+
+def test_zero_warm_compiles_after_replan_settles(obs_on, monkeypatch):
+    monkeypatch.setenv("SRJ_TPU_PLAN_OPT_MATURITY", "2")
+    monkeypatch.setenv("SRJ_TPU_PLAN_OPT_WINDOW", "3")
+    # authored order is sub-optimal: n1 (v > -8) keeps ~90%, n2 (k < 1)
+    # keeps ~12% -> measured stats must swap them exactly once
+    p = _two_filter_chain(t1=-8, t2=1)
+    ins = _kv_inputs(40, seed=9)
+    for _ in range(10):
+        plan.execute(p, dict(ins))
+    _, d = optimizer.for_execution(p)
+    assert d.replans >= 1            # measured sels forced a swap
+    plan.execute(p, dict(ins))       # first run of the new generation
+    before = len(obs.events("compile"))
+    for _ in range(4):               # settled: zero recompiles
+        plan.execute(p, dict(ins))
+    assert len(obs.events("compile")) == before
+    assert d.replans == 1            # and no further churn
+
+
+# ---------------------------------------------------------------------------
+# Priced physical selection
+# ---------------------------------------------------------------------------
+
+def _fake_rows(rows):
+    return lambda: rows
+
+
+def test_staged_crossover_from_ledger(monkeypatch):
+    monkeypatch.setattr(optimizer, "_ledger_rows", _fake_rows([
+        {"op": "shuffle_table_sharded", "sig": "rs8", "bucket": 1024,
+         "impl": "staged", "calls": 10, "wall_s": 1.0, "device_s": 1.0,
+         "bytes": 4e9},
+        {"op": "shuffle_table_sharded", "sig": "rs8", "bucket": 1024,
+         "impl": "collective", "calls": 10, "wall_s": 1.0,
+         "device_s": 1.0, "bytes": 2e9},
+    ]))
+    c, src = optimizer.staged_crossover()
+    assert src == "ledger" and c == pytest.approx(0.5)
+
+
+def test_staged_crossover_from_calibration(monkeypatch):
+    monkeypatch.setattr(optimizer, "_ledger_rows", _fake_rows([]))
+    assert optimizer.staged_crossover() == (None, "none")
+    costmodel.save_calibration({"hbm_GBps": 100.0,
+                                "shuffle_staged_crossover": 2.5})
+    c, src = optimizer.staged_crossover()
+    assert src == "calibration" and c == pytest.approx(2.5)
+
+
+def test_price_route_prefers_cheaper_wire_time(monkeypatch):
+    # collective moves wire 2x faster than staged -> staged must be
+    # >2x smaller to win
+    monkeypatch.setattr(optimizer, "_ledger_rows", _fake_rows([
+        {"op": "shuffle_table_sharded", "sig": "rs8", "bucket": 1024,
+         "impl": "staged", "calls": 10, "device_s": 1.0, "bytes": 1e9},
+        {"op": "shuffle_table_sharded", "sig": "rs8", "bucket": 1024,
+         "impl": "collective", "calls": 10, "device_s": 1.0,
+         "bytes": 2e9},
+    ]))
+    counts = np.full((8, 8), 8, np.int64)
+    counts[0, 0] = 4096          # one hot sender-dest cell inflates the
+    xp = shuffle_mod.plan_exchange(counts, 8, 8)    # collective capacity
+    assert xp.collective_wire_bytes > 2 * xp.staged_wire_bytes
+    assert optimizer.price_route(xp) == ("staged", "priced")
+    uni = shuffle_mod.plan_exchange(
+        np.full((8, 8), 1024, np.int64), 8, 8)
+    assert optimizer.price_route(uni) == ("collective", "priced")
+    assert optimizer.route_summary()["crossover"] == pytest.approx(2.0)
+
+
+def test_crossover_persists_alongside_calibration(monkeypatch):
+    monkeypatch.setattr(optimizer, "_ledger_rows", _fake_rows([
+        {"op": "shuffle_table_sharded", "sig": "rs8", "bucket": 1024,
+         "impl": "staged", "calls": 10, "device_s": 1.0, "bytes": 1e9},
+        {"op": "shuffle_table_sharded", "sig": "rs8", "bucket": 1024,
+         "impl": "collective", "calls": 10, "device_s": 1.0,
+         "bytes": 3e9},
+    ]))
+    # no calibration file yet: the crossover rides along, never leads
+    assert optimizer.maybe_persist_crossover(every=1) is None
+    costmodel.save_calibration({"hbm_GBps": 123.0})
+    c = optimizer.maybe_persist_crossover(every=1)
+    assert c == pytest.approx(3.0)
+    doc = costmodel.load_calibration()
+    assert doc["shuffle_staged_crossover"] == pytest.approx(3.0)
+    assert doc["hbm_GBps"] == pytest.approx(123.0)   # ceilings untouched
+
+
+def test_update_calibration_requires_existing_file():
+    assert costmodel.update_calibration({"shuffle_staged_crossover": 2.0}) \
+        is None
+
+
+def test_forced_route_env_overrides_pricing(monkeypatch):
+    """SRJ_TPU_SHUFFLE_ROUTE stays a forced override above the priced
+    pick; SRJ_TPU_SHUFFLE_STAGED_MIN_PAD forces the legacy heuristic."""
+    import jax
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:1]), ("parts",))
+    counts = np.zeros((1, 1), np.int64)
+    counts[0, 0] = 4096
+    xp = shuffle_mod.plan_exchange(counts, 1, 8)
+    monkeypatch.setenv("SRJ_TPU_SHUFFLE_ROUTE", "collective")
+    assert shuffle_mod._choose_route(xp, mesh, "all_to_all") == "collective"
+    assert optimizer.route_summary()["source"] == "forced"
+
+
+def test_price_impl_maturity_and_margin(monkeypatch):
+    rows = [
+        {"op": "hash_join_probe", "sig": "('k',)", "bucket": 1024,
+         "impl": "pallas", "calls": 10, "device_s": 1.0, "bytes": 4e9},
+        {"op": "hash_join_probe", "sig": "('k',)", "bucket": 1024,
+         "impl": "xla", "calls": 10, "device_s": 1.0, "bytes": 2e9},
+    ]
+    monkeypatch.setattr(optimizer, "_ledger_rows", _fake_rows(rows))
+    assert optimizer.price_impl("hash_join_probe") == "pallas"
+    s = optimizer.impl_summary()["hash_join_probe"]
+    assert s["impl"] == "pallas" and s["alternative"] == "xla"
+    # below maturity: no verdict
+    rows2 = [dict(r, calls=1) for r in rows]
+    monkeypatch.setattr(optimizer, "_ledger_rows", _fake_rows(rows2))
+    assert optimizer.price_impl("hash_join_probe") is None
+    # inside the margin: no verdict
+    rows3 = [dict(rows[0], bytes=2.1e9), rows[1]]
+    monkeypatch.setattr(optimizer, "_ledger_rows", _fake_rows(rows3))
+    assert optimizer.price_impl("hash_join_probe") is None
+    # one impl unmeasured: no verdict
+    monkeypatch.setattr(optimizer, "_ledger_rows", _fake_rows(rows[:1]))
+    assert optimizer.price_impl("hash_join_probe") is None
+
+
+# ---------------------------------------------------------------------------
+# Metrics / healthz / explain surfaces
+# ---------------------------------------------------------------------------
+
+def test_rewrite_metrics_and_healthz(obs_on):
+    p = _join_chain()
+    plan.execute(p, _join_inputs(20))
+
+    def total(name):
+        vals = metrics.registry().snapshot().get(name, {}) \
+            .get("values", {})
+        return sum(v for v in vals.values()
+                   if isinstance(v, (int, float)))
+
+    assert total("srj_tpu_plan_rewrites_total") >= 2
+    doc = exporter._healthz()["optimizer"]
+    assert doc["enabled"] is True
+    rec = doc["plans"][p.fp8]
+    assert rec["optimized"] is not None
+    assert "pushdown_join" in rec["rules"]
+
+
+def test_route_decision_metric(monkeypatch):
+    optimizer.note_route("staged", "priced")
+    optimizer.note_route("collective", "forced")
+    snap = metrics.registry().snapshot() \
+        .get("srj_tpu_plan_opt_route_total", {}).get("values", {})
+    assert sum(v for v in snap.values()
+               if isinstance(v, (int, float))) >= 2
+
+
+def test_explain_analyze_carries_optimizer_provenance(obs_on):
+    p = _join_chain()
+    plan.execute(p, _join_inputs(40, seed=1))
+    new, _, _ = optimizer.optimize(p)
+    struct = planstats.describe_plan(new)
+    stats = planstats.snapshot(new.fp8)["plans"]
+    doc = planstats._analyze_doc(struct, stats, None, None)
+    opt = doc["optimizer"]
+    assert opt["origin"] == p.fp8
+    assert opt["optimized"] == new.fp8
+    assert {f["rule"] for f in opt["rules"]} >= {"pushdown_join"}
+    text = planstats.render(struct, stats)
+    assert "optimizer gen" in text
+
+
+def test_serve_sig_uses_optimized_fingerprint(monkeypatch):
+    """Serve adapters coalesce on the fingerprint the executor would
+    actually run; with the switch off that is the authored one."""
+    from spark_rapids_jni_tpu.serve import ops as serve_ops
+    agg = serve_ops._agg_plan(64)
+    assert serve_ops._coalescing_fp8(agg) == \
+        optimizer.coalescing_fp8(agg)
+    monkeypatch.setenv("SRJ_TPU_PLAN_OPT", "0")
+    assert serve_ops._coalescing_fp8(agg) == agg.fp8
