@@ -1052,6 +1052,94 @@ def bench_optimizer(num_batches):
     return res
 
 
+def bench_outofcore(num_morsels):
+    """Out-of-core streaming axis: the same multi-row-group Parquet
+    aggregate twice through the *identical* ``execute_file`` code path —
+    a SERIAL reference at ``SRJ_TPU_OOC_DEPTH=0`` (inline staging, no
+    worker thread: decode + stage H2D and device compute strictly
+    alternate) versus the PIPELINED stream at the default depth (the
+    prefetch worker decodes/stages morsel k+1 while morsel k computes).
+    The headline is ``ooc_overlap_ratio`` = pipelined wall / serial wall
+    — < 1.0 proves the overlap is real — plus ``ooc_peak_bytes`` (the
+    memwatch live-bytes watermark over the pipelined leg) and the warm
+    compile count (a warm stream must add zero).  Both legs take the
+    best of a few repeats so a single scheduler hiccup can't flip the
+    ratio."""
+    import jax.numpy as jnp
+
+    from spark_rapids_jni_tpu import obs
+    from spark_rapids_jni_tpu.obs import memwatch
+    from spark_rapids_jni_tpu.parquet import scan as _scan
+    from spark_rapids_jni_tpu.runtime import outofcore as _ooc
+    from spark_rapids_jni_tpu.runtime import plan as _plan
+
+    morsel_rows = 4096
+    n = num_morsels * morsel_rows
+    rng = np.random.default_rng(19)
+    cols = {"k": rng.integers(0, 64, n).astype(np.int32),
+            "v": rng.integers(-999, 999, n).astype(np.int32),
+            "w": rng.standard_normal(n).astype(np.float32),
+            "u": rng.standard_normal(n).astype(np.float32)}
+    data = _scan.write_table(cols, row_group_rows=morsel_rows)
+    # the projection's elementwise math keeps the device busy enough per
+    # morsel that the prefetch worker's decode genuinely hides behind it
+    pln = _plan.Plan([
+        _plan.scan("k", "v", "w", "u"),
+        _plan.filter(lambda v: v > -900, ["v"]),
+        _plan.project({"z": (lambda w, u: jnp.tanh(w * u) * jnp.cosh(
+            jnp.sin(w) - jnp.cos(u)), ["w", "u"])}),
+        _plan.aggregate(["k"], [("v", "sum"), ("w", "min"),
+                                ("u", "max"), ("z", "sum")], 128),
+    ])
+    _log(f"outofcore: {num_morsels} morsels x {morsel_rows} rows, "
+         f"{len(data)} file bytes")
+
+    # warmup: compile every bucket the stream hits, so neither timed
+    # leg pays cold XLA compiles
+    _ooc.execute_file(data, pln, morsel_rows=morsel_rows)
+
+    reps = 5
+
+    def _timed_leg(depth):
+        prev = os.environ.get("SRJ_TPU_OOC_DEPTH")
+        os.environ["SRJ_TPU_OOC_DEPTH"] = str(depth)
+        try:
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                _ooc.execute_file(data, pln, morsel_rows=morsel_rows)
+                best = min(best, time.perf_counter() - t0)
+            return best
+        finally:
+            if prev is None:
+                os.environ.pop("SRJ_TPU_OOC_DEPTH", None)
+            else:
+                os.environ["SRJ_TPU_OOC_DEPTH"] = prev
+
+    with _leg_span("outofcore_serial"):
+        serial = _timed_leg(0)
+
+    c0 = obs.compile_totals()["compiles"]
+    with _leg_span("outofcore_pipelined"):
+        pipelined = _timed_leg(2)
+    warm_compiles = int(obs.compile_totals()["compiles"] - c0)
+    peak = int(memwatch.watermark_bytes())
+
+    res = {"num_morsels": num_morsels, "rows": n,
+           "file_bytes": len(data),
+           "serial_s": round(serial, 4),
+           "pipelined_s": round(pipelined, 4),
+           "ooc_overlap_ratio": round(pipelined / max(serial, 1e-9), 4),
+           "ooc_peak_bytes": peak,
+           "pipelined_warm_compiles": warm_compiles,
+           "counters": _ooc.counters()}
+    _log(f"outofcore: serial {serial:.3f}s vs pipelined "
+         f"{pipelined:.3f}s -> overlap ratio "
+         f"{res['ooc_overlap_ratio']}, peak {peak} bytes, "
+         f"{warm_compiles} warm compiles")
+    return res
+
+
 def bench_shuffle(num_rows):
     """Shuffle-throughput axis on an 8-device mesh: the two-phase ragged
     exchange versus the legacy pad-to-max protocol on a hot-key skew
@@ -1456,6 +1544,8 @@ def _run_axis(axis: str):
             res = bench_plan(int(n))
         elif kind == "optimizer":
             res = bench_optimizer(int(n))
+        elif kind == "outofcore":
+            res = bench_outofcore(int(n))
         elif kind == "shuffle":
             res = bench_shuffle(int(n))
         elif kind == "kernels":
@@ -1837,6 +1927,11 @@ def main():
     # pushdown/pruning ratios every round
     _run("plan_optimizer", "optimizer:24")
 
+    # out-of-core streaming axis: pipelined morsel stream vs the fenced
+    # serial reference on a multi-row-group Parquet aggregate — the
+    # overlap ratio and live-bytes peak feed the regress gate
+    _run("outofcore_stream", "outofcore:24")
+
     # pod-scale shuffle axis: the two-phase ragged exchange vs the
     # legacy pad-to-max protocol on a skewed 8-way exchange.  Pinned to
     # the 8-device host-platform CPU mesh so every container measures
@@ -2003,6 +2098,20 @@ def main():
              "value": po["opt_rows_into_join_ratio"], "unit": "ratio"},
             {"metric": "opt_exchange_wire_ratio",
              "value": po["opt_exchange_wire_ratio"], "unit": "ratio"},
+        ])
+    # out-of-core figures: pipelined wall over fenced serial sum
+    # ("ratio" -> lower is better: a broken overlap drifts toward/past
+    # 1.0 and fails the round) and the stream's live-bytes watermark
+    # ("bytes" -> a residency regression fails like a latency one)
+    oo = next((r for r in results.get("outofcore_stream", [])
+               if isinstance(r, dict)
+               and r.get("ooc_overlap_ratio") is not None), None)
+    if oo is not None:
+        out.setdefault("secondary", []).extend([
+            {"metric": "ooc_overlap_ratio",
+             "value": oo["ooc_overlap_ratio"], "unit": "ratio"},
+            {"metric": "ooc_peak_bytes",
+             "value": oo["ooc_peak_bytes"], "unit": "bytes"},
         ])
     # memory figure: the headline axis process's peak live bytes (the
     # memwatch watermark / span peak maximum from the obs digest) — a

@@ -861,6 +861,108 @@ print(f"drift smoke: kernel_a alarmed once ({len(bundles)} bundle, "
 PY
 rm -rf "$DRIFT_DIAG"
 
+# out-of-core smoke: a multi-row-group Parquet aggregate streamed
+# morsel-at-a-time under a forced-low SRJ_TPU_MEM_HEADROOM_BYTES cap —
+# the staged watermark must hold under the cap with ZERO reactive OOM
+# splits (the morsel grid is the proactive answer, not the escape
+# hatch), stats pruning must drop the row groups the predicate excludes,
+# and the join leg must auto-spill its oversized build side
+# (srj_tpu_ooc_spills_total > 0) — every leg byte-identical to the
+# uncapped SRJ_TPU_OOC=0 whole-table reference, with the /healthz
+# outofcore sub-document live on a real scrape
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python - <<'PY'
+import json, os, urllib.request
+import numpy as np
+from spark_rapids_jni_tpu import obs
+from spark_rapids_jni_tpu.obs import exporter, memwatch, metrics
+from spark_rapids_jni_tpu.parquet import scan
+from spark_rapids_jni_tpu.runtime import outofcore, plan
+
+obs.enable()
+port = exporter.start(0)
+assert port, "exporter failed to bind"
+
+def eq(a, b):
+    if isinstance(a, (list, tuple)):
+        assert len(a) == len(b)
+        return all(eq(x, y) for x, y in zip(a, b))
+    a, b = np.asarray(a), np.asarray(b)
+    return a.dtype == b.dtype and a.shape == b.shape \
+        and a.tobytes() == b.tobytes()
+
+def total(name):
+    vals = metrics.registry().snapshot().get(name, {}).get("values", {})
+    return sum(v for v in vals.values() if isinstance(v, (int, float)))
+
+def uncapped_ref(data, pln, **kw):
+    os.environ["SRJ_TPU_OOC"] = "0"
+    try:
+        return outofcore.execute_file(data, pln, **kw)
+    finally:
+        del os.environ["SRJ_TPU_OOC"]
+
+rng = np.random.default_rng(31)
+n = 48_000
+cols = {"k": rng.integers(0, 32, n).astype(np.int32),
+        "v": np.arange(n, dtype=np.int32),
+        "w": rng.standard_normal(n).astype(np.float32)}
+data = scan.write_table(cols, row_group_rows=2048)
+pln = plan.Plan([
+    plan.scan("k", "v", "w"),
+    plan.filter(lambda v: v >= 8192, ["v"]),
+    plan.aggregate(["k"], [("v", "sum"), ("w", "min")], 64),
+])
+ref = uncapped_ref(data, pln)          # whole table, no cap
+
+cap = 256 * 1024                       # << the ~576 KiB whole table
+memwatch.reset()                       # drop the reference leg's watermark
+os.environ["SRJ_TPU_MEM_HEADROOM_BYTES"] = str(cap)
+try:
+    pruned0 = outofcore.counters().get("rowgroups_pruned", 0)
+    got = outofcore.execute_file(data, pln, morsel_rows=2048,
+                                 predicates=[("v", ">=", 8192)])
+    assert eq(got, ref), "capped morselized stream diverged"
+    wm = memwatch.watermark_bytes()
+    assert 0 < wm <= cap, f"watermark {wm} breached the {cap} B cap"
+    assert total("srj_tpu_oom_splits_total") == 0, "reactive OOM split"
+    pruned = outofcore.counters()["rowgroups_pruned"] - pruned0
+    assert pruned == 4, f"stats pruning dropped {pruned} groups, not 4"
+
+    # join leg: a build side far over the cap must auto-spill, partition
+    # by partition, and still reproduce the uncapped resident join
+    m = 120_000                        # 2 int32 arrays ~= 0.94 MiB >> cap
+    side = {"bk": np.arange(m, dtype=np.int32),
+            "bp": (np.arange(m, dtype=np.int32) * 3 + 1).astype(np.int32)}
+    jn = plan.Plan([
+        plan.scan("k", "v"),
+        plan.join("bk", "k", "bp", "j"),
+        plan.aggregate(["k"], [("j", "sum"), ("v", "min")], 64),
+    ])
+    jref = uncapped_ref(data, jn, side_inputs=side)
+    spills0 = total("srj_tpu_ooc_spills_total")
+    jgot = outofcore.execute_file(data, jn, side_inputs=side,
+                                  morsel_rows=2048)
+    spills = total("srj_tpu_ooc_spills_total") - spills0
+    assert spills > 0, "oversized build side never spilled"
+    assert eq(jgot, jref), "spilled join diverged from resident join"
+    assert total("srj_tpu_oom_splits_total") == 0, "reactive OOM split"
+finally:
+    del os.environ["SRJ_TPU_MEM_HEADROOM_BYTES"]
+
+qd = total("srj_tpu_prefetch_queue_depth")
+assert qd == 0, f"prefetch queue depth left at {qd}"
+hz = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+ooc = hz["outofcore"]
+assert ooc["enabled"] and ooc["morsels"] > 0, ooc
+assert ooc["spills"] == spills, ooc
+assert ooc["last"]["spill_partitions"] > 1, ooc["last"]
+exporter.stop()
+print(f"out-of-core smoke: watermark {wm} B under the {cap} B cap, "
+      f"{pruned} row groups pruned, {int(spills)} spill partitions, "
+      f"0 reactive OOM splits, byte-identical to in-core")
+PY
+
 # fleet failover smoke: 3 supervised replicas serve a 4-tenant burst;
 # the chaos harness SIGKILLs the small-bucket affinity owner mid-burst.
 # Gate: zero lost/wrong responses (byte-identical to a single-scheduler

@@ -29,7 +29,15 @@ offline report also computes use the SAME metric names as ``report
   latter fed directly by the injector, live even when spans are off).
 - ``srj_tpu_obs_events_dropped_total{reason}`` — ring evictions and sink
   write failures, so a scrape can tell truncated telemetry from quiet.
-- ``srj_tpu_prefetch_queue_depth`` — staging prefetcher backlog gauge.
+- ``srj_tpu_prefetch_queue_depth`` — staging prefetcher backlog gauge
+  (zeroed on drain-on-close, including a half-consumed stream).
+- ``srj_tpu_ooc_*`` — the out-of-core executor
+  (:mod:`runtime.outofcore`): ``morsels_total`` (morsels dispatched),
+  ``spills_total`` (join build partitions spilled to host and
+  re-streamed), ``rowgroups_pruned_total`` (row groups skipped via
+  footer min/max statistics before any decode), and
+  ``bytes_streamed_total`` (column-chunk payload bytes decoded and
+  staged).  The ``outofcore`` /healthz sub-document mirrors these.
 - ``srj_tpu_serve_*`` — the serving runtime (:mod:`serve.scheduler`):
   ``requests_total`` / ``request_failures_total`` (``{tenant,op}``),
   ``rows_total`` / ``bytes_total`` (``{tenant}``), ``rejected_total``

@@ -1,0 +1,551 @@
+"""Parquet column-chunk data layer: a minimal writer + reader for flat
+numeric tables, built directly on the :mod:`thrift_dom` compact-protocol
+codec and the :mod:`pyfooter` footer engine.
+
+The footer layer (parse / prune / re-serialize) has existed since the
+seed — this module adds the *data pages* underneath it, so the
+out-of-core executor (:mod:`runtime.outofcore`) can stream real column
+chunks out of a real PAR1 file instead of holding whole tables in host
+RAM.  Scope is deliberately the out-of-core working set, not a general
+parquet implementation:
+
+- flat schemas only (root + leaf columns), REQUIRED or OPTIONAL;
+- physical types INT32 / INT64 / FLOAT / DOUBLE;
+- PLAIN encoding, UNCOMPRESSED codec, v1 data pages;
+- OPTIONAL columns carry definition levels in the RLE/bit-packed hybrid
+  encoding at bit width 1 (the 4-byte length-prefixed form v1 pages
+  use), decoded to a boolean validity array;
+- per-chunk ``Statistics`` (``min_value`` / ``max_value`` /
+  ``null_count``) written as PLAIN little-endian scalars, which is what
+  row-group predicate pruning reads back.
+
+Pruning composes three independent filters before a byte of data is
+decoded, all host-side on the footer DOM (exactly the reference repo's
+``NativeParquetJni`` role):
+
+1. **column projection** — :func:`prune_footer` takes the column-name
+   set (the out-of-core executor passes the *optimized* plan's scan
+   columns, i.e. PR 18's ``prune_projections`` survivor set) through
+   ``PyFooter.filter_columns``;
+2. **partition split** — ``PyFooter.filter_groups`` keeps the row
+   groups whose split midpoint falls in ``[part_offset, part_offset +
+   part_length)``;
+3. **predicate skip** — :func:`prune_groups_by_stats` drops row groups
+   whose min/max statistics prove no non-null row can satisfy a
+   conjunct.  Sound only when the plan re-applies the predicate (the
+   Spark pushdown contract) and nulls are dead rows (the executor masks
+   them out), both of which the out-of-core executor guarantees.
+"""
+
+from __future__ import annotations
+
+import struct as _struct
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_jni_tpu.parquet import (
+    StructElement, ValueElement, flatten_schema,
+)
+from spark_rapids_jni_tpu.parquet.pyfooter import (
+    CC_META_DATA, CMD_DATA_PAGE_OFFSET, CMD_TOTAL_COMPRESSED_SIZE,
+    FMD_CREATED_BY, FMD_NUM_ROWS, FMD_ROW_GROUPS, FMD_SCHEMA, FMD_VERSION,
+    PyFooter, RG_COLUMNS, RG_FILE_OFFSET, RG_NUM_ROWS,
+    RG_TOTAL_BYTE_SIZE, RG_TOTAL_COMPRESSED_SIZE, SE_NAME,
+    SE_NUM_CHILDREN, SE_REPETITION, SE_TYPE,
+)
+from spark_rapids_jni_tpu.parquet.thrift_dom import (
+    TList, TStruct, TType, _Reader, write_struct,
+)
+
+# parquet.thrift ids this module adds to pyfooter's set
+CC_FILE_OFFSET = 2
+CMD_TYPE = 1
+CMD_ENCODINGS = 2
+CMD_PATH_IN_SCHEMA = 3
+CMD_CODEC = 4
+CMD_NUM_VALUES = 5
+CMD_TOTAL_UNCOMPRESSED_SIZE = 6
+CMD_STATISTICS = 12
+ST_MAX_LEGACY = 1
+ST_MIN_LEGACY = 2
+ST_NULL_COUNT = 3
+ST_MIN_VALUE = 5
+ST_MAX_VALUE = 6
+PH_TYPE = 1
+PH_UNCOMPRESSED_SIZE = 2
+PH_COMPRESSED_SIZE = 3
+PH_DATA_PAGE_HEADER = 5
+DPH_NUM_VALUES = 1
+DPH_ENCODING = 2
+DPH_DEF_LEVEL_ENCODING = 3
+DPH_REP_LEVEL_ENCODING = 4
+PAGE_DATA = 0
+ENC_PLAIN = 0
+ENC_RLE = 3
+REP_REQUIRED = 0
+REP_OPTIONAL = 1
+
+# physical type <-> numpy dtype (the out-of-core working set)
+_PTYPE_OF_DTYPE = {"int32": 1, "int64": 2, "float32": 4, "float64": 5}
+_DTYPE_OF_PTYPE = {1: np.dtype(np.int32), 2: np.dtype(np.int64),
+                   4: np.dtype(np.float32), 5: np.dtype(np.float64)}
+_PACK_OF_PTYPE = {1: "<i", 2: "<q", 4: "<f", 5: "<d"}
+
+
+# ---------------------------------------------------------------------------
+# RLE/bit-packed hybrid at bit width 1 (definition levels of flat
+# OPTIONAL columns)
+# ---------------------------------------------------------------------------
+
+def _rle_encode_bits(levels: np.ndarray) -> bytes:
+    """Encode 0/1 levels as the 4-byte-length-prefixed RLE hybrid v1
+    data pages carry (pure RLE runs; bit width 1 packs each run's value
+    in one byte)."""
+    out = bytearray()
+    n = len(levels)
+    i = 0
+    while i < n:
+        v = int(levels[i])
+        j = i
+        while j < n and int(levels[j]) == v:
+            j += 1
+        run = j - i
+        header = run << 1          # LSB 0 = RLE run
+        while header >= 0x80:
+            out.append((header & 0x7F) | 0x80)
+            header >>= 7
+        out.append(header)
+        out.append(v)
+        i = j
+    return _struct.pack("<I", len(out)) + bytes(out)
+
+
+def _rle_decode_bits(buf, off: int, count: int) -> Tuple[np.ndarray, int]:
+    """Decode ``count`` bit-width-1 levels from the length-prefixed RLE
+    hybrid at ``buf[off:]``; returns (levels, bytes consumed incl. the
+    length prefix).  Handles both run and bit-packed groups — foreign
+    writers use either."""
+    (nbytes,) = _struct.unpack_from("<I", buf, off)
+    pos = off + 4
+    end = pos + nbytes
+    out = np.empty(count, np.uint8)
+    got = 0
+    while got < count and pos < end:
+        header = 0
+        shift = 0
+        while True:
+            b = buf[pos]
+            pos += 1
+            header |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        if header & 1:             # bit-packed: (header >> 1) groups of 8
+            nvals = (header >> 1) * 8
+            for g in range((header >> 1)):
+                byte = buf[pos]
+                pos += 1
+                for bit in range(8):
+                    if got < count and g * 8 + bit < nvals:
+                        out[got] = (byte >> bit) & 1
+                        got += 1
+        else:                      # RLE run
+            run = header >> 1
+            v = buf[pos]
+            pos += 1
+            take = min(run, count - got)
+            out[got:got + take] = v
+            got += take
+    if got < count:
+        raise ValueError(
+            f"definition levels truncated: {got} of {count}")
+    return out, (end - off)
+
+
+# ---------------------------------------------------------------------------
+# Writer
+# ---------------------------------------------------------------------------
+
+def _plain_scalar(ptype: int, v) -> bytes:
+    return _struct.pack(_PACK_OF_PTYPE[ptype], v)
+
+
+def _page_header(nrows: int, payload_len: int) -> bytes:
+    dph = TStruct()
+    dph.set(DPH_NUM_VALUES, TType.I32, nrows)
+    dph.set(DPH_ENCODING, TType.I32, ENC_PLAIN)
+    dph.set(DPH_DEF_LEVEL_ENCODING, TType.I32, ENC_RLE)
+    dph.set(DPH_REP_LEVEL_ENCODING, TType.I32, ENC_RLE)
+    ph = TStruct()
+    ph.set(PH_TYPE, TType.I32, PAGE_DATA)
+    ph.set(PH_UNCOMPRESSED_SIZE, TType.I32, payload_len)
+    ph.set(PH_COMPRESSED_SIZE, TType.I32, payload_len)
+    ph.set(PH_DATA_PAGE_HEADER, TType.STRUCT, dph)
+    return write_struct(ph)
+
+
+def write_table(columns: Dict[str, np.ndarray],
+                row_group_rows: int = 1 << 20,
+                validity: Optional[Dict[str, np.ndarray]] = None) -> bytes:
+    """Serialize host columns to a complete PAR1 file.
+
+    ``columns``: name -> 1-D numpy array (int32/int64/float32/float64);
+    every array must share one row count.  ``validity``: optional name
+    -> boolean array — a column with a validity entry is written
+    OPTIONAL with definition levels (False rows carry no value), the
+    rest REQUIRED.  ``row_group_rows`` splits rows into consecutive row
+    groups, each with its own per-chunk min/max/null_count statistics —
+    the granule every pruning layer operates on."""
+    if not columns:
+        raise ValueError("write_table needs at least one column")
+    if row_group_rows < 1:
+        raise ValueError("row_group_rows must be >= 1")
+    validity = validity or {}
+    names = list(columns)
+    arrs = {}
+    nrows = None
+    for name in names:
+        a = np.ascontiguousarray(columns[name])
+        if a.ndim != 1:
+            raise ValueError(f"column {name!r} must be 1-D")
+        if str(a.dtype) not in _PTYPE_OF_DTYPE:
+            raise ValueError(f"unsupported dtype {a.dtype} for {name!r}")
+        if nrows is None:
+            nrows = len(a)
+        elif len(a) != nrows:
+            raise ValueError("columns disagree on row count")
+        arrs[name] = a
+    for name, v in validity.items():
+        if name not in arrs:
+            raise ValueError(f"validity for unknown column {name!r}")
+        if len(v) != nrows:
+            raise ValueError(f"validity length mismatch for {name!r}")
+
+    out = bytearray(b"PAR1")
+    groups: List[TStruct] = []
+    for g0 in range(0, max(nrows, 1), row_group_rows):
+        g1 = min(g0 + row_group_rows, nrows)
+        if g1 <= g0 and nrows > 0:
+            break
+        grows = g1 - g0
+        if nrows == 0:
+            if groups:
+                break
+            grows = 0
+        chunks: List[TStruct] = []
+        group_off = len(out)
+        group_bytes = 0
+        for name in names:
+            a = arrs[name][g0:g1]
+            ptype = _PTYPE_OF_DTYPE[str(a.dtype)]
+            optional = name in validity
+            if optional:
+                valid = np.asarray(validity[name][g0:g1], bool)
+                payload = _rle_encode_bits(valid.astype(np.uint8)) \
+                    + a[valid].tobytes()
+                nonnull = a[valid]
+                null_count = int(grows - valid.sum())
+            else:
+                payload = a.tobytes()
+                nonnull = a
+                null_count = 0
+            header = _page_header(grows, len(payload))
+            chunk_off = len(out)
+            out += header
+            out += payload
+            chunk_len = len(header) + len(payload)
+            group_bytes += chunk_len
+
+            md = TStruct()
+            md.set(CMD_TYPE, TType.I32, ptype)
+            md.set(CMD_ENCODINGS, TType.LIST,
+                   TList(TType.I32, [ENC_PLAIN, ENC_RLE]))
+            md.set(CMD_PATH_IN_SCHEMA, TType.LIST,
+                   TList(TType.BINARY, [name.encode()]))
+            md.set(CMD_CODEC, TType.I32, 0)          # UNCOMPRESSED
+            md.set(CMD_NUM_VALUES, TType.I64, grows)
+            md.set(CMD_TOTAL_UNCOMPRESSED_SIZE, TType.I64, chunk_len)
+            md.set(CMD_TOTAL_COMPRESSED_SIZE, TType.I64, chunk_len)
+            md.set(CMD_DATA_PAGE_OFFSET, TType.I64, chunk_off)
+            st = TStruct()
+            st.set(ST_NULL_COUNT, TType.I64, null_count)
+            if len(nonnull):
+                st.set(ST_MIN_VALUE, TType.BINARY,
+                       _plain_scalar(ptype, nonnull.min()))
+                st.set(ST_MAX_VALUE, TType.BINARY,
+                       _plain_scalar(ptype, nonnull.max()))
+            md.set(CMD_STATISTICS, TType.STRUCT, st)
+            cc = TStruct()
+            cc.set(CC_FILE_OFFSET, TType.I64, chunk_off)
+            cc.set(CC_META_DATA, TType.STRUCT, md)
+            chunks.append(cc)
+        rg = TStruct()
+        rg.set(RG_COLUMNS, TType.LIST, TList(TType.STRUCT, chunks))
+        rg.set(RG_TOTAL_BYTE_SIZE, TType.I64, group_bytes)
+        rg.set(RG_NUM_ROWS, TType.I64, grows)
+        rg.set(RG_FILE_OFFSET, TType.I64, group_off)
+        rg.set(RG_TOTAL_COMPRESSED_SIZE, TType.I64, group_bytes)
+        groups.append(rg)
+        if nrows == 0:
+            break
+
+    schema = [_schema_elem("root", None, None, len(names))]
+    for name in names:
+        schema.append(_schema_elem(
+            name, _PTYPE_OF_DTYPE[str(arrs[name].dtype)],
+            REP_OPTIONAL if name in validity else REP_REQUIRED))
+    meta = TStruct()
+    meta.set(FMD_VERSION, TType.I32, 1)
+    meta.set(FMD_SCHEMA, TType.LIST, TList(TType.STRUCT, schema))
+    meta.set(FMD_NUM_ROWS, TType.I64, nrows)
+    meta.set(FMD_ROW_GROUPS, TType.LIST, TList(TType.STRUCT, groups))
+    meta.set(FMD_CREATED_BY, TType.BINARY, b"srj-tpu-scan")
+    body = write_struct(meta)
+    out += body
+    out += _struct.pack("<I", len(body)) + b"PAR1"
+    return bytes(out)
+
+
+def _schema_elem(name: str, ptype: Optional[int],
+                 repetition: Optional[int],
+                 num_children: Optional[int] = None) -> TStruct:
+    s = TStruct()
+    if ptype is not None:
+        s.set(SE_TYPE, TType.I32, ptype)
+    if repetition is not None:
+        s.set(SE_REPETITION, TType.I32, repetition)
+    s.set(SE_NAME, TType.BINARY, name.encode())
+    if num_children is not None:
+        s.set(SE_NUM_CHILDREN, TType.I32, num_children)
+    return s
+
+
+# ---------------------------------------------------------------------------
+# Reader
+# ---------------------------------------------------------------------------
+
+def parse_footer(data: bytes) -> PyFooter:
+    """Parse the footer of a complete PAR1 file."""
+    if len(data) < 12 or data[:4] != b"PAR1" or data[-4:] != b"PAR1":
+        raise ValueError("not a PAR1 file")
+    (n,) = _struct.unpack("<I", data[-8:-4])
+    if 12 + n > len(data):
+        raise ValueError("footer length exceeds file")
+    return PyFooter.parse(data[len(data) - 8 - n:-8])
+
+
+def schema_leaves(footer: PyFooter) -> List[Tuple[str, int, bool]]:
+    """Flat-schema leaves as (name, physical_type, optional)."""
+    elems = footer.meta.at(FMD_SCHEMA).elems
+    out = []
+    for e in elems[1:]:
+        if not e.has(SE_TYPE):
+            raise ValueError("scan layer reads flat schemas only")
+        name = e.get(SE_NAME, b"")
+        out.append((name.decode() if isinstance(name, bytes) else name,
+                    e.at(SE_TYPE),
+                    e.get(SE_REPETITION, REP_REQUIRED) == REP_OPTIONAL))
+    return out
+
+
+def prune_footer(data: bytes, columns: Sequence[str],
+                 part_offset: int = 0,
+                 part_length: Optional[int] = None) -> PyFooter:
+    """Parse + column-project + partition-split in one step: the
+    surviving footer references only ``columns`` (in schema order) and
+    the row groups whose split midpoint lands in the partition."""
+    f = parse_footer(data)
+    sel = StructElement.builder()
+    for c in columns:
+        sel.add_child(c, ValueElement())
+    names, num_children, tags = flatten_schema(sel.build(), False)
+    f.filter_columns(names, num_children, tags, len(columns),
+                     ignore_case=False)
+    if part_length is None:
+        part_length = len(data)
+    f.filter_groups(part_offset, part_length)
+    return f
+
+
+def _chunk_stats(chunk: TStruct, ptype: int):
+    """(min, max, null_count) from a chunk's statistics; values None
+    when absent.  Reads the v2 ``min_value``/``max_value`` fields,
+    falling back to the legacy ``min``/``max`` pair."""
+    md = chunk.get(CC_META_DATA)
+    if md is None:
+        return None, None, None
+    st = md.get(CMD_STATISTICS)
+    if st is None:
+        return None, None, None
+    fmt = _PACK_OF_PTYPE.get(ptype)
+
+    def _dec(fid, legacy):
+        raw = st.get(fid)
+        if raw is None:
+            raw = st.get(legacy)
+        if raw is None or fmt is None \
+                or len(raw) != _struct.calcsize(fmt):
+            return None
+        return _struct.unpack(fmt, bytes(raw))[0]
+
+    nc = st.get(ST_NULL_COUNT)
+    return _dec(ST_MIN_VALUE, ST_MIN_LEGACY), \
+        _dec(ST_MAX_VALUE, ST_MAX_LEGACY), nc
+
+
+def _satisfiable(op: str, lo, hi, lit) -> bool:
+    if op == "<":
+        return lo < lit
+    if op == "<=":
+        return lo <= lit
+    if op == ">":
+        return hi > lit
+    if op == ">=":
+        return hi >= lit
+    if op == "==":
+        return lo <= lit <= hi
+    if op == "!=":
+        return not (lo == hi == lit)
+    raise ValueError(f"unknown predicate op {op!r}")
+
+
+def prune_groups_by_stats(footer: PyFooter,
+                          predicates: Sequence[Tuple[str, str, float]]
+                          ) -> int:
+    """Drop row groups whose chunk statistics prove no non-null row can
+    satisfy every ``(column, op, literal)`` conjunct (op in ``< <= > >=
+    == !=``).  Groups without statistics are kept.  Returns the number
+    of groups dropped.  Sound only when the executing plan re-applies
+    the predicates and treats nulls as dead rows — the out-of-core
+    executor's contract."""
+    if not predicates:
+        return 0
+    groups = footer.meta.get(FMD_ROW_GROUPS)
+    if groups is None or not groups.elems:
+        return 0
+    leaves = schema_leaves(footer)
+    by_name = {name: (i, ptype) for i, (name, ptype, _) in
+               enumerate(leaves)}
+    kept = []
+    for g in groups.elems:
+        cols = g.get(RG_COLUMNS)
+        chunks = cols.elems if cols is not None else []
+        alive = True
+        for name, op, lit in predicates:
+            if name not in by_name:
+                continue
+            idx, ptype = by_name[name]
+            if idx >= len(chunks):
+                continue
+            lo, hi, _nc = _chunk_stats(chunks[idx], ptype)
+            if lo is None or hi is None:
+                continue
+            if not _satisfiable(op, lo, hi, lit):
+                alive = False
+                break
+        if alive:
+            kept.append(g)
+    dropped = len(groups.elems) - len(kept)
+    groups.elems = kept
+    return dropped
+
+
+def group_num_rows(footer: PyFooter) -> List[int]:
+    groups = footer.meta.get(FMD_ROW_GROUPS)
+    if groups is None:
+        return []
+    return [g.get(RG_NUM_ROWS, 0) for g in groups.elems]
+
+
+def group_byte_size(footer: PyFooter, group_index: int) -> int:
+    g = footer.meta.at(FMD_ROW_GROUPS).elems[group_index]
+    total = g.get(RG_TOTAL_COMPRESSED_SIZE)
+    if total:
+        return total
+    cols = g.get(RG_COLUMNS)
+    if cols is None:
+        return 0
+    return sum((c.at(CC_META_DATA).get(CMD_TOTAL_COMPRESSED_SIZE, 0)
+                for c in cols.elems if c.has(CC_META_DATA)), 0)
+
+
+def _decode_chunk(data, md: TStruct, ptype: int, optional: bool
+                  ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Decode one column chunk (all its v1 PLAIN data pages) to
+    (values, validity).  REQUIRED chunks return validity=None; OPTIONAL
+    chunks return a boolean array with null slots zero-filled in
+    values."""
+    dt = _DTYPE_OF_PTYPE.get(ptype)
+    if dt is None:
+        raise ValueError(f"unsupported physical type {ptype}")
+    total = md.at(CMD_NUM_VALUES)
+    off = md.at(CMD_DATA_PAGE_OFFSET)
+    mv = memoryview(data)
+    vals = np.zeros(total, dt)
+    valid = np.ones(total, bool) if optional else None
+    done = 0
+    while done < total:
+        r = _Reader(mv[off:])
+        ph = r.tstruct(0)
+        if ph.at(PH_TYPE) != PAGE_DATA:
+            raise ValueError("scan layer reads v1 PLAIN data pages only")
+        dph = ph.at(PH_DATA_PAGE_HEADER)
+        if dph.at(DPH_ENCODING) != ENC_PLAIN:
+            raise ValueError("scan layer reads PLAIN encoding only")
+        nvals = dph.at(DPH_NUM_VALUES)
+        page_off = off + r.pos
+        payload_len = ph.at(PH_COMPRESSED_SIZE)
+        if optional:
+            levels, consumed = _rle_decode_bits(data, page_off, nvals)
+            live = levels.astype(bool)
+            nlive = int(live.sum())
+            got = np.frombuffer(data, dt, count=nlive,
+                                offset=page_off + consumed)
+            page_vals = np.zeros(nvals, dt)
+            page_vals[live] = got
+            vals[done:done + nvals] = page_vals
+            valid[done:done + nvals] = live
+        else:
+            vals[done:done + nvals] = np.frombuffer(
+                data, dt, count=nvals, offset=page_off)
+        done += nvals
+        off = page_off + payload_len
+    return vals, valid
+
+
+def read_group(data, footer: PyFooter, group_index: int
+               ) -> Dict[str, Tuple[np.ndarray, Optional[np.ndarray]]]:
+    """Decode every column chunk of one row group from the raw file
+    bytes: name -> (values, validity)."""
+    leaves = schema_leaves(footer)
+    g = footer.meta.at(FMD_ROW_GROUPS).elems[group_index]
+    chunks = g.at(RG_COLUMNS).elems
+    if len(chunks) != len(leaves):
+        raise ValueError("row group chunk count disagrees with schema")
+    out = {}
+    for (name, ptype, optional), cc in zip(leaves, chunks):
+        out[name] = _decode_chunk(data, cc.at(CC_META_DATA), ptype,
+                                  optional)
+    return out
+
+
+def read_table(data, footer: Optional[PyFooter] = None
+               ) -> Dict[str, Tuple[np.ndarray, Optional[np.ndarray]]]:
+    """Whole-table decode (every kept row group, concatenated) — the
+    kill-switch / oracle path."""
+    f = footer if footer is not None else parse_footer(data)
+    leaves = schema_leaves(f)
+    ngroups = len(group_num_rows(f))
+    parts = [read_group(data, f, i) for i in range(ngroups)]
+    out = {}
+    for name, ptype, optional in leaves:
+        vs = [p[name][0] for p in parts]
+        vals = np.concatenate(vs) if vs else \
+            np.zeros(0, _DTYPE_OF_PTYPE[ptype])
+        va = None
+        if optional:
+            vvs = [p[name][1] for p in parts]
+            va = np.concatenate(vvs) if vvs else np.zeros(0, bool)
+        out[name] = (vals, va)
+    return out

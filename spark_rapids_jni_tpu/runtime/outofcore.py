@@ -1,0 +1,775 @@
+"""Out-of-core morsel-driven Parquet execution.
+
+Tables no longer have to fit in host RAM or HBM to run through the plan
+executor.  :func:`execute_file` marries the host-side footer layer
+(prune row groups and columns before a byte of data is decoded —
+exactly the reference repo's ``NativeParquetJni`` role) to a
+morsel-driven pipeline over the existing runtime:
+
+1. **Footer pruning** (:mod:`parquet.scan`): column projection uses the
+   *optimized* plan's scan set — PR 18's ``prune_projections`` survivor
+   columns — plus the validity-bearing authored columns the row mask
+   needs; ``filter_groups`` applies the partition split; explicit
+   ``predicates`` skip row groups by min/max statistics
+   (``srj_tpu_ooc_rowgroups_pruned_total``).
+2. **Morsel streaming**: surviving row groups batch into morsels of
+   ~``SRJ_TPU_OOC_MORSEL_ROWS`` rows.  Each morsel decodes and stages
+   (one arena-backed blob, one ``jax.device_put``) on the
+   :func:`staging.prefetch` worker, so decode + H2D of morsel ``k+1``
+   overlaps device compute of morsel ``k``.
+3. **Per-morsel plan fragments**: every morsel runs the plan through
+   ``plan.execute`` — bucketed on the pow-2 :mod:`shapes` grid (a
+   stream of N morsels costs O(log N) compiled programs and a warm
+   stream adds zero), under ``resilience.run`` with the usual
+   span/ledger/planstats attribution, each wrapped in an
+   ``ooc.morsel`` span (the Perfetto overlap lane).  Aggregates return
+   per-morsel partials merged host-side with exact combiner semantics
+   (Python-scalar accumulation — arbitrary precision, so int64 /
+   decimal128-scale sums never overflow at merge — then wrapped back
+   to the device dtype, byte-identical to the in-core result for
+   integer measures); filters/projections/joins stream through with
+   column outputs concatenated on host.
+4. **Join build spill**: when the single join's build side exceeds the
+   memwatch headroom model (live ``headroom_bytes`` against the exact
+   build bytes x ``SRJ_TPU_MEM_SAFETY`` — the same capacity and safety
+   inputs ``memwatch.should_split`` prices with), the build side is
+   spilled to host through ``fetch_arrays``, hash-partitioned on the
+   join key, and the probe stream re-runs partition-at-a-time against
+   each resident build partition (``srj_tpu_ooc_spills_total``).
+
+Row-mask semantics: nulls are dead rows.  The morsel mask is the AND of
+the validity arrays of every *authored* scan column that is OPTIONAL in
+the file — authored, not optimized, so the mask (and therefore every
+byte of the result) is invariant under ``SRJ_TPU_PLAN_OPT``.
+
+Kill switch: ``SRJ_TPU_OOC=0`` decodes every surviving row group,
+concatenates on host, and runs ONE whole-table ``plan.execute`` —
+byte-for-byte the pre-out-of-core behavior (and the oracle the
+equivalence tests pin the morselized path against).
+
+Knobs: ``SRJ_TPU_OOC`` (kill switch, default on),
+``SRJ_TPU_OOC_MORSEL_ROWS`` (target rows per morsel, default 8192),
+``SRJ_TPU_OOC_DEPTH`` (prefetch depth, default 2), ``SRJ_TPU_OOC_SPILL``
+(``auto`` = headroom model, ``1`` = force, ``0`` = never),
+``SRJ_TPU_OOC_SPILL_PARTS`` (partition cap, default 64).
+
+Limits (documented, enforced with clear errors): flat numeric Parquet
+schemas (the :mod:`parquet.scan` working set); aggregate plans must not
+overflow ``max_groups`` within any single morsel; spilling requires
+exactly one join whose probe ref is a scan column; a spilled dup-join
+cannot produce column outputs (rows expand — aggregate above it
+instead).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_jni_tpu.obs import metrics as _metrics
+from spark_rapids_jni_tpu.obs import spans as _spans
+from spark_rapids_jni_tpu.parquet import scan as _scan
+from spark_rapids_jni_tpu.runtime import plan as _plan
+from spark_rapids_jni_tpu.runtime import staging as _staging
+
+_ENV = "SRJ_TPU_OOC"
+_ENV_MORSEL_ROWS = "SRJ_TPU_OOC_MORSEL_ROWS"
+_ENV_DEPTH = "SRJ_TPU_OOC_DEPTH"
+_ENV_SPILL = "SRJ_TPU_OOC_SPILL"
+_ENV_SPILL_PARTS = "SRJ_TPU_OOC_SPILL_PARTS"
+
+__all__ = ["enabled", "execute_file", "morselize", "decode_morsel",
+           "stage_morsel", "counters"]
+
+
+def enabled() -> bool:
+    """Out-of-core execution on?  ``SRJ_TPU_OOC=0`` (or ``off``/``no``/
+    ``false``) falls back to whole-table execution byte-for-byte."""
+    return os.environ.get(_ENV, "1").strip().lower() \
+        not in ("0", "off", "no", "false")
+
+
+def _morsel_rows_target() -> int:
+    try:
+        return max(1, int(os.environ.get(_ENV_MORSEL_ROWS, "8192")))
+    except ValueError:
+        return 8192
+
+
+def _depth() -> int:
+    """Prefetch depth; 0 = inline serial staging (no worker thread, no
+    overlap — the bench axis's reference leg)."""
+    try:
+        return max(0, int(os.environ.get(_ENV_DEPTH, "2")))
+    except ValueError:
+        return 2
+
+
+def _stream_iter(morsels, stage_fn, depth: int):
+    """The morsel source: a Prefetcher at depth >= 1 (decode/H2D of
+    morsel k+1 overlaps compute of morsel k), or a lazy inline map at
+    depth 0 (each morsel decodes only after the previous one's result
+    was consumed — byte-identical, zero overlap)."""
+    if depth < 1:
+        return contextlib.nullcontext(map(stage_fn, morsels))
+    return contextlib.closing(
+        _staging.Prefetcher(morsels, stage_fn, depth=depth))
+
+
+# ---------------------------------------------------------------------------
+# Metrics / health
+# ---------------------------------------------------------------------------
+
+def _count(what: str, n=1) -> None:
+    helps = {
+        "morsels": "Morsels dispatched by the out-of-core executor.",
+        "spills": "Join build partitions spilled to host and "
+                  "re-streamed partition-at-a-time.",
+        "rowgroups_pruned": "Row groups skipped via min/max statistics "
+                            "before any data decode.",
+        "bytes_streamed": "Column-chunk payload bytes decoded and "
+                          "staged by the out-of-core executor.",
+    }
+    try:
+        _metrics.counter(f"srj_tpu_ooc_{what}_total",
+                         helps.get(what, "")).inc(n)
+    except Exception:
+        pass
+
+
+def counters() -> Dict[str, float]:
+    """Current ``srj_tpu_ooc_*_total`` values (test/CI convenience)."""
+    out = {}
+    try:
+        snap = _metrics.registry().snapshot()
+        for what in ("morsels", "spills", "rowgroups_pruned",
+                     "bytes_streamed"):
+            fam = snap.get(f"srj_tpu_ooc_{what}_total") or {}
+            out[what] = float(sum((fam.get("values") or {}).values()))
+    except Exception:
+        pass
+    return out
+
+
+_LAST: Dict = {}
+_EXPORTED = False
+
+
+def _ensure_exported() -> None:
+    global _EXPORTED
+    if _EXPORTED:
+        return
+    _EXPORTED = True
+    try:
+        from spark_rapids_jni_tpu.obs import exporter
+
+        def _health() -> Dict:
+            doc = {"enabled": enabled()}
+            doc.update(counters())
+            if _LAST:
+                doc["last"] = dict(_LAST)
+            return doc
+
+        exporter.register_health_provider("outofcore", _health)
+    except Exception:
+        _EXPORTED = False
+
+
+# ---------------------------------------------------------------------------
+# Morsel plumbing (shared with the bench axis)
+# ---------------------------------------------------------------------------
+
+def morselize(group_rows: Sequence[int], target: int) -> List[List[int]]:
+    """Batch consecutive row-group indices into morsels of >= ``target``
+    rows (always at least one group per morsel; zero-row groups ride
+    along with their neighbors)."""
+    morsels: List[List[int]] = []
+    cur: List[int] = []
+    rows = 0
+    for i, r in enumerate(group_rows):
+        cur.append(i)
+        rows += int(r)
+        if rows >= target:
+            morsels.append(cur)
+            cur, rows = [], 0
+    if cur:
+        morsels.append(cur)
+    return morsels
+
+
+def decode_morsel(data, footer, groups: Sequence[int],
+                  feed_cols: Sequence[str], mask_cols: Sequence[str]
+                  ) -> Tuple[Dict[str, np.ndarray],
+                             Optional[np.ndarray], int]:
+    """Decode one morsel's row groups to host arrays: (columns to feed
+    the plan, row mask from the AND of ``mask_cols`` validities, row
+    count)."""
+    parts = [_scan.read_group(data, footer, g) for g in groups]
+    cols: Dict[str, np.ndarray] = {}
+    names = set(feed_cols) | set(mask_cols)
+    for name in names:
+        vs = [p[name][0] for p in parts]
+        if name in feed_cols:
+            cols[name] = np.concatenate(vs) if vs else vs
+    mask = None
+    for name in mask_cols:
+        va = [p[name][1] for p in parts]
+        if any(v is None for v in va):
+            continue
+        m = np.concatenate(va) if va else None
+        if m is not None:
+            mask = m if mask is None else (mask & m)
+    n = sum(int(p[next(iter(p))][0].shape[0]) for p in parts) \
+        if parts else 0
+    return cols, mask, n
+
+
+def stage_morsel(cols: Dict[str, np.ndarray],
+                 mask: Optional[np.ndarray]):
+    """Stage one decoded morsel to device as ONE arena-backed blob;
+    returns (device columns, device mask).  Runs on the prefetch
+    worker, so the H2D overlaps the previous morsel's compute."""
+    names = list(cols)
+    bufs = [cols[c] for c in names]
+    payload = sum(int(b.nbytes) for b in bufs)
+    if mask is not None:
+        bufs.append(np.ascontiguousarray(mask))
+        payload += int(bufs[-1].nbytes)
+    if not bufs:
+        return {}, None
+    staged = _staging.stage_arrays(bufs)
+    _count("bytes_streamed", payload)
+    dev_cols = dict(zip(names, staged[:len(names)]))
+    dev_mask = staged[len(names)] if mask is not None else None
+    return dev_cols, dev_mask
+
+
+# ---------------------------------------------------------------------------
+# Aggregate partial merge (exact combiner semantics)
+# ---------------------------------------------------------------------------
+
+def _wrap_scalar(v, dt: np.dtype):
+    """Wrap an arbitrary-precision merged scalar back to the device
+    dtype's two's-complement value (device addition wraps; the host
+    merge must land on the same bytes)."""
+    dt = np.dtype(dt)
+    if dt.kind in "iu":
+        bits = dt.itemsize * 8
+        u = int(v) & ((1 << bits) - 1)
+        if dt.kind == "i" and u >= 1 << (bits - 1):
+            u -= 1 << bits
+        return dt.type(u)
+    return dt.type(v)
+
+
+def _agg_shape(node) -> Tuple[bool, Tuple[str, ...],
+                              Tuple[Tuple[str, str], ...], int]:
+    keys = tuple(node.get("keys"))
+    measures = tuple(node.get("measures"))
+    flat = len(keys) == 1 and len(measures) == 1 \
+        and measures[0][1] == "sum"
+    return flat, keys, measures, int(node.get("max_groups"))
+
+
+def _avg_rewrite(pl: "_plan.Plan"):
+    """Rewrite a terminal aggregate's ``avg`` measures to sum+count
+    partials (avg partials do not merge — the
+    ``merge_aggregate_partials`` contract); returns (morsel plan,
+    mapping) where mapping[j] describes how authored measure ``j``
+    assembles from the rewritten measure list."""
+    node = pl.nodes[-1]
+    _, keys, measures, mg = _agg_shape(node)
+    if not any(op == "avg" for _, op in measures):
+        return pl, [("direct", i, op) for i, (_, op)
+                    in enumerate(measures)]
+    new_measures: List[Tuple[str, str]] = []
+    mapping = []
+    for ref, op in measures:
+        if op == "avg":
+            mapping.append(("avg", len(new_measures), op))
+            new_measures.append((ref, "sum"))
+            new_measures.append((ref, "count"))
+        else:
+            mapping.append(("direct", len(new_measures), op))
+            new_measures.append((ref, op))
+    nodes = list(pl.nodes[:-1])
+    nodes.append(_plan.aggregate(list(keys), new_measures, mg))
+    return _plan.Plan(nodes, outputs=pl.outputs), mapping
+
+
+def _partial_lists(result, morsel_plan):
+    """Normalize one morsel's aggregate result tuple to
+    (key_arrays, out_arrays, have, num_groups, ng_dtype) with
+    list-shaped keys and outs regardless of the kernel's flat/multi
+    form."""
+    flat, _, _, _ = _agg_shape(morsel_plan.nodes[-1])
+    gk, outs, have, ng = result
+    if flat:
+        gk, outs = [gk], [outs]
+    ng = np.asarray(ng)
+    return ([np.asarray(k) for k in gk], [np.asarray(o) for o in outs],
+            np.asarray(have), int(ng), ng.dtype)
+
+
+class _AggMerge:
+    """Host-side accumulator over morsel partials: Python-scalar exact
+    combiners keyed by the group-key tuple."""
+
+    def __init__(self, ops: Sequence[str]):
+        from spark_rapids_jni_tpu.models import pipeline as _pl
+        self._merge_one = _pl._merge_one
+        self.ops = list(ops)
+        self.groups: Dict[Tuple, List] = {}
+        self.key_dtypes: Optional[List[np.dtype]] = None
+        self.out_dtypes: Optional[List[np.dtype]] = None
+        self.ng_dtype: Optional[np.dtype] = None
+
+    def add(self, gk: List[np.ndarray], outs: List[np.ndarray],
+            have: np.ndarray, ng_dtype=None) -> None:
+        if self.key_dtypes is None:
+            # dtype truth comes from the partials themselves (count and
+            # num_groups widths differ between x64 and no-x64 modes)
+            self.key_dtypes = [k.dtype for k in gk]
+            self.out_dtypes = [o.dtype for o in outs]
+            self.ng_dtype = ng_dtype
+        for j in np.nonzero(have)[0]:
+            key = tuple(k[j].item() for k in gk)
+            vals = [o[j].item() for o in outs]
+            acc = self.groups.get(key)
+            if acc is None:
+                self.groups[key] = list(vals)
+            else:
+                self._merge_one(acc, vals, self.ops)
+
+
+def _assemble_aggregate(merge: _AggMerge, mapping, authored_node):
+    """Reassemble the in-core aggregate tuple from merged partials —
+    keys ascending, dead slots zero-filled, measures wrapped to the
+    device dtype, ``num_groups`` the uncapped distinct count (the
+    kernel's overflow contract)."""
+    flat, keys, measures, mg = _agg_shape(authored_node)
+    items = sorted(merge.groups.items(), key=lambda kv: kv[0])
+    ng_total = len(items)
+    taken = items[:mg]
+    key_dts = merge.key_dtypes or [np.dtype(np.int32)] * len(keys)
+    gk = [np.zeros(mg, dt) for dt in key_dts]
+    for j, (key, _) in enumerate(taken):
+        for a, kv in zip(gk, key):
+            a[j] = kv
+    outs = []
+    for kind, src, op in mapping:
+        if kind == "avg":
+            sdt = merge.out_dtypes[src]
+            a = np.zeros(mg, np.float32)
+            for j, (_, vals) in enumerate(taken):
+                s = np.float32(_wrap_scalar(vals[src], sdt))
+                c = np.float32(max(int(vals[src + 1]), 1))
+                a[j] = np.float32(s / c)
+        else:
+            dt = merge.out_dtypes[src]
+            a = np.zeros(mg, dt)
+            for j, (_, vals) in enumerate(taken):
+                a[j] = _wrap_scalar(vals[src], dt)
+        outs.append(a)
+    have = np.zeros(mg, bool)
+    have[:len(taken)] = True
+    ng = np.asarray(ng_total, dtype=merge.ng_dtype or np.int32)
+    if flat:
+        return gk[0], outs[0], have, ng
+    return gk, outs, have, ng
+
+
+# ---------------------------------------------------------------------------
+# Spill decision + partitioning
+# ---------------------------------------------------------------------------
+
+def _safety() -> float:
+    try:
+        return float(os.environ.get("SRJ_TPU_MEM_SAFETY", "1.25"))
+    except ValueError:
+        return 1.25
+
+
+def _spill_parts_cap() -> int:
+    try:
+        return max(2, int(os.environ.get(_ENV_SPILL_PARTS, "64")))
+    except ValueError:
+        return 64
+
+
+def _spill_decision(side_inputs: Dict[str, np.ndarray]
+                    ) -> Tuple[bool, int]:
+    """(spill?, partitions): forced by ``SRJ_TPU_OOC_SPILL`` or decided
+    by the memwatch headroom model — the exact build bytes (better than
+    a footprint-model estimate: we hold the arrays) against live
+    headroom x safety, the same inputs ``should_split`` prices with."""
+    mode = os.environ.get(_ENV_SPILL, "auto").strip().lower()
+    if mode in ("0", "off", "no", "false", "never"):
+        return False, 1
+    build_bytes = sum(int(np.asarray(v).nbytes)
+                      for v in side_inputs.values())
+    if mode in ("1", "on", "yes", "true", "force", "always"):
+        hr = None
+    else:
+        from spark_rapids_jni_tpu.obs import memwatch
+        hr = memwatch.headroom_bytes()
+        if hr is None or build_bytes * _safety() <= hr:
+            return False, 1
+    parts = 2
+    cap = _spill_parts_cap()
+    while hr is not None and hr > 0 and parts < cap \
+            and (build_bytes / parts) * _safety() > hr:
+        parts *= 2
+    return True, parts
+
+
+def _partition_of(arr: np.ndarray, parts: int) -> np.ndarray:
+    """Deterministic host-side hash partition of an integer key column
+    (identical for build and probe sides — the Grace-join contract)."""
+    return np.mod(np.asarray(arr).astype(np.int64), parts)
+
+
+# ---------------------------------------------------------------------------
+# Host conversion
+# ---------------------------------------------------------------------------
+
+def _to_host(x):
+    if x is None:
+        return None
+    if isinstance(x, (list, tuple)):
+        t = type(x)
+        return t(_to_host(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _to_host(v) for k, v in x.items()}
+    return np.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# The executor
+# ---------------------------------------------------------------------------
+
+def execute_file(data, plan: "_plan.Plan", *,
+                 side_inputs: Optional[Dict] = None,
+                 predicates: Sequence[Tuple[str, str, float]] = (),
+                 part_offset: int = 0,
+                 part_length: Optional[int] = None,
+                 morsel_rows: Optional[int] = None,
+                 bucket="auto"):
+    """Run ``plan`` over a Parquet file's bytes without ever holding the
+    whole table: footer-pruned column chunks stream through the
+    prefetcher as morsels, each executed as a plan fragment on device.
+
+    ``side_inputs``: join build-side arrays (resident across the
+    stream; spilled to host partitions when oversized).
+    ``predicates``: ``(column, op, literal)`` conjuncts the plan also
+    applies — used ONLY to skip row groups by min/max statistics.
+    Returns host (numpy) results: the aggregate tuple in the in-core
+    layout, ``plan.outputs`` arrays, or ``(columns, mask)``."""
+    _ensure_exported()
+    side_inputs = dict(side_inputs or {})
+    data = bytes(data) if not isinstance(data, (bytes, bytearray)) \
+        else data
+
+    exec_plan = _plan._optimized(plan)
+    feed_cols = list(exec_plan.stream_inputs)
+    authored_cols = list(plan.stream_inputs)
+
+    footer0 = _scan.parse_footer(data)
+    leaves = {name: (ptype, optional)
+              for name, ptype, optional in _scan.schema_leaves(footer0)}
+    missing = [c for c in feed_cols if c not in leaves]
+    if missing:
+        raise ValueError(f"scan columns {missing} not in file schema")
+    mask_cols = [c for c in authored_cols
+                 if c in leaves and leaves[c][1]]
+    read_cols = list(dict.fromkeys(
+        [c for c in authored_cols if c in feed_cols or c in mask_cols]))
+
+    footer = _scan.prune_footer(
+        data, read_cols, part_offset,
+        len(data) if part_length is None else part_length)
+    pruned = _scan.prune_groups_by_stats(footer, predicates)
+    if pruned:
+        _count("rowgroups_pruned", pruned)
+    group_rows = _scan.group_num_rows(footer)
+
+    _LAST.clear()
+    _LAST.update({"plan": plan.fp8, "groups": len(group_rows),
+                  "rowgroups_pruned": int(pruned), "mode": "ooc"})
+
+    if not enabled() or not group_rows:
+        _LAST["mode"] = "whole-table"
+        return _whole_table(data, footer, plan, feed_cols, mask_cols,
+                            side_inputs, bucket)
+
+    morsels = morselize(group_rows,
+                        morsel_rows if morsel_rows is not None
+                        else _morsel_rows_target())
+    _LAST["morsels"] = len(morsels)
+
+    is_agg = plan.nodes[-1].kind == "aggregate" and not plan.outputs
+    join_nodes = [nd for nd in plan.nodes if nd.kind == "join"]
+    spill, parts = (False, 1)
+    if side_inputs and len(join_nodes) == 1:
+        spill, parts = _spill_decision(side_inputs)
+    if spill:
+        _LAST["spill_partitions"] = parts
+        return _run_spilled(data, footer, plan, feed_cols, mask_cols,
+                            side_inputs, morsels, join_nodes[0], parts,
+                            is_agg, bucket)
+
+    # resident build side: stage once, reuse across every morsel
+    side_staged = _stage_sides(side_inputs)
+    return _run_stream(data, footer, plan, feed_cols, mask_cols,
+                       side_staged, morsels, is_agg, bucket)
+
+
+def _stage_sides(side_inputs: Dict) -> Dict:
+    if not side_inputs:
+        return {}
+    names = list(side_inputs)
+    host = [np.ascontiguousarray(np.asarray(side_inputs[k]))
+            for k in names]
+    return dict(zip(names, _staging.stage_arrays(host)))
+
+
+def _run_stream(data, footer, plan, feed_cols, mask_cols, side_staged,
+                morsels, is_agg: bool, bucket):
+    """The straight-line morsel pipeline: decode+stage on the prefetch
+    worker, compute on the consumer, partials merged / outputs
+    concatenated host-side."""
+    if is_agg:
+        morsel_plan, mapping = _avg_rewrite(plan)
+        merge = _AggMerge([op for _, op
+                           in morsel_plan.nodes[-1].get("measures")])
+    col_chunks: List = []
+
+    def _stage(groups):
+        cols, mask, n = decode_morsel(data, footer, list(groups),
+                                      feed_cols, mask_cols)
+        if n == 0:
+            return None, None, 0, len(groups)
+        dev_cols, dev_mask = stage_morsel(cols, mask)
+        return dev_cols, dev_mask, n, len(groups)
+
+    with _stream_iter(morsels, _stage, _depth()) as pf:
+        for i, (dev_cols, dev_mask, n, ngroups) in enumerate(pf):
+            if n == 0:
+                continue
+            with _spans.span("ooc.morsel", morsel=i, rows=n,
+                             groups=ngroups, plan=plan.fp8) as sp:
+                inputs = dict(dev_cols)
+                inputs.update(side_staged)
+                if is_agg:
+                    out = _plan.execute(morsel_plan, inputs,
+                                        mask=dev_mask, bucket=bucket)
+                    gk, outs, have, ng, ngdt = _partial_lists(
+                        out, morsel_plan)
+                    mg = morsel_plan.nodes[-1].get("max_groups")
+                    if ng > mg:
+                        raise RuntimeError(
+                            f"morsel {i} aggregate overflow: {ng} "
+                            f"groups > max_groups={mg}; raise "
+                            "max_groups or shrink morsels")
+                    merge.add(gk, outs, have, ngdt)
+                else:
+                    out = _plan.execute(plan, inputs, mask=dev_mask,
+                                        bucket=bucket)
+                    col_chunks.append(_fetch_output(plan, out))
+                sp.set(mode="stream")
+            _count("morsels")
+
+    if is_agg:
+        if merge.key_dtypes is None:   # every morsel was empty
+            return _whole_table(data, footer, plan, feed_cols,
+                                mask_cols, side_staged, bucket)
+        return _assemble_aggregate(merge, mapping, plan.nodes[-1])
+    if not col_chunks:
+        return _whole_table(data, footer, plan, feed_cols, mask_cols,
+                            side_staged, bucket)
+    return _concat_outputs(plan, col_chunks)
+
+
+def _run_spilled(data, footer, plan, feed_cols, mask_cols, side_inputs,
+                 morsels, join_node, parts: int, is_agg: bool, bucket):
+    """Grace-style spilled join: the build side goes back to host
+    through ``fetch_arrays``, hash-partitions on the join key, and the
+    probe stream re-runs partition-at-a-time against each resident
+    build partition (the probe side is re-decoded per partition — host
+    decode is the cheap axis; HBM residency is the scarce one)."""
+    probe_ref = join_node.get("probe")
+    if probe_ref not in feed_cols:
+        raise ValueError(
+            f"spilled join needs probe ref {probe_ref!r} to be a scan "
+            "column (projected probe keys cannot be partitioned "
+            "host-side)")
+    if not is_agg and join_node.get("how") == "dup":
+        raise ValueError("spilled dup-join column outputs are "
+                         "unsupported (rows expand); aggregate instead")
+    build_key = join_node.get("build_keys")
+    # the spill proper: device-resident build arrays come back to host
+    # in one staged D2H
+    names = list(side_inputs)
+    host_sides = dict(zip(names, _staging.fetch_arrays(
+        [side_inputs[k] for k in names])))
+    bpart = _partition_of(host_sides[build_key], parts)
+
+    if is_agg:
+        morsel_plan, mapping = _avg_rewrite(plan)
+        merge = _AggMerge([op for _, op
+                           in morsel_plan.nodes[-1].get("measures")])
+    total_rows = sum(_scan.group_num_rows(footer))
+    scatter: List = []
+
+    for p in range(parts):
+        bsel = bpart == p
+        side_staged = _stage_sides(
+            {k: np.ascontiguousarray(v[bsel])
+             for k, v in host_sides.items()})
+        _count("spills")
+        row_base = [0]
+
+        def _stage(groups, _p=p, _base=row_base):
+            cols, mask, n = decode_morsel(data, footer, list(groups),
+                                          feed_cols, mask_cols)
+            start = _base[0]
+            _base[0] += n
+            if n == 0:
+                return None, None, 0, None
+            psel = np.asarray(
+                _partition_of(cols[probe_ref], parts) == _p)
+            idx = np.nonzero(psel)[0]
+            if idx.size == 0:
+                return None, None, 0, None
+            pcols = {k: np.ascontiguousarray(v[psel])
+                     for k, v in cols.items()}
+            pmask = np.ascontiguousarray(mask[psel]) \
+                if mask is not None else None
+            dev_cols, dev_mask = stage_morsel(pcols, pmask)
+            return dev_cols, dev_mask, int(idx.size), start + idx
+
+        with _stream_iter(morsels, _stage, _depth()) as pf:
+            for i, (dev_cols, dev_mask, n, gidx) in enumerate(pf):
+                if n == 0:
+                    continue
+                with _spans.span("ooc.morsel", morsel=i, rows=n,
+                                 partition=p, plan=plan.fp8) as sp:
+                    inputs = dict(dev_cols)
+                    inputs.update(side_staged)
+                    if is_agg:
+                        out = _plan.execute(morsel_plan, inputs,
+                                            mask=dev_mask,
+                                            bucket=bucket)
+                        gk, outs, have, ng, ngdt = _partial_lists(
+                            out, morsel_plan)
+                        mg = morsel_plan.nodes[-1].get("max_groups")
+                        if ng > mg:
+                            raise RuntimeError(
+                                f"morsel {i} partition {p} aggregate "
+                                f"overflow: {ng} groups > "
+                                f"max_groups={mg}")
+                        merge.add(gk, outs, have, ngdt)
+                    else:
+                        out = _plan.execute(plan, inputs,
+                                            mask=dev_mask,
+                                            bucket=bucket)
+                        scatter.append((gidx,
+                                        _fetch_output(plan, out)))
+                    sp.set(mode="spill")
+                _count("morsels")
+
+    if is_agg:
+        if merge.key_dtypes is None:
+            return _whole_table(data, footer, plan, feed_cols,
+                                mask_cols, host_sides, bucket)
+        return _assemble_aggregate(merge, mapping, plan.nodes[-1])
+    if not scatter:
+        return _whole_table(data, footer, plan, feed_cols, mask_cols,
+                            host_sides, bucket)
+    return _scatter_outputs(plan, scatter, total_rows)
+
+
+def _whole_table(data, footer, plan, feed_cols, mask_cols, side_inputs,
+                 bucket):
+    """The kill-switch / empty-stream path: decode every surviving row
+    group, concatenate host-side, run ONE ``plan.execute`` — the
+    pre-out-of-core behavior, byte for byte."""
+    table = _scan.read_table(data, footer)
+    leaves = _scan.schema_leaves(footer)
+    dts = {name: _scan._DTYPE_OF_PTYPE[ptype]
+           for name, ptype, _ in leaves}
+    inputs: Dict[str, np.ndarray] = {}
+    for c in feed_cols:
+        inputs[c] = table[c][0] if c in table \
+            else np.zeros(0, dts.get(c, np.int32))
+    mask = None
+    for c in mask_cols:
+        va = table[c][1] if c in table else None
+        if va is not None:
+            mask = va if mask is None else (mask & va)
+    inputs.update(side_inputs)
+    out = _plan.execute(plan, inputs, mask=mask, bucket=bucket)
+    return _to_host(out)
+
+
+def _fetch_output(plan, out):
+    """One morsel's column outputs back to host in one staged D2H."""
+    if plan.outputs:
+        return tuple(_staging.fetch_arrays(list(out)))
+    cols, mask = out
+    names = list(cols)
+    arrs = _staging.fetch_arrays([cols[k] for k in names]
+                                 + ([mask] if mask is not None else []))
+    host_cols = dict(zip(names, arrs[:len(names)]))
+    host_mask = arrs[len(names)] if mask is not None else None
+    return host_cols, host_mask
+
+
+def _concat_outputs(plan, chunks: List):
+    if plan.outputs:
+        return tuple(np.concatenate([c[i] for c in chunks])
+                     for i in range(len(plan.outputs)))
+    names = list(chunks[0][0])
+    cols = {k: np.concatenate([c[0][k] for c in chunks])
+            for k in names}
+    if all(c[1] is None for c in chunks):
+        return cols, None
+    mask = np.concatenate(
+        [c[1] if c[1] is not None
+         else np.ones(len(next(iter(c[0].values()))), bool)
+         for c in chunks])
+    return cols, mask
+
+
+def _scatter_outputs(plan, pieces: List, total_rows: int):
+    """Spilled column outputs come back per (morsel, partition) with
+    their original row indices; scatter restores file row order."""
+    if plan.outputs:
+        outs = None
+        for gidx, vals in pieces:
+            if outs is None:
+                outs = [np.zeros((total_rows,) + v.shape[1:], v.dtype)
+                        for v in vals]
+            for o, v in zip(outs, vals):
+                o[gidx] = v
+        if outs is None:
+            outs = [np.zeros(total_rows)
+                    for _ in (plan.outputs or ())]
+        return tuple(outs)
+    cols_out: Dict[str, np.ndarray] = {}
+    mask_out = None
+    any_mask = any(m is not None for _, (_, m) in pieces)
+    for gidx, (cols, mask) in pieces:
+        for k, v in cols.items():
+            if k not in cols_out:
+                cols_out[k] = np.zeros((total_rows,) + v.shape[1:],
+                                       v.dtype)
+            cols_out[k][gidx] = v
+        if any_mask:
+            if mask_out is None:
+                mask_out = np.zeros(total_rows, bool)
+            mask_out[gidx] = mask if mask is not None else True
+    return cols_out, mask_out

@@ -448,8 +448,8 @@ def _prefetch_iter(items, stage_fn, depth: int, ex):
     qdepth = _obs_metrics.gauge(
         "srj_tpu_prefetch_queue_depth",
         "Batches staged ahead of the consumer by the prefetch worker.")
+    pending = collections.deque()
     try:
-        pending = collections.deque()
         for item in items:
             pending.append(ex.submit(_obs_context.run_with,
                                      _obs_context.capture(), stage_fn, item))
@@ -463,7 +463,27 @@ def _prefetch_iter(items, stage_fn, depth: int, ex):
             qdepth.set(len(pending))
             yield fut.result()
     finally:
+        # Drain-on-close: a consumer abandoning the stream mid-way must
+        # not leave staged blobs (arena refs) parked in the queue.  Not
+        # yet started -> cancelled; done or in flight -> the result is
+        # discarded the moment it exists (done-callback, never blocking
+        # here — joining an in-flight stage under the consumer's finally
+        # could deadlock on the arena lock).
+        while pending:
+            fut = pending.popleft()
+            if not fut.cancel():
+                fut.add_done_callback(_discard_staged)
         qdepth.set(0)
+
+
+def _discard_staged(fut) -> None:
+    """Done-callback releasing an abandoned prefetch stage: retrieve the
+    exception (silences never-retrieved warnings) and drop the result
+    reference with the future."""
+    try:
+        fut.exception()
+    except concurrent.futures.CancelledError:
+        pass
 
 
 def prefetch(items, stage_fn, depth: int = 2):
@@ -526,3 +546,9 @@ class Prefetcher:
         self._closed = True
         self._gen.close()
         self._ex.shutdown(wait=True, cancel_futures=True)
+        # A never-iterated generator's finally never ran; the worker is
+        # joined, so unconditionally zeroing the gauge here is exact.
+        _obs_metrics.gauge(
+            "srj_tpu_prefetch_queue_depth",
+            "Batches staged ahead of the consumer by the prefetch "
+            "worker.").set(0)

@@ -1,0 +1,365 @@
+"""Out-of-core execution contract tests (``runtime/outofcore.py``).
+
+The acceptance grid: morselized execution must be byte-identical to
+in-core whole-table execution across null patterns x bucket-edge table
+and morsel sizes x plan shapes (aggregate / filter+project / join),
+including the spilled-join leg and the ``SRJ_TPU_OOC=0`` kill switch —
+plus the compile-count guard (a warm morsel stream adds zero compiles;
+N morsels cost O(log N) programs) and the metrics / healthz / span-lane
+surfaces."""
+
+import gc
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import obs
+from spark_rapids_jni_tpu.obs import exporter, metrics
+from spark_rapids_jni_tpu.parquet import scan
+from spark_rapids_jni_tpu.runtime import outofcore, shapes, staging
+from spark_rapids_jni_tpu.runtime import plan as P
+
+EDGE_SIZES = [0, 1, 7, 8, 9, 31, 32, 33]
+NULL_PATTERNS = ["none", "some", "all"]
+
+
+@pytest.fixture
+def obs_on():
+    obs.configure_sink(None)
+    obs.clear()
+    metrics.registry().reset()
+    obs.enable()
+    yield
+    obs.disable()
+    obs.configure_sink(None)
+    obs.clear()
+
+
+def _file(n, pattern="none", seed=0, rg=3):
+    rng = np.random.default_rng(seed)
+    cols = {
+        "k": rng.integers(0, 5, n).astype(np.int32),
+        "v": rng.integers(-50, 50, n).astype(np.int32),
+        "w": rng.standard_normal(n).astype(np.float32),
+    }
+    validity = None
+    if pattern == "some":
+        validity = {"v": rng.random(n) > 0.3}
+    elif pattern == "all":
+        validity = {"v": np.zeros(n, bool)}
+    return scan.write_table(cols, row_group_rows=rg, validity=validity)
+
+
+def _deep_eq(a, b, path=""):
+    """Byte-identity including dtype and container shape."""
+    if isinstance(a, (list, tuple)):
+        assert isinstance(b, type(a)) and len(a) == len(b), path
+        for i, (x, y) in enumerate(zip(a, b)):
+            _deep_eq(x, y, f"{path}[{i}]")
+        return
+    if isinstance(a, dict):
+        assert set(a) == set(b), path
+        for k in a:
+            _deep_eq(a[k], b[k], f"{path}.{k}")
+        return
+    if a is None:
+        assert b is None, path
+        return
+    aa, bb = np.asarray(a), np.asarray(b)
+    assert aa.dtype == bb.dtype, (path, aa.dtype, bb.dtype)
+    assert aa.shape == bb.shape, (path, aa.shape, bb.shape)
+    assert np.array_equal(aa, bb), path
+
+
+def _oracle(monkeypatch, pl, data, side=None, **kw):
+    """In-core whole-table execution through the kill switch."""
+    monkeypatch.setenv("SRJ_TPU_OOC", "0")
+    try:
+        return outofcore.execute_file(data, pl, side_inputs=side, **kw)
+    finally:
+        monkeypatch.delenv("SRJ_TPU_OOC", raising=False)
+
+
+def _agg_sum():
+    return P.Plan([P.scan("k", "v"),
+                   P.filter(lambda v: v > -40, ["v"]),
+                   P.aggregate(["k"], [("v", "sum")], 128)])
+
+
+def _agg_multi():
+    return P.Plan([P.scan("k", "v", "w"),
+                   P.aggregate(["k"], [("v", "sum"), ("v", "avg"),
+                                       ("v", "count"), ("w", "min"),
+                                       ("v", "max")], 128)])
+
+
+def _outputs_plan():
+    return P.Plan([P.scan("k", "v"),
+                   P.filter(lambda v: v != 3, ["v"]),
+                   P.project({"d": (lambda v: v * 2 + 1, ["v"])})],
+                  outputs=["d", "k"])
+
+
+def _join_plan(outputs=None):
+    return P.Plan([P.scan("k", "v"),
+                   P.join("bk", "k", "bp", "j"),
+                   P.aggregate(["k"], [("j", "sum"), ("v", "min")],
+                               128)] if outputs is None else
+                  [P.scan("k", "v"), P.join("bk", "k", "bp", "j")],
+                  outputs=outputs)
+
+
+def _side():
+    bk = np.arange(0, 5, dtype=np.int32)
+    return {"bk": bk, "bp": (bk * 100 + 7).astype(np.int32)}
+
+
+# ---------------------------------------------------------------------------
+# Equivalence grid
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("pattern", NULL_PATTERNS)
+@pytest.mark.parametrize("n", EDGE_SIZES)
+def test_aggregate_equivalence_grid(monkeypatch, n, pattern):
+    data = _file(n, pattern, seed=n)
+    pl = _agg_sum()
+    got = outofcore.execute_file(data, pl, morsel_rows=8)
+    _deep_eq(got, _oracle(monkeypatch, pl, data), f"n={n}")
+
+
+@pytest.mark.parametrize("morsel_rows", [1, 9, 33])
+@pytest.mark.parametrize("pattern", ["none", "some"])
+def test_multi_measure_equivalence(monkeypatch, pattern, morsel_rows):
+    data = _file(40, pattern, seed=2)
+    pl = _agg_multi()
+    got = outofcore.execute_file(data, pl, morsel_rows=morsel_rows)
+    _deep_eq(got, _oracle(monkeypatch, pl, data), pattern)
+
+
+@pytest.mark.parametrize("morsel_rows", [1, 8, 32])
+@pytest.mark.parametrize("pattern", ["none", "some"])
+def test_column_outputs_equivalence(monkeypatch, pattern, morsel_rows):
+    data = _file(37, pattern, seed=5)
+    pl = _outputs_plan()
+    got = outofcore.execute_file(data, pl, morsel_rows=morsel_rows)
+    _deep_eq(got, _oracle(monkeypatch, pl, data), pattern)
+
+
+@pytest.mark.parametrize("pattern", ["none", "some"])
+def test_cols_and_mask_equivalence(monkeypatch, pattern):
+    data = _file(29, pattern, seed=6)
+    pl = P.Plan([P.scan("k", "v"),
+                 P.filter(lambda v: v > 0, ["v"])])
+    got = outofcore.execute_file(data, pl, morsel_rows=7)
+    _deep_eq(got, _oracle(monkeypatch, pl, data), pattern)
+
+
+@pytest.mark.parametrize("pattern", ["none", "some"])
+def test_join_resident_equivalence(monkeypatch, pattern):
+    data = _file(45, pattern, seed=8)
+    pl = _join_plan()
+    got = outofcore.execute_file(data, pl, side_inputs=_side(),
+                                 morsel_rows=9)
+    _deep_eq(got, _oracle(monkeypatch, pl, data, _side()), pattern)
+
+
+def test_int_sum_wraps_like_device(monkeypatch):
+    # per-morsel partials merge with Python-scalar precision, then wrap
+    # to the device dtype — a sum overflowing int32 must land on the
+    # same bytes the single whole-table kernel produces
+    n = 96
+    data = scan.write_table(
+        {"k": (np.arange(n) % 3).astype(np.int32),
+         "v": np.full(n, 2**30, np.int32)}, row_group_rows=5)
+    pl = P.Plan([P.scan("k", "v"),
+                 P.aggregate(["k"], [("v", "sum")], 128)])
+    got = outofcore.execute_file(data, pl, morsel_rows=16)
+    _deep_eq(got, _oracle(monkeypatch, pl, data), "wrap")
+
+
+# ---------------------------------------------------------------------------
+# Spilled join leg
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("pattern", ["none", "some"])
+def test_spilled_join_aggregate_equivalence(monkeypatch, pattern):
+    data = _file(45, pattern, seed=9)
+    pl = _join_plan()
+    before = outofcore.counters().get("spills", 0)
+    monkeypatch.setenv("SRJ_TPU_OOC_SPILL", "1")
+    got = outofcore.execute_file(data, pl, side_inputs=_side(),
+                                 morsel_rows=9)
+    monkeypatch.delenv("SRJ_TPU_OOC_SPILL")
+    assert outofcore.counters()["spills"] > before
+    _deep_eq(got, _oracle(monkeypatch, pl, data, _side()), pattern)
+
+
+def test_spilled_join_column_outputs_restore_row_order(monkeypatch):
+    data = _file(41, "some", seed=10)
+    pl = _join_plan(outputs=["j", "v"])
+    monkeypatch.setenv("SRJ_TPU_OOC_SPILL", "1")
+    got = outofcore.execute_file(data, pl, side_inputs=_side(),
+                                 morsel_rows=8)
+    monkeypatch.delenv("SRJ_TPU_OOC_SPILL")
+    _deep_eq(got, _oracle(monkeypatch, pl, data, _side()), "order")
+
+
+def test_spill_never_forced_off(monkeypatch):
+    # SRJ_TPU_OOC_SPILL=0 must keep the build resident even under a
+    # tiny injected headroom cap
+    monkeypatch.setenv("SRJ_TPU_OOC_SPILL", "0")
+    before = outofcore.counters().get("spills", 0)
+    data = _file(20, seed=11)
+    got = outofcore.execute_file(data, _join_plan(),
+                                 side_inputs=_side(), morsel_rows=8)
+    assert outofcore.counters().get("spills", 0) == before
+    monkeypatch.delenv("SRJ_TPU_OOC_SPILL")
+    _deep_eq(got, _oracle(monkeypatch, _join_plan(), data, _side()),
+             "nospill")
+
+
+def test_spilled_projected_probe_rejected(monkeypatch):
+    # a probe ref that only exists post-projection cannot be hash
+    # partitioned host-side; the error must be explicit
+    pl = P.Plan([P.scan("k", "v"),
+                 P.project({"k2": (lambda k: k + 0, ["k"])}),
+                 P.join("bk", "k2", "bp", "j"),
+                 P.aggregate(["k"], [("j", "sum")], 128)])
+    monkeypatch.setenv("SRJ_TPU_OOC_SPILL", "1")
+    with pytest.raises(ValueError, match="probe ref"):
+        outofcore.execute_file(_file(20, seed=12), pl,
+                               side_inputs=_side(), morsel_rows=8)
+
+
+# ---------------------------------------------------------------------------
+# Kill switch
+# ---------------------------------------------------------------------------
+
+def test_kill_switch_matches_direct_in_core(monkeypatch):
+    # SRJ_TPU_OOC=0 must be byte-for-byte the pre-out-of-core behavior:
+    # ONE plan.execute over the host-concatenated table
+    data = _file(26, "some", seed=13)
+    pl = _agg_sum()
+    table = scan.read_table(data)
+    inputs = {c: table[c][0] for c in pl.stream_inputs}
+    mask = table["v"][1]
+    direct = P.execute(pl, inputs, mask=mask)
+    via_switch = _oracle(monkeypatch, pl, data)
+    _deep_eq(via_switch,
+             tuple(np.asarray(x) for x in direct), "kill")
+
+
+def test_kill_switch_matches_morselized(monkeypatch):
+    data = _file(33, "some", seed=14)
+    pl = _agg_multi()
+    _deep_eq(outofcore.execute_file(data, pl, morsel_rows=7),
+             _oracle(monkeypatch, pl, data), "switch")
+
+
+def test_depth_zero_inline_serial_matches(monkeypatch):
+    # SRJ_TPU_OOC_DEPTH=0 runs the same morsel loop with inline staging
+    # (no prefetch worker) — the bench axis's serial reference leg must
+    # stay byte-identical to the threaded stream
+    data = _file(33, "some", seed=15)
+    pl = _agg_multi()
+    threaded = outofcore.execute_file(data, pl, morsel_rows=7)
+    monkeypatch.setenv("SRJ_TPU_OOC_DEPTH", "0")
+    _deep_eq(outofcore.execute_file(data, pl, morsel_rows=7),
+             threaded, "depth0")
+
+
+# ---------------------------------------------------------------------------
+# Footer pruning through the executor
+# ---------------------------------------------------------------------------
+
+def test_predicates_prune_rowgroups_and_preserve_result(monkeypatch):
+    n = 100
+    data = scan.write_table(
+        {"k": (np.arange(n) % 4).astype(np.int32),
+         "v": np.arange(n, dtype=np.int32)}, row_group_rows=10)
+    pl = P.Plan([P.scan("k", "v"),
+                 P.filter(lambda v: v >= 70, ["v"]),
+                 P.aggregate(["k"], [("v", "sum")], 128)])
+    before = outofcore.counters().get("rowgroups_pruned", 0)
+    got = outofcore.execute_file(data, pl, morsel_rows=16,
+                                 predicates=[("v", ">=", 70)])
+    assert outofcore.counters()["rowgroups_pruned"] - before == 7
+    _deep_eq(got, _oracle(monkeypatch, pl, data), "pruned")
+
+
+def test_missing_scan_column_raises():
+    pl = P.Plan([P.scan("k", "nope"),
+                 P.aggregate(["k"], [("nope", "sum")], 128)])
+    with pytest.raises(ValueError, match="not in file schema"):
+        outofcore.execute_file(_file(10), pl)
+
+
+def test_morsel_group_overflow_raises():
+    n = 64
+    data = scan.write_table(
+        {"k": np.arange(n, dtype=np.int32),
+         "v": np.ones(n, np.int32)}, row_group_rows=16)
+    pl = P.Plan([P.scan("k", "v"),
+                 P.aggregate(["k"], [("v", "sum")], 8)])
+    with pytest.raises(RuntimeError, match="overflow"):
+        outofcore.execute_file(data, pl, morsel_rows=16)
+
+
+# ---------------------------------------------------------------------------
+# Compile-count guard (N morsels cost O(log N) programs; warm stream
+# adds zero)
+# ---------------------------------------------------------------------------
+
+def _plan_compiles(fp8):
+    return [e for e in obs.events("compile")
+            if e.get("span") == f"plan[{fp8}]"]
+
+
+def test_warm_morsel_stream_adds_zero_compiles(obs_on):
+    data = _file(64, "some", seed=15, rg=5)
+    # a literal unique to this test -> fresh fingerprint, cold cache
+    pl = P.Plan([P.scan("k", "v"),
+                 P.filter(lambda v: v > -12345, ["v"]),
+                 P.aggregate(["k"], [("v", "sum")], 128)])
+    outofcore.execute_file(data, pl, morsel_rows=8)   # cold: compiles
+    cold = len(_plan_compiles(pl.fp8))
+    # every morsel size lands on the pow-2 grid: O(log N) programs
+    buckets = {shapes.bucket_rows(n) for n in range(1, 65)}
+    assert 0 < cold <= len(buckets)
+    obs.clear()
+    outofcore.execute_file(data, pl, morsel_rows=8)   # warm: zero
+    assert len(_plan_compiles(pl.fp8)) == 0
+
+
+# ---------------------------------------------------------------------------
+# Metrics / healthz / span lane
+# ---------------------------------------------------------------------------
+
+def test_counters_and_healthz(monkeypatch):
+    before = outofcore.counters()
+    data = _file(40, seed=16)
+    outofcore.execute_file(data, _agg_sum(), morsel_rows=8)
+    after = outofcore.counters()
+    assert after["morsels"] > before.get("morsels", 0)
+    assert after["bytes_streamed"] > before.get("bytes_streamed", 0)
+    doc = exporter._healthz()["outofcore"]
+    assert doc["enabled"] is True
+    assert doc["morsels"] == after["morsels"]
+    assert doc["last"]["mode"] in ("ooc", "whole-table")
+
+
+def test_morsel_spans_form_perfetto_lane(obs_on):
+    data = _file(40, seed=17)
+    outofcore.execute_file(data, _agg_sum(), morsel_rows=8)
+    lanes = [e for e in obs.events(kind="span")
+             if e["name"] == "ooc.morsel"]
+    assert len(lanes) >= 2                 # one span per morsel
+    assert all("rows" in e and "morsel" in e for e in lanes)
+
+
+def test_prefetch_gauge_returns_to_zero_after_stream():
+    data = _file(40, seed=18)
+    outofcore.execute_file(data, _agg_sum(), morsel_rows=8)
+    fam = metrics.registry().snapshot().get(
+        "srj_tpu_prefetch_queue_depth") or {}
+    assert sum((fam.get("values") or {}).values()) == 0

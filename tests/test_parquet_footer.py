@@ -367,6 +367,101 @@ class TestCrossImpl:
         fp.close()
 
 
+# ---------------------------------------------------------------------------
+# filter_groups part boundaries (the HMerge/CPU-parse equivalence
+# contract: keep iff part_offset <= group midpoint < part_offset +
+# part_length, on both engines)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("engine", ENGINES)
+class TestPartBoundaries:
+    def _rows(self, raw, off, length, engine):
+        with read_and_filter(raw, off, length, select("a"),
+                             engine=engine) as f:
+            return f.num_rows()
+
+    def test_group_midpoint_exactly_at_part_end(self, engine):
+        # one group [4, 104): midpoint 54.  The keep rule is half-open —
+        # a midpoint landing EXACTLY on part_offset+part_length belongs
+        # to the NEXT part, never to both and never to neither.
+        raw = write_struct(flat_footer(["a"], rows_per_group=(100,)))
+        assert self._rows(raw, 0, 54, engine) == 0     # mid == end: out
+        assert self._rows(raw, 0, 55, engine) == 100   # mid < end: in
+        assert self._rows(raw, 54, 50, engine) == 100  # mid == off: in
+        assert self._rows(raw, 55, 50, engine) == 0    # mid < off: out
+
+    def test_adjacent_parts_cover_each_group_once(self, engine):
+        # groups [4,104) mid 54 and [104,204) mid 154: any split point
+        # assigns every group to exactly one of the two adjacent parts
+        raw = write_struct(flat_footer(["a", "b"],
+                                       rows_per_group=(100, 200)))
+        total = 404
+        for cut in (0, 1, 54, 55, 154, 155, 204, total):
+            left = self._rows(raw, 0, cut, engine)
+            right = self._rows(raw, cut, total - cut, engine)
+            assert left + right == 300, cut
+
+    def test_zero_row_zero_byte_group(self, engine):
+        # a zero-byte group's midpoint IS its start offset; it must ride
+        # with the part containing that offset and contribute 0 rows
+        g1 = row_group([chunk(4, 100)], 100, total_compressed=100)
+        gz = row_group([chunk(104, 0)], 0, total_compressed=0)
+        g2 = row_group([chunk(104, 100)], 50, total_compressed=100)
+        raw = write_struct(file_meta([se("root", num_children=1),
+                                      se("a", ptype=2)], [g1, gz, g2]))
+        assert self._rows(raw, 0, 104, engine) == 100      # g1 only
+        assert self._rows(raw, 104, 100, engine) == 50     # gz + g2
+        assert self._rows(raw, 0, 1 << 40, engine) == 150
+        with read_and_filter(raw, 104, 100, select("a"),
+                             engine=engine) as f:
+            kept = (f._py.meta.at(FMD_ROW_GROUPS).elems
+                    if engine == "python" else None)
+            if kept is not None:
+                assert [g.at(RG_NUM_ROWS) for g in kept] == [0, 50]
+
+    def test_single_group_file_all_or_nothing(self, engine):
+        raw = write_struct(flat_footer(["a"], rows_per_group=(73,)))
+        # midpoint 54: every part either owns the whole file or none
+        assert self._rows(raw, 0, 1 << 40, engine) == 73
+        assert self._rows(raw, 0, 4, engine) == 0
+        assert self._rows(raw, 104, 1000, engine) == 0
+        covered = sum(self._rows(raw, off, 20, engine)
+                      for off in range(0, 120, 20))
+        assert covered == 73  # disjoint tiling finds it exactly once
+
+
+@pytest.mark.skipif(not NATIVE_AVAILABLE, reason="native lib not built")
+def test_part_boundary_parity_sweep():
+    """Property sweep pinning python/native parity on the exact
+    boundary offsets (group start, midpoint, end, and +/-1 around
+    each), including zero-row and single-group footers."""
+    rng = np.random.default_rng(11)
+    for trial in range(20):
+        ngroups = int(rng.integers(1, 5))
+        rows = [int(rng.integers(0, 500)) for _ in range(ngroups)]
+        meta = flat_footer(["a", "b"], rows_per_group=tuple(rows))
+        raw = write_struct(meta)
+        edges = {0, 4}
+        off = 4
+        for _ in range(ngroups):
+            size = 200  # two 100-byte chunks per group
+            for e in (off, off + size // 2, off + size):
+                edges |= {max(0, e - 1), e, e + 1}
+            off += size
+        for part_off in sorted(edges):
+            for part_len in (1, 50, 100, 199, 200, 201, 1 << 40):
+                fn = read_and_filter(raw, part_off, part_len,
+                                     select("a"), engine="native")
+                fp = read_and_filter(raw, part_off, part_len,
+                                     select("a"), engine="python")
+                key = (trial, part_off, part_len)
+                assert fn.num_rows() == fp.num_rows(), key
+                assert fn.serialize_thrift_file() == \
+                    fp.serialize_thrift_file(), key
+                fn.close()
+                fp.close()
+
+
 def test_handle_debug_tracks_leaks(monkeypatch):
     """SRJ_HANDLE_DEBUG tracks open native handles (the refcount-debug
     analogue, reference pom.xml:87,489); close() clears the record."""

@@ -1,0 +1,214 @@
+"""Parquet scan layer tests (``parquet/scan.py``): writer/reader
+roundtrip across dtypes, row-group sizes and null patterns; footer
+column projection + partition-split parity; min/max statistics pruning
+(including the no-stats-keep rule); and the RLE/bit-packed definition
+level decoder against both encodings."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import parquet as parquet_pkg
+from spark_rapids_jni_tpu.parquet import scan
+from spark_rapids_jni_tpu.parquet import pyfooter
+
+
+def _table(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "a": rng.integers(-100, 100, n).astype(np.int32),
+        "b": rng.integers(-10**12, 10**12, n).astype(np.int64),
+        "c": rng.standard_normal(n).astype(np.float32),
+        "d": rng.standard_normal(n).astype(np.float64),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Roundtrip
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n,rg", [(0, 4), (1, 4), (7, 3), (8, 8),
+                                  (9, 4), (33, 32), (100, 9),
+                                  (64, 1 << 20)])
+def test_roundtrip_all_dtypes(n, rg):
+    cols = _table(n, seed=n)
+    data = scan.write_table(cols, row_group_rows=rg)
+    table = scan.read_table(data)
+    assert set(table) == set(cols)
+    for name, arr in cols.items():
+        vals, validity = table[name]
+        assert vals.dtype == arr.dtype
+        assert np.array_equal(vals, arr)
+        assert validity is None  # REQUIRED columns carry no levels
+
+
+@pytest.mark.parametrize("pattern", ["none", "alternate", "all",
+                                     "edges"])
+def test_roundtrip_validity(pattern):
+    n = 41
+    cols = _table(n, seed=3)
+    valid = {
+        "none": np.ones(n, bool),
+        "alternate": np.arange(n) % 2 == 0,
+        "all": np.zeros(n, bool),
+        "edges": np.r_[False, np.ones(n - 2, bool), False],
+    }[pattern]
+    data = scan.write_table(cols, row_group_rows=7,
+                            validity={"b": valid, "c": valid})
+    table = scan.read_table(data)
+    for name in ("b", "c"):
+        vals, validity = table[name]
+        assert validity is not None
+        assert np.array_equal(validity, valid)
+        assert np.array_equal(vals[valid], cols[name][valid])
+        # dead slots decode to zero-fill, never garbage
+        assert np.all(vals[~valid] == 0)
+    for name in ("a", "d"):
+        vals, validity = table[name]
+        assert validity is None
+        assert np.array_equal(vals, cols[name])
+
+
+def test_row_group_layout():
+    data = scan.write_table(_table(100), row_group_rows=17)
+    footer = scan.parse_footer(data)
+    rows = scan.group_num_rows(footer)
+    assert rows == [17, 17, 17, 17, 17, 15]
+    assert all(scan.group_byte_size(footer, i) > 0
+               for i in range(len(rows)))
+    # per-group reads concatenate to the whole table
+    parts = [scan.read_group(data, footer, g) for g in range(len(rows))]
+    whole = scan.read_table(data)
+    for name in whole:
+        got = np.concatenate([p[name][0] for p in parts])
+        assert np.array_equal(got, whole[name][0])
+
+
+def test_empty_table_single_zero_row_group():
+    data = scan.write_table({"a": np.zeros(0, np.int32)})
+    footer = scan.parse_footer(data)
+    assert scan.group_num_rows(footer) == [0]
+    vals, validity = scan.read_table(data)["a"]
+    assert vals.shape == (0,) and vals.dtype == np.int32
+
+
+def test_schema_leaves_and_unsupported_dtype():
+    data = scan.write_table(_table(5))
+    leaves = scan.schema_leaves(scan.parse_footer(data))
+    assert [l[0] for l in leaves] == ["a", "b", "c", "d"]
+    with pytest.raises(ValueError):
+        scan.write_table({"x": np.zeros(3, np.int16)})
+
+
+# ---------------------------------------------------------------------------
+# Projection + partition split
+# ---------------------------------------------------------------------------
+
+def test_prune_footer_projects_columns():
+    data = scan.write_table(_table(50), row_group_rows=9)
+    footer = scan.prune_footer(data, ["d", "a"])
+    names = [l[0] for l in scan.schema_leaves(footer)]
+    assert sorted(names) == ["a", "d"]
+    table = scan.read_table(data, footer)
+    whole = scan.read_table(data)
+    for name in names:
+        assert np.array_equal(table[name][0], whole[name][0])
+
+
+def test_prune_footer_partition_split_covers_exactly():
+    data = scan.write_table(_table(80), row_group_rows=9)
+    total = len(scan.group_num_rows(scan.parse_footer(data)))
+    mid = len(data) // 2
+    f0 = scan.prune_footer(data, ["a"], 0, mid)
+    f1 = scan.prune_footer(data, ["a"], mid, len(data) - mid)
+    n0, n1 = len(scan.group_num_rows(f0)), len(scan.group_num_rows(f1))
+    assert n0 + n1 == total and n0 > 0 and n1 > 0
+    got = np.concatenate([scan.read_table(data, f0)["a"][0],
+                          scan.read_table(data, f1)["a"][0]])
+    assert np.array_equal(got, scan.read_table(data)["a"][0])
+
+
+def test_serialize_pruned_footer_reparses():
+    data = scan.write_table(_table(30), row_group_rows=7)
+    footer = scan.prune_footer(data, ["b"])
+    blob = footer.serialize_file()
+    again = pyfooter.PyFooter.parse(parquet_pkg._strip_framing(blob))
+    assert scan.group_num_rows(again) == scan.group_num_rows(footer)
+    vals, _ = scan.read_group(data, again, 0)["b"]
+    assert np.array_equal(vals, scan.read_table(data)["b"][0][:7])
+
+
+# ---------------------------------------------------------------------------
+# Statistics pruning
+# ---------------------------------------------------------------------------
+
+def test_stats_prune_drops_only_impossible_groups():
+    # sorted column -> group min/max ranges are disjoint windows
+    a = np.arange(100, dtype=np.int32)
+    data = scan.write_table({"a": a}, row_group_rows=10)
+    footer = scan.prune_footer(data, ["a"])
+    dropped = scan.prune_groups_by_stats(footer, [("a", ">", 74)])
+    assert dropped == 7  # groups [0..9] .. [60..69] cannot satisfy
+    vals, _ = scan.read_table(data, footer)["a"]
+    assert np.array_equal(vals[vals > 74], a[a > 74])
+
+
+@pytest.mark.parametrize("op,lit,survivors", [
+    ("<", 10, 1), ("<=", 10, 2), (">", 89, 1), (">=", 89, 2),
+    ("==", 55, 1), ("!=", 55, 10), ("<", -1, 0), (">", 1000, 0),
+])
+def test_stats_prune_operator_matrix(op, lit, survivors):
+    a = np.arange(100, dtype=np.int32)
+    data = scan.write_table({"a": a}, row_group_rows=10)
+    footer = scan.prune_footer(data, ["a"])
+    scan.prune_groups_by_stats(footer, [(("a"), op, lit)])
+    assert len(scan.group_num_rows(footer)) == survivors
+
+
+def test_stats_prune_keeps_groups_without_stats():
+    # an all-null chunk writes no min/max -> the group must survive any
+    # predicate on that column (prune only on proof)
+    n = 20
+    data = scan.write_table({"a": np.arange(n, dtype=np.int32)},
+                            row_group_rows=10,
+                            validity={"a": np.zeros(n, bool)})
+    footer = scan.prune_footer(data, ["a"])
+    assert scan.prune_groups_by_stats(footer, [("a", ">", 10**6)]) == 0
+    assert len(scan.group_num_rows(footer)) == 2
+
+
+def test_stats_prune_unknown_column_is_noop():
+    data = scan.write_table({"a": np.arange(9, dtype=np.int32)},
+                            row_group_rows=3)
+    footer = scan.prune_footer(data, ["a"])
+    assert scan.prune_groups_by_stats(footer,
+                                      [("nope", ">", 0)]) == 0
+    assert len(scan.group_num_rows(footer)) == 3
+
+
+# ---------------------------------------------------------------------------
+# Definition-level codec
+# ---------------------------------------------------------------------------
+
+def test_rle_roundtrip_runs():
+    for levels in ([], [1], [0], [1] * 9, [0] * 5 + [1] * 11,
+                   [1, 0] * 17, [0, 0, 1] * 13):
+        buf = scan._rle_encode_bits(list(levels))
+        got, consumed = scan._rle_decode_bits(buf, 0, len(levels))
+        assert list(got) == list(levels)
+        assert consumed == len(buf)
+
+
+def test_rle_decode_bit_packed_group():
+    # foreign writers may emit bit-packed groups instead of RLE runs:
+    # header (num_groups << 1) | 1, then num_groups bytes of 8 levels
+    # LSB-first
+    levels = [1, 0, 1, 1, 0, 0, 1, 0, 1, 1, 1, 0, 0, 0, 0, 1]
+    packed = bytes([
+        sum(b << i for i, b in enumerate(levels[0:8])),
+        sum(b << i for i, b in enumerate(levels[8:16])),
+    ])
+    body = bytes([(2 << 1) | 1]) + packed
+    buf = len(body).to_bytes(4, "little") + body
+    got, consumed = scan._rle_decode_bits(buf, 0, len(levels))
+    assert list(got) == levels
+    assert consumed == len(buf)

@@ -429,6 +429,59 @@ def test_prefetcher_rejects_bad_depth():
         staging.Prefetcher([1], lambda x: x, depth=0)
 
 
+def _qdepth():
+    from spark_rapids_jni_tpu.obs import metrics as _metrics
+    fam = _metrics.registry().snapshot().get(
+        "srj_tpu_prefetch_queue_depth") or {}
+    return sum((fam.get("values") or {}).values())
+
+
+def test_prefetcher_drain_on_close_zeroes_gauge_and_releases_refs():
+    # Abandoning a half-consumed stream must (a) return the queue-depth
+    # gauge to zero and (b) release every staged blob parked in the
+    # queue — a serving loop cancelling queries would otherwise pin
+    # arena blocks until GC happens to run.
+    import gc
+    import weakref
+
+    class Blob:
+        pass
+
+    refs = []
+
+    def stage(i):
+        b = Blob()
+        refs.append(weakref.ref(b))
+        return b
+
+    before = len(_prefetch_threads())
+    pf = staging.Prefetcher(range(12), stage, depth=3)
+    got = [next(pf) for _ in range(3)]  # half-consume
+    assert _qdepth() > 0                # worker staged ahead
+    del got
+    pf.close()
+    assert _qdepth() == 0
+    assert len(_prefetch_threads()) == before  # worker joined
+    gc.collect()
+    assert refs and all(r() is None for r in refs)
+
+
+def test_prefetcher_close_never_iterated_zeroes_gauge():
+    # a never-started generator's finally never runs — close() must
+    # still leave the gauge at zero (and not hang joining the worker)
+    pf = staging.Prefetcher(range(5), lambda i: i, depth=2)
+    pf.close()
+    assert _qdepth() == 0
+
+
+def test_prefetch_generator_abandon_zeroes_gauge():
+    gen = staging.prefetch(range(8), lambda i: i, depth=2)
+    assert next(gen) == 0
+    assert _qdepth() > 0
+    gen.close()
+    assert _qdepth() == 0
+
+
 # ---------------------------------------------------------------------------
 # Observability attributes
 # ---------------------------------------------------------------------------
