@@ -969,16 +969,27 @@ PY
 # reference), the successor comes up ready WARM (>0 shipped-cache hits,
 # strictly fewer backend compiles than the coldest cold start), and a
 # breaker forced open on one survivor shows up in the other survivor's
-# gossip-imported state.
+# gossip-imported state.  The observability plane rides the same fleet:
+# the burst runs under ONE caller trace context (the router propagates
+# it on the wire), the federated /metrics/fleet scrape must show
+# replica-labeled families plus the srj_tpu_fleet_* rollup, a poisoned
+# request fired at two replicas must land correlated recorder bundles,
+# and afterwards `obs fleet` must render the merged trace with
+# cross-process flow pairs (checked below against the Perfetto schema)
 FLEET_DIR=$(mktemp -d /tmp/srj_fleet_smoke.XXXXXX)
+mkdir -p "$FLEET_DIR/events"
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   SRJ_TPU_FLEET_SMOKE_DIR="$FLEET_DIR" \
+  SRJ_TPU_EVENTS="$FLEET_DIR/events/replica-router.jsonl" \
   python - <<'PY'
-import os, time
+import json, os, time, urllib.request
 import numpy as np
-from spark_rapids_jni_tpu import serve
+from spark_rapids_jni_tpu import obs, serve
+from spark_rapids_jni_tpu.obs import context, exporter, federation
 from spark_rapids_jni_tpu.runtime import shapes
 from spark_rapids_jni_tpu.serve import chaos, fleet, router
+
+obs.enable(os.environ["SRJ_TPU_EVENTS"])
 
 sizes = (100, 900)
 sup = fleet.Supervisor(
@@ -1007,13 +1018,15 @@ rt = router.Router(supervisor=sup, health_ttl_s=0.1)
 victim = rt._candidates("agg", shapes.bucket_rows(sizes[0]), [])[0][0]
 harness = chaos.ChaosHarness(sup, f"0.3:kill:{victim}").start()
 
+burst_ctx = context.root(tenant="burst")   # ONE fleet-wide trace
 futs = []
-for i in range(32):
-    size = sizes[i % 2]
-    k, v = payload(size, size)
-    futs.append((size, rt.aggregate(k, v, deadline_s=120,
-                                    tenant=f"t{i % 4}")))
-    time.sleep(0.03)
+with context.activate(burst_ctx):
+    for i in range(32):
+        size = sizes[i % 2]
+        k, v = payload(size, size)
+        futs.append((size, rt.aggregate(k, v, deadline_s=120,
+                                        tenant=f"t{i % 4}")))
+        time.sleep(0.03)
 wrong = lost = 0
 for size, f in futs:
     out = f.result(240)
@@ -1053,12 +1066,89 @@ while time.time() < deadline:
         seen = True
         break
     time.sleep(0.25)
-rt.close(); sup.stop()
 assert seen, f"breaker {cell} never gossiped to replica {survivors[1]}"
+
+# federated /metrics: replica-labeled families + fleet rollup, served
+# from the supervisor-process exporter over a real socket
+fed = sup.federation
+assert fed is not None, "federation must be on by default"
+fed.scrape_now()
+port = exporter.start(0)
+assert port, "exporter failed to bind"
+body = urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/metrics/fleet", timeout=10).read().decode()
+assert 'srj_tpu_serve_requests_total{replica="' in body, body[:600]
+assert "srj_tpu_fleet_requests_total" in body
+assert 'srj_tpu_fleet_replica_ready{replica="' in body
+hz = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+assert hz["fleet_federation"]["ready_count"] == 3, hz["fleet_federation"]
+exporter.stop()
+
+# incident correlation: the same poisoned request (one trace doc, two
+# attempts) fired at two replicas leaves a bundle in each diag dir
+inc = context.root(tenant="incident")
+for n, rid in enumerate(survivors[:2]):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{sup.endpoints()[rid]}/v1/submit",
+        data=json.dumps({
+            "key": "ci-incident", "tenant": "incident",
+            "op": "nosuchop", "kwargs": {}, "attempt": n,
+            "trace": {"trace_id": inc.trace_id, "span_id": inc.span_id,
+                      "tenant": "incident"}}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    assert not json.loads(
+        urllib.request.urlopen(req, timeout=30).read()).get("ok")
+cross = federation.correlated_incidents(sup.fleet_dir)
+reps = {d["replica"] for d in cross.get(inc.trace_id, ())}
+assert len(reps) >= 2, (sorted(cross), reps)
+
+rt.close(); sup.stop()
 print(f"fleet smoke: {len(futs)} requests through kill of replica "
       f"{victim}, 0 lost 0 wrong; successor warm "
       f"(hits={repl['cache_hits']}, backend={repl['backend_compiles']} "
       f"< cold={coldest}); breaker gossiped "
-      f"{survivors[0]} -> {survivors[1]}")
+      f"{survivors[0]} -> {survivors[1]}; federated scrape + "
+      f"cross-replica incident on replicas {sorted(reps)}")
 PY
-rm -rf "$FLEET_DIR"
+# the `obs fleet` CLI digests the fleet dir the smoke left behind: the
+# merged timeline must show the burst's ONE trace spanning multiple
+# replica logs, the incident story must stay cross-replica, and the
+# merged Perfetto trace must pass the schema check with >= 1
+# cross-process flow pair joining the router lane to a replica lane
+FLEET_JSON=$(mktemp /tmp/srj_fleet_smoke.XXXXXX.json)
+FLEET_TRACE=$(mktemp /tmp/srj_fleet_smoke.XXXXXX.trace.json)
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python -m spark_rapids_jni_tpu.obs fleet --fleet-dir "$FLEET_DIR" \
+  --trace "$FLEET_TRACE" --json > "$FLEET_JSON"
+python - "$FLEET_JSON" "$FLEET_TRACE" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert doc["events"] > 0 and len(doc["events_by_replica"]) >= 3, doc
+assert doc["cross_replica_traces"], "no trace spans multiple replicas"
+cross = doc["cross_replica_incidents"]
+assert cross and any(
+    len({d["replica"] for d in docs}) >= 2 for docs in cross.values()), \
+    "incident index never correlated bundles across replicas"
+
+trace = json.load(open(sys.argv[2]))
+assert set(trace) == {"traceEvents", "displayTimeUnit"}, set(trace)
+evs = trace["traceEvents"]
+bad = [e for e in evs
+       if e["ph"] not in ("M", "B", "E", "X", "C", "s", "f", "i")]
+assert not bad, f"illegal phases: {sorted({e['ph'] for e in bad})}"
+rpc = [e for e in evs if e.get("cat") == "srj.flow"
+       and e.get("name") == "rpc"]
+ss = {e["id"]: e for e in rpc if e["ph"] == "s"}
+fs = {e["id"]: e for e in rpc if e["ph"] == "f"}
+assert ss and set(ss) == set(fs), "unpaired rpc flow arrows"
+assert all(fs[i]["bp"] == "e" and fs[i]["pid"] != s["pid"]
+           and fs[i]["ts"] >= s["ts"] for i, s in ss.items())
+lanes = {e["args"]["name"] for e in evs
+         if e["ph"] == "M" and e["name"] == "process_name"}
+assert sum(1 for p in lanes if p.startswith("replica:")) >= 3, lanes
+print(f"fleet obs smoke: {doc['events']} merged events, "
+      f"{len(doc['cross_replica_traces'])} cross-replica trace(s), "
+      f"{len(ss)} rpc flow pair(s) across lanes {sorted(lanes)}")
+PY
+rm -rf "$FLEET_DIR" "$FLEET_JSON" "$FLEET_TRACE"

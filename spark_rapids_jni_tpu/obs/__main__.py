@@ -1,7 +1,9 @@
 """CLI entry: ``python -m spark_rapids_jni_tpu.obs <events.jsonl>``
 (report), ``python -m spark_rapids_jni_tpu.obs profile <events.jsonl>``
-(roofline attribution) or ``python -m spark_rapids_jni_tpu.obs explain
-[plan] [--analyze]`` (plan tree with measured runtime statistics)."""
+(roofline attribution), ``python -m spark_rapids_jni_tpu.obs explain
+[plan] [--analyze]`` (plan tree with measured runtime statistics) or
+``python -m spark_rapids_jni_tpu.obs fleet --fleet-dir DIR`` (merged
+fleet timeline, federation snapshot, cross-replica incidents)."""
 
 import sys
 
@@ -15,6 +17,11 @@ if argv and argv[0] == "explain":
     from spark_rapids_jni_tpu.obs.planstats import explain_main
 
     sys.exit(explain_main(argv[1:]))
+
+if argv and argv[0] == "fleet":
+    from spark_rapids_jni_tpu.obs.federation import fleet_main
+
+    sys.exit(fleet_main(argv[1:]))
 
 from spark_rapids_jni_tpu.obs.report import main
 

@@ -43,7 +43,8 @@ from typing import Optional
 
 __all__ = [
     "TraceContext", "new_id", "root", "current", "capture", "activate",
-    "run_with", "wrap", "set_host", "host_id",
+    "run_with", "wrap", "set_host", "host_id", "set_replica",
+    "replica_id",
 ]
 
 
@@ -174,3 +175,36 @@ def host_id() -> int:
     except Exception:
         _HOST = 0
     return _HOST
+
+
+# ---------------------------------------------------------------------------
+# Replica lane id (fleet trace merging)
+# ---------------------------------------------------------------------------
+#
+# A single-host fleet (serve.fleet) runs N replica *processes* that all
+# share one host id, so ``host`` alone cannot tell their events apart —
+# the replica id is the second lane-key component.  ``None`` (the
+# common non-fleet case) means "no replica dimension": events carry no
+# ``replica`` stamp and the trace converter keys lanes on host alone.
+
+_REPLICA: Optional[str] = None
+_REPLICA_RESOLVED = False
+
+
+def set_replica(replica) -> None:
+    """Pin this process's fleet replica id (``serve.replica`` calls this
+    with its ``--id`` at startup); ``None`` unpins."""
+    global _REPLICA, _REPLICA_RESOLVED
+    _REPLICA = None if replica is None else str(replica)
+    _REPLICA_RESOLVED = True
+
+
+def replica_id() -> Optional[str]:
+    """This process's fleet replica id, or ``None`` outside a fleet:
+    pinned :func:`set_replica` value -> ``SRJ_TPU_FLEET_ID`` env ->
+    None, resolved once."""
+    global _REPLICA, _REPLICA_RESOLVED
+    if not _REPLICA_RESOLVED:
+        _REPLICA = os.environ.get("SRJ_TPU_FLEET_ID") or None
+        _REPLICA_RESOLVED = True
+    return _REPLICA

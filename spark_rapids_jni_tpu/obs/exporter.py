@@ -145,8 +145,11 @@ def register_route(method: str, path: str, fn) -> None:
     ``fn(query: dict, body: bytes) -> (status: int, doc)`` where ``doc``
     is JSON-serialized for the response body (a serve replica mounts its
     ``POST /v1/submit`` and ``POST /chaos`` handlers here, so one port
-    per process carries metrics, health, and traffic).  A handler that
-    raises answers 500 without taking down the server."""
+    per process carries metrics, health, and traffic).  A ``doc`` that
+    is already ``str``/``bytes`` is served verbatim as a Prometheus
+    text exposition instead (how ``obs.federation`` mounts the fleet
+    ``GET /metrics/fleet``).  A handler that raises answers 500 without
+    taking down the server."""
     with _PROVIDERS_LOCK:
         _ROUTES[(method.upper(), path)] = fn
 
@@ -225,17 +228,26 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def _dispatch_route(self, fn, parts) -> None:
+        ctype = "application/json"
         try:
             n = int(self.headers.get("Content-Length") or 0)
             payload = self.rfile.read(n) if n else b""
             query = {k: v[-1] for k, v in parse_qs(parts.query).items()}
             code, doc = fn(query, payload)
-            body = (json.dumps(doc, default=str) + "\n").encode("utf-8")
+            if isinstance(doc, (str, bytes)):
+                # text routes (a federated metrics exposition) are
+                # served verbatim, not JSON-wrapped
+                body = doc.encode("utf-8") if isinstance(doc, str) \
+                    else doc
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            else:
+                body = (json.dumps(doc, default=str) + "\n"
+                        ).encode("utf-8")
         except Exception as e:  # a sick handler must not kill the server
             code = 500
             body = (json.dumps(
                 {"error": f"{type(e).__name__}: {e}"}) + "\n").encode()
-        self._respond(code, body, "application/json")
+        self._respond(code, body, ctype)
 
     def do_GET(self):  # noqa: N802 (http.server API)
         parts = urlsplit(self.path)

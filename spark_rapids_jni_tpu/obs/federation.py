@@ -1,0 +1,785 @@
+"""Fleet metrics federation + cross-replica incident correlation.
+
+The supervisor-side half of the fleet observability plane.  A serving
+fleet (:mod:`serve.fleet`) is N replica processes, each exporting its
+own ``/metrics`` and ``/healthz`` — N disconnected registries.  This
+module merges them, Prometheus-federation style, on the existing stdlib
+exporter:
+
+**Federator.**  A timer thread scrapes every live replica's
+``/metrics`` (text exposition, parsed by :func:`parse_exposition`) and
+``/healthz`` every ``SRJ_TPU_FLEET_FED_MS`` (default: the supervisor's
+heartbeat).  The merged *fleet exposition* is served from the
+supervisor's own exporter at ``GET /metrics/fleet``:
+
+- every replica family re-exported with a ``replica`` label
+  (``srj_tpu_serve_requests_total{replica="1",tenant="t0",op="agg"}``),
+  so one scrape sees the whole fleet without N scrape targets;
+- ``srj_tpu_fleet_*`` rollup families merged across replicas —
+  counter *sums* (``srj_tpu_fleet_requests_total`` equals the sum of
+  the individual replica scrapes, per (tenant, op) and folded per op),
+  gauge *min/max* (``srj_tpu_fleet_headroom_worst_bytes`` is the
+  fleet's tightest memory), open-state *counts*
+  (``srj_tpu_fleet_breakers_open`` counts open cells anywhere), a
+  fleet QPS rate over the scrape interval, and fleet-level SLO burn
+  recomputed from the *merged* ``srj_tpu_slo_events_total`` rates —
+  not an average of per-replica burns.
+
+A fleet ``/healthz`` rollup (health provider ``fleet_federation``)
+carries the ready count, the degraded replica list, and per-replica
+gossip ages with a ``gossip_stale`` warning once a peer's export
+exceeds 3 missed gossip timers.  Each round also persists
+``<fleet_dir>/FEDERATION.json`` (atomic replace) so offline tooling —
+``python -m spark_rapids_jni_tpu.obs fleet`` — can render the last
+federation snapshot after the fleet is gone.
+
+**Incident correlation.**  Replicas run with per-replica diag dirs
+(``<fleet_dir>/diag/replica-<n>``; :mod:`obs.recorder` bundles stamp
+``replica`` and trace ids into ``repro.json``).  :func:`incident_index`
+scans them and groups bundles by the trace ids they touched — a
+failed-over request that errored on two replicas shows up as ONE
+incident naming both bundles.  The ``obs fleet`` CLI renders the merged
+timeline (per-replica event logs → one Perfetto trace via
+:mod:`obs.trace`'s (host, replica) lanes), the federation snapshot, and
+that incident story.
+
+Kill switch: ``SRJ_TPU_FLEET_FEDERATION=0`` — the supervisor starts no
+Federator and behavior is exactly the per-replica-only plane of PR 17.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import threading
+import time
+import urllib.request
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "parse_exposition", "merge_samples", "Federator", "incident_index",
+    "fleet_main",
+]
+
+
+# ---------------------------------------------------------------------------
+# Exposition parsing (the scrape side of federation)
+# ---------------------------------------------------------------------------
+
+_LABELS_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(v: str) -> str:
+    return (v.replace(r"\n", "\n").replace(r"\"", '"')
+            .replace("\\\\", "\\"))
+
+
+def parse_exposition(text: str) -> List[Tuple[str, str, str, List]]:
+    """Parse a Prometheus text exposition into the same
+    ``(name, kind, help, samples)`` family tuples
+    :func:`obs.metrics.format_exposition` renders (samples are
+    ``(sample_name, labels_dict, value)``) — so a scraped replica
+    exposition round-trips straight back through the shared
+    serializer.  Tolerant: unparseable lines are skipped, samples with
+    no preceding ``# TYPE`` open an ``untyped`` family."""
+    fams: List[Tuple[str, str, str, List]] = []
+    by_name: Dict[str, int] = {}
+    helps: Dict[str, str] = {}
+    cur: Optional[str] = None
+
+    def family(name: str, kind: str = "untyped") -> int:
+        idx = by_name.get(name)
+        if idx is None:
+            idx = len(fams)
+            fams.append((name, kind, helps.get(name, ""), []))
+            by_name[name] = idx
+        return idx
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP "):].split(None, 1)
+            if rest:
+                helps[rest[0]] = rest[1] if len(rest) > 1 else ""
+            continue
+        if line.startswith("# TYPE "):
+            rest = line[len("# TYPE "):].split()
+            if len(rest) >= 2:
+                cur = rest[0]
+                family(cur, rest[1])
+            continue
+        if line.startswith("#"):
+            continue
+        # sample line: name[{labels}] value
+        brace = line.find("{")
+        labels: Dict[str, str] = {}
+        if brace >= 0:
+            close = line.rfind("}")
+            if close < brace:
+                continue
+            sname = line[:brace]
+            for m in _LABELS_RE.finditer(line[brace + 1:close]):
+                labels[m.group(1)] = _unescape(m.group(2))
+            rest = line[close + 1:].strip()
+        else:
+            parts = line.split()
+            if len(parts) < 2:
+                continue
+            sname, rest = parts[0], " ".join(parts[1:])
+        try:
+            value = float(rest.split()[0])
+        except (ValueError, IndexError):
+            continue
+        # histogram/summary child samples (`foo_bucket`, `foo_sum`, …)
+        # attach to the open `foo` family; anything else is its own
+        if cur is not None and (sname == cur
+                                or sname.startswith(cur + "_")):
+            fams[by_name[cur]][3].append((sname, labels, value))
+        else:
+            fams[family(sname)][3].append((sname, labels, value))
+    return fams
+
+
+def _find(families: Iterable[Tuple], name: str) -> Optional[Tuple]:
+    for fam in families:
+        if fam[0] == name:
+            return fam
+    return None
+
+
+def merge_samples(per_replica: Dict[str, List[Tuple]], name: str,
+                  agg: str = "sum", fold: Tuple[str, ...] = ()
+                  ) -> List[Tuple[Dict[str, str], float]]:
+    """Merge one family across replica expositions: samples named
+    exactly ``name`` are grouped by their labels **minus** the folded
+    ones and combined with ``agg`` (``sum`` for counters, ``max`` /
+    ``min`` for gauges, ``count_open`` counts samples whose value is
+    1.0).  Returns ``[(labels, value)]`` sorted by labels — the
+    deterministic merge-math the federation rollups (and their unit
+    tests) are built on."""
+    groups: Dict[Tuple, Tuple[Dict[str, str], List[float]]] = {}
+    for _rid, fams in sorted(per_replica.items()):
+        fam = _find(fams, name)
+        if fam is None:
+            continue
+        for sname, labels, value in fam[3]:
+            if sname != name:
+                continue
+            kept = {k: v for k, v in sorted(labels.items())
+                    if k not in fold and k != "replica"}
+            key = tuple(kept.items())
+            groups.setdefault(key, (kept, []))[1].append(float(value))
+    out: List[Tuple[Dict[str, str], float]] = []
+    for key in sorted(groups):
+        kept, vals = groups[key]
+        if agg == "sum":
+            v = sum(vals)
+        elif agg == "max":
+            v = max(vals)
+        elif agg == "min":
+            v = min(vals)
+        elif agg == "count_open":
+            v = float(sum(1 for x in vals if x == 1.0))
+        else:
+            raise ValueError(f"unknown agg {agg!r}")
+        out.append((kept, v))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The supervisor-side federator
+# ---------------------------------------------------------------------------
+
+def _fam():
+    from spark_rapids_jni_tpu.obs import metrics as m
+    return {
+        "scrapes": m.counter(
+            "srj_tpu_fleet_federation_scrapes_total",
+            "Federation scrape attempts, by replica and outcome.",
+            ("replica", "status")),
+        "age": m.gauge(
+            "srj_tpu_fleet_federation_age_seconds",
+            "Seconds since the last successful federation round."),
+    }
+
+
+class Federator:
+    """Scrape-and-merge aggregator over a :class:`serve.fleet.Supervisor`
+    (anything with ``endpoints() -> {rid: port}``, ``healthz(rid)`` and
+    a ``fleet_dir``).  :meth:`start` registers ``GET /metrics/fleet``
+    on the supervisor process's exporter and begins the timer;
+    :meth:`scrape_now` runs one synchronous round (tests call this to
+    avoid timing races)."""
+
+    def __init__(self, supervisor, period_ms: Optional[float] = None,
+                 host: Optional[str] = None):
+        self._sup = supervisor
+        if period_ms is None:
+            try:
+                period_ms = float(
+                    os.environ.get("SRJ_TPU_FLEET_FED_MS", "") or 0)
+            except ValueError:
+                period_ms = 0
+            if not period_ms:
+                period_ms = getattr(supervisor, "heartbeat_s", 0.5) * 1e3
+        self.period_s = max(0.05, float(period_ms) / 1e3)
+        self.host = host or getattr(supervisor, "host", "127.0.0.1")
+        self.fleet_dir = getattr(supervisor, "fleet_dir", ".")
+        try:
+            gossip_ms = float(
+                os.environ.get("SRJ_TPU_FLEET_GOSSIP_MS", "") or 0)
+        except ValueError:
+            gossip_ms = 0
+        self.gossip_period_s = (gossip_ms / 1e3 if gossip_ms
+                                else getattr(supervisor, "heartbeat_s",
+                                             0.5))
+        self._m = _fam()
+        self._lock = threading.Lock()
+        # rid -> {"families", "health", "ts", "ok"}
+        self._last: Dict[str, dict] = {}
+        self._prev_totals: Optional[Tuple[float, float]] = None
+        self._prev_slo: Dict[str, Tuple[float, float, float]] = {}
+        self._qps: Optional[float] = None
+        self._slo_burn: Dict[str, float] = {}
+        self._round_ts: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Federator":
+        try:
+            from spark_rapids_jni_tpu.obs import exporter as _exporter
+            _exporter.register_route("GET", "/metrics/fleet",
+                                     self._serve_exposition)
+            _exporter.register_health_provider("fleet_federation",
+                                               self.health)
+        except Exception:
+            pass
+        self._thread = threading.Thread(
+            target=self._loop, name="srj-fleet-federator", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(self.period_s * 4 + 1.0)
+        try:
+            from spark_rapids_jni_tpu.obs import exporter as _exporter
+            _exporter.unregister_route("GET", "/metrics/fleet")
+            _exporter.unregister_health_provider("fleet_federation")
+        except Exception:
+            pass
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.period_s):
+            try:
+                self.scrape_now()
+            except Exception as e:
+                print(f"[obs.federation] round failed: {e}",
+                      file=sys.stderr)
+
+    # -- scraping ----------------------------------------------------------
+
+    def _get(self, port: int, path: str, timeout: float) -> bytes:
+        return urllib.request.urlopen(
+            f"http://{self.host}:{port}{path}", timeout=timeout).read()
+
+    def scrape_now(self) -> Dict[str, dict]:
+        """One federation round: scrape every live replica, recompute
+        the derived fleet rollups, persist the snapshot.  Returns the
+        per-replica scrape map (``ok`` False on a failed scrape)."""
+        timeout = max(0.5, self.period_s * 4)
+        eps = dict(self._sup.endpoints())
+        now = time.time()
+        round_docs: Dict[str, dict] = {}
+        for rid, port in sorted(eps.items()):
+            rid = str(rid)
+            doc = {"ok": False, "ts": now, "port": port,
+                   "families": [], "health": None}
+            try:
+                text = self._get(port, "/metrics", timeout).decode(
+                    "utf-8", "replace")
+                doc["families"] = parse_exposition(text)
+                doc["health"] = json.loads(
+                    self._get(port, "/healthz", timeout))
+                doc["ok"] = True
+                self._m["scrapes"].inc(replica=rid, status="ok")
+            except Exception:
+                self._m["scrapes"].inc(replica=rid, status="error")
+            round_docs[rid] = doc
+        with self._lock:
+            # keep the last good scrape of a replica that just failed —
+            # counters are cumulative, a one-round-stale snapshot beats
+            # a hole in the fleet totals (death is visible via health)
+            for rid, doc in round_docs.items():
+                if doc["ok"] or rid not in self._last:
+                    self._last[rid] = doc
+            for rid in list(self._last):
+                if rid not in round_docs:
+                    del self._last[rid]      # slot left the fleet
+            self._derive_locked(now)
+            self._round_ts = now
+        self._m["age"].set(0.0)
+        self._persist()
+        return round_docs
+
+    def _expositions_locked(self) -> Dict[str, List[Tuple]]:
+        return {rid: doc["families"]
+                for rid, doc in self._last.items() if doc["families"]}
+
+    def _derive_locked(self, now: float) -> None:
+        """Inter-round derived rollups: fleet QPS and fleet SLO burn,
+        both computed on merged event rates (counter deltas across the
+        whole fleet between this round and the previous one)."""
+        per = self._expositions_locked()
+        total = sum(v for _l, v in merge_samples(
+            per, "srj_tpu_serve_requests_total", "sum",
+            fold=("tenant", "op")))
+        if self._prev_totals is not None:
+            t0, n0 = self._prev_totals
+            dt = now - t0
+            if dt > 0 and total >= n0:
+                self._qps = (total - n0) / dt
+        self._prev_totals = (now, total)
+        # fleet burn per objective: merged bad-fraction over the round
+        # interval, against the declared target's error budget
+        events = merge_samples(per, "srj_tpu_slo_events_total", "sum")
+        by_obj: Dict[str, Dict[str, float]] = {}
+        for labels, v in events:
+            obj = labels.get("objective", "")
+            by_obj.setdefault(obj, {})[
+                labels.get("outcome", "")] = v
+        targets = {labels.get("objective", ""): v for labels, v in
+                   merge_samples(per, "srj_tpu_slo_target", "max")}
+        burns: Dict[str, float] = {}
+        prev = self._prev_slo
+        nxt: Dict[str, Tuple[float, float, float]] = {}
+        for obj, outcomes in sorted(by_obj.items()):
+            bad = outcomes.get("bad", 0.0)
+            good = outcomes.get("good", 0.0)
+            tot = bad + good
+            p = prev.get(obj)
+            if p is not None and tot >= p[2]:
+                dbad, dtot = bad - p[1], tot - p[2]
+            else:
+                dbad, dtot = bad, tot     # first round: cumulative
+            nxt[obj] = (now, bad, tot)
+            if dtot <= 0:
+                continue
+            budget = 1.0 - float(targets.get(obj, 0.0))
+            frac = dbad / dtot
+            burns[obj] = frac / budget if budget > 0 else (
+                0.0 if frac == 0 else float("inf"))
+        self._prev_slo = nxt
+        self._slo_burn = burns
+
+    # -- the fleet exposition ----------------------------------------------
+
+    def _fleet_families(self) -> List[Tuple[str, str, str, List]]:
+        with self._lock:
+            per = self._expositions_locked()
+            last = {rid: doc for rid, doc in self._last.items()}
+            qps, burns = self._qps, dict(self._slo_burn)
+        fams: List[Tuple[str, str, str, List]] = []
+
+        def add(name, kind, help_, samples):
+            fams.append((name, kind, help_, samples))
+
+        req = merge_samples(per, "srj_tpu_serve_requests_total", "sum")
+        add("srj_tpu_fleet_requests_total", "counter",
+            "Requests admitted fleet-wide: sum of every replica's "
+            "srj_tpu_serve_requests_total, by tenant and op.",
+            [("srj_tpu_fleet_requests_total", l, v) for l, v in req])
+        req_op = merge_samples(per, "srj_tpu_serve_requests_total",
+                               "sum", fold=("tenant",))
+        add("srj_tpu_fleet_requests_by_op_total", "counter",
+            "Fleet request totals folded over tenant, by op.",
+            [("srj_tpu_fleet_requests_by_op_total", l, v)
+             for l, v in req_op])
+        if qps is not None:
+            add("srj_tpu_fleet_qps", "gauge",
+                "Fleet-wide admitted requests per second over the last "
+                "federation interval.",
+                [("srj_tpu_fleet_qps", {}, qps)])
+        head = merge_samples(per, "srj_tpu_mem_headroom_bytes", "min")
+        if head:
+            add("srj_tpu_fleet_headroom_worst_bytes", "gauge",
+                "The fleet's tightest memory headroom (min across "
+                "replicas).",
+                [("srj_tpu_fleet_headroom_worst_bytes", l, v)
+                 for l, v in head])
+        brk = merge_samples(per, "srj_tpu_breaker_state", "count_open",
+                            fold=("op", "sig", "bucket", "impl"))
+        add("srj_tpu_fleet_breakers_open", "gauge",
+            "Open circuit-breaker cells anywhere in the fleet.",
+            [("srj_tpu_fleet_breakers_open", {},
+              sum(v for _l, v in brk))])
+        if burns:
+            add("srj_tpu_fleet_slo_burn", "gauge",
+                "Fleet-level SLO burn per objective, recomputed from "
+                "the merged event rates of every replica (not an "
+                "average of per-replica burns).",
+                [("srj_tpu_fleet_slo_burn", {"objective": o}, v)
+                 for o, v in sorted(burns.items())])
+        ready_samples, gen_samples = [], []
+        for rid, doc in sorted(last.items()):
+            rep = ((doc.get("health") or {}).get("replica") or {})
+            ready_samples.append(
+                ("srj_tpu_fleet_replica_ready", {"replica": rid},
+                 1.0 if (doc["ok"] and rep.get("ready")) else 0.0))
+            if isinstance(rep.get("generation"), (int, float)):
+                gen_samples.append(
+                    ("srj_tpu_fleet_replica_generation",
+                     {"replica": rid}, float(rep["generation"])))
+        add("srj_tpu_fleet_replica_ready", "gauge",
+            "1 when the replica scraped ok and reports ready.",
+            ready_samples)
+        if gen_samples:
+            add("srj_tpu_fleet_replica_generation", "gauge",
+                "Supervisor generation (respawn count) per replica.",
+                gen_samples)
+        ages = self._gossip_ages()
+        if ages:
+            add("srj_tpu_fleet_gossip_age_seconds", "gauge",
+                "Seconds since each replica last published its gossip "
+                "export (supervisor-side view of the fleet file).",
+                [("srj_tpu_fleet_gossip_age_seconds", {"replica": r}, a)
+                 for r, a in sorted(ages.items())])
+        return fams
+
+    def exposition(self) -> str:
+        """The federated text exposition: ``srj_tpu_fleet_*`` rollups
+        first, then every replica family re-exported with a
+        ``replica`` label."""
+        from spark_rapids_jni_tpu.obs import metrics as _metrics
+        fams = self._fleet_families()
+        with self._lock:
+            per = self._expositions_locked()
+        merged: Dict[str, Tuple[str, str, List]] = {}
+        order: List[str] = []
+        for rid, replica_fams in sorted(per.items()):
+            for name, kind, help_, samples in replica_fams:
+                if name not in merged:
+                    merged[name] = (kind, help_, [])
+                    order.append(name)
+                merged[name][2].extend(
+                    (sname, {"replica": rid, **labels}, value)
+                    for sname, labels, value in samples)
+        for name in order:
+            kind, help_, samples = merged[name]
+            fams.append((name, kind, help_, samples))
+        return _metrics.format_exposition(fams)
+
+    def _serve_exposition(self, query: dict, body: bytes):
+        return 200, self.exposition()
+
+    # -- health rollup + persistence ---------------------------------------
+
+    def _gossip_ages(self) -> Dict[str, float]:
+        try:
+            from spark_rapids_jni_tpu.serve import fleet as _fleet
+            path = getattr(self._sup, "gossip_file", None) \
+                or _fleet.gossip_path(self.fleet_dir)
+            doc = _fleet.load_gossip(path)
+        except Exception:
+            return {}
+        now = time.time()
+        ages: Dict[str, float] = {}
+        for rid, sec in (doc.get("replicas") or {}).items():
+            ts = sec.get("ts") if isinstance(sec, dict) else None
+            if isinstance(ts, (int, float)):
+                ages[str(rid)] = max(0.0, now - float(ts))
+        return ages
+
+    def health(self) -> dict:
+        """The ``fleet_federation`` sub-document on the supervisor's
+        ``/healthz``: ready-count, degraded replicas, gossip ages, and
+        the stale-peer warning (> 3 missed gossip timers)."""
+        with self._lock:
+            last = dict(self._last)
+            round_ts = self._round_ts
+        ready, degraded = [], []
+        for rid, doc in sorted(last.items()):
+            rep = ((doc.get("health") or {}).get("replica") or {})
+            if doc["ok"] and rep.get("ready") \
+                    and not rep.get("stalled"):
+                ready.append(rid)
+            else:
+                degraded.append(rid)
+        ages = self._gossip_ages()
+        stale_after = 3 * self.gossip_period_s
+        stale = sorted(r for r, a in ages.items() if a > stale_after)
+        doc = {
+            "replicas": len(last),
+            "ready_count": len(ready),
+            "ready": ready,
+            "degraded": degraded,
+            "gossip_age_s": {r: round(a, 3)
+                             for r, a in sorted(ages.items())},
+            "gossip_stale": stale,
+            "gossip_stale_after_s": round(stale_after, 3),
+            "period_s": self.period_s,
+        }
+        if round_ts is not None:
+            doc["last_round_age_s"] = round(time.time() - round_ts, 3)
+        if stale:
+            doc["warning"] = (
+                f"gossip stale for replicas {stale}: no export for > "
+                f"{stale_after:.1f}s (3 missed timers)")
+        return doc
+
+    def snapshot(self) -> dict:
+        """JSON-able federation snapshot (what FEDERATION.json holds)."""
+        with self._lock:
+            last = dict(self._last)
+            qps, burns = self._qps, dict(self._slo_burn)
+            round_ts = self._round_ts
+        replicas = {}
+        for rid, doc in sorted(last.items()):
+            rep = ((doc.get("health") or {}).get("replica") or {})
+            replicas[rid] = {
+                "ok": doc["ok"],
+                "port": doc.get("port"),
+                "ts": doc.get("ts"),
+                "ready": bool(rep.get("ready")),
+                "generation": rep.get("generation"),
+                "pid": rep.get("pid"),
+                "families": len(doc.get("families") or ()),
+            }
+        return {
+            "ts": round_ts,
+            "period_s": self.period_s,
+            "qps": qps,
+            "slo_burn": burns,
+            "replicas": replicas,
+            "health": self.health(),
+        }
+
+    def _persist(self) -> None:
+        path = os.path.join(self.fleet_dir, "FEDERATION.json")
+        try:
+            os.makedirs(self.fleet_dir, exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(self.snapshot(), f, indent=1, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Incident correlation across replica diag dirs
+# ---------------------------------------------------------------------------
+
+def incident_index(fleet_dir: str) -> Dict[str, List[dict]]:
+    """Correlate flight-recorder bundles across the fleet's per-replica
+    diag dirs by the trace ids they touched.  Returns ``{trace_id:
+    [bundle_doc, ...]}`` where each bundle doc carries the bundle path,
+    the replica that wrote it, and the repro headline (reason / span
+    name / error type) — a failover incident shows as one trace_id
+    naming bundles from two replicas."""
+    index: Dict[str, List[dict]] = {}
+    diag_root = os.path.join(fleet_dir, "diag")
+    try:
+        replica_dirs = sorted(os.listdir(diag_root))
+    except OSError:
+        return index
+    for rd in replica_dirs:
+        rdir = os.path.join(diag_root, rd)
+        if not os.path.isdir(rdir):
+            continue
+        replica = rd[len("replica-"):] if rd.startswith("replica-") \
+            else rd
+        try:
+            bundles = sorted(os.listdir(rdir))
+        except OSError:
+            continue
+        for b in bundles:
+            bdir = os.path.join(rdir, b)
+            try:
+                with open(os.path.join(bdir, "repro.json")) as f:
+                    repro = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if not isinstance(repro, dict):
+                continue
+            reason = None
+            try:
+                with open(os.path.join(bdir, "MANIFEST.json")) as f:
+                    reason = (json.load(f) or {}).get("reason")
+            except (OSError, ValueError):
+                pass
+            ids = set()
+            if repro.get("trace_id"):
+                ids.add(str(repro["trace_id"]))
+            for lt in repro.get("link_trace_ids") or ():
+                ids.add(str(lt))
+            if not ids:
+                continue
+            doc = {
+                "bundle": bdir,
+                "replica": str(repro.get("replica") or replica),
+                "reason": reason,
+                "name": repro.get("name"),
+                "error_type": repro.get("error_type"),
+                "attempt": repro.get("attempt"),
+            }
+            for t in sorted(ids):
+                index.setdefault(t, []).append(doc)
+    return index
+
+
+def correlated_incidents(fleet_dir: str) -> Dict[str, List[dict]]:
+    """The cross-replica subset of :func:`incident_index`: trace ids
+    whose bundles span ≥ 2 distinct replicas."""
+    return {t: docs for t, docs in incident_index(fleet_dir).items()
+            if len({d["replica"] for d in docs}) >= 2}
+
+
+# ---------------------------------------------------------------------------
+# The `obs fleet` CLI
+# ---------------------------------------------------------------------------
+
+def _load_fleet_events(fleet_dir: str) -> List[dict]:
+    from spark_rapids_jni_tpu.obs import report as _report
+    events: List[dict] = []
+    ev_dir = os.path.join(fleet_dir, "events")
+    try:
+        names = sorted(os.listdir(ev_dir))
+    except OSError:
+        return events
+    for name in names:
+        if not name.endswith(".jsonl"):
+            continue
+        replica = name[len("replica-"):-len(".jsonl")] \
+            if name.startswith("replica-") else None
+        try:
+            evs = _report.load_events(os.path.join(ev_dir, name))
+        except Exception:
+            continue
+        for ev in evs:
+            if replica is not None:
+                ev.setdefault("replica", replica)
+            events.append(ev)
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return events
+
+
+def fleet_main(argv=None) -> int:
+    """``python -m spark_rapids_jni_tpu.obs fleet``: render a fleet
+    dir's merged timeline, federation snapshot, and cross-replica
+    incident story; ``--trace out.json`` additionally writes the
+    merged Perfetto trace (per-replica lanes, cross-process flow
+    arrows)."""
+    ap = argparse.ArgumentParser(
+        prog="spark_rapids_jni_tpu.obs fleet",
+        description="Fleet observability: merged timeline, federation "
+                    "snapshot, incident correlation.")
+    ap.add_argument("--fleet-dir", default=os.environ.get(
+        "SRJ_TPU_FLEET_DIR", "."), help="the supervisor's fleet dir")
+    ap.add_argument("--trace", metavar="OUT.json",
+                    help="write the merged Chrome/Perfetto trace here")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+    fleet_dir = args.fleet_dir
+
+    events = _load_fleet_events(fleet_dir)
+    fed_path = os.path.join(fleet_dir, "FEDERATION.json")
+    federation = None
+    try:
+        with open(fed_path) as f:
+            federation = json.load(f)
+    except (OSError, ValueError):
+        pass
+    incidents = incident_index(fleet_dir)
+    cross = {t: docs for t, docs in incidents.items()
+             if len({d["replica"] for d in docs}) >= 2}
+
+    # -- merged timeline ----------------------------------------------------
+    by_replica: Dict[str, int] = {}
+    traces: Dict[str, set] = {}
+    for ev in events:
+        rid = str(ev.get("replica", "?"))
+        by_replica[rid] = by_replica.get(rid, 0) + 1
+        t = ev.get("trace_id")
+        if t:
+            traces.setdefault(str(t), set()).add(rid)
+    multi = {t: sorted(r) for t, r in traces.items() if len(r) > 1}
+
+    if args.json:
+        print(json.dumps({
+            "fleet_dir": fleet_dir,
+            "events": len(events),
+            "events_by_replica": by_replica,
+            "traces": len(traces),
+            "cross_replica_traces": multi,
+            "federation": federation,
+            "incidents": incidents,
+            "cross_replica_incidents": cross,
+        }, indent=1, sort_keys=True, default=str))
+    else:
+        print(f"fleet dir: {fleet_dir}")
+        print(f"\n== merged timeline ==")
+        print(f"{len(events)} events across "
+              f"{len(by_replica)} replica logs "
+              f"({', '.join(f'replica:{r}={n}' for r, n in sorted(by_replica.items()))})")
+        print(f"{len(traces)} traces; "
+              f"{len(multi)} span multiple replicas")
+        for t, rids in sorted(multi.items())[:10]:
+            lanes = ", ".join(f"replica:{r}" for r in rids)
+            print(f"  trace {t}: {lanes}")
+        print("\n== federation snapshot ==")
+        if federation is None:
+            print("(no FEDERATION.json — federation off or never ran)")
+        else:
+            h = federation.get("health") or {}
+            qps = federation.get("qps")
+            print(f"replicas ready: {h.get('ready_count')}"
+                  f"/{h.get('replicas')}"
+                  + (f"  degraded: {h.get('degraded')}"
+                     if h.get("degraded") else "")
+                  + (f"  qps: {qps:.1f}" if isinstance(qps, float)
+                     else ""))
+            if h.get("gossip_stale"):
+                print(f"WARNING gossip stale: {h['gossip_stale']} "
+                      f"(> {h.get('gossip_stale_after_s')}s)")
+            for rid, rep in sorted(
+                    (federation.get("replicas") or {}).items()):
+                print(f"  replica:{rid} ok={rep.get('ok')} "
+                      f"ready={rep.get('ready')} "
+                      f"gen={rep.get('generation')} "
+                      f"pid={rep.get('pid')}")
+        print("\n== incidents ==")
+        if not incidents:
+            print("(no recorder bundles with trace ids)")
+        for t, docs in sorted(incidents.items()):
+            reps = sorted({d["replica"] for d in docs})
+            tag = " [CROSS-REPLICA]" if len(reps) > 1 else ""
+            print(f"  trace {t}{tag}: {len(docs)} bundle(s) on "
+                  f"replica(s) {', '.join(reps)}")
+            for d in docs:
+                print(f"    {d['replica']}: {d.get('reason')} "
+                      f"{d.get('name')} {d.get('error_type') or ''} "
+                      f"({d['bundle']})")
+
+    if args.trace:
+        from spark_rapids_jni_tpu.obs.trace import write_trace
+        n = write_trace(events, args.trace)
+        print(f"\nwrote {n} trace events -> {args.trace}",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(fleet_main())

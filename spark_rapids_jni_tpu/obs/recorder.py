@@ -275,9 +275,19 @@ def _repro(ev: Dict, program_keys: List[Tuple]) -> Dict:
             "rows", "requests", "tenant", "tenants", "error_type",
             "error", "device_dead", "trace_id", "span_id",
             "parent_span_id", "links", "link_trace_ids", "host",
-            "thread", "deadline_ms", "retry_history",
-            "cell", "episode", "z", "profile")
+            "replica", "attempt", "thread", "deadline_ms",
+            "retry_history", "cell", "episode", "z", "profile")
     r = {k: ev[k] for k in keep if k in ev}
+    if "replica" not in r:
+        # fleet attribution even for events emitted before the replica
+        # stamp existed (or synthesized ones): the process-level id
+        try:
+            from spark_rapids_jni_tpu.obs import context as _context
+            rep = _context.replica_id()
+            if rep is not None:
+                r["replica"] = rep
+        except Exception:
+            pass
     r["programs"] = [list(k) for k in program_keys]
     return r
 

@@ -196,6 +196,11 @@ def emit(event: Dict) -> None:
     # host lane id: lets per-host JSONL logs from a multihost run merge
     # into one trace (report --merge) with one process lane per host
     ev.setdefault("host", _context.host_id())
+    # replica lane id: same-host fleet replica processes share a host id,
+    # so lanes key on (host, replica) — absent outside a fleet
+    rep = _context.replica_id()
+    if rep is not None:
+        ev.setdefault("replica", rep)
     try:
         with _STATE.lock:
             if len(_STATE.ring) == _STATE.ring.maxlen:

@@ -29,8 +29,15 @@ additionally emits Perfetto *flow* events: an ``s`` record bound to each
 linked request slice and a matching ``f`` (``bp="e"``) on the batch
 slice, which the viewer draws as request→batch arrows.  Events stamped
 with a ``host`` lane id (every event is, since the trace-context work)
-are partitioned into one *process* lane per host, so per-host JSONL logs
-merged by ``report --merge`` render as a single multi-lane trace.
+are partitioned into one *process* lane per ``(host, replica)`` — fleet
+replica processes share a host id, so the ``replica`` stamp
+(``SRJ_TPU_FLEET_ID``) is what keeps same-host replicas in separate
+lanes, named ``replica:<n>``.  Per-host or per-replica JSONL logs merged
+by ``report --merge`` therefore render as a single multi-lane trace,
+and a span whose ``parent_span_id`` resolves into a *different* process
+lane (the propagated trace context of the fleet wire protocol) gets its
+own cross-process ``s``/``f`` pair — a failed-over request renders as
+one router slice with arrows into both replica lanes that attempted it.
 """
 
 from __future__ import annotations
@@ -64,7 +71,7 @@ class _Node:
 # span attributes that are either structural (reconstructed) or huge;
 # everything else (rows, bytes, bucket, error, ...) rides into args
 _SKIP_ATTRS = {"kind", "name", "status", "wall_s", "ts", "depth", "parent",
-               "thread", "host"}
+               "thread", "host", "replica"}
 
 
 def _span_args(ev: Dict) -> Dict:
@@ -124,21 +131,24 @@ def _build_thread_trees(events: Iterable[Dict]) -> Dict[str, List[_Node]]:
 
 def _emit_span(node: _Node, out: List[Dict], pid: int, tid: int,
                scale: float, t0: float, span_index=None,
-               linkers=None) -> None:
+               linkers=None, child_decls=None) -> None:
     ts = (node.start - t0) * scale
     dur = (node.end - node.start) * scale
     if node.children:
         out.append({"ph": "B", "name": node.name, "pid": pid, "tid": tid,
                     "ts": ts, "args": node.args})
         for c in node.children:
-            _emit_span(c, out, pid, tid, scale, t0, span_index, linkers)
+            _emit_span(c, out, pid, tid, scale, t0, span_index, linkers,
+                       child_decls)
         out.append({"ph": "E", "name": node.name, "pid": pid, "tid": tid,
                     "ts": ts + dur})
     else:
         out.append({"ph": "X", "name": node.name, "pid": pid, "tid": tid,
                     "ts": ts, "dur": dur, "args": node.args})
-    # index for flow arrows: where each span_id's slice begins, and which
-    # slices declared links to other spans
+    # index for flow arrows: where each span_id's slice begins, which
+    # slices declared links to other spans, and which declared a parent
+    # (the cross-process s/f candidates — a replica-side span whose
+    # parent span lives in the router's process lane)
     if span_index is not None:
         sid = node.args.get("span_id")
         if sid:
@@ -148,6 +158,9 @@ def _emit_span(node: _Node, out: List[Dict], pid: int, tid: int,
             out_links = [str(s) for s in links if s]
             if out_links:
                 linkers.append((out_links, pid, tid, ts))
+        psid = node.args.get("parent_span_id")
+        if child_decls is not None and psid:
+            child_decls.append((str(psid), pid, tid, ts))
 
 
 def _plan_segment_slices(events: Iterable[Dict]) -> List[tuple]:
@@ -193,21 +206,44 @@ def _host_of(ev: Dict) -> int:
         return 0
 
 
+def _lane_of(ev: Dict) -> tuple:
+    """Process-lane key: ``(host, replica)``.  Fleet replica processes
+    share one host id, so keying lanes on host alone collides every
+    same-host replica into one pid — the replica id (stamped by
+    ``spans.emit`` from ``SRJ_TPU_FLEET_ID``) is the second component;
+    non-fleet events carry no replica and fold into ``(host, "")``."""
+    r = ev.get("replica")
+    return (_host_of(ev), "" if r is None else str(r))
+
+
+def _lane_name(lane: tuple, multi_host: bool) -> str:
+    h, r = lane
+    if r != "":
+        return (f"replica:{r}" if not multi_host
+                else f"replica:{r} host{h}")
+    return f"spark_rapids_jni_tpu host{h}"
+
+
 def trace_events(events: Iterable[Dict], pid: int = 0) -> Dict:
     """Convert an obs event stream (JSONL records or the live ring) to a
     Chrome ``trace_event`` document: ``{"traceEvents": [...],
     "displayTimeUnit": "ms"}``, timestamps in microseconds relative to
-    the earliest span/counter sample.  Events from multiple ``host``
-    lanes (a merged multihost log) land in one process lane per host."""
+    the earliest span/counter sample.  Events from multiple ``(host,
+    replica)`` lanes (a merged multihost or fleet log) land in one
+    process lane each; spans whose ``parent_span_id`` resolves into a
+    *different* process lane get a cross-process flow arrow (the
+    propagated-context edge: router span -> replica rpc span)."""
     events = [e for e in events if isinstance(e, dict)]
-    by_host: Dict[int, List[Dict]] = {}
+    by_host: Dict[tuple, List[Dict]] = {}
     for e in events:
-        by_host.setdefault(_host_of(e), []).append(e)
-    hosts = sorted(by_host) or [0]
+        by_host.setdefault(_lane_of(e), []).append(e)
+    hosts = sorted(by_host) or [(0, "")]
     multi = len(hosts) > 1
-    # single host keeps the historical lane (pid arg, bare process name);
-    # a merged log gets one pid per host id
-    host_pid = {h: (h if multi else pid) for h in hosts}
+    multi_host = len({h for h, _r in hosts}) > 1
+    # a single lane keeps the historical pid (pid arg, bare process
+    # name); a merged log gets one pid per (host, replica) lane
+    host_pid = {lane: (i if multi else pid)
+                for i, lane in enumerate(hosts)}
     trees = {h: _build_thread_trees(by_host[h]) for h in hosts}
 
     # time origin: earliest span start or counter sample across every
@@ -223,10 +259,11 @@ def trace_events(events: Iterable[Dict], pid: int = 0) -> Dict:
     out: List[Dict] = []
     span_index: Dict[str, tuple] = {}
     linkers: List[tuple] = []
+    child_decls: List[tuple] = []
     for h in hosts:
         hpid = host_pid[h]
         pname = ("spark_rapids_jni_tpu" if not multi
-                 else f"spark_rapids_jni_tpu host{h}")
+                 else _lane_name(h, multi_host))
         out.append({"ph": "M", "name": "process_name", "pid": hpid,
                     "args": {"name": pname}})
 
@@ -242,7 +279,7 @@ def trace_events(events: Iterable[Dict], pid: int = 0) -> Dict:
         for name in names:
             for node in roots[name]:
                 _emit_span(node, out, hpid, tids[name], scale, t0,
-                           span_index, linkers)
+                           span_index, linkers, child_decls)
 
         # plan-segment lane: stats-armed plan spans carry ``segments``
         # (node-kind labels per fused segment) and ``seg_device_s``
@@ -328,6 +365,27 @@ def trace_events(events: Iterable[Dict], pid: int = 0) -> Dict:
             out.append({"ph": "f", "bp": "e", "cat": "srj.flow",
                         "name": "request", "id": fid, "pid": bpid,
                         "tid": btid, "ts": max(bts, sts)})
+
+    # cross-process flow arrows: a span whose parent_span_id resolves
+    # to a slice in a DIFFERENT process lane is a propagated-context
+    # edge (the router's fleet.submit span parenting a replica's
+    # serve.rpc span over the wire) — drawn parent -> child, so a
+    # failed-over request renders as one router slice fanning arrows to
+    # every replica lane that attempted it.  Same-lane parentage is
+    # already visible as nesting and gets no arrow.
+    for psid, cpid, ctid, cts in child_decls:
+        src = span_index.get(psid)
+        if src is None:
+            continue  # parent span outside this log (other process/file)
+        ppid, ptid, pts = src
+        if ppid == cpid:
+            continue
+        fid += 1
+        out.append({"ph": "s", "cat": "srj.flow", "name": "rpc",
+                    "id": fid, "pid": ppid, "tid": ptid, "ts": pts})
+        out.append({"ph": "f", "bp": "e", "cat": "srj.flow",
+                    "name": "rpc", "id": fid, "pid": cpid,
+                    "tid": ctid, "ts": max(cts, pts)})
 
     # non-metadata events sorted by time; python's stable sort keeps the
     # tree-walk order (B before children before E) across equal stamps,

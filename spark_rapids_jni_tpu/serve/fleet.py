@@ -42,9 +42,23 @@ tolerant: :func:`load_gossip` returns empty-with-warning on a truncated
 or malformed read (a replica killed mid-write must never poison its
 successor — ``tests/test_fleet.py`` proves the truncation shapes).
 
+**Observability plane.**  Each replica is spawned with a per-replica
+events sink (``<fleet_dir>/events/replica-<rid>.jsonl``, gate
+``SRJ_TPU_FLEET_EVENTS``), a per-replica flight-recorder diag dir
+(``<fleet_dir>/diag/replica-<rid>``, gate ``SRJ_TPU_FLEET_DIAG``) and
+its supervisor generation (``SRJ_TPU_FLEET_GEN`` = the slot's restart
+count) — the raw material ``obs fleet`` merges into one trace and one
+incident story.  While ``SRJ_TPU_FLEET_FEDERATION`` is on (default),
+the supervisor also runs an :class:`obs.federation.Federator` scraping
+every replica's ``/metrics``+``/healthz`` and re-exporting the fleet
+exposition (``replica``-labeled families plus ``srj_tpu_fleet_*``
+merged rollups) from its own exporter at ``GET /metrics/fleet``.
+
 Knobs: ``SRJ_TPU_FLEET_REPLICAS`` (default 3), ``SRJ_TPU_FLEET_
 HEARTBEAT_MS`` (500), ``SRJ_TPU_FLEET_GOSSIP_FILE``, ``SRJ_TPU_FLEET_
-WARM_SHIP`` (1), ``SRJ_TPU_FLEET_MISS_LIMIT`` (3).
+WARM_SHIP`` (1), ``SRJ_TPU_FLEET_MISS_LIMIT`` (3), ``SRJ_TPU_FLEET_
+FEDERATION`` (1), ``SRJ_TPU_FLEET_FED_MS`` (heartbeat), ``SRJ_TPU_
+FLEET_EVENTS`` (1), ``SRJ_TPU_FLEET_DIAG`` (1).
 """
 
 from __future__ import annotations
@@ -72,6 +86,10 @@ def _env_int(name: str, default: int) -> int:
         return int(os.environ.get(name, "") or default)
     except ValueError:
         return default
+
+
+def _env_on(name: str, default: str = "1") -> bool:
+    return os.environ.get(name, default) not in ("0", "off", "false")
 
 
 # ---------------------------------------------------------------------------
@@ -213,6 +231,7 @@ class Supervisor:
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
         self._m = _fam()
+        self.federation = None     # obs.federation.Federator when on
         self._seed_state_files()
 
     # -- warm-state shipping ----------------------------------------------
@@ -263,6 +282,23 @@ class Supervisor:
             env.pop("SRJ_TPU_FLEET_CACHE_DIR", None)
         env.setdefault("SRJ_TPU_FLEET_GOSSIP_MS",
                        str(int(self.heartbeat_s * 1e3)))
+        # observability plane: supervisor generation (respawns bump it),
+        # per-replica events sink and diag dir — what obs fleet merges
+        with self._lock:
+            r = self._replicas.get(rid)
+            env["SRJ_TPU_FLEET_GEN"] = str(r.restarts if r else 0)
+        if _env_on("SRJ_TPU_FLEET_EVENTS"):
+            ev_dir = os.path.join(self.fleet_dir, "events")
+            os.makedirs(ev_dir, exist_ok=True)
+            # overrides an inherited sink on purpose: N replicas
+            # appending to the launcher's one file would interleave;
+            # per-replica files are what obs fleet --merge wants
+            env["SRJ_TPU_EVENTS"] = os.path.join(
+                ev_dir, f"replica-{rid}.jsonl")
+        if _env_on("SRJ_TPU_FLEET_DIAG"):
+            diag = os.path.join(self.fleet_dir, "diag", f"replica-{rid}")
+            os.makedirs(diag, exist_ok=True)
+            env["SRJ_TPU_DIAG_DIR"] = diag
         env.update(self._extra_env)
         return env
 
@@ -285,6 +321,13 @@ class Supervisor:
             _exporter.register_health_provider("fleet", self.health)
         except Exception:
             pass
+        if _env_on("SRJ_TPU_FLEET_FEDERATION"):
+            try:
+                from spark_rapids_jni_tpu.obs import federation as _fed
+                self.federation = _fed.Federator(self).start()
+            except Exception as e:
+                print(f"[serve.fleet] federation start failed: {e}",
+                      file=sys.stderr)
         return self
 
     def __enter__(self) -> "Supervisor":
@@ -373,6 +416,13 @@ class Supervisor:
 
     def stop(self, timeout_s: float = 10.0) -> None:
         self._stop.set()
+        fed = self.federation
+        if fed is not None:
+            try:
+                fed.stop()
+            except Exception:
+                pass
+            self.federation = None
         t = self._monitor
         if t is not None:
             t.join(self.heartbeat_s * 4 + 1.0)

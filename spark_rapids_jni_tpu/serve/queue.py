@@ -74,6 +74,7 @@ class Request:
     nbytes: int
     t_submit: float = dataclasses.field(default_factory=time.perf_counter)
     trace: Any = None
+    trace_parent: Optional[str] = None
     deadline: Optional[float] = None
 
 

@@ -8,8 +8,14 @@ Scheduler` with coalescing, admission control, memory-aware splitting;
 the fleet endpoints on the same socket:
 
 ``POST /v1/submit``
-    Body ``{"key", "tenant", "op", "deadline_s", "kwargs"}`` with
-    kwargs in the router's wire codec (:func:`serve.router.encode_doc`).
+    Body ``{"key", "tenant", "op", "deadline_s", "kwargs", "trace",
+    "attempt"}`` with kwargs in the router's wire codec
+    (:func:`serve.router.encode_doc`).  ``trace`` (optional) is the
+    caller's ``{"trace_id", "span_id", "tenant"}`` context and
+    ``attempt`` its 0-based re-send counter: the handler re-activates
+    the context so replica-side spans (``serve.rpc`` →
+    ``serve.request`` → batch) chain to the router's ``fleet.submit``
+    span — a failover renders as ONE merged trace across replicas.
     ``key`` is the request's **idempotency key**: results of completed
     requests are cached in a bounded LRU keyed on it, so a router
     re-delivering after a lost ACK gets the recorded response replayed
@@ -141,7 +147,24 @@ def _error_doc(key: str, e: BaseException) -> dict:
     return {"key": key, "ok": False, "error": err}
 
 
+def _wire_context(req: dict):
+    """Rebuild the router's :class:`obs.context.TraceContext` from the
+    wire body's ``trace`` doc (None when the caller sent none — old
+    routers, curl)."""
+    from spark_rapids_jni_tpu.obs import context as _context
+    doc = req.get("trace")
+    if not isinstance(doc, dict) or not doc.get("trace_id"):
+        return None
+    return _context.TraceContext(
+        trace_id=str(doc["trace_id"]),
+        span_id=str(doc.get("span_id") or _context.new_id()),
+        tenant=(str(doc["tenant"]) if doc.get("tenant") is not None
+                else None))
+
+
 def _make_submit_handler(scheduler, dedupe: _Dedupe):
+    from spark_rapids_jni_tpu.obs import context as _context
+    from spark_rapids_jni_tpu.obs import spans as _spans
     from spark_rapids_jni_tpu.serve import router as _router
     from spark_rapids_jni_tpu.serve.client import Client
 
@@ -167,14 +190,29 @@ def _make_submit_handler(scheduler, dedupe: _Dedupe):
         tenant = str(req.get("tenant") or "fleet")
         deadline_s = req.get("deadline_s")
         try:
-            kwargs = _router.decode_doc(req.get("kwargs") or {})
-            client = Client(scheduler, tenant)
-            fut = client._submit(
-                op, None if deadline_s is None else float(deadline_s),
-                kwargs)
-            timeout = (float(deadline_s) + 30.0
-                       if deadline_s is not None else 600.0)
-            result = fut.result(timeout)
+            attempt = int(req.get("attempt") or 0)
+        except (TypeError, ValueError):
+            attempt = 0
+        # cross-process propagation: activate the caller's context so
+        # the serve.rpc span (and the scheduler's serve.request span
+        # under it) chain to the router's fleet.submit span — after a
+        # failover both replicas' spans share ONE trace_id and the
+        # merged trace shows the hop as a flow arrow
+        ctx = _wire_context(req)
+        try:
+            with _context.activate(ctx):
+                with _spans.span("serve.rpc", op=op,
+                                 attempt=attempt) as sp:
+                    kwargs = _router.decode_doc(req.get("kwargs") or {})
+                    client = Client(scheduler, tenant)
+                    fut = client._submit(
+                        op,
+                        None if deadline_s is None else float(deadline_s),
+                        kwargs)
+                    timeout = (float(deadline_s) + 30.0
+                               if deadline_s is not None else 600.0)
+                    result = fut.result(timeout)
+                    sp.set(tenant=tenant)
         except BaseException as e:         # noqa: BLE001 — wire boundary
             return 200, _error_doc(key, e)
         doc = {"key": key, "ok": True,
@@ -282,17 +320,28 @@ def _warmup(scheduler, spec: str) -> int:
 
 def _gossip_loop(path: str, rid: str, stop: threading.Event,
                  period_s: float) -> None:
+    from spark_rapids_jni_tpu.obs import metrics as _metrics
     from spark_rapids_jni_tpu.runtime import resilience
     from spark_rapids_jni_tpu.serve import fleet as _fleet
+    age_g = _metrics.gauge(
+        "srj_tpu_fleet_gossip_age_seconds",
+        "Seconds since each gossip peer last published its export "
+        "(stale > 3 missed timers means the peer stopped gossiping "
+        "while possibly still serving).", ("peer",))
     while not stop.wait(period_s):
         try:
             section = {"ts": time.time(), "pid": os.getpid(),
                        "breakers": resilience.export_breakers()}
             merged = _fleet.publish_gossip(path, rid, section)
+            now = time.time()
             for peer, peer_sec in (merged.get("replicas") or {}).items():
                 if str(peer) == str(rid) or not isinstance(peer_sec,
                                                            dict):
                     continue
+                ts = peer_sec.get("ts")
+                if isinstance(ts, (int, float)):
+                    age_g.set(max(0.0, now - float(ts)),
+                              peer=str(peer))
                 resilience.import_breakers(
                     peer_sec.get("breakers") or {},
                     origin=f"gossip:{peer}")
@@ -314,9 +363,16 @@ def main(argv=None) -> int:
     cache_dir = _configure_warm_cache()   # BEFORE anything compiles
 
     from spark_rapids_jni_tpu import obs
-    from spark_rapids_jni_tpu.obs import compilemon, exporter
+    from spark_rapids_jni_tpu.obs import compilemon, context, exporter
     from spark_rapids_jni_tpu.serve.scheduler import Scheduler
+    context.set_replica(rid)    # lane key for every event this process emits
     obs.enable()
+
+    try:
+        generation = int(os.environ.get("SRJ_TPU_FLEET_GEN", "0") or 0)
+    except ValueError:
+        generation = 0
+    start_ts = time.time()
 
     scheduler = Scheduler().start()
     dedupe = _Dedupe()
@@ -328,6 +384,8 @@ def main(argv=None) -> int:
         return {
             "id": rid,
             "pid": os.getpid(),
+            "generation": generation,
+            "start_ts": start_ts,
             "ready": _READY.is_set(),
             "stalled": _stalled(),
             "warm_cache": cache_dir,

@@ -60,6 +60,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from spark_rapids_jni_tpu.obs import context as _context
+from spark_rapids_jni_tpu.obs import spans as _spans
 from spark_rapids_jni_tpu.runtime import resilience as _resilience
 
 __all__ = ["Router", "encode_doc", "decode_doc", "affinity_bucket"]
@@ -154,6 +156,13 @@ def _fam():
             "srj_tpu_fleet_no_replica_total",
             "Routing rounds that found no routable replica (all dead, "
             "not ready, or shedding)."),
+        "routes": m.counter(
+            "srj_tpu_router_routes_total",
+            "Routing decisions by chosen replica and reason: affinity "
+            "(rendezvous winner), demoted (winner forfeited on queue "
+            "depth), fallback (nothing routable), failover (re-send "
+            "after transport failure), requeue (re-send after "
+            "QueueFull).", ("replica", "reason")),
     }
 
 
@@ -251,6 +260,15 @@ class Router:
         over the routable set (affinity — the hash winner owns the
         bucket), with heavily-loaded winners demoted behind lighter
         peers (queue depth is the health tiebreak)."""
+        return self._candidates2(op, bucket, exclude)[0]
+
+    def _candidates2(self, op: str, bucket: int,
+                     exclude: Sequence[int] = ()
+                     ) -> Tuple[List[Tuple[int, int]], str]:
+        """:meth:`_candidates` plus the decision reason — ``affinity``
+        (the rendezvous winner heads the list), ``demoted`` (the winner
+        forfeited the bucket on queue depth), or ``fallback`` (nothing
+        routable; best-effort over the unhealthy set)."""
         eps = self.endpoints()
         ranked: List[Tuple[float, int, int, int]] = []
         fallback: List[Tuple[float, int, int]] = []
@@ -268,16 +286,17 @@ class Router:
             # a peer sits near-empty forfeits the bucket for this round
             ranked.sort()
             best_depth = min(d for _s, d, _r, _p in ranked)
-            for _s, d, rid, port in ranked:
+            for i, (_s, d, rid, port) in enumerate(ranked):
                 if d <= best_depth + 32:
+                    reason = "affinity" if i == 0 else "demoted"
                     return ([(rid, port)]
                             + [(r, p) for _sc, _d, r, p in ranked
-                               if r != rid])
-            return [(r, p) for _s, _d, r, p in ranked]
+                               if r != rid]), reason
+            return [(r, p) for _s, _d, r, p in ranked], "demoted"
         # nothing routable: last resort is the excluded/unhealthy set in
         # affinity order (a shedding replica beats a lost request)
         fallback.sort()
-        return [(r, p) for _s, r, p in fallback]
+        return [(r, p) for _s, r, p in fallback], "fallback"
 
     def ready(self, all_replicas: bool = False) -> bool:
         """True when at least one replica (or with ``all_replicas``,
@@ -302,19 +321,46 @@ class Router:
                **kwargs) -> "concurrent.futures.Future":
         """Route one request; returns a Future resolving to the op's
         decoded result dict.  The idempotency key is minted here — every
-        failover re-send of this request carries the same key."""
+        failover re-send of this request carries the same key.  The
+        caller's :class:`obs.context.TraceContext` is captured here (on
+        the caller's thread) and propagated over the wire, so replica-
+        side spans chain to the caller's trace."""
         key = uuid.uuid4().hex
+        octx = _context.capture()
         return self._pool.submit(self._submit_sync, op, dict(kwargs),
-                                 deadline_s, tenant or self.tenant, key)
+                                 deadline_s, tenant or self.tenant, key,
+                                 octx)
 
     def _submit_sync(self, op: str, kwargs: Dict,
                      deadline_s: Optional[float], tenant: str,
-                     key: str) -> Dict:
+                     key: str, octx=None) -> Dict:
+        # the router pool thread has no context of its own: activate the
+        # caller's captured context (or mint a fresh root so even an
+        # untraced caller gets one trace_id spanning every failover hop)
+        ctx = octx or _context.root(tenant=tenant)
+        with _context.activate(ctx):
+            with _spans.span("fleet.submit", op=op) as sp:
+                return self._submit_routed(op, kwargs, deadline_s,
+                                           tenant, key, sp)
+
+    def _submit_routed(self, op: str, kwargs: Dict,
+                       deadline_s: Optional[float], tenant: str,
+                       key: str, sp) -> Dict:
         bucket = affinity_bucket(op, kwargs)
+        sp.set(bucket=bucket)
         deadline = (time.monotonic() + float(deadline_s)
                     if deadline_s else None)
         policy = _resilience.default_policy()
         enc_kwargs = encode_doc(kwargs)
+        # what the replica re-activates: the fleet.submit span (when
+        # recording) is the parent of the replica-side serve.rpc span —
+        # THE cross-process edge in the merged trace
+        wctx = _context.current()
+        wire_trace = None
+        if wctx is not None:
+            wire_trace = {"trace_id": wctx.trace_id,
+                          "span_id": wctx.span_id, "tenant": tenant}
+        attempt = 0                  # prior sends of this key
         transport_failures = 0
         prev_sleep = policy.base_s
         failed: List[int] = []       # transport failures (suspect dead)
@@ -325,7 +371,8 @@ class Router:
             if left is not None and left <= 0:
                 raise last_exc or _resilience.DeadlineExceeded(
                     f"fleet.{op}", float(deadline_s or 0))
-            cands = self._candidates(op, bucket, exclude=failed + avoid)
+            cands, route_reason = self._candidates2(
+                op, bucket, exclude=failed + avoid)
             if not cands:
                 self._m["no_replica"].inc()
                 # membership may be mid-failover (replacement starting):
@@ -337,10 +384,19 @@ class Router:
                 prev_sleep = min(policy.cap_s, 3 * prev_sleep)
                 continue
             rid, port = cands[0]
+            # re-sends trump the candidate-ranking reason: the decision
+            # that routed here was the failover/requeue, not affinity
+            if failed:
+                route_reason = "failover"
+            elif avoid:
+                route_reason = "requeue"
+            self._m["routes"].inc(replica=str(rid), reason=route_reason)
             body = json.dumps({
                 "key": key, "tenant": tenant, "op": op,
                 "deadline_s": left, "kwargs": enc_kwargs,
+                "trace": wire_trace, "attempt": attempt,
             }).encode("utf-8")
+            attempt += 1
             timeout = self.request_timeout_s
             if left is not None:
                 timeout = max(0.05, min(timeout, left))
@@ -365,6 +421,10 @@ class Router:
                 continue
             self._m["routed"].inc(replica=str(rid))
             if doc.get("ok"):
+                # NOT "replica": that key is the event's process-lane
+                # stamp (obs.trace) — the router span stays on the
+                # client lane and names its target separately
+                sp.set(routed_replica=str(rid), attempts=attempt)
                 return decode_doc(doc.get("result") or {})
             err = doc.get("error") or {}
             kind = err.get("kind")

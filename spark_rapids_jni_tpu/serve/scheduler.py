@@ -321,6 +321,7 @@ class Scheduler:
                            trace_id=ctx.trace_id if ctx else None)
         req = Request(tenant=str(tenant), op=op, sig=sig, payload=payload,
                       future=fut, rows=rows, nbytes=nbytes, trace=rt,
+                      trace_parent=ctx.span_id if ctx else None,
                       deadline=deadline)
         try:
             self.queue.submit(req)
@@ -552,6 +553,11 @@ class Scheduler:
               "thread": f"tenant:{self._tenant_label(r.tenant)}",
               "op": r.op, "tenant": r.tenant, "rows": r.rows,
               "trace_id": r.trace.trace_id, "span_id": r.trace.span_id}
+        if r.trace_parent is not None:
+            # the submitter's enclosing span (over the fleet wire: the
+            # router's fleet.submit span in ANOTHER process) — the trace
+            # converter renders cross-process parents as flow arrows
+            ev["parent_span_id"] = r.trace_parent
         if err is not None:
             ev["error_type"] = type(err).__name__
             ev["error"] = str(err)[:300]

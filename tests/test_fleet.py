@@ -16,8 +16,10 @@ byte-cap eviction, and crash-consistency of every fleet-shared file
 (a replica killed mid-write must leave a file that loads as
 empty-with-warning, never one that raises)."""
 
+import hashlib
 import json
 import os
+import socket
 import threading
 import time
 import urllib.error
@@ -27,8 +29,9 @@ import numpy as np
 import pytest
 
 from spark_rapids_jni_tpu import obs, serve
-from spark_rapids_jni_tpu.obs import (exporter, memwatch, metrics,
-                                      planstats, recorder)
+from spark_rapids_jni_tpu.obs import (context, exporter, federation,
+                                      memwatch, metrics, planstats,
+                                      recorder, trace)
 from spark_rapids_jni_tpu.runtime import resilience, shapes
 from spark_rapids_jni_tpu.serve import chaos, fleet, router
 
@@ -407,6 +410,221 @@ class TestRouterPlumbing:
 
 
 # ---------------------------------------------------------------------------
+# Satellite: federation merge math against hand-built expositions
+# ---------------------------------------------------------------------------
+
+class TestFederationMath:
+    EXPO_A = (
+        "# HELP srj_tpu_serve_requests_total Completed serve requests.\n"
+        "# TYPE srj_tpu_serve_requests_total counter\n"
+        'srj_tpu_serve_requests_total{tenant="t0",op="agg"} 8\n'
+        'srj_tpu_serve_requests_total{tenant="t1",op="agg"} 2\n'
+        'srj_tpu_serve_requests_total{tenant="t0",op="join"} 7\n'
+        "# TYPE srj_tpu_mem_headroom_bytes gauge\n"
+        "srj_tpu_mem_headroom_bytes 400\n"
+        "# TYPE srj_tpu_breaker_state gauge\n"
+        'srj_tpu_breaker_state{op="agg",sig="s",bucket="100",'
+        'impl="pallas"} 1\n'
+        'srj_tpu_breaker_state{op="agg",sig="s",bucket="1000",'
+        'impl="pallas"} 0\n')
+    EXPO_B = (
+        "# TYPE srj_tpu_serve_requests_total counter\n"
+        'srj_tpu_serve_requests_total{tenant="t0",op="agg"} 5\n'
+        "# TYPE srj_tpu_mem_headroom_bytes gauge\n"
+        "srj_tpu_mem_headroom_bytes 900\n"
+        "# TYPE srj_tpu_breaker_state gauge\n"
+        'srj_tpu_breaker_state{op="agg",sig="s",bucket="100",'
+        'impl="pallas"} 1\n')
+
+    def _per(self):
+        return {"0": federation.parse_exposition(self.EXPO_A),
+                "1": federation.parse_exposition(self.EXPO_B)}
+
+    def test_parse_families_and_kinds(self):
+        fams = federation.parse_exposition(self.EXPO_A)
+        by = {f[0]: f for f in fams}
+        assert by["srj_tpu_serve_requests_total"][1] == "counter"
+        assert by["srj_tpu_serve_requests_total"][2] == (
+            "Completed serve requests.")
+        assert by["srj_tpu_mem_headroom_bytes"][1] == "gauge"
+        assert len(by["srj_tpu_serve_requests_total"][3]) == 3
+
+    def test_parse_unescapes_label_values(self):
+        fams = federation.parse_exposition(
+            "# TYPE f counter\n"
+            'f{msg="a\\"b\\\\c\\nd"} 1\n')
+        (_n, labels, v), = fams[0][3]
+        assert labels["msg"] == 'a"b\\c\nd' and v == 1.0
+
+    def test_parse_untyped_and_garbage_lines(self):
+        fams = federation.parse_exposition(
+            "not a metric line at all\n"
+            "orphan_sample 3\n"
+            "# random comment\n")
+        assert fams == [("orphan_sample", "untyped", "",
+                         [("orphan_sample", {}, 3.0)])]
+
+    def test_parse_attaches_histogram_children(self):
+        fams = federation.parse_exposition(
+            "# TYPE lat histogram\n"
+            'lat_bucket{le="1"} 2\n'
+            'lat_bucket{le="+Inf"} 3\n'
+            "lat_sum 1.5\n"
+            "lat_count 3\n")
+        assert len(fams) == 1 and fams[0][1] == "histogram"
+        assert [s[0] for s in fams[0][3]] == [
+            "lat_bucket", "lat_bucket", "lat_sum", "lat_count"]
+
+    def test_counter_sum_by_label_group(self):
+        got = federation.merge_samples(
+            self._per(), "srj_tpu_serve_requests_total", "sum")
+        assert got == [
+            ({"op": "agg", "tenant": "t0"}, 13.0),
+            ({"op": "agg", "tenant": "t1"}, 2.0),
+            ({"op": "join", "tenant": "t0"}, 7.0)]
+
+    def test_counter_sum_folding_tenant(self):
+        got = federation.merge_samples(
+            self._per(), "srj_tpu_serve_requests_total", "sum",
+            fold=("tenant",))
+        assert got == [({"op": "agg"}, 15.0), ({"op": "join"}, 7.0)]
+
+    def test_gauge_min_max(self):
+        per = self._per()
+        assert federation.merge_samples(
+            per, "srj_tpu_mem_headroom_bytes", "min") == [({}, 400.0)]
+        assert federation.merge_samples(
+            per, "srj_tpu_mem_headroom_bytes", "max") == [({}, 900.0)]
+
+    def test_count_open_breaker_cells(self):
+        got = federation.merge_samples(
+            self._per(), "srj_tpu_breaker_state", "count_open",
+            fold=("op", "sig", "bucket", "impl"))
+        assert got == [({}, 2.0)]
+
+    def test_replica_label_never_groups(self):
+        per = {"0": federation.parse_exposition(
+            '# TYPE c counter\nc{replica="9",op="agg"} 1\n'),
+            "1": federation.parse_exposition(
+            '# TYPE c counter\nc{replica="8",op="agg"} 2\n')}
+        assert federation.merge_samples(per, "c", "sum") == [
+            ({"op": "agg"}, 3.0)]
+
+    def test_roundtrip_through_shared_serializer(self):
+        fams = federation.parse_exposition(self.EXPO_A)
+        text = metrics.format_exposition(fams)
+        again = federation.parse_exposition(text)
+        assert again == fams
+
+
+# ---------------------------------------------------------------------------
+# Satellite: (host, replica) trace lanes + cross-process flow arrows
+# ---------------------------------------------------------------------------
+
+class TestFleetTraceMerge:
+    @staticmethod
+    def _span(name, ts, wall_s, span_id, parent=None, replica=None,
+              host=0, **attrs):
+        ev = {"kind": "span", "name": name, "status": "ok", "ts": ts,
+              "wall_s": wall_s, "depth": 0, "thread": "MainThread",
+              "host": host, "trace_id": "T1", "span_id": span_id,
+              **attrs}
+        if parent is not None:
+            ev["parent_span_id"] = parent
+        if replica is not None:
+            ev["replica"] = replica
+        return ev
+
+    def _failover_events(self):
+        # a router span fanning one failed-over request to two replicas
+        return [
+            self._span("fleet.submit", 10.0, 0.5, "S0", attempts=2),
+            self._span("serve.rpc", 9.7, 0.1, "S1", parent="S0",
+                       replica="0", attempt=0),
+            self._span("serve.rpc", 9.9, 0.1, "S2", parent="S0",
+                       replica="1", attempt=1),
+        ]
+
+    def test_same_host_replicas_get_distinct_lanes(self):
+        doc = trace.trace_events(self._failover_events())
+        pn = {e["pid"]: e["args"]["name"] for e in doc["traceEvents"]
+              if e["ph"] == "M" and e["name"] == "process_name"}
+        assert sorted(pn.values()) == [
+            "replica:0", "replica:1", "spark_rapids_jni_tpu host0"]
+        assert sorted(pn) == [0, 1, 2]   # one pid per (host, replica)
+
+    def test_multi_host_lane_names_carry_the_host(self):
+        evs = self._failover_events()
+        evs.append(self._span("fleet.submit", 10.0, 0.1, "S9", host=1))
+        doc = trace.trace_events(evs)
+        names = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert "replica:0 host0" in names
+        assert "spark_rapids_jni_tpu host1" in names
+
+    def test_cross_process_flow_arrows_pair_up(self):
+        doc = trace.trace_events(self._failover_events())
+        flows = [e for e in doc["traceEvents"]
+                 if e.get("cat") == "srj.flow" and e["name"] == "rpc"]
+        ss = {e["id"]: e for e in flows if e["ph"] == "s"}
+        fs = {e["id"]: e for e in flows if e["ph"] == "f"}
+        assert len(ss) == 2 and set(ss) == set(fs)
+        for fid, s in ss.items():
+            f = fs[fid]
+            assert f["bp"] == "e"          # bind to enclosing slice
+            assert s["pid"] != f["pid"]    # a genuine cross-lane edge
+            assert f["ts"] >= s["ts"]
+        # both arrows leave the router lane (the one slice that fans out)
+        assert len({s["pid"] for s in ss.values()}) == 1
+
+    def test_same_process_parentage_gets_no_arrow(self):
+        evs = [self._span("outer", 10.0, 0.5, "S0"),
+               self._span("inner", 9.8, 0.1, "S1", parent="S0")]
+        doc = trace.trace_events(evs)
+        assert not [e for e in doc["traceEvents"]
+                    if e.get("cat") == "srj.flow"]
+
+    def test_flow_phases_stay_schema_valid(self):
+        for e in trace.trace_events(
+                self._failover_events())["traceEvents"]:
+            assert e["ph"] in ("M", "B", "E", "X", "C", "i", "s", "f")
+
+
+# ---------------------------------------------------------------------------
+# Satellite: federation lifecycle + kill switch
+# ---------------------------------------------------------------------------
+
+class TestFederationLifecycle:
+    def test_kill_switch_restores_per_replica_only(self, tmp_path,
+                                                   monkeypatch):
+        monkeypatch.setenv("SRJ_TPU_FLEET_FEDERATION", "0")
+        sup = fleet.Supervisor(replicas=0,
+                               fleet_dir=str(tmp_path / "f0"))
+        try:
+            sup.start(wait_ready=False)
+            assert sup.federation is None
+        finally:
+            sup.stop()
+
+    def test_federation_on_by_default(self, tmp_path, clean_metrics):
+        sup = fleet.Supervisor(replicas=0,
+                               fleet_dir=str(tmp_path / "f1"))
+        try:
+            sup.start(wait_ready=False)
+            fed = sup.federation
+            assert fed is not None
+            fed.scrape_now()               # empty fleet: still coherent
+            assert "srj_tpu_fleet_breakers_open 0" in fed.exposition()
+            h = fed.health()
+            assert h["replicas"] == 0 and h["ready_count"] == 0
+            assert os.path.exists(
+                os.path.join(str(tmp_path / "f1"), "FEDERATION.json"))
+        finally:
+            sup.stop()
+        assert sup.federation is None      # stop() tears it down
+
+
+# ---------------------------------------------------------------------------
 # The acceptance proof: kill a replica mid-burst
 # ---------------------------------------------------------------------------
 
@@ -456,6 +674,11 @@ class TestFleetChaos:
                             keys, vals).result(240)
 
             rt = router.Router(supervisor=sup, health_ttl_s=0.1)
+            # the whole burst runs under ONE caller trace context: the
+            # router captures it per submit, stamps it on the wire, and
+            # every replica-side span joins the same fleet-wide trace_id
+            obs.enable()
+            burst_ctx = context.root(tenant="burst")
             # kill the affinity owner of the small bucket: the replica
             # guaranteed to hold in-flight requests when the axe falls
             victim = rt._candidates(
@@ -465,14 +688,15 @@ class TestFleetChaos:
 
             futs = []
             t_burst = time.monotonic()
-            for i in range(32):
-                size = self.SIZES[i % 2]
-                keys, vals = self._payload(size, i % 2)
-                futs.append(
-                    ((size, i % 2),
-                     rt.aggregate(keys, vals, deadline_s=120,
-                                  tenant=f"t{i % 4}")))
-                time.sleep(0.03)     # spread the burst across the kill
+            with context.activate(burst_ctx):
+                for i in range(32):
+                    size = self.SIZES[i % 2]
+                    keys, vals = self._payload(size, i % 2)
+                    futs.append(
+                        ((size, i % 2),
+                         rt.aggregate(keys, vals, deadline_s=120,
+                                      tenant=f"t{i % 4}")))
+                    time.sleep(0.03)  # spread the burst across the kill
             assert time.monotonic() - t_burst > 0.3  # kill fell inside
 
             lost = 0
@@ -526,7 +750,161 @@ class TestFleetChaos:
             assert seen, (
                 f"breaker {cell} from replica {src} never reached "
                 f"replica {dst} via gossip")
+
+            # ---- trace propagation: one trace across the failover ----
+            # The burst's kill-failover is timing-dependent, so replay
+            # the same idempotency-key failover deterministically under
+            # the same burst trace: a router whose rendezvous winner is
+            # a dead endpoint (bound, never listening — connection
+            # refused, exactly what the killed replica's port returned)
+            # must fail over mid-flight to a live survivor.
+            bucket100 = shapes.bucket_rows(100)
+
+            def _score(r):
+                return int.from_bytes(hashlib.blake2b(
+                    f"agg|{bucket100}|{r}".encode(),
+                    digest_size=8).digest(), "big")
+            dead_rid = max((0, 1), key=_score)  # rendezvous winner
+            live_rid = 1 - dead_rid
+            blocker = socket.socket()
+            blocker.bind(("127.0.0.1", 0))      # reserved, refuses all
+            dead_port = blocker.getsockname()[1]
+            live_port = sup.endpoints()[survivors[0]]
+            rt2 = router.Router(
+                endpoints={dead_rid: dead_port, live_rid: live_port},
+                health_ttl_s=60.0)
+            # pin the dead endpoint "healthy" so the router picks it,
+            # hits the refused connection, and fails over
+            rt2._health[dead_rid] = (time.monotonic(),
+                                     sup.healthz(survivors[0]))
+            try:
+                with context.activate(burst_ctx):
+                    keys, vals = self._payload(100, 0)
+                    out = rt2.aggregate(keys, vals, deadline_s=60,
+                                        tenant="burst").result(240)
+                for field in ("group_keys", "sums", "have"):
+                    assert np.array_equal(out[field],
+                                          ref[(100, 0)][field])
+            finally:
+                rt2.close()
+                blocker.close()
+
+            fleet_events = federation._load_fleet_events(
+                str(tmp_path / "fleet"))
+            merged = list(obs.events()) + fleet_events
+            tid = burst_ctx.trace_id
+            mine = [e for e in merged if e.get("kind") == "span"
+                    and e.get("trace_id") == tid]
+            rpcs = [e for e in mine if e.get("name") == "serve.rpc"]
+            lanes_hit = {str(e.get("replica")) for e in rpcs}
+            assert str(victim) in lanes_hit, (
+                f"no request span on the killed replica: {lanes_hit}")
+            assert len(lanes_hit) >= 2, lanes_hit
+            retried = [e for e in rpcs
+                       if int(e.get("attempt") or 0) >= 1]
+            assert any(str(e.get("replica")) != str(victim)
+                       for e in retried), (
+                "failover re-send never reached a successor replica")
+            subs = [e for e in mine if e.get("name") == "fleet.submit"]
+            assert any(int(e.get("attempts") or 0) >= 2 for e in subs)
+
+            # the merged Perfetto doc: distinct per-replica lanes, and
+            # schema-valid cross-process flow arrows joining the router
+            # slice to every replica that attempted the request
+            tdoc_all = trace.trace_events(merged)["traceEvents"]
+            for e in tdoc_all:
+                assert e["ph"] in ("M", "B", "E", "X", "C", "i",
+                                   "s", "f")
+            flows = [e for e in tdoc_all
+                     if e.get("cat") == "srj.flow"
+                     and e["name"] == "rpc"]
+            ss = {e["id"]: e for e in flows if e["ph"] == "s"}
+            fs = {e["id"]: e for e in flows if e["ph"] == "f"}
+            assert ss and set(ss) == set(fs)
+            for fid, s in ss.items():
+                f = fs[fid]
+                assert f["bp"] == "e" and f["ts"] >= s["ts"]
+                assert s["pid"] != f["pid"]
+            assert len({f["pid"] for f in fs.values()}) >= 2, (
+                "flow arrows must land on >= 2 replica lanes")
+            pnames = {e["args"]["name"] for e in tdoc_all
+                      if e["ph"] == "M" and e["name"] == "process_name"}
+            assert sum(1 for p in pnames
+                       if p.startswith("replica:")) >= 2, pnames
+
+            # ---- metrics federation: replica labels + fleet sums ----
+            fed = sup.federation
+            assert fed is not None, "federation must be on by default"
+            fed.scrape_now()
+            expo = fed.exposition()
+            assert 'srj_tpu_serve_requests_total{replica="' in expo
+            fleet_req = federation._find(
+                federation.parse_exposition(expo),
+                "srj_tpu_fleet_requests_total")
+            assert fleet_req is not None and fleet_req[3]
+            per = {}
+            for rid, port in sorted(sup.endpoints().items()):
+                raw = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=10).read().decode()
+                per[str(rid)] = federation.parse_exposition(raw)
+            want = {tuple(sorted(lb.items())): v for lb, v in
+                    federation.merge_samples(
+                        per, "srj_tpu_serve_requests_total", "sum")}
+            got = {tuple(sorted(lb.items())): v
+                   for _s, lb, v in fleet_req[3]}
+            assert got == want, (got, want)
+            assert sum(want.values()) >= 32  # the burst is in there
+            hdoc = fed.health()
+            assert hdoc["replicas"] == 3, hdoc
+            assert hdoc["ready_count"] == 3, hdoc
+
+            # the supervisor-process exporter serves the federated
+            # exposition and the fleet health rollup over HTTP
+            xport = exporter.start(0)
+            try:
+                raw = urllib.request.urlopen(
+                    f"http://127.0.0.1:{xport}/metrics/fleet",
+                    timeout=10).read().decode()
+                assert "srj_tpu_fleet_requests_total" in raw
+                assert 'srj_tpu_fleet_replica_ready{replica="' in raw
+                status, live = _get(xport, "/healthz")
+                assert status == 200
+                assert live["fleet_federation"]["ready_count"] == 3
+            finally:
+                exporter.stop()
+
+            # ---- incident correlation across replica diag dirs ----
+            # the same poisoned request (one trace, two attempts) fired
+            # at two replicas leaves a flight-recorder bundle in each
+            # diag dir; the fleet incident index joins them on trace_id
+            inc_ctx = context.root(tenant="incident")
+            inc_trace = {"trace_id": inc_ctx.trace_id,
+                         "span_id": inc_ctx.span_id,
+                         "tenant": "incident"}
+            for n, rid in enumerate(survivors[:2]):
+                body = json.dumps({
+                    "key": "incident-shared", "tenant": "incident",
+                    "op": "nosuchop", "kwargs": {},
+                    "trace": inc_trace, "attempt": n}).encode()
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{sup.endpoints()[rid]}"
+                    "/v1/submit", data=body,
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                rdoc = json.loads(urllib.request.urlopen(
+                    req, timeout=30).read())
+                assert not rdoc.get("ok")
+            idx = federation.incident_index(str(tmp_path / "fleet"))
+            hits = idx.get(inc_ctx.trace_id) or []
+            inc_reps = {h["replica"] for h in hits}
+            assert len(inc_reps) >= 2, (sorted(idx), hits)
+            corr = federation.correlated_incidents(
+                str(tmp_path / "fleet"))
+            assert inc_ctx.trace_id in corr
         finally:
+            obs.disable()
+            obs.clear()
             if rt is not None:
                 rt.close()
             sup.stop()
